@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Benchmark: Gibbs sweep throughput at 1024 chains vs. single-chain NumPy.
+
+The BASELINE.json metric: "Gibbs sweeps/sec/chip (1024 chains)" on a
+J1713-scale dataset (n=130 TOAs, m=74 basis columns, the mixture model),
+with ``vs_baseline`` the wall-clock speedup of the 1024-chain TPU kernel
+over the single-chain NumPy oracle for the same number of per-chain sweeps
+— the north-star's >=50x criterion.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def build(ntoa: int, components: int, seed: int = 42):
+    from gibbs_student_t_tpu.data.demo import make_demo_model_arrays
+
+    return make_demo_model_arrays(n=ntoa, components=components, seed=seed)
+
+
+def bench_numpy(ma, cfg, nsweeps: int, seed: int = 0) -> float:
+    from gibbs_student_t_tpu.backends import NumpyGibbs
+
+    gb = NumpyGibbs(ma, cfg)
+    rng = np.random.default_rng(seed)
+    x0 = ma.x_init(rng)
+    gb.sample(x0, 20, rng=rng)  # warm caches
+    t0 = time.perf_counter()
+    gb.sample(x0, nsweeps, rng=rng)
+    return nsweeps / (time.perf_counter() - t0)
+
+
+def bench_jax(ma, cfg, nchains: int, nsweeps: int, chunk: int,
+              seed: int = 0) -> float:
+    from gibbs_student_t_tpu.backends import JaxGibbs
+
+    gb = JaxGibbs(ma, cfg, nchains=nchains, chunk_size=chunk)
+    # warmup: compile + one chunk
+    state = gb.init_state(seed=seed)
+    gb.sample(niter=chunk, seed=seed, state=state)
+    state = gb.last_state
+    t0 = time.perf_counter()
+    gb.sample(niter=nsweeps, seed=seed, state=state, start_sweep=chunk)
+    dt = time.perf_counter() - t0
+    return nsweeps / dt  # per-chain sweeps/sec (all chains advance together)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nchains", type=int, default=1024)
+    ap.add_argument("--ntoa", type=int, default=130)
+    ap.add_argument("--components", type=int, default=30)
+    ap.add_argument("--niter", type=int, default=200,
+                    help="timed sweeps for the JAX kernel")
+    ap.add_argument("--baseline-sweeps", type=int, default=150)
+    ap.add_argument("--chunk", type=int, default=100)
+    ap.add_argument("--model", default="mixture")
+    ap.add_argument("--quick", action="store_true",
+                    help="small shapes for smoke-testing the benchmark")
+    args = ap.parse_args(argv)
+
+    if args.quick:
+        args.nchains, args.niter = 32, 50
+        args.baseline_sweeps, args.chunk = 30, 25
+
+    from gibbs_student_t_tpu.config import GibbsConfig
+
+    cfg = GibbsConfig(model=args.model, vary_df=True, theta_prior="beta")
+    ma = build(args.ntoa, args.components)
+
+    numpy_sps = bench_numpy(ma, cfg, args.baseline_sweeps)
+    jax_sps = bench_jax(ma, cfg, args.nchains, args.niter, args.chunk)
+
+    # wall-clock speedup for the same per-chain sweep count, i.e. the
+    # north-star "1024 chains vs single-chain NumPy" factor: each JAX sweep
+    # advances nchains chains at once.
+    chain_sweeps_per_sec = jax_sps * args.nchains
+    vs_baseline = chain_sweeps_per_sec / numpy_sps
+
+    print(json.dumps({
+        "metric": f"gibbs_chain_sweeps_per_sec_{args.nchains}chains",
+        "value": round(chain_sweeps_per_sec, 2),
+        "unit": "chain-sweeps/s",
+        "vs_baseline": round(vs_baseline, 2),
+    }))
+    print(f"# numpy single-chain: {numpy_sps:.1f} sweeps/s; "
+          f"jax {args.nchains} chains: {jax_sps:.1f} sweeps/s/chain",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""gibbs_student_t_tpu — a TPU-native framework for robust (Student-t /
+Gaussian-mixture) Gibbs sampling of pulsar-timing-array noise models.
+
+A ground-up JAX/XLA re-design with the capabilities of the reference
+``aniwl/gibbs_student_t`` (blocked Metropolis-within-Gibbs sampler for PTA
+outlier analysis; see /root/reference/gibbs.py). Where the reference is a
+single-chain CPU NumPy code sitting on enterprise/libstempo/LAPACK, this
+framework is:
+
+- **pure-functional**: the sampler sweep is a pure function over an explicit
+  chain-state pytree, ``jit``-compiled once;
+- **chain data-parallel**: ``vmap`` over 1000+ independent chains per chip;
+- **device-parallel**: ``shard_map`` over a ``jax.sharding.Mesh`` for
+  multi-chain / multi-pulsar ensembles, with XLA collectives for cross-chain
+  diagnostics only (chains are independent);
+- **self-contained**: first-party par/tim ingestion, timing-model basis,
+  signal/PTA model layer, and simulator replace enterprise + libstempo/tempo2.
+
+Layout:
+  data/      host-side NumPy ingestion + simulation (par/tim, design matrix)
+  models/    parameters, signal algebra, PTA seam, frozen ModelArrays
+  backends/  SamplerBackend seam: NumPy oracle + JAX TPU kernel
+  ops/       numerics: safe Cholesky, distributions, structured covariance
+  parallel/  mesh/sharding helpers, cross-chain diagnostics
+  utils/     RNG trees, chain storage/spooling, checkpointing
+"""
+
+__version__ = "0.1.0"
+
+from gibbs_student_t_tpu.config import GibbsConfig, MHConfig
+from gibbs_student_t_tpu.models.pta import PTA, ModelArrays
+
+__all__ = ["GibbsConfig", "MHConfig", "PTA", "ModelArrays", "__version__"]

@@ -1,0 +1,15 @@
+"""Sampler backends behind the SamplerBackend plugin seam.
+
+Two implementations of the same blocked MH-within-Gibbs kernel
+(reference gibbs.py:342-385):
+
+- ``numpy``: single-chain host oracle, a cleaned Python-3 equivalent of the
+  reference sampler — the correctness baseline for KS gates;
+- ``jax``: the TPU-native jit+vmap kernel running many chains data-parallel.
+"""
+
+from gibbs_student_t_tpu.backends.base import ChainResult, SamplerBackend, get_backend
+from gibbs_student_t_tpu.backends.numpy_backend import NumpyGibbs
+from gibbs_student_t_tpu.backends.jax_backend import JaxGibbs
+
+__all__ = ["SamplerBackend", "ChainResult", "get_backend", "NumpyGibbs", "JaxGibbs"]

@@ -1,0 +1,85 @@
+"""SamplerBackend seam and chain containers.
+
+The plugin boundary named by the north star (BASELINE.json): drivers select
+``--backend={cpu,jax}`` and everything behind this interface is free to be
+host NumPy or a jitted TPU kernel. The chain surface mirrors the seven
+chain arrays of the reference (reference gibbs.py:344-350): ``chain``
+(hyper/white params), ``bchain``, ``zchain``, ``thetachain``, ``alphachain``,
+``poutchain``, ``dfchain`` — with a leading chain axis in the JAX backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import numpy as np
+
+from gibbs_student_t_tpu.config import GibbsConfig
+from gibbs_student_t_tpu.models.pta import ModelArrays
+
+
+@dataclasses.dataclass
+class ChainResult:
+    """Sampled chains. Arrays are shaped ``(niter, ...)`` for single-chain
+    backends and ``(niter, nchains, ...)`` for vmapped backends."""
+
+    chain: np.ndarray        # parameter vectors
+    bchain: np.ndarray       # basis coefficients
+    zchain: np.ndarray       # outlier indicators
+    thetachain: np.ndarray   # outlier fraction
+    alphachain: np.ndarray   # per-TOA variance scales
+    poutchain: np.ndarray    # per-TOA outlier probabilities
+    dfchain: np.ndarray      # Student-t dof
+    stats: Dict[str, np.ndarray] = dataclasses.field(default_factory=dict)
+
+    def burn(self, nburn: int) -> "ChainResult":
+        """Drop burn-in samples (reference run_sims.py:118-124 drops 100).
+        Per-sweep stats arrays are trimmed too so they stay aligned with
+        the chains."""
+        return ChainResult(
+            **{
+                f.name: getattr(self, f.name)[nburn:]
+                for f in dataclasses.fields(self)
+                if f.name not in ("stats",)
+            },
+            stats={k: v[nburn:] for k, v in self.stats.items()},
+        )
+
+    def save(self, outdir: str) -> None:
+        """Persist in the reference's on-disk layout
+        (reference run_sims.py:118-124)."""
+        import os
+
+        os.makedirs(outdir, exist_ok=True)
+        for name in ("chain", "bchain", "zchain", "poutchain",
+                     "thetachain", "alphachain", "dfchain"):
+            np.save(os.path.join(outdir, f"{name}.npy"), getattr(self, name))
+
+
+class SamplerBackend:
+    """Common construction: a frozen model + config; subclasses implement
+    ``sample``. ``supports_chains`` advertises a vmapped chain axis (and a
+    ``nchains=`` constructor kwarg) so drivers can dispatch generically."""
+
+    supports_chains = False
+
+    def __init__(self, ma: ModelArrays, config: GibbsConfig):
+        self.ma = ma
+        self.config = config
+
+    def sample(self, x0: np.ndarray, niter: int,
+               seed: int = 0) -> ChainResult:
+        raise NotImplementedError
+
+
+def get_backend(name: str):
+    """Resolve a backend by flag value (north-star ``--backend={cpu,jax}``)."""
+    from gibbs_student_t_tpu.backends.numpy_backend import NumpyGibbs
+    from gibbs_student_t_tpu.backends.jax_backend import JaxGibbs
+
+    table = {"cpu": NumpyGibbs, "numpy": NumpyGibbs, "jax": JaxGibbs,
+             "tpu": JaxGibbs}
+    if name not in table:
+        raise ValueError(f"unknown backend {name!r}; options: {sorted(table)}")
+    return table[name]

@@ -1,0 +1,363 @@
+"""JAX TPU backend: the jit+vmap blocked MH-within-Gibbs kernel.
+
+TPU-native re-design of the reference sweep (reference gibbs.py:342-385).
+One sweep is a pure function ``(ChainState, key) -> ChainState``; chains are
+data-parallel via ``vmap`` (the north-star 1024-chains/chip axis,
+BASELINE.json); sweeps advance under ``lax.scan`` in fixed-size chunks whose
+records are spooled to host between chunks, which doubles as the
+checkpoint surface (SURVEY.md §5).
+
+Design choices vs. the reference, per SURVEY.md §7:
+
+- the 20-step white and 10-step hyper Metropolis inner loops
+  (gibbs.py:88,121) are ``lax.fori_loop``s with branchless masked
+  accepts — per-chain data-dependent control flow cannot branch under jit;
+- the random scale-mixture/coordinate jump (gibbs.py:91-97) becomes
+  ``categorical`` + dynamic-index scatter;
+- the per-sweep ``TNT``/``d`` cache (gibbs.py:38-39,302-304) becomes plain
+  dataflow: computed once after the white block, threaded to the hyper
+  block and coefficient draw;
+- all LAPACK factorizations are the diagonally-preconditioned Cholesky of
+  ``ops/linalg.py``; non-PD matrices yield NaN -> -inf -> MH rejection,
+  replacing try/except fallbacks (gibbs.py:168-178,320-324);
+- ``update_alpha``'s data-dependent gate ``sum(z) >= 1`` (gibbs.py:234)
+  is a ``where`` mask; ``update_z``'s NaN clamp (gibbs.py:224) is a
+  ``where``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax, random
+from jax.scipy.special import gammaln
+
+from gibbs_student_t_tpu.backends.base import ChainResult, SamplerBackend
+from gibbs_student_t_tpu.config import GibbsConfig
+from gibbs_student_t_tpu.models.pta import ModelArrays, lnprior, ndiag, phiinv_logdet
+from gibbs_student_t_tpu.ops.linalg import (
+    gaussian_draw,
+    precond_cholesky,
+    precond_solve_quad,
+    robust_precond_cholesky,
+)
+
+
+class ChainState(NamedTuple):
+    """Per-chain sampler state — the full pytree a checkpoint needs
+    (SURVEY.md §5 'checkpoint/resume')."""
+
+    x: jnp.ndarray        # (p,) sampled parameters
+    b: jnp.ndarray        # (m,) basis coefficients
+    z: jnp.ndarray        # (n,) outlier indicators
+    alpha: jnp.ndarray    # (n,) variance scales
+    theta: jnp.ndarray    # () outlier fraction
+    df: jnp.ndarray       # () Student-t dof
+    pout: jnp.ndarray     # (n,) outlier probabilities (derived metric)
+    acc_white: jnp.ndarray  # () last-sweep acceptance rate
+    acc_hyper: jnp.ndarray  # ()
+
+
+_RECORD_FIELDS = ("x", "b", "z", "theta", "alpha", "df", "pout",
+                  "acc_white", "acc_hyper")
+
+
+class JaxGibbs(SamplerBackend):
+    """Many-chain Gibbs sampler; ``sample`` returns ``(niter, nchains, ...)``
+    chains like a stacked version of the reference's attribute arrays."""
+
+    supports_chains = True
+
+    def __init__(self, ma: ModelArrays, config: GibbsConfig,
+                 nchains: int = 64, dtype=jnp.float32,
+                 chunk_size: int = 100):
+        super().__init__(ma, config)
+        self.nchains = nchains
+        self.dtype = dtype
+        self.chunk_size = chunk_size
+        # dtype-cast copy of the frozen model so every kernel array (and the
+        # constants XLA embeds) live in the compute precision
+        self._ma = dataclasses.replace(
+            ma,
+            y=np.asarray(ma.y, dtype=dtype),
+            T=np.asarray(ma.T, dtype=dtype),
+            sigma2=np.asarray(ma.sigma2, dtype=dtype),
+            efac_masks=np.asarray(ma.efac_masks, dtype=dtype),
+            efac_const=np.asarray(ma.efac_const, dtype=dtype),
+            equad_masks=np.asarray(ma.equad_masks, dtype=dtype),
+            equad_const=np.asarray(ma.equad_const, dtype=dtype),
+        )
+        self._pspin = (config.pspin * ma.time_scale
+                       if config.pspin is not None else 1.0)
+        self._chunk_fn = jax.jit(self._make_chunk_fn(),
+                                 static_argnames=("length",))
+        self.last_state: Optional[ChainState] = None
+
+    # ------------------------------------------------------------------
+    # state construction
+    # ------------------------------------------------------------------
+
+    def init_state(self, x0: Optional[np.ndarray] = None,
+                   seed: int = 0) -> ChainState:
+        ma, cfg = self._ma, self.config
+        rng = np.random.default_rng(seed)
+        if x0 is None:
+            x0 = np.stack([ma.x_init(rng) for _ in range(self.nchains)])
+        x0 = np.asarray(x0, dtype=self.dtype)
+        if x0.ndim == 1:
+            x0 = np.broadcast_to(x0, (self.nchains, len(x0))).copy()
+        n, m, c = ma.n, ma.m, self.nchains
+        z0 = jnp.full((c, n), 1.0 if cfg.z_init_ones else 0.0,
+                      dtype=self.dtype)
+        alpha0 = jnp.full((c, n), 1.0 if cfg.vary_alpha else cfg.alpha,
+                          dtype=self.dtype)
+        return ChainState(
+            x=jnp.asarray(x0),
+            b=jnp.zeros((c, m), dtype=self.dtype),
+            z=z0,
+            alpha=alpha0,
+            theta=jnp.full((c,), cfg.outlier_mean, dtype=self.dtype),
+            df=jnp.full((c,), float(cfg.tdf), dtype=self.dtype),
+            pout=jnp.zeros((c, n), dtype=self.dtype),
+            acc_white=jnp.zeros((c,), dtype=self.dtype),
+            acc_hyper=jnp.zeros((c,), dtype=self.dtype),
+        )
+
+    # ------------------------------------------------------------------
+    # single-chain sweep
+    # ------------------------------------------------------------------
+
+    def _lnprior(self, x):
+        return lnprior(self._ma, x, jnp)
+
+    def _mh_block(self, x, key, ind: np.ndarray, nsteps: int, loglike_fn):
+        """Branchless random-walk Metropolis on a coordinate block
+        (reference gibbs.py:80-143)."""
+        mh = self.config.mh
+        sigma = mh.sigma_per_param * len(ind)
+        sizes = jnp.asarray(mh.scale_sizes, dtype=self.dtype)
+        logits = jnp.log(jnp.asarray(mh.scale_probs, dtype=self.dtype))
+        ind = jnp.asarray(ind)
+
+        ll0 = loglike_fn(x)
+        lp0 = self._lnprior(x)
+
+        def body(_, carry):
+            x, ll0, lp0, acc, key = carry
+            key, k1, k2, k3, k4 = random.split(key, 5)
+            scale = sizes[random.categorical(k1, logits)]
+            par = ind[random.randint(k2, (), 0, len(ind))]
+            q = x.at[par].add(random.normal(k3, dtype=self.dtype)
+                              * sigma * scale)
+            ll1 = loglike_fn(q)
+            lp1 = self._lnprior(q)
+            logu = jnp.log(random.uniform(k4, dtype=self.dtype))
+            accept = (ll1 + lp1) - (ll0 + lp0) > logu
+            x = jnp.where(accept, q, x)
+            ll0 = jnp.where(accept, ll1, ll0)
+            lp0 = jnp.where(accept, lp1, lp0)
+            return (x, ll0, lp0, acc + accept, key)
+
+        x, _, _, acc, _ = lax.fori_loop(
+            0, nsteps, body,
+            (x, ll0, lp0, jnp.zeros((), dtype=self.dtype), key))
+        return x, acc / nsteps
+
+    def _sweep(self, state: ChainState, key, ma: ModelArrays | None = None
+               ) -> ChainState:
+        """One full Gibbs sweep. ``ma`` defaults to the backend's frozen
+        model (embedded as constants); the ensemble path passes a traced
+        per-pulsar ModelArrays pytree instead (parallel/ensemble.py)."""
+        if ma is None:
+            ma = self._ma
+        cfg = self.config
+        n, m = ma.n, ma.m
+        kw, kh, kb, kt, kz, ka, kd = random.split(key, 7)
+        x, b, z, alpha, theta, df = (state.x, state.b, state.z, state.alpha,
+                                     state.theta, state.df)
+
+        # --- white-noise MH block (reference gibbs.py:114-143) ---------
+        az = alpha ** z
+        if len(ma.white_indices):
+            Tb = ma.T @ b
+
+            def ll_white(xq):
+                nvec = az * ndiag(ma, xq, jnp)
+                yred = ma.y - Tb
+                return -0.5 * (jnp.sum(jnp.log(nvec))
+                               + jnp.sum(yred * yred / nvec))
+
+            x, acc_w = self._mh_block(x, kw, ma.white_indices,
+                                      cfg.mh.n_white_steps, ll_white)
+        else:
+            acc_w = jnp.zeros((), dtype=self.dtype)
+
+        # --- per-sweep inner products (reference gibbs.py:302-304) -----
+        nvec = az * ndiag(ma, x, jnp)
+        TNT = ma.T.T @ (ma.T / nvec[:, None])
+        d = ma.T.T @ (ma.y / nvec)
+        const_white = -0.5 * (jnp.sum(jnp.log(nvec))
+                              + jnp.sum(ma.y * ma.y / nvec))
+
+        # --- hyper MH block on the marginalized likelihood -------------
+        # (reference gibbs.py:80-111, 288-329)
+        def ll_hyper(xq):
+            phiinv, logdet_phi = phiinv_logdet(ma, xq, jnp)
+            Sigma = TNT + jnp.diag(phiinv)
+            L, isd, logdet_sigma = precond_cholesky(Sigma, cfg.jitter)
+            _, quad = precond_solve_quad(L, isd, d)
+            ll = const_white + 0.5 * (quad - logdet_sigma - logdet_phi)
+            return jnp.where(jnp.isfinite(ll), ll, -jnp.inf)
+
+        if len(ma.hyper_indices):
+            x, acc_h = self._mh_block(x, kh, ma.hyper_indices,
+                                      cfg.mh.n_hyper_steps, ll_hyper)
+        else:
+            acc_h = jnp.zeros((), dtype=self.dtype)
+
+        # --- coefficient draw b ~ N(Sigma^-1 d, Sigma^-1) --------------
+        # (reference gibbs.py:145-182; always-redraw, see numpy_backend).
+        # The draw cannot MH-reject, so it uses the escalating-jitter
+        # factorization (the reference's SVD->QR fallback role,
+        # gibbs.py:168-178).
+        phiinv, _ = phiinv_logdet(ma, x, jnp)
+        Sigma = TNT + jnp.diag(phiinv)
+        L, isd, _ = robust_precond_cholesky(
+            Sigma, jitters=(cfg.jitter, 1e-4, 1e-2, 1e-1))
+        mean, _ = precond_solve_quad(L, isd, d)
+        b = gaussian_draw(L, isd, mean,
+                          random.normal(kb, (m,), dtype=self.dtype))
+
+        resid = ma.y - ma.T @ b
+        nvec0 = ndiag(ma, x, jnp)
+
+        # --- outlier fraction theta ~ Beta (reference gibbs.py:185-198) -
+        if cfg.is_outlier_model:
+            if cfg.theta_prior == "beta":
+                mk = n * cfg.outlier_mean
+                k1mm = n * (1.0 - cfg.outlier_mean)
+            else:
+                mk = k1mm = 1.0
+            sz = jnp.sum(z)
+            theta = random.beta(kt, sz + mk, n - sz + k1mm,
+                                dtype=self.dtype)
+
+        # --- outlier indicators z ~ Bernoulli (reference gibbs.py:201-226)
+        pout = state.pout
+        if cfg.is_outlier_model:
+            p_in = _norm_pdf(resid, nvec0)
+            if cfg.model == "vvh17":
+                top = jnp.full((n,), theta / self._pspin, dtype=self.dtype)
+            else:
+                top = theta * _norm_pdf(resid, alpha * nvec0)
+            bot = top + (1.0 - theta) * p_in
+            q = top / bot
+            q = jnp.where(jnp.isnan(q), 1.0, q)
+            pout = q
+            z = random.bernoulli(kz, jnp.clip(q, 0.0, 1.0)).astype(self.dtype)
+
+        # --- auxiliary scales alpha (reference gibbs.py:229-242) --------
+        if cfg.vary_alpha:
+            top = (resid * resid * z / nvec0 + df) / 2.0
+            g = random.gamma(ka, (z + df) / 2.0, dtype=self.dtype)
+            alpha_new = top / g
+            alpha = jnp.where(jnp.sum(z) >= 1.0, alpha_new, alpha)
+
+        # --- degrees of freedom on the grid (reference gibbs.py:244-259)
+        if cfg.vary_df:
+            grid = jnp.arange(1, cfg.df_max + 1, dtype=self.dtype)
+            s = jnp.sum(jnp.log(alpha) + 1.0 / alpha)
+            logp = (-(grid / 2.0) * s
+                    + n * (grid / 2.0) * jnp.log(grid / 2.0)
+                    - n * gammaln(grid / 2.0))
+            df = grid[random.categorical(kd, logp)]
+
+        return ChainState(x=x, b=b, z=z, alpha=alpha, theta=theta, df=df,
+                          pout=pout, acc_white=acc_w, acc_hyper=acc_h)
+
+    # ------------------------------------------------------------------
+    # chunked driver
+    # ------------------------------------------------------------------
+
+    def _make_chunk_fn(self):
+        def one_chain(state, chain_key, offset, length):
+            def body(st, i):
+                rec = tuple(getattr(st, f) for f in _RECORD_FIELDS)
+                st = self._sweep(st, random.fold_in(chain_key, offset + i))
+                return st, rec
+
+            return lax.scan(body, state, jnp.arange(length))
+
+        def chunk(states, keys, offset, length):
+            return jax.vmap(
+                functools.partial(one_chain, offset=offset, length=length)
+            )(states, keys)
+
+        return chunk
+
+    def sweep_fn(self):
+        """Jitted vmapped single sweep — the benchmark/graft entry surface."""
+        return jax.jit(jax.vmap(self._sweep))
+
+    def lnlikelihood(self, x, z=None, alpha=None):
+        """Single-point marginalized log-likelihood, for parity tests
+        against the NumPy oracle (same math as the hyper-block's
+        ``ll_hyper``)."""
+        ma, cfg = self._ma, self.config
+        x = jnp.asarray(x, dtype=self.dtype)
+        z = (jnp.zeros(ma.n, dtype=self.dtype) if z is None
+             else jnp.asarray(z, dtype=self.dtype))
+        alpha = (jnp.ones(ma.n, dtype=self.dtype) if alpha is None
+                 else jnp.asarray(alpha, dtype=self.dtype))
+        nvec = alpha ** z * ndiag(ma, x, jnp)
+        TNT = ma.T.T @ (ma.T / nvec[:, None])
+        d = ma.T.T @ (ma.y / nvec)
+        const_white = -0.5 * (jnp.sum(jnp.log(nvec))
+                              + jnp.sum(ma.y * ma.y / nvec))
+        phiinv, logdet_phi = phiinv_logdet(ma, x, jnp)
+        Sigma = TNT + jnp.diag(phiinv)
+        L, isd, logdet_sigma = precond_cholesky(Sigma, cfg.jitter)
+        _, quad = precond_solve_quad(L, isd, d)
+        ll = const_white + 0.5 * (quad - logdet_sigma - logdet_phi)
+        return float(jnp.where(jnp.isfinite(ll), ll, -jnp.inf))
+
+    def sample(self, x0: Optional[np.ndarray] = None, niter: int = 1000,
+               seed: int = 0, state: Optional[ChainState] = None,
+               start_sweep: int = 0) -> ChainResult:
+        """Run ``niter`` sweeps for all chains; spool records to host per
+        chunk. Pass ``state``/``start_sweep`` (e.g. from a checkpoint) to
+        resume — the per-sweep ``fold_in`` keying makes the continuation
+        identical to an unbroken run."""
+        if state is None:
+            state = self.init_state(x0, seed=seed)
+        keys = random.split(random.PRNGKey(seed), self.nchains)
+        records = []
+        done = 0
+        while done < niter:
+            length = min(self.chunk_size, niter - done)
+            state, recs = self._chunk_fn(state, keys,
+                                         start_sweep + done, length=length)
+            records.append(jax.device_get(recs))
+            done += length
+        self.last_state = state
+
+        cols = {
+            f: np.concatenate([np.swapaxes(r[i], 0, 1) for r in records])
+            for i, f in enumerate(_RECORD_FIELDS)
+        }
+        return ChainResult(
+            chain=cols["x"], bchain=cols["b"], zchain=cols["z"],
+            thetachain=cols["theta"], alphachain=cols["alpha"],
+            poutchain=cols["pout"], dfchain=cols["df"],
+            stats={"acc_white": cols["acc_white"],
+                   "acc_hyper": cols["acc_hyper"]},
+        )
+
+
+def _norm_pdf(x, var):
+    return jnp.exp(-0.5 * x * x / var) / jnp.sqrt(2.0 * jnp.pi * var)

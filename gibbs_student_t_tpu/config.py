@@ -1,0 +1,78 @@
+"""Configuration dataclasses for the sampler.
+
+The reference spreads configuration across constructor kwargs
+(reference gibbs.py:9-11) and hard-coded constants in the drivers
+(reference run_sims.py:32-35, 57-76) with the MH step-size table duplicated
+inline in two methods (reference gibbs.py:92-94, 125-127). Here every knob is
+a frozen dataclass so configs hash, print, and thread through jit as static
+arguments.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+# Likelihood families of the reference (gibbs.py:50, 187-189, 206-208):
+#   gaussian : plain Gaussian likelihood, z == 0 throughout
+#   t        : Student-t via per-TOA auxiliary inverse-gamma scales, z == 1
+#   mixture  : Gaussian/Gaussian outlier mixture with Bernoulli indicators
+#   vvh17    : Vallisneri & van Haasteren (2017) uniform-in-phase outlier model
+MODELS = ("gaussian", "t", "mixture", "vvh17")
+
+THETA_PRIORS = ("beta", "uniform")
+
+
+@dataclasses.dataclass(frozen=True)
+class MHConfig:
+    """Random-walk Metropolis jump kernel shared by the white and hyper blocks.
+
+    Mirrors the jump structure of reference gibbs.py:88-97 and 121-130: a
+    scale drawn from a discrete mixture, one uniformly-chosen coordinate per
+    step, sigma proportional to the size of the parameter group.
+    """
+
+    n_white_steps: int = 20       # reference gibbs.py:121
+    n_hyper_steps: int = 10       # reference gibbs.py:88
+    sigma_per_param: float = 0.05  # reference gibbs.py:92,125
+    scale_sizes: Tuple[float, ...] = (0.1, 0.5, 1.0, 3.0, 10.0)
+    scale_probs: Tuple[float, ...] = (0.1, 0.15, 0.5, 0.15, 0.1)
+
+
+@dataclasses.dataclass(frozen=True)
+class GibbsConfig:
+    """Model flags of the reference ``Gibbs.__init__`` (gibbs.py:9-51)."""
+
+    model: str = "gaussian"
+    tdf: int = 4                   # Student-t degrees of freedom (initial/fixed)
+    outlier_mean: float = 0.01     # `m`, a-priori outlier probability
+    vary_df: bool = True
+    theta_prior: str = "beta"
+    vary_alpha: bool = True
+    alpha: float = 1e10            # fixed alpha when vary_alpha=False
+    pspin: float | None = None     # spin period (s), needed by model='vvh17'
+    df_max: int = 30               # df grid 1..df_max (reference gibbs.py:248)
+    mh: MHConfig = dataclasses.field(default_factory=MHConfig)
+    # Cholesky jitter added to Sigma's (preconditioned) diagonal. Plays the
+    # role of the reference's SVD->QR fallback / -inf guard
+    # (gibbs.py:168-178, 320-324) in branchless form.
+    jitter: float = 1e-6
+
+    def __post_init__(self):
+        if self.model not in MODELS:
+            raise ValueError(f"model must be one of {MODELS}, got {self.model!r}")
+        if self.theta_prior not in THETA_PRIORS:
+            raise ValueError(
+                f"theta_prior must be one of {THETA_PRIORS}, got {self.theta_prior!r}"
+            )
+        if self.model == "vvh17" and self.pspin is None:
+            raise ValueError("model='vvh17' requires pspin (spin period in s)")
+
+    @property
+    def is_outlier_model(self) -> bool:
+        return self.model in ("mixture", "vvh17")
+
+    @property
+    def z_init_ones(self) -> bool:
+        # reference gibbs.py:50-51: z starts at 1 for t/mixture/vvh17
+        return self.model in ("t", "mixture", "vvh17")

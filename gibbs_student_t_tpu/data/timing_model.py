@@ -1,0 +1,119 @@
+"""Linearized timing model: phase prediction and design matrix.
+
+Replaces the tempo2 (C++) fit machinery that the reference reaches through
+``enterprise.pulsar.Pulsar``/``libstempo`` (reference run_sims.py:47,51;
+simulate_data.py:12-18). Only the linearized path is needed: the sampler
+never refits — it consumes the design matrix ``Mmat`` through an
+SVD-orthonormalized basis (reference run_sims.py:22-25), so what must be
+reproduced is the *span* of the timing columns, not tempo2's exact
+derivatives (SURVEY.md §7 "hard parts").
+
+The phase model is the isolated-pulsar Taylor expansion
+``phi(t) = F0*(t - PEPOCH) + F1/2*(t - PEPOCH)^2`` evaluated in longdouble;
+astrometric and binary fit parameters contribute design columns (annual,
+semi-annual, and orbital harmonics) but no phase-model terms — our simulator
+and reader use the same convention, so the round trip is exact by
+construction.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+from gibbs_student_t_tpu.data.par import Par
+
+SECS_PER_DAY = np.longdouble(86400.0)
+DAYS_PER_YEAR = np.longdouble(365.25)
+
+
+def phase(par: Par, mjds: np.ndarray) -> np.ndarray:
+    """Pulse phase (cycles, longdouble) at each TOA MJD."""
+    dt = (np.asarray(mjds, dtype=np.longdouble) - par.getfloat("PEPOCH")) * SECS_PER_DAY
+    f0 = par.getfloat("F0")
+    f1 = par.getfloat("F1")
+    f2 = par.getfloat("F2")
+    return dt * (f0 + dt * (f1 / 2 + dt * f2 / 6))
+
+
+def prefit_residuals(par: Par, mjds: np.ndarray) -> np.ndarray:
+    """Timing residuals (seconds, float64) from nearest-integer phase wrap.
+
+    Valid while residuals are well inside +-P/2 of a pulse period — true for
+    all datasets in scope (us-scale residuals vs ms-scale periods).
+    """
+    ph = phase(par, mjds)
+    frac = ph - np.rint(ph)
+    f0 = par.getfloat("F0")
+    return np.asarray(frac / f0, dtype=np.float64)
+
+
+def design_matrix(par: Par, mjds: np.ndarray) -> Tuple[np.ndarray, List[str]]:
+    """Design matrix ``M`` (n x m_tm, float64) and its column labels.
+
+    One column per fitted parameter plus the phase offset, mirroring the
+    column count of the tempo2 ``Mmat`` the reference consumes
+    (reference run_sims.py:22-25; SURVEY.md §2.2). Columns are unit-RMS
+    normalized — the downstream SVD basis is scale-invariant.
+    """
+    mjds = np.asarray(mjds, dtype=np.longdouble)
+    pepoch = par.getfloat("PEPOCH", float(mjds.mean()))
+    dt = np.asarray((mjds - pepoch) * SECS_PER_DAY, dtype=np.float64)  # seconds
+    t_yr = np.asarray(
+        (mjds - pepoch) / DAYS_PER_YEAR, dtype=np.float64
+    )  # years since PEPOCH
+    annual = 2 * np.pi * t_yr
+
+    fit = set(par.fit_params())
+    cols: List[np.ndarray] = [np.ones_like(dt)]
+    labels: List[str] = ["OFFSET"]
+
+    def add(label: str, col: np.ndarray):
+        cols.append(col)
+        labels.append(label)
+
+    if "F0" in fit or "F0" in par:
+        add("F0", dt)
+    if "F1" in fit or "F1" in par:
+        add("F1", dt * dt)
+    if "F2" in fit:
+        add("F2", dt ** 3)
+    # Astrometry: sky position -> annual sinusoids; proper motion -> their
+    # secular drift; parallax -> semi-annual term.
+    if "RAJ" in fit:
+        add("RAJ", np.sin(annual))
+    if "DECJ" in fit:
+        add("DECJ", np.cos(annual))
+    if "PMRA" in fit:
+        add("PMRA", t_yr * np.sin(annual))
+    if "PMDEC" in fit:
+        add("PMDEC", t_yr * np.cos(annual))
+    if "PX" in fit:
+        add("PX", np.cos(2 * annual))
+    # Binary block: orbital-frequency fundamentals and harmonics. Distinct
+    # harmonics per parameter keep the columns independent; the SVD basis
+    # consumes only their span.
+    if "PB" in par and ("BINARY" in par or "PB" in fit):
+        pb_days = par.getfloat("PB")
+        t0 = par.getfloat("T0", float(pepoch))
+        orb = np.asarray(
+            2 * np.pi * ((mjds - t0) / pb_days), dtype=np.float64
+        )
+        binary_cols = {
+            "A1": np.sin(orb),
+            "T0": np.cos(orb),
+            "OM": np.sin(2 * orb),
+            "ECC": np.cos(2 * orb),
+            "PB": t_yr * np.sin(orb),
+            "SINI": t_yr * np.cos(orb),
+            "M2": np.sin(3 * orb),
+        }
+        for name, col in binary_cols.items():
+            if name in fit:
+                add(name, col)
+
+    M = np.column_stack(cols)
+    norms = np.sqrt(np.mean(M ** 2, axis=0))
+    norms[norms == 0] = 1.0
+    return M / norms, labels

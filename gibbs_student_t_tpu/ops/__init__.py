@@ -1,0 +1,14 @@
+"""TPU numerics: preconditioned factorizations and distribution draws.
+
+The reference leans on LAPACK (``scipy.linalg`` svd/qr/cho_factor,
+reference gibbs.py:169-178,321-322) with try/except fallbacks. On TPU the
+equivalents must be branchless and batched; this package provides them.
+"""
+
+from gibbs_student_t_tpu.ops.linalg import (
+    gaussian_draw,
+    precond_cholesky,
+    precond_solve_quad,
+)
+
+__all__ = ["precond_cholesky", "precond_solve_quad", "gaussian_draw"]

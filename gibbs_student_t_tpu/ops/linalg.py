@@ -1,0 +1,89 @@
+"""Diagonally-preconditioned Cholesky solves.
+
+``Sigma = T^T N^-1 T + diag(phiinv)`` mixes scales across ~15 decades when
+the red-noise amplitude is small (SURVEY.md §7 "hard parts: float64"): the
+large ``phiinv`` entries sit on the diagonal, so symmetric diagonal
+equilibration ``S' = D^-1/2 Sigma D^-1/2`` brings the matrix to unit
+diagonal and float32-friendly conditioning. All identities:
+
+    Sigma          = D^1/2 S' D^1/2,        L' L'^T = S'
+    Sigma^-1 d     = D^-1/2 S'^-1 (D^-1/2 d)
+    logdet Sigma   = logdet S' + sum log D
+    A A^T = Sigma^-1  for  A = D^-1/2 L'^-T   (Gaussian draws)
+
+This replaces the reference's LAPACK calls *and* its failure handling: a
+non-PD matrix makes ``jnp.linalg.cholesky`` return NaN, which flows to a
+non-finite log-likelihood and an automatic MH rejection — the branchless
+equivalent of the reference's try/except -> -inf (reference
+gibbs.py:320-324) and SVD->QR fallback (gibbs.py:168-178). A small
+``jitter`` on the unit diagonal plays the fallback's regularizing role.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.scipy.linalg import solve_triangular
+
+
+def precond_cholesky(Sigma, jitter: float = 0.0):
+    """Factor ``Sigma`` with diagonal equilibration.
+
+    Returns ``(L, inv_sqrt_d, logdet)`` where ``L`` is the lower Cholesky
+    factor of the equilibrated matrix (plus ``jitter`` on its unit
+    diagonal), ``inv_sqrt_d = D^-1/2``, and ``logdet = logdet Sigma``.
+    """
+    d = jnp.diagonal(Sigma, axis1=-2, axis2=-1)
+    inv_sqrt_d = 1.0 / jnp.sqrt(d)
+    S = Sigma * inv_sqrt_d[..., :, None] * inv_sqrt_d[..., None, :]
+    if jitter:
+        S = S + jitter * jnp.eye(S.shape[-1], dtype=S.dtype)
+    L = jnp.linalg.cholesky(S)
+    logdet = (2.0 * jnp.sum(jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)),
+                            axis=-1)
+              + jnp.sum(jnp.log(d), axis=-1))
+    return L, inv_sqrt_d, logdet
+
+
+def robust_precond_cholesky(Sigma, jitters=(1e-6, 1e-4, 1e-2)):
+    """Escalating-jitter factorization for draws that cannot reject.
+
+    When nearly all TOAs carry huge outlier variances (e.g. the vvh17
+    transient where z starts all-ones, reference gibbs.py:50-51), Sigma is
+    numerically singular in float32: the inlier contribution is rank-one and
+    the 1e-10-relative outlier terms vanish below f32 eps. The b-draw still
+    needs *a* factorization, so candidates are computed at increasing jitter
+    and the first finite one is selected branchlessly. The final jitter is
+    large enough that a unit-diagonal PSD-up-to-rounding matrix always
+    factors in f32.
+    """
+    d = jnp.diagonal(Sigma, axis1=-2, axis2=-1)
+    inv_sqrt_d = 1.0 / jnp.sqrt(d)
+    S = Sigma * inv_sqrt_d[..., :, None] * inv_sqrt_d[..., None, :]
+    eye = jnp.eye(S.shape[-1], dtype=S.dtype)
+    L = jnp.linalg.cholesky(S + jitters[0] * eye)
+    for j in jitters[1:]:
+        ok = jnp.isfinite(L).all()
+        Lj = jnp.linalg.cholesky(S + j * eye)
+        L = jnp.where(ok, L, Lj)
+    logdet = (2.0 * jnp.sum(jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)),
+                            axis=-1)
+              + jnp.sum(jnp.log(d), axis=-1))
+    return L, inv_sqrt_d, logdet
+
+
+def precond_solve_quad(L, inv_sqrt_d, rhs):
+    """Given the factorization from :func:`precond_cholesky`, return
+    ``(Sigma^-1 rhs, rhs^T Sigma^-1 rhs)``."""
+    r = rhs * inv_sqrt_d
+    u = solve_triangular(L, r, lower=True)
+    quad = jnp.sum(u * u, axis=-1)
+    v = solve_triangular(L, u, lower=True, trans="T")
+    return v * inv_sqrt_d, quad
+
+
+def gaussian_draw(L, inv_sqrt_d, mean, xi):
+    """Draw ``b ~ N(mean, Sigma^-1)`` from a standard-normal ``xi`` — the
+    conditional coefficient draw of reference gibbs.py:180 with covariance
+    ``Sigma^-1``: fluctuation = D^-1/2 L^-T xi."""
+    fluct = solve_triangular(L, xi, lower=True, trans="T") * inv_sqrt_d
+    return mean + fluct

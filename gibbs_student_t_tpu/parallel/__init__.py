@@ -1,0 +1,26 @@
+"""Device parallelism: meshes, sharded ensemble sampling, diagnostics.
+
+The reference has no distributed execution at all (SURVEY.md §2.3 — no
+NCCL/MPI/multiprocessing; a single sequential loop). The workload's
+parallel structure is chains x pulsars, both embarrassingly parallel; the
+TPU-native mapping is a ``jax.sharding.Mesh`` over ``('pulsar', 'chain')``
+with ``shard_map``, XLA inserting collectives only for cross-chain
+diagnostics (R-hat/ESS), which ride ICI — never for the sweep itself.
+"""
+
+from gibbs_student_t_tpu.parallel.mesh import make_mesh
+from gibbs_student_t_tpu.parallel.ensemble import EnsembleGibbs, stack_model_arrays
+from gibbs_student_t_tpu.parallel.diagnostics import (
+    effective_sample_size,
+    gelman_rubin,
+    split_rhat,
+)
+
+__all__ = [
+    "make_mesh",
+    "EnsembleGibbs",
+    "stack_model_arrays",
+    "effective_sample_size",
+    "gelman_rubin",
+    "split_rhat",
+]

@@ -1,0 +1,83 @@
+"""Convergence diagnostics: ESS, Gelman-Rubin R-hat, collective variants.
+
+The reference tracks no diagnostics at all — not even MH acceptance
+(SURVEY.md §5). With a chain axis on device, cross-chain statistics are
+where the ``effective-samples/sec`` north-star metric comes from; the
+``*_collective`` form runs inside ``shard_map`` with a ``psum`` over the
+sharded chain axis (the only collective in the framework — chains are
+otherwise independent).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def autocorr_time(x: np.ndarray, c: float = 5.0) -> float:
+    """Integrated autocorrelation time of a 1-D chain (Sokal windowing)."""
+    x = np.asarray(x, dtype=np.float64)
+    n = len(x)
+    x = x - x.mean()
+    # FFT autocorrelation
+    f = np.fft.rfft(x, n=2 * n)
+    acf = np.fft.irfft(f * np.conj(f))[:n]
+    if acf[0] == 0:
+        return 1.0
+    acf /= acf[0]
+    tau = 2.0 * np.cumsum(acf) - 1.0
+    window = np.arange(n) >= c * tau
+    idx = np.argmax(window) if window.any() else n - 1
+    return float(max(tau[idx], 1.0))
+
+
+def effective_sample_size(chains: np.ndarray) -> float:
+    """ESS of ``(niter,)`` or ``(niter, nchains)`` samples: pooled over
+    independent chains, each discounted by its autocorrelation time."""
+    chains = np.atleast_2d(np.asarray(chains, dtype=np.float64).T).T
+    ess = 0.0
+    for k in range(chains.shape[1]):
+        tau = autocorr_time(chains[:, k])
+        ess += chains.shape[0] / tau
+    return float(ess)
+
+
+def gelman_rubin(chains: np.ndarray) -> float:
+    """Potential scale reduction R-hat over ``(niter, nchains)`` samples."""
+    chains = np.asarray(chains, dtype=np.float64)
+    n, m = chains.shape
+    means = chains.mean(axis=0)
+    W = chains.var(axis=0, ddof=1).mean()
+    B = n * means.var(ddof=1)
+    var_plus = (n - 1) / n * W + B / n
+    return float(np.sqrt(var_plus / W))
+
+
+def split_rhat(chains: np.ndarray) -> float:
+    """Rank-normalization-free split-R-hat: halves each chain to detect
+    within-chain drift."""
+    chains = np.asarray(chains, dtype=np.float64)
+    n = chains.shape[0] // 2
+    split = np.concatenate([chains[:n], chains[n:2 * n]], axis=1)
+    return gelman_rubin(split)
+
+
+def rhat_collective(x, axis_name: str):
+    """Per-parameter R-hat across a device-sharded chain axis, computed with
+    ``psum`` collectives inside ``shard_map``.
+
+    ``x`` is ``(local_chains, niter)`` samples of one scalar parameter on
+    this device; the chain axis is sharded over ``axis_name``.
+    """
+    n = x.shape[1]
+    local_means = x.mean(axis=1)                      # (local_chains,)
+    local_vars = x.var(axis=1, ddof=1)
+    m = jax.lax.psum(x.shape[0] * jnp.ones(()), axis_name)
+    mean_sum = jax.lax.psum(local_means.sum(), axis_name)
+    grand = mean_sum / m
+    W = jax.lax.psum(local_vars.sum(), axis_name) / m
+    B = n * jax.lax.psum(((local_means - grand) ** 2).sum(),
+                         axis_name) / (m - 1.0)
+    var_plus = (n - 1.0) / n * W + B / n
+    return jnp.sqrt(var_plus / W)

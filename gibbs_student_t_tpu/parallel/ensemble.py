@@ -1,0 +1,190 @@
+"""Multi-pulsar, multi-chain ensembles sharded over a device mesh.
+
+The reference's batch driver iterates pulsars and model configs in one
+sequential process (reference run_sims.py:80-113; 300k sweeps end to end).
+Here the pulsar ensemble and the chain population are a 2-D ``Mesh``:
+pulsars shard one axis, chains the other, each device sweeping its
+``(local_pulsars, local_chains)`` block independently — per-pulsar
+likelihoods are independent in this model family (reference gibbs.py:28-29
+hard-codes a single pulsar), so the sweep needs no communication at all;
+``psum`` collectives appear only in the cross-chain R-hat diagnostic
+(parallel/diagnostics.py). This realizes BASELINE.json config 5 (32-pulsar
+ensemble across a v5e-8 slice).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import random
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from gibbs_student_t_tpu.backends.base import ChainResult
+from gibbs_student_t_tpu.backends.jax_backend import (
+    _RECORD_FIELDS,
+    ChainState,
+    JaxGibbs,
+)
+from gibbs_student_t_tpu.config import GibbsConfig
+from gibbs_student_t_tpu.models.pta import ModelArrays
+
+
+def _localize_names(ma: ModelArrays) -> ModelArrays:
+    """Strip the pulsar-name prefix from parameter names so every pulsar's
+    static metadata (and therefore pytree structure) is identical and the
+    ensembles can stack."""
+    prefix = ma.name + "_"
+    local = tuple(
+        nm[len(prefix):] if nm.startswith(prefix) else nm
+        for nm in ma.param_names
+    )
+    return dataclasses.replace(ma, name="ensemble", param_names=local)
+
+
+def stack_model_arrays(mas: Sequence[ModelArrays]) -> ModelArrays:
+    """Stack per-pulsar frozen models along a new leading pulsar axis.
+
+    Requires homogeneous shapes (same TOA count, basis size, parameter
+    structure) — the simulated-ensemble regime of BASELINE.json config 5.
+    Heterogeneous real ensembles are padded upstream by the caller.
+    """
+    locs = [_localize_names(ma) for ma in mas]
+    treedef0 = jax.tree.structure(locs[0])
+    for ma in locs[1:]:
+        if jax.tree.structure(ma) != treedef0:
+            raise ValueError(
+                "pulsar models have different structure; ensembles need "
+                "identical signal composition per pulsar")
+    return jax.tree.map(lambda *xs: np.stack(xs), *locs)
+
+
+class EnsembleGibbs:
+    """(pulsars x chains) Gibbs populations on a 2-D device mesh.
+
+    Each pulsar keeps an independent parameter vector (the model family has
+    no cross-pulsar terms); sampling runs ``shard_map``-ed over
+    ``mesh = ('pulsar', 'chain')``, falling back to plain ``vmap`` without
+    a mesh.
+    """
+
+    def __init__(self, mas: Sequence[ModelArrays], config: GibbsConfig,
+                 nchains: int = 64, mesh: Optional[Mesh] = None,
+                 dtype=jnp.float32, chunk_size: int = 50):
+        self.npulsars = len(mas)
+        self.nchains = nchains
+        self.mesh = mesh
+        self.chunk_size = chunk_size
+        self.stacked = stack_model_arrays(mas)
+        # template backend: holds config/dtype and the sweep kernel; its own
+        # frozen model is pulsar 0 (never used when ma is passed explicitly)
+        self.template = JaxGibbs(_localize_names(mas[0]), config,
+                                 nchains=nchains, dtype=dtype,
+                                 chunk_size=chunk_size)
+        self.dtype = dtype
+        self._step = self._build_step()
+        self.last_state = None
+
+    # -- construction -------------------------------------------------------
+
+    def init_state(self, seed: int = 0) -> ChainState:
+        """Batched state with leading (npulsars, nchains) axes."""
+        states = []
+        for pi in range(self.npulsars):
+            ma_p = jax.tree.map(lambda a, i=pi: a[i], self.stacked)
+            gb = object.__new__(JaxGibbs)
+            gb.__dict__.update(self.template.__dict__)
+            gb._ma = ma_p
+            states.append(gb.init_state(seed=seed * 1000 + pi))
+        return jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+
+    def chain_keys(self, seed: int):
+        keys = random.split(random.PRNGKey(seed),
+                            self.npulsars * self.nchains)
+        return keys.reshape(self.npulsars, self.nchains, *keys.shape[1:])
+
+    # -- the sharded step ---------------------------------------------------
+
+    def _build_step(self):
+        template = self.template
+        stacked = jax.tree.map(
+            lambda a: jnp.asarray(a, dtype=self.dtype)
+            if np.issubdtype(np.asarray(a).dtype, np.floating) else a,
+            self.stacked)
+
+        def local_chunk(ma_p, state, chain_key, offset, length):
+            def body(st, i):
+                rec = tuple(getattr(st, f) for f in _RECORD_FIELDS)
+                st = template._sweep(
+                    st, random.fold_in(chain_key, offset + i), ma=ma_p)
+                return st, rec
+
+            return jax.lax.scan(body, state, jnp.arange(length))
+
+        def step(stacked_ma, states, keys, offset, length):
+            def run(ma_block, st_block, key_block):
+                def per_pulsar(ma_p, st_p, keys_p):
+                    return jax.vmap(
+                        functools.partial(local_chunk, ma_p,
+                                          offset=offset, length=length)
+                    )(st_p, keys_p)
+
+                return jax.vmap(per_pulsar)(ma_block, st_block, key_block)
+
+            if self.mesh is None:
+                return run(stacked_ma, states, keys)
+            specs_ma = jax.tree.map(lambda _: P("pulsar"), stacked_ma)
+            specs_state = jax.tree.map(lambda _: P("pulsar", "chain"),
+                                       states)
+            key_spec = P("pulsar", "chain")
+            out_rec_spec = tuple(P("pulsar", "chain")
+                                 for _ in _RECORD_FIELDS)
+            # check_vma=False: the sweep body is collective-free (chains
+            # and pulsars are independent), and the vma checker rejects
+            # unvarying fori_loop carries (fresh accept counters) inside a
+            # manual region.
+            return shard_map(
+                run, mesh=self.mesh,
+                in_specs=(specs_ma, specs_state, key_spec),
+                out_specs=(specs_state, out_rec_spec),
+                check_vma=False,
+            )(stacked_ma, states, keys)
+
+        return jax.jit(functools.partial(step, stacked),
+                       static_argnames=("length",))
+
+    # -- sampling -----------------------------------------------------------
+
+    def sample(self, niter: int, seed: int = 0,
+               state: Optional[ChainState] = None,
+               start_sweep: int = 0) -> ChainResult:
+        if state is None:
+            state = self.init_state(seed)
+        keys = self.chain_keys(seed)
+        records = []
+        done = 0
+        while done < niter:
+            length = min(self.chunk_size, niter - done)
+            state, recs = self._step(state, keys, start_sweep + done,
+                                     length=length)
+            records.append(jax.device_get(recs))
+            done += length
+        self.last_state = state
+
+        # (P, C, len, ...) -> (len, P, C, ...)
+        cols = {
+            f: np.concatenate([np.moveaxis(r[i], 2, 0) for r in records])
+            for i, f in enumerate(_RECORD_FIELDS)
+        }
+        return ChainResult(
+            chain=cols["x"], bchain=cols["b"], zchain=cols["z"],
+            thetachain=cols["theta"], alphachain=cols["alpha"],
+            poutchain=cols["pout"], dfchain=cols["df"],
+            stats={"acc_white": cols["acc_white"],
+                   "acc_hyper": cols["acc_hyper"]},
+        )

@@ -1,0 +1,27 @@
+"""Mesh construction helpers."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(axes: Dict[str, int],
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a ``Mesh`` with named axes, e.g. ``{'pulsar': 2, 'chain': 4}``.
+
+    The axis product must equal the device count. Device order follows
+    ``jax.devices()`` reshaped row-major, which keeps the fastest-varying
+    axis (put ``'chain'`` last) on ICI-adjacent devices.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    shape = tuple(axes.values())
+    if int(np.prod(shape)) != len(devices):
+        raise ValueError(
+            f"mesh {axes} needs {int(np.prod(shape))} devices, "
+            f"have {len(devices)}")
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, tuple(axes.keys()))

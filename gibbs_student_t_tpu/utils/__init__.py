@@ -1,0 +1,6 @@
+"""Utilities: chain persistence, checkpointing, timing."""
+
+from gibbs_student_t_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+from gibbs_student_t_tpu.utils.timing import BlockTimer
+
+__all__ = ["save_checkpoint", "load_checkpoint", "BlockTimer"]

@@ -1,0 +1,36 @@
+"""Checkpoint/resume for the sampler state pytree.
+
+The reference has no checkpointing: a killed 10k-sweep run loses
+everything (SURVEY.md §5; chains live in RAM, reference gibbs.py:344-350,
+written once at the end, run_sims.py:118-124). Here the full sampler state
+is the small per-chain :class:`ChainState` pytree plus a sweep counter, so
+a checkpoint is one host transfer and one ``.npz``; resume is exact because
+sweep keys derive from ``fold_in(chain_key, sweep_index)``
+(tests/test_jax_backend.py::test_resume_matches_unbroken_run).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Tuple
+
+import jax
+import numpy as np
+
+from gibbs_student_t_tpu.backends.jax_backend import ChainState
+
+
+def save_checkpoint(path: str, state: ChainState, sweep: int,
+                    seed: int) -> None:
+    arrays = {f: np.asarray(getattr(state, f)) for f in ChainState._fields}
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp.npz"
+    np.savez(tmp, sweep=sweep, seed=seed, **arrays)
+    os.replace(tmp, path)  # atomic: no torn checkpoints on kill
+
+
+def load_checkpoint(path: str) -> Tuple[ChainState, int, int]:
+    """Returns (state, next_sweep_index, seed)."""
+    with np.load(path) as data:
+        state = ChainState(**{f: data[f] for f in ChainState._fields})
+        return state, int(data["sweep"]), int(data["seed"])

@@ -1,0 +1,46 @@
+"""Wall-clock instrumentation.
+
+The reference's only observability is a progress line every 100 sweeps
+(reference gibbs.py:382-385). ``BlockTimer`` adds per-block wall timing with
+``block_until_ready`` fencing so device work is attributed correctly;
+XLA-level traces are one ``jax.profiler.trace`` away (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from typing import Dict
+
+import jax
+
+
+class BlockTimer:
+    def __init__(self):
+        self.totals: Dict[str, float] = defaultdict(float)
+        self.counts: Dict[str, int] = defaultdict(int)
+
+    def time(self, name: str, fn, *args, **kwargs):
+        """Run ``fn`` and attribute its device time to ``name``."""
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        self.totals[name] += dt
+        self.counts[name] += 1
+        return out
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        return {
+            name: {"total_s": self.totals[name], "calls": self.counts[name],
+                   "mean_s": self.totals[name] / max(self.counts[name], 1)}
+            for name in self.totals
+        }
+
+    def report(self) -> str:
+        lines = []
+        for name, s in sorted(self.summary().items(),
+                              key=lambda kv: -kv[1]["total_s"]):
+            lines.append(f"{name:24s} {s['total_s']:8.3f}s "
+                         f"({s['calls']}x, {s['mean_s'] * 1e3:.2f} ms)")
+        return "\n".join(lines)

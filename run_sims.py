@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Batch experiment driver: the reference's ``run_sims.py`` re-designed.
+
+Reproduces the reference pipeline (reference run_sims.py:31-124) — for each
+outlier fraction theta: simulate a dataset, load the outlier and clean
+twins, build the enterprise-equivalent model (constant efac, uniform equad,
+30-component powerlaw red noise, SVD timing basis with flat prior,
+reference run_sims.py:57-76), run the five model configurations
+(vvh17 / mixture-uniform / mixture-beta / gaussian / t,
+reference run_sims.py:86-107), and save the seven chain arrays with 100
+burn-in sweeps dropped into ``{outdir}/{model}/{theta}/{idx}/``
+(reference run_sims.py:114-124).
+
+North-star additions (BASELINE.json): ``--backend={cpu,jax}`` selects the
+NumPy oracle or the jit+vmap TPU kernel through the SamplerBackend seam,
+and ``--nchains`` runs that many data-parallel chains per config on the
+JAX path (chain axis appended to the saved arrays).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+
+def build_pta(psr, components: int = 30):
+    """The reference's simulated-data model (reference run_sims.py:57-76)."""
+    from gibbs_student_t_tpu.data.demo import make_reference_pta
+
+    return make_reference_pta(psr, components)
+
+
+def model_configs(pspin: float = 0.00457):
+    """The five sampler configurations of reference run_sims.py:86-107."""
+    from gibbs_student_t_tpu.config import GibbsConfig
+
+    return {
+        "vvh17": GibbsConfig(model="vvh17", vary_df=False,
+                             theta_prior="uniform", vary_alpha=False,
+                             alpha=1e10, pspin=pspin),
+        "uniform": GibbsConfig(model="mixture", vary_df=True,
+                               theta_prior="uniform"),
+        "beta": GibbsConfig(model="mixture", vary_df=True,
+                            theta_prior="beta"),
+        "gaussian": GibbsConfig(model="gaussian", vary_df=True,
+                                theta_prior="beta"),
+        "t": GibbsConfig(model="t", vary_df=True, theta_prior="beta"),
+    }
+
+
+def run_one(ma, cfg, backend: str, niter: int, nchains: int, seed: int):
+    from gibbs_student_t_tpu.backends import get_backend
+
+    cls = get_backend(backend)
+    if cls.supports_chains:
+        return cls(ma, cfg, nchains=nchains).sample(niter=niter, seed=seed)
+    gb = cls(ma, cfg)
+    return gb.sample(ma.x_init(np.random.default_rng(seed)), niter,
+                     seed=seed)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--thetas", type=float, nargs="+",
+                    default=[0.05, 0.1, 0.15])
+    ap.add_argument("--niter", type=int, default=10000)
+    ap.add_argument("--burn", type=int, default=100)
+    ap.add_argument("--backend", choices=["cpu", "jax"], default="cpu")
+    ap.add_argument("--nchains", type=int, default=64,
+                    help="data-parallel chains per config (jax backend)")
+    ap.add_argument("--models", nargs="+",
+                    default=["vvh17", "uniform", "beta", "gaussian", "t"])
+    ap.add_argument("--par", default=None)
+    ap.add_argument("--tim", default=None)
+    ap.add_argument("--ntoa", type=int, default=130)
+    ap.add_argument("--components", type=int, default=30)
+    ap.add_argument("--sigma-out", type=float, default=1e-6)
+    ap.add_argument("--simdir", default="simulated_data")
+    ap.add_argument("--outdirs", nargs=2,
+                    default=["output_outlier", "output_no_outlier"])
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--pspin", type=float, default=0.00457)
+    args = ap.parse_args(argv)
+
+    from simulate_data import ensure_base_dataset
+    from gibbs_student_t_tpu.data.pulsar import Pulsar
+    from gibbs_student_t_tpu.data.simulate import simulate_data
+
+    rng = np.random.default_rng(args.seed)
+    parfile, timfile = ensure_base_dataset(args.par, args.tim, args.simdir,
+                                           args.ntoa, args.seed)
+    all_configs = model_configs(args.pspin)
+    unknown = set(args.models) - set(all_configs)
+    if unknown:
+        ap.error(f"unknown --models {sorted(unknown)}; "
+                 f"choose from {sorted(all_configs)}")
+    configs = {k: v for k, v in all_configs.items() if k in args.models}
+
+    for theta in args.thetas:
+        idx = int(rng.integers(0, 2 ** 32))
+        out1, out2 = simulate_data(parfile, timfile, theta=theta, idx=idx,
+                                   sigma_out=args.sigma_out,
+                                   outdir=args.simdir, rng=rng)
+        name = os.path.splitext(
+            [f for f in os.listdir(out1) if f.endswith(".par")][0])[0]
+        psrs = [Pulsar(f"{d}/{name}.par", f"{d}/{name}.tim")
+                for d in (out1, out2)]
+
+        for psr, outdir in zip(psrs, args.outdirs):
+            ma = build_pta(psr, args.components).frozen()
+            for key, cfg in configs.items():
+                seed = int(rng.integers(0, 2 ** 31))
+                res = run_one(ma, cfg, args.backend, args.niter,
+                              args.nchains, seed)
+                out = os.path.join(outdir, key, str(theta), str(idx))
+                res.burn(args.burn).save(out)
+                print(out, flush=True)
+
+
+if __name__ == "__main__":
+    main()

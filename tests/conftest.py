@@ -1,0 +1,56 @@
+"""Test harness configuration.
+
+Tests run on a virtual 8-device CPU backend
+(``--xla_force_host_platform_device_count=8``) — the standard fake-cluster
+trick for exercising ``vmap``/``shard_map``/collective code without TPU
+hardware (SURVEY.md §4). Env vars must be set before JAX initializes, which
+is why this happens at conftest import time.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+)
+
+import numpy as np
+import pytest
+
+from gibbs_student_t_tpu.data.demo import (
+    make_contaminated_pulsar,
+    make_reference_pta,
+)
+from gibbs_student_t_tpu.models import PTA
+
+
+def make_demo_pulsar(tmpdir=None, seed=42, n=130, theta=0.0,
+                     sigma_out=1e-6):
+    """Simulated pulsar with injected red + white noise (and optional
+    outliers), round-tripped through par/tim files when ``tmpdir`` given."""
+    return make_contaminated_pulsar(n=n, components=30, theta=theta,
+                                    sigma_out=sigma_out, seed=seed,
+                                    roundtrip_dir=tmpdir)
+
+
+def make_demo_pta(psr=None, components=30, seed=42) -> PTA:
+    """The reference's simulated-data model (reference run_sims.py:57-76)."""
+    if psr is None:
+        psr, _ = make_demo_pulsar(seed=seed)
+    return make_reference_pta(psr, components)
+
+
+@pytest.fixture(scope="session")
+def demo_pulsar():
+    return make_demo_pulsar()[0]
+
+
+@pytest.fixture(scope="session")
+def demo_pta(demo_pulsar):
+    return make_demo_pta(demo_pulsar)
+
+
+@pytest.fixture(scope="session")
+def demo_ma(demo_pta):
+    return demo_pta.frozen()

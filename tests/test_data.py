@@ -1,0 +1,132 @@
+"""Data-layer tests: par/tim IO, phase model, design matrix, simulator."""
+
+import numpy as np
+import pytest
+
+from gibbs_student_t_tpu.data.demo import (
+    make_demo_epochs,
+    make_demo_fakepulsar,
+    make_demo_par,
+)
+from gibbs_student_t_tpu.data.par import read_par, write_par
+from gibbs_student_t_tpu.data.pulsar import Pulsar
+from gibbs_student_t_tpu.data.simulate import FakePulsar, simulate_data
+from gibbs_student_t_tpu.data.tim import read_tim, write_tim
+from gibbs_student_t_tpu.data.timing_model import (
+    design_matrix,
+    phase,
+    prefit_residuals,
+)
+
+
+def test_par_roundtrip(tmp_path):
+    par = make_demo_par()
+    path = str(tmp_path / "a.par")
+    write_par(par, path)
+    par2 = read_par(path)
+    # longdouble-precision F0/F1 survive the round trip exactly
+    assert par2.getfloat("F0") == par.getfloat("F0")
+    assert par2.getfloat("F1") == par.getfloat("F1")
+    assert par2["F0"].fit == 1
+    assert par2.get("BINARY") == "DD"
+    assert par2.name == par.name
+
+
+def test_tim_roundtrip_with_deleted(tmp_path):
+    fp = make_demo_fakepulsar(n=20)
+    fp.deleted[3] = True
+    fp.deleted[7] = True
+    path = str(tmp_path / "a.tim")
+    fp.savetim(path)
+
+    kept = read_tim(path)
+    assert kept.n == 18
+    full = read_tim(path, include_deleted=True)
+    assert full.n == 20
+    assert full.deleted.sum() == 2
+    # sub-ns MJD round trip
+    np.testing.assert_allclose(
+        np.asarray((full.mjds - fp.stoas) * 86400, dtype=float),
+        0.0, atol=1e-9)
+
+
+def test_ideal_toas_have_integer_phase():
+    fp = make_demo_fakepulsar(n=50)
+    ph = phase(fp.par, fp.stoas)
+    frac = np.asarray(ph - np.rint(ph), dtype=float)
+    # fakepulsar TOAs are exact pulse arrival times (reference
+    # simulate_data.py:18's fakepulsar contract)
+    assert np.abs(frac).max() < 1e-6
+
+
+def test_prefit_residuals_recover_injected_offset():
+    fp = make_demo_fakepulsar(n=50)
+    shift_s = 3.2e-6
+    fp.stoas = fp.stoas + np.longdouble(shift_s) / 86400
+    resid = prefit_residuals(fp.par, fp.stoas)
+    np.testing.assert_allclose(resid, shift_s, rtol=1e-4)
+
+
+def test_design_matrix_full_rank():
+    par = make_demo_par()
+    mjds = make_demo_epochs(130)
+    M, labels = design_matrix(par, mjds)
+    assert M.shape[0] == 130
+    assert M.shape[1] == len(labels)
+    # all fitted params present: offset + F0 F1 RAJ DECJ PMRA PMDEC PX
+    # + PB T0 A1 OM ECC SINI
+    assert M.shape[1] == 14
+    s = np.linalg.svd(M, compute_uv=False)
+    assert s[-1] / s[0] > 1e-8  # numerically full rank
+
+
+def test_pulsar_fit_removes_timing_model(tmp_path):
+    fp = make_demo_fakepulsar(n=80)
+    rng = np.random.default_rng(1)
+    # inject white noise plus a timing-model-shaped signal (F0 drift)
+    fp.stoas = fp.stoas + np.asarray(
+        1e-7 * rng.standard_normal(fp.n), dtype=np.longdouble) / 86400
+    psr = Pulsar(par=fp.par, tim=fp.to_tim())
+    # the fit projects residuals out of the design-matrix span
+    proj = psr.Mmat.T @ (psr.residuals / psr.toaerrs ** 2)
+    np.testing.assert_allclose(proj, 0.0, atol=1e-4)
+
+
+def test_simulate_data_tree(tmp_path):
+    par = make_demo_par()
+    fp = make_demo_fakepulsar(n=40)
+    parfile = str(tmp_path / "base.par")
+    timfile = str(tmp_path / "base.tim")
+    fp.savepar(parfile)
+    fp.savetim(timfile)
+
+    out1, out2 = simulate_data(parfile, timfile, theta=0.3, idx=7,
+                               outdir=str(tmp_path / "sim"),
+                               rng=np.random.default_rng(3))
+    outliers = np.loadtxt(f"{out1}/outliers.txt", dtype=int, ndmin=1)
+    psr_out = Pulsar(f"{out1}/{par.name}.par", f"{out1}/{par.name}.tim")
+    assert psr_out.n == 40
+    # the no_outlier twin drops exactly the flagged TOAs
+    psr_clean = Pulsar(f"{out2}/{par.name}.par", f"{out2}/{par.name}.tim")
+    assert psr_clean.n == 40 - len(outliers)
+
+
+def test_rednoise_injection_spectrum():
+    """Injected red-noise variance matches the powerlaw target on average."""
+    rng = np.random.default_rng(0)
+    waves = []
+    fp0 = make_demo_fakepulsar(n=100)
+    for _ in range(50):
+        fp = FakePulsar(fp0.par, fp0.stoas.copy(), fp0.errors_us)
+        w = fp.add_rednoise(1e-13, 3.0, components=10, rng=rng,
+                            return_waveform=True)
+        waves.append(w)
+    var = np.var(np.asarray(waves), axis=1).mean()
+    toas = np.asarray(fp0.stoas * 86400, dtype=float)
+    tspan = toas.max() - toas.min()
+    f = np.arange(1, 11) / tspan
+    fyr = 1 / (365.25 * 86400)
+    expected = np.sum(1e-26 / (12 * np.pi ** 2) * fyr ** 0.0
+                      * f ** -3.0 / tspan) * 2 / 2
+    # sum over sin+cos halves -> total variance = sum(var_k) * 2 / 2
+    assert 0.5 < var / expected < 2.0

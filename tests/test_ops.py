@@ -1,0 +1,57 @@
+"""Numerics tests: preconditioned Cholesky against reference LAPACK."""
+
+import numpy as np
+import scipy.linalg as sl
+
+import jax
+import jax.numpy as jnp
+
+from gibbs_student_t_tpu.ops.linalg import (
+    gaussian_draw,
+    precond_cholesky,
+    precond_solve_quad,
+)
+
+
+def _spd(m, diag_spread, seed=0):
+    rng = np.random.default_rng(seed)
+    A = rng.standard_normal((m, m))
+    S = A @ A.T + m * np.eye(m)
+    d = 10.0 ** rng.uniform(0, diag_spread, m)
+    return S * np.sqrt(d[:, None] * d[None, :])
+
+
+def test_precond_cholesky_logdet_and_solve():
+    # 12 decades of diagonal spread — the Sigma regime of small-amplitude
+    # red noise (SURVEY.md §7 float64 hard part)
+    S = _spd(40, 12)
+    rhs = np.random.default_rng(1).standard_normal(40)
+
+    L, isd, logdet = precond_cholesky(jnp.asarray(S))
+    sol, quad = precond_solve_quad(L, isd, jnp.asarray(rhs))
+
+    sign, logdet_ref = np.linalg.slogdet(S)
+    sol_ref = sl.solve(S, rhs)
+    np.testing.assert_allclose(float(logdet), logdet_ref, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(sol), sol_ref, rtol=1e-3)
+    np.testing.assert_allclose(float(quad), rhs @ sol_ref, rtol=1e-4)
+
+
+def test_non_pd_yields_nan_not_crash():
+    """Branchless failure path: non-PD input -> NaN (feeds -inf / MH reject),
+    replacing the reference's try/except (reference gibbs.py:320-324)."""
+    S = np.eye(4)
+    S[0, 1] = S[1, 0] = 2.0  # indefinite
+    L, isd, logdet = precond_cholesky(jnp.asarray(S))
+    assert not bool(jnp.isfinite(L).all())
+
+
+def test_gaussian_draw_moments():
+    S = _spd(6, 3, seed=2)
+    L, isd, _ = precond_cholesky(jnp.asarray(S))
+    mean = jnp.zeros(6)
+    xi = jax.random.normal(jax.random.PRNGKey(0), (20000, 6))
+    draws = jax.vmap(lambda e: gaussian_draw(L, isd, mean, e))(xi)
+    cov = np.cov(np.asarray(draws).T)
+    np.testing.assert_allclose(cov, np.linalg.inv(S), atol=5e-2 * np.abs(
+        np.linalg.inv(S)).max())

@@ -1,0 +1,115 @@
+"""Checkpoint/resume, chain persistence, and driver-script tests."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from gibbs_student_t_tpu.backends import JaxGibbs
+from gibbs_student_t_tpu.config import GibbsConfig
+from gibbs_student_t_tpu.utils import BlockTimer, load_checkpoint, save_checkpoint
+from tests.conftest import make_demo_pta
+
+
+@pytest.fixture(scope="module")
+def ma():
+    return make_demo_pta().frozen()
+
+
+def test_checkpoint_roundtrip_resume(ma, tmp_path):
+    """Kill-and-resume reproduces the unbroken run exactly — the recovery
+    story the reference lacks (SURVEY.md §5)."""
+    cfg = GibbsConfig(model="mixture")
+    gb = JaxGibbs(ma, cfg, nchains=2, chunk_size=5)
+    full = gb.sample(niter=20, seed=9)
+
+    gb2 = JaxGibbs(ma, cfg, nchains=2, chunk_size=5)
+    gb2.sample(niter=10, seed=9)
+    path = str(tmp_path / "ckpt.npz")
+    save_checkpoint(path, gb2.last_state, sweep=10, seed=9)
+
+    state, sweep, seed = load_checkpoint(path)
+    gb3 = JaxGibbs(ma, cfg, nchains=2, chunk_size=5)
+    resumed = gb3.sample(niter=10, seed=seed, state=state, start_sweep=sweep)
+    np.testing.assert_array_equal(full.chain[10:], resumed.chain)
+
+
+def test_chain_result_save_layout(ma, tmp_path):
+    """On-disk tree matches the reference driver's layout
+    (reference run_sims.py:118-124)."""
+    cfg = GibbsConfig(model="gaussian")
+    gb = JaxGibbs(ma, cfg, nchains=2, chunk_size=5)
+    res = gb.sample(niter=10, seed=0)
+    out = str(tmp_path / "out")
+    res.burn(2).save(out)
+    for name in ("chain", "bchain", "zchain", "poutchain", "thetachain",
+                 "alphachain", "dfchain"):
+        arr = np.load(os.path.join(out, f"{name}.npy"))
+        assert arr.shape[0] == 8
+
+def test_block_timer():
+    bt = BlockTimer()
+    bt.time("noop", lambda: np.zeros(3))
+    assert "noop" in bt.summary()
+    assert "noop" in bt.report()
+
+
+def _run_script(args, cwd):
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = "/root/repo"
+    return subprocess.run([sys.executable] + args, cwd=cwd,
+                          capture_output=True, text=True, env=env,
+                          timeout=600)
+
+
+def test_simulate_data_driver(tmp_path):
+    r = _run_script(["/root/repo/simulate_data.py", "--theta", "0.2",
+                     "--idx", "3", "--ntoa", "30", "--seed", "1",
+                     "--outdir", str(tmp_path / "sim")], str(tmp_path))
+    assert r.returncode == 0, r.stderr
+    out1 = r.stdout.strip().splitlines()[-2]
+    assert os.path.exists(os.path.join(out1, "outliers.txt"))
+
+
+def test_run_sims_driver_cpu(tmp_path):
+    r = _run_script(
+        ["/root/repo/run_sims.py", "--backend", "cpu", "--niter", "30",
+         "--burn", "5", "--thetas", "0.1", "--ntoa", "30",
+         "--components", "5", "--models", "gaussian", "t",
+         "--simdir", str(tmp_path / "sim"),
+         "--outdirs", str(tmp_path / "o1"), str(tmp_path / "o2")],
+        str(tmp_path))
+    assert r.returncode == 0, r.stderr
+    lines = [l for l in r.stdout.splitlines() if l.strip()]
+    assert len(lines) == 4  # 2 models x 2 datasets
+    chain = np.load(os.path.join(lines[0], "chain.npy"))
+    assert chain.shape[0] == 25
+
+
+@pytest.mark.slow
+def test_run_sims_driver_jax(tmp_path):
+    r = _run_script(
+        ["/root/repo/run_sims.py", "--backend", "jax", "--niter", "20",
+         "--burn", "5", "--nchains", "4", "--thetas", "0.1",
+         "--ntoa", "30", "--components", "5", "--models", "beta",
+         "--simdir", str(tmp_path / "sim"),
+         "--outdirs", str(tmp_path / "o1"), str(tmp_path / "o2")],
+        str(tmp_path))
+    assert r.returncode == 0, r.stderr
+    lines = [l for l in r.stdout.splitlines() if l.strip()]
+    chain = np.load(os.path.join(lines[0], "chain.npy"))
+    assert chain.shape == (15, 4, 3)
+
+
+@pytest.mark.slow
+def test_bench_quick(tmp_path):
+    r = _run_script(["/root/repo/bench.py", "--quick"], str(tmp_path))
+    assert r.returncode == 0, r.stderr
+    line = json.loads(r.stdout.strip().splitlines()[-1])
+    assert {"metric", "value", "unit", "vs_baseline"} <= set(line)
+    assert line["value"] > 0
