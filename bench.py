@@ -15,10 +15,49 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
+
+
+def resolve_platform(requested: str, probe_timeout: float = 120.0) -> str:
+    """Pick the JAX platform, guarding against a wedged TPU tunnel.
+
+    The container reaches its TPU through a loopback relay that can hang
+    ``jax.devices()`` forever. Probing in a *subprocess* with a timeout
+    (the hang is uninterruptible in-process) keeps the benchmark from
+    stalling: on a healthy chip the probe returns in seconds and we use
+    the TPU; otherwise we fall back to CPU so a benchmark line is always
+    recorded.
+    """
+    if requested != "auto":
+        return requested
+    platform = os.environ.get("JAX_PLATFORMS", "")
+    if platform in ("", "cpu"):
+        return "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    try:
+        out, err = proc.communicate(timeout=probe_timeout)
+        if proc.returncode == 0 and out.strip().isdigit():
+            return platform
+        sys.stderr.write(f"# device probe failed: {err[-500:]}\n")
+    except subprocess.TimeoutExpired:
+        sys.stderr.write(f"# device probe hung >{probe_timeout:.0f}s "
+                         f"(platform {platform!r}); falling back to cpu\n")
+        proc.kill()
+        try:
+            # Don't block on reaping: a child wedged in an uninterruptible
+            # tunnel syscall may not die even on SIGKILL — exactly the
+            # failure mode this probe exists to route around.
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            pass
+    return "cpu"
 
 
 def build(ntoa: int, components: int, seed: int = 42):
@@ -66,11 +105,19 @@ def main(argv=None):
     ap.add_argument("--model", default="mixture")
     ap.add_argument("--quick", action="store_true",
                     help="small shapes for smoke-testing the benchmark")
+    ap.add_argument("--platform", default="auto",
+                    help="jax platform: auto (probe TPU, fall back to cpu), "
+                         "or an explicit JAX_PLATFORMS value")
     args = ap.parse_args(argv)
 
     if args.quick:
         args.nchains, args.niter = 32, 50
         args.baseline_sweeps, args.chunk = 30, 25
+
+    platform = resolve_platform(args.platform)
+    import jax
+
+    jax.config.update("jax_platforms", platform)
 
     from gibbs_student_t_tpu.config import GibbsConfig
 
@@ -92,9 +139,9 @@ def main(argv=None):
         "unit": "chain-sweeps/s",
         "vs_baseline": round(vs_baseline, 2),
     }))
-    print(f"# numpy single-chain: {numpy_sps:.1f} sweeps/s; "
-          f"jax {args.nchains} chains: {jax_sps:.1f} sweeps/s/chain",
-          file=sys.stderr)
+    print(f"# platform={platform}; numpy single-chain: {numpy_sps:.1f} "
+          f"sweeps/s; jax {args.nchains} chains: {jax_sps:.1f} "
+          f"sweeps/s/chain", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -328,22 +328,48 @@ class JaxGibbs(SamplerBackend):
 
     def sample(self, x0: Optional[np.ndarray] = None, niter: int = 1000,
                seed: int = 0, state: Optional[ChainState] = None,
-               start_sweep: int = 0) -> ChainResult:
+               start_sweep: int = 0,
+               spool_dir: Optional[str] = None) -> ChainResult:
         """Run ``niter`` sweeps for all chains; spool records to host per
         chunk. Pass ``state``/``start_sweep`` (e.g. from a checkpoint) to
         resume — the per-sweep ``fold_in`` keying makes the continuation
-        identical to an unbroken run."""
+        identical to an unbroken run. With ``spool_dir``, each chunk
+        streams to native spool files + a state checkpoint (utils/spool.py)
+        and host memory stays O(chunk) instead of O(niter)."""
+        if niter < 1:
+            raise ValueError(f"niter must be >= 1, got {niter}")
+        resume = start_sweep > 0
         if state is None:
             state = self.init_state(x0, seed=seed)
         keys = random.split(random.PRNGKey(seed), self.nchains)
+        spool = None
+        if spool_dir is not None:
+            from gibbs_student_t_tpu.utils.spool import ChainSpool
+
+            # Resuming from a checkpointed state appends to the existing
+            # spool instead of truncating it.
+            spool = ChainSpool(spool_dir, seed, resume=resume)
         records = []
         done = 0
         while done < niter:
             length = min(self.chunk_size, niter - done)
             state, recs = self._chunk_fn(state, keys,
                                          start_sweep + done, length=length)
-            records.append(jax.device_get(recs))
+            host = jax.device_get(recs)
             done += length
+            if spool is not None:
+                spool.append(
+                    {f: np.swapaxes(host[i], 0, 1)
+                     for i, f in enumerate(_RECORD_FIELDS)},
+                    state, start_sweep + done)
+            else:
+                records.append(host)
+        if spool is not None:
+            spool.close()
+            from gibbs_student_t_tpu.utils.spool import load_spool
+
+            self.last_state = state
+            return load_spool(spool_dir)
         self.last_state = state
 
         cols = {

@@ -1,0 +1,216 @@
+"""ctypes bindings for the native runtime library (``native/src``).
+
+The reference's native layer is reached through ``libstempo`` → tempo2
+(C++) for data ingestion (reference simulate_data.py:12-18,
+run_sims.py:47,51); here the native side of the runtime is first-party:
+``libgst_native.so`` provides the FORMAT-1 tim tokenizer and the binary
+chain spooler. Everything degrades gracefully — ``available()`` is False
+when the library hasn't been built (``make -C native``) and callers fall
+back to the pure-Python paths, so the framework never *requires* a
+compiler at runtime.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "libgst_native.so")
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), os.pardir, os.pardir,
+                           "native")
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c = ctypes
+    sigs = {
+        "gst_last_error": ([], c.c_char_p),
+        "gst_tim_read": ([c.c_char_p, c.c_int], c.c_void_p),
+        "gst_tim_free": ([c.c_void_p], None),
+        "gst_tim_n": ([c.c_void_p], c.c_int64),
+        "gst_tim_nsites": ([c.c_void_p], c.c_int64),
+        "gst_tim_nflags": ([c.c_void_p], c.c_int64),
+        "gst_tim_fill": ([c.c_void_p] + [c.c_void_p] * 6, None),
+        "gst_tim_name": ([c.c_void_p, c.c_int64], c.c_char_p),
+        "gst_tim_site": ([c.c_void_p, c.c_int64], c.c_char_p),
+        "gst_tim_flag_name": ([c.c_void_p, c.c_int64], c.c_char_p),
+        "gst_tim_flag_value": ([c.c_void_p, c.c_int64, c.c_int64],
+                               c.c_char_p),
+        "gst_spool_open": ([c.c_char_p, c.c_uint32, c.c_uint32,
+                            c.POINTER(c.c_uint64), c.c_int], c.c_void_p),
+        "gst_spool_append": ([c.c_void_p, c.c_void_p, c.c_uint64], c.c_int),
+        "gst_spool_flush": ([c.c_void_p], c.c_int),
+        "gst_spool_close": ([c.c_void_p], c.c_int),
+        "gst_spool_info": ([c.c_char_p, c.POINTER(c.c_uint32),
+                            c.POINTER(c.c_uint32), c.POINTER(c.c_uint64),
+                            c.POINTER(c.c_uint64)], c.c_int64),
+    }
+    for name, (argtypes, restype) in sigs.items():
+        fn = getattr(lib, name)
+        fn.argtypes = argtypes
+        fn.restype = restype
+    return lib
+
+
+def load(build: bool = False) -> Optional[ctypes.CDLL]:
+    """Load (optionally building) the native library; None if unavailable."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH) and build:
+        build_native()
+    if os.path.exists(_LIB_PATH):
+        _lib = _bind(ctypes.CDLL(_LIB_PATH))
+    return _lib
+
+
+def build_native() -> None:
+    """Compile the library with the repo Makefile (g++, no deps)."""
+    subprocess.run(["make", "-C", os.path.abspath(_NATIVE_DIR)], check=True,
+                   capture_output=True)
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def _err(lib) -> str:
+    return lib.gst_last_error().decode()
+
+
+# ---------------------------------------------------------------------------
+# tim reading
+# ---------------------------------------------------------------------------
+
+def read_tim_native(path: str, include_deleted: bool = False):
+    """Native-parser version of :func:`data.tim.read_tim`; same TimFile."""
+    from gibbs_student_t_tpu.data.tim import TimFile
+
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native library not built (run make -C native)")
+    h = lib.gst_tim_read(path.encode(), int(include_deleted))
+    if not h:
+        msg = _err(lib)
+        if "INCLUDE" in msg:
+            raise NotImplementedError(msg)
+        raise OSError(msg)
+    try:
+        n = lib.gst_tim_n(h)
+        freqs = np.empty(n, dtype=np.float64)
+        day = np.empty(n, dtype=np.float64)
+        frac = np.empty(n, dtype=np.float64)
+        errors = np.empty(n, dtype=np.float64)
+        site_idx = np.empty(n, dtype=np.int32)
+        deleted = np.empty(n, dtype=np.uint8)
+        if n:
+            lib.gst_tim_fill(
+                h, *(a.ctypes.data_as(ctypes.c_void_p)
+                     for a in (freqs, day, frac, errors, site_idx, deleted)))
+        sites_tbl = [lib.gst_tim_site(h, i).decode()
+                     for i in range(lib.gst_tim_nsites(h))]
+        names = [lib.gst_tim_name(h, i).decode() for i in range(n)]
+        flags: Dict[str, np.ndarray] = {}
+        for j in range(lib.gst_tim_nflags(h)):
+            key = lib.gst_tim_flag_name(h, j).decode()
+            flags[key] = np.array(
+                [lib.gst_tim_flag_value(h, j, i).decode() for i in range(n)],
+                dtype=object)
+        flags = dict(sorted(flags.items()))
+        mjds = day.astype(np.longdouble) + frac.astype(np.longdouble)
+        return TimFile(
+            names=names,
+            freqs=freqs,
+            mjds=mjds,
+            errors=errors,
+            sites=[sites_tbl[i] for i in site_idx],
+            flags=flags,
+            deleted=deleted.astype(bool),
+        )
+    finally:
+        lib.gst_tim_free(h)
+
+
+# ---------------------------------------------------------------------------
+# chain spooler
+# ---------------------------------------------------------------------------
+
+_ITEMSIZE = {np.dtype(np.float32): 4, np.dtype(np.float64): 8}
+
+
+class SpoolWriter:
+    """Append-only typed array file: rows of a fixed trailing shape.
+
+    Used to stream per-chunk sampler records to disk (SURVEY.md §5
+    "checkpoint/resume": the reference holds all chains in RAM,
+    reference gibbs.py:344-350). A killed run leaves a readable prefix —
+    the row count is implied by file size, not a footer.
+    """
+
+    def __init__(self, path: str, trailing_shape: Sequence[int],
+                 dtype=np.float32, append: bool = False):
+        """``append=True`` keeps an existing file's records (resume path);
+        the on-disk header must match ``dtype``/``trailing_shape``."""
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native library not built (run make -C native)")
+        self._lib = lib
+        self.dtype = np.dtype(dtype)
+        self.trailing_shape = tuple(int(s) for s in trailing_shape)
+        shape_arr = (ctypes.c_uint64 * len(self.trailing_shape))(
+            *self.trailing_shape)
+        self._h = lib.gst_spool_open(path.encode(),
+                                     _ITEMSIZE[self.dtype],
+                                     len(self.trailing_shape), shape_arr,
+                                     int(append))
+        if not self._h:
+            raise OSError(_err(lib))
+
+    def append(self, rows: np.ndarray) -> None:
+        rows = np.ascontiguousarray(rows, dtype=self.dtype)
+        if rows.shape[1:] != self.trailing_shape:
+            raise ValueError(
+                f"row shape {rows.shape[1:]} != {self.trailing_shape}")
+        rc = self._lib.gst_spool_append(
+            self._h, rows.ctypes.data_as(ctypes.c_void_p), rows.shape[0])
+        if rc != 0:
+            raise OSError(_err(self._lib))
+
+    def flush(self) -> None:
+        self._lib.gst_spool_flush(self._h)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.gst_spool_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def read_spool(path: str) -> np.ndarray:
+    """Load a spool file as one array, leading axis = appended rows."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native library not built (run make -C native)")
+    itemsize = ctypes.c_uint32()
+    ndim = ctypes.c_uint32()
+    shape = (ctypes.c_uint64 * 8)()
+    header = ctypes.c_uint64()
+    rows = lib.gst_spool_info(path.encode(), ctypes.byref(itemsize),
+                              ctypes.byref(ndim), shape,
+                              ctypes.byref(header))
+    if rows < 0:
+        raise OSError(_err(lib))
+    dtype = np.float32 if itemsize.value == 4 else np.float64
+    trailing = tuple(shape[i] for i in range(ndim.value))
+    data = np.fromfile(path, dtype=dtype, offset=header.value,
+                       count=rows * int(np.prod(trailing, dtype=np.int64)))
+    return data.reshape((rows,) + trailing)

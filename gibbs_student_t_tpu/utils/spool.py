@@ -1,0 +1,96 @@
+"""Streaming chain output: spool sampler records to disk chunk by chunk.
+
+The reference accumulates every chain array in RAM for the whole run and
+writes once at the end (reference gibbs.py:344-350, run_sims.py:118-124) —
+a killed 10k-sweep run loses everything, and a 1024-chain run would hold
+``niter x nchains x n`` floats live. A :class:`ChainSpool` instead appends
+each device chunk to native append-only spool files (``native.SpoolWriter``)
+and checkpoints the state pytree, so host memory stays O(chunk) and a
+killed run resumes from the last chunk boundary.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+from gibbs_student_t_tpu.backends.base import ChainResult
+from gibbs_student_t_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+_CHAIN_KEYS = {
+    "x": "chain", "b": "bchain", "z": "zchain", "theta": "thetachain",
+    "alpha": "alphachain", "df": "dfchain", "pout": "poutchain",
+}
+
+
+class ChainSpool:
+    """Directory of per-field spool files plus a rolling state checkpoint."""
+
+    def __init__(self, path: str, seed: int, resume: bool = False):
+        """``resume=True`` appends to an existing spool directory (after a
+        kill: ``load_spool_state`` -> ``sample(state=..., start_sweep=...,
+        spool_dir=...)``) instead of truncating it."""
+        from gibbs_student_t_tpu import native
+
+        if not native.available():
+            raise RuntimeError(
+                "chain spooling needs the native library (make -C native)")
+        self._native = native
+        self.path = path
+        self.seed = seed
+        self.resume = resume
+        self._writers: Optional[Dict[str, object]] = None
+        os.makedirs(path, exist_ok=True)
+
+    def append(self, records: Dict[str, np.ndarray], state, sweep: int
+               ) -> None:
+        """``records[field]`` is ``(chunk_len, nchains, ...)``; ``sweep`` is
+        the index of the first sweep *after* this chunk (the resume point)."""
+        if self._writers is None:
+            with open(os.path.join(self.path, "meta.json"), "w") as fh:
+                json.dump({"fields": sorted(records),
+                           "seed": self.seed}, fh)
+            self._writers = {
+                f: self._native.SpoolWriter(
+                    os.path.join(self.path, f + ".spool"),
+                    trailing_shape=a.shape[1:], dtype=a.dtype,
+                    append=self.resume)
+                for f, a in records.items()
+            }
+        for f, a in records.items():
+            self._writers[f].append(a)
+            self._writers[f].flush()
+        save_checkpoint(os.path.join(self.path, "state.npz"), state,
+                        sweep, self.seed)
+
+    def close(self) -> None:
+        if self._writers is not None:
+            for w in self._writers.values():
+                w.close()
+            self._writers = None
+
+
+def load_spool(path: str) -> ChainResult:
+    """Reassemble a :class:`ChainResult` from a spool directory (including
+    the readable prefix of an interrupted run)."""
+    from gibbs_student_t_tpu import native
+
+    with open(os.path.join(path, "meta.json")) as fh:
+        meta = json.load(fh)
+    cols = {f: native.read_spool(os.path.join(path, f + ".spool"))
+            for f in meta["fields"]}
+    # A kill mid-append can leave fields at different lengths; trim to the
+    # common prefix so every array stays sweep-aligned.
+    nmin = min(len(a) for a in cols.values())
+    cols = {f: a[:nmin] for f, a in cols.items()}
+    chains = {_CHAIN_KEYS[f]: cols.pop(f)
+              for f in list(cols) if f in _CHAIN_KEYS}
+    return ChainResult(**chains, stats=cols)
+
+
+def load_spool_state(path: str):
+    """(state, next_sweep, seed) from a spool directory's checkpoint."""
+    return load_checkpoint(os.path.join(path, "state.npz"))

@@ -1,0 +1,376 @@
+// gst_native: native runtime components for gibbs_student_t_tpu.
+//
+// The reference crosses into native code for data ingestion (tempo2 C++
+// reached through libstempo, reference simulate_data.py:12-18,
+// run_sims.py:47,51) and for linear algebra (LAPACK). The linear algebra
+// lives on the TPU in this framework (ops/linalg.py); this library is the
+// native side of the runtime around it:
+//
+//   1. a FORMAT-1 .tim tokenizer (the hot ingestion loop — parsing 1e5+
+//      TOA lines in Python is the data-loading bottleneck of the stress
+//      configs), semantics matched to gibbs_student_t_tpu/data/tim.py;
+//   2. a binary chain spooler: append-only typed array files used to
+//      stream per-chunk sampler records to disk so a 10k-sweep x 1024-chain
+//      run holds O(chunk) not O(niter) host memory.
+//
+// C ABI only (consumed via ctypes, no pybind11 in the image). All
+// functions return 0/handle on success; gst_last_error() reports failures.
+
+#include <cctype>
+#include <cerrno>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#if defined(_WIN32)
+#define GST_EXPORT extern "C" __declspec(dllexport)
+#else
+#define GST_EXPORT extern "C" __attribute__((visibility("default")))
+#endif
+
+namespace {
+
+thread_local std::string g_error;
+
+void set_error(const std::string& msg) { g_error = msg; }
+
+// ---------------------------------------------------------------------------
+// tim parsing
+// ---------------------------------------------------------------------------
+
+struct TimData {
+  std::vector<std::string> names;
+  std::vector<double> freqs;
+  std::vector<double> mjd_day;    // integer part of the MJD
+  std::vector<double> mjd_frac;   // fractional day; day+frac loses <0.1 ns
+  std::vector<double> errors;     // microseconds
+  std::vector<int32_t> site_idx;  // index into sites
+  std::vector<std::string> sites;
+  std::vector<uint8_t> deleted;
+  // flag name -> per-TOA values ("" where absent)
+  std::vector<std::string> flag_names;
+  std::vector<std::vector<std::string>> flag_values;
+};
+
+std::vector<std::string> tokenize(const std::string& line) {
+  std::vector<std::string> out;
+  std::istringstream ss(line);
+  std::string tok;
+  while (ss >> tok) out.push_back(tok);
+  return out;
+}
+
+bool parse_double(const std::string& s, double* out) {
+  errno = 0;
+  char* end = nullptr;
+  *out = std::strtod(s.c_str(), &end);
+  return end == s.c_str() + s.size() && errno == 0;
+}
+
+bool parse_longdouble(const std::string& s, long double* out) {
+  errno = 0;
+  char* end = nullptr;
+  *out = std::strtold(s.c_str(), &end);
+  return end == s.c_str() + s.size() && errno == 0;
+}
+
+bool starts_with(const std::string& s, const char* p) {
+  return s.rfind(p, 0) == 0;
+}
+
+std::string upper(const std::string& s) {
+  std::string o = s;
+  for (auto& c : o) c = static_cast<char>(std::toupper(c));
+  return o;
+}
+
+std::string strip(const std::string& s) {
+  size_t a = s.find_first_not_of(" \t\r\n");
+  if (a == std::string::npos) return "";
+  size_t b = s.find_last_not_of(" \t\r\n");
+  return s.substr(a, b - a + 1);
+}
+
+// Semantics mirror data/tim.py::read_tim line for line.
+TimData* parse_tim(const char* path, int include_deleted) {
+  std::ifstream fh(path);
+  if (!fh) {
+    set_error(std::string("cannot open ") + path);
+    return nullptr;
+  }
+  auto data = std::make_unique<TimData>();
+  std::map<std::string, int32_t> site_ids;
+  std::map<std::string, size_t> flag_ids;
+
+  std::string raw;
+  while (std::getline(fh, raw)) {
+    std::string line = strip(raw);
+    if (line.empty()) continue;
+    std::string up = upper(line);
+    if (starts_with(up, "FORMAT") || starts_with(up, "MODE")) continue;
+    if (starts_with(up, "INCLUDE")) {
+      set_error("INCLUDE directives are not supported");
+      return nullptr;
+    }
+    bool is_deleted = false;
+    if (starts_with(line, "C ") || starts_with(line, "#")) {
+      is_deleted = true;
+      size_t i = 0;
+      while (i < line.size() && (line[i] == 'C' || line[i] == '#')) ++i;
+      line = strip(line.substr(i));
+      if (line.empty()) continue;
+    }
+    auto tokens = tokenize(line);
+    if (tokens.size() < 5) continue;
+    double freq, err;
+    long double mjd;
+    if (!parse_double(tokens[1], &freq) ||
+        !parse_longdouble(tokens[2], &mjd) ||
+        !parse_double(tokens[3], &err))
+      continue;  // stray comment line
+    if (is_deleted && !include_deleted) continue;
+
+    data->names.push_back(tokens[0]);
+    data->freqs.push_back(freq);
+    long double day = std::floor(mjd);
+    data->mjd_day.push_back(static_cast<double>(day));
+    data->mjd_frac.push_back(static_cast<double>(mjd - day));
+    data->errors.push_back(err);
+    auto it = site_ids.find(tokens[4]);
+    if (it == site_ids.end()) {
+      it = site_ids.emplace(tokens[4],
+                            static_cast<int32_t>(data->sites.size())).first;
+      data->sites.push_back(tokens[4]);
+    }
+    data->site_idx.push_back(it->second);
+    data->deleted.push_back(is_deleted ? 1 : 0);
+
+    size_t row = data->freqs.size() - 1;
+    for (size_t ii = 5; ii < tokens.size(); ) {
+      if (tokens[ii][0] == '-' && ii + 1 < tokens.size()) {
+        std::string name = tokens[ii];
+        name.erase(0, name.find_first_not_of('-'));
+        auto fit = flag_ids.find(name);
+        if (fit == flag_ids.end()) {
+          fit = flag_ids.emplace(name, data->flag_names.size()).first;
+          data->flag_names.push_back(name);
+          data->flag_values.emplace_back();
+        }
+        auto& col = data->flag_values[fit->second];
+        col.resize(data->freqs.size(), "");
+        col[row] = tokens[ii + 1];
+        ii += 2;
+      } else {
+        ii += 1;
+      }
+    }
+  }
+  for (auto& col : data->flag_values) col.resize(data->freqs.size(), "");
+  return data.release();
+}
+
+// ---------------------------------------------------------------------------
+// chain spooler
+// ---------------------------------------------------------------------------
+
+// File layout: 8-byte magic "GSTSPOOL", u32 version, u32 itemsize (4|8),
+// u32 ndim_trailing, u64 trailing_shape[...]; then raw row-major records.
+// The leading (row) dimension is implied by file size, so an append-only
+// writer needs no footer and a killed run leaves a readable prefix.
+constexpr char kMagic[8] = {'G', 'S', 'T', 'S', 'P', 'O', 'O', 'L'};
+constexpr uint32_t kVersion = 1;
+
+struct Spool {
+  std::FILE* fh = nullptr;
+  uint64_t row_bytes = 0;
+};
+
+}  // namespace
+
+GST_EXPORT const char* gst_last_error() { return g_error.c_str(); }
+
+// -- tim ABI ----------------------------------------------------------------
+
+GST_EXPORT void* gst_tim_read(const char* path, int include_deleted) {
+  return parse_tim(path, include_deleted);
+}
+
+GST_EXPORT void gst_tim_free(void* h) { delete static_cast<TimData*>(h); }
+
+GST_EXPORT int64_t gst_tim_n(void* h) {
+  return static_cast<int64_t>(static_cast<TimData*>(h)->freqs.size());
+}
+
+GST_EXPORT int64_t gst_tim_nsites(void* h) {
+  return static_cast<int64_t>(static_cast<TimData*>(h)->sites.size());
+}
+
+GST_EXPORT int64_t gst_tim_nflags(void* h) {
+  return static_cast<int64_t>(static_cast<TimData*>(h)->flag_names.size());
+}
+
+GST_EXPORT void gst_tim_fill(void* h, double* freqs, double* mjd_day,
+                             double* mjd_frac, double* errors,
+                             int32_t* site_idx, uint8_t* deleted) {
+  auto* d = static_cast<TimData*>(h);
+  size_t n = d->freqs.size();
+  std::memcpy(freqs, d->freqs.data(), n * sizeof(double));
+  std::memcpy(mjd_day, d->mjd_day.data(), n * sizeof(double));
+  std::memcpy(mjd_frac, d->mjd_frac.data(), n * sizeof(double));
+  std::memcpy(errors, d->errors.data(), n * sizeof(double));
+  std::memcpy(site_idx, d->site_idx.data(), n * sizeof(int32_t));
+  std::memcpy(deleted, d->deleted.data(), n * sizeof(uint8_t));
+}
+
+GST_EXPORT const char* gst_tim_name(void* h, int64_t i) {
+  return static_cast<TimData*>(h)->names[i].c_str();
+}
+
+GST_EXPORT const char* gst_tim_site(void* h, int64_t i) {
+  return static_cast<TimData*>(h)->sites[i].c_str();
+}
+
+GST_EXPORT const char* gst_tim_flag_name(void* h, int64_t j) {
+  return static_cast<TimData*>(h)->flag_names[j].c_str();
+}
+
+GST_EXPORT const char* gst_tim_flag_value(void* h, int64_t j, int64_t i) {
+  return static_cast<TimData*>(h)->flag_values[j][i].c_str();
+}
+
+// -- spool ABI --------------------------------------------------------------
+
+// Forward declaration (definition below, after the writer functions).
+GST_EXPORT int64_t gst_spool_info(const char* path, uint32_t* itemsize,
+                                  uint32_t* ndim_trailing,
+                                  uint64_t* trailing_shape,
+                                  uint64_t* header_bytes);
+
+GST_EXPORT void* gst_spool_open(const char* path, uint32_t itemsize,
+                                uint32_t ndim_trailing,
+                                const uint64_t* trailing_shape,
+                                int append) {
+  if (itemsize != 4 && itemsize != 8) {
+    set_error("itemsize must be 4 or 8");
+    return nullptr;
+  }
+  uint64_t row = itemsize;
+  for (uint32_t i = 0; i < ndim_trailing; ++i) row *= trailing_shape[i];
+  if (append) {
+    // Resume path: keep existing records. Require a matching header so a
+    // config change can't silently interleave incompatible rows.
+    uint32_t have_item = 0, have_ndim = 0;
+    uint64_t have_shape[8] = {0}, header = 0;
+    std::FILE* probe = std::fopen(path, "rb");
+    if (probe) {
+      std::fclose(probe);
+      int64_t rows = gst_spool_info(path, &have_item, &have_ndim,
+                                    have_shape, &header);
+      if (rows < 0) return nullptr;  // corrupt header: refuse to append
+      if (have_item != itemsize || have_ndim != ndim_trailing ||
+          std::memcmp(have_shape, trailing_shape,
+                      8 * ndim_trailing) != 0) {
+        set_error("spool header mismatch: existing file has a different "
+                  "dtype/shape");
+        return nullptr;
+      }
+      std::FILE* fh = std::fopen(path, "ab");
+      if (!fh) {
+        set_error(std::string("cannot open ") + path + ": " +
+                  std::strerror(errno));
+        return nullptr;
+      }
+      auto* sp = new Spool();
+      sp->fh = fh;
+      sp->row_bytes = row;
+      return sp;
+    }
+    // fall through: no existing file, create fresh
+  }
+  std::FILE* fh = std::fopen(path, "wb");
+  if (!fh) {
+    set_error(std::string("cannot open ") + path + ": " +
+              std::strerror(errno));
+    return nullptr;
+  }
+  bool ok = std::fwrite(kMagic, 1, 8, fh) == 8 &&
+            std::fwrite(&kVersion, 4, 1, fh) == 1 &&
+            std::fwrite(&itemsize, 4, 1, fh) == 1 &&
+            std::fwrite(&ndim_trailing, 4, 1, fh) == 1 &&
+            (ndim_trailing == 0 ||
+             std::fwrite(trailing_shape, 8, ndim_trailing, fh) ==
+                 ndim_trailing);
+  if (!ok) {
+    set_error("failed to write spool header");
+    std::fclose(fh);
+    return nullptr;
+  }
+  auto* sp = new Spool();
+  sp->fh = fh;
+  sp->row_bytes = row;
+  return sp;
+}
+
+GST_EXPORT int gst_spool_append(void* h, const void* data, uint64_t rows) {
+  auto* sp = static_cast<Spool*>(h);
+  uint64_t nb = rows * sp->row_bytes;
+  if (std::fwrite(data, 1, nb, sp->fh) != nb) {
+    set_error(std::string("short write: ") + std::strerror(errno));
+    return -1;
+  }
+  return 0;
+}
+
+GST_EXPORT int gst_spool_flush(void* h) {
+  return std::fflush(static_cast<Spool*>(h)->fh) == 0 ? 0 : -1;
+}
+
+GST_EXPORT int gst_spool_close(void* h) {
+  auto* sp = static_cast<Spool*>(h);
+  int rc = std::fclose(sp->fh);
+  delete sp;
+  if (rc != 0) set_error("close failed");
+  return rc == 0 ? 0 : -1;
+}
+
+// Reader side: parse the header of an existing spool file. Returns rows, or
+// -1 on error; fills itemsize/ndim/shape (shape buffer must hold >= 8).
+GST_EXPORT int64_t gst_spool_info(const char* path, uint32_t* itemsize,
+                                  uint32_t* ndim_trailing,
+                                  uint64_t* trailing_shape,
+                                  uint64_t* header_bytes) {
+  std::FILE* fh = std::fopen(path, "rb");
+  if (!fh) {
+    set_error(std::string("cannot open ") + path);
+    return -1;
+  }
+  char magic[8];
+  uint32_t version = 0;
+  if (std::fread(magic, 1, 8, fh) != 8 ||
+      std::memcmp(magic, kMagic, 8) != 0 ||
+      std::fread(&version, 4, 1, fh) != 1 || version != kVersion ||
+      std::fread(itemsize, 4, 1, fh) != 1 ||
+      std::fread(ndim_trailing, 4, 1, fh) != 1 || *ndim_trailing > 8 ||
+      std::fread(trailing_shape, 8, *ndim_trailing, fh) != *ndim_trailing) {
+    set_error("bad spool header");
+    std::fclose(fh);
+    return -1;
+  }
+  uint64_t row = *itemsize;
+  for (uint32_t i = 0; i < *ndim_trailing; ++i) row *= trailing_shape[i];
+  *header_bytes = 20 + 8ull * *ndim_trailing;
+  std::fseek(fh, 0, SEEK_END);
+  int64_t total = std::ftell(fh);
+  std::fclose(fh);
+  return (total - static_cast<int64_t>(*header_bytes)) /
+         static_cast<int64_t>(row);
+}

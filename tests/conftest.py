@@ -15,6 +15,14 @@ os.environ["XLA_FLAGS"] = (
     + " --xla_force_host_platform_device_count=8"
 )
 
+# The container's sitecustomize imports jax at interpreter start (before
+# this conftest runs) with JAX_PLATFORMS=axon, so the env mutation above is
+# too late for jax's import-time config capture — force the platform through
+# the live config as well.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np
 import pytest
 

@@ -1,0 +1,110 @@
+"""Analysis module: the notebook's validation surface as library functions."""
+
+import numpy as np
+import pytest
+
+from gibbs_student_t_tpu import analysis
+from gibbs_student_t_tpu.backends.base import ChainResult
+
+
+def _fake_result(niter=400, nchains=4, n=20, m=6, p=3, seed=0):
+    rng = np.random.default_rng(seed)
+    pout = np.zeros((niter, nchains, n))
+    pout[..., :3] = 0.97          # three hot TOAs
+    pout[..., 3:] = 0.05
+    return ChainResult(
+        chain=rng.standard_normal((niter, nchains, p)) + [1.0, -2.0, 0.5],
+        bchain=rng.standard_normal((niter, nchains, m)),
+        zchain=(pout > 0.5).astype(float),
+        thetachain=rng.beta(2.0, 18.0, (niter, nchains)),
+        alphachain=np.ones((niter, nchains, n)),
+        poutchain=pout,
+        dfchain=rng.integers(1, 10, (niter, nchains)).astype(float),
+        stats={"acc_white": np.full((niter, nchains), 0.3),
+               "acc_hyper": np.full((niter, nchains), 0.2)},
+    )
+
+
+def test_summarize_multichain():
+    res = _fake_result()
+    s = analysis.summarize(res, ["a", "b", "c"])
+    np.testing.assert_allclose(s.mean, [1.0, -2.0, 0.5], atol=0.1)
+    assert s.rhat is not None and np.all(s.rhat < 1.05)
+    assert np.all(s.ess > 100)
+    assert "a" in s.table() and "R-hat" in s.table()
+
+
+def test_summarize_single_chain():
+    res = _fake_result(nchains=1)
+    squeezed = ChainResult(
+        chain=res.chain[:, 0], bchain=res.bchain[:, 0],
+        zchain=res.zchain[:, 0], thetachain=res.thetachain[:, 0],
+        alphachain=res.alphachain[:, 0], poutchain=res.poutchain[:, 0],
+        dfchain=res.dfchain[:, 0], stats={})
+    s = analysis.summarize(squeezed, ["a", "b", "c"])
+    assert s.rhat is None
+    assert np.isfinite(s.mean).all()
+
+
+def test_outlier_identification_and_confusion():
+    res = _fake_result()
+    idx = analysis.identify_outliers(res)
+    np.testing.assert_array_equal(idx, [0, 1, 2])
+    z_true = np.zeros(20)
+    z_true[[0, 1, 5]] = 1
+    c = analysis.outlier_confusion(res, z_true)
+    assert c == {"true_positive": 2, "false_positive": 1,
+                 "false_negative": 1, "true_negative": 16}
+
+
+def test_theta_posterior_check_matches_beta_moments():
+    res = _fake_result(niter=2000)
+    centers, hist, prior = analysis.theta_posterior_check(res, n=20,
+                                                          outlier_mean=0.1)
+    # the analytic density must normalize to ~1 over the histogram support
+    width = centers[1] - centers[0]
+    assert 0.5 < hist.sum() * width <= 1.01
+    assert np.all(np.isfinite(prior))
+
+
+def test_df_posterior_pmf():
+    res = _fake_result()
+    pmf = analysis.df_posterior(res, df_max=30)
+    assert pmf.shape == (30,)
+    np.testing.assert_allclose(pmf.sum(), 1.0)
+    assert pmf[10:].sum() == 0.0  # draws were 1..9
+
+
+def test_acceptance_report():
+    res = _fake_result()
+    rep = analysis.acceptance_report(res)
+    assert rep == {"acc_white": pytest.approx(0.3),
+                   "acc_hyper": pytest.approx(0.2)}
+
+
+def test_waveform_reconstruction_shapes(demo_ma):
+    niter, nchains = 50, 2
+    rng = np.random.default_rng(1)
+    res = _fake_result(niter=niter, nchains=nchains, n=demo_ma.n,
+                       m=demo_ma.m)
+    res.bchain = rng.standard_normal((niter, nchains, demo_ma.m))
+    draws, med, lo, hi = analysis.reconstruct_waveform(res, demo_ma,
+                                                       ndraws=30)
+    assert draws.shape == (30, demo_ma.n)
+    assert med.shape == (demo_ma.n,)
+    assert np.all(lo <= hi)
+
+
+def test_plots_write_files(tmp_path, demo_ma):
+    pytest.importorskip("matplotlib")
+    res = _fake_result(niter=60, nchains=2, n=demo_ma.n, m=demo_ma.m,
+                       p=len(demo_ma.param_names))
+    mjds = np.linspace(53000, 54800, demo_ma.n)
+    analysis.plot_posteriors(res, demo_ma.param_names,
+                             str(tmp_path / "p.png"))
+    analysis.plot_outlier_map(res, mjds, str(tmp_path / "o.png"),
+                              z_true=np.zeros(demo_ma.n))
+    analysis.plot_waveform(res, demo_ma, mjds, str(tmp_path / "w.png"))
+    analysis.plot_df_posterior(res, str(tmp_path / "d.png"))
+    for f in ("p.png", "o.png", "w.png", "d.png"):
+        assert (tmp_path / f).stat().st_size > 0

@@ -1,0 +1,174 @@
+"""Native library (C++ tim tokenizer + chain spooler) vs. Python paths."""
+
+import numpy as np
+import pytest
+
+from gibbs_student_t_tpu import native
+from gibbs_student_t_tpu.data.tim import _read_tim_python, read_tim
+
+from tests.conftest import make_demo_pulsar
+
+
+@pytest.fixture(scope="module", autouse=True)
+def built():
+    native.load(build=True)
+    assert native.available(), "native build failed"
+
+
+TIM_TEXT = """\
+FORMAT 1
+MODE 1
+fake 1440.00000000 53012.00012345678901 0.04000000 AXIS -f L-wide -be ASP
+fake 1440.00000000 53026.10012345678902 0.05000000 AXIS -be GUPPI
+C fake 1440.00000000 53040.20012345678903 0.06000000 AXIS -f L-wide
+# a freeform comment line that is not a TOA
+fake 430.00000000 53054.30012345678904 0.07000000 ao
+"""
+
+
+def _write(tmp_path, text):
+    p = tmp_path / "test.tim"
+    p.write_text(text)
+    return str(p)
+
+
+@pytest.mark.parametrize("include_deleted", [False, True])
+def test_native_matches_python(tmp_path, include_deleted):
+    path = _write(tmp_path, TIM_TEXT)
+    ref = _read_tim_python(path, include_deleted)
+    nat = native.read_tim_native(path, include_deleted)
+    assert nat.names == ref.names
+    assert nat.sites == ref.sites
+    np.testing.assert_array_equal(nat.freqs, ref.freqs)
+    np.testing.assert_array_equal(nat.errors, ref.errors)
+    np.testing.assert_array_equal(nat.deleted, ref.deleted)
+    assert sorted(nat.flags) == sorted(ref.flags)
+    for k in ref.flags:
+        assert list(nat.flags[k]) == list(ref.flags[k])
+    # day+frac split loses <0.1 ns; compare at 1e-15 days (~0.1 ns)
+    np.testing.assert_allclose(
+        np.asarray(nat.mjds, dtype=np.float64),
+        np.asarray(ref.mjds, dtype=np.float64), rtol=0, atol=1e-15)
+    assert float(np.max(np.abs(nat.mjds - ref.mjds))) < 2e-15
+
+
+def test_read_tim_auto_prefers_native(tmp_path):
+    path = _write(tmp_path, TIM_TEXT)
+    tim = read_tim(path, engine="auto")
+    assert tim.n == 3
+
+
+def test_native_roundtrip_demo_pulsar(tmp_path):
+    """Full simulator round trip through the native parser."""
+    psr_py, _ = make_demo_pulsar(tmpdir=str(tmp_path), seed=7, n=40)
+    timfile = [str(p) for p in tmp_path.rglob("*.tim")][0]
+    nat = native.read_tim_native(timfile)
+    ref = _read_tim_python(timfile)
+    assert nat.n == ref.n
+    assert float(np.max(np.abs(nat.mjds - ref.mjds))) < 2e-15
+
+
+def test_native_include_raises(tmp_path):
+    path = _write(tmp_path, "FORMAT 1\nINCLUDE other.tim\n")
+    with pytest.raises(NotImplementedError):
+        native.read_tim_native(path)
+
+
+def test_spool_roundtrip(tmp_path):
+    path = str(tmp_path / "x.spool")
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((5, 3, 2)).astype(np.float32)
+    b = rng.standard_normal((2, 3, 2)).astype(np.float32)
+    with native.SpoolWriter(path, trailing_shape=(3, 2)) as w:
+        w.append(a)
+        w.append(b)
+    out = native.read_spool(path)
+    np.testing.assert_array_equal(out, np.concatenate([a, b]))
+
+
+def test_spool_scalar_rows_float64(tmp_path):
+    path = str(tmp_path / "s.spool")
+    vals = np.arange(7, dtype=np.float64)
+    with native.SpoolWriter(path, trailing_shape=(), dtype=np.float64) as w:
+        w.append(vals)
+    np.testing.assert_array_equal(native.read_spool(path), vals)
+
+
+def test_spool_interrupted_prefix_readable(tmp_path):
+    """A dead writer (no close) must leave a readable file — the crash
+    resume story."""
+    path = str(tmp_path / "p.spool")
+    w = native.SpoolWriter(path, trailing_shape=(4,))
+    data = np.ones((10, 4), dtype=np.float32)
+    w.append(data)
+    w.flush()
+    # no close: simulates a killed process
+    out = native.read_spool(path)
+    np.testing.assert_array_equal(out, data)
+    w.close()
+
+
+def test_spool_append_resume_keeps_history(tmp_path):
+    path = str(tmp_path / "r.spool")
+    a = np.full((3, 2), 1.0, dtype=np.float32)
+    b = np.full((2, 2), 2.0, dtype=np.float32)
+    with native.SpoolWriter(path, trailing_shape=(2,)) as w:
+        w.append(a)
+    with native.SpoolWriter(path, trailing_shape=(2,), append=True) as w:
+        w.append(b)
+    np.testing.assert_array_equal(native.read_spool(path),
+                                  np.concatenate([a, b]))
+    # header mismatch on resume is refused, not silently corrupted
+    with pytest.raises(OSError, match="mismatch"):
+        native.SpoolWriter(path, trailing_shape=(3,), append=True)
+
+
+def test_jax_sample_spool_resume_appends(tmp_path, demo_ma):
+    """Kill/resume flow: run 6 sweeps, 'crash', resume 4 more from the
+    checkpoint — the spool must contain all 10 and match an unbroken run."""
+    from gibbs_student_t_tpu.backends import JaxGibbs
+    from gibbs_student_t_tpu.config import GibbsConfig
+    from gibbs_student_t_tpu.utils.spool import load_spool, load_spool_state
+
+    cfg = GibbsConfig(model="mixture", vary_df=True)
+    gb = JaxGibbs(demo_ma, cfg, nchains=2, chunk_size=3)
+    ref = gb.sample(niter=10, seed=5)
+    d = str(tmp_path / "spool")
+    gb.sample(niter=6, seed=5, spool_dir=d)
+    state, sweep, seed = load_spool_state(d)
+    assert sweep == 6
+    import jax
+
+    state = jax.tree.map(jnp_asarray, state)
+    gb.sample(niter=4, seed=seed, state=state, start_sweep=sweep,
+              spool_dir=d)
+    out = load_spool(d)
+    assert out.chain.shape[0] == 10
+    np.testing.assert_allclose(out.chain, ref.chain, rtol=1e-5, atol=1e-6)
+
+
+def jnp_asarray(x):
+    import jax.numpy as jnp
+
+    return jnp.asarray(x)
+
+
+def test_jax_sample_spooled_matches_inmemory(tmp_path, demo_ma):
+    from gibbs_student_t_tpu.backends import JaxGibbs
+    from gibbs_student_t_tpu.config import GibbsConfig
+    from gibbs_student_t_tpu.utils.spool import load_spool_state
+
+    cfg = GibbsConfig(model="mixture", vary_df=True)
+    gb = JaxGibbs(demo_ma, cfg, nchains=3, chunk_size=4)
+    res_mem = gb.sample(niter=10, seed=11)
+    spool_dir = str(tmp_path / "spool")
+    res_sp = gb.sample(niter=10, seed=11, spool_dir=spool_dir)
+    np.testing.assert_allclose(res_sp.chain, res_mem.chain, rtol=1e-6)
+    np.testing.assert_allclose(res_sp.thetachain, res_mem.thetachain,
+                               rtol=1e-6)
+    np.testing.assert_allclose(res_sp.stats["acc_hyper"],
+                               res_mem.stats["acc_hyper"], rtol=1e-6)
+    state, sweep, seed = load_spool_state(spool_dir)
+    assert sweep == 10 and seed == 11
+    np.testing.assert_allclose(np.asarray(state.x),
+                               np.asarray(gb.last_state.x), rtol=1e-6)
