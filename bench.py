@@ -79,10 +79,12 @@ def bench_numpy(ma, cfg, nsweeps: int, seed: int = 0) -> float:
 
 
 def bench_jax(ma, cfg, nchains: int, nsweeps: int, chunk: int,
-              seed: int = 0) -> float:
+              seed: int = 0, record: str = "full",
+              tnt_block_size="auto") -> float:
     from gibbs_student_t_tpu.backends import JaxGibbs
 
-    gb = JaxGibbs(ma, cfg, nchains=nchains, chunk_size=chunk)
+    gb = JaxGibbs(ma, cfg, nchains=nchains, chunk_size=chunk,
+                  record=record, tnt_block_size=tnt_block_size)
     # warmup: compile + one chunk
     state = gb.init_state(seed=seed)
     gb.sample(niter=chunk, seed=seed, state=state)
@@ -105,6 +107,9 @@ def main(argv=None):
     ap.add_argument("--model", default="mixture")
     ap.add_argument("--quick", action="store_true",
                     help="small shapes for smoke-testing the benchmark")
+    ap.add_argument("--stress", action="store_true",
+                    help="1e5-TOA blocked-reduction config (BASELINE "
+                         "config 4): 64 chains, light recording")
     ap.add_argument("--platform", default="auto",
                     help="jax platform: auto (probe TPU, fall back to cpu), "
                          "or an explicit JAX_PLATFORMS value")
@@ -113,6 +118,12 @@ def main(argv=None):
     if args.quick:
         args.nchains, args.niter = 32, 50
         args.baseline_sweeps, args.chunk = 30, 25
+    record = "full"
+    if args.stress:
+        args.ntoa, args.nchains = 100_000, 64
+        args.niter, args.chunk = 20, 10
+        args.baseline_sweeps = 3
+        record = "light"
 
     platform = resolve_platform(args.platform)
     import jax
@@ -125,7 +136,8 @@ def main(argv=None):
     ma = build(args.ntoa, args.components)
 
     numpy_sps = bench_numpy(ma, cfg, args.baseline_sweeps)
-    jax_sps = bench_jax(ma, cfg, args.nchains, args.niter, args.chunk)
+    jax_sps = bench_jax(ma, cfg, args.nchains, args.niter, args.chunk,
+                        record=record)
 
     # wall-clock speedup for the same per-chain sweep count, i.e. the
     # north-star "1024 chains vs single-chain NumPy" factor: each JAX sweep

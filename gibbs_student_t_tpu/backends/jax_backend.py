@@ -46,6 +46,12 @@ from gibbs_student_t_tpu.ops.linalg import (
     precond_solve_quad,
     robust_precond_cholesky,
 )
+from gibbs_student_t_tpu.ops.tnt import (
+    auto_block_size,
+    matvec_blocked,
+    pad_rows,
+    tnt_products,
+)
 
 
 class ChainState(NamedTuple):
@@ -75,23 +81,60 @@ class JaxGibbs(SamplerBackend):
 
     def __init__(self, ma: ModelArrays, config: GibbsConfig,
                  nchains: int = 64, dtype=jnp.float32,
-                 chunk_size: int = 100):
+                 chunk_size: int = 100,
+                 tnt_block_size: int | str | None = "auto",
+                 record: str = "full"):
+        """``tnt_block_size`` selects the TOA reduction: ``None`` dense,
+        an int for a ``lax.scan`` over row blocks (the 1e5-TOA stress path,
+        BASELINE.json config 4; TOA axis zero-padded to a block multiple),
+        ``"auto"`` picks by TOA count. ``record="light"`` records only the
+        O(1)-per-sweep fields (x, theta, df, acceptance) — at stress scale
+        the per-TOA chains (z, alpha, pout) dominate host transfer."""
         super().__init__(ma, config)
         self.nchains = nchains
         self.dtype = dtype
         self.chunk_size = chunk_size
+        if record not in ("full", "light"):
+            raise ValueError(f"record must be 'full' or 'light', got {record!r}")
+        self._record_fields = (_RECORD_FIELDS if record == "full" else
+                               ("x", "theta", "df", "acc_white", "acc_hyper"))
+        if tnt_block_size == "auto":
+            tnt_block_size = auto_block_size(ma.n)
+        self._block_size = tnt_block_size
+        self._n_real = ma.n
+        y, T, sigma2 = ma.y, ma.T, ma.sigma2
+        efac_masks, equad_masks = ma.efac_masks, ma.equad_masks
+        self._n_pad = 0
+        if tnt_block_size is not None:
+            T, y, self._n_pad = pad_rows(np.asarray(T), np.asarray(y),
+                                         tnt_block_size)
+            if self._n_pad:
+                # Padded rows: zero basis/residual/masks. ndiag() gives 0
+                # there; the sweep forces their nvec to 1 (row mask), so
+                # they contribute nothing to any reduction (ops/tnt.py).
+                pad = self._n_pad
+                sigma2 = np.concatenate([sigma2, np.zeros(pad)])
+                efac_masks = np.concatenate(
+                    [efac_masks, np.zeros((efac_masks.shape[0], pad))],
+                    axis=1)
+                equad_masks = np.concatenate(
+                    [equad_masks, np.zeros((equad_masks.shape[0], pad))],
+                    axis=1)
         # dtype-cast copy of the frozen model so every kernel array (and the
         # constants XLA embeds) live in the compute precision
         self._ma = dataclasses.replace(
             ma,
-            y=np.asarray(ma.y, dtype=dtype),
-            T=np.asarray(ma.T, dtype=dtype),
-            sigma2=np.asarray(ma.sigma2, dtype=dtype),
-            efac_masks=np.asarray(ma.efac_masks, dtype=dtype),
+            y=np.asarray(y, dtype=dtype),
+            T=np.asarray(T, dtype=dtype),
+            sigma2=np.asarray(sigma2, dtype=dtype),
+            efac_masks=np.asarray(efac_masks, dtype=dtype),
             efac_const=np.asarray(ma.efac_const, dtype=dtype),
-            equad_masks=np.asarray(ma.equad_masks, dtype=dtype),
+            equad_masks=np.asarray(equad_masks, dtype=dtype),
             equad_const=np.asarray(ma.equad_const, dtype=dtype),
         )
+        self._row_mask = (
+            None if not self._n_pad else
+            jnp.arange(self._ma.n) < self._n_real)
         self._pspin = (config.pspin * ma.time_scale
                        if config.pspin is not None else 1.0)
         self._chunk_fn = jax.jit(self._make_chunk_fn(),
@@ -116,6 +159,10 @@ class JaxGibbs(SamplerBackend):
                       dtype=self.dtype)
         alpha0 = jnp.full((c, n), 1.0 if cfg.vary_alpha else cfg.alpha,
                           dtype=self.dtype)
+        if self._row_mask is not None:
+            # padded TOA rows never count as outliers and carry unit scale
+            z0 = jnp.where(self._row_mask, z0, 0.0)
+            alpha0 = jnp.where(self._row_mask, alpha0, 1.0)
         return ChainState(
             x=jnp.asarray(x0),
             b=jnp.zeros((c, m), dtype=self.dtype),
@@ -175,19 +222,30 @@ class JaxGibbs(SamplerBackend):
         per-pulsar ModelArrays pytree instead (parallel/ensemble.py)."""
         if ma is None:
             ma = self._ma
+            mask = self._row_mask        # None unless the TOA axis is padded
+            bs = self._block_size
+            n = self._n_real             # statistical n (excludes padding)
+        else:
+            mask, bs, n = None, None, ma.n
         cfg = self.config
-        n, m = ma.n, ma.m
+        m = ma.m
         kw, kh, kb, kt, kz, ka, kd = random.split(key, 7)
         x, b, z, alpha, theta, df = (state.x, state.b, state.z, state.alpha,
                                      state.theta, state.df)
 
+        def masked_nvec(xq, az):
+            """alpha^z-scaled white variances; padded rows pinned to 1 so
+            they add 0 to every log/quadratic reduction."""
+            nv = az * ndiag(ma, xq, jnp)
+            return nv if mask is None else jnp.where(mask, nv, 1.0)
+
         # --- white-noise MH block (reference gibbs.py:114-143) ---------
         az = alpha ** z
         if len(ma.white_indices):
-            Tb = ma.T @ b
+            Tb = matvec_blocked(ma.T, b, bs)
 
             def ll_white(xq):
-                nvec = az * ndiag(ma, xq, jnp)
+                nvec = masked_nvec(xq, az)
                 yred = ma.y - Tb
                 return -0.5 * (jnp.sum(jnp.log(nvec))
                                + jnp.sum(yred * yred / nvec))
@@ -197,12 +255,10 @@ class JaxGibbs(SamplerBackend):
         else:
             acc_w = jnp.zeros((), dtype=self.dtype)
 
-        # --- per-sweep inner products (reference gibbs.py:302-304) -----
-        nvec = az * ndiag(ma, x, jnp)
-        TNT = ma.T.T @ (ma.T / nvec[:, None])
-        d = ma.T.T @ (ma.y / nvec)
-        const_white = -0.5 * (jnp.sum(jnp.log(nvec))
-                              + jnp.sum(ma.y * ma.y / nvec))
+        # --- per-sweep inner products (reference gibbs.py:302-304), via
+        # the fused dense/blocked reduction (ops/tnt.py) ----------------
+        nvec = masked_nvec(x, az)
+        TNT, d, const_white = tnt_products(ma.T, ma.y, nvec, bs)
 
         # --- hyper MH block on the marginalized likelihood -------------
         # (reference gibbs.py:80-111, 288-329)
@@ -233,8 +289,10 @@ class JaxGibbs(SamplerBackend):
         b = gaussian_draw(L, isd, mean,
                           random.normal(kb, (m,), dtype=self.dtype))
 
-        resid = ma.y - ma.T @ b
+        resid = ma.y - matvec_blocked(ma.T, b, bs)
         nvec0 = ndiag(ma, x, jnp)
+        if mask is not None:
+            nvec0 = jnp.where(mask, nvec0, 1.0)
 
         # --- outlier fraction theta ~ Beta (reference gibbs.py:185-198) -
         if cfg.is_outlier_model:
@@ -252,12 +310,14 @@ class JaxGibbs(SamplerBackend):
         if cfg.is_outlier_model:
             p_in = _norm_pdf(resid, nvec0)
             if cfg.model == "vvh17":
-                top = jnp.full((n,), theta / self._pspin, dtype=self.dtype)
+                top = jnp.full_like(resid, theta / self._pspin)
             else:
                 top = theta * _norm_pdf(resid, alpha * nvec0)
             bot = top + (1.0 - theta) * p_in
             q = top / bot
             q = jnp.where(jnp.isnan(q), 1.0, q)
+            if mask is not None:
+                q = jnp.where(mask, q, 0.0)  # pads never flag as outliers
             pout = q
             z = random.bernoulli(kz, jnp.clip(q, 0.0, 1.0)).astype(self.dtype)
 
@@ -266,12 +326,17 @@ class JaxGibbs(SamplerBackend):
             top = (resid * resid * z / nvec0 + df) / 2.0
             g = random.gamma(ka, (z + df) / 2.0, dtype=self.dtype)
             alpha_new = top / g
+            if mask is not None:
+                alpha_new = jnp.where(mask, alpha_new, 1.0)
             alpha = jnp.where(jnp.sum(z) >= 1.0, alpha_new, alpha)
 
         # --- degrees of freedom on the grid (reference gibbs.py:244-259)
         if cfg.vary_df:
             grid = jnp.arange(1, cfg.df_max + 1, dtype=self.dtype)
-            s = jnp.sum(jnp.log(alpha) + 1.0 / alpha)
+            terms = jnp.log(alpha) + 1.0 / alpha
+            if mask is not None:
+                terms = jnp.where(mask, terms, 0.0)
+            s = jnp.sum(terms)
             logp = (-(grid / 2.0) * s
                     + n * (grid / 2.0) * jnp.log(grid / 2.0)
                     - n * gammaln(grid / 2.0))
@@ -285,9 +350,11 @@ class JaxGibbs(SamplerBackend):
     # ------------------------------------------------------------------
 
     def _make_chunk_fn(self):
+        fields = self._record_fields
+
         def one_chain(state, chain_key, offset, length):
             def body(st, i):
-                rec = tuple(getattr(st, f) for f in _RECORD_FIELDS)
+                rec = tuple(getattr(st, f) for f in fields)
                 st = self._sweep(st, random.fold_in(chain_key, offset + i))
                 return st, rec
 
@@ -310,15 +377,19 @@ class JaxGibbs(SamplerBackend):
         ``ll_hyper``)."""
         ma, cfg = self._ma, self.config
         x = jnp.asarray(x, dtype=self.dtype)
-        z = (jnp.zeros(ma.n, dtype=self.dtype) if z is None
+        z = (jnp.zeros(self._n_real, dtype=self.dtype) if z is None
              else jnp.asarray(z, dtype=self.dtype))
-        alpha = (jnp.ones(ma.n, dtype=self.dtype) if alpha is None
+        alpha = (jnp.ones(self._n_real, dtype=self.dtype) if alpha is None
                  else jnp.asarray(alpha, dtype=self.dtype))
+        if self._n_pad:
+            z = jnp.concatenate([z, jnp.zeros(self._n_pad, self.dtype)])
+            alpha = jnp.concatenate(
+                [alpha, jnp.ones(self._n_pad, self.dtype)])
         nvec = alpha ** z * ndiag(ma, x, jnp)
-        TNT = ma.T.T @ (ma.T / nvec[:, None])
-        d = ma.T.T @ (ma.y / nvec)
-        const_white = -0.5 * (jnp.sum(jnp.log(nvec))
-                              + jnp.sum(ma.y * ma.y / nvec))
+        if self._row_mask is not None:
+            nvec = jnp.where(self._row_mask, nvec, 1.0)
+        TNT, d, const_white = tnt_products(ma.T, ma.y, nvec,
+                                           self._block_size)
         phiinv, logdet_phi = phiinv_logdet(ma, x, jnp)
         Sigma = TNT + jnp.diag(phiinv)
         L, isd, logdet_sigma = precond_cholesky(Sigma, cfg.jitter)
@@ -347,10 +418,13 @@ class JaxGibbs(SamplerBackend):
             from gibbs_student_t_tpu.utils.spool import ChainSpool
 
             # Resuming from a checkpointed state appends to the existing
-            # spool instead of truncating it.
-            spool = ChainSpool(spool_dir, seed, resume=resume)
+            # spool (truncated back to the checkpointed sweep first, in
+            # case a crash left orphaned rows) instead of overwriting it.
+            spool = ChainSpool(spool_dir, seed, resume=resume,
+                               resume_at=start_sweep if resume else None)
         records = []
         done = 0
+        fields = self._record_fields
         while done < niter:
             length = min(self.chunk_size, niter - done)
             state, recs = self._chunk_fn(state, keys,
@@ -359,8 +433,8 @@ class JaxGibbs(SamplerBackend):
             done += length
             if spool is not None:
                 spool.append(
-                    {f: np.swapaxes(host[i], 0, 1)
-                     for i, f in enumerate(_RECORD_FIELDS)},
+                    {f: self._trim(f, np.swapaxes(host[i], 0, 1))
+                     for i, f in enumerate(fields)},
                     state, start_sweep + done)
             else:
                 records.append(host)
@@ -373,15 +447,28 @@ class JaxGibbs(SamplerBackend):
         self.last_state = state
 
         cols = {
-            f: np.concatenate([np.swapaxes(r[i], 0, 1) for r in records])
-            for i, f in enumerate(_RECORD_FIELDS)
+            f: self._trim(
+                f, np.concatenate([np.swapaxes(r[i], 0, 1)
+                                   for r in records]))
+            for i, f in enumerate(fields)
         }
+        return self._to_result(cols)
+
+    def _trim(self, field: str, arr: np.ndarray) -> np.ndarray:
+        """Cut TOA padding back off the recorded per-TOA chains."""
+        if self._n_pad and field in ("z", "alpha", "pout"):
+            return arr[..., :self._n_real]
+        return arr
+
+    def _to_result(self, cols) -> ChainResult:
+        empty = np.zeros((0,))
         return ChainResult(
-            chain=cols["x"], bchain=cols["b"], zchain=cols["z"],
-            thetachain=cols["theta"], alphachain=cols["alpha"],
-            poutchain=cols["pout"], dfchain=cols["df"],
-            stats={"acc_white": cols["acc_white"],
-                   "acc_hyper": cols["acc_hyper"]},
+            chain=cols.get("x", empty), bchain=cols.get("b", empty),
+            zchain=cols.get("z", empty), thetachain=cols.get("theta", empty),
+            alphachain=cols.get("alpha", empty),
+            poutchain=cols.get("pout", empty), dfchain=cols.get("df", empty),
+            stats={k: v for k, v in cols.items()
+                   if k.startswith("acc_")},
         )
 
 

@@ -40,8 +40,15 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         "gst_tim_flag_name": ([c.c_void_p, c.c_int64], c.c_char_p),
         "gst_tim_flag_value": ([c.c_void_p, c.c_int64, c.c_int64],
                                c.c_char_p),
+        # packed exports return a raw pointer (NOT c_char_p, which would
+        # stop at the first NUL and copy-convert) + byte length
+        "gst_tim_names_packed": ([c.c_void_p, c.POINTER(c.c_uint64)],
+                                 c.c_void_p),
+        "gst_tim_flag_packed": ([c.c_void_p, c.c_int64,
+                                 c.POINTER(c.c_uint64)], c.c_void_p),
         "gst_spool_open": ([c.c_char_p, c.c_uint32, c.c_uint32,
-                            c.POINTER(c.c_uint64), c.c_int], c.c_void_p),
+                            c.POINTER(c.c_uint64), c.c_int, c.c_uint64],
+                           c.c_void_p),
         "gst_spool_append": ([c.c_void_p, c.c_void_p, c.c_uint64], c.c_int),
         "gst_spool_flush": ([c.c_void_p], c.c_int),
         "gst_spool_close": ([c.c_void_p], c.c_int),
@@ -113,13 +120,21 @@ def read_tim_native(path: str, include_deleted: bool = False):
                      for a in (freqs, day, frac, errors, site_idx, deleted)))
         sites_tbl = [lib.gst_tim_site(h, i).decode()
                      for i in range(lib.gst_tim_nsites(h))]
-        names = [lib.gst_tim_name(h, i).decode() for i in range(n)]
+
+        def unpack(ptr, nbytes) -> list:
+            # one FFI call + one split for the whole column (the per-index
+            # getters would be O(n) round-trips on 1e5-TOA files)
+            blob = ctypes.string_at(ptr, nbytes.value).decode()
+            return blob.split("\n") if n else []
+
+        nb = ctypes.c_uint64()
+        names = unpack(lib.gst_tim_names_packed(h, ctypes.byref(nb)), nb)
         flags: Dict[str, np.ndarray] = {}
         for j in range(lib.gst_tim_nflags(h)):
             key = lib.gst_tim_flag_name(h, j).decode()
-            flags[key] = np.array(
-                [lib.gst_tim_flag_value(h, j, i).decode() for i in range(n)],
-                dtype=object)
+            vals = unpack(lib.gst_tim_flag_packed(h, j, ctypes.byref(nb)),
+                          nb)
+            flags[key] = np.array(vals, dtype=object)
         flags = dict(sorted(flags.items()))
         mjds = day.astype(np.longdouble) + frac.astype(np.longdouble)
         return TimFile(
@@ -151,10 +166,17 @@ class SpoolWriter:
     the row count is implied by file size, not a footer.
     """
 
+    _KEEP_ALL = 2 ** 64 - 1
+
     def __init__(self, path: str, trailing_shape: Sequence[int],
-                 dtype=np.float32, append: bool = False):
+                 dtype=np.float32, append: bool = False,
+                 keep_rows: Optional[int] = None):
         """``append=True`` keeps an existing file's records (resume path);
-        the on-disk header must match ``dtype``/``trailing_shape``."""
+        the on-disk header must match ``dtype``/``trailing_shape``.
+        ``keep_rows`` truncates the file to that many rows before
+        appending — pass the checkpointed sweep count so orphaned rows
+        from a crash mid-append (or a partial row mid-write) are discarded
+        rather than silently shifting every later sweep."""
         lib = load()
         if lib is None:
             raise RuntimeError("native library not built (run make -C native)")
@@ -163,10 +185,10 @@ class SpoolWriter:
         self.trailing_shape = tuple(int(s) for s in trailing_shape)
         shape_arr = (ctypes.c_uint64 * len(self.trailing_shape))(
             *self.trailing_shape)
-        self._h = lib.gst_spool_open(path.encode(),
-                                     _ITEMSIZE[self.dtype],
-                                     len(self.trailing_shape), shape_arr,
-                                     int(append))
+        self._h = lib.gst_spool_open(
+            path.encode(), _ITEMSIZE[self.dtype],
+            len(self.trailing_shape), shape_arr, int(append),
+            self._KEEP_ALL if keep_rows is None else int(keep_rows))
         if not self._h:
             raise OSError(_err(lib))
 

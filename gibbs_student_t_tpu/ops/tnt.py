@@ -1,0 +1,111 @@
+"""Fused inner products of the marginalized likelihood, dense and blocked.
+
+Every sweep needs the same three reductions over the TOA axis
+(reference gibbs.py:302-311):
+
+    TNT = T^T N^-1 T        (m, m)
+    d   = T^T N^-1 y        (m,)
+    c   = -1/2 (sum log N + y^T N^-1 y)     (scalar)
+
+where ``N = diag(nvec)``. The dense form materializes the weighted basis
+``T / nvec[:, None]`` — an ``(n, m)`` intermediate *per chain* under
+``vmap``, which at the stress scale (n=1e5, m~74, 1024 chains) is ~30 TB
+and cannot exist. :func:`tnt_products` therefore switches to a
+``lax.scan`` over TOA blocks (BASELINE.json config 4): each step computes
+one block's ``T_b^T (T_b / nvec_b)`` on the MXU and accumulates into the
+``(m, m)`` carry, so live memory per chain is ``O(block x m)`` and the
+matmuls stay big enough to tile well.
+
+``T`` is parameter-independent in this model family, so callers pad it
+once (``pad_rows``) to a block multiple; padded rows carry ``y = 0`` and
+``nvec = 1`` and contribute exactly zero to all three outputs.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def pad_rows(T: np.ndarray, y: np.ndarray,
+             block_size: int) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Zero-pad the TOA axis to a multiple of ``block_size``.
+
+    Returns ``(T_pad, y_pad, n_pad)``. Weight arrays built from masks
+    must map the padded tail to ``nvec = 1`` (see ``JaxGibbs``): zero
+    basis rows and zero residuals then contribute nothing to TNT/d, and
+    ``log 1 = 0`` contributes nothing to the white constant.
+    """
+    n = T.shape[0]
+    n_pad = (-n) % block_size
+    if n_pad == 0:
+        return T, y, 0
+    T_pad = np.concatenate([T, np.zeros((n_pad, T.shape[1]), T.dtype)])
+    y_pad = np.concatenate([y, np.zeros(n_pad, y.dtype)])
+    return T_pad, y_pad, n_pad
+
+
+def tnt_products(T, y, nvec, block_size: Optional[int] = None):
+    """``(TNT, d, const_white)`` for one chain.
+
+    ``block_size=None`` is the dense path (small n). With a block size,
+    the TOA axis (which must be an exact multiple) is reduced by
+    ``lax.scan``; results are bitwise-independent of ``block_size`` up to
+    float reassociation.
+    """
+    if block_size is None:
+        w = 1.0 / nvec
+        Tw = T * w[:, None]
+        TNT = T.T @ Tw
+        d = Tw.T @ y
+        const = -0.5 * (jnp.sum(jnp.log(nvec)) + jnp.sum(y * y * w))
+        return TNT, d, const
+
+    n, m = T.shape
+    if n % block_size != 0:
+        raise ValueError(
+            f"blocked tnt_products needs n ({n}) to be a multiple of "
+            f"block_size ({block_size}); use pad_rows first")
+    nb = n // block_size
+    Tb = T.reshape(nb, block_size, m)
+    yb = y.reshape(nb, block_size)
+    nb_vec = nvec.reshape(nb, block_size)
+
+    def step(carry, blk):
+        TNT, d, const = carry
+        Tk, yk, nk = blk
+        w = 1.0 / nk
+        Tw = Tk * w[:, None]
+        TNT = TNT + Tk.T @ Tw
+        d = d + Tw.T @ yk
+        const = const - 0.5 * (jnp.sum(jnp.log(nk))
+                               + jnp.sum(yk * yk * w))
+        return (TNT, d, const), None
+
+    init = (jnp.zeros((m, m), dtype=T.dtype),
+            jnp.zeros((m,), dtype=T.dtype),
+            jnp.zeros((), dtype=T.dtype))
+    (TNT, d, const), _ = lax.scan(step, init, (Tb, yb, nb_vec))
+    return TNT, d, const
+
+
+def matvec_blocked(T, b, block_size: Optional[int] = None):
+    """``T @ b`` with an optional row-blocked scan (same padding contract);
+    used for the conditional-likelihood residual ``y - T b`` at stress
+    scale."""
+    if block_size is None:
+        return T @ b
+    n, m = T.shape
+    nb = n // block_size
+    return lax.map(lambda Tk: Tk @ b,
+                   T.reshape(nb, block_size, m)).reshape(n)
+
+
+def auto_block_size(n: int, threshold: int = 16384,
+                    block: int = 4096) -> Optional[int]:
+    """Default policy: dense below ``threshold`` TOAs, blocked above."""
+    return None if n < threshold else block

@@ -29,10 +29,13 @@ _CHAIN_KEYS = {
 class ChainSpool:
     """Directory of per-field spool files plus a rolling state checkpoint."""
 
-    def __init__(self, path: str, seed: int, resume: bool = False):
+    def __init__(self, path: str, seed: int, resume: bool = False,
+                 resume_at: Optional[int] = None):
         """``resume=True`` appends to an existing spool directory (after a
         kill: ``load_spool_state`` -> ``sample(state=..., start_sweep=...,
-        spool_dir=...)``) instead of truncating it."""
+        spool_dir=...)``) instead of truncating it. ``resume_at`` is the
+        checkpointed sweep index being resumed from; rows past it (orphans
+        from a crash mid-append) are truncated away before appending."""
         from gibbs_student_t_tpu import native
 
         if not native.available():
@@ -42,6 +45,7 @@ class ChainSpool:
         self.path = path
         self.seed = seed
         self.resume = resume
+        self.resume_at = resume_at
         self._writers: Optional[Dict[str, object]] = None
         os.makedirs(path, exist_ok=True)
 
@@ -50,14 +54,34 @@ class ChainSpool:
         """``records[field]`` is ``(chunk_len, nchains, ...)``; ``sweep`` is
         the index of the first sweep *after* this chunk (the resume point)."""
         if self._writers is None:
-            with open(os.path.join(self.path, "meta.json"), "w") as fh:
-                json.dump({"fields": sorted(records),
-                           "seed": self.seed}, fh)
+            meta_path = os.path.join(self.path, "meta.json")
+            chunk_len = len(next(iter(records.values())))
+            keep_rows = None
+            if self.resume and os.path.exists(meta_path):
+                with open(meta_path) as fh:
+                    meta = json.load(fh)
+                if meta["fields"] != sorted(records):
+                    raise ValueError(
+                        f"resume record fields {sorted(records)} do not "
+                        f"match the spooled run's {meta['fields']}; use "
+                        "the same record= mode to resume")
+                base = meta.get("base", 0)
+                if self.resume_at is not None:
+                    keep_rows = self.resume_at - base
+                    if keep_rows < 0:
+                        raise ValueError(
+                            f"resume_at={self.resume_at} predates the "
+                            f"spool's first sweep ({base})")
+            else:
+                base = sweep - chunk_len
+                with open(meta_path, "w") as fh:
+                    json.dump({"fields": sorted(records),
+                               "seed": self.seed, "base": base}, fh)
             self._writers = {
                 f: self._native.SpoolWriter(
                     os.path.join(self.path, f + ".spool"),
                     trailing_shape=a.shape[1:], dtype=a.dtype,
-                    append=self.resume)
+                    append=self.resume, keep_rows=keep_rows)
                 for f, a in records.items()
             }
         for f, a in records.items():
@@ -88,6 +112,10 @@ def load_spool(path: str) -> ChainResult:
     cols = {f: a[:nmin] for f, a in cols.items()}
     chains = {_CHAIN_KEYS[f]: cols.pop(f)
               for f in list(cols) if f in _CHAIN_KEYS}
+    # fields not spooled (record="light" runs) come back empty
+    empty = np.zeros((0,))
+    for key in _CHAIN_KEYS.values():
+        chains.setdefault(key, empty)
     return ChainResult(**chains, stats=cols)
 
 

@@ -20,6 +20,9 @@
 #include <cerrno>
 #include <cmath>
 #include <cstdint>
+#if !defined(_WIN32)
+#include <unistd.h>
+#endif
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
@@ -48,6 +51,7 @@ void set_error(const std::string& msg) { g_error = msg; }
 // ---------------------------------------------------------------------------
 
 struct TimData {
+  std::string pack_buf;  // scratch for packed-string export
   std::vector<std::string> names;
   std::vector<double> freqs;
   std::vector<double> mjd_day;    // integer part of the MJD
@@ -235,6 +239,32 @@ GST_EXPORT const char* gst_tim_name(void* h, int64_t i) {
   return static_cast<TimData*>(h)->names[i].c_str();
 }
 
+namespace {
+// Newline-joined packed export: tokens come from whitespace splitting so
+// they can never contain '\n'; one FFI call replaces n round-trips.
+const char* pack(TimData* d, const std::vector<std::string>& col,
+                 uint64_t* nbytes) {
+  d->pack_buf.clear();
+  for (size_t i = 0; i < col.size(); ++i) {
+    if (i) d->pack_buf.push_back('\n');
+    d->pack_buf += col[i];
+  }
+  *nbytes = d->pack_buf.size();
+  return d->pack_buf.c_str();
+}
+}  // namespace
+
+GST_EXPORT const char* gst_tim_names_packed(void* h, uint64_t* nbytes) {
+  auto* d = static_cast<TimData*>(h);
+  return pack(d, d->names, nbytes);
+}
+
+GST_EXPORT const char* gst_tim_flag_packed(void* h, int64_t j,
+                                           uint64_t* nbytes) {
+  auto* d = static_cast<TimData*>(h);
+  return pack(d, d->flag_values[j], nbytes);
+}
+
 GST_EXPORT const char* gst_tim_site(void* h, int64_t i) {
   return static_cast<TimData*>(h)->sites[i].c_str();
 }
@@ -255,10 +285,16 @@ GST_EXPORT int64_t gst_spool_info(const char* path, uint32_t* itemsize,
                                   uint64_t* trailing_shape,
                                   uint64_t* header_bytes);
 
+// keep_rows: number of valid rows to retain when appending (the caller's
+// checkpointed sweep count). The file is truncated to exactly that many
+// rows first, discarding any orphaned or partially-written tail a crash
+// between per-field appends and the checkpoint may have left — otherwise
+// the resumed records land after stale rows and every later sweep is
+// silently misaligned across fields. Pass UINT64_MAX to keep all rows.
 GST_EXPORT void* gst_spool_open(const char* path, uint32_t itemsize,
                                 uint32_t ndim_trailing,
                                 const uint64_t* trailing_shape,
-                                int append) {
+                                int append, uint64_t keep_rows) {
   if (itemsize != 4 && itemsize != 8) {
     set_error("itemsize must be 4 or 8");
     return nullptr;
@@ -282,6 +318,19 @@ GST_EXPORT void* gst_spool_open(const char* path, uint32_t itemsize,
         set_error("spool header mismatch: existing file has a different "
                   "dtype/shape");
         return nullptr;
+      }
+      if (keep_rows != UINT64_MAX) {
+        if (static_cast<uint64_t>(rows) < keep_rows) {
+          set_error("spool shorter than checkpoint: file has fewer rows "
+                    "than keep_rows");
+          return nullptr;
+        }
+        if (::truncate(path, static_cast<off_t>(header +
+                                                keep_rows * row)) != 0) {
+          set_error(std::string("truncate failed: ") +
+                    std::strerror(errno));
+          return nullptr;
+        }
       }
       std::FILE* fh = std::fopen(path, "ab");
       if (!fh) {
