@@ -83,13 +83,20 @@ class JaxGibbs(SamplerBackend):
                  nchains: int = 64, dtype=jnp.float32,
                  chunk_size: int = 100,
                  tnt_block_size: int | str | None = "auto",
-                 record: str = "full"):
+                 record: str = "full",
+                 use_pallas: bool | str = "auto",
+                 pallas_interpret: bool = False):
         """``tnt_block_size`` selects the TOA reduction: ``None`` dense,
         an int for a ``lax.scan`` over row blocks (the 1e5-TOA stress path,
         BASELINE.json config 4; TOA axis zero-padded to a block multiple),
         ``"auto"`` picks by TOA count. ``record="light"`` records only the
         O(1)-per-sweep fields (x, theta, df, acceptance) — at stress scale
-        the per-TOA chains (z, alpha, pout) dominate host transfer."""
+        the per-TOA chains (z, alpha, pout) dominate host transfer.
+        ``use_pallas`` routes the blocked TNT reduction through the fused
+        Pallas TPU kernel (ops/pallas_tnt.py), batched over all chains
+        between the vmapped sweep stages; ``"auto"`` enables it on TPU
+        when the blocked path is active. ``pallas_interpret`` runs the
+        kernel in interpreter mode (CPU testing)."""
         super().__init__(ma, config)
         self.nchains = nchains
         self.dtype = dtype
@@ -135,6 +142,13 @@ class JaxGibbs(SamplerBackend):
         self._row_mask = (
             None if not self._n_pad else
             jnp.arange(self._ma.n) < self._n_real)
+        self._pallas_interpret = pallas_interpret
+        if use_pallas == "auto":
+            use_pallas = (self._block_size is not None
+                          and jax.default_backend() in ("tpu", "axon"))
+        elif use_pallas and self._block_size is None:
+            raise ValueError("use_pallas requires a tnt_block_size")
+        self._use_pallas = bool(use_pallas)
         self._pspin = (config.pspin * ma.time_scale
                        if config.pspin is not None else 1.0)
         self._chunk_fn = jax.jit(self._make_chunk_fn(),
@@ -215,37 +229,48 @@ class JaxGibbs(SamplerBackend):
             (x, ll0, lp0, jnp.zeros((), dtype=self.dtype), key))
         return x, acc / nsteps
 
+    def _resolve(self, ma: ModelArrays | None):
+        """(ma, row_mask, block_size, statistical_n) for a sweep stage.
+        ``ma=None`` selects the backend's own (possibly padded) model; the
+        ensemble passes a traced per-pulsar pytree, which is never padded."""
+        if ma is None:
+            return self._ma, self._row_mask, self._block_size, self._n_real
+        return ma, None, None, ma.n
+
+    def _masked_nvec(self, ma, mask, xq, az):
+        """alpha^z-scaled white variances; padded rows pinned to 1 so
+        they add 0 to every log/quadratic reduction."""
+        nv = az * ndiag(ma, xq, jnp)
+        return nv if mask is None else jnp.where(mask, nv, 1.0)
+
     def _sweep(self, state: ChainState, key, ma: ModelArrays | None = None
                ) -> ChainState:
         """One full Gibbs sweep. ``ma`` defaults to the backend's frozen
         model (embedded as constants); the ensemble path passes a traced
         per-pulsar ModelArrays pytree instead (parallel/ensemble.py)."""
-        if ma is None:
-            ma = self._ma
-            mask = self._row_mask        # None unless the TOA axis is padded
-            bs = self._block_size
-            n = self._n_real             # statistical n (excludes padding)
-        else:
-            mask, bs, n = None, None, ma.n
+        keys = random.split(key, 7)
+        x, acc_w, nvec = self._sweep_white(state, keys[0], ma)
+        ma_r, _, bs, _ = self._resolve(ma)
+        # per-sweep inner products (reference gibbs.py:302-304), via the
+        # fused dense/blocked reduction (ops/tnt.py)
+        TNT, d, const_white = tnt_products(ma_r.T, ma_r.y, nvec, bs)
+        return self._sweep_rest(state, x, acc_w, TNT, d, const_white,
+                                keys[1:], ma)
+
+    def _sweep_white(self, state: ChainState, kw, ma: ModelArrays | None):
+        """Sweep stage 1: the white-noise MH block
+        (reference gibbs.py:114-143). Returns the updated parameter
+        vector, the block acceptance rate, and the post-block ``nvec``."""
+        ma, mask, bs, _ = self._resolve(ma)
         cfg = self.config
-        m = ma.m
-        kw, kh, kb, kt, kz, ka, kd = random.split(key, 7)
-        x, b, z, alpha, theta, df = (state.x, state.b, state.z, state.alpha,
-                                     state.theta, state.df)
+        x, b, z, alpha = state.x, state.b, state.z, state.alpha
 
-        def masked_nvec(xq, az):
-            """alpha^z-scaled white variances; padded rows pinned to 1 so
-            they add 0 to every log/quadratic reduction."""
-            nv = az * ndiag(ma, xq, jnp)
-            return nv if mask is None else jnp.where(mask, nv, 1.0)
-
-        # --- white-noise MH block (reference gibbs.py:114-143) ---------
         az = alpha ** z
         if len(ma.white_indices):
             Tb = matvec_blocked(ma.T, b, bs)
 
             def ll_white(xq):
-                nvec = masked_nvec(xq, az)
+                nvec = self._masked_nvec(ma, mask, xq, az)
                 yred = ma.y - Tb
                 return -0.5 * (jnp.sum(jnp.log(nvec))
                                + jnp.sum(yred * yred / nvec))
@@ -254,11 +279,18 @@ class JaxGibbs(SamplerBackend):
                                       cfg.mh.n_white_steps, ll_white)
         else:
             acc_w = jnp.zeros((), dtype=self.dtype)
+        return x, acc_w, self._masked_nvec(ma, mask, x, az)
 
-        # --- per-sweep inner products (reference gibbs.py:302-304), via
-        # the fused dense/blocked reduction (ops/tnt.py) ----------------
-        nvec = masked_nvec(x, az)
-        TNT, d, const_white = tnt_products(ma.T, ma.y, nvec, bs)
+    def _sweep_rest(self, state: ChainState, x, acc_w, TNT, d, const_white,
+                    keys, ma: ModelArrays | None) -> ChainState:
+        """Sweep stages 2-7: everything conditioned on the TNT/d inner
+        products (hyper MH, coefficient draw, theta/z/alpha/df)."""
+        ma, mask, bs, n = self._resolve(ma)
+        cfg = self.config
+        m = ma.m
+        kh, kb, kt, kz, ka, kd = keys
+        b, z, alpha, theta, df = (state.b, state.z, state.alpha,
+                                  state.theta, state.df)
 
         # --- hyper MH block on the marginalized likelihood -------------
         # (reference gibbs.py:80-111, 288-329)
@@ -349,6 +381,27 @@ class JaxGibbs(SamplerBackend):
     # chunked driver
     # ------------------------------------------------------------------
 
+    def _batched_sweep(self, states: ChainState, keys) -> ChainState:
+        """One sweep for ALL chains: vmapped MH stages around a single
+        batched TNT reduction — the seam where the fused Pallas kernel
+        replaces per-chain scans (ops/pallas_tnt.py)."""
+        from gibbs_student_t_tpu.ops.pallas_tnt import tnt_batched
+
+        ma = self._ma
+        ks = jax.vmap(lambda k: random.split(k, 7))(keys)   # (C, 7, ...)
+        x, acc_w, nvec = jax.vmap(
+            lambda st, k: self._sweep_white(st, k, None))(states, ks[:, 0])
+        TNT, d, const = tnt_batched(
+            ma.T, ma.y, nvec, self._block_size,
+            use_pallas=True, interpret=self._pallas_interpret)
+        TNT = TNT.astype(self.dtype)
+        d = d.astype(self.dtype)
+        const = const.astype(self.dtype)
+        return jax.vmap(
+            lambda st, xx, aw, t, dd, cc, kk:
+            self._sweep_rest(st, xx, aw, t, dd, cc, kk, None)
+        )(states, x, acc_w, TNT, d, const, ks[:, 1:])
+
     def _make_chunk_fn(self):
         fields = self._record_fields
 
@@ -365,7 +418,21 @@ class JaxGibbs(SamplerBackend):
                 functools.partial(one_chain, offset=offset, length=length)
             )(states, keys)
 
-        return chunk
+        def chunk_batched(states, keys, offset, length):
+            # outer scan over sweeps; each step advances every chain via
+            # the batched sweep (the Pallas TNT path)
+            def body(sts, i):
+                rec = tuple(getattr(sts, f) for f in fields)
+                ki = jax.vmap(
+                    lambda k: random.fold_in(k, offset + i))(keys)
+                sts = self._batched_sweep(sts, ki)
+                return sts, rec
+
+            sts, recs = lax.scan(body, states, jnp.arange(length))
+            # (length, C, ...) -> (C, length, ...) to match the vmap path
+            return sts, tuple(jnp.swapaxes(r, 0, 1) for r in recs)
+
+        return chunk_batched if self._use_pallas else chunk
 
     def sweep_fn(self):
         """Jitted vmapped single sweep — the benchmark/graft entry surface."""

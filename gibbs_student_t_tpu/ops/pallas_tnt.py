@@ -1,0 +1,165 @@
+"""Pallas TPU kernel: batched fused ``T^T N^-1 T`` / ``T^T N^-1 y``.
+
+The one op worth a hand kernel in this framework (SURVEY.md §3.1: the
+``O(n m^2)`` TNT build dominates each sweep once n is large). The XLA
+path (ops/tnt.py) scans TOA blocks per chain, which under ``vmap``
+materializes a ``(chains, block, m)`` weighted-basis intermediate in HBM
+every step. This kernel instead:
+
+- tiles chains (``chain_tile`` per grid step) and keeps each tile's
+  ``(chain_tile, mp, mp)`` accumulator resident in VMEM across the whole
+  TOA sweep (grid = (chain_tiles, toa_blocks), TOA innermost, so output
+  blocks get consecutive visits and are written back exactly once);
+- reads the shared basis block once per chain tile and applies every
+  chain's weights to it in registers — the weighted basis never exists
+  in HBM;
+- fuses the ``d`` matvec into the same pass over ``T``.
+
+``m`` is zero-padded to a 128-lane multiple (the MXU pads internally
+anyway); padded columns produce zero rows/cols that are sliced off.
+The scalar piece of the likelihood constant (``sum log nvec``,
+``y^T N^-1 y``) stays in XLA — elementwise reductions the VPU/fusion
+already handle.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:  # pltpu only imports on builds with the TPU extension available
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAVE_PLTPU = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _HAVE_PLTPU = False
+
+
+def _round_up(x: int, mult: int) -> int:
+    return -(-x // mult) * mult
+
+
+def _tnt_kernel(T_ref, w_ref, wy_ref, tnt_ref, d_ref, *, chain_tile: int):
+    """One grid step: fold one TOA block into one chain tile's accumulators.
+
+    Block shapes: ``T (B, mp)``, ``w/wy (chain_tile, B)``,
+    ``tnt (chain_tile, mp, mp)``, ``d (chain_tile, mp)``.
+    """
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        tnt_ref[:] = jnp.zeros_like(tnt_ref)
+        d_ref[:] = jnp.zeros_like(d_ref)
+
+    T = T_ref[:]                       # (B, mp) — shared across the tile
+    # contract axis 0 (TOAs) of both operands: (B, mp) x (B, mp) -> (mp, mp)
+    contract = (((0,), (0,)), ((), ()))
+    for j in range(chain_tile):        # static unroll over the chain tile
+        Tw = T * w_ref[j, :][:, None]  # weighted basis, registers/VMEM only
+        tnt_ref[j] += jax.lax.dot_general(
+            T, Tw, contract, preferred_element_type=jnp.float32)
+        d_ref[j] += jnp.dot(wy_ref[j, :], T,
+                            preferred_element_type=jnp.float32)
+
+
+def tnt_batched_pallas(T, y, nvec, block_size: int = 256,
+                       chain_tile: Optional[int] = None,
+                       interpret: bool = False):
+    """``(TNT, d, const)`` for a batch of chains in one fused pass.
+
+    ``T (n, m)``, ``y (n,)`` shared; ``nvec (C, n)`` per chain. Returns
+    ``TNT (C, m, m)``, ``d (C, m)``, ``const (C,)`` matching
+    ``ops.tnt.tnt_products`` per chain. ``n`` must be a multiple of
+    ``block_size`` (use ``ops.tnt.pad_rows``; padded rows must carry
+    ``nvec = 1`` exactly as on the XLA path).
+    """
+    C, n = nvec.shape
+    m = T.shape[1]
+    if n % block_size != 0:
+        raise ValueError(f"n ({n}) must be a multiple of block_size "
+                         f"({block_size}); use ops.tnt.pad_rows")
+    if chain_tile is None:
+        chain_tile = min(32, C)
+    cpad = _round_up(C, chain_tile) - C
+    w = 1.0 / nvec
+    wy = y[None, :] * w
+    if cpad:
+        # padded chains: weight zero -> zero outputs, sliced off below
+        w = jnp.concatenate([w, jnp.zeros((cpad, n), w.dtype)])
+        wy = jnp.concatenate([wy, jnp.zeros((cpad, n), wy.dtype)])
+    mp = _round_up(m, 128)
+    Tp = jnp.pad(T, ((0, 0), (0, mp - m)))
+    Ct = chain_tile
+    grid = ((C + cpad) // Ct, n // block_size)
+
+    kernel = functools.partial(_tnt_kernel, chain_tile=Ct)
+    vmem = pltpu.VMEM if _HAVE_PLTPU else None
+    kwargs = {}
+    if _HAVE_PLTPU:
+        # chain tiles are independent ("parallel"); the TOA dimension
+        # accumulates in order ("arbitrary")
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"))
+
+    def spec(shape, index_map):
+        if vmem is None:
+            return pl.BlockSpec(shape, index_map)
+        return pl.BlockSpec(shape, index_map, memory_space=vmem)
+
+    TNT_p, d_p = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            spec((block_size, mp), lambda c, i: (i, 0)),    # T block
+            spec((Ct, block_size), lambda c, i: (c, i)),    # w tile
+            spec((Ct, block_size), lambda c, i: (c, i)),    # wy tile
+        ],
+        out_specs=[
+            spec((Ct, mp, mp), lambda c, i: (c, 0, 0)),
+            spec((Ct, mp), lambda c, i: (c, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(((C + cpad), mp, mp), jnp.float32),
+            jax.ShapeDtypeStruct(((C + cpad), mp), jnp.float32),
+        ],
+        interpret=interpret,
+        **kwargs,
+    )(Tp, w, wy)
+
+    TNT = TNT_p[:C, :m, :m]
+    d = d_p[:C, :m]
+    # scalar constant: pure elementwise reductions, left to XLA fusion
+    const = -0.5 * (jnp.sum(jnp.log(nvec), axis=-1)
+                    + jnp.sum(y[None, :] * wy[:C], axis=-1))
+    return TNT, d, const.astype(TNT.dtype)
+
+
+def tnt_batched_xla(T, y, nvec,
+                    block_size: Optional[int] = None
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """vmap of the XLA reduction — reference implementation and fallback."""
+    from gibbs_student_t_tpu.ops.tnt import tnt_products
+
+    return jax.vmap(lambda nv: tnt_products(T, y, nv, block_size))(nvec)
+
+
+def tnt_batched(T, y, nvec, block_size: Optional[int] = None,
+                use_pallas: Optional[bool] = None, interpret: bool = False):
+    """Dispatch: the Pallas kernel on TPU, the XLA scan elsewhere.
+
+    ``use_pallas=None`` auto-detects the default device platform.
+    """
+    if use_pallas is None:
+        use_pallas = (_HAVE_PLTPU
+                      and jax.default_backend() in ("tpu", "axon"))
+    if use_pallas and block_size:
+        return tnt_batched_pallas(T, y, nvec, block_size=block_size,
+                                  interpret=interpret)
+    return tnt_batched_xla(T, y, nvec, block_size)

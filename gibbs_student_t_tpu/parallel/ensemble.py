@@ -83,9 +83,15 @@ class EnsembleGibbs:
         self.stacked = stack_model_arrays(mas)
         # template backend: holds config/dtype and the sweep kernel; its own
         # frozen model is pulsar 0 (never used when ma is passed explicitly)
+        # tnt_block_size=None: the ensemble sweeps per-pulsar models passed
+        # as traced pytrees, which must stay unpadded — auto-blocking would
+        # pad the template's own model and break state shapes for large
+        # pulsars (blocked/Pallas reductions are the single-model backend's
+        # stress path, not the ensemble's).
         self.template = JaxGibbs(_localize_names(mas[0]), config,
                                  nchains=nchains, dtype=dtype,
-                                 chunk_size=chunk_size)
+                                 chunk_size=chunk_size,
+                                 tnt_block_size=None, use_pallas=False)
         self.dtype = dtype
         self._step = self._build_step()
         self.last_state = None

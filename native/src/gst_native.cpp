@@ -325,8 +325,16 @@ GST_EXPORT void* gst_spool_open(const char* path, uint32_t itemsize,
                     "than keep_rows");
           return nullptr;
         }
-        if (::truncate(path, static_cast<off_t>(header +
-                                                keep_rows * row)) != 0) {
+        uint64_t new_size = header + keep_rows * row;
+#if defined(_WIN32)
+        std::FILE* tf = std::fopen(path, "r+b");
+        bool trunc_ok = tf && _chsize_s(_fileno(tf),
+                                        static_cast<long long>(new_size)) == 0;
+        if (tf) std::fclose(tf);
+        if (!trunc_ok) {
+#else
+        if (::truncate(path, static_cast<off_t>(new_size)) != 0) {
+#endif
           set_error(std::string("truncate failed: ") +
                     std::strerror(errno));
           return nullptr;
