@@ -43,7 +43,10 @@ class ChainResult:
                 for f in dataclasses.fields(self)
                 if f.name not in ("stats",)
             },
-            stats={k: v[nburn:] for k, v in self.stats.items()},
+            # per-sweep stats stay sweep-aligned; run-level scalars (e.g.
+            # n_reinits) pass through untouched
+            stats={k: (v[nburn:] if np.ndim(v) else v)
+                   for k, v in self.stats.items()},
         )
 
     def save(self, outdir: str) -> None:

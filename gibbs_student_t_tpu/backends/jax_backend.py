@@ -467,13 +467,16 @@ class JaxGibbs(SamplerBackend):
     def sample(self, x0: Optional[np.ndarray] = None, niter: int = 1000,
                seed: int = 0, state: Optional[ChainState] = None,
                start_sweep: int = 0,
-               spool_dir: Optional[str] = None) -> ChainResult:
+               spool_dir: Optional[str] = None,
+               reinit_diverged: bool = False) -> ChainResult:
         """Run ``niter`` sweeps for all chains; spool records to host per
         chunk. Pass ``state``/``start_sweep`` (e.g. from a checkpoint) to
         resume — the per-sweep ``fold_in`` keying makes the continuation
         identical to an unbroken run. With ``spool_dir``, each chunk
         streams to native spool files + a state checkpoint (utils/spool.py)
-        and host memory stays O(chunk) instead of O(niter)."""
+        and host memory stays O(chunk) instead of O(niter).
+        ``reinit_diverged`` re-draws numerically dead chains from the prior
+        at chunk boundaries (count reported in ``stats['n_reinits']``)."""
         if niter < 1:
             raise ValueError(f"niter must be >= 1, got {niter}")
         resume = start_sweep > 0
@@ -492,12 +495,17 @@ class JaxGibbs(SamplerBackend):
         records = []
         done = 0
         fields = self._record_fields
+        n_reinits = 0
         while done < niter:
             length = min(self.chunk_size, niter - done)
             state, recs = self._chunk_fn(state, keys,
                                          start_sweep + done, length=length)
             host = jax.device_get(recs)
             done += length
+            if reinit_diverged:
+                state, n_bad = self._reinit_diverged(
+                    state, seed=seed + 7919 * (start_sweep + done))
+                n_reinits += n_bad
             if spool is not None:
                 spool.append(
                     {f: self._trim(f, np.swapaxes(host[i], 0, 1))
@@ -510,7 +518,10 @@ class JaxGibbs(SamplerBackend):
             from gibbs_student_t_tpu.utils.spool import load_spool
 
             self.last_state = state
-            return load_spool(spool_dir)
+            res = load_spool(spool_dir)
+            if reinit_diverged:
+                res.stats["n_reinits"] = np.asarray(n_reinits)
+            return res
         self.last_state = state
 
         cols = {
@@ -519,7 +530,52 @@ class JaxGibbs(SamplerBackend):
                                    for r in records]))
             for i, f in enumerate(fields)
         }
-        return self._to_result(cols)
+        res = self._to_result(cols)
+        if reinit_diverged:
+            res.stats["n_reinits"] = np.asarray(n_reinits)
+        return res
+
+    @staticmethod
+    @jax.jit
+    def _diverged_mask_device(state: ChainState):
+        """(nchains,) bool computed on device — only the mask crosses to
+        host, not the per-TOA state (which at stress scale is tens of MB
+        per chunk, exactly what record='light' avoids transferring)."""
+        def bad(a):
+            return ~jnp.isfinite(a).reshape(a.shape[0], -1).all(axis=1)
+
+        return (bad(state.x) | bad(state.b) | bad(state.theta)
+                | bad(state.alpha) | bad(state.df)
+                | (state.alpha <= 0).reshape(state.alpha.shape[0],
+                                             -1).any(axis=1))
+
+    def diverged_mask(self, state: ChainState) -> np.ndarray:
+        """Boolean (nchains,) mask of numerically dead chains.
+
+        The reference's failure handling is purely local (SVD->QR fallback,
+        -inf on Cholesky failure, NaN clamps — reference gibbs.py:168-178,
+        320-324, 224); a chain whose state still goes non-finite stays dead
+        forever. With a vmapped population, chain-level recovery is cheap
+        (SURVEY.md §5): detect here, re-initialize in ``sample``.
+        """
+        state = jax.tree.map(jnp.asarray, state)
+        return np.asarray(self._diverged_mask_device(state))
+
+    def _reinit_diverged(self, state: ChainState, seed: int
+                         ) -> tuple[ChainState, int]:
+        """Replace dead chains with fresh prior draws (chain-level elastic
+        recovery; healthy chains are untouched bitwise)."""
+        bad = self.diverged_mask(state)
+        n_bad = int(bad.sum())
+        if n_bad == 0:
+            return state, 0
+        fresh = self.init_state(seed=seed)
+        state = jax.tree.map(
+            lambda cur, fr: jnp.where(
+                jnp.asarray(bad).reshape((-1,) + (1,) * (cur.ndim - 1)),
+                fr, cur),
+            state, fresh)
+        return state, n_bad
 
     def _trim(self, field: str, arr: np.ndarray) -> np.ndarray:
         """Cut TOA padding back off the recorded per-TOA chains."""

@@ -15,6 +15,11 @@ from gibbs_student_t_tpu.parallel.diagnostics import (
     gelman_rubin,
     split_rhat,
 )
+from gibbs_student_t_tpu.parallel.multihost import (
+    initialize_distributed,
+    local_shard,
+    make_hybrid_mesh,
+)
 
 __all__ = [
     "make_mesh",
@@ -23,4 +28,7 @@ __all__ = [
     "effective_sample_size",
     "gelman_rubin",
     "split_rhat",
+    "initialize_distributed",
+    "local_shard",
+    "make_hybrid_mesh",
 ]

@@ -1,0 +1,101 @@
+"""Multi-host (DCN-tier) execution: initialization and hybrid meshes.
+
+The reference has no distributed backend at all (SURVEY.md §2.3 — no
+NCCL/MPI/sockets; one process, one chain). This framework's communication
+backend is XLA's: collectives are compiled into the program and ride ICI
+within a slice and DCN across hosts. This module is the process-level
+runtime around that — the moral equivalent of the reference ecosystem's
+``torch.distributed``/NCCL bootstrap, but as thin coordination glue, since
+the data plane belongs to XLA.
+
+Placement policy for this workload (SURVEY.md §2.3): chains are
+embarrassingly parallel and all-reduce only in diagnostics, so the
+``chain`` axis lives on ICI (within-slice); pulsar ensembles have *no*
+cross-pulsar terms, so the ``pulsar`` axis is the one that may span DCN —
+its collectives are diagnostics-only and latency-tolerant.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> bool:
+    """Bring up the JAX distributed runtime for multi-host execution.
+
+    Arguments default to the standard env vars
+    (``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/``JAX_PROCESS_ID``
+    or a cloud-TPU metadata environment, in which case
+    ``jax.distributed.initialize`` auto-detects everything). Returns True
+    if a multi-process runtime was initialized, False for the
+    single-process fallback — callers can treat both uniformly because a
+    1-host "ensemble" is just the degenerate mesh.
+    """
+    coordinator_address = (coordinator_address
+                           or os.environ.get("JAX_COORDINATOR_ADDRESS"))
+    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    if coordinator_address is None and num_processes in (None, 1):
+        return False  # single host, nothing to coordinate
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def make_hybrid_mesh(ici_axes: Dict[str, int],
+                     dcn_axes: Optional[Dict[str, int]] = None) -> Mesh:
+    """Mesh whose ``dcn_axes`` span hosts and ``ici_axes`` stay in-slice.
+
+    ``make_hybrid_mesh({'chain': 8}, {'pulsar': 4})`` on a 4-host x
+    8-chip pod slice places each pulsar group on one host (collectives
+    across pulsars cross DCN — diagnostics only) and shards chains over
+    the chips of that host (ICI). Falls back to a plain mesh when running
+    single-process (dcn product must then be 1 or divide the local device
+    count).
+    """
+    dcn_axes = dcn_axes or {}
+    n_proc = jax.process_count()
+    axis_names = tuple(dcn_axes.keys()) + tuple(ici_axes.keys())
+    if n_proc > 1:
+        from jax.experimental import mesh_utils
+
+        dcn_shape = tuple(dcn_axes.values()) + (1,) * len(ici_axes)
+        ici_shape = (1,) * len(dcn_axes) + tuple(ici_axes.values())
+        devices = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=jax.devices())
+        return Mesh(devices, axis_names)
+    # single process: all axes are local; order DCN-first so the slowest
+    # axis varies slowest exactly as it would across hosts
+    shape = tuple(dcn_axes.values()) + tuple(ici_axes.values())
+    devices = jax.devices()
+    if int(np.prod(shape)) != len(devices):
+        raise ValueError(
+            f"mesh {dict(**dcn_axes, **ici_axes)} needs "
+            f"{int(np.prod(shape))} devices, have {len(devices)}")
+    return Mesh(np.asarray(devices).reshape(shape), axis_names)
+
+
+def local_shard(n_items: int, axis_size: int,
+                axis_index: Optional[int] = None) -> slice:
+    """Contiguous slice of ``n_items`` owned by this host along a DCN axis
+    — the per-process data-loading contract (each host reads only its own
+    pulsars' par/tim files; arrays then enter the sharded computation via
+    ``jax.make_array_from_process_local_data``).
+    """
+    if axis_index is None:
+        axis_index = jax.process_index() % axis_size
+    per = -(-n_items // axis_size)
+    start = axis_index * per
+    return slice(start, min(start + per, n_items))

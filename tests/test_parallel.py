@@ -20,6 +20,35 @@ from gibbs_student_t_tpu.parallel.diagnostics import rhat_collective
 from tests.conftest import make_demo_pta, make_demo_pulsar
 
 
+def test_multihost_single_process_fallbacks():
+    """Single-process degenerate paths of the DCN-tier helpers: the hybrid
+    mesh reduces to a local mesh (DCN axis first/slowest), initialization
+    is a no-op, and data sharding covers every item exactly once."""
+    from gibbs_student_t_tpu.parallel import (
+        initialize_distributed,
+        local_shard,
+        make_hybrid_mesh,
+    )
+
+    assert initialize_distributed() is False  # no coordinator configured
+    mesh = make_hybrid_mesh({"chain": 4}, {"pulsar": 2})
+    assert mesh.axis_names == ("pulsar", "chain")
+    assert mesh.devices.shape == (2, 4)
+    with pytest.raises(ValueError, match="devices"):
+        make_hybrid_mesh({"chain": 3}, {"pulsar": 2})
+    # ensemble step runs on the hybrid-constructed mesh
+    mas = [make_demo_pta(make_demo_pulsar(seed=50 + i, n=24)[0],
+                         components=4).frozen() for i in range(2)]
+    ens = EnsembleGibbs(mas, GibbsConfig(model="mixture"), nchains=4,
+                        mesh=mesh, chunk_size=2)
+    res = ens.sample(niter=2, seed=0)
+    assert np.isfinite(res.chain).all()
+    # local_shard tiles [0, n) exactly
+    got = sorted(sum((list(range(*local_shard(7, 3, i).indices(7)))
+                      for i in range(3)), []))
+    assert got == list(range(7))
+
+
 def _ensemble_mas(npulsars=4, n=40, components=8):
     mas = []
     for i in range(npulsars):
