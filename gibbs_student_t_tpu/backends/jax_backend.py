@@ -108,7 +108,19 @@ class JaxGibbs(SamplerBackend):
         if tnt_block_size == "auto":
             tnt_block_size = auto_block_size(ma.n)
         self._block_size = tnt_block_size
+        # A model may arrive pre-padded (an ensemble slice from
+        # parallel.ensemble.pad_model_arrays): its row_mask marks the real
+        # TOA rows. Padding must be suffix-form so recorded per-TOA chains
+        # trim back by simple slicing (_trim).
+        base_mask = None
         self._n_real = ma.n
+        if ma.row_mask is not None:
+            base_mask = np.asarray(ma.row_mask, dtype=bool)
+            self._n_real = int(base_mask.sum())
+            if not base_mask[:self._n_real].all():
+                raise ValueError(
+                    "ModelArrays.row_mask must be suffix padding "
+                    "(all real rows before all padded rows)")
         y, T, sigma2 = ma.y, ma.T, ma.sigma2
         efac_masks, equad_masks = ma.efac_masks, ma.equad_masks
         self._n_pad = 0
@@ -138,10 +150,15 @@ class JaxGibbs(SamplerBackend):
             efac_const=np.asarray(ma.efac_const, dtype=dtype),
             equad_masks=np.asarray(equad_masks, dtype=dtype),
             equad_const=np.asarray(ma.equad_const, dtype=dtype),
+            row_mask=None,  # padding state lives in self._row_mask
         )
-        self._row_mask = (
-            None if not self._n_pad else
-            jnp.arange(self._ma.n) < self._n_real)
+        if base_mask is None and not self._n_pad:
+            self._row_mask = None
+        else:
+            bm = (base_mask if base_mask is not None
+                  else np.ones(ma.n, dtype=bool))
+            self._row_mask = jnp.asarray(
+                np.concatenate([bm, np.zeros(self._n_pad, dtype=bool)]))
         self._pallas_interpret = pallas_interpret
         if use_pallas == "auto":
             use_pallas = (self._block_size is not None
@@ -232,9 +249,13 @@ class JaxGibbs(SamplerBackend):
     def _resolve(self, ma: ModelArrays | None):
         """(ma, row_mask, block_size, statistical_n) for a sweep stage.
         ``ma=None`` selects the backend's own (possibly padded) model; the
-        ensemble passes a traced per-pulsar pytree, which is never padded."""
+        ensemble passes a traced per-pulsar pytree whose padding (if any)
+        is carried by ``ma.row_mask`` — its statistical n is then a traced
+        scalar so each vmapped pulsar uses its own real TOA count."""
         if ma is None:
             return self._ma, self._row_mask, self._block_size, self._n_real
+        if ma.row_mask is not None:
+            return ma, ma.row_mask, None, jnp.sum(ma.row_mask)
         return ma, None, None, ma.n
 
     def _masked_nvec(self, ma, mask, xq, az):
@@ -448,10 +469,11 @@ class JaxGibbs(SamplerBackend):
              else jnp.asarray(z, dtype=self.dtype))
         alpha = (jnp.ones(self._n_real, dtype=self.dtype) if alpha is None
                  else jnp.asarray(alpha, dtype=self.dtype))
-        if self._n_pad:
-            z = jnp.concatenate([z, jnp.zeros(self._n_pad, self.dtype)])
+        pad_total = self._ma.n - self._n_real
+        if pad_total:
+            z = jnp.concatenate([z, jnp.zeros(pad_total, self.dtype)])
             alpha = jnp.concatenate(
-                [alpha, jnp.ones(self._n_pad, self.dtype)])
+                [alpha, jnp.ones(pad_total, self.dtype)])
         nvec = alpha ** z * ndiag(ma, x, jnp)
         if self._row_mask is not None:
             nvec = jnp.where(self._row_mask, nvec, 1.0)
@@ -495,7 +517,10 @@ class JaxGibbs(SamplerBackend):
         records = []
         done = 0
         fields = self._record_fields
-        n_reinits = 0
+        # cumulative across spool resumes: an interrupted run's count is
+        # carried forward from run_stats.json instead of resetting
+        n_reinits = (int(spool.load_run_stats().get("n_reinits", 0))
+                     if spool is not None and resume else 0)
         while done < niter:
             length = min(self.chunk_size, niter - done)
             state, recs = self._chunk_fn(state, keys,
@@ -510,7 +535,9 @@ class JaxGibbs(SamplerBackend):
                 spool.append(
                     {f: self._trim(f, np.swapaxes(host[i], 0, 1))
                      for i, f in enumerate(fields)},
-                    state, start_sweep + done)
+                    state, start_sweep + done,
+                    run_stats=({"n_reinits": n_reinits}
+                               if reinit_diverged else None))
             else:
                 records.append(host)
         if spool is not None:
@@ -578,8 +605,9 @@ class JaxGibbs(SamplerBackend):
         return state, n_bad
 
     def _trim(self, field: str, arr: np.ndarray) -> np.ndarray:
-        """Cut TOA padding back off the recorded per-TOA chains."""
-        if self._n_pad and field in ("z", "alpha", "pout"):
+        """Cut TOA padding (block padding and/or a pre-padded model's
+        suffix rows) back off the recorded per-TOA chains."""
+        if self._ma.n != self._n_real and field in ("z", "alpha", "pout"):
             return arr[..., :self._n_real]
         return arr
 

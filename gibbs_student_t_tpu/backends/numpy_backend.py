@@ -35,6 +35,11 @@ from gibbs_student_t_tpu.models.pta import ModelArrays, lnprior, ndiag, phiinv_l
 class NumpyGibbs(SamplerBackend):
     def __init__(self, ma: ModelArrays, config: GibbsConfig):
         super().__init__(ma, config)
+        if ma.row_mask is not None and not np.all(ma.row_mask):
+            raise ValueError(
+                "NumpyGibbs does not support padded models; pass the "
+                "unpadded per-pulsar ModelArrays (padding exists only "
+                "for stacking ensembles on device)")
         cfg = config
         n = ma.n
         self._z = (np.ones(n) if cfg.z_init_ones else np.zeros(n))

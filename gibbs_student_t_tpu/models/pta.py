@@ -26,7 +26,7 @@ within float32 range, which is what makes the TPU fast path viable
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -126,6 +126,10 @@ class ModelArrays:
     phi_blocks: Tuple
     param_names: Tuple[str, ...]
     prior_specs: np.ndarray          # (p, 4) kind/a/b/init
+    # (n,) bool: True for real TOA rows, False for suffix padding rows
+    # added by parallel.ensemble.pad_model_arrays so heterogeneous
+    # per-pulsar TOA counts can stack. None means every row is real.
+    row_mask: Optional[np.ndarray] = None
     time_scale: float = 1e6
 
     @property
@@ -176,7 +180,8 @@ class ModelArrays:
 jax.tree_util.register_dataclass(
     ModelArrays,
     data_fields=["y", "T", "sigma2", "efac_masks", "efac_const",
-                 "equad_masks", "equad_const", "phi_blocks", "prior_specs"],
+                 "equad_masks", "equad_const", "phi_blocks", "prior_specs",
+                 "row_mask"],
     meta_fields=["name", "efac_idx", "equad_idx", "param_names",
                  "time_scale"],
 )

