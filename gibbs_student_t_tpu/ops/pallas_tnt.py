@@ -159,6 +159,10 @@ def tnt_batched(T, y, nvec, block_size: Optional[int] = None,
     if use_pallas is None:
         use_pallas = (_HAVE_PLTPU
                       and jax.default_backend() in ("tpu", "axon"))
+    if jnp.result_type(T, y, nvec) == jnp.float64:
+        # the kernel accumulates in f32; silently degrading an f64 run's
+        # TNT/d precision would be worse than the slower XLA path
+        use_pallas = False
     if use_pallas and block_size:
         return tnt_batched_pallas(T, y, nvec, block_size=block_size,
                                   interpret=interpret)

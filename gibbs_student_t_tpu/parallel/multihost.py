@@ -46,6 +46,10 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
         process_id = int(os.environ["JAX_PROCESS_ID"])
     if coordinator_address is None and num_processes in (None, 1):
         return False  # single host, nothing to coordinate
+    if coordinator_address is None:
+        raise ValueError(
+            f"num_processes={num_processes} requires a coordinator address "
+            "(pass coordinator_address= or set JAX_COORDINATOR_ADDRESS)")
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
