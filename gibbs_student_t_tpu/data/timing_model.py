@@ -37,19 +37,26 @@ T_SUN = np.longdouble(4.925490947e-6)
 # Binary flavors sharing the DD delay algebra at the precision in scope
 # (BT differs from DD only in terms that vanish for the pars handled here).
 _DD_FAMILY = {"DD", "DDH", "DDK", "DDGR", "BT"}
+# Small-eccentricity Laplace-Lagrange parameterization (Lange et al. 2001):
+# TASC epoch of ascending node, EPS1 = e sin(omega), EPS2 = e cos(omega).
+_ELL1_FAMILY = {"ELL1"}
+
+
+def _binary_flavor(par: Par) -> str:
+    return str(par.get("BINARY", "")).upper()
 
 
 def has_binary(par: Par) -> bool:
     if "BINARY" not in par or "PB" not in par:
         return False
-    flavor = str(par.get("BINARY")).upper()
-    if flavor not in _DD_FAMILY:
-        # Fail loudly: evaluating the DD formulas on e.g. an ELL1 par
-        # (TASC/EPS1/EPS2, no T0) would silently compute the orbital
-        # phase from T0=0 and leave an unremoved ~A1-sized sinusoid.
+    flavor = _binary_flavor(par)
+    if flavor not in _DD_FAMILY | _ELL1_FAMILY:
+        # Fail loudly: evaluating the DD formulas on an unknown flavor's
+        # par (different epoch parameters) would silently compute the
+        # orbital phase wrong and leave an unremoved ~A1-sized sinusoid.
         raise ValueError(
-            f"unsupported binary model {flavor!r}: only the DD family "
-            f"{sorted(_DD_FAMILY)} is implemented")
+            f"unsupported binary model {flavor!r}: implemented are the DD "
+            f"family {sorted(_DD_FAMILY)} and {sorted(_ELL1_FAMILY)}")
     return True
 
 
@@ -91,12 +98,53 @@ def _orbit_geometry(par: Par, t: np.ndarray):
     }
 
 
+def _ell1_geometry(par: Par, t: np.ndarray):
+    """ELL1 orbital quantities at times ``t``: orbital phase from the
+    ascending-node epoch TASC plus the Laplace-Lagrange eccentricity
+    components (Lange et al. 2001 parameterization, tempo2 ELL1model)."""
+    pb = par.getfloat("PB")
+    tasc = par.getfloat("TASC")
+    orbits = (t - tasc) / pb
+    pbdot = par.getfloat("PBDOT")
+    if pbdot != 0:
+        orbits = orbits - 0.5 * pbdot * orbits * orbits
+    phi = 2.0 * np.pi * (orbits - np.floor(orbits))
+    dt_sec = (t - tasc) * SECS_PER_DAY
+    return {
+        "phi": phi, "sinp": np.sin(phi), "cosp": np.cos(phi),
+        "sin2p": np.sin(2.0 * phi), "cos2p": np.cos(2.0 * phi),
+        # EPS1DOT/EPS2DOT carry tempo2's 1/s units
+        "eta": par.getfloat("EPS1") + par.getfloat("EPS1DOT") * dt_sec,
+        "kap": par.getfloat("EPS2") + par.getfloat("EPS2DOT") * dt_sec,
+        "x": par.getfloat("A1")
+             + par.getfloat("XDOT") * (t - tasc) * SECS_PER_DAY,
+        "pb": pb, "tasc": tasc, "t": t,
+        "m2": par.getfloat("M2"), "sini": par.getfloat("SINI"),
+    }
+
+
 def _delay_at(par: Par, t: np.ndarray) -> np.ndarray:
-    """DD orbital delay (seconds, longdouble) evaluated at times ``t``:
-    Roemer ``x beta``, Einstein ``gamma sin E``, Shapiro
+    """Orbital delay (seconds, longdouble) evaluated at times ``t``.
+
+    DD family: Roemer ``x beta``, Einstein ``gamma sin E``, Shapiro
     ``-2 r ln(1 - e cos E - s beta)`` (Damour-Deruelle timing formula —
     what tempo2 applies for BINARY DD, the model the reference's dataset
-    was generated with)."""
+    was generated with). ELL1: the first-order-in-eccentricity form
+    ``x [sin phi + (kappa/2) sin 2phi - (eta/2) cos 2phi]`` with Shapiro
+    ``-2 r ln(1 - s sin phi)`` (Lange et al. 2001; tempo2 ELL1model).
+    """
+    if _binary_flavor(par) in _ELL1_FAMILY:
+        g = _ell1_geometry(par, t)
+        # first order in eccentricity, including the -(3/2) x eta constant
+        # of the expansion (expand the DD Roemer in e: beta = sin(phi)
+        # - (3/2) eta + (kappa/2) sin(2 phi) - (eta/2) cos(2 phi))
+        delay = g["x"] * (g["sinp"] + 0.5 * g["kap"] * g["sin2p"]
+                          - 0.5 * g["eta"] * g["cos2p"]
+                          - 1.5 * g["eta"])
+        if g["m2"] != 0 and g["sini"] != 0:
+            lam = 1.0 - g["sini"] * g["sinp"]
+            delay = delay - 2.0 * T_SUN * g["m2"] * np.log(lam)
+        return delay
     g = _orbit_geometry(par, t)
     beta = (g["sinw"] * (g["cosE"] - g["ecc"])
             + g["q"] * g["cosw"] * g["sinE"])
@@ -195,7 +243,30 @@ def design_matrix(par: Par, mjds: np.ndarray) -> Tuple[np.ndarray, List[str]]:
     # correction is second order in the derivative). The residual response
     # to a small parameter change is -d(delay); sign and scale wash out in
     # the unit-RMS normalization and the downstream SVD.
-    if has_binary(par):
+    if has_binary(par) and _binary_flavor(par) in _ELL1_FAMILY:
+        g = _ell1_geometry(par, mjds)
+        sinp, cosp = g["sinp"], g["cosp"]
+        sin2p, cos2p = g["sin2p"], g["cos2p"]
+        x, eta, kap = g["x"], g["eta"], g["kap"]
+        # d(phase)/d(param) chain through phi for TASC/PB
+        dR_dphi = x * (cosp + kap * cos2p + eta * sin2p)
+        two_pi = 2.0 * np.pi
+        binary_cols = {
+            "A1": sinp + 0.5 * kap * sin2p - 0.5 * eta * cos2p - 1.5 * eta,
+            "TASC": dR_dphi * (-two_pi / g["pb"]),
+            "PB": dR_dphi * (-two_pi * (g["t"] - g["tasc"])
+                             / g["pb"] ** 2),
+            "EPS1": x * (-0.5 * cos2p - 1.5),
+            "EPS2": 0.5 * x * sin2p,
+        }
+        lam = 1.0 - g["sini"] * sinp
+        m2_eff = g["m2"] if g["m2"] != 0 else np.longdouble(1.0)
+        binary_cols["SINI"] = 2.0 * T_SUN * m2_eff * sinp / lam
+        binary_cols["M2"] = -2.0 * T_SUN * np.log(lam)
+        for name, col in binary_cols.items():
+            if name in fit:
+                add(name, np.asarray(col, dtype=np.float64))
+    elif has_binary(par):
         g = _orbit_geometry(par, mjds)
         sinE, cosE = g["sinE"], g["cosE"]
         sinw, cosw = g["sinw"], g["cosw"]

@@ -143,3 +143,98 @@ def test_rednoise_injection_spectrum():
                       * f ** -3.0 / tspan) * 2 / 2
     # sum over sin+cos halves -> total variance = sum(var_k) * 2 / 2
     assert 0.5 < var / expected < 2.0
+
+
+# ---------------------------------------------------------------------------
+# ELL1 binary model
+# ---------------------------------------------------------------------------
+
+def _ell1_par_from_dd(dd_par):
+    """ELL1 par equivalent to a small-eccentricity DD par:
+    TASC = T0 - omega*PB/2pi, EPS1 = e sin(omega), EPS2 = e cos(omega)
+    (Lange et al. 2001)."""
+    import dataclasses
+
+    from gibbs_student_t_tpu.data.par import Par, ParParam
+
+    ld = np.longdouble
+    e = dd_par.getfloat("ECC")
+    om = np.deg2rad(dd_par.getfloat("OM"))
+    pb = dd_par.getfloat("PB")
+    tasc = dd_par.getfloat("T0") - om * pb / (2 * np.pi)
+    params = {k: dataclasses.replace(v) for k, v in dd_par.params.items()
+              if k not in ("T0", "OM", "ECC")}
+    params["BINARY"] = ParParam("BINARY", "ELL1")
+    params["TASC"] = ParParam("TASC", ld(tasc), 1)
+    params["EPS1"] = ParParam("EPS1", ld(e * np.sin(om)), 1)
+    params["EPS2"] = ParParam("EPS2", ld(e * np.cos(om)), 1)
+    return Par(params)
+
+
+def test_ell1_matches_dd_at_small_eccentricity():
+    """The ELL1 delay must agree with the exact DD delay to O(e^2 x):
+    independent cross-validation of both binary implementations."""
+    from gibbs_student_t_tpu.data.timing_model import binary_delay
+
+    dd = make_demo_par()
+    ell1 = _ell1_par_from_dd(dd)
+    t = make_demo_epochs(60, rng=np.random.default_rng(5))
+    d_dd = np.asarray(binary_delay(dd, t), dtype=np.float64)
+    d_ell1 = np.asarray(binary_delay(ell1, t), dtype=np.float64)
+    e = float(dd.getfloat("ECC"))
+    x = float(dd.getfloat("A1"))
+    assert np.abs(d_dd).max() > 0.9 * x  # both really computed something
+    # O(e^2 x) ~ 1e-7 s here; allow a few of those
+    assert np.abs(d_dd - d_ell1).max() < 5 * e * e * x + 1e-9
+
+
+def test_ell1_ideal_toas_roundtrip():
+    par = _ell1_par_from_dd(make_demo_par())
+    epochs = make_demo_epochs(50, rng=np.random.default_rng(6))
+    fp = FakePulsar(par, epochs, np.full(50, 0.1))
+    r = prefit_residuals(par, fp.stoas)
+    assert np.abs(r).max() < 1e-9
+
+
+@pytest.mark.parametrize("name,h", [
+    ("A1", 1e-6), ("TASC", 1e-6), ("PB", 1e-8),
+    ("EPS1", 1e-9), ("EPS2", 1e-9), ("SINI", 1e-6),
+])
+def test_ell1_design_columns_match_finite_difference(name, h):
+    import dataclasses
+
+    from gibbs_student_t_tpu.data.par import Par
+    from gibbs_student_t_tpu.data.timing_model import binary_delay
+
+    par = _ell1_par_from_dd(make_demo_par())
+    t = make_demo_epochs(50, rng=np.random.default_rng(7))
+    M, labels = design_matrix(par, t)
+    assert name in labels
+    col = M[:, labels.index(name)]
+
+    def perturbed(sign):
+        params = dict(par.params)
+        p = params[name]
+        params[name] = dataclasses.replace(
+            p, value=p.value + np.longdouble(sign * h))
+        return Par(params)
+
+    dp = np.asarray(binary_delay(perturbed(+1), t)
+                    - binary_delay(perturbed(-1), t),
+                    dtype=np.float64) / (2 * h)
+    cn = col / np.linalg.norm(col)
+    dn = dp / np.linalg.norm(dp)
+    assert abs(float(cn @ dn)) > 0.9999
+
+
+def test_unsupported_binary_flavor_raises():
+    import dataclasses
+
+    from gibbs_student_t_tpu.data.par import Par, ParParam
+
+    par = make_demo_par()
+    params = dict(par.params)
+    params["BINARY"] = ParParam("BINARY", "T2")
+    bad = Par(params)
+    with pytest.raises(ValueError, match="unsupported binary model"):
+        prefit_residuals(bad, make_demo_epochs(10))
