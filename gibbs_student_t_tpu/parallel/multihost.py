@@ -46,15 +46,22 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
         process_id = int(os.environ["JAX_PROCESS_ID"])
     if coordinator_address is None and num_processes in (None, 1):
         return False  # single host, nothing to coordinate
-    if coordinator_address is None:
-        raise ValueError(
-            f"num_processes={num_processes} requires a coordinator address "
-            "(pass coordinator_address= or set JAX_COORDINATOR_ADDRESS)")
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
+    try:
+        # coordinator_address may legitimately be None here: on cloud-TPU /
+        # Slurm / GKE, jax auto-detects unset params from the cluster env.
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except (RuntimeError, ValueError) as e:
+        if coordinator_address is None:
+            raise ValueError(
+                f"num_processes={num_processes} with no coordinator "
+                "address and no detectable cluster environment — pass "
+                "coordinator_address= or set JAX_COORDINATOR_ADDRESS"
+            ) from e
+        raise
     return True
 
 
@@ -77,8 +84,16 @@ def make_hybrid_mesh(ici_axes: Dict[str, int],
 
         dcn_shape = tuple(dcn_axes.values()) + (1,) * len(ici_axes)
         ici_shape = (1,) * len(dcn_axes) + tuple(ici_axes.values())
-        devices = mesh_utils.create_hybrid_device_mesh(
-            ici_shape, dcn_shape, devices=jax.devices())
+        try:
+            devices = mesh_utils.create_hybrid_device_mesh(
+                ici_shape, dcn_shape, devices=jax.devices())
+        except ValueError:
+            # Platforms without ICI-slice structure (multi-process CPU —
+            # the fake-cluster test rig — or single-slice pods): the
+            # process is the DCN granule.
+            devices = mesh_utils.create_hybrid_device_mesh(
+                ici_shape, dcn_shape, devices=jax.devices(),
+                process_is_granule=True)
         return Mesh(devices, axis_names)
     # single process: all axes are local; order DCN-first so the slowest
     # axis varies slowest exactly as it would across hosts
