@@ -150,7 +150,11 @@ def bench_numpy(ma, cfg, nsweeps: int, seed: int = 0):
 
 def bench_jax(ma, cfg, nchains: int, nsweeps: int, chunk: int,
               seed: int = 0, record: str = "full",
-              tnt_block_size="auto"):
+              tnt_block_size="auto", profile_dir: str | None = None):
+    import contextlib
+
+    import jax
+
     from gibbs_student_t_tpu.backends import JaxGibbs
 
     gb = JaxGibbs(ma, cfg, nchains=nchains, chunk_size=chunk,
@@ -159,16 +163,20 @@ def bench_jax(ma, cfg, nchains: int, nsweeps: int, chunk: int,
     state = gb.init_state(seed=seed)
     gb.sample(niter=chunk, seed=seed, state=state)
     state = gb.last_state
+    trace = (jax.profiler.trace(profile_dir) if profile_dir
+             else contextlib.nullcontext())
     t0 = time.perf_counter()
-    res = gb.sample(niter=nsweeps, seed=seed, state=state, start_sweep=chunk)
+    with trace:
+        res = gb.sample(niter=nsweeps, seed=seed, state=state,
+                        start_sweep=chunk)
     dt = time.perf_counter() - t0
-    for blk in ("white", "hyper"):
-        acc = np.asarray(res.stats.get(f"acc_{blk}", np.zeros(0)))
-        if acc.size:
-            print(f"# acceptance[{blk}]: mean={acc.mean():.3f} "
-                  f"min={acc.mean(axis=0).min():.3f} "
-                  f"max={acc.mean(axis=0).max():.3f} over {acc.shape[1]} "
-                  f"chains", file=sys.stderr)
+    if profile_dir:
+        print(f"# xla trace written to {profile_dir}", file=sys.stderr)
+    for blk, acc in res.acceptance_rates().items():
+        print(f"# acceptance[{blk}]: mean={acc.mean():.3f} "
+              f"min={acc.mean(axis=0).min():.3f} "
+              f"max={acc.mean(axis=0).max():.3f} over {acc.shape[1]} "
+              f"chains", file=sys.stderr)
     return nsweeps / dt, _ess(res, ma.param_names, dt), gb
 
 
@@ -242,6 +250,9 @@ def main(argv=None):
     ap.add_argument("--no-block-timings", action="store_true",
                     help="skip the per-block timing breakdown (saves a few "
                          "extra stage compiles)")
+    ap.add_argument("--profile", metavar="DIR", default=None,
+                    help="capture a jax.profiler trace of the timed JAX "
+                         "window into DIR (view with xprof/tensorboard)")
     args = ap.parse_args(argv)
 
     if args.quick:
@@ -268,7 +279,8 @@ def main(argv=None):
 
     numpy_sps, numpy_ess = bench_numpy(ma, cfg, args.baseline_sweeps)
     jax_sps, jax_ess, gb = bench_jax(ma, cfg, args.nchains, args.niter,
-                                     args.chunk, record=record)
+                                     args.chunk, record=record,
+                                     profile_dir=args.profile)
 
     # wall-clock speedup for the same per-chain sweep count, i.e. the
     # north-star "1024 chains vs single-chain NumPy" factor: each JAX sweep

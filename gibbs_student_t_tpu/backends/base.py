@@ -59,6 +59,17 @@ class ChainResult:
                      "thetachain", "alphachain", "dfchain"):
             np.save(os.path.join(outdir, f"{name}.npy"), getattr(self, name))
 
+    def acceptance_rates(self) -> Dict[str, np.ndarray]:
+        """Per-MH-block acceptance arrays present in ``stats`` — the one
+        place the block list lives, shared by every driver's
+        observability output (bench.py, run_sims.py)."""
+        out = {}
+        for blk in ("white", "hyper"):
+            acc = np.asarray(self.stats.get(f"acc_{blk}", np.zeros(0)))
+            if acc.size:
+                out[blk] = acc
+        return out
+
 
 class SamplerBackend:
     """Common construction: a frozen model + config; subclasses implement

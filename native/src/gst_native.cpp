@@ -18,7 +18,6 @@
 
 #include <cctype>
 #include <cerrno>
-#include <charconv>
 #include <cmath>
 #include <cstdint>
 #if !defined(_WIN32)
@@ -77,25 +76,28 @@ std::vector<std::string> tokenize(const std::string& line) {
 
 // Numeric parsing must be locale-independent: strtod/strtold honor
 // LC_NUMERIC, so a host process running under e.g. a comma-decimal locale
-// would silently truncate MJDs and diverge from the Python parser.
-// std::from_chars is locale-free for double; long double goes through
-// strtold_l pinned to a cached "C" locale (POSIX).
-bool parse_double(const std::string& s, double* out) {
-  const char* b = s.c_str();
-  const char* e = b + s.size();
-  // from_chars rejects a leading '+' that strtod and Python's float()
-  // accept; skip it so both engines keep the same line-acceptance set.
-  if (b != e && *b == '+') ++b;
-  auto res = std::from_chars(b, e, *out);
-  return res.ec == std::errc() && res.ptr == e;
-}
-
+// would silently truncate MJDs and diverge from the Python parser. Both
+// parsers go through strtoX_l pinned to a cached "C" locale (POSIX) — one
+// mechanism, portable to toolchains whose <charconv> lacks floating-point
+// from_chars (GCC < 11, libc++), and grammar-compatible with Python's
+// float() (leading '+', case-insensitive exponents).
 #if !defined(_WIN32)
 locale_t c_numeric_locale() {
   static locale_t loc = newlocale(LC_ALL_MASK, "C", (locale_t)0);
   return loc;
 }
 #endif
+
+bool parse_double(const std::string& s, double* out) {
+  errno = 0;
+  char* end = nullptr;
+#if !defined(_WIN32)
+  *out = strtod_l(s.c_str(), &end, c_numeric_locale());
+#else
+  *out = std::strtod(s.c_str(), &end);
+#endif
+  return end == s.c_str() + s.size() && errno == 0;
+}
 
 bool parse_longdouble(const std::string& s, long double* out) {
   errno = 0;

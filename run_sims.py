@@ -21,6 +21,8 @@ from __future__ import annotations
 
 import argparse
 import os
+import sys
+import time
 
 import numpy as np
 
@@ -59,6 +61,15 @@ def run_one(ma, cfg, backend: str, niter: int, nchains: int, seed: int):
     gb = cls(ma, cfg)
     return gb.sample(ma.x_init(np.random.default_rng(seed)), niter,
                      seed=seed)
+
+
+def _summarize(key: str, res, dt: float, niter: int) -> str:
+    """One observability line per config: wall time, throughput, and MH
+    acceptance rates (the reference tracks none of these, SURVEY.md §5)."""
+    parts = [f"{key}: {dt:.1f}s, {niter / dt:.1f} sweeps/s"]
+    parts += [f"acc[{blk}]={acc.mean():.2f}"
+              for blk, acc in res.acceptance_rates().items()]
+    return "  # " + ", ".join(parts)
 
 
 def main(argv=None):
@@ -112,11 +123,15 @@ def main(argv=None):
             ma = build_pta(psr, args.components).frozen()
             for key, cfg in configs.items():
                 seed = int(rng.integers(0, 2 ** 31))
+                t0 = time.perf_counter()
                 res = run_one(ma, cfg, args.backend, args.niter,
                               args.nchains, seed)
+                dt = time.perf_counter() - t0
                 out = os.path.join(outdir, key, str(theta), str(idx))
                 res.burn(args.burn).save(out)
                 print(out, flush=True)
+                print(_summarize(key, res, dt, args.niter), file=sys.stderr,
+                      flush=True)
 
 
 if __name__ == "__main__":
