@@ -40,10 +40,10 @@ from jax.scipy.special import gammaln
 from gibbs_student_t_tpu.backends.base import ChainResult, SamplerBackend
 from gibbs_student_t_tpu.config import GibbsConfig
 from gibbs_student_t_tpu.models.pta import ModelArrays, lnprior, ndiag, phiinv_logdet
+from jax.scipy.linalg import solve_triangular
+
 from gibbs_student_t_tpu.ops.linalg import (
-    gaussian_draw,
-    precond_cholesky,
-    precond_solve_quad,
+    precond_quad_logdet,
     robust_precond_cholesky,
 )
 from gibbs_student_t_tpu.ops.tnt import (
@@ -318,8 +318,7 @@ class JaxGibbs(SamplerBackend):
         def ll_hyper(xq):
             phiinv, logdet_phi = phiinv_logdet(ma, xq, jnp)
             Sigma = TNT + jnp.diag(phiinv)
-            L, isd, logdet_sigma = precond_cholesky(Sigma, cfg.jitter)
-            _, quad = precond_solve_quad(L, isd, d)
+            quad, logdet_sigma = precond_quad_logdet(Sigma, d, cfg.jitter)
             ll = const_white + 0.5 * (quad - logdet_sigma - logdet_phi)
             return jnp.where(jnp.isfinite(ll), ll, -jnp.inf)
 
@@ -336,11 +335,13 @@ class JaxGibbs(SamplerBackend):
         # gibbs.py:168-178).
         phiinv, _ = phiinv_logdet(ma, x, jnp)
         Sigma = TNT + jnp.diag(phiinv)
-        L, isd, _ = robust_precond_cholesky(
-            Sigma, jitters=(cfg.jitter, 1e-4, 1e-2, 1e-1))
-        mean, _ = precond_solve_quad(L, isd, d)
-        b = gaussian_draw(L, isd, mean,
-                          random.normal(kb, (m,), dtype=self.dtype))
+        L, isd, _, u = robust_precond_cholesky(
+            Sigma, jitters=(cfg.jitter, 1e-4, 1e-2, 1e-1), rhs=d)
+        # b = mean + fluct = D^-1/2 L^-T (u + xi): the forward solve rode
+        # along with the factorization, so one backward substitution
+        # yields the draw (reference gibbs.py:169-180's mn + Li*xi)
+        xi = random.normal(kb, (m,), dtype=self.dtype)
+        b = solve_triangular(L, u + xi, lower=True, trans="T") * isd
 
         resid = ma.y - matvec_blocked(ma.T, b, bs)
         nvec0 = ndiag(ma, x, jnp)
@@ -481,8 +482,7 @@ class JaxGibbs(SamplerBackend):
                                            self._block_size)
         phiinv, logdet_phi = phiinv_logdet(ma, x, jnp)
         Sigma = TNT + jnp.diag(phiinv)
-        L, isd, logdet_sigma = precond_cholesky(Sigma, cfg.jitter)
-        _, quad = precond_solve_quad(L, isd, d)
+        quad, logdet_sigma = precond_quad_logdet(Sigma, d, cfg.jitter)
         ll = const_white + 0.5 * (quad - logdet_sigma - logdet_phi)
         return float(jnp.where(jnp.isfinite(ll), ll, -jnp.inf))
 

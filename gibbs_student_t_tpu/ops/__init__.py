@@ -8,7 +8,10 @@ equivalents must be branchless and batched; this package provides them.
 from gibbs_student_t_tpu.ops.linalg import (
     gaussian_draw,
     precond_cholesky,
+    precond_quad_logdet,
     precond_solve_quad,
+    robust_precond_cholesky,
 )
 
-__all__ = ["precond_cholesky", "precond_solve_quad", "gaussian_draw"]
+__all__ = ["precond_cholesky", "precond_quad_logdet", "precond_solve_quad",
+           "robust_precond_cholesky", "gaussian_draw"]

@@ -65,8 +65,11 @@ def _tnt_kernel(T_ref, w_ref, wy_ref, tnt_ref, d_ref, *, chain_tile: int):
         Tw = T * w_ref[j, :][:, None]  # weighted basis, registers/VMEM only
         tnt_ref[j] += jax.lax.dot_general(
             T, Tw, contract, preferred_element_type=jnp.float32)
-        d_ref[j] += jnp.dot(wy_ref[j, :], T,
-                            preferred_element_type=jnp.float32)
+        # keep the matvec 2-D (1, B) @ (B, mp): a 1-D lhs emits a
+        # dot_dimension_numbers attribute this libtpu's Mosaic fails to
+        # parse (verified on TPU v5e: "[1, 1]" for lhs_non_contracting)
+        d_ref[j:j + 1] += jnp.dot(wy_ref[j:j + 1, :], T,
+                                  preferred_element_type=jnp.float32)
 
 
 def tnt_batched_pallas(T, y, nvec, block_size: int = 256,

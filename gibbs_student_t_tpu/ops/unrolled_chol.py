@@ -1,0 +1,120 @@
+"""Statically-unrolled batched Cholesky for small matrices on TPU.
+
+XLA's ``cholesky`` lowers to a sequential While loop over columns with
+dynamic slicing; for the (chains, m, m) batches this framework factors
+every MH step (m ~ 74, reference gibbs.py:318-321), that costs ~10.5 ms
+per call on a v5e — ~85% of the whole Gibbs sweep (measured:
+``tools/tpu_microbench.py``, ``artifacts/tpu_microbench_r02.json``).
+The matrix is tiny but the *loop machinery* dominates.
+
+This module instead unrolls the Cholesky–Banachiewicz recurrence at
+trace time (``m`` is a static model constant), in panel-blocked form
+chosen for the TPU *compiler* as much as the hardware:
+
+- ``L`` lives in a fixed-shape ``(..., m, m)`` buffer; columns are
+  written with static-index ``at.set`` (lowered to in-place
+  dynamic-update-slice), never by growing concatenation — an early
+  variant that concatenated a ``(..., m, j)`` stack produced 74
+  distinct-shaped einsums and blew TPU compile time past 10 minutes;
+- cross-panel corrections are one batched GEMM per panel
+  (``L @ rows^T`` on the MXU), so the per-column work only contracts
+  over the ``panel``-wide in-panel stack;
+- every op in the unrolled program has one of ~10 static shapes, so the
+  compiled program is small and fast to build.
+
+The forward substitution ``u = L^-1 rhs`` rides along in the same pass,
+so the marginalized-likelihood evaluation (quad form + logdet,
+reference gibbs.py:288-329) never touches a triangular-solve expander
+either.
+
+Non-PD inputs produce a NaN pivot that propagates through every later
+column and into ``logdet`` — the branchless failure signal the callers
+already map to ``-inf`` log-likelihood / MH rejection (ops/linalg.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+# Above this the unrolled program stops paying for itself (HLO count grows
+# linearly with m) and callers should fall back to jnp.linalg.cholesky.
+MAX_UNROLL_DIM = 160
+
+
+def _round_up(x: int, mult: int) -> int:
+    return -(-x // mult) * mult
+
+
+def chol_forward(S, rhs=None, panel: int = 16
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray,
+                            Optional[jnp.ndarray]]:
+    """Cholesky ``L L^T = S`` with an optional fused forward solve.
+
+    ``S (..., m, m)`` symmetric; ``rhs (..., m)`` optional. Returns
+    ``(L, logdet, u)`` with ``logdet = logdet S`` and ``u = L^-1 rhs``
+    (``None`` when no rhs). Unrolled statically over columns — use only
+    for ``m <= MAX_UNROLL_DIM``.
+    """
+    m0 = S.shape[-1]
+    dtype = S.dtype
+    m = _round_up(m0, panel)
+    if m != m0:
+        # pad with an identity block: unit pivots add 0 to logdet and
+        # leave the leading m0 columns untouched
+        pad = m - m0
+        S = jnp.pad(S, [(0, 0)] * (S.ndim - 2) + [(0, pad), (0, pad)])
+        eye_tail = jnp.asarray(np.pad(np.zeros(m0), (0, pad),
+                                      constant_values=1.0), dtype)
+        S = S + jnp.diag(eye_tail)
+        if rhs is not None:
+            rhs = jnp.pad(rhs, [(0, 0)] * (rhs.ndim - 1) + [(0, pad)])
+
+    L = jnp.zeros_like(S)
+    u = None if rhs is None else jnp.zeros_like(rhs)
+    log_pivs = []
+    for o in range(0, m, panel):
+        rows = L[..., o:o + panel, :]                    # (..., p, m)
+        # columns o..o+p corrected for every previous panel in one GEMM
+        corr = jnp.einsum("...mk,...bk->...mb", L, rows)
+        P = S[..., :, o:o + panel] - corr                # (..., m, p)
+        Pl = jnp.zeros_like(P)
+        if rhs is not None:
+            rp = rhs[..., o:o + panel] - jnp.einsum(
+                "...bm,...m->...b", rows, u)
+            up = jnp.zeros_like(rp)
+        for i in range(panel):
+            j = o + i
+            lj = Pl[..., j, :]                           # (..., p)
+            c = P[..., :, i] - jnp.einsum("...mk,...k->...m", Pl, lj)
+            piv2 = c[..., j]
+            inv_piv = jnp.reciprocal(jnp.sqrt(piv2))
+            log_pivs.append(jnp.log(piv2))
+            mask = jnp.asarray(np.arange(m) >= j, dtype=bool)
+            col = jnp.where(mask, c * inv_piv[..., None],
+                            jnp.zeros((), dtype))
+            if rhs is not None:
+                # in-panel contributions use the same pre-update Pl row
+                ui = (rp[..., i]
+                      - jnp.einsum("...k,...k->...", lj, up)) * inv_piv
+                up = up.at[..., i].set(ui)
+            Pl = Pl.at[..., :, i].set(col)
+        L = L.at[..., :, o:o + panel].set(Pl)
+        if rhs is not None:
+            u = u.at[..., o:o + panel].set(up)
+    logdet = jnp.sum(jnp.stack(log_pivs, axis=-1), axis=-1)
+    if m != m0:
+        L = L[..., :m0, :m0]
+        if u is not None:
+            u = u[..., :m0]
+    return L, logdet, u
+
+
+def chol_quad_logdet(S, rhs) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """``(rhs^T S^-1 rhs, logdet S)`` in one fused unrolled pass — the
+    whole linear-algebra payload of one marginalized-likelihood
+    evaluation."""
+    _, logdet, u = chol_forward(S, rhs)
+    return jnp.sum(u * u, axis=-1), logdet

@@ -88,7 +88,18 @@ locale_t c_numeric_locale() {
 }
 #endif
 
+// strtod accepts C hex-float literals ("0x10", "0x1p-3") that Python's
+// float() rejects; keep the two engines' line-acceptance sets identical
+// by rejecting a hex prefix up front.
+bool has_hex_prefix(const std::string& s) {
+  size_t i = 0;
+  while (i < s.size() && (s[i] == '+' || s[i] == '-' || s[i] == ' ')) ++i;
+  return i + 1 < s.size() && s[i] == '0' &&
+         (s[i + 1] == 'x' || s[i + 1] == 'X');
+}
+
 bool parse_double(const std::string& s, double* out) {
+  if (has_hex_prefix(s)) return false;
   errno = 0;
   char* end = nullptr;
 #if !defined(_WIN32)
@@ -100,6 +111,7 @@ bool parse_double(const std::string& s, double* out) {
 }
 
 bool parse_longdouble(const std::string& s, long double* out) {
+  if (has_hex_prefix(s)) return false;
   errno = 0;
   char* end = nullptr;
 #if !defined(_WIN32)

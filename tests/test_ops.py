@@ -55,3 +55,82 @@ def test_gaussian_draw_moments():
     cov = np.cov(np.asarray(draws).T)
     np.testing.assert_allclose(cov, np.linalg.inv(S), atol=5e-2 * np.abs(
         np.linalg.inv(S)).max())
+
+
+# --- statically-unrolled Cholesky (ops/unrolled_chol.py) ----------------
+
+from gibbs_student_t_tpu.ops.linalg import (  # noqa: E402
+    precond_quad_logdet,
+    robust_precond_cholesky,
+)
+from gibbs_student_t_tpu.ops.unrolled_chol import chol_forward  # noqa: E402
+
+
+def test_unrolled_chol_matches_lapack():
+    S = _spd(37, 0, seed=3)  # odd size, unit-ish diagonal
+    rhs = np.random.default_rng(4).standard_normal(37)
+    L, logdet, u = chol_forward(jnp.asarray(S), jnp.asarray(rhs))
+    L_ref = np.linalg.cholesky(S)
+    np.testing.assert_allclose(np.asarray(L), L_ref, rtol=2e-4, atol=1e-5)
+    np.testing.assert_allclose(float(logdet), np.linalg.slogdet(S)[1],
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(u),
+                               sl.solve_triangular(L_ref, rhs, lower=True),
+                               rtol=2e-4, atol=1e-5)
+
+
+def test_unrolled_chol_batched_and_vmapped():
+    Ss = np.stack([_spd(12, 2, seed=s) for s in range(5)])
+    Ls, logdets, _ = chol_forward(jnp.asarray(Ss))
+    Lv, logdetv, _ = jax.vmap(lambda s: chol_forward(s))(jnp.asarray(Ss))
+    for k in range(5):
+        np.testing.assert_allclose(np.asarray(Ls[k]),
+                                   np.linalg.cholesky(Ss[k]), rtol=2e-4,
+                                   atol=1e-6)
+    np.testing.assert_allclose(np.asarray(Lv), np.asarray(Ls), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(logdetv), np.asarray(logdets),
+                               rtol=1e-6)
+
+
+def test_unrolled_chol_nan_on_non_pd():
+    S = np.eye(4)
+    S[0, 1] = S[1, 0] = 2.0
+    L, logdet, _ = chol_forward(jnp.asarray(S))
+    assert not bool(jnp.isfinite(logdet))
+
+
+def test_precond_quad_logdet_fused():
+    S = _spd(40, 12)
+    rhs = np.random.default_rng(1).standard_normal(40)
+    quad, logdet = precond_quad_logdet(jnp.asarray(S), jnp.asarray(rhs))
+    sol_ref = sl.solve(S, rhs)
+    np.testing.assert_allclose(float(quad), rhs @ sol_ref, rtol=1e-4)
+    np.testing.assert_allclose(float(logdet), np.linalg.slogdet(S)[1],
+                               rtol=1e-5)
+
+
+def test_robust_cholesky_fused_rhs_matches_plain():
+    S = _spd(20, 6, seed=5)
+    rhs = np.random.default_rng(6).standard_normal(20)
+    L, isd, logdet, u = robust_precond_cholesky(jnp.asarray(S), rhs=jnp.asarray(rhs))
+    L2, isd2, logdet2 = robust_precond_cholesky(jnp.asarray(S))
+    np.testing.assert_allclose(np.asarray(L), np.asarray(L2), rtol=1e-6)
+    np.testing.assert_allclose(float(logdet), float(logdet2), rtol=1e-6)
+    # u = L^-1 (isd * rhs); full solve through both triangles == Sigma^-1 rhs.
+    # jitter j on the equilibrated unit diagonal maps back to Sigma + j*diag(Sigma)
+    from jax.scipy.linalg import solve_triangular
+    v = solve_triangular(L, u, lower=True, trans="T") * isd
+    np.testing.assert_allclose(
+        np.asarray(v),
+        sl.solve(S + 1e-6 * np.diag(np.diag(S)), rhs), rtol=2e-4)
+
+
+def test_robust_cholesky_escalates_to_finite():
+    """A singular matrix must still yield a finite factorization at some
+    jitter level (the b-draw cannot reject; reference gibbs.py:168-178)."""
+    v = np.ones(8)
+    S = np.outer(v, v) + 1e-9 * np.eye(8)  # numerically rank-one
+    L, isd, logdet = robust_precond_cholesky(
+        jnp.asarray(S, jnp.float32), jitters=(1e-6, 1e-4, 1e-2, 1e-1))
+    assert bool(jnp.isfinite(L).all())
+    assert bool(jnp.isfinite(logdet))

@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Per-op TPU microbenchmark for the sweep's building blocks.
+
+Each candidate op runs K times inside a single ``lax.scan`` dispatch, so
+tunnel/dispatch latency is amortized and the number is the op's true
+on-device cost — the breakdown ``bench.py``'s per-call block timings
+cannot give through the axon relay. Used to attribute the per-sweep cost
+(VERDICT r1 weak #6) and to size the Cholesky optimization.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def timed_scan(fn, args, reps: int, name: str, results: dict):
+    """Cost of one `fn(*args)` call, amortized over `reps` in-scan calls."""
+    import jax
+    import jax.numpy as jnp
+
+    def body(carry, _):
+        out = fn(*args)
+        # fold a scalar of the output into the carry so nothing is DCE'd
+        s = sum(jnp.sum(o) for o in jax.tree_util.tree_leaves(out))
+        return carry + s * 1e-30, None
+
+    run = jax.jit(lambda: jax.lax.scan(body, jnp.zeros(()), None,
+                                       length=reps)[0])
+    try:
+        jax.block_until_ready(run())  # compile
+        t0 = time.perf_counter()
+        jax.block_until_ready(run())
+        dt = (time.perf_counter() - t0) / reps
+    except Exception as e:  # keep the sweep going; record the failure
+        results[name] = f"FAILED: {type(e).__name__}: {str(e)[:200]}"
+        print(f"{name:40s}   FAILED ({type(e).__name__})")
+        return
+    results[name] = round(dt * 1e3, 3)
+    print(f"{name:40s} {dt * 1e3:8.3f} ms")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nchains", type=int, default=1024)
+    ap.add_argument("--reps", type=int, default=30)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from jax import random
+
+    from gibbs_student_t_tpu.backends import JaxGibbs
+    from gibbs_student_t_tpu.config import GibbsConfig
+    from gibbs_student_t_tpu.data.demo import make_demo_model_arrays
+    from gibbs_student_t_tpu.ops.linalg import (
+        precond_cholesky,
+        precond_solve_quad,
+        robust_precond_cholesky,
+    )
+    from gibbs_student_t_tpu.ops.tnt import tnt_products
+
+    print(f"devices: {jax.devices()}")
+    C, reps = args.nchains, args.reps
+    results: dict = {"nchains": C, "platform": jax.default_backend()}
+
+    ma = make_demo_model_arrays(n=130, components=30, seed=42)
+    cfg = GibbsConfig(model="mixture", vary_df=True, theta_prior="beta")
+    gb = JaxGibbs(ma, cfg, nchains=C, chunk_size=10)
+    state = gb.init_state(seed=0)
+    m = gb._ma.m
+
+    rng = np.random.default_rng(0)
+    A = jnp.asarray(rng.standard_normal((C, m, 40)), jnp.float32)
+    Sigma = A @ jnp.swapaxes(A, -1, -2) + 10.0 * jnp.eye(m, dtype=jnp.float32)
+    rhs = jnp.asarray(rng.standard_normal((C, m)), jnp.float32)
+    nvec = jnp.asarray(10.0 ** rng.uniform(-1.5, 1.5, (C, gb._ma.n)),
+                       jnp.float32)
+    keys = random.split(random.PRNGKey(0), C)
+    ks7 = jax.vmap(lambda k: random.split(k, 7))(keys)
+
+    # --- the real composed stages -------------------------------------
+    timed_scan(lambda s, k: gb._batched_sweep(s, k),
+               (state, keys), reps, "full_sweep", results)
+    timed_scan(jax.vmap(lambda st, k: gb._sweep_white(st, k, None)),
+               (state, ks7[:, 0]), reps, "white_block(20 MH)", results)
+    timed_scan(jax.vmap(lambda nv: tnt_products(gb._ma.T, gb._ma.y, nv,
+                                                gb._block_size)),
+               (nvec,), reps, "tnt_xla_vmap", results)
+    from gibbs_student_t_tpu.ops.pallas_tnt import tnt_batched_pallas
+    if jax.default_backend() in ("tpu", "axon"):
+        n = gb._ma.n
+        bs = gb._block_size or n
+        if n % bs == 0:
+            timed_scan(lambda nv: tnt_batched_pallas(
+                gb._ma.T, gb._ma.y, nv, block_size=bs),
+                (nvec,), reps, "tnt_pallas", results)
+
+    # --- linalg primitives --------------------------------------------
+    timed_scan(jnp.linalg.cholesky, (Sigma,), reps,
+               f"cholesky({C},{m},{m})", results)
+    mp = 128
+    Sp = (jnp.zeros((C, mp, mp), jnp.float32)
+          .at[:, :m, :m].set(Sigma).at[:, m:, m:].add(
+              jnp.eye(mp - m, dtype=jnp.float32)))
+    timed_scan(jnp.linalg.cholesky, (Sp,), reps,
+               f"cholesky_padded({C},{mp},{mp})", results)
+    timed_scan(lambda S: precond_cholesky(S, 1e-6), (Sigma,), reps,
+               "precond_cholesky", results)
+    timed_scan(lambda S: robust_precond_cholesky(S), (Sigma,), reps,
+               "robust_precond_cholesky(3j)", results)
+    L = jnp.linalg.cholesky(Sigma)
+    isd = jnp.ones((C, m), jnp.float32)
+    timed_scan(lambda L_, r: precond_solve_quad(L_, isd, r), (L, rhs),
+               reps, "precond_solve_quad(2 trisolve)", results)
+    timed_scan(
+        lambda S, r: jnp.linalg.solve(S, r[..., None])[..., 0],
+        (Sigma, rhs), reps, f"lu_solve({C},{m})", results)
+
+    # one hyper MH step's math, isolated (cholesky + 1 trisolve + logdet)
+    def hyper_eval(S, r):
+        Lh, isdh, logdet = precond_cholesky(S, 1e-6)
+        _, quad = precond_solve_quad(Lh, isdh, r)
+        return quad - logdet
+
+    timed_scan(hyper_eval, (Sigma, rhs), reps, "hyper_eval_once", results)
+
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(results, fh, indent=1)
+        print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()
