@@ -134,3 +134,19 @@ def test_robust_cholesky_escalates_to_finite():
         jnp.asarray(S, jnp.float32), jitters=(1e-6, 1e-4, 1e-2, 1e-1))
     assert bool(jnp.isfinite(L).all())
     assert bool(jnp.isfinite(logdet))
+
+
+def test_unrolled_gate_env_override(monkeypatch):
+    """GST_UNROLLED_CHOL forces the unrolled path on/off regardless of
+    platform, and both paths agree."""
+    from gibbs_student_t_tpu.ops import linalg
+    S = jnp.asarray(_spd(20, 6, seed=7))
+    rhs = jnp.asarray(np.random.default_rng(8).standard_normal(20))
+    monkeypatch.setenv("GST_UNROLLED_CHOL", "1")
+    assert linalg._unrolled_wanted(20)
+    q1, l1 = linalg.precond_quad_logdet(S, rhs)
+    monkeypatch.setenv("GST_UNROLLED_CHOL", "0")
+    assert not linalg._unrolled_wanted(20)
+    q0, l0 = linalg.precond_quad_logdet(S, rhs)
+    np.testing.assert_allclose(float(q1), float(q0), rtol=1e-4)
+    np.testing.assert_allclose(float(l1), float(l0), rtol=1e-5)
