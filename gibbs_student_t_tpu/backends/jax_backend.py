@@ -40,9 +40,9 @@ from jax.scipy.special import gammaln
 from gibbs_student_t_tpu.backends.base import ChainResult, SamplerBackend
 from gibbs_student_t_tpu.config import GibbsConfig
 from gibbs_student_t_tpu.models.pta import ModelArrays, lnprior, ndiag, phiinv_logdet
-from jax.scipy.linalg import solve_triangular
 
 from gibbs_student_t_tpu.ops.linalg import (
+    backward_solve,
     precond_quad_logdet,
     robust_precond_cholesky,
 )
@@ -341,7 +341,7 @@ class JaxGibbs(SamplerBackend):
         # along with the factorization, so one backward substitution
         # yields the draw (reference gibbs.py:169-180's mn + Li*xi)
         xi = random.normal(kb, (m,), dtype=self.dtype)
-        b = solve_triangular(L, u + xi, lower=True, trans="T") * isd
+        b = backward_solve(L, u + xi) * isd
 
         resid = ma.y - matvec_blocked(ma.T, b, bs)
         nvec0 = ndiag(ma, x, jnp)

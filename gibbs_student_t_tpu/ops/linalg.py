@@ -37,6 +37,7 @@ from jax.scipy.linalg import solve_triangular
 from gibbs_student_t_tpu.ops.unrolled_chol import (
     MAX_UNROLL_DIM,
     chol_forward,
+    tri_solve_T,
 )
 
 
@@ -134,6 +135,14 @@ def robust_precond_cholesky(Sigma, jitters=(1e-6, 1e-4, 1e-2), rhs=None):
             u = jnp.where(ok[..., None], u, us[k])
     out = (L, inv_sqrt_d, logdet_S + logd)
     return out + (u,) if rhs is not None else out
+
+
+def backward_solve(L, rhs):
+    """``L^T x = rhs`` through the same platform gate as the
+    factorization: unrolled on TPU, XLA's triangular-solve elsewhere."""
+    if _unrolled_wanted(L.shape[-1]):
+        return tri_solve_T(L, rhs)
+    return solve_triangular(L, rhs, lower=True, trans="T")
 
 
 def precond_solve_quad(L, inv_sqrt_d, rhs):

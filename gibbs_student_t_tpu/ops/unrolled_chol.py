@@ -118,3 +118,38 @@ def chol_quad_logdet(S, rhs) -> Tuple[jnp.ndarray, jnp.ndarray]:
     evaluation."""
     _, logdet, u = chol_forward(S, rhs)
     return jnp.sum(u * u, axis=-1), logdet
+
+
+def tri_solve_T(L, rhs, panel: int = 16) -> jnp.ndarray:
+    """Backward substitution ``L^T x = rhs`` in the same fixed-shape
+    panel-unrolled style as :func:`chol_forward` — the b-draw's last
+    remaining triangular-solve expander (reference gibbs.py:180's
+    ``mn + Li*xi`` becomes one such solve in ops/linalg.py).
+
+    ``L (..., m, m)`` lower-triangular, ``rhs (..., m)``.
+    """
+    m0 = L.shape[-1]
+    m = _round_up(m0, panel)
+    if m != m0:
+        pad = m - m0
+        L = jnp.pad(L, [(0, 0)] * (L.ndim - 2) + [(0, pad), (0, pad)])
+        eye_tail = jnp.asarray(np.pad(np.zeros(m0), (0, pad),
+                                      constant_values=1.0), L.dtype)
+        L = L + jnp.diag(eye_tail)
+        rhs = jnp.pad(rhs, [(0, 0)] * (rhs.ndim - 1) + [(0, pad)])
+
+    x = jnp.zeros_like(rhs)
+    for o in range(m - panel, -1, -panel):
+        cols = L[..., :, o:o + panel]                  # (..., m, p)
+        # contributions from already-solved entries (all in higher panels;
+        # unsolved x entries are still zero so the full contraction is safe)
+        rp = rhs[..., o:o + panel] - jnp.einsum(
+            "...kb,...k->...b", cols, x)
+        Bd = L[..., o:o + panel, o:o + panel]          # (..., p, p)
+        xp = jnp.zeros_like(rp)
+        for i in range(panel - 1, -1, -1):
+            ci = jnp.einsum("...t,...t->...", Bd[..., :, i], xp)
+            xi = (rp[..., i] - ci) / Bd[..., i, i]
+            xp = xp.at[..., i].set(xi)
+        x = x.at[..., o:o + panel].set(xp)
+    return x[..., :m0] if m != m0 else x

@@ -150,3 +150,21 @@ def test_unrolled_gate_env_override(monkeypatch):
     q0, l0 = linalg.precond_quad_logdet(S, rhs)
     np.testing.assert_allclose(float(q1), float(q0), rtol=1e-4)
     np.testing.assert_allclose(float(l1), float(l0), rtol=1e-5)
+
+
+def test_unrolled_tri_solve_T_matches_scipy():
+    from gibbs_student_t_tpu.ops.unrolled_chol import tri_solve_T
+    S = _spd(37, 0, seed=9)
+    L = np.linalg.cholesky(S)
+    rhs = np.random.default_rng(10).standard_normal(37)
+    x = tri_solve_T(jnp.asarray(L), jnp.asarray(rhs))
+    x_ref = sl.solve_triangular(L.T, rhs, lower=False)
+    np.testing.assert_allclose(np.asarray(x), x_ref, rtol=2e-4, atol=1e-6)
+    # batched + vmapped agree
+    Ls = jnp.asarray(np.stack([L, L * 1.5]))
+    rs = jnp.asarray(np.stack([rhs, rhs * 2.0]))
+    xb = tri_solve_T(Ls, rs)
+    xv = jax.vmap(tri_solve_T)(Ls, rs)
+    np.testing.assert_allclose(np.asarray(xb), np.asarray(xv), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(xb[0]), x_ref, rtol=2e-4,
+                               atol=1e-6)
