@@ -118,9 +118,42 @@ def resolve_platform(requested: str, probe_timeout: float = 300.0,
     return env_platform or backend
 
 
-def build(ntoa: int, components: int, seed: int = 42):
+REF_PAR = "/root/reference/J1713+0747.par"
+REF_TIM = "/root/reference/J1713+0747.tim"
+
+
+def build(ntoa: int, components: int, seed: int = 42,
+          dataset: str = "auto"):
+    """Model arrays for the benchmark workload.
+
+    ``auto`` prefers the actual J1713+0747 dataset (reference epochs +
+    par through the simulate pipeline, exactly BASELINE configs 1/3:
+    "J1713+0747 full TOA set") when the reference files are present and
+    the TOA count matches; otherwise the synthetic demo pulsar of the
+    same shape.
+    """
     from gibbs_student_t_tpu.data.demo import make_demo_model_arrays
 
+    if dataset in ("auto", "j1713") and ntoa == 130 and os.path.exists(
+            REF_PAR) and os.path.exists(REF_TIM):
+        import glob
+        import tempfile
+
+        from gibbs_student_t_tpu.data.demo import make_reference_pta
+        from gibbs_student_t_tpu.data.pulsar import Pulsar
+        from gibbs_student_t_tpu.data.simulate import simulate_data
+
+        rng = np.random.default_rng(seed)
+        with tempfile.TemporaryDirectory() as td:
+            out1, _ = simulate_data(REF_PAR, REF_TIM, theta=0.1, idx=0,
+                                    sigma_out=1e-6, outdir=td, rng=rng)
+            psr = Pulsar(glob.glob(out1 + "/*.par")[0],
+                         glob.glob(out1 + "/*.tim")[0])
+        print("# dataset: J1713+0747 (reference epochs+par, simulated "
+              "red noise + outliers)", file=sys.stderr)
+        return make_reference_pta(psr, components).frozen()
+    if dataset == "j1713":
+        raise FileNotFoundError(f"{REF_PAR} not present or ntoa != 130")
     return make_demo_model_arrays(n=ntoa, components=components, seed=seed)
 
 
@@ -242,6 +275,10 @@ def main(argv=None):
     ap.add_argument("--stress", action="store_true",
                     help="1e5-TOA blocked-reduction config (BASELINE "
                          "config 4): 64 chains, light recording")
+    ap.add_argument("--dataset", default="auto",
+                    choices=("auto", "j1713", "demo"),
+                    help="auto: the J1713+0747 dataset when the reference "
+                         "files exist (north-star workload), else demo")
     ap.add_argument("--platform", default="auto",
                     help="jax platform: auto (probe TPU, fall back to cpu), "
                          "or an explicit JAX_PLATFORMS value")
@@ -275,7 +312,7 @@ def main(argv=None):
     from gibbs_student_t_tpu.config import GibbsConfig
 
     cfg = GibbsConfig(model=args.model, vary_df=True, theta_prior="beta")
-    ma = build(args.ntoa, args.components)
+    ma = build(args.ntoa, args.components, dataset=args.dataset)
 
     numpy_sps, numpy_ess = bench_numpy(ma, cfg, args.baseline_sweeps)
     jax_sps, jax_ess, gb = bench_jax(ma, cfg, args.nchains, args.niter,

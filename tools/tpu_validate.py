@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""One-shot TPU validation: unrolled-Cholesky sweep + Pallas TNT kernel.
+
+Everything runs in a single process so the fragile loopback relay is
+dialed exactly once and never abandoned mid-flight (killing a client
+with in-flight remote-compile work wedges the relay for every later
+process — observed 2026-07-29). Each stage prints as it completes and
+all results land in ``--out`` even if a later stage fails.
+
+Stages:
+1. liveness: one tiny op (fail fast if the relay is wedged);
+2. unrolled chol_forward / tri_solve_T: compile time + in-scan per-call
+   cost vs the XLA expanders (the VERDICT r2 perf fix);
+3. full batched sweep, unrolled on vs off (GST_UNROLLED_CHOL);
+4. Pallas TNT kernel vs XLA blocked reduction: parity + in-scan timing
+   at the flagship and stress shapes (VERDICT r1 task 3).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="artifacts/tpu_validation_r02.json")
+    ap.add_argument("--reps", type=int, default=20)
+    args = ap.parse_args()
+    results: dict = {}
+
+    def flush():
+        with open(args.out, "w") as fh:
+            json.dump(results, fh, indent=1)
+
+    def stage(name):
+        def deco(fn):
+            t0 = time.perf_counter()
+            try:
+                results[name] = fn()
+            except Exception as e:  # record and continue
+                results[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
+            results[name + "_stage_s"] = round(time.perf_counter() - t0, 1)
+            print(f"[{name}] {results[name]}", flush=True)
+            flush()
+        return deco
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import random
+
+    @stage("liveness")
+    def _():
+        d = jax.devices()
+        jnp.ones(8).sum().block_until_ready()
+        return {"devices": str(d), "backend": jax.default_backend()}
+
+    if "error" in results.get("liveness", {}):
+        print("relay wedged; aborting", file=sys.stderr)
+        flush()
+        return 1
+
+    def timed_scan(fn, reps):
+        def body(c, _):
+            o = fn()
+            s = sum(jnp.sum(x) for x in jax.tree_util.tree_leaves(o))
+            return c + s * 1e-30, None
+        run = jax.jit(lambda: jax.lax.scan(body, jnp.zeros(()), None,
+                                           length=reps)[0])
+        t0 = time.perf_counter()
+        jax.block_until_ready(run())
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        jax.block_until_ready(run())
+        return (time.perf_counter() - t0) / reps * 1e3, compile_s
+
+    rng = np.random.default_rng(0)
+    m, C = 74, 1024
+    A = jnp.asarray(rng.standard_normal((C, m, 40)), jnp.float32)
+    S = A @ jnp.swapaxes(A, -1, -2) + 10.0 * jnp.eye(m, dtype=jnp.float32)
+    rhs = jnp.asarray(rng.standard_normal((C, m)), jnp.float32)
+
+    @stage("unrolled_chol")
+    def _():
+        from gibbs_student_t_tpu.ops.unrolled_chol import (
+            chol_forward, tri_solve_T)
+        ms, comp = timed_scan(lambda: chol_forward(S, rhs)[0], args.reps)
+        xla_ms, _ = timed_scan(lambda: jnp.linalg.cholesky(S), args.reps)
+        L, ld, u = jax.jit(chol_forward)(S, rhs)
+        err = float(jnp.max(jnp.abs(L - jnp.linalg.cholesky(S))))
+        x = jax.jit(tri_solve_T)(L, rhs)
+        from jax.scipy.linalg import solve_triangular
+        xe = float(jnp.max(jnp.abs(
+            x - solve_triangular(L, rhs[..., None], lower=True,
+                                 trans="T")[..., 0])))
+        tri_ms, _ = timed_scan(lambda: tri_solve_T(L, rhs), args.reps)
+        tri_xla_ms, _ = timed_scan(
+            lambda: solve_triangular(L, rhs[..., None], lower=True,
+                                     trans="T")[..., 0], args.reps)
+        return {"chol_forward_ms": round(ms, 3), "compile_s": round(comp, 1),
+                "xla_cholesky_ms": round(xla_ms, 3),
+                "tri_solve_T_ms": round(tri_ms, 3),
+                "xla_trisolve_ms": round(tri_xla_ms, 3),
+                "max_abs_err_L": err, "max_abs_err_x": xe}
+
+    @stage("full_sweep")
+    def _():
+        from gibbs_student_t_tpu.backends import JaxGibbs
+        from gibbs_student_t_tpu.config import GibbsConfig
+        from gibbs_student_t_tpu.data.demo import make_demo_model_arrays
+
+        ma = make_demo_model_arrays(n=130, components=30, seed=42)
+        cfg = GibbsConfig(model="mixture", vary_df=True,
+                          theta_prior="beta")
+        out = {}
+        for flag in ("1", "0"):
+            os.environ["GST_UNROLLED_CHOL"] = flag
+            gb = JaxGibbs(ma, cfg, nchains=C, chunk_size=10)
+            st = gb.init_state(seed=0)
+            keys = random.split(random.PRNGKey(0), C)
+            ms, comp = timed_scan(
+                lambda: gb._batched_sweep(st, keys), args.reps)
+            key = "unrolled" if flag == "1" else "expander"
+            out[key + "_sweep_ms"] = round(ms, 2)
+            out[key + "_compile_s"] = round(comp, 1)
+        del os.environ["GST_UNROLLED_CHOL"]
+        return out
+
+    @stage("pallas_tnt")
+    def _():
+        from gibbs_student_t_tpu.ops.pallas_tnt import (
+            tnt_batched_pallas, tnt_batched_xla)
+        out = {}
+        for tag, (Cc, n, bs) in {"flagship": (1024, 256, 256),
+                                 "stress": (64, 100352, 512)}.items():
+            T = jnp.asarray(rng.standard_normal((n, m)), jnp.float32)
+            y = jnp.asarray(rng.standard_normal(n), jnp.float32)
+            nv = jnp.asarray(10.0 ** rng.uniform(-1.5, 1.5, (Cc, n)),
+                             jnp.float32)
+            p = jax.jit(lambda: tnt_batched_pallas(T, y, nv, block_size=bs))
+            x = jax.jit(lambda: tnt_batched_xla(T, y, nv, bs))
+            TNT_p, d_p, _ = jax.block_until_ready(p())
+            TNT_x, d_x, _ = jax.block_until_ready(x())
+            rel = float(jnp.max(jnp.abs(TNT_p - TNT_x))
+                        / jnp.max(jnp.abs(TNT_x)))
+            pm, _ = timed_scan(p, max(5, args.reps // 2))
+            xm, _ = timed_scan(x, max(5, args.reps // 2))
+            out[tag] = {"rel_err": rel, "pallas_ms": round(pm, 3),
+                        "xla_ms": round(xm, 3)}
+        return out
+
+    flush()
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
