@@ -308,6 +308,15 @@ def main(argv=None):
     import jax
 
     jax.config.update("jax_platforms", platform)
+    # persistent compile cache: repeated bench runs (and the driver's
+    # end-of-round invocation) skip the sweep kernel's first-compile cost
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(os.path.dirname(
+                              os.path.abspath(__file__)), ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    except Exception:
+        pass  # older jax without the cache knobs
 
     from gibbs_student_t_tpu.config import GibbsConfig
 
