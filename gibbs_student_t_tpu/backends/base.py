@@ -49,6 +49,20 @@ class ChainResult:
                    for k, v in self.stats.items()},
         )
 
+    def select_pulsar(self, i: int) -> "ChainResult":
+        """Slice one pulsar out of an ensemble result (arrays shaped
+        ``(niter, npulsars, nchains, ...)``, parallel/ensemble.py) into
+        the ordinary ``(niter, nchains, ...)`` form drivers save."""
+        return ChainResult(
+            **{
+                f.name: getattr(self, f.name)[:, i]
+                for f in dataclasses.fields(self)
+                if f.name not in ("stats",)
+            },
+            stats={k: (v[:, i] if np.ndim(v) >= 2 else v)
+                   for k, v in self.stats.items()},
+        )
+
     def save(self, outdir: str) -> None:
         """Persist in the reference's on-disk layout
         (reference run_sims.py:118-124)."""

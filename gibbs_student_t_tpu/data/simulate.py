@@ -102,6 +102,7 @@ def simulate_data(
     sigma_out: float = 1e-6,
     outdir: str = "simulated_data",
     rng: Optional[np.random.Generator] = None,
+    keep: Optional[int] = None,
 ):
     """End-to-end simulated dataset, mirroring the reference pipeline
     (reference simulate_data.py:10-39):
@@ -121,8 +122,11 @@ def simulate_data(
     par = read_par(parfile)
     tim = read_tim(timfile)
 
-    err_us = 10 ** (-7 + rng.standard_normal(tim.n) * 0.2) * 1e6
-    psr = FakePulsar(par, tim.mjds, err_us)
+    # ``keep`` subsets the real epochs (first-N) — ensembles use it to
+    # simulate heterogeneous per-pulsar TOA counts from one base tim
+    mjds = tim.mjds if keep is None else tim.mjds[:keep]
+    err_us = 10 ** (-7 + rng.standard_normal(len(mjds)) * 0.2) * 1e6
+    psr = FakePulsar(par, mjds, err_us)
     psr.add_rednoise(1e-14, 4.33, components=30, rng=rng)
 
     z = rng.random(psr.n) < theta

@@ -72,6 +72,69 @@ def _summarize(key: str, res, dt: float, niter: int) -> str:
     return "  # " + ", ".join(parts)
 
 
+def run_ensemble(args, configs, parfile, timfile, rng):
+    """BASELINE config 5: an ``--ensemble N``-pulsar PTA sampled as one
+    ``shard_map`` population over a ``('pulsar', 'chain')`` device mesh
+    (parallel/ensemble.py) — the reference iterates pulsars sequentially
+    in one process (reference run_sims.py:80). Pulsar datasets get
+    distinct noise realizations and (deliberately) heterogeneous TOA
+    counts; the ensemble row-masks the padding."""
+    import jax
+
+    from gibbs_student_t_tpu.data.pulsar import Pulsar
+    from gibbs_student_t_tpu.data.simulate import simulate_data
+    from gibbs_student_t_tpu.parallel import EnsembleGibbs, make_mesh
+
+    theta = args.thetas[0]
+    mas = []
+    for i in range(args.ensemble):
+        idx = int(rng.integers(0, 2 ** 32))
+        out1, _ = simulate_data(parfile, timfile, theta=theta, idx=idx,
+                                sigma_out=args.sigma_out,
+                                outdir=args.simdir, rng=rng,
+                                keep=args.ntoa - (i % 3) * (args.ntoa // 13))
+        name = os.path.splitext(
+            [f for f in os.listdir(out1) if f.endswith(".par")][0])[0]
+        psr = Pulsar(f"{out1}/{name}.par", f"{out1}/{name}.tim")
+        mas.append(build_pta(psr, args.components).frozen())
+
+    # largest device grid whose axes divide the pulsar/chain populations
+    # (shard_map needs even shards); unused devices are left idle
+    ndev = jax.device_count()
+    n_p = n_c = 1
+    for cp in range(1, ndev + 1):
+        if args.ensemble % cp:
+            continue
+        for cc in range(1, ndev // cp + 1):
+            if args.nchains % cc == 0 and cp * cc > n_p * n_c:
+                n_p, n_c = cp, cc
+    mesh = (make_mesh({"pulsar": n_p, "chain": n_c},
+                      devices=jax.devices()[:n_p * n_c])
+            if n_p * n_c > 1 else None)
+    print(f"# ensemble: {args.ensemble} pulsars x {args.nchains} chains "
+          f"on {ndev} device(s)"
+          + (f", mesh {dict(zip(mesh.axis_names, mesh.devices.shape))}"
+             if mesh else ""), file=sys.stderr, flush=True)
+
+    for key, cfg in configs.items():
+        seed = int(rng.integers(0, 2 ** 31))
+        ens = EnsembleGibbs(mas, cfg, nchains=args.nchains, mesh=mesh)
+        t0 = time.perf_counter()
+        res = ens.sample(niter=args.niter, seed=seed)
+        dt = time.perf_counter() - t0
+        sweeps = args.niter * args.ensemble * args.nchains
+        print(f"  # {key}: {dt:.1f}s, {sweeps / dt:.0f} "
+              "pulsar-chain-sweeps/s", file=sys.stderr, flush=True)
+        burned = res.burn(args.burn)
+        for i, ma in enumerate(mas):
+            # simulated ensembles reuse the base pulsar's name; the index
+            # keeps per-pulsar trees distinct
+            out = os.path.join(args.outdirs[0], "ensemble", key,
+                               str(theta), f"{i:02d}_{ma.name or 'pulsar'}")
+            burned.select_pulsar(i).save(out)
+            print(out, flush=True)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--thetas", type=float, nargs="+",
@@ -81,6 +144,11 @@ def main(argv=None):
     ap.add_argument("--backend", choices=["cpu", "jax"], default="cpu")
     ap.add_argument("--nchains", type=int, default=64,
                     help="data-parallel chains per config (jax backend)")
+    ap.add_argument("--ensemble", type=int, default=0, metavar="N",
+                    help="sample an N-pulsar PTA ensemble as one sharded "
+                         "(pulsar x chain) population instead of the "
+                         "sequential per-dataset pipeline (BASELINE "
+                         "config 5; uses --thetas[0])")
     ap.add_argument("--models", nargs="+",
                     default=["vvh17", "uniform", "beta", "gaussian", "t"])
     ap.add_argument("--par", default=None)
@@ -108,6 +176,10 @@ def main(argv=None):
         ap.error(f"unknown --models {sorted(unknown)}; "
                  f"choose from {sorted(all_configs)}")
     configs = {k: v for k, v in all_configs.items() if k in args.models}
+
+    if args.ensemble:
+        run_ensemble(args, configs, parfile, timfile, rng)
+        return
 
     for theta in args.thetas:
         idx = int(rng.integers(0, 2 ** 32))

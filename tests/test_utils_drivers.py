@@ -113,3 +113,25 @@ def test_bench_quick(tmp_path):
     line = json.loads(r.stdout.strip().splitlines()[-1])
     assert {"metric", "value", "unit", "vs_baseline"} <= set(line)
     assert line["value"] > 0
+
+
+@pytest.mark.slow
+def test_run_sims_ensemble_driver(tmp_path):
+    """BASELINE config 5 surface: --ensemble N samples a sharded
+    (pulsar x chain) PTA population with heterogeneous TOA counts and
+    saves one chain tree per pulsar."""
+    r = _run_script(
+        ["/root/repo/run_sims.py", "--backend", "jax", "--ensemble", "3",
+         "--nchains", "2", "--niter", "12", "--burn", "2",
+         "--thetas", "0.1", "--ntoa", "30", "--components", "4",
+         "--models", "beta",
+         "--simdir", str(tmp_path / "sim"),
+         "--outdirs", str(tmp_path / "o1"), str(tmp_path / "o2")],
+        str(tmp_path))
+    assert r.returncode == 0, r.stderr
+    lines = [l for l in r.stdout.splitlines() if l.strip()]
+    assert len(lines) == 3  # one tree per pulsar
+    for ln in lines:
+        chain = np.load(os.path.join(ln, "chain.npy"))
+        assert chain.shape == (10, 2, 3)
+    assert "# ensemble: 3 pulsars" in r.stderr
