@@ -101,11 +101,17 @@ def main():
         tri_xla_ms, _ = timed_scan(
             lambda: solve_triangular(L, rhs[..., None], lower=True,
                                      trans="T")[..., 0], args.reps)
+        panels = {}
+        for p in (8, 32):  # panel=16 is the default measured above
+            pms, pc = timed_scan(
+                lambda p=p: chol_forward(S, rhs, panel=p)[0], args.reps)
+            panels[f"panel{p}_ms"] = round(pms, 3)
+            panels[f"panel{p}_compile_s"] = round(pc, 1)
         return {"chol_forward_ms": round(ms, 3), "compile_s": round(comp, 1),
                 "xla_cholesky_ms": round(xla_ms, 3),
                 "tri_solve_T_ms": round(tri_ms, 3),
                 "xla_trisolve_ms": round(tri_xla_ms, 3),
-                "max_abs_err_L": err, "max_abs_err_x": xe}
+                "max_abs_err_L": err, "max_abs_err_x": xe, **panels}
 
     @stage("full_sweep")
     def _():
