@@ -290,6 +290,10 @@ def main(argv=None):
     ap.add_argument("--profile", metavar="DIR", default=None,
                     help="capture a jax.profiler trace of the timed JAX "
                          "window into DIR (view with xprof/tensorboard)")
+    ap.add_argument("--accel-timeout", type=float, default=1800.0,
+                    help="hard deadline (s) for the accelerator attempt; "
+                         "on expiry the benchmark reruns on CPU so a JSON "
+                         "line is always emitted (0 disables the guard)")
     args = ap.parse_args(argv)
 
     if args.quick:
@@ -305,6 +309,47 @@ def main(argv=None):
     platform = resolve_platform(args.platform,
                                 probe_timeout=args.probe_timeout,
                                 retries=args.probe_retries)
+
+    # Accelerator watchdog: the relay can wedge *between* a successful
+    # probe and the first dispatch/compile, which would hang this process
+    # indefinitely and leave no JSON line at all. Run the accelerator
+    # attempt in a child with a hard deadline; on timeout/failure, rerun
+    # on CPU so a benchmark line is always produced.
+    if (platform != "cpu" and args.accel_timeout > 0
+            and os.environ.get("_GST_BENCH_CHILD") != "1"):
+        env = dict(os.environ)
+        env["_GST_BENCH_CHILD"] = "1"
+        raw = list(argv if argv is not None else sys.argv[1:])
+        passthrough = []
+        skip = False
+        for a in raw:
+            if skip:
+                skip = False
+            elif a == "--platform":
+                skip = True
+            elif not a.startswith("--platform="):
+                passthrough.append(a)
+        child_args = [sys.executable, os.path.abspath(__file__),
+                      "--platform", platform] + passthrough
+        # ladder: accelerator with the unrolled-Cholesky kernel ->
+        # accelerator with the XLA expander path (in case the unrolled
+        # program ever hits a pathological TPU compile) -> cpu
+        for attempt, extra_env in (("unrolled kernel", {}),
+                                   ("expander fallback",
+                                    {"GST_UNROLLED_CHOL": "0"})):
+            proc = subprocess.Popen(child_args, env={**env, **extra_env})
+            try:
+                rc = proc.wait(timeout=args.accel_timeout)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                rc = -1
+            if rc == 0:
+                return
+            print(f"# accelerator attempt ({attempt}) "
+                  f"{'timed out' if rc == -1 else f'failed rc={rc}'}; "
+                  "trying next fallback", file=sys.stderr)
+        platform = "cpu"
+
     import jax
 
     jax.config.update("jax_platforms", platform)
