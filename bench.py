@@ -333,21 +333,41 @@ def main(argv=None):
                       "--platform", platform] + passthrough
         # ladder: accelerator with the unrolled-Cholesky kernel ->
         # accelerator with the XLA expander path (in case the unrolled
-        # program ever hits a pathological TPU compile) -> cpu
+        # program ever hits a pathological TPU compile) -> cpu.
+        # Child stdout is captured and forwarded only on success so the
+        # "exactly one JSON line" contract survives partial children.
         for attempt, extra_env in (("unrolled kernel", {}),
                                    ("expander fallback",
                                     {"GST_UNROLLED_CHOL": "0"})):
-            proc = subprocess.Popen(child_args, env={**env, **extra_env})
+            proc = subprocess.Popen(child_args, env={**env, **extra_env},
+                                    stdout=subprocess.PIPE, text=True)
+            timed_out = False
             try:
-                rc = proc.wait(timeout=args.accel_timeout)
+                out, _ = proc.communicate(timeout=args.accel_timeout)
+                rc = proc.returncode
             except subprocess.TimeoutExpired:
+                timed_out = True
                 proc.kill()
+                try:
+                    out, _ = proc.communicate(timeout=10)
+                except subprocess.TimeoutExpired:
+                    out = ""
                 rc = -1
             if rc == 0:
+                sys.stdout.write(out)
                 return
             print(f"# accelerator attempt ({attempt}) "
-                  f"{'timed out' if rc == -1 else f'failed rc={rc}'}; "
-                  "trying next fallback", file=sys.stderr)
+                  f"{'timed out' if timed_out else f'failed rc={rc}'}",
+                  file=sys.stderr)
+            if timed_out:
+                # killing a client with in-flight remote-compile work
+                # wedges the relay for later processes (observed; see
+                # docs/PERFORMANCE.md) — another accelerator attempt
+                # would burn a second full timeout, so drop to CPU now
+                print("# relay kill is known to wedge later clients; "
+                      "skipping remaining accelerator rungs",
+                      file=sys.stderr)
+                break
         platform = "cpu"
 
     import jax

@@ -178,6 +178,9 @@ def main(argv=None):
     configs = {k: v for k, v in all_configs.items() if k in args.models}
 
     if args.ensemble:
+        if args.backend != "jax":
+            ap.error("--ensemble runs the sharded JAX population; pass "
+                     "--backend jax (the NumPy oracle has no ensemble path)")
         run_ensemble(args, configs, parfile, timfile, rng)
         return
 

@@ -1,0 +1,33 @@
+"""Shared measurement helper for the TPU tools.
+
+One timing methodology for both ``tpu_microbench.py`` and
+``tpu_validate.py``: the op under test runs ``reps`` times inside a
+single ``lax.scan`` dispatch, so the loopback relay's ~65 ms per-dispatch
+latency is amortized away and the number is the op's on-device cost. A
+scalar folded from every output leaf into the carry keeps the op from
+being dead-code-eliminated.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def timed_scan(fn, reps: int):
+    """``(ms_per_call, compile_seconds)`` for one ``fn()`` invocation."""
+    import jax
+    import jax.numpy as jnp
+
+    def body(c, _):
+        out = fn()
+        s = sum(jnp.sum(x) for x in jax.tree_util.tree_leaves(out))
+        return c + s * 1e-30, None
+
+    run = jax.jit(lambda: jax.lax.scan(body, jnp.zeros(()), None,
+                                       length=reps)[0])
+    t0 = time.perf_counter()
+    jax.block_until_ready(run())
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    jax.block_until_ready(run())
+    return (time.perf_counter() - t0) / reps * 1e3, compile_s

@@ -12,35 +12,25 @@ from __future__ import annotations
 
 import argparse
 import json
-import time
+import os
+import sys
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from benchlib import timed_scan as _timed_scan  # noqa: E402
 
 
 def timed_scan(fn, args, reps: int, name: str, results: dict):
     """Cost of one `fn(*args)` call, amortized over `reps` in-scan calls."""
-    import jax
-    import jax.numpy as jnp
-
-    def body(carry, _):
-        out = fn(*args)
-        # fold a scalar of the output into the carry so nothing is DCE'd
-        s = sum(jnp.sum(o) for o in jax.tree_util.tree_leaves(out))
-        return carry + s * 1e-30, None
-
-    run = jax.jit(lambda: jax.lax.scan(body, jnp.zeros(()), None,
-                                       length=reps)[0])
     try:
-        jax.block_until_ready(run())  # compile
-        t0 = time.perf_counter()
-        jax.block_until_ready(run())
-        dt = (time.perf_counter() - t0) / reps
+        ms, _ = _timed_scan(lambda: fn(*args), reps)
     except Exception as e:  # keep the sweep going; record the failure
         results[name] = f"FAILED: {type(e).__name__}: {str(e)[:200]}"
         print(f"{name:40s}   FAILED ({type(e).__name__})")
         return
-    results[name] = round(dt * 1e3, 3)
-    print(f"{name:40s} {dt * 1e3:8.3f} ms")
+    results[name] = round(ms, 3)
+    print(f"{name:40s} {ms:8.3f} ms")
 
 
 def main():

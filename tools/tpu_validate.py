@@ -53,6 +53,9 @@ def main():
     import numpy as np
     from jax import random
 
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from benchlib import timed_scan as _ts
+
     @stage("liveness")
     def _():
         d = jax.devices()
@@ -65,18 +68,7 @@ def main():
         return 1
 
     def timed_scan(fn, reps):
-        def body(c, _):
-            o = fn()
-            s = sum(jnp.sum(x) for x in jax.tree_util.tree_leaves(o))
-            return c + s * 1e-30, None
-        run = jax.jit(lambda: jax.lax.scan(body, jnp.zeros(()), None,
-                                           length=reps)[0])
-        t0 = time.perf_counter()
-        jax.block_until_ready(run())
-        compile_s = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        jax.block_until_ready(run())
-        return (time.perf_counter() - t0) / reps * 1e3, compile_s
+        return _ts(fn, reps)
 
     rng = np.random.default_rng(0)
     m, C = 74, 1024
