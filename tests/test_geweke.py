@@ -147,3 +147,76 @@ def test_geweke_marginals_match_priors():
     obs, _ = np.histogram(th, bins=edges)
     p = stats.chisquare(obs).pvalue
     assert p > 1e-3, f"df: prior-uniformity chi2 p={p:.2e} (tau={tau:.0f})"
+
+
+@pytest.mark.slow
+def test_geweke_jax_kernel_marginals():
+    """Same joint-distribution check driven through the jitted TPU-kernel
+    sweep (backends/jax_backend.py): data re-simulated on host each step,
+    one _sweep application per step with y passed as a traced leaf (the
+    ensemble seam), so nothing recompiles. Catches kernel-specific bugs
+    the NumPy-oracle Geweke run cannot: per-block key threading, the
+    branchless masked MH accepts, where-gated draws."""
+    import jax
+    from jax import random
+
+    from gibbs_student_t_tpu.backends import JaxGibbs
+    from gibbs_student_t_tpu.backends.jax_backend import ChainState
+
+    rng = np.random.default_rng(42)
+    ma = _proper_ma()
+    n, m = ma.n, ma.m
+    cfg = GibbsConfig(model="mixture", vary_df=True, theta_prior="beta",
+                      outlier_mean=0.2)
+    gb = JaxGibbs(ma, cfg, nchains=1, tnt_block_size=None,
+                  use_pallas=False)
+    ma_j = gb._ma
+
+    step = jax.jit(lambda st, key, y: gb._sweep(
+        st, key, dataclasses.replace(ma_j, y=y)))
+
+    x = ma.x_init(rng)
+    df0 = float(rng.integers(1, cfg.df_max + 1))
+    theta0 = rng.beta(n * cfg.outlier_mean, n * (1 - cfg.outlier_mean))
+    z0 = (rng.random(n) < theta0).astype(np.float32)
+    alpha0 = ((df0 / 2) / rng.gamma(df0 / 2, size=n)).astype(np.float32)
+    phiinv, _ = phiinv_logdet(ma, x)
+    b0 = (rng.standard_normal(m) / np.sqrt(phiinv)).astype(np.float32)
+    f32 = np.float32
+    st = ChainState(
+        x=x.astype(f32), b=b0, z=z0, alpha=alpha0,
+        theta=f32(theta0), df=f32(df0), pout=np.zeros(n, f32),
+        acc_white=f32(0), acc_hyper=f32(0))
+    st = jax.tree.map(np.asarray, st)
+
+    base = random.PRNGKey(20260730)
+    burn, keep = 1000, 14000
+    xs = np.zeros((keep, len(ma.param_names)))
+    thetas = np.zeros(keep)
+    for k in range(burn + keep):
+        nvec = (np.asarray(st.alpha) ** np.asarray(st.z)
+                * ndiag(ma, np.asarray(st.x, np.float64)))
+        y = (np.asarray(ma.T) @ np.asarray(st.b, np.float64)
+             + np.sqrt(nvec) * rng.standard_normal(n))
+        st = step(st, random.fold_in(base, k), y.astype(np.float32))
+        if k >= burn:
+            xs[k - burn] = np.asarray(st.x)
+            thetas[k - burn] = float(st.theta)
+
+    bounds = {"equad": EQUAD, "log10_A": LOG10A, "gamma": GAMMA}
+    for i, name in enumerate(ma.param_names):
+        lo, hi = next(v for k2, v in bounds.items() if k2 in name)
+        s = xs[:, i]
+        tau = _tau(s)
+        sem = (hi - lo) / np.sqrt(12) / np.sqrt(len(s) / tau)
+        z = (s.mean() - (lo + hi) / 2) / sem
+        assert abs(z) < 4.5, f"{name}: prior-mean z={z:.2f} (tau={tau:.0f})"
+        th = s[::max(1, int(np.ceil(2 * tau)))]
+        p = stats.kstest(th, "uniform", args=(lo, hi - lo)).pvalue
+        assert p > 1e-3, f"{name}: prior-marginal KS p={p:.2e} (tau={tau:.0f})"
+
+    tau = _tau(thetas)
+    th = thetas[::max(1, int(np.ceil(2 * tau)))]
+    p = stats.kstest(th, "beta", args=(n * cfg.outlier_mean,
+                                       n * (1 - cfg.outlier_mean))).pvalue
+    assert p > 1e-3, f"theta: prior-marginal KS p={p:.2e} (tau={tau:.0f})"
