@@ -39,12 +39,19 @@ from jax.scipy.special import gammaln
 
 from gibbs_student_t_tpu.backends.base import ChainResult, SamplerBackend
 from gibbs_student_t_tpu.config import GibbsConfig
-from gibbs_student_t_tpu.models.pta import ModelArrays, lnprior, ndiag, phiinv_logdet
+from gibbs_student_t_tpu.models.pta import (
+    ModelArrays,
+    lnprior,
+    ndiag,
+    phiinv_logdet,
+    static_phi_columns,
+)
 
 from gibbs_student_t_tpu.ops.linalg import (
     backward_solve,
     precond_quad_logdet,
     robust_precond_cholesky,
+    schur_eliminate,
 )
 from gibbs_student_t_tpu.ops.tnt import (
     auto_block_size,
@@ -85,7 +92,8 @@ class JaxGibbs(SamplerBackend):
                  tnt_block_size: int | str | None = "auto",
                  record: str = "full",
                  use_pallas: bool | str = "auto",
-                 pallas_interpret: bool = False):
+                 pallas_interpret: bool = False,
+                 hyper_schur: bool | str = "auto"):
         """``tnt_block_size`` selects the TOA reduction: ``None`` dense,
         an int for a ``lax.scan`` over row blocks (the 1e5-TOA stress path,
         BASELINE.json config 4; TOA axis zero-padded to a block multiple),
@@ -96,7 +104,14 @@ class JaxGibbs(SamplerBackend):
         Pallas TPU kernel (ops/pallas_tnt.py), batched over all chains
         between the vmapped sweep stages; ``"auto"`` enables it on TPU
         when the blocked path is active. ``pallas_interpret`` runs the
-        kernel in interpreter mode (CPU testing)."""
+        kernel in interpreter mode (CPU testing). ``hyper_schur``
+        pre-eliminates the phi-static basis columns (timing block,
+        constant-pinned GPs) from the hyper-MH factorization once per
+        sweep (ops/linalg.py schur_eliminate) — exact block algebra;
+        with ``jitter>0`` the regularization lands on the sub-blocks'
+        own equilibrated diagonals rather than full Sigma's, a same-order
+        perturbation. ``"auto"`` enables it when at least 8 static
+        columns exist; ``True`` raises if the split is degenerate."""
         super().__init__(ma, config)
         self.nchains = nchains
         self.dtype = dtype
@@ -159,6 +174,22 @@ class JaxGibbs(SamplerBackend):
                   else np.ones(ma.n, dtype=bool))
             self._row_mask = jnp.asarray(
                 np.concatenate([bm, np.zeros(self._n_pad, dtype=bool)]))
+        # Schur pre-elimination of the phi-static basis columns in the
+        # hyper MH (timing block + any constant-pinned GP blocks): their
+        # Sigma contribution is proposal-independent, so eliminating them
+        # once per sweep shrinks the per-evaluation factorization from m
+        # to the varying-column count. Exact block algebra — identical
+        # likelihood values up to rounding.
+        smask = static_phi_columns(self._ma)
+        n_static = int(smask.sum())
+        if hyper_schur == "auto":
+            hyper_schur = 8 <= n_static < self._ma.m
+        elif hyper_schur and not 0 < n_static < self._ma.m:
+            raise ValueError(
+                "hyper_schur needs both static and varying phi columns "
+                f"(static={n_static} of m={self._ma.m})")
+        self._schur = ((np.flatnonzero(smask), np.flatnonzero(~smask))
+                       if hyper_schur else None)
         self._pallas_interpret = pallas_interpret
         if use_pallas == "auto":
             use_pallas = (self._block_size is not None
@@ -315,12 +346,32 @@ class JaxGibbs(SamplerBackend):
 
         # --- hyper MH block on the marginalized likelihood -------------
         # (reference gibbs.py:80-111, 288-329)
-        def ll_hyper(xq):
-            phiinv, logdet_phi = phiinv_logdet(ma, xq, jnp)
-            Sigma = TNT + jnp.diag(phiinv)
-            quad, logdet_sigma = precond_quad_logdet(Sigma, d, cfg.jitter)
-            ll = const_white + 0.5 * (quad - logdet_sigma - logdet_phi)
-            return jnp.where(jnp.isfinite(ll), ll, -jnp.inf)
+        if self._schur is not None and len(ma.hyper_indices):
+            # Once per sweep: eliminate the phi-static columns so each
+            # proposal factors only the varying block — algebra and
+            # failure semantics in ops/linalg.py schur_eliminate.
+            s_i, v_i = self._schur
+            phiinv_s = phiinv_logdet(ma, x, jnp)[0][s_i]  # x-independent
+            S0, rt, quad_s, logdetA = schur_eliminate(
+                TNT[np.ix_(s_i, s_i)] + jnp.diag(phiinv_s),
+                TNT[np.ix_(s_i, v_i)], TNT[np.ix_(v_i, v_i)],
+                d[s_i], d[v_i], cfg.jitter)
+
+            def ll_hyper(xq):
+                phiinv, logdet_phi = phiinv_logdet(ma, xq, jnp)
+                Sv = S0 + jnp.diag(phiinv[v_i])
+                quad_v, logdet_S = precond_quad_logdet(Sv, rt, cfg.jitter)
+                ll = const_white + 0.5 * (quad_s + quad_v - logdetA
+                                          - logdet_S - logdet_phi)
+                return jnp.where(jnp.isfinite(ll), ll, -jnp.inf)
+        else:
+            def ll_hyper(xq):
+                phiinv, logdet_phi = phiinv_logdet(ma, xq, jnp)
+                Sigma = TNT + jnp.diag(phiinv)
+                quad, logdet_sigma = precond_quad_logdet(Sigma, d,
+                                                         cfg.jitter)
+                ll = const_white + 0.5 * (quad - logdet_sigma - logdet_phi)
+                return jnp.where(jnp.isfinite(ll), ll, -jnp.inf)
 
         if len(ma.hyper_indices):
             x, acc_h = self._mh_block(x, kh, ma.hyper_indices,

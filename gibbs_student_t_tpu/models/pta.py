@@ -210,6 +210,27 @@ def ndiag(ma: ModelArrays, x, xp=np):
     return nv
 
 
+def static_phi_columns(ma: ModelArrays) -> np.ndarray:
+    """Boolean mask over the m basis columns whose prior precision does
+    not depend on the sampled parameter vector: improper/constant blocks,
+    plus powerlaw/ecorr blocks pinned to constants. These columns keep
+    the same ``Sigma`` contribution across every hyper-MH proposal in a
+    sweep, so the hyper block can Schur-eliminate them once per sweep
+    and factor only the varying columns per evaluation
+    (backends/jax_backend.py)."""
+    mask = np.zeros(ma.m, dtype=bool)
+    for blk in ma.phi_blocks:
+        if isinstance(blk, (ImproperBlock, ConstBlock)):
+            mask[blk.start:blk.stop] = True
+        elif isinstance(blk, PowerlawBlock):
+            if blk.idx_log10A < 0 and blk.idx_gamma < 0:
+                mask[blk.start:blk.stop] = True
+        elif isinstance(blk, EcorrBlock):
+            if all(i < 0 for i in blk.idx):
+                mask[blk.start:blk.stop] = True
+    return mask
+
+
 def phiinv_logdet(ma: ModelArrays, x, xp=np):
     """Prior precision diag phi^-1(x) (scaled) and logdet phi, the
     get_phiinv seam (reference gibbs.py:155,298). Improper (timing) blocks

@@ -145,6 +145,38 @@ def backward_solve(L, rhs):
     return solve_triangular(L, rhs, lower=True, trans="T")
 
 
+def schur_eliminate(Sigma_ss, Sigma_sv, Sigma_vv, rhs_s, rhs_v,
+                    jitter: float = 0.0):
+    """Pre-eliminate a fixed block of ``Sigma`` for repeated solves.
+
+    For ``Sigma = [[A, B], [B^T, C + D]]`` where only the diagonal ``D``
+    on the v-block changes between evaluations (the hyper-MH structure:
+    phi-static columns s, phi-varying columns v), returns
+    ``(S0, rt, quad_s, logdetA)`` with ``S0 = C - B^T A^-1 B`` and
+    ``rt = rhs_v - B^T A^-1 rhs_s`` such that for any diagonal ``D``:
+
+        rhs^T Sigma^-1 rhs = quad_s + rt^T (S0 + D)^-1 rt
+        logdet Sigma       = logdetA + logdet(S0 + D)
+
+    — evaluated downstream via :func:`precond_quad_logdet` on the
+    smaller ``S0 + D``. ``A`` is a principal submatrix of every
+    ``Sigma`` sharing it, so a non-PD ``A`` (NaN here) poisons every
+    evaluation — the same reject-all failure semantics as factoring the
+    full matrix per evaluation.
+    """
+    La, isd_a, logdetA = precond_cholesky(Sigma_ss, jitter)
+    rhsM = jnp.concatenate([Sigma_sv, rhs_s[..., :, None]], axis=-1)
+    u = solve_triangular(La, rhsM * isd_a[..., :, None], lower=True)
+    w = solve_triangular(La, u, lower=True,
+                         trans="T") * isd_a[..., :, None]
+    Ainv_rs = w[..., :, -1]
+    quad_s = jnp.sum(rhs_s * Ainv_rs, axis=-1)
+    mT = jnp.swapaxes(Sigma_sv, -1, -2)
+    S0 = Sigma_vv - mT @ w[..., :, :-1]
+    rt = rhs_v - (mT @ Ainv_rs[..., None])[..., 0]
+    return S0, rt, quad_s, logdetA
+
+
 def precond_solve_quad(L, inv_sqrt_d, rhs):
     """Given the factorization from :func:`precond_cholesky`, return
     ``(Sigma^-1 rhs, rhs^T Sigma^-1 rhs)``."""

@@ -206,3 +206,96 @@ def test_unrolled_chol_sweep_matches_lapack_path(ma, monkeypatch):
                                atol=2e-3)
     np.testing.assert_allclose(outs["1"][1], outs["0"][1], rtol=5e-2,
                                atol=5e-4)
+
+
+def test_hyper_schur_sweep_matches_full(ma):
+    """The Schur-eliminated hyper block is exact block algebra: with
+    identical keys it must reproduce the full-factorization chains to
+    float precision (f64 here, so any algebra error is glaring)."""
+    cfg = GibbsConfig(model="mixture", vary_df=True, jitter=0.0)
+    jax.config.update("jax_enable_x64", True)
+    try:
+        outs = {}
+        for flag in (True, False):
+            gb = JaxGibbs(ma, cfg, nchains=2, chunk_size=5,
+                          dtype=jnp.float64, hyper_schur=flag)
+            assert (gb._schur is not None) == flag
+            res = gb.sample(niter=8, seed=7)
+            outs[flag] = (np.asarray(res.chain), np.asarray(res.bchain))
+    finally:
+        jax.config.update("jax_enable_x64", False)
+    np.testing.assert_allclose(outs[True][0], outs[False][0], rtol=1e-9)
+    np.testing.assert_allclose(outs[True][1], outs[False][1], rtol=1e-6,
+                               atol=1e-12)
+
+
+def test_hyper_schur_auto_activation(ma):
+    """auto: on for the reference model (14 static timing columns... >=8),
+    off when everything varies."""
+    cfg = GibbsConfig(model="gaussian")
+    gb = JaxGibbs(ma, cfg, nchains=1)
+    assert gb._schur is not None
+    s_i, v_i = gb._schur
+    assert len(s_i) + len(v_i) == ma.m and len(s_i) >= 8
+
+
+def test_hyper_schur_f32_accuracy(ma):
+    """The f32 Schur path (the production TPU regime: default jitter,
+    explicit C - B^T A^-1 B cancellation over the zero-prior timing
+    block) must track the f64 full-factorization likelihood to
+    MH-usable accuracy across prior draws — the same bar
+    test_likelihood_f32_accuracy sets for the full path."""
+    from gibbs_student_t_tpu.models.pta import (
+        phiinv_logdet, static_phi_columns)
+    from gibbs_student_t_tpu.ops.linalg import (
+        precond_quad_logdet, schur_eliminate)
+
+    cfg = GibbsConfig(model="mixture")
+    rng = np.random.default_rng(11)
+    gb = JaxGibbs(ma, cfg, nchains=1)  # f32 arrays, schur auto-on
+    assert gb._schur is not None
+    s_i, v_i = gb._schur
+    maj = gb._ma
+
+    def ll_pair(x, nvec):
+        from gibbs_student_t_tpu.ops.tnt import tnt_products
+
+        # f32 through the Schur path
+        TNT, d, const = tnt_products(maj.T, maj.y,
+                                     nvec.astype(np.float32), None)
+        phs = phiinv_logdet(maj, x.astype(np.float32), jnp)[0]
+        S0, rt, quad_s, logdetA = schur_eliminate(
+            TNT[np.ix_(s_i, s_i)] + jnp.diag(phs[s_i]),
+            TNT[np.ix_(s_i, v_i)], TNT[np.ix_(v_i, v_i)],
+            d[s_i], d[v_i], cfg.jitter)
+        phiinv, logdet_phi = phiinv_logdet(maj, x.astype(np.float32), jnp)
+        quad_v, logdet_S = precond_quad_logdet(
+            S0 + jnp.diag(phiinv[v_i]), rt, cfg.jitter)
+        ll32 = float(const + 0.5 * (quad_s + quad_v - logdetA
+                                    - logdet_S - logdet_phi))
+
+        # f64 full factorization, jitter-free truth
+        T64 = np.asarray(ma.T, np.float64)
+        nv = nvec.astype(np.float64)
+        TNT64 = T64.T @ (T64 / nv[:, None])
+        d64 = T64.T @ (np.asarray(ma.y, np.float64) / nv)
+        phi64, logdet_phi64 = phiinv_logdet(ma, x.astype(np.float64))
+        Sig = TNT64 + np.diag(phi64)
+        import scipy.linalg as sl
+        cf = sl.cho_factor(Sig)
+        quad = d64 @ sl.cho_solve(cf, d64)
+        logdet_sig = 2 * np.sum(np.log(np.diag(cf[0])))
+        const64 = -0.5 * (np.sum(np.log(nv))
+                          + np.asarray(ma.y, np.float64) ** 2 @ (1 / nv))
+        ll64 = const64 + 0.5 * (quad - logdet_sig - logdet_phi64)
+        return ll32, float(ll64)
+
+    gaps = []
+    for _ in range(8):
+        x = ma.x_init(rng)
+        nvec = np.asarray(10.0 ** rng.uniform(-2, 0.5, ma.n), np.float64)
+        gaps.append(np.subtract(*ll_pair(x, nvec)))
+    gaps = np.asarray(gaps)
+    # absolute offsets cancel in MH differences; the spread is what
+    # matters, and it must be well below 1 in log-likelihood
+    assert np.std(gaps) < 0.15, f"f32 schur ll spread {np.std(gaps):.3f}"

@@ -115,16 +115,21 @@ def main():
         cfg = GibbsConfig(model="mixture", vary_df=True,
                           theta_prior="beta")
         out = {}
-        for flag in ("1", "0"):
-            os.environ["GST_UNROLLED_CHOL"] = flag
-            gb = JaxGibbs(ma, cfg, nchains=C, chunk_size=10)
-            st = gb.init_state(seed=0)
-            keys = random.split(random.PRNGKey(0), C)
-            ms, comp = timed_scan(
-                lambda: gb._batched_sweep(st, keys), args.reps)
-            key = "unrolled" if flag == "1" else "expander"
-            out[key + "_sweep_ms"] = round(ms, 2)
-            out[key + "_compile_s"] = round(comp, 1)
+        # 2x2: unrolled linalg on/off x schur elimination on/off — the
+        # numbers that pick the production configuration
+        for uflag in ("1", "0"):
+            for schur in (True, False):
+                os.environ["GST_UNROLLED_CHOL"] = uflag
+                gb = JaxGibbs(ma, cfg, nchains=C, chunk_size=10,
+                              hyper_schur=schur)
+                st = gb.init_state(seed=0)
+                keys = random.split(random.PRNGKey(0), C)
+                ms, comp = timed_scan(
+                    lambda: gb._batched_sweep(st, keys), args.reps)
+                key = (("unrolled" if uflag == "1" else "expander")
+                       + ("_schur" if schur else "_full"))
+                out[key + "_sweep_ms"] = round(ms, 2)
+                out[key + "_compile_s"] = round(comp, 1)
         del os.environ["GST_UNROLLED_CHOL"]
         return out
 
