@@ -48,6 +48,23 @@ def _round_up(x: int, mult: int) -> int:
     return -(-x // mult) * mult
 
 
+def _pad_identity(M, rhs, panel: int):
+    """Pad the trailing (m, m) block of ``M`` to a panel multiple with an
+    identity tail (unit pivots: adds 0 to logdet, leaves the leading
+    block untouched), and the rhs with zeros. Returns ``(M, rhs, m0)``."""
+    m0 = M.shape[-1]
+    m = _round_up(m0, panel)
+    if m != m0:
+        pad = m - m0
+        M = jnp.pad(M, [(0, 0)] * (M.ndim - 2) + [(0, pad), (0, pad)])
+        eye_tail = jnp.asarray(np.pad(np.zeros(m0), (0, pad),
+                                      constant_values=1.0), M.dtype)
+        M = M + jnp.diag(eye_tail)
+        if rhs is not None:
+            rhs = jnp.pad(rhs, [(0, 0)] * (rhs.ndim - 1) + [(0, pad)])
+    return M, rhs, m0
+
+
 def chol_forward(S, rhs=None, panel: int = 16
                  ) -> Tuple[jnp.ndarray, jnp.ndarray,
                             Optional[jnp.ndarray]]:
@@ -58,19 +75,9 @@ def chol_forward(S, rhs=None, panel: int = 16
     (``None`` when no rhs). Unrolled statically over columns — use only
     for ``m <= MAX_UNROLL_DIM``.
     """
-    m0 = S.shape[-1]
     dtype = S.dtype
-    m = _round_up(m0, panel)
-    if m != m0:
-        # pad with an identity block: unit pivots add 0 to logdet and
-        # leave the leading m0 columns untouched
-        pad = m - m0
-        S = jnp.pad(S, [(0, 0)] * (S.ndim - 2) + [(0, pad), (0, pad)])
-        eye_tail = jnp.asarray(np.pad(np.zeros(m0), (0, pad),
-                                      constant_values=1.0), dtype)
-        S = S + jnp.diag(eye_tail)
-        if rhs is not None:
-            rhs = jnp.pad(rhs, [(0, 0)] * (rhs.ndim - 1) + [(0, pad)])
+    S, rhs, m0 = _pad_identity(S, rhs, panel)
+    m = S.shape[-1]
 
     L = jnp.zeros_like(S)
     u = None if rhs is None else jnp.zeros_like(rhs)
@@ -128,15 +135,8 @@ def tri_solve_T(L, rhs, panel: int = 16) -> jnp.ndarray:
 
     ``L (..., m, m)`` lower-triangular, ``rhs (..., m)``.
     """
-    m0 = L.shape[-1]
-    m = _round_up(m0, panel)
-    if m != m0:
-        pad = m - m0
-        L = jnp.pad(L, [(0, 0)] * (L.ndim - 2) + [(0, pad), (0, pad)])
-        eye_tail = jnp.asarray(np.pad(np.zeros(m0), (0, pad),
-                                      constant_values=1.0), L.dtype)
-        L = L + jnp.diag(eye_tail)
-        rhs = jnp.pad(rhs, [(0, 0)] * (rhs.ndim - 1) + [(0, pad)])
+    L, rhs, m0 = _pad_identity(L, rhs, panel)
+    m = L.shape[-1]
 
     x = jnp.zeros_like(rhs)
     for o in range(m - panel, -1, -panel):
