@@ -156,6 +156,30 @@ def main():
                         "xla_ms": round(xm, 3)}
         return out
 
+    @stage("headline")
+    def _():
+        # the BASELINE metric at the production configuration, measured
+        # through the real sample() driver (chunked scan + spooling)
+        import time as _t
+
+        from gibbs_student_t_tpu.backends import JaxGibbs
+        from gibbs_student_t_tpu.config import GibbsConfig
+
+        import bench as bench_mod
+
+        ma = bench_mod.build(130, 30)
+        cfg = GibbsConfig(model="mixture", vary_df=True,
+                          theta_prior="beta")
+        gb = JaxGibbs(ma, cfg, nchains=1024, chunk_size=100)
+        st = gb.init_state(seed=0)
+        gb.sample(niter=100, seed=0, state=st)  # warm
+        st = gb.last_state
+        t0 = _t.perf_counter()
+        gb.sample(niter=200, seed=0, state=st, start_sweep=100)
+        dt = _t.perf_counter() - t0
+        return {"chain_sweeps_per_sec": round(200 / dt * 1024, 1),
+                "sweeps_per_sec_per_chain": round(200 / dt, 2)}
+
     flush()
     print(f"wrote {args.out}")
     return 0
