@@ -220,3 +220,52 @@ def test_geweke_jax_kernel_marginals():
     p = stats.kstest(th, "beta", args=(n * cfg.outlier_mean,
                                        n * (1 - cfg.outlier_mean))).pvalue
     assert p > 1e-3, f"theta: prior-marginal KS p={p:.2e} (tau={tau:.0f})"
+
+
+@pytest.mark.slow
+def test_geweke_detects_broken_kernel():
+    """Negative control for the harness: a deliberately mis-scaled
+    coefficient draw (fluctuation doubled, i.e. wrong conditional
+    covariance) must blow the prior-marginal gates — otherwise the
+    passing tests above prove nothing."""
+
+    class BrokenGibbs(NumpyGibbs):
+        def update_b(self, x, rng):
+            good = super().update_b(x, rng)
+            # re-center then double the fluctuation around the mean:
+            # cheap surrogate for a wrong-covariance draw
+            return 2.0 * good
+
+    rng = np.random.default_rng(5)
+    ma = _proper_ma()
+    n = ma.n
+    cfg = GibbsConfig(model="mixture", vary_df=True, theta_prior="beta",
+                      outlier_mean=0.2)
+    gb = BrokenGibbs(ma, cfg)
+    x = ma.x_init(rng)
+    gb.tdf = 4.0
+    gb._theta = 0.2
+    gb._z = (rng.random(n) < 0.2).astype(float)
+    gb._alpha = 2.0 / rng.gamma(2.0, size=n)
+    phiinv, _ = phiinv_logdet(ma, x)
+    gb._b = rng.standard_normal(ma.m) / np.sqrt(phiinv)
+
+    burn, keep = 500, 6000
+    xs = np.zeros((keep, len(ma.param_names)))
+    for k in range(burn + keep):
+        gb.ma = _resimulate(gb, ma, x, rng)
+        x = _one_sweep(gb, x, rng)
+        if k >= burn:
+            xs[k - burn] = x
+
+    # doubling b inflates the apparent red-noise power: log10_A's
+    # marginal must depart its Uniform prior decisively
+    i = next(i for i, nm in enumerate(ma.param_names) if "log10_A" in nm)
+    s = xs[:, i]
+    tau = _tau(s)
+    lo, hi = LOG10A
+    sem = (hi - lo) / np.sqrt(12) / np.sqrt(len(s) / tau)
+    z = (s.mean() - (lo + hi) / 2) / sem
+    assert abs(z) > 6.0, (
+        f"broken kernel not detected: log10_A prior-mean z={z:.2f} "
+        f"(tau={tau:.0f}) — the Geweke gates lack power")
