@@ -331,14 +331,15 @@ def main(argv=None):
                 passthrough.append(a)
         child_args = [sys.executable, os.path.abspath(__file__),
                       "--platform", platform] + passthrough
-        # ladder: accelerator with the unrolled-Cholesky kernel ->
-        # accelerator with the XLA expander path (in case the unrolled
-        # program ever hits a pathological TPU compile) -> cpu.
+        # ladder: accelerator with the default kernel (XLA expander +
+        # Schur; hardware A/B in artifacts/tpu_validation_r02.json) ->
+        # accelerator with Schur elimination off (in case the larger
+        # once-per-sweep elimination ever miscompiles) -> cpu.
         # Child stdout is captured and forwarded only on success so the
         # "exactly one JSON line" contract survives partial children.
-        for attempt, extra_env in (("unrolled kernel", {}),
-                                   ("expander fallback",
-                                    {"GST_UNROLLED_CHOL": "0"})):
+        for attempt, extra_env in (("default kernel", {}),
+                                   ("no-schur fallback",
+                                    {"GST_HYPER_SCHUR": "0"})):
             proc = subprocess.Popen(child_args, env={**env, **extra_env},
                                     stdout=subprocess.PIPE, text=True)
             timed_out = False

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 from typing import NamedTuple, Optional
 
 import jax
@@ -183,7 +184,12 @@ class JaxGibbs(SamplerBackend):
         smask = static_phi_columns(self._ma)
         n_static = int(smask.sum())
         if hyper_schur == "auto":
-            hyper_schur = 8 <= n_static < self._ma.m
+            env = os.environ.get("GST_HYPER_SCHUR")
+            if env is not None:  # bench fallback-ladder override
+                hyper_schur = (env not in ("0", "false", "")
+                               and 0 < n_static < self._ma.m)
+            else:
+                hyper_schur = 8 <= n_static < self._ma.m
         elif hyper_schur and not 0 < n_static < self._ma.m:
             raise ValueError(
                 "hyper_schur needs both static and varying phi columns "

@@ -20,36 +20,33 @@ gibbs.py:320-324) and SVD->QR fallback (gibbs.py:168-178). A small
 
 For the small per-chain systems this model factors (m ~ 74), XLA's
 While-loop ``cholesky``/``triangular_solve`` expanders dominate the whole
-Gibbs sweep on TPU; matrices up to ``MAX_UNROLL_DIM`` therefore route to
-the statically-unrolled kernel in ops/unrolled_chol.py (measured 4-5x
-per-factorization win on v5e, artifacts/tpu_microbench_r02.json), with
-``jnp.linalg.cholesky`` kept as the large-m fallback.
+Gibbs sweep on TPU. The trace-unrolled replacement in
+ops/unrolled_chol.py is opt-in via ``GST_UNROLLED_CHOL=1`` only: it wins
+standalone but loses inside the full sweep (see ``_unrolled_wanted``).
 """
 
 from __future__ import annotations
 
 import os
 
-import jax
 import jax.numpy as jnp
 from jax.scipy.linalg import solve_triangular
 
-from gibbs_student_t_tpu.ops.unrolled_chol import (
-    MAX_UNROLL_DIM,
-    chol_forward,
-    tri_solve_T,
-)
+from gibbs_student_t_tpu.ops.unrolled_chol import chol_forward, tri_solve_T
 
 
 def _unrolled_wanted(m: int) -> bool:
-    """The unrolled kernel only pays on TPU — on CPU, LAPACK's cholesky
-    is 2x faster at runtime and ~10x faster to compile (so the CPU test
-    suite and the NumPy-oracle parity paths stay on the library op).
-    ``GST_UNROLLED_CHOL=1/0`` overrides for A/B measurement."""
+    """Opt-in only (``GST_UNROLLED_CHOL=1``): hardware A/B on the v5e
+    (artifacts/tpu_validation_r02.json) showed the trace-unrolled kernel
+    wins standalone (4.1 ms vs 11.5 ms per batched factorization) but
+    *loses 4x inside the full jitted sweep* (510 ms vs 127 ms per sweep
+    with the XLA expander) — the long unrolled program schedules badly in
+    the sweep's fori_loop context. The expander is the production path;
+    the flag is kept for A/B measurement."""
     env = os.environ.get("GST_UNROLLED_CHOL")
     if env is not None:
         return env not in ("0", "false", "")
-    return m <= MAX_UNROLL_DIM and jax.default_backend() in ("tpu", "axon")
+    return False
 
 
 def _equilibrate(Sigma, jitter: float):
@@ -63,8 +60,8 @@ def _equilibrate(Sigma, jitter: float):
 
 
 def _factor(S, rhs=None):
-    """``(L, logdet S, L^-1 rhs | None)`` via the unrolled kernel for
-    small m on TPU, XLA's expander otherwise."""
+    """``(L, logdet S, L^-1 rhs | None)`` via XLA's expander, or the
+    opt-in trace-unrolled kernel (``GST_UNROLLED_CHOL=1``)."""
     if _unrolled_wanted(S.shape[-1]):
         return chol_forward(S, rhs)
     L = jnp.linalg.cholesky(S)
@@ -138,8 +135,8 @@ def robust_precond_cholesky(Sigma, jitters=(1e-6, 1e-4, 1e-2), rhs=None):
 
 
 def backward_solve(L, rhs):
-    """``L^T x = rhs`` through the same platform gate as the
-    factorization: unrolled on TPU, XLA's triangular-solve elsewhere."""
+    """``L^T x = rhs`` through the same gate as the factorization:
+    XLA's triangular-solve, or unrolled under ``GST_UNROLLED_CHOL=1``."""
     if _unrolled_wanted(L.shape[-1]):
         return tri_solve_T(L, rhs)
     return solve_triangular(L, rhs, lower=True, trans="T")

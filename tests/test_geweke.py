@@ -225,15 +225,15 @@ def test_geweke_jax_kernel_marginals():
 @pytest.mark.slow
 def test_geweke_detects_broken_kernel():
     """Negative control for the harness: a deliberately mis-scaled
-    coefficient draw (fluctuation doubled, i.e. wrong conditional
+    coefficient draw (doubled, i.e. wrong conditional mean and
     covariance) must blow the prior-marginal gates — otherwise the
     passing tests above prove nothing."""
 
     class BrokenGibbs(NumpyGibbs):
         def update_b(self, x, rng):
             good = super().update_b(x, rng)
-            # re-center then double the fluctuation around the mean:
-            # cheap surrogate for a wrong-covariance draw
+            # doubling the whole draw corrupts both the conditional mean
+            # (2*mu) and the covariance (4x) — a gross b-draw error
             return 2.0 * good
 
     rng = np.random.default_rng(5)

@@ -53,7 +53,9 @@ def main():
     import numpy as np
     from jax import random
 
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.dirname(here))  # repo root: gibbs_student_t_tpu, bench
+    sys.path.insert(0, here)
     from benchlib import timed_scan as _ts
 
     @stage("liveness")
