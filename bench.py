@@ -331,15 +331,16 @@ def main(argv=None):
                 passthrough.append(a)
         child_args = [sys.executable, os.path.abspath(__file__),
                       "--platform", platform] + passthrough
-        # ladder: accelerator with the default kernel (XLA expander +
-        # Schur; hardware A/B in artifacts/tpu_validation_r02.json) ->
-        # accelerator with Schur elimination off (in case the larger
-        # once-per-sweep elimination ever miscompiles) -> cpu.
+        # ladder: accelerator with the default kernel (Pallas
+        # lane-batched Cholesky + Schur, hardware A/B in
+        # artifacts/tpu_validation_r02b.json) -> accelerator with the
+        # Pallas kernel off, i.e. the XLA expander path (in case the
+        # custom kernel ever miscompiles on a new libtpu) -> cpu.
         # Child stdout is captured and forwarded only on success so the
         # "exactly one JSON line" contract survives partial children.
         for attempt, extra_env in (("default kernel", {}),
-                                   ("no-schur fallback",
-                                    {"GST_HYPER_SCHUR": "0"})):
+                                   ("no-pallas-chol fallback",
+                                    {"GST_PALLAS_CHOL": "0"})):
             proc = subprocess.Popen(child_args, env={**env, **extra_env},
                                     stdout=subprocess.PIPE, text=True)
             timed_out = False

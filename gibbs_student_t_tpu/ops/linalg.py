@@ -20,18 +20,32 @@ gibbs.py:320-324) and SVD->QR fallback (gibbs.py:168-178). A small
 
 For the small per-chain systems this model factors (m ~ 74), XLA's
 While-loop ``cholesky``/``triangular_solve`` expanders dominate the whole
-Gibbs sweep on TPU. The trace-unrolled replacement in
-ops/unrolled_chol.py is opt-in via ``GST_UNROLLED_CHOL=1`` only: it wins
-standalone but loses inside the full sweep (see ``_unrolled_wanted``).
+Gibbs sweep on TPU. The production TPU path is the Pallas lane-batched
+kernel (ops/pallas_chol.py), reached through ``jax.custom_batching``:
+the factorizations sit *inside* the chain-``vmap``, so ``_factor_fused``
+/ ``_backsolve_fused`` carry a custom vmap rule that collapses all batch
+axes onto the kernel's lane dimension — an unbatched call (the CPU
+oracle-parity paths) still lowers to the plain XLA expander.
+``GST_PALLAS_CHOL=auto|1|interpret|0`` gates it; the trace-unrolled XLA
+replacement (ops/unrolled_chol.py) stays opt-in via
+``GST_UNROLLED_CHOL=1`` only (wins standalone, loses in-sweep).
 """
 
 from __future__ import annotations
 
 import os
 
+import jax
 import jax.numpy as jnp
+import numpy as np
+from jax.custom_batching import custom_vmap
 from jax.scipy.linalg import solve_triangular
 
+from gibbs_student_t_tpu.ops.pallas_chol import (
+    MAX_PALLAS_DIM,
+    chol_fused_lane,
+    tri_solve_T_lane,
+)
 from gibbs_student_t_tpu.ops.unrolled_chol import chol_forward, tri_solve_T
 
 
@@ -59,18 +73,90 @@ def _equilibrate(Sigma, jitter: float):
     return S, inv_sqrt_d, jnp.sum(jnp.log(d), axis=-1)
 
 
-def _factor(S, rhs=None):
-    """``(L, logdet S, L^-1 rhs | None)`` via XLA's expander, or the
-    opt-in trace-unrolled kernel (``GST_UNROLLED_CHOL=1``)."""
-    if _unrolled_wanted(S.shape[-1]):
-        return chol_forward(S, rhs)
+def _pallas_chol_mode():
+    """``(enabled, interpret, forced)`` from ``GST_PALLAS_CHOL``:
+    ``auto`` (default) enables the Pallas kernel on TPU backends for
+    batches past ``_PALLAS_MIN_BATCH``; ``interpret`` forces it in
+    interpreter mode (CPU testing); ``0``/``false``/empty disables; any
+    other value forces it regardless of platform or batch size — the
+    same anything-truthy-is-on rule as ``GST_UNROLLED_CHOL``."""
+    env = os.environ.get("GST_PALLAS_CHOL", "auto")
+    if env in ("0", "false", ""):
+        return False, False, False
+    if env == "interpret":
+        return True, True, True
+    if env == "auto":
+        return jax.default_backend() in ("tpu", "axon"), False, False
+    return True, False, True
+
+
+# Below this flattened batch size the relayout overhead outweighs the
+# kernel win and the expander is kept.
+_PALLAS_MIN_BATCH = 16
+
+
+def _pallas_ok(shape, dtype, forced: bool) -> bool:
+    batch = int(np.prod(shape[:-2])) if len(shape) > 2 else 1
+    return (dtype == jnp.float32 and shape[-1] <= MAX_PALLAS_DIM
+            and (forced or batch >= _PALLAS_MIN_BATCH))
+
+
+@custom_vmap
+def _factor_fused(S, rhs):
+    """``(L, logdet S, L^-1 rhs)`` — Pallas lane-batched kernel when
+    enabled and the (flattened) batch is big enough, XLA expander
+    otherwise. The vmap rule below folds mapped axes into the batch
+    *before* this dispatch runs, so a chain-vmapped call sees the full
+    chain batch here."""
+    enabled, interp, forced = _pallas_chol_mode()
+    if enabled and _pallas_ok(S.shape, S.dtype, forced):
+        L, logdet, u = chol_fused_lane(S, rhs, interpret=interp)
+        return L, logdet, u
     L = jnp.linalg.cholesky(S)
     logdet = 2.0 * jnp.sum(jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)),
                            axis=-1)
-    u = None
-    if rhs is not None:
-        u = solve_triangular(L, rhs[..., None], lower=True)[..., 0]
+    u = solve_triangular(L, rhs[..., None], lower=True)[..., 0]
     return L, logdet, u
+
+
+@_factor_fused.def_vmap
+def _factor_fused_vmap(axis_size, in_batched, S, rhs):
+    if not in_batched[0]:
+        S = jnp.broadcast_to(S, (axis_size,) + S.shape)
+    if not in_batched[1]:
+        rhs = jnp.broadcast_to(rhs, (axis_size,) + rhs.shape)
+    return _factor_fused(S, rhs), (True, True, True)
+
+
+@custom_vmap
+def _backsolve_fused(L, rhs):
+    """``L^T x = rhs`` — Pallas lane-batched backward substitution or the
+    XLA triangular-solve, same dispatch as :func:`_factor_fused`."""
+    enabled, interp, forced = _pallas_chol_mode()
+    if enabled and _pallas_ok(L.shape, L.dtype, forced):
+        return tri_solve_T_lane(L, rhs, interpret=interp)
+    return solve_triangular(L, rhs, lower=True, trans="T")
+
+
+@_backsolve_fused.def_vmap
+def _backsolve_fused_vmap(axis_size, in_batched, L, rhs):
+    if not in_batched[0]:
+        L = jnp.broadcast_to(L, (axis_size,) + L.shape)
+    if not in_batched[1]:
+        rhs = jnp.broadcast_to(rhs, (axis_size,) + rhs.shape)
+    return _backsolve_fused(L, rhs), True
+
+
+def _factor(S, rhs=None):
+    """``(L, logdet S, L^-1 rhs | None)`` via the Pallas/XLA dispatch, or
+    the opt-in trace-unrolled kernel (``GST_UNROLLED_CHOL=1``)."""
+    if _unrolled_wanted(S.shape[-1]):
+        return chol_forward(S, rhs)
+    # a dead rhs (and its fused solve, and the unused L relayout) is
+    # eliminated by XLA when the caller only consumes logdet/u
+    L, logdet, u = _factor_fused(
+        S, rhs if rhs is not None else jnp.zeros(S.shape[:-1], S.dtype))
+    return L, logdet, (u if rhs is not None else None)
 
 
 def precond_cholesky(Sigma, jitter: float = 0.0):
@@ -135,11 +221,11 @@ def robust_precond_cholesky(Sigma, jitters=(1e-6, 1e-4, 1e-2), rhs=None):
 
 
 def backward_solve(L, rhs):
-    """``L^T x = rhs`` through the same gate as the factorization:
-    XLA's triangular-solve, or unrolled under ``GST_UNROLLED_CHOL=1``."""
+    """``L^T x = rhs`` through the same gates as the factorization:
+    the Pallas/XLA dispatch, or unrolled under ``GST_UNROLLED_CHOL=1``."""
     if _unrolled_wanted(L.shape[-1]):
         return tri_solve_T(L, rhs)
-    return solve_triangular(L, rhs, lower=True, trans="T")
+    return _backsolve_fused(L, rhs)
 
 
 def schur_eliminate(Sigma_ss, Sigma_sv, Sigma_vv, rhs_s, rhs_v,

@@ -1,0 +1,239 @@
+"""Pallas TPU kernel: lane-batched small-matrix Cholesky + solves.
+
+The Gibbs sweep factors ~14 batched ``(chains, m, m)`` systems per sweep
+with m ~ 60-74 (10 marginalized-likelihood MH evaluations, reference
+gibbs.py:288-329, plus the stacked escalating-jitter b-draw factorization,
+gibbs.py:168-178). The FLOPs are trivial (~0.1 GFLOP per factorization at
+1024 chains) but XLA lowers ``cholesky``/``triangular_solve`` to a
+sequential While loop over columns with dynamic slices — ~11 ms per
+factorization on a v5e, ~85% of the whole sweep
+(artifacts/tpu_microbench_r02.json). A trace-time-unrolled XLA variant
+(ops/unrolled_chol.py) wins standalone but schedules badly inside the
+sweep (artifacts/tpu_validation_r02.json), so the production path is this
+kernel, designed for how a TPU actually wants to do thousands of tiny
+factorizations at once:
+
+- **batch on the lane dimension.** Arrays live as ``(m, m, lanes)`` with
+  the matrix *column* index outermost (untiled), the row index on
+  sublanes, and ``chain_tile`` chains on lanes. Every step of the
+  textbook right-looking recurrence becomes a full-width VPU op over 128
+  chains at once — no MXU, no loop machinery, no per-chain anything.
+- **everything resident in VMEM.** One chain tile's working set
+  (~3 MB at m=80, 128 lanes) stays on-chip for the whole factorization;
+  HBM sees exactly one read of ``S`` and one write of ``L``.
+- **rank-1 trailing updates, statically unrolled.** Column ``j`` costs
+  one ``(m, m, lanes)`` fused multiply-subtract masked to columns
+  ``> j``; the full factorization is ~m uniform ops with identical
+  static shapes (the shape discipline that ops/unrolled_chol.py's
+  compile-time blowup taught).
+- **fused forward substitution.** ``u = L^-1 rhs`` rides along in the
+  same pass, so a marginalized-likelihood evaluation
+  (``rhs^T Sigma^-1 rhs``, ``logdet Sigma``) needs no separate
+  triangular solve; the matching backward kernel finishes the b-draw.
+
+Failure semantics are branchless and identical to the XLA paths: a
+non-PD pivot produces NaN via ``rsqrt``, which poisons ``logdet`` and
+every later column — callers map non-finite to ``-inf`` log-likelihood /
+MH rejection (reference gibbs.py:320-324).
+
+Like ops/pallas_tnt.py, matvec-shaped ops are kept >= 2-D throughout:
+this libtpu's Mosaic cannot parse the attribute a 1-D ``jnp.dot`` emits
+(verified on v5e; see that module's header).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu only imports on builds with the TPU extension available
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAVE_PLTPU = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _HAVE_PLTPU = False
+
+# Above this the statically-unrolled kernel program gets large and the
+# O(m^2)-per-tile VMEM working set stops fitting comfortably.
+MAX_PALLAS_DIM = 160
+
+
+def _round_up(x: int, mult: int) -> int:
+    return -(-x // mult) * mult
+
+
+def _spec(shape, index_map):
+    if _HAVE_PLTPU:
+        return pl.BlockSpec(shape, index_map, memory_space=pltpu.VMEM)
+    return pl.BlockSpec(shape, index_map)
+
+
+def _chol_kernel(S_ref, r_ref, L_ref, u_ref, ld_ref, *, mp: int):
+    """Factor one chain tile: ``L L^T = S`` with fused forward solve.
+
+    Layout (column-major-of-columns): ``S/L (mp, mp, lanes)`` indexed
+    ``[matrix column, matrix row, chain]``; ``r/u (mp, lanes)``.
+    Right-looking: after column ``j`` is finished, its rank-1 outer
+    product is subtracted from every *later* column in one masked
+    full-buffer op, so the trailing matrix always holds the Schur
+    complement of the processed block.
+    """
+    L_ref[:] = S_ref[:]
+    lanes = r_ref.shape[-1]
+    racc = jnp.zeros((mp, lanes), jnp.float32)  # sum_k L[i,k] u[k]
+    ld = jnp.zeros((1, lanes), jnp.float32)
+    # masks built from in-kernel iota (captured host constants are not
+    # allowed in pallas kernels); comparisons against the static j fold
+    # into predicated vector ops
+    rows2 = jax.lax.broadcasted_iota(jnp.int32, (mp, 1), 0)
+    rows3 = jax.lax.broadcasted_iota(jnp.int32, (mp, 1, 1), 0)
+    for j in range(mp):
+        c = L_ref[j]                              # (mp, lanes)
+        piv = c[j:j + 1, :]                       # (1, lanes)
+        inv = jax.lax.rsqrt(piv)
+        ld += jnp.log(piv)
+        col = jnp.where(rows2 >= j, c * inv, 0.0)
+        uj = (r_ref[j:j + 1, :] - racc[j:j + 1, :]) * inv
+        u_ref[j:j + 1, :] = uj
+        racc = racc + col * uj
+        # rank-1 trailing update of the columns strictly after j; the
+        # mask keeps finished columns (and j itself, written below) intact
+        upd = col[:, None, :] * col[None, :, :]   # [j', i, chain]
+        L_ref[:] = L_ref[:] - jnp.where(rows3 > j, upd, 0.0)
+        L_ref[j] = col
+    ld_ref[:] = ld
+
+
+def _backsolve_kernel(L_ref, r_ref, x_ref, *, mp: int):
+    """``L^T x = r`` for one chain tile, same layout as `_chol_kernel`.
+
+    Descending substitution: entries above the current row are still
+    zero in ``x``, so the full-column contraction is the partial sum the
+    recurrence needs.
+    """
+    x_ref[:] = jnp.zeros_like(x_ref)
+    for j in range(mp - 1, -1, -1):
+        colj = L_ref[j]                           # (mp, lanes)
+        dot = jnp.sum(colj * x_ref[:], axis=0, keepdims=True)
+        x_ref[j:j + 1, :] = (r_ref[j:j + 1, :] - dot) / colj[j:j + 1, :]
+
+
+def _pad_batch_identity(S, rhs, bpad: int):
+    """Append ``bpad`` identity systems along the flat batch axis."""
+    if not bpad:
+        return S, rhs
+    mp = S.shape[-1]
+    eye = jnp.broadcast_to(jnp.eye(mp, dtype=S.dtype), (bpad, mp, mp))
+    S = jnp.concatenate([S, eye], axis=0)
+    rhs = jnp.concatenate([rhs, jnp.zeros((bpad, mp), rhs.dtype)], axis=0)
+    return S, rhs
+
+
+def _to_lane_layout(S, rhs):
+    """``(B, mp, mp) -> (mp, mp, B)`` (column, row, chain); rhs -> (mp, B)."""
+    return jnp.transpose(S, (2, 1, 0)), jnp.transpose(rhs, (1, 0))
+
+
+def chol_fused_lane(S, rhs, chain_tile: int = 128, interpret: bool = False
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """``(L, logdet, u)`` for ``S (..., m, m)``, ``rhs (..., m)`` (f32).
+
+    All leading dims are flattened onto the lane-batch axis. Callers
+    that only consume ``logdet``/``u`` (the marginalized-likelihood MH
+    path) don't pay for ``L``: its back-relayout is ordinary XLA code
+    that dead-code-eliminates when unused.
+    """
+    if S.dtype != jnp.float32:
+        raise ValueError(f"pallas chol kernel is float32-only, got {S.dtype}")
+    batch = S.shape[:-2]
+    m = S.shape[-1]
+    from gibbs_student_t_tpu.ops.unrolled_chol import _pad_identity
+
+    Sf = S.reshape((-1,) + S.shape[-2:])
+    rf = rhs.reshape((-1, m))
+    B = Sf.shape[0]
+    tile = min(chain_tile, _round_up(B, 8))
+    Sf, rf, _ = _pad_identity(Sf, rf, 8)       # sublane-align m
+    mp = Sf.shape[-1]
+    Bp = _round_up(B, tile)
+    Sf, rf = _pad_batch_identity(Sf, rf, Bp - B)
+    St, rt = _to_lane_layout(Sf, rf)
+
+    kwargs = {}
+    if _HAVE_PLTPU:  # chain tiles are independent
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel",))
+    kernel = functools.partial(_chol_kernel, mp=mp)
+    Lt, ut, ld = pl.pallas_call(
+        kernel,
+        grid=(Bp // tile,),
+        in_specs=[
+            _spec((mp, mp, tile), lambda g: (0, 0, g)),
+            _spec((mp, tile), lambda g: (0, g)),
+        ],
+        out_specs=[
+            _spec((mp, mp, tile), lambda g: (0, 0, g)),
+            _spec((mp, tile), lambda g: (0, g)),
+            _spec((1, tile), lambda g: (0, g)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((mp, mp, Bp), jnp.float32),
+            jax.ShapeDtypeStruct((mp, Bp), jnp.float32),
+            jax.ShapeDtypeStruct((1, Bp), jnp.float32),
+        ],
+        interpret=interpret,
+        **kwargs,
+    )(St, rt)
+
+    logdet = ld[0, :B].reshape(batch)
+    u = jnp.transpose(ut, (1, 0))[:B, :m].reshape(batch + (m,))
+    L = jnp.transpose(Lt, (2, 1, 0))[:B, :m, :m].reshape(batch + (m, m))
+    return L, logdet, u
+
+
+def tri_solve_T_lane(L, rhs, chain_tile: int = 128,
+                     interpret: bool = False) -> jnp.ndarray:
+    """Backward substitution ``L^T x = rhs`` in the lane-batched layout.
+
+    ``L (..., m, m)`` lower-triangular (as from :func:`chol_fused_lane`),
+    ``rhs (..., m)``; float32 only.
+    """
+    if L.dtype != jnp.float32:
+        raise ValueError(f"pallas solve kernel is float32-only, got {L.dtype}")
+    batch = L.shape[:-2]
+    m = L.shape[-1]
+    from gibbs_student_t_tpu.ops.unrolled_chol import _pad_identity
+
+    Lf = L.reshape((-1, m, m))
+    rf = rhs.reshape((-1, m))
+    B = Lf.shape[0]
+    tile = min(chain_tile, _round_up(B, 8))
+    Lf, rf, _ = _pad_identity(Lf, rf, 8)
+    mp = Lf.shape[-1]
+    Bp = _round_up(B, tile)
+    Lf, rf = _pad_batch_identity(Lf, rf, Bp - B)
+    Lt, rt = _to_lane_layout(Lf, rf)
+
+    kwargs = {}
+    if _HAVE_PLTPU:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel",))
+    kernel = functools.partial(_backsolve_kernel, mp=mp)
+    xt = pl.pallas_call(
+        kernel,
+        grid=(Bp // tile,),
+        in_specs=[
+            _spec((mp, mp, tile), lambda g: (0, 0, g)),
+            _spec((mp, tile), lambda g: (0, g)),
+        ],
+        out_specs=_spec((mp, tile), lambda g: (0, g)),
+        out_shape=jax.ShapeDtypeStruct((mp, Bp), jnp.float32),
+        interpret=interpret,
+        **kwargs,
+    )(Lt, rt)
+    return jnp.transpose(xt, (1, 0))[:B, :m].reshape(batch + (m,))

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""One-shot TPU validation: unrolled-Cholesky sweep + Pallas TNT kernel.
+"""One-shot TPU validation: Pallas lane-batched Cholesky + TNT kernels.
 
 Everything runs in a single process so the fragile loopback relay is
 dialed exactly once and never abandoned mid-flight (killing a client
@@ -9,11 +9,13 @@ all results land in ``--out`` even if a later stage fails.
 
 Stages:
 1. liveness: one tiny op (fail fast if the relay is wedged);
-2. unrolled chol_forward / tri_solve_T: compile time + in-scan per-call
-   cost vs the XLA expanders (the VERDICT r2 perf fix);
-3. full batched sweep, unrolled on vs off (GST_UNROLLED_CHOL);
+2. pallas_chol: lane-batched factor+solve parity vs the XLA expander on
+   hardware, plus in-scan timings at the hyper-MH (m=60 Schur'd) and
+   full (m=74) shapes;
+3. full batched sweep, Pallas chol on (default) vs off (GST_PALLAS_CHOL);
 4. Pallas TNT kernel vs XLA blocked reduction: parity + in-scan timing
-   at the flagship and stress shapes (VERDICT r1 task 3).
+   at the flagship and stress shapes;
+5. headline: BASELINE chain-sweeps/s through the real sample() driver.
 """
 
 from __future__ import annotations
@@ -78,34 +80,55 @@ def main():
     S = A @ jnp.swapaxes(A, -1, -2) + 10.0 * jnp.eye(m, dtype=jnp.float32)
     rhs = jnp.asarray(rng.standard_normal((C, m)), jnp.float32)
 
-    @stage("unrolled_chol")
+    @stage("pallas_chol")
     def _():
-        from gibbs_student_t_tpu.ops.unrolled_chol import (
-            chol_forward, tri_solve_T)
-        ms, comp = timed_scan(lambda: chol_forward(S, rhs)[0], args.reps)
-        xla_ms, _ = timed_scan(lambda: jnp.linalg.cholesky(S), args.reps)
-        L, ld, u = jax.jit(chol_forward)(S, rhs)
-        err = float(jnp.max(jnp.abs(L - jnp.linalg.cholesky(S))))
-        x = jax.jit(tri_solve_T)(L, rhs)
         from jax.scipy.linalg import solve_triangular
-        xe = float(jnp.max(jnp.abs(
-            x - solve_triangular(L, rhs[..., None], lower=True,
-                                 trans="T")[..., 0])))
-        tri_ms, _ = timed_scan(lambda: tri_solve_T(L, rhs), args.reps)
-        tri_xla_ms, _ = timed_scan(
-            lambda: solve_triangular(L, rhs[..., None], lower=True,
-                                     trans="T")[..., 0], args.reps)
-        panels = {}
-        for p in (8, 32):  # panel=16 is the default measured above
-            pms, pc = timed_scan(
-                lambda p=p: chol_forward(S, rhs, panel=p)[0], args.reps)
-            panels[f"panel{p}_ms"] = round(pms, 3)
-            panels[f"panel{p}_compile_s"] = round(pc, 1)
-        return {"chol_forward_ms": round(ms, 3), "compile_s": round(comp, 1),
-                "xla_cholesky_ms": round(xla_ms, 3),
-                "tri_solve_T_ms": round(tri_ms, 3),
-                "xla_trisolve_ms": round(tri_xla_ms, 3),
-                "max_abs_err_L": err, "max_abs_err_x": xe, **panels}
+
+        from gibbs_student_t_tpu.ops.pallas_chol import (
+            chol_fused_lane, tri_solve_T_lane)
+
+        out = {}
+        for tag, mm in (("m74", 74), ("m60", 60)):
+            A = jnp.asarray(rng.standard_normal((C, mm, 40)), jnp.float32)
+            Sm = A @ jnp.swapaxes(A, -1, -2) + 10.0 * jnp.eye(
+                mm, dtype=jnp.float32)
+            rm = jnp.asarray(rng.standard_normal((C, mm)), jnp.float32)
+            pal = jax.jit(lambda Sm=Sm, rm=rm: chol_fused_lane(Sm, rm))
+            L, ld, u = jax.block_until_ready(pal())
+            L0 = jnp.linalg.cholesky(Sm)
+            ld0 = 2 * jnp.sum(jnp.log(jnp.diagonal(
+                L0, axis1=-2, axis2=-1)), axis=-1)
+            u0 = solve_triangular(L0, rm[..., None], lower=True)[..., 0]
+            out[tag] = {
+                "max_err_L": float(jnp.max(jnp.abs(L - L0))),
+                "max_err_ld": float(jnp.max(jnp.abs(ld - ld0))),
+                "max_err_u": float(jnp.max(jnp.abs(u - u0))),
+            }
+            # logdet+u only (the hyper-MH payload: L's relayout DCE'd)
+            pms, comp = timed_scan(
+                lambda Sm=Sm, rm=rm: chol_fused_lane(Sm, rm)[1:],
+                args.reps)
+            xms, _ = timed_scan(
+                lambda Sm=Sm, rm=rm: (
+                    2 * jnp.sum(jnp.log(jnp.diagonal(
+                        jnp.linalg.cholesky(Sm), axis1=-2, axis2=-1)),
+                        axis=-1),
+                    solve_triangular(jnp.linalg.cholesky(Sm),
+                                     rm[..., None], lower=True)[..., 0]),
+                args.reps)
+            bms, _ = timed_scan(
+                lambda L=L, rm=rm: tri_solve_T_lane(L, rm),
+                args.reps)
+            bx, _ = timed_scan(
+                lambda L=L, rm=rm: solve_triangular(
+                    L, rm, lower=True, trans="T"), args.reps)
+            out[tag].update({
+                "pallas_quadld_ms": round(pms, 3),
+                "pallas_compile_s": round(comp, 1),
+                "xla_quadld_ms": round(xms, 3),
+                "pallas_backsolve_ms": round(bms, 3),
+                "xla_backsolve_ms": round(bx, 3)})
+        return out
 
     @stage("full_sweep")
     def _():
@@ -117,22 +140,21 @@ def main():
         cfg = GibbsConfig(model="mixture", vary_df=True,
                           theta_prior="beta")
         out = {}
-        # 2x2: unrolled linalg on/off x schur elimination on/off — the
-        # numbers that pick the production configuration
-        for uflag in ("1", "0"):
-            for schur in (True, False):
-                os.environ["GST_UNROLLED_CHOL"] = uflag
-                gb = JaxGibbs(ma, cfg, nchains=C, chunk_size=10,
-                              hyper_schur=schur)
+        # the production decision: Pallas chol (default-on for TPU) vs
+        # the plain expander path, both with Schur auto
+        try:
+            for pflag, key in (("auto", "pallas"), ("0", "expander")):
+                os.environ["GST_PALLAS_CHOL"] = pflag
+                gb = JaxGibbs(ma, cfg, nchains=C, chunk_size=10)
                 st = gb.init_state(seed=0)
                 keys = random.split(random.PRNGKey(0), C)
                 ms, comp = timed_scan(
                     lambda: gb._batched_sweep(st, keys), args.reps)
-                key = (("unrolled" if uflag == "1" else "expander")
-                       + ("_schur" if schur else "_full"))
                 out[key + "_sweep_ms"] = round(ms, 2)
                 out[key + "_compile_s"] = round(comp, 1)
-        del os.environ["GST_UNROLLED_CHOL"]
+        finally:
+            # a mid-loop failure must not leak the flag into later stages
+            os.environ.pop("GST_PALLAS_CHOL", None)
         return out
 
     @stage("pallas_tnt")
