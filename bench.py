@@ -182,7 +182,7 @@ def bench_numpy(ma, cfg, nsweeps: int, seed: int = 0):
 
 
 def bench_jax(ma, cfg, nchains: int, nsweeps: int, chunk: int,
-              seed: int = 0, record: str = "full",
+              seed: int = 0, record: str = "compact",
               tnt_block_size="auto", profile_dir: str | None = None):
     import contextlib
 
@@ -299,7 +299,7 @@ def main(argv=None):
     if args.quick:
         args.nchains, args.niter = 32, 50
         args.baseline_sweeps, args.chunk = 30, 25
-    record = "full"
+    record = "compact"  # the backend's production default
     if args.stress:
         args.ntoa, args.nchains = 100_000, 64
         args.niter, args.chunk = 20, 10

@@ -80,6 +80,15 @@ class ChainState(NamedTuple):
 _RECORD_FIELDS = ("x", "b", "z", "theta", "alpha", "df", "pout",
                   "acc_white", "acc_hyper")
 
+# record="compact": device->host transport dtypes for the bulky recorded
+# fields. z is exactly 0/1 so uint8 is lossless; pout is a probability
+# (float16 keeps ~3 decimal digits); b/alpha need float32 *range*
+# (alpha spans many decades) so bfloat16. Host arrays are re-materialized
+# as float32 — the cast exists only on the wire, where chain recording is
+# bandwidth-bound (~200 MB per 100-sweep chunk at 1024 chains otherwise).
+_COMPACT_CASTS = {"z": jnp.uint8, "pout": jnp.float16,
+                  "b": jnp.bfloat16, "alpha": jnp.bfloat16}
+
 
 class JaxGibbs(SamplerBackend):
     """Many-chain Gibbs sampler; ``sample`` returns ``(niter, nchains, ...)``
@@ -91,16 +100,25 @@ class JaxGibbs(SamplerBackend):
                  nchains: int = 64, dtype=jnp.float32,
                  chunk_size: int = 100,
                  tnt_block_size: int | str | None = "auto",
-                 record: str = "full",
+                 record: str = "compact",
                  use_pallas: bool | str = "auto",
                  pallas_interpret: bool = False,
                  hyper_schur: bool | str = "auto"):
         """``tnt_block_size`` selects the TOA reduction: ``None`` dense,
         an int for a ``lax.scan`` over row blocks (the 1e5-TOA stress path,
         BASELINE.json config 4; TOA axis zero-padded to a block multiple),
-        ``"auto"`` picks by TOA count. ``record="light"`` records only the
-        O(1)-per-sweep fields (x, theta, df, acceptance) — at stress scale
-        the per-TOA chains (z, alpha, pout) dominate host transfer.
+        ``"auto"`` picks by TOA count. ``record`` picks the chain
+        recording mode: ``"compact"`` (default) records every field but
+        moves the bulky ones device->host in narrow transport dtypes —
+        z as uint8 (exact: values are 0/1), pout as float16 (a
+        probability; ~3 decimal digits), b and alpha as bfloat16
+        (float32 range, ~2-3 significant digits) — then re-materializes
+        float32 host arrays, cutting transfer bytes ~2.2x (the sampled
+        parameter chains x/theta/df and acceptance stats are always
+        exact float32); ``"full"`` transports everything in float32
+        bit-exactly; ``"light"`` records only the O(1)-per-sweep fields
+        (x, theta, df, acceptance) — at stress scale the per-TOA chains
+        (z, alpha, pout) dominate host transfer.
         ``use_pallas`` routes the blocked TNT reduction through the fused
         Pallas TPU kernel (ops/pallas_tnt.py), batched over all chains
         between the vmapped sweep stages; ``"auto"`` enables it on TPU
@@ -117,10 +135,16 @@ class JaxGibbs(SamplerBackend):
         self.nchains = nchains
         self.dtype = dtype
         self.chunk_size = chunk_size
-        if record not in ("full", "light"):
-            raise ValueError(f"record must be 'full' or 'light', got {record!r}")
-        self._record_fields = (_RECORD_FIELDS if record == "full" else
+        if record not in ("full", "compact", "light"):
+            raise ValueError("record must be 'full', 'compact' or "
+                             f"'light', got {record!r}")
+        self._record_fields = (_RECORD_FIELDS if record != "light" else
                                ("x", "theta", "df", "acc_white", "acc_hyper"))
+        # compact transport only applies to float32 runs: an explicit
+        # float64 run asked for full precision and must get bit-exact
+        # float64 chains back (the casts would silently narrow them)
+        self._record_casts = (_COMPACT_CASTS if record == "compact"
+                              and dtype == jnp.float32 else {})
         if tnt_block_size == "auto":
             tnt_block_size = auto_block_size(ma.n)
         self._block_size = tnt_block_size
@@ -483,10 +507,19 @@ class JaxGibbs(SamplerBackend):
 
     def _make_chunk_fn(self):
         fields = self._record_fields
+        casts = self._record_casts
+
+        def rec_of(st):
+            # transport casts happen on device, inside the scan, so the
+            # chunk's record buffers are narrow before they ever cross
+            # to host (record="compact")
+            return tuple(
+                getattr(st, f).astype(casts[f]) if f in casts
+                else getattr(st, f) for f in fields)
 
         def one_chain(state, chain_key, offset, length):
             def body(st, i):
-                rec = tuple(getattr(st, f) for f in fields)
+                rec = rec_of(st)
                 st = self._sweep(st, random.fold_in(chain_key, offset + i))
                 return st, rec
 
@@ -501,7 +534,7 @@ class JaxGibbs(SamplerBackend):
             # outer scan over sweeps; each step advances every chain via
             # the batched sweep (the Pallas TNT path)
             def body(sts, i):
-                rec = tuple(getattr(sts, f) for f in fields)
+                rec = rec_of(sts)
                 ki = jax.vmap(
                     lambda k: random.fold_in(k, offset + i))(keys)
                 sts = self._batched_sweep(sts, ki)
@@ -578,25 +611,42 @@ class JaxGibbs(SamplerBackend):
         # carried forward from run_stats.json instead of resetting
         n_reinits = (int(spool.load_run_stats().get("n_reinits", 0))
                      if spool is not None and resume else 0)
-        while done < niter:
-            length = min(self.chunk_size, niter - done)
-            state, recs = self._chunk_fn(state, keys,
-                                         start_sweep + done, length=length)
-            host = jax.device_get(recs)
-            done += length
-            if reinit_diverged:
-                state, n_bad = self._reinit_diverged(
-                    state, seed=seed + 7919 * (start_sweep + done))
-                n_reinits += n_bad
+
+        def flush(recs, chunk_state, sweep_end):
+            host = self._materialize(jax.device_get(recs))
             if spool is not None:
                 spool.append(
                     {f: self._trim(f, np.swapaxes(host[i], 0, 1))
                      for i, f in enumerate(fields)},
-                    state, start_sweep + done,
+                    chunk_state, sweep_end,
                     run_stats=({"n_reinits": n_reinits}
                                if reinit_diverged else None))
             else:
                 records.append(host)
+
+        pending = None
+        while done < niter:
+            length = min(self.chunk_size, niter - done)
+            state, recs = self._chunk_fn(state, keys,
+                                         start_sweep + done, length=length)
+            done += length
+            if reinit_diverged:
+                # divergence scan needs the post-chunk state on host, so
+                # this path stays sequential (flush after reinit so the
+                # spool checkpoint carries the repaired state + count)
+                state, n_bad = self._reinit_diverged(
+                    state, seed=seed + 7919 * (start_sweep + done))
+                n_reinits += n_bad
+                flush(recs, state, start_sweep + done)
+            else:
+                # double-buffer: dispatch chunk k+1 (async) before the
+                # blocking device->host pull of chunk k's records, so
+                # record transfer overlaps the next chunk's compute
+                if pending is not None:
+                    flush(*pending)
+                pending = (recs, state, start_sweep + done)
+        if pending is not None:
+            flush(*pending)
         if spool is not None:
             spool.close()
             from gibbs_student_t_tpu.utils.spool import load_spool
@@ -660,6 +710,17 @@ class JaxGibbs(SamplerBackend):
                 fr, cur),
             state, fresh)
         return state, n_bad
+
+    def _materialize(self, host):
+        """Undo the record-transport casts: the narrow wire dtypes
+        (record="compact") come back as float32 host arrays, so
+        downstream consumers (spool files, ChainResult, analysis) see
+        the same dtypes as a record="full" run."""
+        if not self._record_casts:
+            return list(host)
+        return [np.asarray(h, np.float32) if f in self._record_casts
+                else h
+                for f, h in zip(self._record_fields, host)]
 
     def _trim(self, field: str, arr: np.ndarray) -> np.ndarray:
         """Cut TOA padding (block padding and/or a pre-padded model's

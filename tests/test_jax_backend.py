@@ -107,9 +107,13 @@ def test_vmap_consistency(ma):
                "pspin": 0.00457, "theta_prior": "uniform"}),
 ])
 def test_all_models_run_finite(ma, model, kwargs):
-    """The five driver configurations of reference run_sims.py:89-107."""
+    """The five driver configurations of reference run_sims.py:89-107.
+
+    record="full" keeps the semantic spot checks (fixed alpha, z
+    identities) at bit-exact recording precision; the compact transport
+    has its own equivalence test below."""
     cfg = GibbsConfig(model=model, **kwargs)
-    gb = JaxGibbs(ma, cfg, nchains=4, chunk_size=10)
+    gb = JaxGibbs(ma, cfg, nchains=4, chunk_size=10, record="full")
     res = gb.sample(niter=20, seed=0)
     assert np.isfinite(res.chain).all()
     assert np.isfinite(res.bchain).all()
@@ -136,6 +140,28 @@ def test_resume_matches_unbroken_run(ma):
                         start_sweep=10)
     stitched = np.concatenate([first.chain, second.chain])
     np.testing.assert_array_equal(full.chain, stitched)
+
+
+def test_compact_record_matches_full(ma):
+    """record="compact" (the default) narrows only the device->host
+    transport: the sampled-parameter chains and z come back bit-identical
+    to record="full"; pout/b/alpha within their wire precision (f16 /
+    bf16). Host arrays are float32 either way."""
+    cfg = GibbsConfig(model="mixture", vary_df=True)
+    outs = {}
+    for mode in ("full", "compact"):
+        gb = JaxGibbs(ma, cfg, nchains=3, chunk_size=4, record=mode)
+        outs[mode] = gb.sample(niter=9, seed=11)
+    f, c = outs["full"], outs["compact"]
+    for arr in (c.chain, c.bchain, c.zchain, c.poutchain, c.alphachain):
+        assert arr.dtype == np.float32
+    np.testing.assert_array_equal(f.chain, c.chain)
+    np.testing.assert_array_equal(f.thetachain, c.thetachain)
+    np.testing.assert_array_equal(f.dfchain, c.dfchain)
+    np.testing.assert_array_equal(f.zchain, c.zchain)  # 0/1: lossless
+    np.testing.assert_allclose(f.poutchain, c.poutchain, atol=5e-4)
+    np.testing.assert_allclose(f.bchain, c.bchain, rtol=1e-2, atol=1e-6)
+    np.testing.assert_allclose(f.alphachain, c.alphachain, rtol=1e-2)
 
 
 def _posterior_gate(ma, cfg, niter_np=6000, burn_np=1000, thin_np=20,
@@ -198,7 +224,7 @@ def test_unrolled_chol_sweep_matches_lapack_path(ma, monkeypatch):
     outs = {}
     for flag in ("1", "0"):
         monkeypatch.setenv("GST_UNROLLED_CHOL", flag)
-        gb = JaxGibbs(ma, cfg, nchains=3, chunk_size=5)
+        gb = JaxGibbs(ma, cfg, nchains=3, chunk_size=5, record="full")
         res = gb.sample(niter=10, seed=123)
         outs[flag] = (np.asarray(res.chain), np.asarray(res.bchain))
     # identical draws up to f32 rounding: same algorithm, same keys

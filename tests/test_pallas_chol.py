@@ -144,7 +144,8 @@ def test_sweep_chains_identical_pallas_vs_expander(monkeypatch):
 
     def run(flag):
         monkeypatch.setenv("GST_PALLAS_CHOL", flag)
-        gb = JaxGibbs(ma, cfg, nchains=4, chunk_size=5)
+        # record="full": parity asserted on un-quantized chains
+        gb = JaxGibbs(ma, cfg, nchains=4, chunk_size=5, record="full")
         return gb.sample(niter=10, seed=0)
 
     r_exp = run("0")
