@@ -255,8 +255,11 @@ def schur_eliminate(Sigma_ss, Sigma_sv, Sigma_vv, rhs_s, rhs_v,
     Ainv_rs = w[..., :, -1]
     quad_s = jnp.sum(rhs_s * Ainv_rs, axis=-1)
     mT = jnp.swapaxes(Sigma_sv, -1, -2)
-    S0 = Sigma_vv - mT @ w[..., :, :-1]
-    rt = rhs_v - (mT @ Ainv_rs[..., None])[..., 0]
+    # full f32 passes: TPU's default matmul precision is bfloat16-input
+    # and the eliminated block feeds every hyper-MH likelihood this sweep
+    hi = jax.lax.Precision.HIGHEST
+    S0 = Sigma_vv - jnp.matmul(mT, w[..., :, :-1], precision=hi)
+    rt = rhs_v - jnp.matmul(mT, Ainv_rs[..., None], precision=hi)[..., 0]
     return S0, rt, quad_s, logdetA
 
 

@@ -61,15 +61,21 @@ def _tnt_kernel(T_ref, w_ref, wy_ref, tnt_ref, d_ref, *, chain_tile: int):
     T = T_ref[:]                       # (B, mp) — shared across the tile
     # contract axis 0 (TOAs) of both operands: (B, mp) x (B, mp) -> (mp, mp)
     contract = (((0,), (0,)), ((), ()))
+    # HIGHEST: full f32 passes on the MXU — the default truncates inputs
+    # to bfloat16, and TNT/d noise biases the hyper posteriors (see
+    # ops/tnt.py module docstring)
+    hi = jax.lax.Precision.HIGHEST
     for j in range(chain_tile):        # static unroll over the chain tile
         Tw = T * w_ref[j, :][:, None]  # weighted basis, registers/VMEM only
         tnt_ref[j] += jax.lax.dot_general(
-            T, Tw, contract, preferred_element_type=jnp.float32)
+            T, Tw, contract, preferred_element_type=jnp.float32,
+            precision=hi)
         # keep the matvec 2-D (1, B) @ (B, mp): a 1-D lhs emits a
         # dot_dimension_numbers attribute this libtpu's Mosaic fails to
         # parse (verified on TPU v5e: "[1, 1]" for lhs_non_contracting)
         d_ref[j:j + 1] += jnp.dot(wy_ref[j:j + 1, :], T,
-                                  preferred_element_type=jnp.float32)
+                                  preferred_element_type=jnp.float32,
+                                  precision=hi)
 
 
 def tnt_batched_pallas(T, y, nvec, block_size: int = 256,

@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""One-shot ON-CHIP posterior gate: the north-star acceptance criterion
+run on real TPU hardware with the production kernels active.
+
+The CPU test suite's posterior gates (tests/test_jax_backend.py,
+tests/test_j1713.py) exercise the expander paths — conftest forces the
+cpu platform, so the Pallas lane-batched Cholesky and fused TNT kernels
+never face a statistical test there. This script runs the same
+oracle-vs-kernel comparison on the device: the J1713+0747 workload
+(BASELINE configs 1/3), 1024 chains through the default TPU kernel
+stack, against the single-chain NumPy oracle on the host, gated on
+posterior-mean gaps (< 0.33 posterior sd) and gross-error KS
+(p > 0.001) per hyperparameter — the same calibrated thresholds as the
+test-suite gates (KS on thinned MCMC draws is a gross-error detector
+only; see tests/test_jax_backend.py::_posterior_gate).
+
+Single process, budgets itself, exits cleanly (relay discipline — see
+docs/PERFORMANCE.md operational notes).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="artifacts/tpu_gate_r02.json")
+    ap.add_argument("--niter-np", type=int, default=6000)
+    ap.add_argument("--burn-np", type=int, default=1000)
+    ap.add_argument("--thin-np", type=int, default=20)
+    ap.add_argument("--nchains", type=int, default=1024)
+    ap.add_argument("--niter-j", type=int, default=500)
+    ap.add_argument("--burn-j", type=int, default=150)
+    ap.add_argument("--thin-j", type=int, default=20)
+    ap.add_argument("--seed", type=int, default=123)
+    args = ap.parse_args()
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.dirname(here))
+
+    import numpy as np
+    from scipy import stats
+
+    import jax
+
+    out: dict = {"params": {}}
+
+    def flush():
+        with open(args.out, "w") as fh:
+            json.dump(out, fh, indent=1)
+
+    t0 = time.perf_counter()
+    devs = jax.devices()
+    out["device"] = str(devs)
+    out["backend"] = jax.default_backend()
+    print(f"[liveness] {devs} ({time.perf_counter() - t0:.1f}s)",
+          flush=True)
+    flush()
+
+    import bench as bench_mod
+    from gibbs_student_t_tpu.backends import JaxGibbs, NumpyGibbs
+    from gibbs_student_t_tpu.config import GibbsConfig
+
+    ma = bench_mod.build(130, 30)
+    cfg = GibbsConfig(model="mixture", vary_df=True, theta_prior="beta")
+
+    t0 = time.perf_counter()
+    rng = np.random.default_rng(args.seed)
+    gb_n = NumpyGibbs(ma, cfg)
+    res_n = gb_n.sample(ma.x_init(rng), args.niter_np, seed=args.seed)
+    out["oracle_seconds"] = round(time.perf_counter() - t0, 1)
+    print(f"[oracle] {args.niter_np} sweeps in {out['oracle_seconds']}s",
+          flush=True)
+    flush()
+
+    t0 = time.perf_counter()
+    gb_j = JaxGibbs(ma, cfg, nchains=args.nchains, chunk_size=100)
+    res_j = gb_j.sample(niter=args.niter_j, seed=args.seed + 1)
+    out["kernel_seconds"] = round(time.perf_counter() - t0, 1)
+    out["kernel_config"] = {
+        "nchains": args.nchains, "niter": args.niter_j,
+        "pallas_chol": os.environ.get("GST_PALLAS_CHOL", "auto"),
+        "use_pallas_tnt": gb_j._use_pallas,
+        "hyper_schur": gb_j._schur is not None,
+    }
+    print(f"[kernel] {args.niter_j} sweeps x {args.nchains} chains in "
+          f"{out['kernel_seconds']}s", flush=True)
+
+    sub = np.random.default_rng(0)
+    failures = []
+    for pi, name in enumerate(ma.param_names):
+        a = res_n.chain[args.burn_np:, pi][::args.thin_np]
+        b = res_j.chain[args.burn_j::args.thin_j, :, pi].ravel()
+        if b.size > 4000:  # keep the two-sample KS comparably sized
+            b = sub.choice(b, 4000, replace=False)
+        sd = max(a.std(), b.std(), 1e-12)
+        gap = float(abs(a.mean() - b.mean()) / sd)
+        ks = stats.ks_2samp(a, b)
+        ok = bool(gap <= 0.33 and ks.pvalue >= 0.001)
+        out["params"][name] = {
+            "oracle_mean": round(float(a.mean()), 4),
+            "kernel_mean": round(float(b.mean()), 4),
+            "gap_sd": round(gap, 3), "ks_p": float(ks.pvalue), "ok": ok,
+        }
+        if not ok:
+            failures.append(name)
+    a = res_n.thetachain[args.burn_np::args.thin_np]
+    b = res_j.thetachain[args.burn_j::args.thin_j].ravel()
+    sd = max(a.std(), b.std(), 1e-12)
+    out["theta_gap_sd"] = round(float(abs(a.mean() - b.mean()) / sd), 3)
+    out["ok"] = bool(not failures and out["theta_gap_sd"] < 0.5)
+    out["failures"] = failures
+    flush()
+    print(json.dumps(out["params"], indent=1), flush=True)
+    print(f"[gate] ok={out['ok']} theta_gap={out['theta_gap_sd']}",
+          flush=True)
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
