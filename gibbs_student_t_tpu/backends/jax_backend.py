@@ -90,6 +90,16 @@ _COMPACT_CASTS = {"z": jnp.uint8, "pout": jnp.float16,
                   "b": jnp.bfloat16, "alpha": jnp.bfloat16}
 
 
+def record_tuple(st, fields, casts):
+    """One sweep's record in wire dtypes — shared by the single-model
+    chunk functions below and the ensemble's sharded chunk
+    (parallel/ensemble.py), so the compact transport rules live in
+    exactly one place (``_COMPACT_CASTS``)."""
+    return tuple(
+        getattr(st, f).astype(casts[f]) if f in casts else getattr(st, f)
+        for f in fields)
+
+
 class JaxGibbs(SamplerBackend):
     """Many-chain Gibbs sampler; ``sample`` returns ``(niter, nchains, ...)``
     chains like a stacked version of the reference's attribute arrays."""
@@ -513,9 +523,7 @@ class JaxGibbs(SamplerBackend):
             # transport casts happen on device, inside the scan, so the
             # chunk's record buffers are narrow before they ever cross
             # to host (record="compact")
-            return tuple(
-                getattr(st, f).astype(casts[f]) if f in casts
-                else getattr(st, f) for f in fields)
+            return record_tuple(st, fields, casts)
 
         def one_chain(state, chain_key, offset, length):
             def body(st, i):

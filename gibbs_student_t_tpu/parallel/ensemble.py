@@ -27,9 +27,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from gibbs_student_t_tpu.backends.base import ChainResult
 from gibbs_student_t_tpu.backends.jax_backend import (
-    _RECORD_FIELDS,
     ChainState,
     JaxGibbs,
+    record_tuple,
 )
 from gibbs_student_t_tpu.config import GibbsConfig
 from gibbs_student_t_tpu.models.pta import ModelArrays
@@ -134,11 +134,13 @@ class EnsembleGibbs:
 
     def __init__(self, mas: Sequence[ModelArrays], config: GibbsConfig,
                  nchains: int = 64, mesh: Optional[Mesh] = None,
-                 dtype=jnp.float32, chunk_size: int = 50):
+                 dtype=jnp.float32, chunk_size: int = 50,
+                 record: str = "compact"):
         self.npulsars = len(mas)
         self.nchains = nchains
         self.mesh = mesh
         self.chunk_size = chunk_size
+        self.record = record
         self.stacked = stack_model_arrays(mas)
         # template backend: holds config/dtype and the sweep kernel; its own
         # frozen model is pulsar 0 (never used when ma is passed explicitly)
@@ -149,7 +151,7 @@ class EnsembleGibbs:
         # stress path, not the ensemble's).
         self.template = JaxGibbs(_localize_names(mas[0]), config,
                                  nchains=nchains, dtype=dtype,
-                                 chunk_size=chunk_size,
+                                 chunk_size=chunk_size, record=record,
                                  tnt_block_size=None, use_pallas=False)
         self.dtype = dtype
         self._step = self._build_step()
@@ -188,9 +190,14 @@ class EnsembleGibbs:
             if np.issubdtype(np.asarray(a).dtype, np.floating) else a,
             self.stacked)
 
+        fields = template._record_fields
+        casts = template._record_casts
+
         def local_chunk(ma_p, state, chain_key, offset, length):
             def body(st, i):
-                rec = tuple(getattr(st, f) for f in _RECORD_FIELDS)
+                # same compact device-side transport casts as the
+                # single-model backend (backends/jax_backend.py)
+                rec = record_tuple(st, fields, casts)
                 st = template._sweep(
                     st, random.fold_in(chain_key, offset + i), ma=ma_p)
                 return st, rec
@@ -213,8 +220,7 @@ class EnsembleGibbs:
             specs_state = jax.tree.map(lambda _: P("pulsar", "chain"),
                                        states)
             key_spec = P("pulsar", "chain")
-            out_rec_spec = tuple(P("pulsar", "chain")
-                                 for _ in _RECORD_FIELDS)
+            out_rec_spec = tuple(P("pulsar", "chain") for _ in fields)
             # check_vma=False: the sweep body is collective-free (chains
             # and pulsars are independent), and the vma checker rejects
             # unvarying fori_loop carries (fresh accept counters) inside a
@@ -239,23 +245,26 @@ class EnsembleGibbs:
         keys = self.chain_keys(seed)
         records = []
         done = 0
+        pending = None
         while done < niter:
             length = min(self.chunk_size, niter - done)
             state, recs = self._step(state, keys, start_sweep + done,
                                      length=length)
-            records.append(jax.device_get(recs))
             done += length
+            # double-buffer: next chunk dispatches before the blocking
+            # pull of the previous one (same as JaxGibbs.sample)
+            if pending is not None:
+                records.append(
+                    self.template._materialize(jax.device_get(pending)))
+            pending = recs
+        if pending is not None:
+            records.append(
+                self.template._materialize(jax.device_get(pending)))
         self.last_state = state
 
         # (P, C, len, ...) -> (len, P, C, ...)
         cols = {
             f: np.concatenate([np.moveaxis(r[i], 2, 0) for r in records])
-            for i, f in enumerate(_RECORD_FIELDS)
+            for i, f in enumerate(self.template._record_fields)
         }
-        return ChainResult(
-            chain=cols["x"], bchain=cols["b"], zchain=cols["z"],
-            thetachain=cols["theta"], alphachain=cols["alpha"],
-            poutchain=cols["pout"], dfchain=cols["df"],
-            stats={"acc_white": cols["acc_white"],
-                   "acc_hyper": cols["acc_hyper"]},
-        )
+        return self.template._to_result(cols)

@@ -228,3 +228,25 @@ def test_ensemble_resume_matches_unbroken():
                        start_sweep=5)
     stitched = np.concatenate([first.chain, rest.chain])
     np.testing.assert_allclose(stitched, full, rtol=1e-6, atol=1e-7)
+
+
+def test_ensemble_compact_record_matches_full():
+    """The ensemble's compact record transport (same wire casts as the
+    single-model backend) reproduces full-precision recording: x/z
+    bit-exact, pout/b/alpha within wire precision."""
+    mas = [make_demo_pta(make_demo_pulsar(seed=70 + i, n=24)[0],
+                         components=4).frozen() for i in range(2)]
+    cfg = GibbsConfig(model="mixture")
+    outs = {}
+    for mode in ("full", "compact"):
+        ens = EnsembleGibbs(mas, cfg, nchains=3, chunk_size=3,
+                            record=mode)
+        outs[mode] = ens.sample(niter=7, seed=4)
+    f, c = outs["full"], outs["compact"]
+    assert c.bchain.dtype == np.float32
+    np.testing.assert_array_equal(f.chain, c.chain)
+    np.testing.assert_array_equal(f.zchain, c.zchain)
+    np.testing.assert_array_equal(f.dfchain, c.dfchain)
+    np.testing.assert_allclose(f.poutchain, c.poutchain, atol=5e-4)
+    np.testing.assert_allclose(f.bchain, c.bchain, rtol=1e-2, atol=1e-6)
+    np.testing.assert_allclose(f.alphachain, c.alphachain, rtol=1e-2)

@@ -96,3 +96,64 @@ def test_backend_light_record_mode():
     assert res.dfchain.shape[0] == 10
     assert res.zchain.size == 0 and res.poutchain.size == 0
     assert res.stats["acc_hyper"].shape[0] == 10
+
+
+def _dot_precisions(fn, *args):
+    """All dot_general precisions in fn's jaxpr, recursing into scans."""
+    import jax
+
+    found = []
+
+    def walk(jaxpr):
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name == "dot_general":
+                found.append(eqn.params.get("precision"))
+            for v in eqn.params.values():
+                if hasattr(v, "jaxpr"):
+                    walk(v.jaxpr)
+
+    walk(jax.make_jaxpr(fn)(*args).jaxpr)
+    return found
+
+
+def test_likelihood_matmuls_pinned_to_highest_precision():
+    """Regression guard for the TPU bf16-matmul posterior bias
+    (artifacts/tpu_gate_r02.json history): every contraction feeding the
+    marginalized likelihood must carry Precision.HIGHEST — XLA's default
+    on TPU truncates f32 matmul inputs to bfloat16, which measurably
+    biased the red-noise gamma posterior on hardware."""
+    import jax.numpy as jnp
+    from jax.lax import Precision
+
+    from gibbs_student_t_tpu.ops.linalg import schur_eliminate
+    from gibbs_student_t_tpu.ops.tnt import matvec_blocked, tnt_products
+
+    T = jnp.ones((32, 5))
+    y = jnp.ones(32)
+    nv = jnp.ones(32)
+    cases = [
+        (lambda: _dot_precisions(
+            lambda T, y, nv: tnt_products(T, y, nv), T, y, nv)),
+        (lambda: _dot_precisions(
+            lambda T, y, nv: tnt_products(T, y, nv, 16), T, y, nv)),
+        (lambda: _dot_precisions(
+            lambda T, b: matvec_blocked(T, b), T, jnp.ones(5))),
+        (lambda: _dot_precisions(
+            lambda T, b: matvec_blocked(T, b, 16), T, jnp.ones(5))),
+    ]
+    for case in cases:
+        ps = case()
+        assert ps, "expected at least one dot_general"
+        for p in ps:
+            assert p == (Precision.HIGHEST, Precision.HIGHEST), ps
+    # schur_eliminate: its two explicit matmuls are HIGHEST (its
+    # triangular solves expand to non-dot ops at this size)
+    rng = np.random.default_rng(0)
+    A = jnp.asarray(rng.standard_normal((6, 6)) + 10 * np.eye(6),
+                    jnp.float32)
+    S = A @ A.T
+    ps = _dot_precisions(
+        lambda S: schur_eliminate(S[:4, :4], S[:4, 4:], S[4:, 4:],
+                                  jnp.ones(4), jnp.ones(2)), S)
+    hi = [p for p in ps if p == (Precision.HIGHEST, Precision.HIGHEST)]
+    assert len(hi) >= 2, ps

@@ -49,6 +49,7 @@ def main():
     import jax
 
     out: dict = {"params": {}}
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
 
     def flush():
         with open(args.out, "w") as fh:
