@@ -186,7 +186,8 @@ class NumpyGibbs(SamplerBackend):
             p_out = _norm_pdf(resid, np.sqrt(self._alpha * nvec0))
             top = self._theta * p_out
         bot = top + (1 - self._theta) * p_in
-        q = top / bot
+        with np.errstate(invalid="ignore"):  # 0/0 -> NaN -> 1 below
+            q = top / bot
         q[np.isnan(q)] = 1.0
         self._pout = q
         return (rng.random(self.ma.n) < np.minimum(q, 1.0)).astype(np.float64)

@@ -129,7 +129,9 @@ class EnsembleGibbs:
     Each pulsar keeps an independent parameter vector (the model family has
     no cross-pulsar terms); sampling runs ``shard_map``-ed over
     ``mesh = ('pulsar', 'chain')``, falling back to plain ``vmap`` without
-    a mesh.
+    a mesh. ``record`` takes the same modes as ``JaxGibbs``
+    ("compact"/"full"/"light"), with the identical wire casts and
+    double-buffered device->host flushes.
     """
 
     def __init__(self, mas: Sequence[ModelArrays], config: GibbsConfig,

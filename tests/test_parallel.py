@@ -250,3 +250,16 @@ def test_ensemble_compact_record_matches_full():
     np.testing.assert_allclose(f.poutchain, c.poutchain, atol=5e-4)
     np.testing.assert_allclose(f.bchain, c.bchain, rtol=1e-2, atol=1e-6)
     np.testing.assert_allclose(f.alphachain, c.alphachain, rtol=1e-2)
+
+
+def test_ensemble_light_record_mode():
+    """record="light" drops the per-TOA chains from the ensemble's
+    transfer too (the stress-scale transport knob)."""
+    mas = [make_demo_pta(make_demo_pulsar(seed=80 + i, n=24)[0],
+                         components=4).frozen() for i in range(2)]
+    ens = EnsembleGibbs(mas, GibbsConfig(model="mixture"), nchains=2,
+                        chunk_size=3, record="light")
+    res = ens.sample(niter=5, seed=1)
+    assert res.chain.shape[:3] == (5, 2, 2)
+    assert res.zchain.size == 0 and res.poutchain.size == 0
+    assert res.stats["acc_hyper"].shape[0] == 5

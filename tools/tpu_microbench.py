@@ -17,7 +17,9 @@ import sys
 
 import numpy as np
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))  # repo root for the package
+sys.path.insert(0, _HERE)
 from benchlib import timed_scan as _timed_scan  # noqa: E402
 
 
@@ -118,6 +120,22 @@ def main():
         return quad - logdet
 
     timed_scan(hyper_eval, (Sigma, rhs), reps, "hyper_eval_once", results)
+
+    # --- Pallas lane-batched kernels (the production TPU linalg) ------
+    from gibbs_student_t_tpu.ops.pallas_chol import (
+        chol_fused_lane,
+        tri_solve_T_lane,
+    )
+
+    for mm in (m, max(8, m - 14)):  # full and Schur-eliminated sizes
+        Sm = Sigma[:, :mm, :mm] + 5.0 * jnp.eye(mm, dtype=jnp.float32)
+        rm = rhs[:, :mm]
+        timed_scan(lambda S, r: chol_fused_lane(S, r)[1:], (Sm, rm),
+                   reps, f"pallas_chol_quadld({C},{mm})", results)
+        timed_scan(lambda S, r: chol_fused_lane(S, r), (Sm, rm),
+                   reps, f"pallas_chol_with_L({C},{mm})", results)
+    timed_scan(lambda L_, r: tri_solve_T_lane(L_, r), (L, rhs),
+               reps, f"pallas_backsolve({C},{m})", results)
 
     if args.out:
         with open(args.out, "w") as fh:
