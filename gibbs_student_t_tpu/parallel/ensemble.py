@@ -242,6 +242,8 @@ class EnsembleGibbs:
     def sample(self, niter: int, seed: int = 0,
                state: Optional[ChainState] = None,
                start_sweep: int = 0) -> ChainResult:
+        if niter < 1:
+            raise ValueError(f"niter must be >= 1, got {niter}")
         if state is None:
             state = self.init_state(seed)
         keys = self.chain_keys(seed)
