@@ -81,9 +81,13 @@ def probe_device(probe_timeout: float, retries: int,
 
     for i in range(retries):
         rec = {"attempt": i + 1, "unix_time": round(time.time(), 1)}
-        result_path = os.path.abspath(f".bench_probe_result_{os.getpid()}_{i}")
-        # a prior run's abandoned child (same recycled pid) may have left —
-        # or may yet write — a result here; never read a stale verdict
+        # unique per attempt across runs: a prior run's abandoned child
+        # (even one with this recycled pid) can wake up and write its
+        # stale verdict at any time — a pid-only name could be adopted
+        # as fresh. time_ns makes collision impossible; cleanup below
+        # only guards against this very process re-looping.
+        result_path = os.path.abspath(
+            f".bench_probe_result_{os.getpid()}_{i}_{time.time_ns()}")
         _cleanup_probe_files(result_path)
         errlog = open(result_path + ".stderr", "w")
         t0 = time.perf_counter()
@@ -405,7 +409,8 @@ def main(argv=None):
                                    ("no-pallas-chol fallback",
                                     {"GST_PALLAS_CHOL": "0"})):
             out_path = os.path.abspath(
-                f".bench_child_{os.getpid()}_{attempt.split()[0]}.out")
+                f".bench_child_{os.getpid()}_{attempt.split()[0]}_"
+                f"{time.time_ns()}.out")
             with open(out_path, "w") as out_fh:
                 proc = subprocess.Popen(child_args,
                                         env={**env, **extra_env},

@@ -18,6 +18,15 @@ import numpy as np
 from gibbs_student_t_tpu.config import GibbsConfig
 from gibbs_student_t_tpu.models.pta import ModelArrays
 
+#: ``ChainResult.stats`` keys that are run-level metadata rather than
+#: per-sweep arrays: ``burn`` passes them through untouched and
+#: ``select_pulsar`` reduces them instead of slicing a sweep axis.
+#: ``n_toa`` is the per-pulsar real TOA count of a (padded) ensemble run;
+#: ``n_reinits`` the cumulative diverged-chain re-inits; ``record_mode``
+#: the recording mode the run used (so compact-transport quantization of
+#: b/alpha/pout is discoverable downstream).
+META_STATS = ("n_toa", "n_reinits", "record_mode")
+
 
 @dataclasses.dataclass
 class ChainResult:
@@ -43,25 +52,40 @@ class ChainResult:
                 for f in dataclasses.fields(self)
                 if f.name not in ("stats",)
             },
-            # per-sweep stats stay sweep-aligned; run-level scalars (e.g.
-            # n_reinits) pass through untouched
-            stats={k: (v[nburn:] if np.ndim(v) else v)
+            # per-sweep stats stay sweep-aligned; run-level metadata
+            # (META_STATS) passes through untouched
+            stats={k: (v[nburn:] if np.ndim(v) and k not in META_STATS
+                       else v)
                    for k, v in self.stats.items()},
         )
 
     def select_pulsar(self, i: int) -> "ChainResult":
         """Slice one pulsar out of an ensemble result (arrays shaped
         ``(niter, npulsars, nchains, ...)``, parallel/ensemble.py) into
-        the ordinary ``(niter, nchains, ...)`` form drivers save."""
-        return ChainResult(
-            **{
-                f.name: getattr(self, f.name)[:, i]
-                for f in dataclasses.fields(self)
-                if f.name not in ("stats",)
-            },
-            stats={k: (v[:, i] if np.ndim(v) >= 2 else v)
-                   for k, v in self.stats.items()},
-        )
+        the ordinary ``(niter, nchains, ...)`` form drivers save.
+
+        A heterogeneous ensemble pads every pulsar's TOA axis to the
+        maximum so the stacked arrays are rectangular; the per-pulsar
+        real counts ride along as ``stats['n_toa']``, and the slice cuts
+        the padded suffix back off the per-TOA chains here — saved trees
+        are ``(niter, nchains, n_i)``, exactly the reference's per-pulsar
+        layout (reference run_sims.py:118-124)."""
+        fields = {
+            f.name: getattr(self, f.name)[:, i]
+            for f in dataclasses.fields(self)
+            if f.name not in ("stats",)
+        }
+        stats = {k: (v if k in META_STATS or np.ndim(v) < 2 else v[:, i])
+                 for k, v in self.stats.items()}
+        n_toa = self.stats.get("n_toa")
+        if n_toa is not None:
+            n_i = int(np.asarray(n_toa)[i])
+            for name in ("zchain", "alphachain", "poutchain"):
+                arr = fields[name]
+                if arr.size and arr.shape[-1] > n_i:
+                    fields[name] = arr[..., :n_i]
+            stats["n_toa"] = np.asarray(n_i)
+        return ChainResult(**fields, stats=stats)
 
     def save(self, outdir: str) -> None:
         """Persist in the reference's on-disk layout

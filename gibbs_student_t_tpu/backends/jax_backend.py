@@ -100,6 +100,54 @@ def record_tuple(st, fields, casts):
         for f in fields)
 
 
+def chunked_sweep_loop(state, niter, chunk_size, start_sweep,
+                       step_fn, flush_fn, reinit_fn=None, n_reinits=0):
+    """The chunk-orchestration loop shared by ``JaxGibbs.sample`` and
+    ``EnsembleGibbs.sample`` (parallel/ensemble.py) so the flush
+    machinery cannot drift between them.
+
+    ``step_fn(state, offset, length) -> (state, recs)`` advances one
+    chunk; ``flush_fn(recs, chunk_state, sweep_end, n_reinits)`` moves a
+    chunk's records to host (spool or in-memory); ``reinit_fn(state,
+    sweep_end) -> (state, n_bad)``, when given, repairs diverged chains
+    at each chunk boundary. Without ``reinit_fn``, flushes are
+    double-buffered: chunk k+1 is dispatched before the blocking pull of
+    chunk k's records, overlapping transfer with compute (crash window:
+    up to two chunks — see ``JaxGibbs.sample``). With it, flushes are
+    sequential (the divergence scan needs each post-chunk state on
+    host). Returns ``(state, n_reinits)``."""
+    done = 0
+    pending = None
+    while done < niter:
+        length = min(chunk_size, niter - done)
+        state, recs = step_fn(state, start_sweep + done, length)
+        done += length
+        if reinit_fn is not None:
+            state, n_bad = reinit_fn(state, start_sweep + done)
+            n_reinits += n_bad
+            flush_fn(recs, state, start_sweep + done, n_reinits)
+        else:
+            if pending is not None:
+                flush_fn(*pending, n_reinits)
+            pending = (recs, state, start_sweep + done)
+    if pending is not None:
+        flush_fn(*pending, n_reinits)
+    return state, n_reinits
+
+
+def merge_reinit(state, bad, fresh, batch_ndim: int):
+    """Replace the ``bad``-masked leading-axis entries of ``state`` with
+    ``fresh`` draws; healthy entries stay bitwise identical. ``bad`` has
+    ``batch_ndim`` leading batch axes ((nchains,) for the single-model
+    backend, (npulsars, nchains) for ensembles)."""
+    mask = jnp.asarray(bad)
+    return jax.tree.map(
+        lambda cur, fr: jnp.where(
+            mask.reshape(mask.shape + (1,) * (cur.ndim - batch_ndim)),
+            fr, cur),
+        state, fresh)
+
+
 class JaxGibbs(SamplerBackend):
     """Many-chain Gibbs sampler; ``sample`` returns ``(niter, nchains, ...)``
     chains like a stacked version of the reference's attribute arrays."""
@@ -140,7 +188,13 @@ class JaxGibbs(SamplerBackend):
         with ``jitter>0`` the regularization lands on the sub-blocks'
         own equilibrated diagonals rather than full Sigma's, a same-order
         perturbation. ``"auto"`` enables it when at least 8 static
-        columns exist; ``True`` raises if the split is degenerate."""
+        columns exist; ``True`` raises if the split is degenerate.
+
+        Env overrides (``GST_HYPER_SCHUR``, ``GST_PALLAS_CHOL``,
+        ``GST_UNROLLED_CHOL``) are consulted at construction/trace time
+        and baked into the compiled sweep: set them *before* constructing
+        the backend; flipping them afterwards does not affect an existing
+        instance (ops/linalg.py ``_pallas_chol_mode``)."""
         super().__init__(ma, config)
         self.nchains = nchains
         self.dtype = dtype
@@ -148,6 +202,7 @@ class JaxGibbs(SamplerBackend):
         if record not in ("full", "compact", "light"):
             raise ValueError("record must be 'full', 'compact' or "
                              f"'light', got {record!r}")
+        self._record_mode = record
         self._record_fields = (_RECORD_FIELDS if record != "light" else
                                ("x", "theta", "df", "acc_white", "acc_hyper"))
         # compact transport only applies to float32 runs: an explicit
@@ -596,7 +651,18 @@ class JaxGibbs(SamplerBackend):
         streams to native spool files + a state checkpoint (utils/spool.py)
         and host memory stays O(chunk) instead of O(niter).
         ``reinit_diverged`` re-draws numerically dead chains from the prior
-        at chunk boundaries (count reported in ``stats['n_reinits']``)."""
+        at chunk boundaries (count reported in ``stats['n_reinits']``).
+
+        Record flushes are double-buffered: chunk k's device->host pull
+        happens only after chunk k+1 is dispatched, overlapping transfer
+        with the next chunk's compute (the ~30 MB/s relay link otherwise
+        gates the sweep, docs/PERFORMANCE.md). The costs (ADVICE r2): a
+        crash can lose up to TWO chunks of spooled progress instead of
+        one, and two chunks of record buffers are live on device at once
+        — size ``chunk_size`` accordingly at stress scale.
+        ``reinit_diverged`` runs flush sequentially instead (its
+        divergence scan needs each post-chunk state on host), restoring
+        the one-chunk crash window at the cost of the overlap."""
         if niter < 1:
             raise ValueError(f"niter must be >= 1, got {niter}")
         resume = start_sweep > 0
@@ -611,16 +677,16 @@ class JaxGibbs(SamplerBackend):
             # spool (truncated back to the checkpointed sweep first, in
             # case a crash left orphaned rows) instead of overwriting it.
             spool = ChainSpool(spool_dir, seed, resume=resume,
-                               resume_at=start_sweep if resume else None)
+                               resume_at=start_sweep if resume else None,
+                               record_mode=self.record_mode)
         records = []
-        done = 0
         fields = self._record_fields
         # cumulative across spool resumes: an interrupted run's count is
         # carried forward from run_stats.json instead of resetting
-        n_reinits = (int(spool.load_run_stats().get("n_reinits", 0))
-                     if spool is not None and resume else 0)
+        n_reinits0 = (int(spool.load_run_stats().get("n_reinits", 0))
+                      if spool is not None and resume else 0)
 
-        def flush(recs, chunk_state, sweep_end):
+        def flush(recs, chunk_state, sweep_end, n_reinits):
             host = self._materialize(jax.device_get(recs))
             if spool is not None:
                 spool.append(
@@ -632,29 +698,14 @@ class JaxGibbs(SamplerBackend):
             else:
                 records.append(host)
 
-        pending = None
-        while done < niter:
-            length = min(self.chunk_size, niter - done)
-            state, recs = self._chunk_fn(state, keys,
-                                         start_sweep + done, length=length)
-            done += length
-            if reinit_diverged:
-                # divergence scan needs the post-chunk state on host, so
-                # this path stays sequential (flush after reinit so the
-                # spool checkpoint carries the repaired state + count)
-                state, n_bad = self._reinit_diverged(
-                    state, seed=seed + 7919 * (start_sweep + done))
-                n_reinits += n_bad
-                flush(recs, state, start_sweep + done)
-            else:
-                # double-buffer: dispatch chunk k+1 (async) before the
-                # blocking device->host pull of chunk k's records, so
-                # record transfer overlaps the next chunk's compute
-                if pending is not None:
-                    flush(*pending)
-                pending = (recs, state, start_sweep + done)
-        if pending is not None:
-            flush(*pending)
+        state, n_reinits = chunked_sweep_loop(
+            state, niter, self.chunk_size, start_sweep,
+            step_fn=lambda st, off, ln: self._chunk_fn(st, keys, off,
+                                                       length=ln),
+            flush_fn=flush,
+            reinit_fn=((lambda st, end: self._reinit_diverged(
+                st, seed=seed + 7919 * end)) if reinit_diverged else None),
+            n_reinits=n_reinits0)
         if spool is not None:
             spool.close()
             from gibbs_student_t_tpu.utils.spool import load_spool
@@ -711,13 +762,8 @@ class JaxGibbs(SamplerBackend):
         n_bad = int(bad.sum())
         if n_bad == 0:
             return state, 0
-        fresh = self.init_state(seed=seed)
-        state = jax.tree.map(
-            lambda cur, fr: jnp.where(
-                jnp.asarray(bad).reshape((-1,) + (1,) * (cur.ndim - 1)),
-                fr, cur),
-            state, fresh)
-        return state, n_bad
+        return merge_reinit(state, bad, self.init_state(seed=seed),
+                            batch_ndim=1), n_bad
 
     def _materialize(self, host):
         """Undo the record-transport casts: the narrow wire dtypes
@@ -737,15 +783,28 @@ class JaxGibbs(SamplerBackend):
             return arr[..., :self._n_real]
         return arr
 
+    @property
+    def record_mode(self) -> str:
+        """Effective recording mode: 'compact' only when the narrow wire
+        casts are actually active (they are disabled for float64 runs,
+        which get bit-exact chains regardless of the requested mode)."""
+        if self._record_mode == "light":
+            return "light"
+        return "compact" if self._record_casts else "full"
+
     def _to_result(self, cols) -> ChainResult:
         empty = np.zeros((0,))
+        stats = {k: v for k, v in cols.items() if k.startswith("acc_")}
+        # quantized compact transport is discoverable downstream: host
+        # arrays are float32 either way, so the dtype alone cannot tell
+        # a ~2-3-digit b/alpha chain from a bit-exact one (ADVICE r2)
+        stats["record_mode"] = np.asarray(self.record_mode)
         return ChainResult(
             chain=cols.get("x", empty), bchain=cols.get("b", empty),
             zchain=cols.get("z", empty), thetachain=cols.get("theta", empty),
             alphachain=cols.get("alpha", empty),
             poutchain=cols.get("pout", empty), dfchain=cols.get("df", empty),
-            stats={k: v for k, v in cols.items()
-                   if k.startswith("acc_")},
+            stats=stats,
         )
 
 

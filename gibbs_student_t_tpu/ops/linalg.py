@@ -79,7 +79,15 @@ def _pallas_chol_mode():
     batches past ``_PALLAS_MIN_BATCH``; ``interpret`` forces it in
     interpreter mode (CPU testing); ``0``/``false``/empty disables; any
     other value forces it regardless of platform or batch size — the
-    same anything-truthy-is-on rule as ``GST_UNROLLED_CHOL``."""
+    same anything-truthy-is-on rule as ``GST_UNROLLED_CHOL``.
+
+    Read at TRACE time: the value is baked into a backend's jitted sweep
+    when that function is first traced, so set the env var *before*
+    constructing ``JaxGibbs`` (same for ``GST_HYPER_SCHUR``, snapshotted
+    in ``JaxGibbs.__init__``). Flipping it afterwards silently has no
+    effect on an existing backend instance — construct a new one for an
+    A/B (the pattern bench.py's fallback ladder uses: fresh process per
+    rung)."""
     env = os.environ.get("GST_PALLAS_CHOL", "auto")
     if env in ("0", "false", ""):
         return False, False, False
@@ -152,8 +160,13 @@ def _factor(S, rhs=None):
     the opt-in trace-unrolled kernel (``GST_UNROLLED_CHOL=1``)."""
     if _unrolled_wanted(S.shape[-1]):
         return chol_forward(S, rhs)
-    # a dead rhs (and its fused solve, and the unused L relayout) is
-    # eliminated by XLA when the caller only consumes logdet/u
+    # rhs=None callers pass zeros: on the XLA expander branch the dead
+    # solve (and unused L relayout) is DCE'd when only logdet/u are
+    # consumed; on the Pallas branch the fused forward solve lives inside
+    # one pallas_call and IS executed — measured in the hardware A/B as
+    # noise at the m=74 flagship shape (the factorization dominates), so
+    # no separate no-rhs kernel variant exists. Revisit if a profile ever
+    # shows precond_cholesky (the only zero-rhs caller) hot on TPU.
     L, logdet, u = _factor_fused(
         S, rhs if rhs is not None else jnp.zeros(S.shape[:-1], S.dtype))
     return L, logdet, (u if rhs is not None else None)

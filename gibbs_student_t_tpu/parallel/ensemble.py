@@ -29,6 +29,8 @@ from gibbs_student_t_tpu.backends.base import ChainResult
 from gibbs_student_t_tpu.backends.jax_backend import (
     ChainState,
     JaxGibbs,
+    chunked_sweep_loop,
+    merge_reinit,
     record_tuple,
 )
 from gibbs_student_t_tpu.config import GibbsConfig
@@ -143,6 +145,14 @@ class EnsembleGibbs:
         self.mesh = mesh
         self.chunk_size = chunk_size
         self.record = record
+        # per-pulsar REAL TOA counts, before stacking pads to n_max:
+        # ChainResult.select_pulsar uses these to cut the padding back
+        # off saved per-pulsar chains (reference run_sims.py:118-124
+        # saves exactly n rows per pulsar)
+        self.n_toa = np.array([
+            int(np.asarray(ma.row_mask).sum()) if ma.row_mask is not None
+            else ma.n
+            for ma in mas])
         self.stacked = stack_model_arrays(mas)
         # template backend: holds config/dtype and the sweep kernel; its own
         # frozen model is pulsar 0 (never used when ma is passed explicitly)
@@ -241,34 +251,112 @@ class EnsembleGibbs:
 
     def sample(self, niter: int, seed: int = 0,
                state: Optional[ChainState] = None,
-               start_sweep: int = 0) -> ChainResult:
+               start_sweep: int = 0,
+               spool_dir: Optional[str] = None,
+               reinit_diverged: bool = False) -> ChainResult:
+        """Run ``niter`` sweeps for every (pulsar, chain) population.
+
+        Feature parity with ``JaxGibbs.sample`` (VERDICT r2 weak #4):
+        ``spool_dir`` streams each chunk to native append-only spool
+        files + a state checkpoint so host memory stays O(chunk) and a
+        killed run resumes from the last chunk boundary;
+        ``reinit_diverged`` re-draws numerically dead (pulsar, chain)
+        populations from the prior at chunk boundaries (cumulative count
+        in ``stats['n_reinits']``). Spooled arrays keep the rectangular
+        padded TOA axis; ``select_pulsar`` trims via ``stats['n_toa']``.
+        """
         if niter < 1:
             raise ValueError(f"niter must be >= 1, got {niter}")
+        resume = start_sweep > 0
         if state is None:
             state = self.init_state(seed)
         keys = self.chain_keys(seed)
-        records = []
-        done = 0
-        pending = None
-        while done < niter:
-            length = min(self.chunk_size, niter - done)
-            state, recs = self._step(state, keys, start_sweep + done,
-                                     length=length)
-            done += length
-            # double-buffer: next chunk dispatches before the blocking
-            # pull of the previous one (same as JaxGibbs.sample)
-            if pending is not None:
-                records.append(
-                    self.template._materialize(jax.device_get(pending)))
-            pending = recs
-        if pending is not None:
-            records.append(
-                self.template._materialize(jax.device_get(pending)))
-        self.last_state = state
+        spool = None
+        if spool_dir is not None:
+            from gibbs_student_t_tpu.utils.spool import ChainSpool
 
-        # (P, C, len, ...) -> (len, P, C, ...)
-        cols = {
-            f: np.concatenate([np.moveaxis(r[i], 2, 0) for r in records])
-            for i, f in enumerate(self.template._record_fields)
-        }
-        return self.template._to_result(cols)
+            spool = ChainSpool(spool_dir, seed, resume=resume,
+                               resume_at=start_sweep if resume else None,
+                               record_mode=self.template.record_mode,
+                               extra_meta={"n_toa": self.n_toa.tolist()})
+        records = []
+        fields = self.template._record_fields
+        n_reinits0 = (int(spool.load_run_stats().get("n_reinits", 0))
+                      if spool is not None and resume else 0)
+
+        def flush(recs, chunk_state, sweep_end, n_reinits):
+            host = self.template._materialize(jax.device_get(recs))
+            if spool is not None:
+                # (P, C, len, ...) -> (len, P, C, ...): spool rows are
+                # sweeps, exactly like the single-model backend
+                spool.append(
+                    {f: np.moveaxis(host[i], 2, 0)
+                     for i, f in enumerate(fields)},
+                    chunk_state, sweep_end,
+                    run_stats=({"n_reinits": n_reinits}
+                               if reinit_diverged else None))
+            else:
+                records.append(host)
+
+        # double-buffering/sequential-reinit orchestration shared with
+        # JaxGibbs.sample (backends/jax_backend.py chunked_sweep_loop)
+        state, n_reinits = chunked_sweep_loop(
+            state, niter, self.chunk_size, start_sweep,
+            step_fn=lambda st, off, ln: self._step(st, keys, off,
+                                                   length=ln),
+            flush_fn=flush,
+            reinit_fn=((lambda st, end: self._reinit_diverged(
+                st, seed=seed + 7919 * end)) if reinit_diverged else None),
+            n_reinits=n_reinits0)
+        self.last_state = state
+        if spool is not None:
+            spool.close()
+            from gibbs_student_t_tpu.utils.spool import load_spool
+
+            res = load_spool(spool_dir)
+        else:
+            # (P, C, len, ...) -> (len, P, C, ...)
+            cols = {
+                f: np.concatenate([np.moveaxis(r[i], 2, 0)
+                                   for r in records])
+                for i, f in enumerate(fields)
+            }
+            res = self.template._to_result(cols)
+        res.stats["n_toa"] = self.n_toa
+        if reinit_diverged:
+            res.stats["n_reinits"] = np.asarray(n_reinits)
+        return res
+
+    # -- divergence recovery ------------------------------------------------
+
+    @staticmethod
+    @jax.jit
+    def _diverged_mask_device(state: ChainState):
+        """(npulsars, nchains) bool of numerically dead populations —
+        the ensemble form of JaxGibbs._diverged_mask_device (only the
+        mask crosses to host)."""
+        def bad(a):
+            return ~jnp.isfinite(a).reshape(
+                a.shape[0], a.shape[1], -1).all(axis=2)
+
+        return (bad(state.x) | bad(state.b) | bad(state.theta[..., None])
+                | bad(state.alpha) | bad(state.df[..., None])
+                | (state.alpha <= 0).reshape(
+                    state.alpha.shape[0], state.alpha.shape[1], -1
+                ).any(axis=2))
+
+    def diverged_mask(self, state: ChainState) -> np.ndarray:
+        state = jax.tree.map(jnp.asarray, state)
+        return np.asarray(self._diverged_mask_device(state))
+
+    def _reinit_diverged(self, state: ChainState, seed: int
+                         ) -> tuple:
+        """Replace dead (pulsar, chain) entries with fresh prior draws;
+        healthy populations are untouched bitwise (chain-level elastic
+        recovery, SURVEY.md §5)."""
+        bad = self.diverged_mask(state)
+        n_bad = int(bad.sum())
+        if n_bad == 0:
+            return state, 0
+        return merge_reinit(state, bad, self.init_state(seed=seed),
+                            batch_ndim=2), n_bad

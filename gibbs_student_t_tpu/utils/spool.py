@@ -30,12 +30,17 @@ class ChainSpool:
     """Directory of per-field spool files plus a rolling state checkpoint."""
 
     def __init__(self, path: str, seed: int, resume: bool = False,
-                 resume_at: Optional[int] = None):
+                 resume_at: Optional[int] = None,
+                 record_mode: Optional[str] = None,
+                 extra_meta: Optional[Dict] = None):
         """``resume=True`` appends to an existing spool directory (after a
         kill: ``load_spool_state`` -> ``sample(state=..., start_sweep=...,
         spool_dir=...)``) instead of truncating it. ``resume_at`` is the
         checkpointed sweep index being resumed from; rows past it (orphans
-        from a crash mid-append) are truncated away before appending."""
+        from a crash mid-append) are truncated away before appending.
+        ``record_mode`` is persisted in ``meta.json`` so a spooled run's
+        transport quantization (record="compact") stays discoverable; a
+        resume with a different mode is rejected."""
         from gibbs_student_t_tpu import native
 
         if not native.available():
@@ -46,6 +51,10 @@ class ChainSpool:
         self.seed = seed
         self.resume = resume
         self.resume_at = resume_at
+        self.record_mode = record_mode
+        # JSON-able run-level metadata (e.g. the ensemble's per-pulsar
+        # real TOA counts) replayed into ChainResult.stats by load_spool
+        self.extra_meta = extra_meta
         self._writers: Optional[Dict[str, object]] = None
         os.makedirs(path, exist_ok=True)
 
@@ -68,6 +77,12 @@ class ChainSpool:
                         f"resume record fields {sorted(records)} do not "
                         f"match the spooled run's {meta['fields']}; use "
                         "the same record= mode to resume")
+                prior_mode = meta.get("record_mode")
+                if (self.record_mode is not None and prior_mode is not None
+                        and prior_mode != self.record_mode):
+                    raise ValueError(
+                        f"resume record mode {self.record_mode!r} does not "
+                        f"match the spooled run's {prior_mode!r}")
                 base = meta.get("base", 0)
                 if self.resume_at is not None:
                     keep_rows = self.resume_at - base
@@ -79,7 +94,9 @@ class ChainSpool:
                 base = sweep - chunk_len
                 with open(meta_path, "w") as fh:
                     json.dump({"fields": sorted(records),
-                               "seed": self.seed, "base": base}, fh)
+                               "seed": self.seed, "base": base,
+                               "record_mode": self.record_mode,
+                               "extra": self.extra_meta or {}}, fh)
             self._writers = {
                 f: self._native.SpoolWriter(
                     os.path.join(self.path, f + ".spool"),
@@ -137,6 +154,10 @@ def load_spool(path: str) -> ChainResult:
     empty = np.zeros((0,))
     for key in _CHAIN_KEYS.values():
         chains.setdefault(key, empty)
+    if meta.get("record_mode") is not None:
+        cols["record_mode"] = np.asarray(meta["record_mode"])
+    for k, v in meta.get("extra", {}).items():
+        cols[k] = np.asarray(v)
     return ChainResult(**chains, stats=cols)
 
 

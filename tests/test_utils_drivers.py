@@ -50,6 +50,19 @@ def test_chain_result_save_layout(ma, tmp_path):
         arr = np.load(os.path.join(out, f"{name}.npy"))
         assert arr.shape[0] == 8
 
+def test_record_mode_discoverable(ma):
+    """The active recording mode rides in stats so compact-transport
+    quantization of b/alpha/pout can't be mistaken for bit-exact chains
+    (ADVICE r2): host dtypes are float32 either way."""
+    cfg = GibbsConfig(model="mixture")
+    res = JaxGibbs(ma, cfg, nchains=2, chunk_size=5).sample(niter=5, seed=0)
+    assert str(res.stats["record_mode"]) == "compact"
+    resf = JaxGibbs(ma, cfg, nchains=2, chunk_size=5,
+                    record="full").sample(niter=5, seed=0)
+    assert str(resf.stats["record_mode"]) == "full"
+    assert str(res.burn(2).stats["record_mode"]) == "compact"
+
+
 def test_block_timer():
     bt = BlockTimer()
     bt.time("noop", lambda: np.zeros(3))
@@ -190,7 +203,12 @@ def test_run_sims_ensemble_driver(tmp_path):
     assert r.returncode == 0, r.stderr
     lines = [l for l in r.stdout.splitlines() if l.strip()]
     assert len(lines) == 3  # one tree per pulsar
+    ns = []
     for ln in lines:
         chain = np.load(os.path.join(ln, "chain.npy"))
         assert chain.shape == (10, 2, 3)
+        ns.append(np.load(os.path.join(ln, "zchain.npy")).shape[-1])
+    # heterogeneous TOA counts survive to disk unpadded (driver passes
+    # keep=ntoa - (i%3)*(ntoa//13): 30, 28, 26)
+    assert ns == [30, 28, 26]
     assert "# ensemble: 3 pulsars" in r.stderr

@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""Relay liveness watcher: probe the TPU until it answers, then stop.
+
+One probe child at a time (the relay discipline in docs/PERFORMANCE.md),
+each a fresh interpreter (a failed axon init poisons a process), never
+signalled — children exit on their own (observed: a wedged-relay attempt
+returns UNAVAILABLE after ~30 min rather than hanging forever). Appends
+one JSON line per attempt to ``artifacts/relay_watch_r03.jsonl``; on
+success writes ``.relay_alive`` next to this repo's root and exits, so a
+shell loop (or a human) can poll a single file instead of dialing the
+relay again.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(ROOT, "artifacts", "relay_watch_r03.jsonl")
+ALIVE = os.path.join(ROOT, ".relay_alive")
+
+CHILD = (
+    "import jax; ds = jax.devices(); "
+    "print(jax.default_backend(), len(ds), ds[0].device_kind)"
+)
+
+
+def main(interval: float = 600.0) -> None:
+    attempt = 0
+    while True:
+        attempt += 1
+        t0 = time.time()
+        proc = subprocess.run(
+            [sys.executable, "-c", CHILD],
+            capture_output=True, text=True)
+        rec = {
+            "attempt": attempt,
+            "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(t0)),
+            "seconds": round(time.time() - t0, 1),
+            "rc": proc.returncode,
+            "out": proc.stdout.strip()[:120],
+            "err": proc.stderr.strip()[-200:],
+        }
+        with open(LOG, "a") as fh:
+            fh.write(json.dumps(rec) + "\n")
+        if proc.returncode == 0 and proc.stdout.strip():
+            backend = proc.stdout.split()[0]
+            if backend != "cpu":
+                with open(ALIVE, "w") as fh:
+                    json.dump({"backend": backend, "at": rec["utc"]}, fh)
+                return
+        time.sleep(interval)
+
+
+if __name__ == "__main__":
+    main(float(sys.argv[1]) if len(sys.argv) > 1 else 600.0)

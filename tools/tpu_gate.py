@@ -29,8 +29,8 @@ import time
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--out", default="artifacts/tpu_gate_r02.json")
-    ap.add_argument("--niter-np", type=int, default=6000)
+    ap.add_argument("--out", default="artifacts/tpu_gate_r03.json")
+    ap.add_argument("--niter-np", type=int, default=10000)
     ap.add_argument("--burn-np", type=int, default=1000)
     ap.add_argument("--thin-np", type=int, default=20)
     ap.add_argument("--nchains", type=int, default=1024)
@@ -94,9 +94,13 @@ def main():
 
     sub = np.random.default_rng(0)
     failures = []
-    for pi, name in enumerate(ma.param_names):
-        a = res_n.chain[args.burn_np:, pi][::args.thin_np]
-        b = res_j.chain[args.burn_j::args.thin_j, :, pi].ravel()
+
+    def gate(name, a, b):
+        """Mean-gap (< 0.33 sd) + gross-error KS (p > 0.001) on thinned
+        draws — one rule for hyperparams AND the latent theta/df chains
+        (VERDICT r2 weak #6: theta/df deserve first-class gating)."""
+        a = np.asarray(a, dtype=np.float64)
+        b = np.asarray(b, dtype=np.float64)
         if b.size > 4000:  # keep the two-sample KS comparably sized
             b = sub.choice(b, 4000, replace=False)
         sd = max(a.std(), b.std(), 1e-12)
@@ -110,11 +114,20 @@ def main():
         }
         if not ok:
             failures.append(name)
-    a = res_n.thetachain[args.burn_np::args.thin_np]
-    b = res_j.thetachain[args.burn_j::args.thin_j].ravel()
-    sd = max(a.std(), b.std(), 1e-12)
-    out["theta_gap_sd"] = round(float(abs(a.mean() - b.mean()) / sd), 3)
-    out["ok"] = bool(not failures and out["theta_gap_sd"] < 0.5)
+        return gap
+
+    for pi, name in enumerate(ma.param_names):
+        gate(name,
+             res_n.chain[args.burn_np:, pi][::args.thin_np],
+             res_j.chain[args.burn_j::args.thin_j, :, pi].ravel())
+    theta_gap = gate("theta",
+                     res_n.thetachain[args.burn_np::args.thin_np],
+                     res_j.thetachain[args.burn_j::args.thin_j].ravel())
+    gate("df",
+         res_n.dfchain[args.burn_np::args.thin_np].ravel(),
+         res_j.dfchain[args.burn_j::args.thin_j].ravel())
+    out["theta_gap_sd"] = round(theta_gap, 3)  # back-compat key
+    out["ok"] = bool(not failures)
     out["failures"] = failures
     flush()
     print(json.dumps(out["params"], indent=1), flush=True)

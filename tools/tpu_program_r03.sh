@@ -1,0 +1,42 @@
+#!/bin/bash
+# Round-3 hardware program: run every TPU artifact in priority order the
+# moment the relay is alive. Relay discipline (docs/PERFORMANCE.md):
+# exactly ONE JAX client at a time, each stage a fresh process that
+# budgets itself and exits cleanly; nothing here ever signals a client.
+# Launch detached:  setsid nohup bash tools/tpu_program_r03.sh &
+set -u
+cd "$(dirname "$0")/.."
+LOG=artifacts/tpu_program_r03.log
+say() { echo "[$(date -u +%FT%TZ)] $*" >> "$LOG"; }
+
+say "=== TPU program r03 start ==="
+
+# Stage 1: the official benchmark (VERDICT r2 next-round #1).
+say "stage 1: bench.py (official flagship)"
+python bench.py --platform axon \
+  > artifacts/BENCH_TPU_r03.out 2> artifacts/BENCH_TPU_r03.err
+say "stage 1 rc=$? json=$(tail -1 artifacts/BENCH_TPU_r03.out)"
+
+# Stage 2: stress config on hardware (VERDICT r2 next-round #3).
+say "stage 2: bench.py --stress (1e5 TOAs)"
+python bench.py --stress --platform axon \
+  > artifacts/BENCH_STRESS_r03.out 2> artifacts/BENCH_STRESS_r03.err
+say "stage 2 rc=$? json=$(tail -1 artifacts/BENCH_STRESS_r03.out)"
+
+# Stage 3: on-chip posterior gate with theta/df gates (next-round #7).
+say "stage 3: tools/tpu_gate.py"
+python tools/tpu_gate.py --out artifacts/tpu_gate_r03.json \
+  > artifacts/tpu_gate_r03.out 2>&1
+say "stage 3 rc=$?"
+
+# Stage 4: ensemble on hardware (next-round #4): shard_map mesh on the
+# single chip, flagship-scale populations, beta config.
+say "stage 4: run_sims.py --ensemble on chip"
+python run_sims.py --backend jax --ensemble 4 --nchains 256 \
+  --niter 200 --burn 50 --thetas 0.1 --ntoa 130 --components 30 \
+  --models beta --seed 7 --simdir /tmp/ens_sim_r03 \
+  --outdirs /tmp/ens_out_r03 /tmp/ens_out2_r03 \
+  > artifacts/ENSEMBLE_TPU_r03.out 2> artifacts/ENSEMBLE_TPU_r03.err
+say "stage 4 rc=$?"
+
+say "=== TPU program r03 done ==="
