@@ -24,8 +24,9 @@ from gibbs_student_t_tpu.models.pta import ModelArrays
 #: ``n_toa`` is the per-pulsar real TOA count of a (padded) ensemble run;
 #: ``n_reinits`` the cumulative diverged-chain re-inits; ``record_mode``
 #: the recording mode the run used (so compact-transport quantization of
-#: b/alpha/pout is discoverable downstream).
-META_STATS = ("n_toa", "n_reinits", "record_mode")
+#: b/alpha/pout is discoverable downstream); ``record_thin`` the on-device
+#: sweep-thinning factor (rows = every ``record_thin``-th sweep).
+META_STATS = ("n_toa", "n_reinits", "record_mode", "record_thin")
 
 
 @dataclasses.dataclass

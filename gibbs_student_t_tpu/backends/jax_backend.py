@@ -159,6 +159,7 @@ class JaxGibbs(SamplerBackend):
                  chunk_size: int = 100,
                  tnt_block_size: int | str | None = "auto",
                  record: str = "compact",
+                 record_thin: int = 1,
                  use_pallas: bool | str = "auto",
                  pallas_interpret: bool = False,
                  hyper_schur: bool | str = "auto"):
@@ -177,6 +178,16 @@ class JaxGibbs(SamplerBackend):
         bit-exactly; ``"light"`` records only the O(1)-per-sweep fields
         (x, theta, df, acceptance) — at stress scale the per-TOA chains
         (z, alpha, pout) dominate host transfer.
+        ``record_thin=t`` records every t-th sweep (the state *before*
+        sweeps 0, t, 2t, ...), cutting device->host record bytes t-fold
+        while every sweep still runs with identical keying — row k of a
+        thinned result is bit-identical to row k*t of an unthinned run.
+        The reference records every sweep and its analyses thin
+        afterwards; here thinning can happen before the wire because
+        transport, not compute, gates wall time through this relay
+        (docs/PERFORMANCE.md roofline). ``chunk_size`` and ``niter``
+        must be multiples of t; downstream row counts (e.g. ``burn``)
+        are in recorded rows.
         ``use_pallas`` routes the blocked TNT reduction through the fused
         Pallas TPU kernel (ops/pallas_tnt.py), batched over all chains
         between the vmapped sweep stages; ``"auto"`` enables it on TPU
@@ -203,6 +214,14 @@ class JaxGibbs(SamplerBackend):
             raise ValueError("record must be 'full', 'compact' or "
                              f"'light', got {record!r}")
         self._record_mode = record
+        if record_thin < 1:
+            raise ValueError(f"record_thin must be >= 1, got {record_thin}")
+        if chunk_size % record_thin:
+            raise ValueError(
+                f"chunk_size ({chunk_size}) must be a multiple of "
+                f"record_thin ({record_thin}) so chunk boundaries land "
+                "on recorded sweeps")
+        self.record_thin = int(record_thin)
         self._record_fields = (_RECORD_FIELDS if record != "light" else
                                ("x", "theta", "df", "acc_white", "acc_hyper"))
         # compact transport only applies to float32 runs: an explicit
@@ -573,6 +592,7 @@ class JaxGibbs(SamplerBackend):
     def _make_chunk_fn(self):
         fields = self._record_fields
         casts = self._record_casts
+        thin = self.record_thin
 
         def rec_of(st):
             # transport casts happen on device, inside the scan, so the
@@ -580,13 +600,27 @@ class JaxGibbs(SamplerBackend):
             # to host (record="compact")
             return record_tuple(st, fields, casts)
 
+        # The scan iterates over recorded rows (every ``thin``-th sweep);
+        # an inner fori_loop advances the ``thin`` sweeps in between with
+        # the SAME per-sweep fold_in keying as an unthinned run, so row k
+        # of a thinned chain is bit-identical to row k*thin of a full one
+        # (tests/test_jax_backend.py::test_record_thin_rows_match_unthinned).
+
         def one_chain(state, chain_key, offset, length):
-            def body(st, i):
+            def body(st, i0):
                 rec = rec_of(st)
-                st = self._sweep(st, random.fold_in(chain_key, offset + i))
+                if thin == 1:  # default path: no inner loop machinery
+                    st = self._sweep(st, random.fold_in(chain_key, i0))
+                else:
+                    st = lax.fori_loop(
+                        0, thin,
+                        lambda j, s: self._sweep(
+                            s, random.fold_in(chain_key, i0 + j)),
+                        st)
                 return st, rec
 
-            return lax.scan(body, state, jnp.arange(length))
+            return lax.scan(body, state,
+                            offset + jnp.arange(0, length, thin))
 
         def chunk(states, keys, offset, length):
             return jax.vmap(
@@ -594,17 +628,23 @@ class JaxGibbs(SamplerBackend):
             )(states, keys)
 
         def chunk_batched(states, keys, offset, length):
-            # outer scan over sweeps; each step advances every chain via
-            # the batched sweep (the Pallas TNT path)
-            def body(sts, i):
+            # outer scan over recorded rows; each step advances all
+            # chains via the batched sweep (the Pallas TNT path)
+            def body(sts, i0):
                 rec = rec_of(sts)
-                ki = jax.vmap(
-                    lambda k: random.fold_in(k, offset + i))(keys)
-                sts = self._batched_sweep(sts, ki)
+
+                def inner(j, s):
+                    ki = jax.vmap(
+                        lambda k: random.fold_in(k, i0 + j))(keys)
+                    return self._batched_sweep(s, ki)
+
+                sts = (inner(0, sts) if thin == 1
+                       else lax.fori_loop(0, thin, inner, sts))
                 return sts, rec
 
-            sts, recs = lax.scan(body, states, jnp.arange(length))
-            # (length, C, ...) -> (C, length, ...) to match the vmap path
+            sts, recs = lax.scan(body, states,
+                                 offset + jnp.arange(0, length, thin))
+            # (rows, C, ...) -> (C, rows, ...) to match the vmap path
             return sts, tuple(jnp.swapaxes(r, 0, 1) for r in recs)
 
         return chunk_batched if self._use_pallas else chunk
@@ -665,6 +705,13 @@ class JaxGibbs(SamplerBackend):
         the one-chunk crash window at the cost of the overlap."""
         if niter < 1:
             raise ValueError(f"niter must be >= 1, got {niter}")
+        if niter % self.record_thin:
+            raise ValueError(f"niter ({niter}) must be a multiple of "
+                             f"record_thin ({self.record_thin})")
+        if start_sweep % self.record_thin:
+            raise ValueError(
+                f"start_sweep ({start_sweep}) must land on a recorded "
+                f"sweep (multiple of record_thin={self.record_thin})")
         resume = start_sweep > 0
         if state is None:
             state = self.init_state(x0, seed=seed)
@@ -678,7 +725,8 @@ class JaxGibbs(SamplerBackend):
             # case a crash left orphaned rows) instead of overwriting it.
             spool = ChainSpool(spool_dir, seed, resume=resume,
                                resume_at=start_sweep if resume else None,
-                               record_mode=self.record_mode)
+                               record_mode=self.record_mode,
+                               record_thin=self.record_thin)
         records = []
         fields = self._record_fields
         # cumulative across spool resumes: an interrupted run's count is
@@ -799,6 +847,8 @@ class JaxGibbs(SamplerBackend):
         # arrays are float32 either way, so the dtype alone cannot tell
         # a ~2-3-digit b/alpha chain from a bit-exact one (ADVICE r2)
         stats["record_mode"] = np.asarray(self.record_mode)
+        if self.record_thin != 1:
+            stats["record_thin"] = np.asarray(self.record_thin)
         return ChainResult(
             chain=cols.get("x", empty), bchain=cols.get("b", empty),
             zchain=cols.get("z", empty), thetachain=cols.get("theta", empty),

@@ -139,7 +139,7 @@ class EnsembleGibbs:
     def __init__(self, mas: Sequence[ModelArrays], config: GibbsConfig,
                  nchains: int = 64, mesh: Optional[Mesh] = None,
                  dtype=jnp.float32, chunk_size: int = 50,
-                 record: str = "compact"):
+                 record: str = "compact", record_thin: int = 1):
         self.npulsars = len(mas)
         self.nchains = nchains
         self.mesh = mesh
@@ -164,6 +164,7 @@ class EnsembleGibbs:
         self.template = JaxGibbs(_localize_names(mas[0]), config,
                                  nchains=nchains, dtype=dtype,
                                  chunk_size=chunk_size, record=record,
+                                 record_thin=record_thin,
                                  tnt_block_size=None, use_pallas=False)
         self.dtype = dtype
         self._step = self._build_step()
@@ -204,17 +205,27 @@ class EnsembleGibbs:
 
         fields = template._record_fields
         casts = template._record_casts
+        thin = template.record_thin
 
         def local_chunk(ma_p, state, chain_key, offset, length):
-            def body(st, i):
+            # scan over recorded rows, inner loop over the thin sweeps
+            # between them — same structure and keying as the
+            # single-model chunk fn (backends/jax_backend.py)
+            def body(st, i0):
                 # same compact device-side transport casts as the
-                # single-model backend (backends/jax_backend.py)
+                # single-model backend
                 rec = record_tuple(st, fields, casts)
-                st = template._sweep(
-                    st, random.fold_in(chain_key, offset + i), ma=ma_p)
+
+                def one(j, s):
+                    return template._sweep(
+                        s, random.fold_in(chain_key, i0 + j), ma=ma_p)
+
+                st = (one(0, st) if thin == 1
+                      else jax.lax.fori_loop(0, thin, one, st))
                 return st, rec
 
-            return jax.lax.scan(body, state, jnp.arange(length))
+            return jax.lax.scan(body, state,
+                                offset + jnp.arange(0, length, thin))
 
         def step(stacked_ma, states, keys, offset, length):
             def run(ma_block, st_block, key_block):
@@ -267,6 +278,13 @@ class EnsembleGibbs:
         """
         if niter < 1:
             raise ValueError(f"niter must be >= 1, got {niter}")
+        thin = self.template.record_thin
+        if niter % thin:
+            raise ValueError(f"niter ({niter}) must be a multiple of "
+                             f"record_thin ({thin})")
+        if start_sweep % thin:
+            raise ValueError(f"start_sweep ({start_sweep}) must land on "
+                             f"a recorded sweep (multiple of {thin})")
         resume = start_sweep > 0
         if state is None:
             state = self.init_state(seed)
@@ -278,6 +296,7 @@ class EnsembleGibbs:
             spool = ChainSpool(spool_dir, seed, resume=resume,
                                resume_at=start_sweep if resume else None,
                                record_mode=self.template.record_mode,
+                               record_thin=thin,
                                extra_meta={"n_toa": self.n_toa.tolist()})
         records = []
         fields = self.template._record_fields
@@ -287,8 +306,9 @@ class EnsembleGibbs:
         def flush(recs, chunk_state, sweep_end, n_reinits):
             host = self.template._materialize(jax.device_get(recs))
             if spool is not None:
-                # (P, C, len, ...) -> (len, P, C, ...): spool rows are
-                # sweeps, exactly like the single-model backend
+                # (P, C, rows, ...) -> (rows, P, C, ...): spool rows are
+                # RECORDED rows (one per record_thin sweeps), exactly
+                # like the single-model backend
                 spool.append(
                     {f: np.moveaxis(host[i], 2, 0)
                      for i, f in enumerate(fields)},

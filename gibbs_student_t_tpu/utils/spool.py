@@ -32,6 +32,7 @@ class ChainSpool:
     def __init__(self, path: str, seed: int, resume: bool = False,
                  resume_at: Optional[int] = None,
                  record_mode: Optional[str] = None,
+                 record_thin: int = 1,
                  extra_meta: Optional[Dict] = None):
         """``resume=True`` appends to an existing spool directory (after a
         kill: ``load_spool_state`` -> ``sample(state=..., start_sweep=...,
@@ -52,6 +53,10 @@ class ChainSpool:
         self.resume = resume
         self.resume_at = resume_at
         self.record_mode = record_mode
+        # spool rows are RECORDED sweeps: with thinning, one row per
+        # record_thin sweeps — sweep-indexed bookkeeping (base/resume_at)
+        # divides by this to reach row counts
+        self.record_thin = int(record_thin)
         # JSON-able run-level metadata (e.g. the ensemble's per-pulsar
         # real TOA counts) replayed into ChainResult.stats by load_spool
         self.extra_meta = extra_meta
@@ -83,19 +88,30 @@ class ChainSpool:
                     raise ValueError(
                         f"resume record mode {self.record_mode!r} does not "
                         f"match the spooled run's {prior_mode!r}")
+                if meta.get("record_thin", 1) != self.record_thin:
+                    raise ValueError(
+                        f"resume record_thin {self.record_thin} does not "
+                        f"match the spooled run's "
+                        f"{meta.get('record_thin', 1)}")
                 base = meta.get("base", 0)
                 if self.resume_at is not None:
-                    keep_rows = self.resume_at - base
+                    if (self.resume_at - base) % self.record_thin:
+                        raise ValueError(
+                            f"resume_at={self.resume_at} is not on a "
+                            f"recorded-sweep boundary (base {base}, "
+                            f"thin {self.record_thin})")
+                    keep_rows = (self.resume_at - base) // self.record_thin
                     if keep_rows < 0:
                         raise ValueError(
                             f"resume_at={self.resume_at} predates the "
                             f"spool's first sweep ({base})")
             else:
-                base = sweep - chunk_len
+                base = sweep - chunk_len * self.record_thin
                 with open(meta_path, "w") as fh:
                     json.dump({"fields": sorted(records),
                                "seed": self.seed, "base": base,
                                "record_mode": self.record_mode,
+                               "record_thin": self.record_thin,
                                "extra": self.extra_meta or {}}, fh)
             self._writers = {
                 f: self._native.SpoolWriter(
@@ -156,6 +172,8 @@ def load_spool(path: str) -> ChainResult:
         chains.setdefault(key, empty)
     if meta.get("record_mode") is not None:
         cols["record_mode"] = np.asarray(meta["record_mode"])
+    if meta.get("record_thin", 1) != 1:
+        cols["record_thin"] = np.asarray(meta["record_thin"])
     for k, v in meta.get("extra", {}).items():
         cols[k] = np.asarray(v)
     return ChainResult(**chains, stats=cols)

@@ -52,12 +52,14 @@ def model_configs(pspin: float = 0.00457):
     }
 
 
-def run_one(ma, cfg, backend: str, niter: int, nchains: int, seed: int):
+def run_one(ma, cfg, backend: str, niter: int, nchains: int, seed: int,
+            record: str = "compact", record_thin: int = 1):
     from gibbs_student_t_tpu.backends import get_backend
 
     cls = get_backend(backend)
     if cls.supports_chains:
-        return cls(ma, cfg, nchains=nchains).sample(niter=niter, seed=seed)
+        return cls(ma, cfg, nchains=nchains, record=record,
+                   record_thin=record_thin).sample(niter=niter, seed=seed)
     gb = cls(ma, cfg)
     return gb.sample(ma.x_init(np.random.default_rng(seed)), niter,
                      seed=seed)
@@ -108,9 +110,11 @@ def run_ensemble(args, configs, parfile, timfile, rng):
         for cc in range(1, ndev // cp + 1):
             if args.nchains % cc == 0 and cp * cc > n_p * n_c:
                 n_p, n_c = cp, cc
-    mesh = (make_mesh({"pulsar": n_p, "chain": n_c},
-                      devices=jax.devices()[:n_p * n_c])
-            if n_p * n_c > 1 else None)
+    # always shard_map, even on a single device (1x1 mesh): the on-chip
+    # ensemble run must exercise the same sharded code path the CPU mesh
+    # tests validate, not silently fall back to plain vmap
+    mesh = make_mesh({"pulsar": n_p, "chain": n_c},
+                     devices=jax.devices()[:n_p * n_c])
     print(f"# ensemble: {args.ensemble} pulsars x {args.nchains} chains "
           f"on {ndev} device(s)"
           + (f", mesh {dict(zip(mesh.axis_names, mesh.devices.shape))}"
@@ -118,7 +122,9 @@ def run_ensemble(args, configs, parfile, timfile, rng):
 
     for key, cfg in configs.items():
         seed = int(rng.integers(0, 2 ** 31))
-        ens = EnsembleGibbs(mas, cfg, nchains=args.nchains, mesh=mesh)
+        ens = EnsembleGibbs(mas, cfg, nchains=args.nchains, mesh=mesh,
+                            record=args.record,
+                            record_thin=args.record_thin)
         t0 = time.perf_counter()
         res = ens.sample(niter=args.niter, seed=seed)
         dt = time.perf_counter() - t0
@@ -149,6 +155,16 @@ def main(argv=None):
                          "(pulsar x chain) population instead of the "
                          "sequential per-dataset pipeline (BASELINE "
                          "config 5; uses --thetas[0])")
+    ap.add_argument("--record", default="compact",
+                    choices=["compact", "full", "light"],
+                    help="chain recording mode (jax backend): transport "
+                         "dtype narrowing, full precision, or O(1) "
+                         "fields only")
+    ap.add_argument("--record-thin", type=int, default=1,
+                    help="record every Nth sweep on device (jax "
+                         "backend). --niter stays in SWEEPS (must be a "
+                         "multiple of N; niter/N rows come back); "
+                         "--burn counts recorded ROWS")
     ap.add_argument("--models", nargs="+",
                     default=["vvh17", "uniform", "beta", "gaussian", "t"])
     ap.add_argument("--par", default=None)
@@ -200,7 +216,8 @@ def main(argv=None):
                 seed = int(rng.integers(0, 2 ** 31))
                 t0 = time.perf_counter()
                 res = run_one(ma, cfg, args.backend, args.niter,
-                              args.nchains, seed)
+                              args.nchains, seed, record=args.record,
+                              record_thin=args.record_thin)
                 dt = time.perf_counter() - t0
                 out = os.path.join(outdir, key, str(theta), str(idx))
                 res.burn(args.burn).save(out)

@@ -142,6 +142,37 @@ def test_resume_matches_unbroken_run(ma):
     np.testing.assert_array_equal(full.chain, stitched)
 
 
+def test_record_thin_rows_match_unthinned(ma):
+    """On-device sweep thinning: every sweep still runs with identical
+    keying, so a thinned run's row k is BIT-identical to row k*t of an
+    unthinned run — thinning only cuts the wire bytes (the transport
+    wall, docs/PERFORMANCE.md roofline)."""
+    cfg = GibbsConfig(model="mixture", vary_df=True)
+    full = JaxGibbs(ma, cfg, nchains=2, chunk_size=6).sample(niter=12,
+                                                             seed=3)
+    gb = JaxGibbs(ma, cfg, nchains=2, chunk_size=6, record_thin=3)
+    thin = gb.sample(niter=12, seed=3)
+    assert thin.chain.shape[0] == 4
+    np.testing.assert_array_equal(thin.chain, full.chain[::3])
+    np.testing.assert_array_equal(thin.zchain, full.zchain[::3])
+    np.testing.assert_array_equal(thin.dfchain, full.dfchain[::3])
+    np.testing.assert_array_equal(thin.bchain, full.bchain[::3])
+    assert int(thin.stats["record_thin"]) == 3
+    assert "record_thin" not in full.stats
+    # resume lands on recorded-sweep boundaries and stitches exactly
+    gb2 = JaxGibbs(ma, cfg, nchains=2, chunk_size=6, record_thin=3)
+    first = gb2.sample(niter=6, seed=3)
+    second = gb2.sample(niter=6, seed=3, state=gb2.last_state,
+                        start_sweep=6)
+    np.testing.assert_array_equal(
+        np.concatenate([first.chain, second.chain]), thin.chain)
+    # invalid shapes are rejected up front
+    with pytest.raises(ValueError, match="record_thin"):
+        JaxGibbs(ma, cfg, nchains=2, chunk_size=5, record_thin=3)
+    with pytest.raises(ValueError, match="record_thin"):
+        gb.sample(niter=10, seed=3)
+
+
 def test_compact_record_matches_full(ma):
     """record="compact" (the default) narrows only the device->host
     transport: the sampled-parameter chains and z come back bit-identical

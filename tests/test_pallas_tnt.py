@@ -82,3 +82,27 @@ def test_backend_pallas_sweep_matches_vmap_path():
                                atol=2e-3)
     np.testing.assert_allclose(r_pal.zchain, r_ref.zchain)
     np.testing.assert_allclose(r_pal.dfchain, r_ref.dfchain)
+
+
+def test_backend_pallas_sweep_record_thin_rows_match():
+    """record_thin on the batched (Pallas TNT) chunk driver: thinned
+    rows must be bit-identical to every t-th row of the unthinned
+    batched run — the stress path is exactly where thinning is used,
+    so its keying cannot go untested (chunk_batched's inner loop)."""
+    from gibbs_student_t_tpu.backends import JaxGibbs
+    from gibbs_student_t_tpu.config import GibbsConfig
+    from tests.conftest import make_demo_pta, make_demo_pulsar
+
+    psr, _ = make_demo_pulsar(seed=11, n=40, theta=0.1)
+    ma = make_demo_pta(psr, components=5).frozen()
+    cfg = GibbsConfig(model="mixture", vary_df=True)
+    full = JaxGibbs(ma, cfg, nchains=3, tnt_block_size=32,
+                    use_pallas=True, pallas_interpret=True,
+                    chunk_size=6).sample(niter=6, seed=2)
+    thin = JaxGibbs(ma, cfg, nchains=3, tnt_block_size=32,
+                    use_pallas=True, pallas_interpret=True,
+                    chunk_size=6, record_thin=3).sample(niter=6, seed=2)
+    assert thin.chain.shape[0] == 2
+    np.testing.assert_array_equal(thin.chain, full.chain[::3])
+    np.testing.assert_array_equal(thin.zchain, full.zchain[::3])
+    np.testing.assert_array_equal(thin.dfchain, full.dfchain[::3])
