@@ -75,6 +75,12 @@ class ChainState(NamedTuple):
     pout: jnp.ndarray     # (n,) outlier probabilities (derived metric)
     acc_white: jnp.ndarray  # () last-sweep acceptance rate
     acc_hyper: jnp.ndarray  # ()
+    # (2,) log jump-scale multipliers [white, hyper] — identically 0
+    # (scale 1, the reference's fixed table) unless MHConfig.adapt_until
+    # enables Robbins-Monro adaptation. The numpy default keeps
+    # hand-built states (tests) valid without triggering device init at
+    # import time.
+    mh_log_scale: jnp.ndarray = np.zeros(2, np.float32)
 
 
 _RECORD_FIELDS = ("x", "b", "z", "theta", "alpha", "df", "pout",
@@ -139,7 +145,13 @@ def merge_reinit(state, bad, fresh, batch_ndim: int):
     """Replace the ``bad``-masked leading-axis entries of ``state`` with
     ``fresh`` draws; healthy entries stay bitwise identical. ``bad`` has
     ``batch_ndim`` leading batch axes ((nchains,) for the single-model
-    backend, (npulsars, nchains) for ensembles)."""
+    backend, (npulsars, nchains) for ensembles).
+
+    The adapted MH jump scales survive re-init: a chain diverges in its
+    x/b/alpha state, not its (bounded) step sizes, and Robbins-Monro may
+    already be frozen — a zeroed scale would silently run the rest of
+    the sampling un-adapted."""
+    fresh = fresh._replace(mh_log_scale=state.mh_log_scale)
     mask = jnp.asarray(bad)
     return jax.tree.map(
         lambda cur, fr: jnp.where(
@@ -349,6 +361,7 @@ class JaxGibbs(SamplerBackend):
             pout=jnp.zeros((c, n), dtype=self.dtype),
             acc_white=jnp.zeros((c,), dtype=self.dtype),
             acc_hyper=jnp.zeros((c,), dtype=self.dtype),
+            mh_log_scale=jnp.zeros((c, 2), dtype=self.dtype),
         )
 
     # ------------------------------------------------------------------
@@ -358,11 +371,15 @@ class JaxGibbs(SamplerBackend):
     def _lnprior(self, x):
         return lnprior(self._ma, x, jnp)
 
-    def _mh_block(self, x, key, ind: np.ndarray, nsteps: int, loglike_fn):
+    def _mh_block(self, x, key, ind: np.ndarray, nsteps: int, loglike_fn,
+                  jump_scale=1.0):
         """Branchless random-walk Metropolis on a coordinate block
-        (reference gibbs.py:80-143)."""
+        (reference gibbs.py:80-143). ``jump_scale`` multiplies the jump
+        sigma (the chain's adapted log-scale, exp'd; exactly 1 when
+        adaptation is off — the body's own ``scale`` is the per-step
+        discrete mixture draw, a different thing)."""
         mh = self.config.mh
-        sigma = mh.sigma_per_param * len(ind)
+        sigma = mh.sigma_per_param * len(ind) * jump_scale
         sizes = jnp.asarray(mh.scale_sizes, dtype=self.dtype)
         logits = jnp.log(jnp.asarray(mh.scale_probs, dtype=self.dtype))
         ind = jnp.asarray(ind)
@@ -409,11 +426,13 @@ class JaxGibbs(SamplerBackend):
         nv = az * ndiag(ma, xq, jnp)
         return nv if mask is None else jnp.where(mask, nv, 1.0)
 
-    def _sweep(self, state: ChainState, key, ma: ModelArrays | None = None
-               ) -> ChainState:
+    def _sweep(self, state: ChainState, key, ma: ModelArrays | None = None,
+               sweep=None) -> ChainState:
         """One full Gibbs sweep. ``ma`` defaults to the backend's frozen
         model (embedded as constants); the ensemble path passes a traced
-        per-pulsar ModelArrays pytree instead (parallel/ensemble.py)."""
+        per-pulsar ModelArrays pytree instead (parallel/ensemble.py).
+        ``sweep`` is the (traced) sweep index, needed only when MH
+        adaptation is enabled (MHConfig.adapt_until)."""
         keys = random.split(key, 7)
         x, acc_w, nvec = self._sweep_white(state, keys[0], ma)
         ma_r, _, bs, _ = self._resolve(ma)
@@ -421,7 +440,7 @@ class JaxGibbs(SamplerBackend):
         # fused dense/blocked reduction (ops/tnt.py)
         TNT, d, const_white = tnt_products(ma_r.T, ma_r.y, nvec, bs)
         return self._sweep_rest(state, x, acc_w, TNT, d, const_white,
-                                keys[1:], ma)
+                                keys[1:], ma, sweep)
 
     def _sweep_white(self, state: ChainState, kw, ma: ModelArrays | None):
         """Sweep stage 1: the white-noise MH block
@@ -442,13 +461,14 @@ class JaxGibbs(SamplerBackend):
                                + jnp.sum(yred * yred / nvec))
 
             x, acc_w = self._mh_block(x, kw, ma.white_indices,
-                                      cfg.mh.n_white_steps, ll_white)
+                                      cfg.mh.n_white_steps, ll_white,
+                                      jump_scale=jnp.exp(state.mh_log_scale[0]))
         else:
             acc_w = jnp.zeros((), dtype=self.dtype)
         return x, acc_w, self._masked_nvec(ma, mask, x, az)
 
     def _sweep_rest(self, state: ChainState, x, acc_w, TNT, d, const_white,
-                    keys, ma: ModelArrays | None) -> ChainState:
+                    keys, ma: ModelArrays | None, sweep=None) -> ChainState:
         """Sweep stages 2-7: everything conditioned on the TNT/d inner
         products (hyper MH, coefficient draw, theta/z/alpha/df)."""
         ma, mask, bs, n = self._resolve(ma)
@@ -489,7 +509,8 @@ class JaxGibbs(SamplerBackend):
 
         if len(ma.hyper_indices):
             x, acc_h = self._mh_block(x, kh, ma.hyper_indices,
-                                      cfg.mh.n_hyper_steps, ll_hyper)
+                                      cfg.mh.n_hyper_steps, ll_hyper,
+                                      jump_scale=jnp.exp(state.mh_log_scale[1]))
         else:
             acc_h = jnp.zeros((), dtype=self.dtype)
 
@@ -561,14 +582,31 @@ class JaxGibbs(SamplerBackend):
                     - n * gammaln(grid / 2.0))
             df = grid[random.categorical(kd, logp)]
 
+        # --- Robbins-Monro jump-scale adaptation (opt-in; frozen past
+        # adapt_until, so the chain is ordinary MH from that sweep on)
+        mh_ls = state.mh_log_scale
+        if cfg.mh.adapt_until > 0:
+            if sweep is None:
+                raise ValueError(
+                    "MHConfig.adapt_until > 0 needs the sweep index; "
+                    "drive the kernel through sample() (sweep_fn()/"
+                    "direct _sweep calls cannot adapt)")
+            t = jnp.asarray(sweep, dtype=self.dtype)
+            eta = jnp.where(t < cfg.mh.adapt_until,
+                            (t + 1.0) ** (-cfg.mh.adapt_decay), 0.0)
+            mh_ls = mh_ls + eta * (
+                jnp.stack([acc_w, acc_h]) - cfg.mh.target_accept)
+
         return ChainState(x=x, b=b, z=z, alpha=alpha, theta=theta, df=df,
-                          pout=pout, acc_white=acc_w, acc_hyper=acc_h)
+                          pout=pout, acc_white=acc_w, acc_hyper=acc_h,
+                          mh_log_scale=mh_ls)
 
     # ------------------------------------------------------------------
     # chunked driver
     # ------------------------------------------------------------------
 
-    def _batched_sweep(self, states: ChainState, keys) -> ChainState:
+    def _batched_sweep(self, states: ChainState, keys,
+                       sweep=None) -> ChainState:
         """One sweep for ALL chains: vmapped MH stages around a single
         batched TNT reduction — the seam where the fused Pallas kernel
         replaces per-chain scans (ops/pallas_tnt.py)."""
@@ -586,7 +624,7 @@ class JaxGibbs(SamplerBackend):
         const = const.astype(self.dtype)
         return jax.vmap(
             lambda st, xx, aw, t, dd, cc, kk:
-            self._sweep_rest(st, xx, aw, t, dd, cc, kk, None)
+            self._sweep_rest(st, xx, aw, t, dd, cc, kk, None, sweep)
         )(states, x, acc_w, TNT, d, const, ks[:, 1:])
 
     def _make_chunk_fn(self):
@@ -610,12 +648,14 @@ class JaxGibbs(SamplerBackend):
             def body(st, i0):
                 rec = rec_of(st)
                 if thin == 1:  # default path: no inner loop machinery
-                    st = self._sweep(st, random.fold_in(chain_key, i0))
+                    st = self._sweep(st, random.fold_in(chain_key, i0),
+                                     sweep=i0)
                 else:
                     st = lax.fori_loop(
                         0, thin,
                         lambda j, s: self._sweep(
-                            s, random.fold_in(chain_key, i0 + j)),
+                            s, random.fold_in(chain_key, i0 + j),
+                            sweep=i0 + j),
                         st)
                 return st, rec
 
@@ -636,7 +676,7 @@ class JaxGibbs(SamplerBackend):
                 def inner(j, s):
                     ki = jax.vmap(
                         lambda k: random.fold_in(k, i0 + j))(keys)
-                    return self._batched_sweep(s, ki)
+                    return self._batched_sweep(s, ki, sweep=i0 + j)
 
                 sts = (inner(0, sts) if thin == 1
                        else lax.fori_loop(0, thin, inner, sts))

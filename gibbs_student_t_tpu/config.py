@@ -37,6 +37,19 @@ class MHConfig:
     sigma_per_param: float = 0.05  # reference gibbs.py:92,125
     scale_sizes: Tuple[float, ...] = (0.1, 0.5, 1.0, 3.0, 10.0)
     scale_probs: Tuple[float, ...] = (0.1, 0.15, 0.5, 0.15, 0.1)
+    # Opt-in Robbins-Monro step-size adaptation (JAX backend): for the
+    # first ``adapt_until`` sweeps, each chain's per-block log jump scale
+    # moves by eta_t * (acc - target_accept), eta_t = (t+1)^-adapt_decay,
+    # then freezes — the chain is ordinary (valid) MH from that sweep on,
+    # so set burn >= adapt_until when analyzing. The reference's fixed
+    # scales (gibbs.py:92-94,125-127) sit at ~0.95 white acceptance on
+    # the flagship model — far above the ~0.44 optimum for
+    # one-coordinate random-walk MH — so adaptation buys mixing speed
+    # without touching the model. 0 (default) reproduces the reference's
+    # fixed-scale behavior exactly.
+    adapt_until: int = 0
+    target_accept: float = 0.44
+    adapt_decay: float = 0.66
 
 
 @dataclasses.dataclass(frozen=True)
@@ -67,6 +80,14 @@ class GibbsConfig:
             )
         if self.model == "vvh17" and self.pspin is None:
             raise ValueError("model='vvh17' requires pspin (spin period in s)")
+
+    def with_adapt(self, adapt_until: int) -> "GibbsConfig":
+        """This config with MH jump-scale adaptation for the first
+        ``adapt_until`` sweeps (the drivers' ``--adapt`` flag; see
+        MHConfig). Shared so bench.py and run_sims.py cannot drift."""
+        return dataclasses.replace(
+            self, mh=dataclasses.replace(self.mh,
+                                         adapt_until=adapt_until))
 
     @property
     def is_outlier_model(self) -> bool:

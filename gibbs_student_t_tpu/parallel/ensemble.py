@@ -218,7 +218,8 @@ class EnsembleGibbs:
 
                 def one(j, s):
                     return template._sweep(
-                        s, random.fold_in(chain_key, i0 + j), ma=ma_p)
+                        s, random.fold_in(chain_key, i0 + j), ma=ma_p,
+                        sweep=i0 + j)
 
                 st = (one(0, st) if thin == 1
                       else jax.lax.fori_loop(0, thin, one, st))

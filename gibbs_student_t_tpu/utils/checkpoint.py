@@ -30,7 +30,20 @@ def save_checkpoint(path: str, state: ChainState, sweep: int,
 
 
 def load_checkpoint(path: str) -> Tuple[ChainState, int, int]:
-    """Returns (state, next_sweep_index, seed)."""
+    """Returns (state, next_sweep_index, seed).
+
+    Checkpoints written before a ChainState field existed load with that
+    field at its neutral value (currently: ``mh_log_scale`` zeros — the
+    un-adapted jump scale), so old spools/checkpoints stay resumable."""
     with np.load(path) as data:
-        state = ChainState(**{f: data[f] for f in ChainState._fields})
+        vals = {}
+        for f in ChainState._fields:
+            if f in data:
+                vals[f] = data[f]
+            elif f == "mh_log_scale":
+                vals[f] = np.zeros(data["x"].shape[:-1] + (2,),
+                                   data["x"].dtype)
+            else:
+                raise KeyError(f"checkpoint {path} lacks field {f!r}")
+        state = ChainState(**vals)
         return state, int(data["sweep"]), int(data["seed"])

@@ -155,6 +155,11 @@ def main(argv=None):
                          "(pulsar x chain) population instead of the "
                          "sequential per-dataset pipeline (BASELINE "
                          "config 5; uses --thetas[0])")
+    ap.add_argument("--adapt", type=int, default=0, metavar="N",
+                    help="adapt MH jump scales for the first N sweeps "
+                         "(jax backend; Robbins-Monro, then frozen — set "
+                         "--burn to at least N rows). 0 = the "
+                         "reference's fixed scales")
     ap.add_argument("--record", default="compact",
                     choices=["compact", "full", "light"],
                     help="chain recording mode (jax backend): transport "
@@ -187,6 +192,13 @@ def main(argv=None):
     parfile, timfile = ensure_base_dataset(args.par, args.tim, args.simdir,
                                            args.ntoa, args.seed)
     all_configs = model_configs(args.pspin)
+    if args.adapt:
+        if args.backend != "jax":
+            ap.error("--adapt is a jax-backend feature; the NumPy "
+                     "oracle runs the reference's fixed jump scales "
+                     "(pass --backend jax)")
+        all_configs = {k: v.with_adapt(args.adapt)
+                       for k, v in all_configs.items()}
     unknown = set(args.models) - set(all_configs)
     if unknown:
         ap.error(f"unknown --models {sorted(unknown)}; "

@@ -142,6 +142,49 @@ def test_resume_matches_unbroken_run(ma):
     np.testing.assert_array_equal(full.chain, stitched)
 
 
+def test_adaptive_mh_moves_acceptance_toward_target(ma):
+    """Opt-in Robbins-Monro jump-scale adaptation: the reference's fixed
+    table sits near 0.95 white acceptance (too timid for mixing); with
+    adapt_until set, post-adaptation acceptance must land near
+    target_accept and closer to it than the fixed-scale run, while the
+    posterior stays the same (adaptation freezes -> valid MH after).
+    Default configs (adapt_until=0) keep the reference's behavior."""
+    import dataclasses
+
+    from jax import random
+
+    cfg_fixed = GibbsConfig(model="gaussian", vary_df=False)
+    cfg_adapt = dataclasses.replace(
+        cfg_fixed, mh=dataclasses.replace(cfg_fixed.mh, adapt_until=150))
+    gb_f = JaxGibbs(ma, cfg_fixed, nchains=8, chunk_size=50)
+    gb_a = JaxGibbs(ma, cfg_adapt, nchains=8, chunk_size=50)
+    rf = gb_f.sample(niter=300, seed=0)
+    ra = gb_a.sample(niter=300, seed=0)
+    target = cfg_adapt.mh.target_accept
+    acc_f = float(rf.stats["acc_white"][150:].mean())
+    acc_a = float(ra.stats["acc_white"][150:].mean())
+    assert abs(acc_a - target) < abs(acc_f - target)
+    assert 0.2 < acc_a < 0.65, f"adapted white acceptance {acc_a:.2f}"
+    # adaptation is frozen past adapt_until: the scales stop moving
+    ls = np.asarray(gb_a.last_state.mh_log_scale)
+    gb_a2 = JaxGibbs(ma, cfg_adapt, nchains=8, chunk_size=50)
+    ra2 = gb_a2.sample(niter=200, seed=0, state=gb_a.last_state,
+                       start_sweep=300)
+    np.testing.assert_array_equal(
+        np.asarray(gb_a2.last_state.mh_log_scale), ls)
+    # same posterior, better mixing: means agree loosely (short chains)
+    a = rf.chain[150:].reshape(-1, rf.chain.shape[-1])
+    b = np.concatenate([ra.chain[150:], ra2.chain]).reshape(
+        -1, rf.chain.shape[-1])
+    for pi in range(a.shape[-1]):
+        sd = max(a[:, pi].std(), b[:, pi].std(), 1e-12)
+        assert abs(a[:, pi].mean() - b[:, pi].mean()) < 0.6 * sd
+    # kernels driven without a sweep index cannot adapt: loud error
+    with pytest.raises(ValueError, match="sweep index"):
+        jax.vmap(gb_a._sweep)(gb_a.init_state(seed=0),
+                              random.split(random.PRNGKey(0), 8))
+
+
 def test_record_thin_rows_match_unthinned(ma):
     """On-device sweep thinning: every sweep still runs with identical
     keying, so a thinned run's row k is BIT-identical to row k*t of an

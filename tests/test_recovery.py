@@ -28,7 +28,8 @@ def test_diverged_mask_flags_nonfinite_and_nonpositive(demo_ma):
 def test_reinit_replaces_only_dead_chains(demo_ma):
     gb = _backend(demo_ma)
     state = gb.init_state(seed=0)
-    broken = state._replace(x=state.x.at[2].set(jnp.inf))
+    broken = state._replace(x=state.x.at[2].set(jnp.inf),
+                            mh_log_scale=state.mh_log_scale + 0.7)
     fixed, n_bad = gb._reinit_diverged(broken, seed=123)
     assert n_bad == 1
     assert np.isfinite(np.asarray(fixed.x)).all()
@@ -36,6 +37,10 @@ def test_reinit_replaces_only_dead_chains(demo_ma):
     for i in (0, 1, 3):
         np.testing.assert_array_equal(np.asarray(fixed.x)[i],
                                       np.asarray(state.x)[i])
+    # adapted MH jump scales survive re-init: Robbins-Monro may already
+    # be frozen, and a zeroed scale would run un-adapted forever after
+    np.testing.assert_array_equal(np.asarray(fixed.mh_log_scale),
+                                  np.asarray(broken.mh_log_scale))
 
 
 def test_sample_recovers_injected_divergence(demo_ma):
