@@ -25,8 +25,11 @@ from gibbs_student_t_tpu.models.pta import ModelArrays
 #: ``n_reinits`` the cumulative diverged-chain re-inits; ``record_mode``
 #: the recording mode the run used (so compact-transport quantization of
 #: b/alpha/pout is discoverable downstream); ``record_thin`` the on-device
-#: sweep-thinning factor (rows = every ``record_thin``-th sweep).
-META_STATS = ("n_toa", "n_reinits", "record_mode", "record_thin")
+#: sweep-thinning factor (rows = every ``record_thin``-th sweep);
+#: ``rhat``/``rhat_history``/``converged`` are ``sample_until``'s
+#: convergence verdict (per-parameter / per-check, not per-sweep).
+META_STATS = ("n_toa", "n_reinits", "record_mode", "record_thin",
+              "rhat", "rhat_history", "converged")
 
 
 @dataclasses.dataclass

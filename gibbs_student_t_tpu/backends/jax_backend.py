@@ -38,7 +38,11 @@ import numpy as np
 from jax import lax, random
 from jax.scipy.special import gammaln
 
-from gibbs_student_t_tpu.backends.base import ChainResult, SamplerBackend
+from gibbs_student_t_tpu.backends.base import (
+    META_STATS,
+    ChainResult,
+    SamplerBackend,
+)
 from gibbs_student_t_tpu.config import GibbsConfig
 from gibbs_student_t_tpu.models.pta import (
     ModelArrays,
@@ -815,6 +819,116 @@ class JaxGibbs(SamplerBackend):
         if reinit_diverged:
             res.stats["n_reinits"] = np.asarray(n_reinits)
         return res
+
+    def sample_until(self, rhat_target: float = 1.01,
+                     max_sweeps: int = 20000, check_every: int = 500,
+                     seed: int = 0,
+                     x0: Optional[np.ndarray] = None,
+                     state: Optional[ChainState] = None,
+                     min_sweeps: int = 0,
+                     **sample_kwargs) -> ChainResult:
+        """Sample until every parameter's split-R-hat across the chain
+        axis drops below ``rhat_target`` (checked every ``check_every``
+        sweeps over the second half of the accumulated chains), or
+        ``max_sweeps`` is reached.
+
+        The massively-parallel chain axis is what makes online
+        convergence monitoring nearly free — a per-window host-side
+        split-R-hat over (rows, nchains) — and the reference (which
+        tracks no diagnostics at all, SURVEY.md §5) has no analog; users
+        there pick niter by folklore. The returned result carries the
+        R-hat trajectory in ``stats['rhat_history']`` ((checks, p)
+        array), the final values in ``stats['rhat']``, and
+        ``stats['converged']``. Extra kwargs (``spool_dir``,
+        ``reinit_diverged``, ...) pass through to ``sample``;
+        ``check_every`` must be a multiple of ``record_thin`` covering
+        at least 8 recorded rows (smaller windows degenerate
+        split-R-hat). With ``spool_dir``, segments append to one spool
+        and the returned result is the reloaded full history
+        (cumulative counters included); in-memory segments are
+        concatenated, with ``n_reinits`` summed across them."""
+        from gibbs_student_t_tpu.parallel.diagnostics import split_rhat
+
+        if check_every % self.record_thin or (
+                check_every // self.record_thin) < 8:
+            raise ValueError(
+                "check_every must be a multiple of record_thin covering "
+                ">= 8 recorded rows, or the split-R-hat window degenerates"
+                f" (got {check_every} at record_thin={self.record_thin})")
+        # sample() with a spool returns the ENTIRE spooled history
+        # reloaded from disk each call, so spool mode keeps only the
+        # latest result; the in-memory path accumulates segments.
+        spool_mode = bool(sample_kwargs.get("spool_dir"))
+        segments = []
+        history = []
+        done = 0
+        converged = False
+
+        def window_of(segs, total_rows):
+            """Rows [total_rows//2:] without re-concatenating the full
+            history every check (only the tail segments that overlap)."""
+            start = total_rows // 2
+            out, r0 = [], 0
+            for s in segs:
+                r1 = r0 + s.shape[0]
+                if r1 > start:
+                    out.append(s[max(0, start - r0):])
+                r0 = r1
+            return np.concatenate(out)
+
+        res = None
+        while done < max_sweeps:
+            length = min(check_every, max_sweeps - done)
+            res = self.sample(x0=x0 if done == 0 else None,
+                              niter=length, seed=seed,
+                              state=state, start_sweep=done,
+                              **sample_kwargs)
+            state = self.last_state
+            done += length
+            if spool_mode:
+                total_rows = res.chain.shape[0]
+                window = res.chain[total_rows // 2:]
+            else:
+                segments.append(res)
+                total_rows = sum(s.chain.shape[0] for s in segments)
+                window = window_of([s.chain for s in segments],
+                                   total_rows)
+            # second half of the accumulated run: the usual split-R-hat
+            # convention folds early-transient sweeps out of the window
+            rhat = np.array([split_rhat(window[..., pi])
+                             for pi in range(window.shape[-1])])
+            history.append(rhat)
+            if done >= max(min_sweeps, 2 * check_every) and (
+                    rhat < rhat_target).all():
+                converged = True
+                break
+        if spool_mode:
+            out = res  # already the full history, cumulative counters
+        else:
+            cols = {}
+            for f in dataclasses.fields(ChainResult):
+                if f.name == "stats":
+                    continue
+                arrs = [getattr(s, f.name) for s in segments]
+                cols[f.name] = (np.concatenate(arrs) if arrs[0].size
+                                else arrs[0])
+            stats = {}
+            for k in segments[0].stats:
+                v0 = segments[0].stats[k]
+                if k == "n_reinits":
+                    # per-call counters: the run's total is the sum
+                    stats[k] = np.asarray(sum(
+                        int(s.stats[k]) for s in segments))
+                elif k in META_STATS or np.ndim(v0) == 0:
+                    stats[k] = v0
+                else:
+                    stats[k] = np.concatenate([s.stats[k]
+                                               for s in segments])
+            out = ChainResult(**cols, stats=stats)
+        out.stats["rhat_history"] = np.stack(history)
+        out.stats["rhat"] = history[-1]
+        out.stats["converged"] = np.asarray(converged)
+        return out
 
     @staticmethod
     @jax.jit

@@ -142,6 +142,33 @@ def test_resume_matches_unbroken_run(ma):
     np.testing.assert_array_equal(full.chain, stitched)
 
 
+def test_sample_until_converges_and_matches_plain_run(ma):
+    """Online convergence stopping over the chain axis: sample_until
+    stops once split-R-hat clears the target, its concatenated chains
+    are bit-identical to one plain run of the same length (resume
+    keying), and the R-hat verdict rides in run-level stats that burn()
+    leaves alone."""
+    cfg = GibbsConfig(model="gaussian", vary_df=False)
+    gb = JaxGibbs(ma, cfg, nchains=8, chunk_size=50)
+    res = gb.sample_until(rhat_target=1.2, max_sweeps=600,
+                          check_every=100, seed=4)
+    total = res.chain.shape[0]
+    assert total % 100 == 0 and 200 <= total <= 600
+    assert bool(res.stats["converged"]) == (total < 600) or bool(
+        res.stats["converged"])
+    assert res.stats["rhat"].shape == (res.chain.shape[-1],)
+    assert res.stats["rhat_history"].shape[0] == total // 100
+    if res.stats["converged"]:
+        assert (res.stats["rhat"] < 1.2).all()
+    plain = JaxGibbs(ma, cfg, nchains=8, chunk_size=50).sample(
+        niter=total, seed=4)
+    np.testing.assert_array_equal(res.chain, plain.chain)
+    burned = res.burn(50)
+    assert burned.stats["rhat"].shape == (res.chain.shape[-1],)
+    np.testing.assert_array_equal(burned.stats["rhat_history"],
+                                  res.stats["rhat_history"])
+
+
 def test_adaptive_mh_moves_acceptance_toward_target(ma):
     """Opt-in Robbins-Monro jump-scale adaptation: the reference's fixed
     table sits near 0.95 white acceptance (too timid for mixing); with

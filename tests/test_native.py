@@ -180,6 +180,27 @@ def jnp_asarray(x):
     return jnp.asarray(x)
 
 
+def test_sample_until_spool_no_duplication(tmp_path, demo_ma):
+    """sample_until with a spool: each segment's sample() reloads the
+    FULL spool, so the implementation must keep only the latest result —
+    the final chain has exactly done-sweeps rows, no duplicated prefix,
+    and matches a plain run of the same length."""
+    from gibbs_student_t_tpu.backends import JaxGibbs
+    from gibbs_student_t_tpu.config import GibbsConfig
+
+    cfg = GibbsConfig(model="gaussian", vary_df=False)
+    gb = JaxGibbs(demo_ma, cfg, nchains=4, chunk_size=25)
+    res = gb.sample_until(rhat_target=1.5, max_sweeps=150, check_every=50,
+                          seed=7, spool_dir=str(tmp_path / "spool"))
+    total = res.chain.shape[0]
+    assert total in (100, 150)  # first possible stop is 2 checks
+    plain = JaxGibbs(demo_ma, cfg, nchains=4, chunk_size=25).sample(
+        niter=total, seed=7)
+    np.testing.assert_allclose(res.chain, plain.chain, rtol=1e-6,
+                               atol=1e-7)
+    assert res.stats["rhat"].shape == (res.chain.shape[-1],)
+
+
 def test_jax_sample_spool_thin_resume(tmp_path, demo_ma):
     """Spooled runs with record_thin keep sweep-indexed bookkeeping
     (meta base / checkpoint sweeps) while spool rows are recorded rows;
