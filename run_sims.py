@@ -53,13 +53,20 @@ def model_configs(pspin: float = 0.00457):
 
 
 def run_one(ma, cfg, backend: str, niter: int, nchains: int, seed: int,
-            record: str = "compact", record_thin: int = 1):
+            record: str = "compact", record_thin: int = 1,
+            until_rhat: float = 0.0, check_every: int = 500):
     from gibbs_student_t_tpu.backends import get_backend
 
     cls = get_backend(backend)
     if cls.supports_chains:
-        return cls(ma, cfg, nchains=nchains, record=record,
-                   record_thin=record_thin).sample(niter=niter, seed=seed)
+        gb = cls(ma, cfg, nchains=nchains, record=record,
+                 record_thin=record_thin)
+        if until_rhat:
+            # convergence-stopped run: --niter becomes the cap
+            return gb.sample_until(rhat_target=until_rhat,
+                                   max_sweeps=niter,
+                                   check_every=check_every, seed=seed)
+        return gb.sample(niter=niter, seed=seed)
     gb = cls(ma, cfg)
     return gb.sample(ma.x_init(np.random.default_rng(seed)), niter,
                      seed=seed)
@@ -71,6 +78,14 @@ def _summarize(key: str, res, dt: float, niter: int) -> str:
     parts = [f"{key}: {dt:.1f}s, {niter / dt:.1f} sweeps/s"]
     parts += [f"acc[{blk}]={acc.mean():.2f}"
               for blk, acc in res.acceptance_rates().items()]
+    if "rhat" in res.stats:
+        # convergence-stopped runs did fewer sweeps than the --niter
+        # cap: report throughput from the rows actually sampled
+        sweeps = res.chain.shape[0] * int(res.stats.get("record_thin", 1))
+        parts[0] = f"{key}: {dt:.1f}s, {sweeps / dt:.1f} sweeps/s"
+        parts.append(f"rhat_max={float(np.max(res.stats['rhat'])):.3f}"
+                     f" converged={bool(res.stats['converged'])}"
+                     f" rows={res.chain.shape[0]}")
     return "  # " + ", ".join(parts)
 
 
@@ -160,6 +175,14 @@ def main(argv=None):
                          "(jax backend; Robbins-Monro, then frozen — set "
                          "--burn to at least N rows). 0 = the "
                          "reference's fixed scales")
+    ap.add_argument("--until-rhat", type=float, default=0.0,
+                    metavar="TARGET",
+                    help="jax backend: stop each config once every "
+                         "parameter's split-R-hat over the chain axis "
+                         "drops below TARGET (--niter becomes the cap; "
+                         "checked every --check-every sweeps)")
+    ap.add_argument("--check-every", type=int, default=500,
+                    help="sweeps between R-hat checks for --until-rhat")
     ap.add_argument("--record", default="compact",
                     choices=["compact", "full", "light"],
                     help="chain recording mode (jax backend): transport "
@@ -184,6 +207,34 @@ def main(argv=None):
     ap.add_argument("--pspin", type=float, default=0.00457)
     args = ap.parse_args(argv)
 
+    # validate flag combinations BEFORE any dataset work: a bad combo
+    # must not cost a simulation (or, with several models/thetas, crash
+    # hours into the sweep)
+    all_configs = model_configs(args.pspin)
+    if args.adapt and args.backend != "jax":
+        ap.error("--adapt is a jax-backend feature; the NumPy oracle "
+                 "runs the reference's fixed jump scales "
+                 "(pass --backend jax)")
+    if args.until_rhat:
+        if args.backend != "jax":
+            ap.error("--until-rhat needs the chain axis "
+                     "(pass --backend jax)")
+        if args.ensemble:
+            ap.error("--until-rhat is not wired to --ensemble yet")
+        thin = max(args.record_thin, 1)
+        if (args.check_every < 1 or args.check_every % thin
+                or args.check_every // thin < 8):
+            ap.error("--check-every must be a multiple of --record-thin "
+                     "covering >= 8 recorded rows")
+    unknown = set(args.models) - set(all_configs)
+    if unknown:
+        ap.error(f"unknown --models {sorted(unknown)}; "
+                 f"choose from {sorted(all_configs)}")
+    if args.adapt:
+        all_configs = {k: v.with_adapt(args.adapt)
+                       for k, v in all_configs.items()}
+    configs = {k: v for k, v in all_configs.items() if k in args.models}
+
     from simulate_data import ensure_base_dataset
     from gibbs_student_t_tpu.data.pulsar import Pulsar
     from gibbs_student_t_tpu.data.simulate import simulate_data
@@ -191,19 +242,6 @@ def main(argv=None):
     rng = np.random.default_rng(args.seed)
     parfile, timfile = ensure_base_dataset(args.par, args.tim, args.simdir,
                                            args.ntoa, args.seed)
-    all_configs = model_configs(args.pspin)
-    if args.adapt:
-        if args.backend != "jax":
-            ap.error("--adapt is a jax-backend feature; the NumPy "
-                     "oracle runs the reference's fixed jump scales "
-                     "(pass --backend jax)")
-        all_configs = {k: v.with_adapt(args.adapt)
-                       for k, v in all_configs.items()}
-    unknown = set(args.models) - set(all_configs)
-    if unknown:
-        ap.error(f"unknown --models {sorted(unknown)}; "
-                 f"choose from {sorted(all_configs)}")
-    configs = {k: v for k, v in all_configs.items() if k in args.models}
 
     if args.ensemble:
         if args.backend != "jax":
@@ -229,7 +267,9 @@ def main(argv=None):
                 t0 = time.perf_counter()
                 res = run_one(ma, cfg, args.backend, args.niter,
                               args.nchains, seed, record=args.record,
-                              record_thin=args.record_thin)
+                              record_thin=args.record_thin,
+                              until_rhat=args.until_rhat,
+                              check_every=args.check_every)
                 dt = time.perf_counter() - t0
                 out = os.path.join(outdir, key, str(theta), str(idx))
                 res.burn(args.burn).save(out)

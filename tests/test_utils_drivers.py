@@ -120,6 +120,32 @@ def test_run_sims_driver_jax(tmp_path):
 
 
 @pytest.mark.slow
+def test_run_sims_until_rhat(tmp_path):
+    """--until-rhat: convergence-stopped runs from the batch driver; the
+    saved chains stop at a check boundary <= the --niter cap and the
+    observability line reports the R-hat verdict."""
+    r = _run_script(
+        ["/root/repo/run_sims.py", "--backend", "jax", "--niter", "60",
+         "--burn", "5", "--nchains", "6", "--thetas", "0.1",
+         "--ntoa", "30", "--components", "5", "--models", "gaussian",
+         "--until-rhat", "1.5", "--check-every", "20",
+         "--simdir", str(tmp_path / "sim"),
+         "--outdirs", str(tmp_path / "o1"), str(tmp_path / "o2")],
+        str(tmp_path))
+    assert r.returncode == 0, r.stderr
+    lines = [l for l in r.stdout.splitlines() if l.strip()]
+    chain = np.load(os.path.join(lines[0], "chain.npy"))
+    assert chain.shape[0] % 20 == 15  # burn 5 off a 20-multiple
+    assert chain.shape[0] <= 55
+    assert "rhat_max=" in r.stderr and "converged=" in r.stderr
+    # cpu backend is rejected up front
+    r2 = _run_script(
+        ["/root/repo/run_sims.py", "--backend", "cpu", "--until-rhat",
+         "1.2", "--simdir", str(tmp_path / "sim2")], str(tmp_path))
+    assert r2.returncode != 0 and "until-rhat" in r2.stderr
+
+
+@pytest.mark.slow
 def test_bench_quick(tmp_path):
     r = _run_script(["/root/repo/bench.py", "--quick"], str(tmp_path))
     assert r.returncode == 0, r.stderr
