@@ -145,6 +145,103 @@ def chunked_sweep_loop(state, niter, chunk_size, start_sweep,
     return state, n_reinits
 
 
+def _rhat_per_param(window):
+    """(p,) split-R-hat per parameter over a (rows, nchains, p) window."""
+    from gibbs_student_t_tpu.parallel.diagnostics import split_rhat
+
+    return np.array([split_rhat(window[..., pi])
+                     for pi in range(window.shape[-1])])
+
+
+def _sample_until_loop(sample_fn, last_state_fn, record_thin, rhat_of,
+                       rhat_target, max_sweeps, check_every, min_sweeps,
+                       state, spool_mode):
+    """Shared convergence-stopping loop behind ``JaxGibbs.sample_until``
+    and ``EnsembleGibbs.sample_until`` — segments of ``check_every``
+    sweeps until ``rhat_of`` (computed on the second half of the
+    accumulated chains) clears ``rhat_target`` everywhere.
+
+    ``sample_fn(length, state, start_sweep) -> ChainResult`` runs one
+    segment; ``spool_mode`` means each segment's result is already the
+    reloaded FULL history (utils/spool.py), so only the latest is kept
+    (and its counters are cumulative); otherwise segments are
+    concatenated, with per-call ``n_reinits`` summed."""
+    if check_every % record_thin or (check_every // record_thin) < 8:
+        raise ValueError(
+            "check_every must be a multiple of record_thin covering "
+            ">= 8 recorded rows, or the split-R-hat window degenerates"
+            f" (got {check_every} at record_thin={record_thin})")
+    if max_sweeps % record_thin:
+        # fail now, not at the final partial segment after hours of work
+        raise ValueError(
+            f"max_sweeps ({max_sweeps}) must be a multiple of "
+            f"record_thin ({record_thin})")
+    segments = []
+    history = []
+    done = 0
+    converged = False
+
+    def window_of(segs, total_rows):
+        """Rows [total_rows//2:] without re-concatenating the full
+        history every check (only the tail segments that overlap)."""
+        start = total_rows // 2
+        out, r0 = [], 0
+        for s in segs:
+            r1 = r0 + s.shape[0]
+            if r1 > start:
+                out.append(s[max(0, start - r0):])
+            r0 = r1
+        return np.concatenate(out)
+
+    res = None
+    while done < max_sweeps:
+        length = min(check_every, max_sweeps - done)
+        res = sample_fn(length, state, done)
+        state = last_state_fn()
+        done += length
+        if spool_mode:
+            total_rows = res.chain.shape[0]
+            window = res.chain[total_rows // 2:]
+        else:
+            segments.append(res)
+            total_rows = sum(s.chain.shape[0] for s in segments)
+            window = window_of([s.chain for s in segments], total_rows)
+        # second half of the accumulated run: the usual split-R-hat
+        # convention folds early-transient sweeps out of the window
+        rhat = rhat_of(window)
+        history.append(rhat)
+        if done >= max(min_sweeps, 2 * check_every) and (
+                rhat < rhat_target).all():
+            converged = True
+            break
+    if spool_mode:
+        out = res  # already the full history, cumulative counters
+    else:
+        cols = {}
+        for f in dataclasses.fields(ChainResult):
+            if f.name == "stats":
+                continue
+            arrs = [getattr(s, f.name) for s in segments]
+            cols[f.name] = (np.concatenate(arrs) if arrs[0].size
+                            else arrs[0])
+        stats = {}
+        for k in segments[0].stats:
+            v0 = segments[0].stats[k]
+            if k == "n_reinits":
+                # per-call counters: the run's total is the sum
+                stats[k] = np.asarray(sum(
+                    int(s.stats[k]) for s in segments))
+            elif k in META_STATS or np.ndim(v0) == 0:
+                stats[k] = v0
+            else:
+                stats[k] = np.concatenate([s.stats[k] for s in segments])
+        out = ChainResult(**cols, stats=stats)
+    out.stats["rhat_history"] = np.stack(history)
+    out.stats["rhat"] = history[-1]
+    out.stats["converged"] = np.asarray(converged)
+    return out
+
+
 def merge_reinit(state, bad, fresh, batch_ndim: int):
     """Replace the ``bad``-masked leading-axis entries of ``state`` with
     ``fresh`` draws; healthy entries stay bitwise identical. ``bad`` has
@@ -847,88 +944,16 @@ class JaxGibbs(SamplerBackend):
         and the returned result is the reloaded full history
         (cumulative counters included); in-memory segments are
         concatenated, with ``n_reinits`` summed across them."""
-        from gibbs_student_t_tpu.parallel.diagnostics import split_rhat
+        def sample_fn(length, st, start):
+            return self.sample(x0=x0 if start == 0 else None,
+                               niter=length, seed=seed, state=st,
+                               start_sweep=start, **sample_kwargs)
 
-        if check_every % self.record_thin or (
-                check_every // self.record_thin) < 8:
-            raise ValueError(
-                "check_every must be a multiple of record_thin covering "
-                ">= 8 recorded rows, or the split-R-hat window degenerates"
-                f" (got {check_every} at record_thin={self.record_thin})")
-        # sample() with a spool returns the ENTIRE spooled history
-        # reloaded from disk each call, so spool mode keeps only the
-        # latest result; the in-memory path accumulates segments.
-        spool_mode = bool(sample_kwargs.get("spool_dir"))
-        segments = []
-        history = []
-        done = 0
-        converged = False
-
-        def window_of(segs, total_rows):
-            """Rows [total_rows//2:] without re-concatenating the full
-            history every check (only the tail segments that overlap)."""
-            start = total_rows // 2
-            out, r0 = [], 0
-            for s in segs:
-                r1 = r0 + s.shape[0]
-                if r1 > start:
-                    out.append(s[max(0, start - r0):])
-                r0 = r1
-            return np.concatenate(out)
-
-        res = None
-        while done < max_sweeps:
-            length = min(check_every, max_sweeps - done)
-            res = self.sample(x0=x0 if done == 0 else None,
-                              niter=length, seed=seed,
-                              state=state, start_sweep=done,
-                              **sample_kwargs)
-            state = self.last_state
-            done += length
-            if spool_mode:
-                total_rows = res.chain.shape[0]
-                window = res.chain[total_rows // 2:]
-            else:
-                segments.append(res)
-                total_rows = sum(s.chain.shape[0] for s in segments)
-                window = window_of([s.chain for s in segments],
-                                   total_rows)
-            # second half of the accumulated run: the usual split-R-hat
-            # convention folds early-transient sweeps out of the window
-            rhat = np.array([split_rhat(window[..., pi])
-                             for pi in range(window.shape[-1])])
-            history.append(rhat)
-            if done >= max(min_sweeps, 2 * check_every) and (
-                    rhat < rhat_target).all():
-                converged = True
-                break
-        if spool_mode:
-            out = res  # already the full history, cumulative counters
-        else:
-            cols = {}
-            for f in dataclasses.fields(ChainResult):
-                if f.name == "stats":
-                    continue
-                arrs = [getattr(s, f.name) for s in segments]
-                cols[f.name] = (np.concatenate(arrs) if arrs[0].size
-                                else arrs[0])
-            stats = {}
-            for k in segments[0].stats:
-                v0 = segments[0].stats[k]
-                if k == "n_reinits":
-                    # per-call counters: the run's total is the sum
-                    stats[k] = np.asarray(sum(
-                        int(s.stats[k]) for s in segments))
-                elif k in META_STATS or np.ndim(v0) == 0:
-                    stats[k] = v0
-                else:
-                    stats[k] = np.concatenate([s.stats[k]
-                                               for s in segments])
-            out = ChainResult(**cols, stats=stats)
-        out.stats["rhat_history"] = np.stack(history)
-        out.stats["rhat"] = history[-1]
-        out.stats["converged"] = np.asarray(converged)
-        return out
+        return _sample_until_loop(
+            sample_fn, lambda: self.last_state, self.record_thin,
+            _rhat_per_param, rhat_target, max_sweeps, check_every,
+            min_sweeps, state,
+            spool_mode=bool(sample_kwargs.get("spool_dir")))
 
     @staticmethod
     @jax.jit

@@ -348,6 +348,36 @@ class EnsembleGibbs:
             res.stats["n_reinits"] = np.asarray(n_reinits)
         return res
 
+    def sample_until(self, rhat_target: float = 1.01,
+                     max_sweeps: int = 20000, check_every: int = 500,
+                     seed: int = 0, state: Optional[ChainState] = None,
+                     min_sweeps: int = 0,
+                     **sample_kwargs) -> ChainResult:
+        """Ensemble convergence stopping: sample until EVERY pulsar's
+        every parameter clears ``rhat_target`` (split-R-hat over that
+        pulsar's chain axis). Same loop and result semantics as
+        ``JaxGibbs.sample_until`` (backends/jax_backend.py); the R-hat
+        arrays in stats are shaped (npulsars, p)."""
+        from gibbs_student_t_tpu.backends.jax_backend import (
+            _rhat_per_param,
+            _sample_until_loop,
+        )
+
+        def rhat_of(window):
+            # window: (rows, npulsars, nchains, p) -> (npulsars, p)
+            return np.array([_rhat_per_param(window[:, pl])
+                             for pl in range(window.shape[1])])
+
+        def sample_fn(length, st, start):
+            return self.sample(niter=length, seed=seed, state=st,
+                               start_sweep=start, **sample_kwargs)
+
+        return _sample_until_loop(
+            sample_fn, lambda: self.last_state,
+            self.template.record_thin, rhat_of, rhat_target,
+            max_sweeps, check_every, min_sweeps, state,
+            spool_mode=bool(sample_kwargs.get("spool_dir")))
+
     # -- divergence recovery ------------------------------------------------
 
     @staticmethod

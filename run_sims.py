@@ -141,11 +141,22 @@ def run_ensemble(args, configs, parfile, timfile, rng):
                             record=args.record,
                             record_thin=args.record_thin)
         t0 = time.perf_counter()
-        res = ens.sample(niter=args.niter, seed=seed)
+        if args.until_rhat:
+            res = ens.sample_until(rhat_target=args.until_rhat,
+                                   max_sweeps=args.niter,
+                                   check_every=args.check_every,
+                                   seed=seed)
+        else:
+            res = ens.sample(niter=args.niter, seed=seed)
         dt = time.perf_counter() - t0
-        sweeps = args.niter * args.ensemble * args.nchains
+        sweeps = (res.chain.shape[0] * args.record_thin
+                  * args.ensemble * args.nchains)
+        extra = ""
+        if "rhat" in res.stats:
+            extra = (f", rhat_max={float(np.max(res.stats['rhat'])):.3f}"
+                     f" converged={bool(res.stats['converged'])}")
         print(f"  # {key}: {dt:.1f}s, {sweeps / dt:.0f} "
-              "pulsar-chain-sweeps/s", file=sys.stderr, flush=True)
+              f"pulsar-chain-sweeps/s{extra}", file=sys.stderr, flush=True)
         burned = res.burn(args.burn)
         for i, ma in enumerate(mas):
             # simulated ensembles reuse the base pulsar's name; the index
@@ -219,13 +230,17 @@ def main(argv=None):
         if args.backend != "jax":
             ap.error("--until-rhat needs the chain axis "
                      "(pass --backend jax)")
-        if args.ensemble:
-            ap.error("--until-rhat is not wired to --ensemble yet")
         thin = max(args.record_thin, 1)
         if (args.check_every < 1 or args.check_every % thin
                 or args.check_every // thin < 8):
             ap.error("--check-every must be a multiple of --record-thin "
                      "covering >= 8 recorded rows")
+        if args.niter % thin:
+            ap.error("--niter (the sweep cap) must be a multiple of "
+                     "--record-thin")
+    if args.ensemble and args.backend != "jax":
+        ap.error("--ensemble runs the sharded JAX population; pass "
+                 "--backend jax (the NumPy oracle has no ensemble path)")
     unknown = set(args.models) - set(all_configs)
     if unknown:
         ap.error(f"unknown --models {sorted(unknown)}; "
@@ -244,9 +259,6 @@ def main(argv=None):
                                            args.ntoa, args.seed)
 
     if args.ensemble:
-        if args.backend != "jax":
-            ap.error("--ensemble runs the sharded JAX population; pass "
-                     "--backend jax (the NumPy oracle has no ensemble path)")
         run_ensemble(args, configs, parfile, timfile, rng)
         return
 

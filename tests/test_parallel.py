@@ -370,6 +370,26 @@ def test_ensemble_sample_recovers_injected_divergence():
     assert np.isfinite(res.chain[-1]).all()
 
 
+def test_ensemble_sample_until():
+    """Ensemble convergence stopping: per-(pulsar, param) split-R-hat
+    gates the stop; chains are bit-identical to a plain run of the same
+    length and run-level metadata survives."""
+    mas = [make_demo_pta(make_demo_pulsar(seed=88 + i, n=24)[0],
+                         components=4).frozen() for i in range(2)]
+    cfg = GibbsConfig(model="gaussian", vary_df=False)
+    ens = EnsembleGibbs(mas, cfg, nchains=4, chunk_size=10)
+    res = ens.sample_until(rhat_target=1.5, max_sweeps=60,
+                           check_every=20, seed=2)
+    total = res.chain.shape[0]
+    assert total in (40, 60)
+    assert res.stats["rhat"].shape == (2, res.chain.shape[-1])
+    assert res.stats["rhat_history"].shape[0] == total // 20
+    assert tuple(res.stats["n_toa"]) == (24, 24)
+    plain = EnsembleGibbs(mas, cfg, nchains=4, chunk_size=10).sample(
+        niter=total, seed=2)
+    np.testing.assert_array_equal(res.chain, plain.chain)
+
+
 def test_ensemble_record_thin_rows_match():
     """Ensemble twin of the single-model thinning guarantee: identical
     keying, rows = every t-th sweep, bit-exact vs the unthinned run."""

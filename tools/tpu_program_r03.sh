@@ -23,6 +23,17 @@ python bench.py --stress --platform axon \
   > artifacts/BENCH_STRESS_r03.out 2> artifacts/BENCH_STRESS_r03.err
 say "stage 2 rc=$? json=$(tail -1 artifacts/BENCH_STRESS_r03.out)"
 
+# Stage 2b: the reference's own recorded headline shape — its ONLY real
+# measurement is 19 sweeps/s single-chain at n=12863 TOAs, m~54
+# (gibbs_likelihood.ipynb cell 5; SURVEY.md §6). Same shape here,
+# demo dataset, 256 chains.
+say "stage 2b: bench.py notebook-scale (n=12863, 20 components)"
+python bench.py --platform axon --dataset demo --ntoa 12863 \
+  --components 20 --nchains 256 --niter 50 --chunk 25 \
+  --baseline-sweeps 30 \
+  > artifacts/BENCH_NOTEBOOK_r03.out 2> artifacts/BENCH_NOTEBOOK_r03.err
+say "stage 2b rc=$? json=$(tail -1 artifacts/BENCH_NOTEBOOK_r03.out)"
+
 # Stage 3: on-chip posterior gate with theta/df gates (next-round #7).
 say "stage 3: tools/tpu_gate.py"
 python tools/tpu_gate.py --out artifacts/tpu_gate_r03.json \
