@@ -171,6 +171,8 @@ def _sample_until_loop(sample_fn, last_state_fn, record_thin, rhat_of,
             "check_every must be a multiple of record_thin covering "
             ">= 8 recorded rows, or the split-R-hat window degenerates"
             f" (got {check_every} at record_thin={record_thin})")
+    if max_sweeps < 1:
+        raise ValueError(f"max_sweeps must be >= 1, got {max_sweeps}")
     if max_sweeps % record_thin:
         # fail now, not at the final partial segment after hours of work
         raise ValueError(

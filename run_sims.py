@@ -235,9 +235,16 @@ def main(argv=None):
                 or args.check_every // thin < 8):
             ap.error("--check-every must be a multiple of --record-thin "
                      "covering >= 8 recorded rows")
-        if args.niter % thin:
-            ap.error("--niter (the sweep cap) must be a multiple of "
-                     "--record-thin")
+        if args.niter % thin or args.niter < 1:
+            ap.error("--niter (the sweep cap) must be a positive "
+                     "multiple of --record-thin")
+        if args.burn >= 2 * args.check_every // thin:
+            ap.error(
+                f"--burn ({args.burn} rows) must be smaller than the "
+                f"earliest possible --until-rhat stop "
+                f"(2 x check-every / record-thin = "
+                f"{2 * args.check_every // thin} rows), or an early "
+                "convergence would save empty chains")
     if args.ensemble and args.backend != "jax":
         ap.error("--ensemble runs the sharded JAX population; pass "
                  "--backend jax (the NumPy oracle has no ensemble path)")

@@ -390,6 +390,25 @@ def test_ensemble_sample_until():
     np.testing.assert_array_equal(res.chain, plain.chain)
 
 
+def test_ensemble_adaptive_mh_engages():
+    """The sweep index threads through the ensemble chunk, so MH
+    adaptation works under shard_map-less ensembles too: acceptance
+    moves toward the target and the per-population scales differ from
+    their zero init."""
+    import dataclasses
+
+    mas = [make_demo_pta(make_demo_pulsar(seed=91 + i, n=24)[0],
+                         components=4).frozen() for i in range(2)]
+    cfg = GibbsConfig(model="gaussian", vary_df=False)
+    cfg = dataclasses.replace(
+        cfg, mh=dataclasses.replace(cfg.mh, adapt_until=100))
+    ens = EnsembleGibbs(mas, cfg, nchains=3, chunk_size=50)
+    res = ens.sample(niter=200, seed=5)
+    acc = float(res.stats["acc_white"][100:].mean())
+    assert 0.15 < acc < 0.7, f"adapted ensemble acceptance {acc:.2f}"
+    assert np.abs(np.asarray(ens.last_state.mh_log_scale)).max() > 0.1
+
+
 def test_ensemble_record_thin_rows_match():
     """Ensemble twin of the single-model thinning guarantee: identical
     keying, rows = every t-th sweep, bit-exact vs the unthinned run."""

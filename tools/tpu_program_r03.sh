@@ -34,6 +34,13 @@ python bench.py --platform axon --dataset demo --ntoa 12863 \
   > artifacts/BENCH_NOTEBOOK_r03.out 2> artifacts/BENCH_NOTEBOOK_r03.err
 say "stage 2b rc=$? json=$(tail -1 artifacts/BENCH_NOTEBOOK_r03.out)"
 
+# Stage 2c: BASELINE config 2 (synthetic 1e3-TOA pulsar, 64 chains).
+say "stage 2c: bench.py config-2 (n=1000, 64 chains)"
+python bench.py --platform axon --dataset demo --ntoa 1000 \
+  --nchains 64 --niter 100 --chunk 50 \
+  > artifacts/BENCH_CFG2_r03.out 2> artifacts/BENCH_CFG2_r03.err
+say "stage 2c rc=$? json=$(tail -1 artifacts/BENCH_CFG2_r03.out)"
+
 # Stage 3: on-chip posterior gate with theta/df gates (next-round #7).
 say "stage 3: tools/tpu_gate.py"
 python tools/tpu_gate.py --out artifacts/tpu_gate_r03.json \
