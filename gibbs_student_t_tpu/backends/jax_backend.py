@@ -305,8 +305,10 @@ class JaxGibbs(SamplerBackend):
         are in recorded rows.
         ``use_pallas`` routes the blocked TNT reduction through the fused
         Pallas TPU kernel (ops/pallas_tnt.py), batched over all chains
-        between the vmapped sweep stages; ``"auto"`` enables it on TPU
-        when the blocked path is active. ``pallas_interpret`` runs the
+        between the vmapped sweep stages; ``"auto"`` resolves to False —
+        the hardware A/B measured the XLA scan faster in every regime
+        where the blocked path is active (artifacts/pallas_tnt_tpu_r02):
+        the kernel is kept opt-in for A/B only. ``pallas_interpret`` runs the
         kernel in interpreter mode (CPU testing). ``hyper_schur``
         pre-eliminates the phi-static basis columns (timing block,
         constant-pinned GPs) from the hyper-MH factorization once per
@@ -421,8 +423,14 @@ class JaxGibbs(SamplerBackend):
                        if hyper_schur else None)
         self._pallas_interpret = pallas_interpret
         if use_pallas == "auto":
-            use_pallas = (self._block_size is not None
-                          and jax.default_backend() in ("tpu", "axon"))
+            # Measured, not assumed: the blocked regime (n >= 16384, the
+            # only one where this dispatch matters) is exactly where the
+            # Pallas TNT lost the on-chip A/B to the XLA scan
+            # (artifacts/pallas_tnt_tpu_r02.json), and at the 1e5-TOA
+            # stress shape with block 4096 it VMEM-OOMs outright
+            # (artifacts/BENCH_STRESS_r03.err). Auto therefore always
+            # takes the XLA scan; pass use_pallas=True for A/B.
+            use_pallas = False
         elif use_pallas and self._block_size is None:
             raise ValueError("use_pallas requires a tnt_block_size")
         self._use_pallas = bool(use_pallas)

@@ -78,6 +78,20 @@ def _tnt_kernel(T_ref, w_ref, wy_ref, tnt_ref, d_ref, *, chain_tile: int):
                                   precision=hi)
 
 
+def _auto_chain_tile(block_size: int, mp: int, C: int) -> int:
+    """Default chain tile under a ~6 MB VMEM budget.
+
+    The unrolled per-chain loop materializes a ``(block_size, mp)`` f32
+    weighted-basis temporary per chain, and Mosaic keeps several alive
+    at once — at block 4096, mp 128 a 32-chain tile blew the 16 MB
+    scoped-VMEM stack (measured: 22.13 MB requested,
+    artifacts/BENCH_STRESS_r03.err). The grid's chain axis absorbs what
+    the tile gives up.
+    """
+    per_chain = block_size * mp * 4
+    return max(1, min(32, C, (6 << 20) // per_chain))
+
+
 def tnt_batched_pallas(T, y, nvec, block_size: int = 256,
                        chain_tile: Optional[int] = None,
                        interpret: bool = False):
@@ -94,8 +108,9 @@ def tnt_batched_pallas(T, y, nvec, block_size: int = 256,
     if n % block_size != 0:
         raise ValueError(f"n ({n}) must be a multiple of block_size "
                          f"({block_size}); use ops.tnt.pad_rows")
+    mp = _round_up(m, 128)
     if chain_tile is None:
-        chain_tile = min(32, C)
+        chain_tile = _auto_chain_tile(block_size, mp, C)
     cpad = _round_up(C, chain_tile) - C
     w = 1.0 / nvec
     wy = y[None, :] * w
@@ -103,7 +118,6 @@ def tnt_batched_pallas(T, y, nvec, block_size: int = 256,
         # padded chains: weight zero -> zero outputs, sliced off below
         w = jnp.concatenate([w, jnp.zeros((cpad, n), w.dtype)])
         wy = jnp.concatenate([wy, jnp.zeros((cpad, n), wy.dtype)])
-    mp = _round_up(m, 128)
     Tp = jnp.pad(T, ((0, 0), (0, mp - m)))
     Ct = chain_tile
     grid = ((C + cpad) // Ct, n // block_size)
@@ -161,13 +175,15 @@ def tnt_batched_xla(T, y, nvec,
 
 def tnt_batched(T, y, nvec, block_size: Optional[int] = None,
                 use_pallas: Optional[bool] = None, interpret: bool = False):
-    """Dispatch: the Pallas kernel on TPU, the XLA scan elsewhere.
+    """Dispatch: the Pallas kernel when asked for, the XLA scan otherwise.
 
-    ``use_pallas=None`` auto-detects the default device platform.
+    ``use_pallas=None`` resolves to the XLA scan: the on-chip A/B
+    measured it faster than this kernel in every blocked regime
+    (artifacts/pallas_tnt_tpu_r02.json), so the kernel is opt-in A/B
+    material, not a default.
     """
     if use_pallas is None:
-        use_pallas = (_HAVE_PLTPU
-                      and jax.default_backend() in ("tpu", "axon"))
+        use_pallas = False
     if jnp.result_type(T, y, nvec) == jnp.float64:
         # the kernel accumulates in f32; silently degrading an f64 run's
         # TNT/d precision would be worse than the slower XLA path

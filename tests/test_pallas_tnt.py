@@ -62,6 +62,51 @@ def test_dispatch_prefers_xla_off_tpu():
         np.testing.assert_allclose(a, b, rtol=1e-5)
 
 
+def test_backend_auto_never_picks_pallas_tnt():
+    """``use_pallas="auto"`` must resolve to the XLA scan even where the
+    blocked path is active: the on-chip A/B measured the scan faster in
+    that whole regime, and at the 1e5-TOA stress shape the kernel
+    VMEM-OOMed on hardware (artifacts/BENCH_STRESS_r03.err) — auto
+    selecting it turned the stress bench into a CPU fallback."""
+    from gibbs_student_t_tpu.backends import JaxGibbs
+    from gibbs_student_t_tpu.config import GibbsConfig
+    from tests.conftest import make_demo_pta, make_demo_pulsar
+
+    psr, _ = make_demo_pulsar(seed=11, n=40, theta=0.1)
+    ma = make_demo_pta(psr, components=5).frozen()
+    gb = JaxGibbs(ma, GibbsConfig(model="mixture"), nchains=2,
+                  tnt_block_size=32)  # blocked path active, auto pallas
+    assert gb._use_pallas is False
+
+
+def test_auto_chain_tile_respects_vmem_budget():
+    """The default chain tile shrinks with block_size so the unrolled
+    per-chain weighted-basis temporaries stay inside the ~6 MB budget
+    (32 chains x (4096, 128) f32 temporaries blew the 16 MB scoped-VMEM
+    stack on hardware)."""
+    from gibbs_student_t_tpu.ops.pallas_tnt import _auto_chain_tile
+
+    # block 4096, mp 128 -> per-chain temp 2 MB -> tile capped at 3
+    assert _auto_chain_tile(4096, 128, C=64) == 3
+    # the stress shape that OOMed: must now fit well under 16 MB
+    assert _auto_chain_tile(4096, 128, C=64) * 4096 * 128 * 4 <= 6 << 20
+    # small blocks keep the old wide tile; tiny batches never exceed C
+    assert _auto_chain_tile(256, 128, C=64) == 32
+    assert _auto_chain_tile(256, 128, C=5) == 5
+    # pathological: never below one chain
+    assert _auto_chain_tile(65536, 256, C=8) == 1
+
+    # and a capped-tile run still computes the right answer
+    T, y, nvec = _problem(C=6, n=512, m=7)
+    out = tnt_batched_pallas(
+        jnp.tile(T, (8, 1)), jnp.tile(y, 8), jnp.tile(nvec, (1, 8)),
+        block_size=4096, interpret=True)
+    assert out[0].shape == (6, 7, 7)
+    ref = tnt_batched_xla(jnp.tile(T, (8, 1)), jnp.tile(y, 8),
+                          jnp.tile(nvec, (1, 8)))
+    np.testing.assert_allclose(out[0], ref[0], rtol=2e-3, atol=2e-3)
+
+
 def test_backend_pallas_sweep_matches_vmap_path():
     """The batched-sweep chunk driver (Pallas TNT between vmapped stages)
     must reproduce the per-chain vmap path — same keys, same math."""

@@ -1,0 +1,48 @@
+#!/bin/bash
+# Round-3 hardware program, part B: runs after tpu_program_r03.sh
+# completes. Same relay discipline (docs/PERFORMANCE.md): ONE JAX client
+# at a time, fresh process per stage, nothing signals a client, and no
+# other CPU-hungry work while a stage runs (single-core host — a
+# concurrent pytest measurably halves the transfer-bound bench wall,
+# compare artifacts/BENCH_TPU_r03.out vs BENCH_TPU_r03b.out).
+# Launch detached:  setsid nohup bash tools/tpu_program_r03b.sh &
+set -u
+cd "$(dirname "$0")/.."
+LOG=artifacts/tpu_program_r03b.log
+say() { echo "[$(date -u +%FT%TZ)] $*" >> "$LOG"; }
+
+say "=== TPU program r03b start ==="
+
+# Stage 5: clean flagship rerun (stage 1 ran concurrently with a pytest
+# sweep on this 1-core host; this is the uncontended official number).
+say "stage 5: bench.py flagship, uncontended"
+python bench.py --platform axon \
+  > artifacts/BENCH_TPU_r03b.out 2> artifacts/BENCH_TPU_r03b.err
+say "stage 5 rc=$? json=$(tail -1 artifacts/BENCH_TPU_r03b.out)"
+
+# Stage 5b: stress rerun on-chip. Stage 2's attempt VMEM-OOMed because
+# use_pallas=auto engaged the Pallas TNT exactly where the A/B had
+# measured it slower (fixed: auto now always takes the XLA scan), so
+# its artifact is a CPU fallback; this is the real hardware stress
+# number (BASELINE config 4, VERDICT r2 next #3).
+say "stage 5b: bench.py --stress on-chip (XLA-scan TNT)"
+python bench.py --stress --platform axon \
+  > artifacts/BENCH_STRESS_TPU_r03.out 2> artifacts/BENCH_STRESS_TPU_r03.err
+say "stage 5b rc=$? json=$(tail -1 artifacts/BENCH_STRESS_TPU_r03.out)"
+
+# Stage 6: adaptive-MH on-chip — the ESS/s headline with the round-3
+# sampler improvement engaged (tagged adapt_sweeps in the JSON line;
+# the official metric stays fixed-scale).
+say "stage 6: bench.py --adapt 100"
+python bench.py --platform axon --adapt 100 \
+  > artifacts/BENCH_ADAPT_TPU_r03.out 2> artifacts/BENCH_ADAPT_TPU_r03.err
+say "stage 6 rc=$? json=$(tail -1 artifacts/BENCH_ADAPT_TPU_r03.out)"
+
+# Stage 7: record_thin=8 on-chip — the compute-bound regime under the
+# slow relay link (tagged record_thin in the JSON line).
+say "stage 7: bench.py --record-thin 8"
+python bench.py --platform axon --record-thin 8 --niter 400 \
+  > artifacts/BENCH_THIN_TPU_r03.out 2> artifacts/BENCH_THIN_TPU_r03.err
+say "stage 7 rc=$? json=$(tail -1 artifacts/BENCH_THIN_TPU_r03.out)"
+
+say "=== TPU program r03b done ==="
