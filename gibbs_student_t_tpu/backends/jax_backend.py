@@ -91,13 +91,48 @@ _RECORD_FIELDS = ("x", "b", "z", "theta", "alpha", "df", "pout",
                   "acc_white", "acc_hyper")
 
 # record="compact": device->host transport dtypes for the bulky recorded
-# fields. z is exactly 0/1 so uint8 is lossless; pout is a probability
+# fields. z is exactly 0/1 so it is bit-packed (8 indicators per byte,
+# lossless — unpacked bit-exactly on host); pout is a probability
 # (float16 keeps ~3 decimal digits); b/alpha need float32 *range*
 # (alpha spans many decades) so bfloat16. Host arrays are re-materialized
 # as float32 — the cast exists only on the wire, where chain recording is
-# bandwidth-bound (~200 MB per 100-sweep chunk at 1024 chains otherwise).
-_COMPACT_CASTS = {"z": jnp.uint8, "pout": jnp.float16,
+# bandwidth-bound (~200 MB per 100-sweep chunk at 1024 chains otherwise;
+# the relay link runs tens of MB/s, docs/PERFORMANCE.md).
+_PACKBITS = "packbits"
+_U8PROB = "u8prob"
+
+_COMPACT_CASTS = {"z": _PACKBITS, "pout": jnp.float16,
                   "b": jnp.bfloat16, "alpha": jnp.bfloat16}
+
+# record="compact8": compact plus pout quantized to uint8 (levels of
+# 1/255 — ~2.4 decimal digits on a probability whose downstream use is
+# thresholded outlier maps, analysis.py). Halves the pout wire bytes on
+# top of compact; opt-in because it is the lossiest tier.
+_COMPACT8_CASTS = dict(_COMPACT_CASTS, pout=_U8PROB)
+
+
+def _pack_bits(a):
+    """Little-endian bit-pack a 0/1 array along its last axis:
+    (..., n) -> (..., ceil(n/8)) uint8. Lossless for the z indicator
+    chains; the host side restores exactly with
+    ``np.unpackbits(..., bitorder='little')`` (``_unpack_bits``)."""
+    n = a.shape[-1]
+    pad = (-n) % 8
+    b = jnp.asarray(a, jnp.uint8)
+    if pad:
+        b = jnp.concatenate(
+            [b, jnp.zeros(b.shape[:-1] + (pad,), jnp.uint8)], axis=-1)
+    b = b.reshape(b.shape[:-1] + ((n + pad) // 8, 8))
+    w = jnp.left_shift(jnp.uint32(1), jnp.arange(8, dtype=jnp.uint32))
+    return (b.astype(jnp.uint32) * w).sum(axis=-1).astype(jnp.uint8)
+
+
+def _unpack_bits(h, n):
+    """Host-side inverse of ``_pack_bits``: (..., ceil(n/8)) uint8 ->
+    (..., n) float32 of exact 0/1 values."""
+    bits = np.unpackbits(np.asarray(h, np.uint8), axis=-1,
+                         bitorder="little")
+    return bits[..., :n].astype(np.float32)
 
 
 def record_tuple(st, fields, casts):
@@ -105,9 +140,18 @@ def record_tuple(st, fields, casts):
     chunk functions below and the ensemble's sharded chunk
     (parallel/ensemble.py), so the compact transport rules live in
     exactly one place (``_COMPACT_CASTS``)."""
-    return tuple(
-        getattr(st, f).astype(casts[f]) if f in casts else getattr(st, f)
-        for f in fields)
+    out = []
+    for f in fields:
+        v = getattr(st, f)
+        c = casts.get(f) if casts else None
+        if c is _PACKBITS:
+            v = _pack_bits(v)
+        elif c is _U8PROB:
+            v = jnp.clip(jnp.round(v * 255.0), 0, 255).astype(jnp.uint8)
+        elif c is not None:
+            v = v.astype(c)
+        out.append(v)
+    return tuple(out)
 
 
 def chunked_sweep_loop(state, niter, chunk_size, start_sweep,
@@ -284,12 +328,14 @@ class JaxGibbs(SamplerBackend):
         ``"auto"`` picks by TOA count. ``record`` picks the chain
         recording mode: ``"compact"`` (default) records every field but
         moves the bulky ones device->host in narrow transport dtypes —
-        z as uint8 (exact: values are 0/1), pout as float16 (a
+        z bit-packed 8-per-byte (exact: values are 0/1), pout as float16 (a
         probability; ~3 decimal digits), b and alpha as bfloat16
         (float32 range, ~2-3 significant digits) — then re-materializes
-        float32 host arrays, cutting transfer bytes ~2.2x (the sampled
+        float32 host arrays, cutting transfer bytes ~2.5x (the sampled
         parameter chains x/theta/df and acceptance stats are always
-        exact float32); ``"full"`` transports everything in float32
+        exact float32); ``"compact8"`` additionally quantizes pout to
+        uint8 (1/255 steps — plenty for thresholded outlier maps),
+        ~3x total; ``"full"`` transports everything in float32
         bit-exactly; ``"light"`` records only the O(1)-per-sweep fields
         (x, theta, df, acceptance) — at stress scale the per-TOA chains
         (z, alpha, pout) dominate host transfer.
@@ -327,9 +373,9 @@ class JaxGibbs(SamplerBackend):
         self.nchains = nchains
         self.dtype = dtype
         self.chunk_size = chunk_size
-        if record not in ("full", "compact", "light"):
-            raise ValueError("record must be 'full', 'compact' or "
-                             f"'light', got {record!r}")
+        if record not in ("full", "compact", "compact8", "light"):
+            raise ValueError("record must be 'full', 'compact', "
+                             f"'compact8' or 'light', got {record!r}")
         self._record_mode = record
         if record_thin < 1:
             raise ValueError(f"record_thin must be >= 1, got {record_thin}")
@@ -344,8 +390,12 @@ class JaxGibbs(SamplerBackend):
         # compact transport only applies to float32 runs: an explicit
         # float64 run asked for full precision and must get bit-exact
         # float64 chains back (the casts would silently narrow them)
-        self._record_casts = (_COMPACT_CASTS if record == "compact"
-                              and dtype == jnp.float32 else {})
+        self._record_casts = {}
+        if dtype == jnp.float32:
+            if record == "compact":
+                self._record_casts = _COMPACT_CASTS
+            elif record == "compact8":
+                self._record_casts = _COMPACT8_CASTS
         if tnt_block_size == "auto":
             tnt_block_size = auto_block_size(ma.n)
         self._block_size = tnt_block_size
@@ -1002,16 +1052,27 @@ class JaxGibbs(SamplerBackend):
         return merge_reinit(state, bad, self.init_state(seed=seed),
                             batch_ndim=1), n_bad
 
-    def _materialize(self, host):
+    def _materialize(self, host, n_last=None):
         """Undo the record-transport casts: the narrow wire dtypes
         (record="compact") come back as float32 host arrays, so
         downstream consumers (spool files, ChainResult, analysis) see
-        the same dtypes as a record="full" run."""
+        the same dtypes as a record="full" run. ``n_last`` overrides the
+        unpacked length of bit-packed per-TOA fields — the ensemble's
+        records are padded to its n_max, not this backend's own n."""
         if not self._record_casts:
             return list(host)
-        return [np.asarray(h, np.float32) if f in self._record_casts
-                else h
-                for f, h in zip(self._record_fields, host)]
+        out = []
+        for f, h in zip(self._record_fields, host):
+            c = self._record_casts.get(f)
+            if c is _PACKBITS:
+                out.append(_unpack_bits(h, n_last or self._ma.n))
+            elif c is _U8PROB:
+                out.append(np.asarray(h, np.float32) / 255.0)
+            elif c is not None:
+                out.append(np.asarray(h, np.float32))
+            else:
+                out.append(h)
+        return out
 
     def _trim(self, field: str, arr: np.ndarray) -> np.ndarray:
         """Cut TOA padding (block padding and/or a pre-padded model's
@@ -1027,7 +1088,9 @@ class JaxGibbs(SamplerBackend):
         which get bit-exact chains regardless of the requested mode)."""
         if self._record_mode == "light":
             return "light"
-        return "compact" if self._record_casts else "full"
+        if not self._record_casts:
+            return "full"
+        return self._record_mode  # "compact" or "compact8"
 
     def _to_result(self, cols) -> ChainResult:
         empty = np.zeros((0,))

@@ -132,7 +132,7 @@ class EnsembleGibbs:
     no cross-pulsar terms); sampling runs ``shard_map``-ed over
     ``mesh = ('pulsar', 'chain')``, falling back to plain ``vmap`` without
     a mesh. ``record`` takes the same modes as ``JaxGibbs``
-    ("compact"/"full"/"light"), with the identical wire casts and
+    ("compact"/"compact8"/"full"/"light"), with the identical wire casts and
     double-buffered device->host flushes.
     """
 
@@ -305,7 +305,10 @@ class EnsembleGibbs:
                       if spool is not None and resume else 0)
 
         def flush(recs, chunk_state, sweep_end, n_reinits):
-            host = self.template._materialize(jax.device_get(recs))
+            # n_last: ensemble records are padded to n_max (stacked
+            # models), not the template pulsar's own TOA count
+            host = self.template._materialize(
+                jax.device_get(recs), n_last=int(self.stacked.y.shape[-1]))
             if spool is not None:
                 # (P, C, rows, ...) -> (rows, P, C, ...): spool rows are
                 # RECORDED rows (one per record_thin sweeps), exactly

@@ -195,7 +195,7 @@ def main(argv=None):
     ap.add_argument("--check-every", type=int, default=500,
                     help="sweeps between R-hat checks for --until-rhat")
     ap.add_argument("--record", default="compact",
-                    choices=["compact", "full", "light"],
+                    choices=["compact", "compact8", "full", "light"],
                     help="chain recording mode (jax backend): transport "
                          "dtype narrowing, full precision, or O(1) "
                          "fields only")

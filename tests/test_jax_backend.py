@@ -243,6 +243,24 @@ def test_record_thin_rows_match_unthinned(ma):
         gb.sample(niter=10, seed=3)
 
 
+def test_pack_bits_roundtrip():
+    """The compact wire bit-packs z 8-per-byte (the record stream is
+    relay-bandwidth-bound, docs/PERFORMANCE.md); device-side _pack_bits
+    and host-side _unpack_bits must be exact inverses for 0/1 data,
+    including non-multiple-of-8 TOA counts and batched leading axes."""
+    from gibbs_student_t_tpu.backends.jax_backend import (_pack_bits,
+                                                          _unpack_bits)
+    rng = np.random.default_rng(3)
+    for shape in [(130,), (3, 130), (2, 4, 136), (5, 1)]:
+        z = rng.integers(0, 2, shape).astype(np.float32)
+        packed = np.asarray(_pack_bits(jnp.asarray(z)))
+        assert packed.dtype == np.uint8
+        assert packed.shape == shape[:-1] + ((shape[-1] + 7) // 8,)
+        out = _unpack_bits(packed, shape[-1])
+        assert out.dtype == np.float32
+        np.testing.assert_array_equal(out, z)
+
+
 def test_compact_record_matches_full(ma):
     """record="compact" (the default) narrows only the device->host
     transport: the sampled-parameter chains and z come back bit-identical
@@ -263,6 +281,26 @@ def test_compact_record_matches_full(ma):
     np.testing.assert_allclose(f.poutchain, c.poutchain, atol=5e-4)
     np.testing.assert_allclose(f.bchain, c.bchain, rtol=1e-2, atol=1e-6)
     np.testing.assert_allclose(f.alphachain, c.alphachain, rtol=1e-2)
+
+
+def test_compact8_record_matches_full(ma):
+    """record="compact8" = compact plus pout quantized to uint8 on the
+    wire (1/255 steps). Everything exact stays exact; pout is within
+    half a quantization step; the mode is discoverable in stats."""
+    cfg = GibbsConfig(model="mixture", vary_df=True)
+    outs = {}
+    for mode in ("full", "compact8"):
+        gb = JaxGibbs(ma, cfg, nchains=3, chunk_size=4, record=mode)
+        outs[mode] = gb.sample(niter=9, seed=11)
+    f, c8 = outs["full"], outs["compact8"]
+    np.testing.assert_array_equal(f.chain, c8.chain)
+    np.testing.assert_array_equal(f.thetachain, c8.thetachain)
+    np.testing.assert_array_equal(f.dfchain, c8.dfchain)
+    np.testing.assert_array_equal(f.zchain, c8.zchain)
+    assert c8.poutchain.dtype == np.float32
+    np.testing.assert_allclose(f.poutchain, c8.poutchain,
+                               atol=0.5 / 255 + 1e-7)
+    assert str(c8.stats["record_mode"]) == "compact8"
 
 
 def _posterior_gate(ma, cfg, niter_np=6000, burn_np=1000, thin_np=20,

@@ -267,6 +267,31 @@ def test_ensemble_compact_record_matches_full():
     np.testing.assert_allclose(f.alphachain, c.alphachain, rtol=1e-2)
 
 
+def test_ensemble_compact8_heterogeneous():
+    """compact8 through the ensemble path, with UNEQUAL TOA counts: the
+    bit-packed z must unpack at the stacked n_max, not the template
+    pulsar's own n (JaxGibbs._materialize n_last), and pout lands within
+    its 1/255 wire step."""
+    mas = []
+    for i, n in enumerate((18, 34)):
+        psr, _ = make_demo_pulsar(seed=90 + i, n=n)
+        psr.name = f"J{i:04d}+2222"
+        mas.append(make_demo_pta(psr, components=4).frozen())
+    cfg = GibbsConfig(model="mixture")
+    outs = {}
+    for mode in ("full", "compact8"):
+        ens = EnsembleGibbs(mas, cfg, nchains=3, chunk_size=3,
+                            record=mode)
+        outs[mode] = ens.sample(niter=6, seed=5)
+    f, c8 = outs["full"], outs["compact8"]
+    np.testing.assert_array_equal(f.chain, c8.chain)
+    np.testing.assert_array_equal(f.zchain, c8.zchain)
+    np.testing.assert_allclose(f.poutchain, c8.poutchain,
+                               atol=0.5 / 255 + 1e-7)
+    assert c8.select_pulsar(0).zchain.shape[-1] == 18
+    assert str(c8.stats["record_mode"]) == "compact8"
+
+
 def test_pallas_chol_engages_inside_shard_map(monkeypatch):
     """The custom_vmap Pallas Cholesky dispatch must survive the
     ensemble's shard_map + nested vmap and land in the traced program
