@@ -303,9 +303,11 @@ def block_timings(gb, seed: int = 0, iters: int = 5) -> str:
     else:
         tnt = jax.jit(jax.vmap(lambda nv: tnt_products(
             gb._ma.T, gb._ma.y, nv, gb._block_size)))
+    # sweep=0 so the microbench composes with adaptive-MH configs
+    # (adapt_until > 0 requires the sweep index; None would raise)
     rest = jax.jit(jax.vmap(
         lambda st, xx, aw, t, dd, cc, kk:
-        gb._sweep_rest(st, xx, aw, t, dd, cc, kk, None)))
+        gb._sweep_rest(st, xx, aw, t, dd, cc, kk, None, 0)))
 
     # compile outside the timed loop
     x, acc_w, nvec = jax.block_until_ready(white(state, ks[:, 0]))
@@ -346,6 +348,13 @@ def main(argv=None):
                          "Official metric keeps 0 = the reference's "
                          "fixed scales; a nonzero value is tagged in "
                          "the JSON line")
+    ap.add_argument("--record", default=None,
+                    choices=("full", "compact", "compact8", "light"),
+                    help="chain recording mode (default: compact, the "
+                         "backend's production default; --stress uses "
+                         "light). compact8 additionally quantizes pout "
+                         "to uint8 on the wire; a non-default choice is "
+                         "tagged in the JSON line")
     ap.add_argument("--record-thin", type=int, default=1,
                     help="record every Nth sweep on device (cuts record "
                          "transport N-fold; every sweep still runs). The "
@@ -382,6 +391,8 @@ def main(argv=None):
         args.niter, args.chunk = 20, 10
         args.baseline_sweeps = 3
         record = "light"
+    if args.record is not None:
+        record = args.record
     # validate after the quick/stress shape overrides but up front — the
     # numpy baseline takes minutes and a bad thin value must not burn it
     # before erroring
@@ -506,6 +517,11 @@ def main(argv=None):
         # flagged so a thinned experiment can never be mistaken for the
         # official every-sweep-recorded metric
         line["record_thin"] = args.record_thin
+    if record != "compact":
+        # non-default EFFECTIVE wire format (explicit --record, or the
+        # --stress override to light) is flagged so the line can't pass
+        # as the production-default metric
+        line["record"] = record
     if args.adapt:
         line["adapt_sweeps"] = args.adapt
     if jax_ess is not None:

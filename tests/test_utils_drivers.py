@@ -63,6 +63,24 @@ def test_record_mode_discoverable(ma):
     assert str(res.burn(2).stats["record_mode"]) == "compact"
 
 
+def test_block_timings_composes_with_adapt(ma):
+    """bench's per-block microbench must drive _sweep_rest with a real
+    sweep index: an adapt-enabled config (MHConfig.adapt_until > 0)
+    rejects sweep=None, which on 2026-07-31 failed the whole on-chip
+    accelerator attempt of `bench.py --adapt` (the fallback ladder then
+    landed on CPU, artifacts/BENCH_ADAPT_TPU_r03.err)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, root)
+    try:
+        import bench
+    finally:
+        sys.path.remove(root)
+    cfg = GibbsConfig(model="mixture").with_adapt(50)
+    gb = JaxGibbs(ma, cfg, nchains=2, chunk_size=4)
+    out = bench.block_timings(gb, iters=1)
+    assert "white_mh_block" in out
+
+
 def test_block_timer():
     bt = BlockTimer()
     bt.time("noop", lambda: np.zeros(3))
@@ -156,10 +174,11 @@ def test_bench_quick(tmp_path):
 
 @pytest.fixture()
 def bench_mod():
-    sys.path.insert(0, "/root/repo")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, root)
     import bench
     yield bench
-    sys.path.remove("/root/repo")
+    sys.path.remove(root)
 
 
 def test_probe_success_path(bench_mod, tmp_path, monkeypatch):
