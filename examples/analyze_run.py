@@ -44,6 +44,7 @@ def main(argv=None):
     from gibbs_student_t_tpu.analysis import (
         acceptance_report,
         outlier_confusion,
+        plot_corner,
         plot_df_posterior,
         plot_outlier_map,
         plot_posteriors,
@@ -105,6 +106,8 @@ def main(argv=None):
     plot_outlier_map(res, mjds, os.path.join(args.outdir, "outliers.png"),
                      z_true=z_true)
     plot_waveform(res, ma, mjds, os.path.join(args.outdir, "waveform.png"))
+    plot_corner(res, ma.param_names[: min(6, len(ma.param_names))],
+                os.path.join(args.outdir, "corner.png"))
     if cfg.vary_df:
         plot_df_posterior(res, os.path.join(args.outdir, "df.png"))
     if cfg.is_outlier_model:

@@ -235,6 +235,51 @@ def plot_waveform(res: ChainResult, ma: ModelArrays, mjds: np.ndarray,
     plt.close(fig)
 
 
+def plot_corner(res: ChainResult, names: Sequence[str], path: str,
+                truths: Optional[Dict[str, float]] = None,
+                bins: int = 30) -> None:
+    """Pairwise posterior ("corner") grid: marginal histograms on the
+    diagonal, 2-D density below it — the role the external ``corner``
+    package plays in the reference notebook (gibbs_likelihood.ipynb
+    cells 12-14), first-party here so validation needs no extra deps."""
+    plt = _plt()
+    chain = _flat(np.asarray(res.chain), 1)
+    idx = list(range(len(names)))
+    p = len(idx)
+    fig, axes = plt.subplots(p, p, figsize=(2.2 * p, 2.2 * p),
+                             squeeze=False)
+    for r in range(p):
+        for c in range(p):
+            ax = axes[r][c]
+            if c > r:
+                ax.axis("off")
+                continue
+            if c == r:
+                ax.hist(chain[:, idx[r]], bins=bins, density=True,
+                        histtype="step")
+                if truths and names[r] in truths:
+                    ax.axvline(truths[names[r]], color="k", ls="--", lw=1)
+            else:
+                ax.hist2d(chain[:, idx[c]], chain[:, idx[r]], bins=bins,
+                          cmap="Blues")
+                if truths and names[c] in truths:
+                    ax.axvline(truths[names[c]], color="k", ls="--", lw=1)
+                if truths and names[r] in truths:
+                    ax.axhline(truths[names[r]], color="k", ls="--", lw=1)
+            if r == p - 1:
+                ax.set_xlabel(names[c], fontsize=8)
+            else:
+                ax.set_xticklabels([])
+            if c == 0 and r > 0:
+                ax.set_ylabel(names[r], fontsize=8)
+            else:
+                ax.set_yticklabels([])
+            ax.tick_params(labelsize=6)
+    fig.tight_layout()
+    fig.savefig(path, dpi=110)
+    plt.close(fig)
+
+
 def plot_df_posterior(res: ChainResult, path: str, df_max: int = 30) -> None:
     """Dof posterior bars (reference cell 24)."""
     plt = _plt()

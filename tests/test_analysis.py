@@ -106,5 +106,8 @@ def test_plots_write_files(tmp_path, demo_ma):
                               z_true=np.zeros(demo_ma.n))
     analysis.plot_waveform(res, demo_ma, mjds, str(tmp_path / "w.png"))
     analysis.plot_df_posterior(res, str(tmp_path / "d.png"))
-    for f in ("p.png", "o.png", "w.png", "d.png"):
+    analysis.plot_corner(res, demo_ma.param_names[:3],
+                         str(tmp_path / "c.png"),
+                         truths={demo_ma.param_names[0]: 0.0})
+    for f in ("p.png", "o.png", "w.png", "d.png", "c.png"):
         assert (tmp_path / f).stat().st_size > 0
