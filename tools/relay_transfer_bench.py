@@ -1,0 +1,107 @@
+"""Measure device->host transfer characteristics of the TPU relay link.
+
+The flagship benchmark is record-transport-bound (docs/PERFORMANCE.md):
+per chunk, ``sample()`` pulls a tuple of per-field record buffers with
+``jax.device_get``. This tool answers two questions that decide the next
+wire-format optimization:
+
+1. What is the achieved bandwidth for a single large contiguous buffer
+   (the best case the link can do)?
+2. Is there a meaningful per-fetch overhead — i.e. does fetching the
+   same bytes as N separate arrays (what the record pytree does today)
+   cost materially more than one coalesced buffer?
+
+Run ONE client at a time per the relay discipline. Writes a JSON
+artifact with latency/bandwidth per shape.
+
+Usage:  python tools/relay_transfer_bench.py --out artifacts/relay_transfer_r03.json
+"""
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def _time_get(make, reps=3):
+    """Median wall seconds to device_get a FRESH pytree per rep.
+
+    ``make()`` must return newly-computed device arrays each call:
+    jax.Array caches its fetched host value (``_npy_value``), so timing
+    repeat fetches of the same array measures the cache, not the link
+    (the first version of this tool reported ~900 GB/s that way)."""
+    import jax
+    ts = []
+    for _ in range(reps):
+        xs = make()
+        jax.block_until_ready(xs)
+        t0 = time.perf_counter()
+        host = jax.device_get(xs)
+        ts.append(time.perf_counter() - t0)
+        del host, xs
+    return float(np.median(ts))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="artifacts/relay_transfer_bench.json")
+    ap.add_argument("--reps", type=int, default=3)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    results = {"platform": dev.platform,
+               "device_kind": getattr(dev, "device_kind", "")}
+
+    # iota + a traced op so each make() yields a genuinely fresh,
+    # uncached device array with incompressible-ish content
+    counter = [0]
+
+    def fresh(nbytes):
+        counter[0] += 1
+        c = counter[0]
+
+        def make():
+            return (jax.lax.iota(jnp.uint8, nbytes) + jnp.uint8(c))
+
+        return make
+
+    # Single contiguous buffers across 3 decades of size.
+    sizes_mb = [0.125, 1, 8, 32]
+    single = []
+    for mb in sizes_mb:
+        nbytes = int(mb * 2 ** 20)
+        t = _time_get(fresh(nbytes), args.reps)
+        single.append({"mb": mb, "sec": t, "mb_per_s": mb / t})
+    results["single_buffer"] = single
+
+    # Same total bytes (32 MB), split 1 / 7 / 56 ways: does per-fetch
+    # overhead matter at record-pytree granularity?
+    total_mb = 32
+    split = []
+    for nparts in (1, 7, 56):
+        part = int(total_mb * 2 ** 20) // nparts
+
+        def make(nparts=nparts, part=part):
+            counter[0] += 1
+            c = counter[0]
+            return [jax.lax.iota(jnp.uint8, part) + jnp.uint8(c + i)
+                    for i in range(nparts)]
+
+        t = _time_get(make, args.reps)
+        split.append({"parts": nparts, "total_mb": total_mb, "sec": t,
+                      "mb_per_s": total_mb / t})
+    results["split_32mb"] = split
+
+    # Tiny-fetch latency (the per-roundtrip floor).
+    results["tiny_fetch_sec"] = _time_get(fresh(16), args.reps)
+
+    with open(args.out, "w") as f:
+        json.dump(results, f, indent=1)
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

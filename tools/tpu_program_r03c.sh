@@ -1,0 +1,77 @@
+#!/bin/bash
+# Round-3 hardware program, part C: everything still outstanding after
+# the 01:00 UTC relay recovery ran stage 1 (contended bench, 65.5x) and
+# stage 2 (stress VMEM-OOM, since fixed) before the session restart
+# killed the runner. Same relay discipline (docs/PERFORMANCE.md): ONE
+# JAX client at a time, fresh process per stage, nothing signals a
+# client, no concurrent CPU-hungry work (1-core host).
+# Launch detached:  setsid nohup bash tools/tpu_program_r03c.sh &
+set -u
+cd "$(dirname "$0")/.."
+LOG=artifacts/tpu_program_r03c.log
+say() { echo "[$(date -u +%FT%TZ)] $*" >> "$LOG"; }
+
+say "=== TPU program r03c start ==="
+
+# Stage 5: clean flagship rerun (stage 1 ran concurrently with a pytest
+# sweep on this 1-core host; this is the uncontended official number).
+say "stage 5: bench.py flagship, uncontended"
+python bench.py --platform axon \
+  > artifacts/BENCH_TPU_r03b.out 2> artifacts/BENCH_TPU_r03b.err
+say "stage 5 rc=$? json=$(tail -1 artifacts/BENCH_TPU_r03b.out)"
+
+# Stage 5b: stress rerun on-chip. Stage 2's attempt VMEM-OOMed because
+# use_pallas=auto engaged the Pallas TNT exactly where the A/B had
+# measured it slower (fixed: auto now always takes the XLA scan).
+say "stage 5b: bench.py --stress on-chip (XLA-scan TNT)"
+python bench.py --stress --platform axon \
+  > artifacts/BENCH_STRESS_TPU_r03.out 2> artifacts/BENCH_STRESS_TPU_r03.err
+say "stage 5b rc=$? json=$(tail -1 artifacts/BENCH_STRESS_TPU_r03.out)"
+
+# Stage 2b: the reference's own recorded headline shape (n=12863, m~54;
+# gibbs_likelihood.ipynb cell 5, SURVEY.md §6). Demo dataset, 256 chains.
+say "stage 2b: bench.py notebook-scale (n=12863, 20 components)"
+python bench.py --platform axon --dataset demo --ntoa 12863 \
+  --components 20 --nchains 256 --niter 50 --chunk 25 \
+  --baseline-sweeps 30 \
+  > artifacts/BENCH_NOTEBOOK_r03.out 2> artifacts/BENCH_NOTEBOOK_r03.err
+say "stage 2b rc=$? json=$(tail -1 artifacts/BENCH_NOTEBOOK_r03.out)"
+
+# Stage 2c: BASELINE config 2 (synthetic 1e3-TOA pulsar, 64 chains).
+say "stage 2c: bench.py config-2 (n=1000, 64 chains)"
+python bench.py --platform axon --dataset demo --ntoa 1000 \
+  --nchains 64 --niter 100 --chunk 50 \
+  > artifacts/BENCH_CFG2_r03.out 2> artifacts/BENCH_CFG2_r03.err
+say "stage 2c rc=$? json=$(tail -1 artifacts/BENCH_CFG2_r03.out)"
+
+# Stage 3: on-chip posterior gate with theta/df gates (VERDICT next #7).
+say "stage 3: tools/tpu_gate.py"
+python tools/tpu_gate.py --out artifacts/tpu_gate_r03.json \
+  > artifacts/tpu_gate_r03.out 2>&1
+say "stage 3 rc=$?"
+
+# Stage 4: ensemble on hardware (VERDICT next #4): shard_map mesh on the
+# single chip, flagship-scale populations, beta config.
+say "stage 4: run_sims.py --ensemble on chip"
+python run_sims.py --backend jax --ensemble 4 --nchains 256 \
+  --niter 200 --burn 50 --thetas 0.1 --ntoa 130 --components 30 \
+  --models beta --seed 7 --simdir /tmp/ens_sim_r03 \
+  --outdirs /tmp/ens_out_r03 /tmp/ens_out2_r03 \
+  > artifacts/ENSEMBLE_TPU_r03.out 2> artifacts/ENSEMBLE_TPU_r03.err
+say "stage 4 rc=$?"
+
+# Stage 6: adaptive-MH on-chip — ESS/s with the round-3 sampler
+# improvement engaged (tagged adapt_sweeps in the JSON line).
+say "stage 6: bench.py --adapt 100"
+python bench.py --platform axon --adapt 100 \
+  > artifacts/BENCH_ADAPT_TPU_r03.out 2> artifacts/BENCH_ADAPT_TPU_r03.err
+say "stage 6 rc=$? json=$(tail -1 artifacts/BENCH_ADAPT_TPU_r03.out)"
+
+# Stage 7: record_thin=8 on-chip — the compute-bound regime under the
+# slow relay link (tagged record_thin in the JSON line).
+say "stage 7: bench.py --record-thin 8"
+python bench.py --platform axon --record-thin 8 --niter 400 \
+  > artifacts/BENCH_THIN_TPU_r03.out 2> artifacts/BENCH_THIN_TPU_r03.err
+say "stage 7 rc=$? json=$(tail -1 artifacts/BENCH_THIN_TPU_r03.out)"
+
+say "=== TPU program r03c done ==="

@@ -1,0 +1,20 @@
+#!/bin/bash
+# Round-3 hardware program, part D: waits for part C to finish, then
+# runs the relay transfer microbench (wire-format optimization input).
+# Same relay discipline: ONE JAX client at a time.
+# Launch detached:  setsid nohup bash tools/tpu_program_r03d.sh &
+set -u
+cd "$(dirname "$0")/.."
+LOG=artifacts/tpu_program_r03d.log
+say() { echo "[$(date -u +%FT%TZ)] $*" >> "$LOG"; }
+
+say "=== TPU program r03d queued (waiting for r03c) ==="
+while ! grep -q "r03c done" artifacts/tpu_program_r03c.log 2>/dev/null; do
+  sleep 60
+done
+
+say "stage 8: relay transfer microbench"
+python tools/relay_transfer_bench.py --out artifacts/relay_transfer_r03.json \
+  > artifacts/relay_transfer_r03.out 2>&1
+say "stage 8 rc=$?"
+say "=== TPU program r03d done ==="

@@ -1,0 +1,46 @@
+#!/bin/bash
+# Round-3 hardware program, part E: wire-format A/B for the packed
+# record transport (z bit-pack in compact; compact8 = + uint8 pout).
+# Waits for part D to finish AND for .tests_green_r03e (full pytest on
+# the new wire code) before touching the relay. ONE client at a time.
+# Launch detached:  setsid nohup bash tools/tpu_program_r03e.sh &
+set -u
+cd "$(dirname "$0")/.."
+LOG=artifacts/tpu_program_r03e.log
+say() { echo "[$(date -u +%FT%TZ)] $*" >> "$LOG"; }
+
+say "=== TPU program r03e queued (waiting for r03d + tests green) ==="
+while ! grep -q "r03d done" artifacts/tpu_program_r03d.log 2>/dev/null \
+   || [ ! -f .tests_green_r03e ]; do
+  sleep 30
+done
+
+# A/B baseline is stage 5 (compact, unpacked z): 13210 ch-sw/s, 86.66x.
+say "stage 9: flagship, compact with packed z"
+python bench.py --platform axon \
+  > artifacts/BENCH_PACKED_r03.out 2> artifacts/BENCH_PACKED_r03.err
+say "stage 9 rc=$? json=$(tail -1 artifacts/BENCH_PACKED_r03.out)"
+
+say "stage 9b: flagship, compact8"
+python bench.py --platform axon --record compact8 \
+  > artifacts/BENCH_C8_r03.out 2> artifacts/BENCH_C8_r03.err
+say "stage 9b rc=$? json=$(tail -1 artifacts/BENCH_C8_r03.out)"
+
+# A/B baseline is stage 2b (compact, unpacked z): 199.24 ch-sw/s.
+say "stage 9c: notebook-scale, compact with packed z"
+python bench.py --platform axon --dataset demo --ntoa 12863 \
+  --components 20 --nchains 256 --niter 50 --chunk 25 \
+  --baseline-sweeps 6 \
+  > artifacts/BENCH_NOTEBOOK_PACKED_r03.out \
+  2> artifacts/BENCH_NOTEBOOK_PACKED_r03.err
+say "stage 9c rc=$? json=$(tail -1 artifacts/BENCH_NOTEBOOK_PACKED_r03.out)"
+
+say "stage 9d: notebook-scale, compact8"
+python bench.py --platform axon --dataset demo --ntoa 12863 \
+  --components 20 --nchains 256 --niter 50 --chunk 25 \
+  --baseline-sweeps 6 --record compact8 \
+  > artifacts/BENCH_NOTEBOOK_C8_r03.out \
+  2> artifacts/BENCH_NOTEBOOK_C8_r03.err
+say "stage 9d rc=$? json=$(tail -1 artifacts/BENCH_NOTEBOOK_C8_r03.out)"
+
+say "=== TPU program r03e done ==="

@@ -1,0 +1,34 @@
+#!/bin/bash
+# Round-3 hardware program, part F: adaptive-MH on-chip rerun. Stage 6
+# (part C) crashed in block_timings — _sweep_rest was driven without a
+# sweep index, which the adapt guard rejects (fixed in bench.py by
+# passing sweep=0) — and its fallback ladder landed on CPU. Waits for
+# part E so exactly ONE JAX client touches the relay at a time.
+# Launch detached:  setsid nohup bash tools/tpu_program_r03f.sh &
+set -u
+cd "$(dirname "$0")/.."
+LOG=artifacts/tpu_program_r03f.log
+say() { echo "[$(date -u +%FT%TZ)] $*" >> "$LOG"; }
+
+say "=== TPU program r03f queued (waiting for r03e) ==="
+while ! grep -q "r03e done" artifacts/tpu_program_r03e.log 2>/dev/null; do
+  sleep 30
+done
+
+say "stage 10: bench.py --adapt 100 (fixed block_timings)"
+python bench.py --platform axon --adapt 100 \
+  > artifacts/BENCH_ADAPT_TPU_r03.out 2> artifacts/BENCH_ADAPT_TPU_r03.err
+say "stage 10 rc=$? json=$(tail -1 artifacts/BENCH_ADAPT_TPU_r03.out)"
+
+say "stage 10b: bench.py --adapt 100 --record compact8 (all opt-ins)"
+python bench.py --platform axon --adapt 100 --record compact8 \
+  > artifacts/BENCH_ADAPT_C8_r03.out 2> artifacts/BENCH_ADAPT_C8_r03.err
+say "stage 10b rc=$? json=$(tail -1 artifacts/BENCH_ADAPT_C8_r03.out)"
+
+say "stage 10c: bench.py --record-thin 8 --record compact8 --niter 400"
+python bench.py --platform axon --record-thin 8 --record compact8 \
+  --niter 400 --chunk 96 \
+  > artifacts/BENCH_THIN_C8_r03.out 2> artifacts/BENCH_THIN_C8_r03.err
+say "stage 10c rc=$? json=$(tail -1 artifacts/BENCH_THIN_C8_r03.out)"
+
+say "=== TPU program r03f done ==="
