@@ -317,7 +317,7 @@ class JaxGibbs(SamplerBackend):
                  nchains: int = 64, dtype=jnp.float32,
                  chunk_size: int = 100,
                  tnt_block_size: int | str | None = "auto",
-                 record: str = "compact",
+                 record: str = "compact8",
                  record_thin: int = 1,
                  use_pallas: bool | str = "auto",
                  pallas_interpret: bool = False,
@@ -326,17 +326,21 @@ class JaxGibbs(SamplerBackend):
         an int for a ``lax.scan`` over row blocks (the 1e5-TOA stress path,
         BASELINE.json config 4; TOA axis zero-padded to a block multiple),
         ``"auto"`` picks by TOA count. ``record`` picks the chain
-        recording mode: ``"compact"`` (default) records every field but
-        moves the bulky ones device->host in narrow transport dtypes —
-        z bit-packed 8-per-byte (exact: values are 0/1), pout as float16 (a
-        probability; ~3 decimal digits), b and alpha as bfloat16
-        (float32 range, ~2-3 significant digits) — then re-materializes
-        float32 host arrays, cutting transfer bytes ~2.5x (the sampled
-        parameter chains x/theta/df and acceptance stats are always
-        exact float32); ``"compact8"`` additionally quantizes pout to
-        uint8 (1/255 steps — plenty for thresholded outlier maps),
-        ~3x total; ``"full"`` transports everything in float32
-        bit-exactly; ``"light"`` records only the O(1)-per-sweep fields
+        recording mode: ``"compact8"`` (default) records every field
+        but moves the bulky ones device->host in narrow transport
+        dtypes — z bit-packed 8-per-byte (exact: values are 0/1), pout
+        as uint8 (1/255 steps — a diagnostic probability whose
+        downstream consumers are 0.5/0.9 thresholds, analysis.py), b
+        and alpha as bfloat16 (float32 range — alpha spans decades —
+        ~2-3 significant digits) — then re-materializes float32 host
+        arrays, ~3x fewer bytes than full (the sampled parameter chains
+        x/theta/df and acceptance stats are always exact float32). The
+        default is the cheapest tier that preserves every downstream
+        use; measured 2.25x wall-clock on the transport-bound flagship
+        (docs/PERFORMANCE.md). ``"compact"`` keeps pout at float16
+        (~3 decimal digits); ``"full"`` transports everything in
+        float32 bit-exactly; ``"light"`` records only the
+        O(1)-per-sweep fields
         (x, theta, df, acceptance) — at stress scale the per-TOA chains
         (z, alpha, pout) dominate host transfer.
         ``record_thin=t`` records every t-th sweep (the state *before*

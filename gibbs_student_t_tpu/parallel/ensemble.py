@@ -139,7 +139,7 @@ class EnsembleGibbs:
     def __init__(self, mas: Sequence[ModelArrays], config: GibbsConfig,
                  nchains: int = 64, mesh: Optional[Mesh] = None,
                  dtype=jnp.float32, chunk_size: int = 50,
-                 record: str = "compact", record_thin: int = 1):
+                 record: str = "compact8", record_thin: int = 1):
         self.npulsars = len(mas)
         self.nchains = nchains
         self.mesh = mesh

@@ -53,7 +53,7 @@ def model_configs(pspin: float = 0.00457):
 
 
 def run_one(ma, cfg, backend: str, niter: int, nchains: int, seed: int,
-            record: str = "compact", record_thin: int = 1,
+            record: str = "compact8", record_thin: int = 1,
             until_rhat: float = 0.0, check_every: int = 500):
     from gibbs_student_t_tpu.backends import get_backend
 
@@ -194,7 +194,7 @@ def main(argv=None):
                          "checked every --check-every sweeps)")
     ap.add_argument("--check-every", type=int, default=500,
                     help="sweeps between R-hat checks for --until-rhat")
-    ap.add_argument("--record", default="compact",
+    ap.add_argument("--record", default="compact8",
                     choices=["compact", "compact8", "full", "light"],
                     help="chain recording mode (jax backend): transport "
                          "dtype narrowing, full precision, or O(1) "

@@ -353,7 +353,7 @@ def test_ensemble_spool_resume_matches_unbroken(tmp_path):
     # spool meta preserves run-level metadata: a later load_spool still
     # trims per-pulsar selections and reports the transport mode
     assert tuple(out.stats["n_toa"]) == (24, 24)
-    assert str(out.stats["record_mode"]) == "compact"
+    assert str(out.stats["record_mode"]) == "compact8"  # production default
     assert out.select_pulsar(0).zchain.shape[-1] == 24
 
 

@@ -56,11 +56,11 @@ def test_record_mode_discoverable(ma):
     (ADVICE r2): host dtypes are float32 either way."""
     cfg = GibbsConfig(model="mixture")
     res = JaxGibbs(ma, cfg, nchains=2, chunk_size=5).sample(niter=5, seed=0)
-    assert str(res.stats["record_mode"]) == "compact"
+    assert str(res.stats["record_mode"]) == "compact8"  # production default
     resf = JaxGibbs(ma, cfg, nchains=2, chunk_size=5,
                     record="full").sample(niter=5, seed=0)
     assert str(resf.stats["record_mode"]) == "full"
-    assert str(res.burn(2).stats["record_mode"]) == "compact"
+    assert str(res.burn(2).stats["record_mode"]) == "compact8"
 
 
 def test_block_timings_composes_with_adapt(ma):
