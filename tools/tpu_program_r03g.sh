@@ -1,0 +1,30 @@
+#!/bin/bash
+# Round-3 hardware program, part G: (a) the official no-flag bench with
+# the new compact8 production default; (b) record-thin rerun with
+# niter a multiple of chunk (stage 10c's 400%96=16 partial chunk
+# recompiled inside the timed window and undercounted 3x).
+# ONE JAX client at a time.
+set -u
+cd "$(dirname "$0")/.."
+LOG=artifacts/tpu_program_r03g.log
+say() { echo "[$(date -u +%FT%TZ)] $*" >> "$LOG"; }
+
+say "=== TPU program r03g start ==="
+
+say "stage 11: bench.py (no flags, production default compact8)"
+python bench.py --platform axon \
+  > artifacts/BENCH_DEFAULT_r03.out 2> artifacts/BENCH_DEFAULT_r03.err
+say "stage 11 rc=$? json=$(tail -1 artifacts/BENCH_DEFAULT_r03.out)"
+
+say "stage 11b: bench.py --record-thin 8 --niter 384 --chunk 96"
+python bench.py --platform axon --record-thin 8 --niter 384 --chunk 96 \
+  > artifacts/BENCH_THIN8_r03.out 2> artifacts/BENCH_THIN8_r03.err
+say "stage 11b rc=$? json=$(tail -1 artifacts/BENCH_THIN8_r03.out)"
+
+say "stage 11c: bench.py --adapt 100 (with compact8 default)"
+python bench.py --platform axon --adapt 100 \
+  > artifacts/BENCH_ADAPT_DEFAULT_r03.out \
+  2> artifacts/BENCH_ADAPT_DEFAULT_r03.err
+say "stage 11c rc=$? json=$(tail -1 artifacts/BENCH_ADAPT_DEFAULT_r03.out)"
+
+say "=== TPU program r03g done ==="

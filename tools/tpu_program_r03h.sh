@@ -1,0 +1,20 @@
+#!/bin/bash
+# Round-3 hardware program, part H: on-chip posterior gate rerun with
+# the compact8 production default active (the gated chains x/theta/df
+# are exact in every wire tier, but the artifact proves it on hardware).
+# Waits for part G. ONE JAX client at a time.
+set -u
+cd "$(dirname "$0")/.."
+LOG=artifacts/tpu_program_r03h.log
+say() { echo "[$(date -u +%FT%TZ)] $*" >> "$LOG"; }
+
+say "=== TPU program r03h queued (waiting for r03g) ==="
+while ! grep -q "r03g done" artifacts/tpu_program_r03g.log 2>/dev/null; do
+  sleep 30
+done
+
+say "stage 12: tools/tpu_gate.py under compact8 default"
+python tools/tpu_gate.py --out artifacts/tpu_gate_r03b.json \
+  > artifacts/tpu_gate_r03b.out 2>&1
+say "stage 12 rc=$?"
+say "=== TPU program r03h done ==="
