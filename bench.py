@@ -435,11 +435,11 @@ def main(argv=None):
                 passthrough.append(a)
         child_args = [sys.executable, os.path.abspath(__file__),
                       "--platform", platform] + passthrough
-        # ladder: accelerator with the default kernel (Pallas
-        # lane-batched Cholesky + Schur, hardware A/B in
-        # artifacts/tpu_validation_r02b.json) -> accelerator with the
-        # Pallas kernel off, i.e. the XLA expander path (in case the
-        # custom kernel ever miscompiles on a new libtpu) -> cpu.
+        # ladder: accelerator with the default kernels (fused white +
+        # hyper MH blocks, Pallas lane-batched Cholesky, Schur) ->
+        # fused MH blocks off (Pallas chol still on) -> every Pallas
+        # kernel off, i.e. the XLA expander path (in case a custom
+        # kernel ever miscompiles on a new libtpu) -> cpu.
         # Child stdout goes to a file and is forwarded only on success,
         # so the "exactly one JSON line" contract survives partial
         # children. On deadline expiry the child is ABANDONED alive —
@@ -447,8 +447,13 @@ def main(argv=None):
         # work is what wedged the relay in round 2
         # (artifacts/RELAY_WEDGE_r02.json; VERDICT r2 weak #2).
         for attempt, extra_env in (("default kernel", {}),
+                                   ("no-fused-mh fallback",
+                                    {"GST_PALLAS_WHITE": "0",
+                                     "GST_PALLAS_HYPER": "0"}),
                                    ("no-pallas-chol fallback",
-                                    {"GST_PALLAS_CHOL": "0"})):
+                                    {"GST_PALLAS_CHOL": "0",
+                                     "GST_PALLAS_WHITE": "0",
+                                     "GST_PALLAS_HYPER": "0"})):
             out_path = os.path.abspath(
                 f".bench_child_{os.getpid()}_{attempt.split()[0]}_"
                 f"{time.time_ns()}.out")

@@ -369,10 +369,13 @@ class JaxGibbs(SamplerBackend):
         columns exist; ``True`` raises if the split is degenerate.
 
         Env overrides (``GST_HYPER_SCHUR``, ``GST_PALLAS_CHOL``,
-        ``GST_UNROLLED_CHOL``) are consulted at construction/trace time
+        ``GST_UNROLLED_CHOL``, ``GST_PALLAS_WHITE``,
+        ``GST_PALLAS_HYPER``) are consulted at construction/trace time
         and baked into the compiled sweep: set them *before* constructing
         the backend; flipping them afterwards does not affect an existing
-        instance (ops/linalg.py ``_pallas_chol_mode``)."""
+        instance (ops/linalg.py ``_pallas_chol_mode``). The white/hyper
+        flags gate the fused whole-MH-block kernels (ops/pallas_white.py,
+        ops/pallas_hyper.py), both ``auto``-on for TPU backends."""
         super().__init__(ma, config)
         self.nchains = nchains
         self.dtype = dtype
@@ -490,6 +493,48 @@ class JaxGibbs(SamplerBackend):
         self._use_pallas = bool(use_pallas)
         self._pspin = (config.pspin * ma.time_scale
                        if config.pspin is not None else 1.0)
+        # Fused white-noise MH block (ops/pallas_white.py): the whole
+        # 20-step block as one Pallas launch on TPU, dispatched through
+        # custom_vmap like the Cholesky kernel. Built only for the
+        # float32 frozen-model path; ``GST_PALLAS_WHITE`` (same
+        # trace-time snapshot semantics as GST_PALLAS_CHOL) gates the
+        # actual kernel use inside the dispatcher.
+        self._white_block = None
+        if dtype == jnp.float32 and len(self._ma.white_indices):
+            from gibbs_student_t_tpu.ops.pallas_white import (
+                build_white_consts,
+                make_white_block,
+            )
+
+            wc = build_white_consts(
+                self._ma,
+                None if self._row_mask is None else np.asarray(
+                    self._row_mask))
+            self._white_block = make_white_block(wc)
+        # Fused hyper MH block (ops/pallas_hyper.py): the 10-step
+        # marginalized-likelihood block as one Pallas launch, with the
+        # Schur block (or TNT) resident in VMEM across all proposals.
+        # ``GST_PALLAS_HYPER`` gates the kernel inside the dispatcher.
+        # Unlike the white block's always-on dispatcher, this one is only
+        # built when the mode resolves enabled at CONSTRUCTION time:
+        # with it off, the closure path still routes factorizations
+        # through the Pallas Cholesky dispatch (ops/linalg.py), which is
+        # what a GST_PALLAS_HYPER=0 A/B arm should measure.
+        self._hyper_block = None
+        self._hyper_consts = None
+        if dtype == jnp.float32 and len(self._ma.hyper_indices):
+            from gibbs_student_t_tpu.ops.pallas_hyper import (
+                _pallas_hyper_mode,
+                build_hyper_consts,
+                make_hyper_block,
+            )
+
+            if _pallas_hyper_mode()[0]:
+                cols = (self._schur[1] if self._schur is not None
+                        else np.arange(self._ma.m))
+                self._hyper_consts = build_hyper_consts(self._ma, cols)
+                self._hyper_block = make_hyper_block(self._hyper_consts,
+                                                     config.jitter)
         self._chunk_fn = jax.jit(self._make_chunk_fn(),
                                  static_argnames=("length",))
         self.last_state: Optional[ChainState] = None
@@ -536,41 +581,52 @@ class JaxGibbs(SamplerBackend):
     def _lnprior(self, x):
         return lnprior(self._ma, x, jnp)
 
+    def _mh_draws(self, key, ind: np.ndarray, nsteps: int, jump_scale):
+        """All of one MH block's randomness, drawn up front: coordinate
+        choices, pre-scaled jumps (the discrete scale mixture folded in,
+        reference gibbs.py:91-97/124-130), and log-uniform accept draws.
+        Batching the draws replaces ~4 threefry dispatches *per step*
+        with 4 per block — and hands the fused white kernel
+        (ops/pallas_white.py) the identical random stream the XLA loop
+        consumes, so kernel-on/off A/Bs differ only by reduction order."""
+        mh = self.config.mh
+        sigma = mh.sigma_per_param * len(ind) * jump_scale
+        sizes = jnp.asarray(mh.scale_sizes, dtype=self.dtype)
+        logits = jnp.log(jnp.asarray(mh.scale_probs, dtype=self.dtype))
+        kc, kp, kn, ku = random.split(key, 4)
+        scales = sizes[random.categorical(kc, logits, shape=(nsteps,))]
+        pars = jnp.asarray(ind)[random.randint(kp, (nsteps,), 0, len(ind))]
+        jumps = (random.normal(kn, (nsteps,), dtype=self.dtype)
+                 * sigma * scales)
+        logus = jnp.log(random.uniform(ku, (nsteps,), dtype=self.dtype))
+        return pars, jumps, logus
+
     def _mh_block(self, x, key, ind: np.ndarray, nsteps: int, loglike_fn,
                   jump_scale=1.0):
         """Branchless random-walk Metropolis on a coordinate block
         (reference gibbs.py:80-143). ``jump_scale`` multiplies the jump
         sigma (the chain's adapted log-scale, exp'd; exactly 1 when
-        adaptation is off — the body's own ``scale`` is the per-step
-        discrete mixture draw, a different thing)."""
-        mh = self.config.mh
-        sigma = mh.sigma_per_param * len(ind) * jump_scale
-        sizes = jnp.asarray(mh.scale_sizes, dtype=self.dtype)
-        logits = jnp.log(jnp.asarray(mh.scale_probs, dtype=self.dtype))
-        ind = jnp.asarray(ind)
+        adaptation is off — the per-step ``scale`` drawn in ``_mh_draws``
+        is the discrete mixture draw, a different thing)."""
+        pars, jumps, logus = self._mh_draws(key, ind, nsteps, jump_scale)
 
         ll0 = loglike_fn(x)
         lp0 = self._lnprior(x)
 
-        def body(_, carry):
-            x, ll0, lp0, acc, key = carry
-            key, k1, k2, k3, k4 = random.split(key, 5)
-            scale = sizes[random.categorical(k1, logits)]
-            par = ind[random.randint(k2, (), 0, len(ind))]
-            q = x.at[par].add(random.normal(k3, dtype=self.dtype)
-                              * sigma * scale)
+        def body(i, carry):
+            x, ll0, lp0, acc = carry
+            q = x.at[pars[i]].add(jumps[i])
             ll1 = loglike_fn(q)
             lp1 = self._lnprior(q)
-            logu = jnp.log(random.uniform(k4, dtype=self.dtype))
-            accept = (ll1 + lp1) - (ll0 + lp0) > logu
+            accept = (ll1 + lp1) - (ll0 + lp0) > logus[i]
             x = jnp.where(accept, q, x)
             ll0 = jnp.where(accept, ll1, ll0)
             lp0 = jnp.where(accept, lp1, lp0)
-            return (x, ll0, lp0, acc + accept, key)
+            return (x, ll0, lp0, acc + accept)
 
-        x, _, _, acc, _ = lax.fori_loop(
+        x, _, _, acc = lax.fori_loop(
             0, nsteps, body,
-            (x, ll0, lp0, jnp.zeros((), dtype=self.dtype), key))
+            (x, ll0, lp0, jnp.zeros((), dtype=self.dtype)))
         return x, acc / nsteps
 
     def _resolve(self, ma: ModelArrays | None):
@@ -610,7 +666,15 @@ class JaxGibbs(SamplerBackend):
     def _sweep_white(self, state: ChainState, kw, ma: ModelArrays | None):
         """Sweep stage 1: the white-noise MH block
         (reference gibbs.py:114-143). Returns the updated parameter
-        vector, the block acceptance rate, and the post-block ``nvec``."""
+        vector, the block acceptance rate, and the post-block ``nvec``.
+
+        On the backend's own frozen float32 model the whole block runs as
+        ONE fused Pallas launch (ops/pallas_white.py) when enabled — the
+        20 sequential steps are pure elementwise work whose XLA form is
+        bound by per-step fixed costs, not arithmetic
+        (docs/PERFORMANCE.md roofline). The ensemble's traced per-pulsar
+        models and float64 runs keep the XLA loop."""
+        ma_in = ma
         ma, mask, bs, _ = self._resolve(ma)
         cfg = self.config
         x, b, z, alpha = state.x, state.b, state.z, state.alpha
@@ -618,16 +682,25 @@ class JaxGibbs(SamplerBackend):
         az = alpha ** z
         if len(ma.white_indices):
             Tb = matvec_blocked(ma.T, b, bs)
-
-            def ll_white(xq):
-                nvec = self._masked_nvec(ma, mask, xq, az)
+            jump_scale = jnp.exp(state.mh_log_scale[0])
+            if ma_in is None and self._white_block is not None:
+                nsteps = cfg.mh.n_white_steps
+                pars, jumps, logus = self._mh_draws(
+                    kw, ma.white_indices, nsteps, jump_scale)
+                dx = jnp.zeros((nsteps, ma.nparam), self.dtype).at[
+                    jnp.arange(nsteps), pars].set(jumps)
                 yred = ma.y - Tb
-                return -0.5 * (jnp.sum(jnp.log(nvec))
-                               + jnp.sum(yred * yred / nvec))
+                x, acc_w = self._white_block(x, az, yred * yred, dx, logus)
+            else:
+                def ll_white(xq):
+                    nvec = self._masked_nvec(ma, mask, xq, az)
+                    yred = ma.y - Tb
+                    return -0.5 * (jnp.sum(jnp.log(nvec))
+                                   + jnp.sum(yred * yred / nvec))
 
-            x, acc_w = self._mh_block(x, kw, ma.white_indices,
-                                      cfg.mh.n_white_steps, ll_white,
-                                      jump_scale=jnp.exp(state.mh_log_scale[0]))
+                x, acc_w = self._mh_block(x, kw, ma.white_indices,
+                                          cfg.mh.n_white_steps, ll_white,
+                                          jump_scale=jump_scale)
         else:
             acc_w = jnp.zeros((), dtype=self.dtype)
         return x, acc_w, self._masked_nvec(ma, mask, x, az)
@@ -636,6 +709,7 @@ class JaxGibbs(SamplerBackend):
                     keys, ma: ModelArrays | None, sweep=None) -> ChainState:
         """Sweep stages 2-7: everything conditioned on the TNT/d inner
         products (hyper MH, coefficient draw, theta/z/alpha/df)."""
+        ma_in = ma
         ma, mask, bs, n = self._resolve(ma)
         cfg = self.config
         m = ma.m
@@ -645,37 +719,68 @@ class JaxGibbs(SamplerBackend):
 
         # --- hyper MH block on the marginalized likelihood -------------
         # (reference gibbs.py:80-111, 288-329)
+        jump_scale_h = jnp.exp(state.mh_log_scale[1])
         if self._schur is not None and len(ma.hyper_indices):
             # Once per sweep: eliminate the phi-static columns so each
             # proposal factors only the varying block — algebra and
-            # failure semantics in ops/linalg.py schur_eliminate.
+            # failure semantics in ops/linalg.py schur_eliminate. Shared
+            # by the fused and closure paths below.
             s_i, v_i = self._schur
             phiinv_s = phiinv_logdet(ma, x, jnp)[0][s_i]  # x-independent
             S0, rt, quad_s, logdetA = schur_eliminate(
                 TNT[np.ix_(s_i, s_i)] + jnp.diag(phiinv_s),
                 TNT[np.ix_(s_i, v_i)], TNT[np.ix_(v_i, v_i)],
                 d[s_i], d[v_i], cfg.jitter)
+        if (ma_in is None and self._hyper_block is not None
+                and len(ma.hyper_indices)):
+            # Fused path (ops/pallas_hyper.py): draws precomputed with
+            # the same key schedule, the whole block one Pallas launch.
+            nsteps = cfg.mh.n_hyper_steps
+            pars, jumps, logus = self._mh_draws(
+                kh, ma.hyper_indices, nsteps, jump_scale_h)
+            dxh = jnp.zeros((nsteps, ma.nparam), self.dtype).at[
+                jnp.arange(nsteps), pars].set(jumps)
+            hc = self._hyper_consts
+            if self._schur is not None:
+                base = (const_white + 0.5 * (quad_s - logdetA)
+                        - 0.5 * hc.logdet_phi_static)
+                Sh, rh = S0, rt
+            else:
+                Sh, rh = TNT, d
+                base = const_white - 0.5 * hc.logdet_phi_static
+            # phiinv_static is exactly zero on the Schur path for
+            # per-block static/varying splits, but a mixed ecorr block
+            # (const and sampled groups in one block) puts static-phi
+            # columns inside the varying subset — their constant prior
+            # precision rides on the diagonal here, matching the closure
+            # path's full phiinv[v_i].
+            dS0 = (jnp.diagonal(Sh, axis1=-2, axis2=-1)
+                   + jnp.asarray(hc.phiinv_static, self.dtype))
+            x, acc_h = self._hyper_block(x, Sh, dS0, rh, base, dxh,
+                                         logus)
+        elif len(ma.hyper_indices):
+            if self._schur is not None:
+                def ll_hyper(xq):
+                    phiinv, logdet_phi = phiinv_logdet(ma, xq, jnp)
+                    Sv = S0 + jnp.diag(phiinv[v_i])
+                    quad_v, logdet_S = precond_quad_logdet(Sv, rt,
+                                                           cfg.jitter)
+                    ll = const_white + 0.5 * (quad_s + quad_v - logdetA
+                                              - logdet_S - logdet_phi)
+                    return jnp.where(jnp.isfinite(ll), ll, -jnp.inf)
+            else:
+                def ll_hyper(xq):
+                    phiinv, logdet_phi = phiinv_logdet(ma, xq, jnp)
+                    Sigma = TNT + jnp.diag(phiinv)
+                    quad, logdet_sigma = precond_quad_logdet(Sigma, d,
+                                                             cfg.jitter)
+                    ll = const_white + 0.5 * (quad - logdet_sigma
+                                              - logdet_phi)
+                    return jnp.where(jnp.isfinite(ll), ll, -jnp.inf)
 
-            def ll_hyper(xq):
-                phiinv, logdet_phi = phiinv_logdet(ma, xq, jnp)
-                Sv = S0 + jnp.diag(phiinv[v_i])
-                quad_v, logdet_S = precond_quad_logdet(Sv, rt, cfg.jitter)
-                ll = const_white + 0.5 * (quad_s + quad_v - logdetA
-                                          - logdet_S - logdet_phi)
-                return jnp.where(jnp.isfinite(ll), ll, -jnp.inf)
-        else:
-            def ll_hyper(xq):
-                phiinv, logdet_phi = phiinv_logdet(ma, xq, jnp)
-                Sigma = TNT + jnp.diag(phiinv)
-                quad, logdet_sigma = precond_quad_logdet(Sigma, d,
-                                                         cfg.jitter)
-                ll = const_white + 0.5 * (quad - logdet_sigma - logdet_phi)
-                return jnp.where(jnp.isfinite(ll), ll, -jnp.inf)
-
-        if len(ma.hyper_indices):
             x, acc_h = self._mh_block(x, kh, ma.hyper_indices,
                                       cfg.mh.n_hyper_steps, ll_hyper,
-                                      jump_scale=jnp.exp(state.mh_log_scale[1]))
+                                      jump_scale=jump_scale_h)
         else:
             acc_h = jnp.zeros((), dtype=self.dtype)
 

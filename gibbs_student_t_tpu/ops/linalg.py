@@ -88,19 +88,16 @@ def _pallas_chol_mode():
     effect on an existing backend instance — construct a new one for an
     A/B (the pattern bench.py's fallback ladder uses: fresh process per
     rung)."""
-    env = os.environ.get("GST_PALLAS_CHOL", "auto")
-    if env in ("0", "false", ""):
-        return False, False, False
-    if env == "interpret":
-        return True, True, True
-    if env == "auto":
-        return jax.default_backend() in ("tpu", "axon"), False, False
-    return True, False, True
+    from gibbs_student_t_tpu.ops.pallas_util import mode_from_env
+
+    return mode_from_env("GST_PALLAS_CHOL")
 
 
 # Below this flattened batch size the relayout overhead outweighs the
-# kernel win and the expander is kept.
-_PALLAS_MIN_BATCH = 16
+# kernel win and the expander is kept — the shared threshold of every
+# Pallas kernel gate (ops/pallas_util.py), imported so the fused-MH
+# dispatchers' fallback assumptions cannot drift from this one.
+from gibbs_student_t_tpu.ops.pallas_util import MIN_BATCH as _PALLAS_MIN_BATCH  # noqa: E402
 
 
 def _pallas_ok(shape, dtype, forced: bool) -> bool:

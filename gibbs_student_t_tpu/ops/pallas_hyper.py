@@ -1,0 +1,508 @@
+"""Pallas TPU kernel: the whole hyper-parameter MH block in one launch.
+
+The reference's red/hyper update is 10 sequential Metropolis steps on the
+``b``-marginalized likelihood (reference gibbs.py:80-111, 288-329), each
+paying an m x m factorization. The production path already runs the
+factorizations through the lane-batched Pallas Cholesky
+(ops/pallas_chol.py), Schur-reduced to the phi-varying columns
+(ops/linalg.py schur_eliminate) — but every one of the 10 steps still
+pays XLA-level glue *around* its factorization: the (chains, v, v)
+matrix is re-read from HBM, diag-added, equilibrated, and re-laid-out to
+the kernel's (col, row, chain) form, ~4 full passes over a ~15 MB buffer
+per step (docs/PERFORMANCE.md roofline: the hyper block's non-
+factorization 2/3).
+
+This kernel hoists all of that out of the step loop: the Schur block
+``S0`` crosses HBM once per sweep (already in lane layout), and the
+entire MH block — per-proposal prior-precision evaluation, equilibrated
+Cholesky with fused forward solve, prior, masked accept — runs on-chip:
+
+- **phi is two broadcast rows, not a model walk.** Every varying phi
+  block's log-precision is affine in the sampled hypers:
+  ``logphi_col = K0_col + sum_k K_k_col * x[i_k]`` (powerlaw in
+  log10_A/gamma, ecorr in each log10_ecorr — models/pta.py
+  phiinv_logdet). The K rows are trace-time constants; a proposal's
+  phi eval is ``nk`` fused multiply-adds.
+- **the equilibrated matrix is never materialized in HBM.** With
+  ``d = diag(S0) + phiinv``, the preconditioned matrix is
+  ``S' = isd_i isd_j S0`` off-diagonal and exactly ``1 + jitter`` on
+  the diagonal (ops/linalg.py ``_equilibrate`` algebra), built directly
+  into a VMEM scratch buffer each step.
+- **same recurrence as ops/pallas_chol.py**, statically unrolled over
+  the v real columns with the forward solve fused (only
+  ``logdet``/``quad`` leave the recurrence — L is never stored); the
+  10-step MH loop is an in-kernel ``fori_loop`` so the program size
+  stays one factorization, not ten.
+- **failure semantics unchanged**: a non-PD proposal makes ``rsqrt``
+  produce NaN, the log-likelihood goes non-finite, and
+  ``NaN > logu = False`` rejects — the reference's try/except -> -inf
+  (gibbs.py:320-324), per lane.
+
+Layout is the Cholesky kernel's: matrix column index outermost, row on
+sublanes, chains on lanes; per-chain scalars are (1, chains) rows, and
+per-step draws index on the (untiled) leading axis. Constants that the
+(row, chain) planes consume are pre-broadcast over the chain axis
+outside the kernel (a few hundred KB of HBM) — cheaper than fighting
+width-1 lane slices, which Mosaic handles poorly.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.custom_batching import custom_vmap
+from jax.experimental import pallas as pl
+
+from gibbs_student_t_tpu.models.pta import (
+    ConstBlock,
+    EcorrBlock,
+    ImproperBlock,
+    PowerlawBlock,
+)
+from gibbs_student_t_tpu.ops.pallas_util import (
+    HAVE_PLTPU as _HAVE_PLTPU,
+    MIN_BATCH as _MIN_BATCH,
+    mode_from_env,
+    pltpu,
+    round_up as _round_up,
+    vmem_spec as _spec,
+)
+from gibbs_student_t_tpu.ops.pallas_white import _lnprior_cols
+
+LN10 = float(np.log(10.0))
+_LOG_2PI = float(np.log(2.0 * np.pi))
+
+# Past this column count one tile's two (v, v, lanes) buffers (S0 +
+# scratch) stop fitting; the XLA path handles larger models. Tied to
+# the Cholesky kernel's limit: the fallback below this bound is
+# loss-free exactly because such shapes were never Pallas-chol eligible
+# on the closure path either.
+from gibbs_student_t_tpu.ops.pallas_chol import MAX_PALLAS_DIM as MAX_PALLAS_V  # noqa: E402
+
+
+class HyperConsts(NamedTuple):
+    """Trace-time constants of one model's marginalized likelihood over
+    a column subset ``cols`` (the Schur varying block, or all m).
+
+    ``K``: (1 + nk, v) — row 0 the constant part of ``logphi`` on the
+    varying columns, row 1+k the coefficient of ``x[hyp_idx[k]]``.
+    ``hyp_idx``: the x-indices the K rows multiply.
+    ``phi_sel``: (v,) 1.0 where the column's phi varies with x (its
+    phiinv is evaluated in-kernel), 0.0 where static or improper.
+    ``phiinv_static``: (v,) constant phiinv of static-phi columns in the
+    subset (zero for improper columns). On the Schur path this is zero
+    for every per-block static/varying split, but NOT necessarily for a
+    mixed ecorr block (const and sampled groups in one block land whole
+    in the varying subset) — callers must always add it to the diagonal.
+    ``logdet_phi_static``: scalar — sum of logphi over ALL static-phi
+    columns of the model (inside or outside the subset; the eliminated
+    Schur block's phi lives here).
+    ``specs``: (3, p) prior table rows (kind, a, b).
+    """
+
+    K: np.ndarray
+    hyp_idx: Tuple[int, ...]
+    phi_sel: np.ndarray
+    phiinv_static: np.ndarray
+    logdet_phi_static: float
+    specs: np.ndarray
+
+
+def build_hyper_consts(ma, cols) -> HyperConsts:
+    """Decompose ``models.pta.phiinv_logdet`` into affine-in-x form.
+
+    For every phi block, ``logphi_col = const_col + sum_k coef_col *
+    x[idx_k]`` exactly (the powerlaw and ecorr formulas are
+    log-linear in the sampled hypers); improper blocks carry no phi at
+    all (zero phiinv, zero logdet — models/signals.ImproperPhi).
+    """
+    from gibbs_student_t_tpu.models.signals import FYR
+
+    m = ma.m
+    s2 = float(ma.time_scale) ** 2
+    const_col = np.zeros(m)
+    has_phi = np.zeros(m, bool)
+    varying = np.zeros(m, bool)
+    coefs: dict[int, np.ndarray] = {}
+
+    def coef_row(idx):
+        if idx not in coefs:
+            coefs[idx] = np.zeros(m)
+        return coefs[idx]
+
+    for blk in ma.phi_blocks:
+        sl = slice(blk.start, blk.stop)
+        if isinstance(blk, ImproperBlock):
+            continue
+        if isinstance(blk, ConstBlock):
+            const_col[sl] = np.log(np.asarray(blk.phi, np.float64))
+            has_phi[sl] = True
+            continue
+        if isinstance(blk, PowerlawBlock):
+            freqs = np.asarray(blk.freqs, np.float64)
+            const_col[sl] = (-np.log(12.0 * np.pi ** 2)
+                             - 3.0 * np.log(FYR)
+                             + np.log(float(blk.df)) + np.log(s2))
+            gam_vec = np.log(FYR) - np.log(freqs)
+            if blk.idx_log10A >= 0:
+                coef_row(blk.idx_log10A)[sl] += 2.0 * LN10
+                varying[sl] = True
+            else:
+                const_col[sl] += 2.0 * LN10 * float(blk.const_log10A)
+            if blk.idx_gamma >= 0:
+                coef_row(blk.idx_gamma)[sl] += gam_vec
+                varying[sl] = True
+            else:
+                const_col[sl] += float(blk.const_gamma) * gam_vec
+            has_phi[sl] = True
+            continue
+        if isinstance(blk, EcorrBlock):
+            group = np.asarray(blk.col_group)
+            const_col[sl] += np.log(s2)
+            for g, idx in enumerate(blk.idx):
+                gcols = blk.start + np.flatnonzero(group == g)
+                if idx >= 0:
+                    coef_row(idx)[gcols] += 2.0 * LN10
+                    varying[gcols] = True
+                else:
+                    const_col[gcols] += 2.0 * LN10 * float(blk.const[g])
+            has_phi[sl] = True
+            continue
+        raise TypeError(f"unknown phi block {type(blk)}")  # pragma: no cover
+
+    cols = np.asarray(cols, int)
+    hyp_idx = tuple(sorted(coefs))
+    K = np.zeros((1 + len(hyp_idx), len(cols)))
+    K[0] = np.where(varying[cols], const_col[cols], 0.0)
+    for k, idx in enumerate(hyp_idx):
+        K[1 + k] = coefs[idx][cols]
+    static = has_phi & ~varying
+    phiinv_static = np.where(static[cols], np.exp(-const_col[cols]), 0.0)
+    logdet_static = float(const_col[static].sum())
+    specs = np.asarray(ma.prior_specs, np.float32)[:, :3].T.copy()
+    kinds = set(np.unique(specs[0].astype(int)))
+    if not kinds <= {0, 1, 2}:
+        # mirror of pallas_white.build_white_consts's guard: the fused
+        # prior only implements the lnprior_specs kinds known today
+        raise ValueError(f"unsupported prior kinds for fused MH: {kinds}")
+    return HyperConsts(K=K.astype(np.float32), hyp_idx=hyp_idx,
+                       phi_sel=varying[cols].astype(np.float32),
+                       phiinv_static=phiinv_static.astype(np.float32),
+                       logdet_phi_static=logdet_static, specs=specs)
+
+
+# ---------------------------------------------------------------------------
+# shared step math (XLA path; the kernel mirrors it lane-padded)
+# ---------------------------------------------------------------------------
+
+
+def _phi_eval_xla(q, consts: HyperConsts):
+    """(phiinv_varying, sum_logphi_varying) on (…, v) operands."""
+    K = jnp.asarray(consts.K, q.dtype)
+    sel = jnp.asarray(consts.phi_sel, q.dtype)
+    lph = K[0]
+    for k, idx in enumerate(consts.hyp_idx):
+        lph = lph + K[1 + k] * q[..., idx:idx + 1]
+    phiinv = sel * jnp.exp(-lph)
+    return phiinv, jnp.sum(sel * lph, axis=-1)
+
+
+def _lnprior_sum_xla(q, consts: HyperConsts):
+    sp = jnp.asarray(consts.specs, q.dtype)
+    return jnp.sum(_lnprior_cols(q, sp[0], sp[1], sp[2]), axis=-1)
+
+
+def hyper_mh_loop_xla(x, S0, dS0, rt, base, dx, logu,
+                      consts: HyperConsts, jitter: float):
+    """The full hyper MH block over precomputed draws, plain XLA — the
+    non-Pallas dispatch target. Batch-generic. ``S0 (…, v, v)`` is the
+    proposal-independent matrix block (Schur complement, or TNT), ``dS0``
+    its diagonal plus any static phiinv, ``base`` the per-chain constant
+    part of the log-likelihood (white const + Schur quad/logdet + static
+    phi logdet)."""
+    v = S0.shape[-1]
+    eye = jnp.eye(v, dtype=S0.dtype)
+
+    def ll_lp(q):
+        phiinv, sum_lph = _phi_eval_xla(q, consts)
+        d = dS0 + phiinv
+        isd = 1.0 / jnp.sqrt(d)
+        Ssc = S0 * isd[..., :, None] * isd[..., None, :]
+        Ssc = jnp.where(eye == 1.0, 1.0 + jitter, Ssc)
+        L = jnp.linalg.cholesky(Ssc)
+        logdet_S = 2.0 * jnp.sum(
+            jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), axis=-1)
+        from jax.scipy.linalg import solve_triangular
+
+        u = solve_triangular(L, (rt * isd)[..., None], lower=True)[..., 0]
+        quad = jnp.sum(u * u, axis=-1)
+        ll = base + 0.5 * (quad - (logdet_S + jnp.sum(jnp.log(d), axis=-1))
+                           - sum_lph)
+        ll = jnp.where(jnp.isfinite(ll), ll, -jnp.inf)
+        return ll, _lnprior_sum_xla(q, consts)
+
+    nsteps = dx.shape[-2]
+    ll0, lp0 = ll_lp(x)
+    acc0 = jnp.zeros(ll0.shape, x.dtype)
+
+    def body(i, carry):
+        x, ll0, lp0, acc = carry
+        q = x + lax.dynamic_index_in_dim(dx, i, axis=dx.ndim - 2,
+                                         keepdims=False)
+        ll1, lp1 = ll_lp(q)
+        lu = lax.dynamic_index_in_dim(logu, i, axis=logu.ndim - 1,
+                                      keepdims=False)
+        accept = (ll1 + lp1) - (ll0 + lp0) > lu
+        am = accept[..., None]
+        return (jnp.where(am, q, x), jnp.where(accept, ll1, ll0),
+                jnp.where(accept, lp1, lp0), acc + accept)
+
+    x, _, _, acc = lax.fori_loop(0, nsteps, body, (x, ll0, lp0, acc0))
+    return x, acc / nsteps
+
+
+# ---------------------------------------------------------------------------
+# the kernel
+# ---------------------------------------------------------------------------
+
+
+def _hyper_kernel(S0_ref, dS0_ref, rt_ref, x_ref, dx_ref, lu_ref, K_ref,
+                  sel_ref, sp_ref, base_ref, xo_ref, ao_ref, A_ref, *,
+                  nsteps: int, v: int, p: int,
+                  hyp_idx: Tuple[int, ...], jitter: float):
+    """One chain tile. Layouts: ``S0/A (vp, vp, lanes)`` indexed
+    [matrix column, matrix row, chain]; ``dS0/rt/K*/sel (vp, lanes)``;
+    ``x (pp, lanes)``; ``dx (nsteps, pp, lanes)``; ``lu (Sp, lanes)``;
+    ``sp (4, pp, lanes)`` prior rows; ``base (1, lanes)``."""
+    vp = S0_ref.shape[0]
+    lanes = x_ref.shape[-1]
+    rows2 = lax.broadcasted_iota(jnp.int32, (vp, 1), 0)
+    rows3 = lax.broadcasted_iota(jnp.int32, (vp, 1, 1), 0)
+    cols3 = lax.broadcasted_iota(jnp.int32, (1, vp, 1), 1)
+    prow = lax.broadcasted_iota(jnp.int32, (x_ref.shape[0], 1), 0)
+    vmask = rows2 < v
+    pmask = prow < p
+    kind = jnp.where(pmask, sp_ref[0], -1.0)
+    a = sp_ref[1]
+    b = sp_ref[2]
+    base = base_ref[0:1, :]
+    sel = sel_ref[:]
+    dS0 = dS0_ref[:]
+    rt = rt_ref[:]
+
+    def ll_lp(q):
+        # phi eval: affine logphi rows, then the masked exp
+        lph = K_ref[0]
+        for k, idx in enumerate(hyp_idx):
+            lph = lph + K_ref[1 + k] * q[idx:idx + 1, :]
+        phiinv = sel * jnp.exp(-lph)
+        sum_lph = jnp.sum(sel * lph, axis=0, keepdims=True)
+        d = dS0 + phiinv
+        isd = lax.rsqrt(d)
+        sum_logd = jnp.sum(jnp.where(vmask, jnp.log(d), 0.0), axis=0,
+                           keepdims=True)
+        # equilibrated matrix straight into VMEM scratch: unit diagonal
+        # by construction, so the diagonal is written as 1 + jitter
+        A_ref[:] = jnp.where(
+            rows3 == cols3, 1.0 + jitter,
+            S0_ref[:] * isd[:, None, :] * isd[None, :, :])
+        rp = rt * isd
+        racc = jnp.zeros((vp, lanes), jnp.float32)
+        ld = jnp.zeros((1, lanes), jnp.float32)
+        quad = jnp.zeros((1, lanes), jnp.float32)
+        for j in range(v):
+            c = A_ref[j]                          # (vp, lanes)
+            piv = c[j:j + 1, :]
+            inv = lax.rsqrt(piv)
+            ld += jnp.log(piv)
+            col = jnp.where(rows2 >= j, c * inv, 0.0)
+            uj = (rp[j:j + 1, :] - racc[j:j + 1, :]) * inv
+            racc = racc + col * uj
+            quad += uj * uj
+            upd = col[:, None, :] * col[None, :, :]
+            A_ref[:] = A_ref[:] - jnp.where(rows3 > j, upd, 0.0)
+        ll = base + 0.5 * (quad - (ld + sum_logd) - sum_lph)
+        ll = jnp.where(jnp.isfinite(ll), ll, -jnp.inf)
+        # prior over the full parameter vector (reference gibbs.py:99)
+        lp_el = jnp.where(pmask, _lnprior_cols(q, kind, a, b), 0.0)
+        lp = jnp.sum(lp_el, axis=0, keepdims=True)
+        return ll, lp
+
+    x = x_ref[:]
+    ll0, lp0 = ll_lp(x)
+
+    def step(j, carry):
+        x, ll0, lp0, acc = carry
+        q = x + dx_ref[j]
+        ll1, lp1 = ll_lp(q)
+        lu = lu_ref[j]                            # (1, lanes)
+        am = (ll1 + lp1) - (ll0 + lp0) > lu
+        return (jnp.where(am, q, x), jnp.where(am, ll1, ll0),
+                jnp.where(am, lp1, lp0), acc + am.astype(jnp.float32))
+
+    x, _, _, acc = lax.fori_loop(
+        0, nsteps, step,
+        (x, ll0, lp0, jnp.zeros((1, lanes), jnp.float32)))
+    xo_ref[:] = x
+    ao_ref[:] = jnp.broadcast_to(acc, ao_ref.shape)
+
+
+def hyper_mh_fused(x, S0, dS0, rt, base, dx, logu, consts: HyperConsts,
+                   jitter: float, chain_tile: int = 128,
+                   interpret: bool = False):
+    """``(x_new, acc_rate)`` for the whole hyper MH block, one launch.
+
+    ``x (C, p)``, ``S0 (C, v, v)``, ``dS0/rt (C, v)``, ``base (C,)``,
+    ``dx (C, S, p)``, ``logu (C, S)`` — float32 only.
+    """
+    if x.dtype != jnp.float32:
+        raise ValueError(f"pallas hyper kernel is float32-only, got {x.dtype}")
+    C, p = x.shape
+    v = S0.shape[-1]
+    S = dx.shape[-2]
+    vp = _round_up(v, 8)
+    pp = _round_up(p, 8)
+    tile = chain_tile
+    while tile > 8 and 2 * vp * vp * tile * 4 > 8 * 2 ** 20:
+        tile //= 2
+    tile = max(8, min(tile, _round_up(C, 8)))
+    Cp = _round_up(C, tile)
+
+    def padc(arr):
+        padn = Cp - arr.shape[0]
+        if not padn:
+            return arr
+        return jnp.concatenate(
+            [arr, jnp.broadcast_to(arr[:1], (padn,) + arr.shape[1:])],
+            axis=0)
+
+    def padax(arr, axis, to):
+        padn = to - arr.shape[axis]
+        if not padn:
+            return arr
+        shape = list(arr.shape)
+        shape[axis] = padn
+        return jnp.concatenate(
+            [arr, jnp.zeros(shape, arr.dtype)], axis=axis)
+
+    # identity-pad the matrix block so padded columns factor to 1
+    S0p = padax(padax(S0, -1, vp), -2, vp)
+    if vp > v:
+        eyepad = (jnp.arange(vp) >= v)
+        S0p = S0p + jnp.where(
+            eyepad[:, None] & eyepad[None, :],
+            jnp.eye(vp, dtype=S0.dtype), 0.0)
+    dS0p = padax(dS0, -1, vp) + (jnp.arange(vp) >= v).astype(S0.dtype)
+    # lane layout: [col, row, chain] / [row, chain]
+    S0t = jnp.transpose(padc(S0p), (2, 1, 0))
+    dS0t = jnp.transpose(padc(dS0p), (1, 0))
+    rtt = jnp.transpose(padc(padax(rt, -1, vp)), (1, 0))
+    xt = jnp.transpose(padc(padax(x, -1, pp)), (1, 0))
+    dxt = jnp.transpose(padc(padax(dx, -1, pp)), (1, 2, 0))  # (S, pp, Cp)
+    # (S, 1, Cp): the step index lands on an untiled leading axis, so the
+    # in-kernel fori_loop can dynamic-index it
+    lut = jnp.transpose(padc(logu), (1, 0))[:, None, :]
+    bt = padc(base)[None, :]                                 # (1, Cp)
+
+    # constants pre-broadcast over the chain lane axis (cheap HBM, and it
+    # sidesteps width-1 lane slicing in-kernel)
+    K = jnp.asarray(consts.K, jnp.float32)
+    nk = K.shape[0]
+    Kt = jnp.broadcast_to(padax(K, -1, vp)[:, :, None], (nk, vp, Cp))
+    selt = jnp.broadcast_to(
+        padax(jnp.asarray(consts.phi_sel, jnp.float32), -1, vp)[:, None],
+        (vp, Cp))
+    sp = jnp.asarray(consts.specs, jnp.float32)
+    sp = jnp.concatenate(
+        [sp, jnp.zeros((4 - sp.shape[0], sp.shape[1]), jnp.float32)])
+    spt = jnp.broadcast_to(padax(sp, -1, pp)[:, :, None], (4, pp, Cp))
+
+    if not _HAVE_PLTPU:  # pragma: no cover - no-TPU-extension builds
+        raise RuntimeError("pallas TPU extension unavailable")
+    kwargs = {"compiler_params": pltpu.CompilerParams(
+        dimension_semantics=("parallel",))}
+    scratch = [pltpu.VMEM((vp, vp, tile), jnp.float32)]
+    kernel = functools.partial(_hyper_kernel, nsteps=S, v=v, p=p,
+                               hyp_idx=consts.hyp_idx, jitter=jitter)
+    xo, ao = pl.pallas_call(
+        kernel,
+        grid=(Cp // tile,),
+        in_specs=[
+            _spec((vp, vp, tile), lambda g: (0, 0, g)),
+            _spec((vp, tile), lambda g: (0, g)),
+            _spec((vp, tile), lambda g: (0, g)),
+            _spec((pp, tile), lambda g: (0, g)),
+            _spec((S, pp, tile), lambda g: (0, 0, g)),
+            _spec((S, 1, tile), lambda g: (0, 0, g)),
+            _spec((nk, vp, tile), lambda g: (0, 0, g)),
+            _spec((vp, tile), lambda g: (0, g)),
+            _spec((4, pp, tile), lambda g: (0, 0, g)),
+            _spec((1, tile), lambda g: (0, g)),
+        ],
+        out_specs=[
+            _spec((pp, tile), lambda g: (0, g)),
+            _spec((8, tile), lambda g: (0, g)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((pp, Cp), jnp.float32),
+            jax.ShapeDtypeStruct((8, Cp), jnp.float32),
+        ],
+        scratch_shapes=scratch,
+        interpret=interpret,
+        **kwargs,
+    )(S0t, dS0t, rtt, xt, dxt, lut, Kt, selt, spt, bt)
+    return jnp.transpose(xo, (1, 0))[:C, :p], ao[0, :C] / S
+
+
+# ---------------------------------------------------------------------------
+# dispatch
+# ---------------------------------------------------------------------------
+
+
+def _pallas_hyper_mode():
+    """``(enabled, interpret, forced)`` from ``GST_PALLAS_HYPER`` — the
+    shared trace-time snapshot semantics of ops/pallas_util.py
+    ``mode_from_env`` (same contract as GST_PALLAS_CHOL/WHITE)."""
+    return mode_from_env("GST_PALLAS_HYPER")
+
+
+def make_hyper_block(consts: HyperConsts, jitter: float):
+    """Build the dispatched hyper-MH block for one frozen model —
+    ``block(x, S0, dS0, rt, base, dx, logu) -> (x_new, acc_rate)``,
+    custom-vmapped like ops/pallas_white.make_white_block."""
+
+    @custom_vmap
+    def block(x, S0, dS0, rt, base, dx, logu):
+        enabled, interp, forced = _pallas_hyper_mode()
+        batch = x.shape[:-1]
+        B = int(np.prod(batch)) if batch else 1
+        ok = (_HAVE_PLTPU and x.dtype == jnp.float32
+              and S0.shape[-1] <= MAX_PALLAS_V
+              and (forced or B >= _MIN_BATCH) and x.ndim >= 2)
+        if enabled and ok:
+            p = x.shape[-1]
+            v = S0.shape[-1]
+            S = dx.shape[-2]
+            xf, acc = hyper_mh_fused(
+                x.reshape(B, p), S0.reshape(B, v, v), dS0.reshape(B, v),
+                rt.reshape(B, v), base.reshape(B), dx.reshape(B, S, p),
+                logu.reshape(B, S), consts, jitter, interpret=interp)
+            return xf.reshape(batch + (p,)), acc.reshape(batch)
+        return hyper_mh_loop_xla(x, S0, dS0, rt, base, dx, logu,
+                                 consts, jitter)
+
+    @block.def_vmap
+    def _block_vmap(axis_size, in_batched, *args):
+        out = []
+        for arr, bt in zip(args, in_batched):
+            if not bt:
+                arr = jnp.broadcast_to(arr, (axis_size,) + arr.shape)
+            out.append(arr)
+        return block(*out), (True, True)
+
+    return block

@@ -1,0 +1,55 @@
+"""Shared plumbing for the Pallas TPU kernels (ops/pallas_*.py).
+
+One copy of the env-flag parser, padding arithmetic, and block-spec
+helper, so the per-kernel gates (``GST_PALLAS_CHOL``,
+``GST_PALLAS_WHITE``, ``GST_PALLAS_HYPER``) cannot drift apart in
+semantics: every flag supports ``auto`` (on for TPU backends),
+``0``/``false``/empty (off), ``interpret`` (forced, interpreter mode —
+the CPU testing path), and anything-else-truthy (forced on).
+
+All flags are read at TRACE time and baked into the compiled program —
+set them before constructing a backend; flipping them afterwards
+silently has no effect on an existing instance (the bench fallback
+ladder uses a fresh process per rung for exactly this reason).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+from jax.experimental import pallas as pl
+
+try:  # pltpu only imports on builds with the TPU extension available
+    from jax.experimental.pallas import tpu as pltpu
+
+    HAVE_PLTPU = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    HAVE_PLTPU = False
+
+# Below this flattened batch size a kernel's relayout/launch overhead
+# outweighs its win and the XLA path is kept.
+MIN_BATCH = 16
+
+
+def round_up(x: int, mult: int) -> int:
+    return -(-x // mult) * mult
+
+
+def vmem_spec(shape, index_map) -> pl.BlockSpec:
+    if HAVE_PLTPU:
+        return pl.BlockSpec(shape, index_map, memory_space=pltpu.VMEM)
+    return pl.BlockSpec(shape, index_map)
+
+
+def mode_from_env(var: str):
+    """``(enabled, interpret, forced)`` for one kernel gate env var."""
+    env = os.environ.get(var, "auto")
+    if env in ("0", "false", ""):
+        return False, False, False
+    if env == "interpret":
+        return True, True, True
+    if env == "auto":
+        return jax.default_backend() in ("tpu", "axon"), False, False
+    return True, False, True

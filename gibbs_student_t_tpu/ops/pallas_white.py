@@ -1,0 +1,401 @@
+"""Pallas TPU kernel: the whole white-noise MH block in one launch.
+
+The reference's white-noise update is 20 sequential single-coordinate
+Metropolis steps (reference gibbs.py:114-143), each evaluating the
+conditional-on-b likelihood ``-1/2 (sum log N + sum (y-Tb)^2/N)`` with
+``N = alpha^z * Nvec0(efac, equad)`` (reference gibbs.py:262-284). On the
+TPU the arithmetic is trivial — O(n * chains) elementwise per step — but
+the XLA lowering pays a fixed ~120 us of kernel-launch/scheduling cost
+per step across ~6 small fused kernels (threefry draws, ndiag, two
+reductions, prior, masked accept), making the block ~2.4 ms of the
+6.9 ms flagship sweep while using ~1% of the VPU
+(docs/PERFORMANCE.md roofline: "fixed per-op cost").
+
+This kernel runs the *entire* block — all ``nsteps`` proposals,
+likelihood + prior evaluations, and masked accepts — inside one
+``pallas_call``:
+
+- **chains on sublanes, TOAs/params on lanes.** Every per-chain array is
+  ``(chain_tile, n)`` / ``(chain_tile, p)``; a likelihood evaluation is a
+  handful of full-width VPU ops plus one lane-axis reduction. (The
+  Cholesky kernel puts chains on *lanes* because its recurrence walks
+  matrix columns; here the reductions run over TOAs, so TOAs take the
+  lane axis and constants broadcast naturally as ``(1, n)`` rows.)
+- **randomness is an input, not kernel code.** The per-step draws
+  (coordinate choice, jump, log-uniform) are precomputed OUTSIDE with
+  the exact key schedule of the XLA path (``jax_backend._mh_draws``), so
+  kernel-on vs kernel-off runs consume identical randoms and differ only
+  by floating-point reduction order.
+- **constant folding at trace time.** Selection groups pinned to
+  constants (e.g. the reference's ``efac=1``, run_sims.py:57) fold into
+  a fixed baseline variance row ``nv0``; only x-varying groups pay an
+  in-kernel coefficient: ``nv(q) = nv0 + sum_g q[i_g]^2 * A_g +
+  sum_h exp(2 ln10 q[i_h]) * B_h`` with ``A_g = efac_mask_g * sigma2``,
+  ``B_h = equad_mask_h * time_scale^2`` (models/pta.py ndiag).
+- **-inf semantics preserved.** Out-of-bounds proposals get ``-inf``
+  prior exactly as ``models/parameter.lnprior_specs``; ``-inf - -inf =
+  NaN > logu`` is False, i.e. auto-reject — identical to the XLA path.
+
+Padding contract: TOA lanes beyond the real row mask carry
+``az = 1, yred2 = 0`` and a zero ``rmask`` pins their variance to 1, so
+they add exactly 0 to both reduction terms; parameter lanes beyond ``p``
+are masked out of the prior sum; padded chain rows are edge-replicated
+and sliced off by the caller.
+
+Like the other kernels in ops/, matvec-shaped contractions stay >= 2-D
+(this libtpu's Mosaic cannot parse 1-D ``jnp.dot`` attributes) — though
+this kernel needs no dots at all: lane extraction ``x[:, i]`` is a
+masked lane-reduction, which also avoids width-1 lane slicing.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.custom_batching import custom_vmap
+from jax.experimental import pallas as pl
+
+from gibbs_student_t_tpu.ops.pallas_util import (
+    HAVE_PLTPU as _HAVE_PLTPU,
+    MIN_BATCH as _MIN_BATCH,
+    mode_from_env,
+    pltpu,
+    round_up as _round_up,
+    vmem_spec as _spec,
+)
+
+LN10 = float(np.log(10.0))
+_LOG_2PI = float(np.log(2.0 * np.pi))
+
+# Above this TOA count one chain tile's (tile, n) working set stops
+# fitting comfortably in VMEM at the minimum 8-row tile; the XLA loop
+# path handles the stress shapes (which are TNT-bound anyway).
+MAX_PALLAS_N = 32768
+
+
+class WhiteConsts(NamedTuple):
+    """Trace-time constants of one model's white-noise likelihood.
+
+    ``rows``: (R, n) stacked constant rows — row 0 the folded baseline
+    variance ``nv0``, row 1 the real-TOA mask, rows 2+ the per-varying-
+    group basis rows. ``var``: static ``(kind, x_index, row_slot)``
+    triples, kind 0 = efac (coefficient ``q^2``), 1 = equad
+    (``exp(2 ln10 q)``). ``specs``: (3, p) prior table rows
+    (kind, a, b) from ``ModelArrays.prior_specs``.
+    """
+
+    rows: np.ndarray
+    var: Tuple[Tuple[int, int, int], ...]
+    specs: np.ndarray
+
+
+def build_white_consts(ma, row_mask=None) -> WhiteConsts:
+    """Fold a ``ModelArrays``'s white-noise structure into kernel form.
+
+    Mirrors ``models.pta.ndiag`` exactly: constant-pinned groups
+    (idx == -1) fold into the baseline row at trace time; varying groups
+    keep their (n,) basis row and an in-kernel coefficient.
+    """
+    n = ma.y.shape[0]
+    sigma2 = np.asarray(ma.sigma2, np.float64)
+    nv0 = np.zeros(n, np.float64)
+    var_rows = []
+    var = []
+    for g, idx in enumerate(ma.efac_idx):
+        A = np.asarray(ma.efac_masks[g], np.float64) * sigma2
+        if idx < 0:
+            nv0 += float(ma.efac_const[g]) ** 2 * A
+        else:
+            var.append((0, int(idx), 2 + len(var_rows)))
+            var_rows.append(A)
+    s2 = float(ma.time_scale) ** 2
+    for h, idx in enumerate(ma.equad_idx):
+        B = np.asarray(ma.equad_masks[h], np.float64) * s2
+        if idx < 0:
+            nv0 += 10.0 ** (2.0 * float(ma.equad_const[h])) * B
+        else:
+            var.append((1, int(idx), 2 + len(var_rows)))
+            var_rows.append(B)
+    rmask = (np.ones(n) if row_mask is None
+             else np.asarray(row_mask, np.float64))
+    rows = np.stack([nv0, rmask] + var_rows).astype(np.float32)
+    specs = np.asarray(ma.prior_specs, np.float32)[:, :3].T.copy()
+    kinds = set(np.unique(specs[0].astype(int)))
+    if not kinds <= {0, 1, 2}:
+        # _lnprior_cols implements exactly these kinds; a new kind added
+        # to models/parameter.lnprior_specs must be mirrored there or
+        # the fused paths would silently -inf that parameter's prior
+        raise ValueError(f"unsupported prior kinds for fused MH: {kinds}")
+    return WhiteConsts(rows=rows, var=tuple(var), specs=specs)
+
+
+# ---------------------------------------------------------------------------
+# shared step math (XLA path; the kernel mirrors it lane-padded)
+# ---------------------------------------------------------------------------
+
+
+def _lnprior_cols(q, kind, a, b):
+    """Per-parameter log-prior, the ``lnprior_specs`` formula on
+    broadcastable (…, p) operands (models/parameter.py:126-144)."""
+    out = jnp.full(q.shape, -jnp.inf, q.dtype)
+    inb = (q >= a) & (q <= b)
+    u = kind == 0
+    out = jnp.where(u & inb, -jnp.log(jnp.where(u, b - a, 1.0)), out)
+    nrm = kind == 1
+    z = (q - a) / jnp.where(nrm, b, 1.0)
+    out = jnp.where(nrm, -0.5 * z * z - jnp.log(jnp.where(nrm, b, 1.0))
+                    - 0.5 * _LOG_2PI, out)
+    lexp = kind == 2
+    den = jnp.where(lexp, 10.0 ** b - 10.0 ** a, 1.0)
+    out = jnp.where(lexp & inb, q * LN10 + jnp.log(LN10 / den), out)
+    return out
+
+
+def _ll_lp_xla(q, az, yred2, rows, var, specs):
+    """(ll, lp) for proposal ``q`` (…, p) with per-chain ``az``/``yred2``
+    (…, n) — the array-based form of the white conditional likelihood
+    (reference gibbs.py:262-284) plus the full prior."""
+    nd = rows[0]
+    for vkind, idx, slot in var:
+        val = q[..., idx:idx + 1]
+        c = val * val if vkind == 0 else jnp.exp(2.0 * LN10 * val)
+        nd = nd + c * rows[slot]
+    nv = az * nd
+    nv = rows[1] * nv + (1.0 - rows[1])
+    ll = -0.5 * jnp.sum(jnp.log(nv) + yred2 / nv, axis=-1)
+    lp = jnp.sum(_lnprior_cols(q, specs[0], specs[1], specs[2]), axis=-1)
+    return ll, lp
+
+
+def white_mh_loop_xla(x, az, yred2, dx, logu, consts: WhiteConsts):
+    """The full white MH block as a ``fori_loop`` over precomputed draws —
+    the non-Pallas dispatch target. Batch-generic: every operand may carry
+    leading batch axes (``dx`` (…, S, p), ``logu`` (…, S))."""
+    rows = jnp.asarray(consts.rows, x.dtype)
+    specs = jnp.asarray(consts.specs, x.dtype)
+    nsteps = dx.shape[-2]
+    ll0, lp0 = _ll_lp_xla(x, az, yred2, rows, consts.var, specs)
+    acc0 = jnp.zeros(ll0.shape, x.dtype)
+
+    def body(i, carry):
+        x, ll0, lp0, acc = carry
+        q = x + lax.dynamic_index_in_dim(dx, i, axis=dx.ndim - 2,
+                                         keepdims=False)
+        ll1, lp1 = _ll_lp_xla(q, az, yred2, rows, consts.var, specs)
+        lu = lax.dynamic_index_in_dim(logu, i, axis=logu.ndim - 1,
+                                      keepdims=False)
+        accept = (ll1 + lp1) - (ll0 + lp0) > lu
+        am = accept[..., None]
+        return (jnp.where(am, q, x), jnp.where(accept, ll1, ll0),
+                jnp.where(accept, lp1, lp0), acc + accept)
+
+    x, _, _, acc = lax.fori_loop(0, nsteps, body, (x, ll0, lp0, acc0))
+    return x, acc / nsteps
+
+
+# ---------------------------------------------------------------------------
+# the kernel
+# ---------------------------------------------------------------------------
+
+
+def _white_kernel(x_ref, az_ref, y2_ref, dx_ref, lu_ref, cn_ref, sp_ref,
+                  xo_ref, ao_ref, *, nsteps: int, p: int,
+                  var: Tuple[Tuple[int, int, int], ...]):
+    C, P = x_ref.shape
+    N = az_ref.shape[1]
+    colP = lax.broadcasted_iota(jnp.int32, (1, P), 1)
+    colS = lax.broadcasted_iota(jnp.int32, (1, lu_ref.shape[1]), 1)
+    pmask = colP < p
+    kind = jnp.where(pmask, sp_ref[0:1, :], -1.0)
+    a = sp_ref[1:2, :]
+    b = sp_ref[2:3, :]
+    nv0 = cn_ref[0:1, :]
+    rmask = cn_ref[1:2, :]
+    az = az_ref[:]
+    y2 = y2_ref[:]
+    lu_all = lu_ref[:]
+
+    def ll_lp(q):
+        nd = jnp.zeros((C, N), jnp.float32) + nv0
+        for vkind, idx, slot in var:
+            # lane extraction q[:, idx] as a masked reduction — avoids
+            # width-1 lane slicing, which Mosaic handles poorly
+            val = jnp.sum(jnp.where(colP == idx, q, 0.0), axis=1,
+                          keepdims=True)
+            c = val * val if vkind == 0 else jnp.exp(2.0 * LN10 * val)
+            nd = nd + c * cn_ref[slot:slot + 1, :]
+        nv = az * nd
+        nv = rmask * nv + (1.0 - rmask)
+        ll = -0.5 * jnp.sum(jnp.log(nv) + y2 / nv, axis=1, keepdims=True)
+        lp_el = _lnprior_cols(q, kind, a, b)
+        lp_el = jnp.where(pmask, lp_el, 0.0)
+        lp = jnp.sum(lp_el, axis=1, keepdims=True)
+        return ll, lp
+
+    x = x_ref[:]
+    ll0, lp0 = ll_lp(x)
+    acc = jnp.zeros((C, 1), jnp.float32)
+    for j in range(nsteps):
+        q = x + dx_ref[j]
+        ll1, lp1 = ll_lp(q)
+        lu = jnp.sum(jnp.where(colS == j, lu_all, 0.0), axis=1,
+                     keepdims=True)
+        am = (ll1 + lp1) - (ll0 + lp0) > lu
+        x = jnp.where(am, q, x)
+        ll0 = jnp.where(am, ll1, ll0)
+        lp0 = jnp.where(am, lp1, lp0)
+        acc = acc + am.astype(jnp.float32)
+    xo_ref[:] = x
+    ao_ref[:] = jnp.broadcast_to(acc, ao_ref.shape)
+
+
+def _pad_lanes(arr, width):
+    pad = width - arr.shape[-1]
+    if pad <= 0:
+        return arr
+    return jnp.concatenate(
+        [arr, jnp.zeros(arr.shape[:-1] + (pad,), arr.dtype)], axis=-1)
+
+
+def white_mh_fused(x, az, yred2, dx, logu, consts: WhiteConsts,
+                   chain_tile: int = 256, interpret: bool = False):
+    """``(x_new, acc_rate)`` for the whole white MH block, one launch.
+
+    ``x (C, p)``, ``az/yred2 (C, n)``, ``dx (C, S, p)`` precomputed
+    one-hot jump vectors, ``logu (C, S)`` log-uniform accept draws —
+    float32 only (the production TPU regime; float64 runs take the XLA
+    path).
+    """
+    if x.dtype != jnp.float32:
+        raise ValueError(f"pallas white kernel is float32-only, got {x.dtype}")
+    C, p = x.shape
+    n = az.shape[-1]
+    S = dx.shape[-2]
+    P = _round_up(p, 128)
+    N = _round_up(n, 128)
+    SP = _round_up(S, 128)
+    # VMEM-budget the chain tile: ~6 (tile, N)-sized live buffers
+    # (az, y2, nv, nd + pipelining headroom), cap ~4 MB
+    tile = chain_tile
+    while tile > 8 and 6 * tile * N * 4 > 4 * 2 ** 20:
+        tile //= 2
+    tile = max(8, min(tile, _round_up(C, 8)))
+    Cp = _round_up(C, tile)
+
+    def pad_chains(arr):
+        padc = Cp - arr.shape[0]
+        if not padc:
+            return arr
+        # edge-replicate so padded rows stay finite and in-bounds
+        return jnp.concatenate(
+            [arr, jnp.broadcast_to(arr[:1], (padc,) + arr.shape[1:])],
+            axis=0)
+
+    xp_ = pad_chains(_pad_lanes(x, P))
+    azp = pad_chains(_pad_lanes(az, N))
+    # padded TOA lanes: az must be 1 (not 0) so log(nv)=0 there; the rmask
+    # row already zeroes their reduction terms, this keeps them finite
+    if N > n:
+        lane = lax.broadcasted_iota(jnp.int32, (1, N), 1)
+        azp = jnp.where(lane < n, azp, 1.0)
+    y2p = pad_chains(_pad_lanes(yred2, N))
+    dxp = jnp.swapaxes(pad_chains(_pad_lanes(dx, P)), 0, 1)  # (S, Cp, P)
+    lup = pad_chains(_pad_lanes(logu, SP))
+
+    rows = _pad_lanes(jnp.asarray(consts.rows, jnp.float32), N)
+    R = _round_up(rows.shape[0], 8)
+    rows = jnp.concatenate(
+        [rows, jnp.zeros((R - rows.shape[0], N), jnp.float32)], axis=0)
+    specs = _pad_lanes(jnp.asarray(consts.specs, jnp.float32), P)
+    specs = jnp.concatenate(
+        [specs, jnp.zeros((8 - specs.shape[0], P), jnp.float32)], axis=0)
+
+    kwargs = {}
+    if _HAVE_PLTPU:  # chain tiles are independent
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel",))
+    kernel = functools.partial(_white_kernel, nsteps=S, p=p,
+                               var=consts.var)
+    xo, ao = pl.pallas_call(
+        kernel,
+        grid=(Cp // tile,),
+        in_specs=[
+            _spec((tile, P), lambda g: (g, 0)),
+            _spec((tile, N), lambda g: (g, 0)),
+            _spec((tile, N), lambda g: (g, 0)),
+            _spec((S, tile, P), lambda g: (0, g, 0)),
+            _spec((tile, SP), lambda g: (g, 0)),
+            _spec((R, N), lambda g: (0, 0)),
+            _spec((8, P), lambda g: (0, 0)),
+        ],
+        out_specs=[
+            _spec((tile, P), lambda g: (g, 0)),
+            _spec((tile, 8), lambda g: (g, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Cp, P), jnp.float32),
+            jax.ShapeDtypeStruct((Cp, 8), jnp.float32),
+        ],
+        interpret=interpret,
+        **kwargs,
+    )(xp_, azp, y2p, dxp, lup, rows, specs)
+    return xo[:C, :p], ao[:C, 0] / S
+
+
+# ---------------------------------------------------------------------------
+# dispatch
+# ---------------------------------------------------------------------------
+
+
+def _pallas_white_mode():
+    """``(enabled, interpret, forced)`` from ``GST_PALLAS_WHITE`` — the
+    shared trace-time-snapshot semantics of ops/pallas_util.py
+    ``mode_from_env``: ``auto`` enables on TPU backends for batches past
+    ``MIN_BATCH``; set the env var *before* constructing the backend."""
+    return mode_from_env("GST_PALLAS_WHITE")
+
+
+def make_white_block(consts: WhiteConsts):
+    """Build the dispatched white-MH block for one frozen model.
+
+    Returns ``block(x, az, yred2, dx, logu) -> (x_new, acc_rate)`` wrapped
+    in ``jax.custom_batching.custom_vmap``: a chain-vmapped call collapses
+    every mapped axis onto the kernel's chain-tile dimension (the same
+    integration pattern as ops/linalg.py's ``_factor_fused``); unbatched
+    or non-TPU calls run the identical-math XLA loop.
+    """
+
+    @custom_vmap
+    def block(x, az, yred2, dx, logu):
+        enabled, interp, forced = _pallas_white_mode()
+        batch = x.shape[:-1]
+        B = int(np.prod(batch)) if batch else 1
+        ok = (_HAVE_PLTPU and x.dtype == jnp.float32
+              and az.shape[-1] <= MAX_PALLAS_N
+              and (forced or B >= _MIN_BATCH) and x.ndim >= 2)
+        if enabled and ok:
+            p = x.shape[-1]
+            n = az.shape[-1]
+            S = dx.shape[-2]
+            xf, acc = white_mh_fused(
+                x.reshape(B, p), az.reshape(B, n), yred2.reshape(B, n),
+                dx.reshape(B, S, p), logu.reshape(B, S),
+                consts, interpret=interp)
+            return xf.reshape(batch + (p,)), acc.reshape(batch)
+        return white_mh_loop_xla(x, az, yred2, dx, logu, consts)
+
+    @block.def_vmap
+    def _block_vmap(axis_size, in_batched, *args):
+        out = []
+        for arr, bt in zip(args, in_batched):
+            if not bt:
+                arr = jnp.broadcast_to(arr, (axis_size,) + arr.shape)
+            out.append(arr)
+        return block(*out), (True, True)
+
+    return block

@@ -1,0 +1,206 @@
+"""Fused hyper-MH block (ops/pallas_hyper.py), interpret mode on CPU.
+
+Covers the affine logphi decomposition against ``models.pta
+.phiinv_logdet`` (powerlaw and ecorr varying blocks, constant folding,
+static logdet), kernel-vs-XLA-loop parity on identical draws, non-PD
+reject semantics, and whole-sweep chain equivalence against the closure
+path through the backend on identical keys.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from gibbs_student_t_tpu.backends import JaxGibbs
+from gibbs_student_t_tpu.config import GibbsConfig
+from gibbs_student_t_tpu.data.demo import make_demo_model_arrays
+from tests.conftest import make_demo_pulsar
+from gibbs_student_t_tpu.models.pta import PTA, phiinv_logdet, static_phi_columns
+from gibbs_student_t_tpu.ops.pallas_hyper import (
+    build_hyper_consts,
+    hyper_mh_fused,
+    hyper_mh_loop_xla,
+    make_hyper_block,
+)
+
+
+def _ecorr_ma(n=40, seed=6):
+    from gibbs_student_t_tpu.models.parameter import Uniform
+    from gibbs_student_t_tpu.models.signals import (
+        EcorrBasisModel,
+        FourierBasisGP,
+        MeasurementNoise,
+        TimingModel,
+        powerlaw,
+    )
+
+    psr, _ = make_demo_pulsar(seed=seed, n=n)
+    toas = psr.toas.copy()
+    toas = np.repeat(toas[::4][:n // 4], 4) + np.tile(
+        [0.0, 30.0, 60.0, 90.0], n // 4)
+    psr.toas = toas
+    s = (MeasurementNoise()
+         + EcorrBasisModel(Uniform(-10, -5))
+         + FourierBasisGP(powerlaw(log10_A=Uniform(-18, -12),
+                                   gamma=Uniform(1, 7)), components=4)
+         + TimingModel())
+    return PTA([s(psr)]).frozen()
+
+
+def _reconstruct_phi(ma, consts, cols, x):
+    """phiinv/logdet on the subset from the affine K rows (float64)."""
+    K = consts.K.astype(np.float64)
+    lph = K[0].copy()
+    for k, idx in enumerate(consts.hyp_idx):
+        lph += K[1 + k] * x[idx]
+    sel = consts.phi_sel.astype(bool)
+    phiinv = np.where(sel, np.exp(-lph), 0.0) + consts.phiinv_static
+    logdet = consts.logdet_phi_static + lph[sel].sum()
+    return phiinv, logdet
+
+
+@pytest.mark.parametrize("make_ma", [
+    lambda: make_demo_model_arrays(n=40, components=5, seed=2),
+    _ecorr_ma,
+])
+def test_affine_decomposition_matches_phiinv_logdet(make_ma):
+    ma = make_ma()
+    cols = np.arange(ma.m)
+    consts = build_hyper_consts(ma, cols)
+    rng = np.random.default_rng(7)
+    for _ in range(3):
+        x = ma.x_init(rng)
+        pinv_ref, ld_ref = phiinv_logdet(ma, x, np)
+        pinv, ld = _reconstruct_phi(ma, consts, cols, x)
+        np.testing.assert_allclose(pinv, pinv_ref, rtol=1e-5)
+        np.testing.assert_allclose(ld, ld_ref, rtol=1e-6, atol=1e-6)
+
+
+def test_affine_decomposition_schur_subset():
+    """On the Schur varying subset every column is varying, the static
+    logdet carries the eliminated block, and the two pieces reassemble
+    the full logdet."""
+    ma = make_demo_model_arrays(n=40, components=5, seed=3)
+    smask = static_phi_columns(ma)
+    v_i = np.flatnonzero(~smask)
+    consts = build_hyper_consts(ma, v_i)
+    assert consts.phi_sel.all()
+    assert np.all(consts.phiinv_static == 0.0)
+    rng = np.random.default_rng(1)
+    x = ma.x_init(rng)
+    pinv_ref, ld_ref = phiinv_logdet(ma, x, np)
+    pinv, ld = _reconstruct_phi(ma, consts, v_i, x)
+    np.testing.assert_allclose(pinv, pinv_ref[v_i], rtol=1e-5)
+    np.testing.assert_allclose(ld, ld_ref, rtol=1e-6, atol=1e-6)
+
+
+def _block_inputs(ma, cols, C, S=5, seed=4):
+    rng = np.random.default_rng(seed)
+    p = ma.nparam
+    v = len(cols)
+    x = np.stack([ma.x_init(rng) for _ in range(C)]).astype(np.float32)
+    A = rng.standard_normal((C, v, 2 * v))
+    S0 = (A @ np.swapaxes(A, -1, -2) / v
+          + 2.0 * np.eye(v)).astype(np.float32)
+    dS0 = np.einsum("bii->bi", S0).copy()
+    rt = rng.standard_normal((C, v)).astype(np.float32)
+    base = rng.standard_normal(C).astype(np.float32)
+    hyper = ma.hyper_indices
+    dx = np.zeros((C, S, p), np.float32)
+    for c in range(C):
+        for s in range(S):
+            dx[c, s, hyper[rng.integers(0, len(hyper))]] = (
+                rng.standard_normal() * 0.3)
+    logu = np.log(rng.uniform(size=(C, S))).astype(np.float32)
+    return tuple(jnp.asarray(a) for a in (x, S0, dS0, rt, base, dx, logu))
+
+
+@pytest.mark.parametrize("make_ma", [
+    lambda: make_demo_model_arrays(n=40, components=5, seed=2),
+    _ecorr_ma,
+])
+def test_kernel_matches_xla_loop(make_ma):
+    ma = make_ma()
+    cols = np.arange(ma.m)
+    consts = build_hyper_consts(ma, cols)
+    args = _block_inputs(ma, cols, C=9)
+    x1, a1 = jax.jit(lambda *a: hyper_mh_fused(
+        *a, consts=consts, jitter=1e-6, chain_tile=8,
+        interpret=True))(*args)
+    x0, a0 = jax.jit(lambda *a: hyper_mh_loop_xla(
+        *a, consts=consts, jitter=1e-6))(*args)
+    np.testing.assert_allclose(np.asarray(x1), np.asarray(x0),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(a1), np.asarray(a0))
+
+
+def test_non_pd_proposals_reject():
+    """A matrix block that goes non-PD under every proposal must reject
+    all of them (NaN -> -inf -> reject, reference gibbs.py:320-324)."""
+    ma = make_demo_model_arrays(n=30, components=4, seed=5)
+    cols = np.arange(ma.m)
+    consts = build_hyper_consts(ma, cols)
+    x, S0, dS0, rt, base, dx, logu = _block_inputs(ma, cols, C=4)
+    S0 = -jnp.asarray(np.broadcast_to(
+        np.eye(len(cols), dtype=np.float32), S0.shape))
+    dS0 = -jnp.ones_like(dS0) * 5.0  # negative diagonal: rsqrt -> NaN
+    logu = jnp.full_like(logu, -1e30)
+    for fn in (lambda: hyper_mh_loop_xla(x, S0, dS0, rt, base, dx, logu,
+                                         consts, 1e-6),
+               lambda: hyper_mh_fused(x, S0, dS0, rt, base, dx, logu,
+                                      consts, 1e-6, chain_tile=8,
+                                      interpret=True)):
+        x1, acc = fn()
+        np.testing.assert_array_equal(np.asarray(x1), np.asarray(x))
+        assert float(jnp.max(acc)) == 0.0
+
+
+def test_dispatch_under_vmap(monkeypatch):
+    ma = make_demo_model_arrays(n=30, components=4, seed=6)
+    cols = np.arange(ma.m)
+    consts = build_hyper_consts(ma, cols)
+    block = make_hyper_block(consts, jitter=1e-6)
+    args = _block_inputs(ma, cols, C=8, seed=11)
+    monkeypatch.setenv("GST_PALLAS_HYPER", "interpret")
+    x1, a1 = jax.vmap(block)(*args)
+    monkeypatch.setenv("GST_PALLAS_HYPER", "0")
+    x0, a0 = jax.vmap(block)(*args)
+    np.testing.assert_allclose(np.asarray(x1), np.asarray(x0),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(a1), np.asarray(a0))
+
+
+def test_auto_mode_stays_off_on_cpu(monkeypatch):
+    from gibbs_student_t_tpu.ops import pallas_hyper
+
+    monkeypatch.delenv("GST_PALLAS_HYPER", raising=False)
+    enabled, _, _ = pallas_hyper._pallas_hyper_mode()
+    assert not enabled
+
+
+@pytest.mark.parametrize("schur", ["auto", False])
+def test_sweep_chains_identical_fused_vs_closure(monkeypatch, schur):
+    """Whole-sweep equivalence through the backend: closure path vs the
+    fused hyper block on identical keys, Schur on and off."""
+    ma = make_demo_model_arrays(n=40, components=6, seed=3)
+    cfg = GibbsConfig(model="mixture", vary_df=True, theta_prior="beta")
+
+    def run(flag):
+        monkeypatch.setenv("GST_PALLAS_HYPER", flag)
+        monkeypatch.setenv("GST_PALLAS_WHITE", "0")
+        gb = JaxGibbs(ma, cfg, nchains=6, chunk_size=5, record="full",
+                      hyper_schur=schur)
+        return gb.sample(niter=10, seed=0)
+
+    r0 = run("0")
+    r1 = run("interpret")
+    np.testing.assert_allclose(np.asarray(r1.chain),
+                               np.asarray(r0.chain),
+                               rtol=2e-3, atol=2e-3)
+    np.testing.assert_array_equal(np.asarray(r1.zchain),
+                                  np.asarray(r0.zchain))
+    np.testing.assert_allclose(
+        np.asarray(r1.stats["acc_hyper"]),
+        np.asarray(r0.stats["acc_hyper"]), atol=1e-6)

@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""One-shot TPU A/B: fused Pallas MH blocks (white + hyper) vs XLA loops.
+
+Same relay discipline as tpu_validate.py: a single process, the relay
+dialed once, every stage's result flushed to ``--out`` as it lands.
+
+Stages:
+1. liveness;
+2. white_block: in-scan timing of the vmapped white stage alone, fused
+   kernel off/on, plus on-hardware parity on identical draws;
+3. full_sweep: in-scan timing of the whole vmapped Gibbs sweep across
+   the four flag combinations (off/off, white, hyper, both);
+4. headline: chain-sweeps/s through the real ``sample()`` driver
+   (chunked scan, compact8 recording), off/off vs both, chain parity.
+
+``GST_PALLAS_WHITE``/``GST_PALLAS_HYPER`` are consulted when the sweep
+first TRACES (hyper: at backend construction), so each arm holds its env
+vars across construction *and* first call.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import os
+import sys
+import time
+
+
+@contextlib.contextmanager
+def env_flags(white, hyper):
+    prev = {k: os.environ.get(k)
+            for k in ("GST_PALLAS_WHITE", "GST_PALLAS_HYPER")}
+    os.environ["GST_PALLAS_WHITE"] = white
+    os.environ["GST_PALLAS_HYPER"] = hyper
+    try:
+        yield
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="artifacts/fused_ab_r03.json")
+    ap.add_argument("--reps", type=int, default=30)
+    ap.add_argument("--nchains", type=int, default=1024)
+    args = ap.parse_args()
+    results: dict = {}
+
+    def flush():
+        with open(args.out, "w") as fh:
+            json.dump(results, fh, indent=1)
+
+    def stage(name):
+        def deco(fn):
+            t0 = time.perf_counter()
+            try:
+                results[name] = fn()
+            except Exception as e:  # record and continue
+                results[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
+            results[name + "_stage_s"] = round(time.perf_counter() - t0, 1)
+            print(f"[{name}] {results[name]}", flush=True)
+            flush()
+        return deco
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import random
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.dirname(here))
+    sys.path.insert(0, here)
+    from benchlib import timed_scan
+
+    @stage("liveness")
+    def _():
+        d = jax.devices()
+        jnp.ones(8).sum().block_until_ready()
+        return {"devices": str(d), "backend": jax.default_backend()}
+
+    if "error" in results.get("liveness", {}):
+        print("relay wedged; aborting", file=sys.stderr)
+        flush()
+        return 1
+
+    from gibbs_student_t_tpu.backends import JaxGibbs
+    from gibbs_student_t_tpu.config import GibbsConfig
+    from gibbs_student_t_tpu.data.demo import make_demo_model_arrays
+
+    C = args.nchains
+    ma = make_demo_model_arrays(n=130, components=30, seed=42)
+    cfg = GibbsConfig(model="mixture", vary_df=True, theta_prior="beta")
+
+    @stage("white_block")
+    def _():
+        out = {}
+        xs = {}
+        for white, key in (("0", "xla"), ("auto", "fused")):
+            with env_flags(white, "0"):
+                gb = JaxGibbs(ma, cfg, nchains=C, chunk_size=100)
+                st = gb.init_state(seed=0)
+                keys = random.split(random.PRNGKey(0), C)
+                white_fn = lambda: jax.vmap(
+                    lambda s, k: gb._sweep_white(s, k, None))(st, keys)
+                x, acc, nv = jax.block_until_ready(jax.jit(white_fn)())
+                xs[key] = (np.asarray(x), np.asarray(acc))
+                ms, comp = timed_scan(white_fn, args.reps)
+                out[key + "_ms"] = round(ms, 3)
+                out[key + "_compile_s"] = round(comp, 1)
+        out["max_dx"] = float(np.max(np.abs(xs["fused"][0] - xs["xla"][0])))
+        out["max_dacc"] = float(np.max(np.abs(xs["fused"][1]
+                                              - xs["xla"][1])))
+        return out
+
+    COMBOS = ((("0", "0"), "off"), (("auto", "0"), "white"),
+              (("0", "auto"), "hyper"), (("auto", "auto"), "both"))
+
+    @stage("full_sweep")
+    def _():
+        out = {}
+        for (white, hyper), key in COMBOS:
+            with env_flags(white, hyper):
+                gb = JaxGibbs(ma, cfg, nchains=C, chunk_size=100)
+                st = gb.init_state(seed=0)
+                keys = random.split(random.PRNGKey(0), C)
+                sweep = lambda: jax.vmap(
+                    lambda s, k: gb._sweep(s, k, None, 0))(st, keys)
+                ms, comp = timed_scan(sweep, args.reps)
+                out[key + "_sweep_ms"] = round(ms, 2)
+                out[key + "_compile_s"] = round(comp, 1)
+        return out
+
+    @stage("headline")
+    def _():
+        out = {}
+        chains = {}
+        for (white, hyper), key in ((("0", "0"), "off"),
+                                    (("auto", "auto"), "both")):
+            with env_flags(white, hyper):
+                gb = JaxGibbs(ma, cfg, nchains=C, chunk_size=100)
+                st = gb.init_state(seed=0)
+                gb.sample(niter=100, seed=0, state=st)  # warm
+                st = gb.last_state
+                t0 = time.perf_counter()
+                res = gb.sample(niter=200, seed=0, state=st,
+                                start_sweep=100)
+                dt = time.perf_counter() - t0
+                out[key + "_chain_sweeps_per_s"] = round(200 * C / dt, 1)
+                chains[key] = np.asarray(res.chain)
+        out["max_dchain"] = float(np.max(np.abs(chains["both"]
+                                                - chains["off"])))
+        return out
+
+    flush()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
