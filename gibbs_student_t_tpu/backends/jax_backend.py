@@ -529,9 +529,15 @@ class JaxGibbs(SamplerBackend):
                 make_hyper_block,
             )
 
-            if _pallas_hyper_mode()[0]:
-                cols = (self._schur[1] if self._schur is not None
-                        else np.arange(self._ma.m))
+            from gibbs_student_t_tpu.ops.pallas_hyper import MAX_PALLAS_V
+
+            cols = (self._schur[1] if self._schur is not None
+                    else np.arange(self._ma.m))
+            # Models past the kernel's VMEM bound keep the closure path
+            # (whose factorizations still reach the Pallas Cholesky) —
+            # the dispatcher's XLA fallback would route them through the
+            # plain expander instead.
+            if _pallas_hyper_mode()[0] and len(cols) <= MAX_PALLAS_V:
                 self._hyper_consts = build_hyper_consts(self._ma, cols)
                 self._hyper_block = make_hyper_block(self._hyper_consts,
                                                      config.jitter)
@@ -600,6 +606,15 @@ class JaxGibbs(SamplerBackend):
                  * sigma * scales)
         logus = jnp.log(random.uniform(ku, (nsteps,), dtype=self.dtype))
         return pars, jumps, logus
+
+    def _mh_dx(self, pars, jumps, nsteps: int):
+        """(nsteps, p) one-hot jump vectors from the precomputed draws.
+        Built by comparison against an iota rather than a scatter —
+        scatters lower poorly on TPU, and this sits on every sweep's
+        critical path when a fused MH kernel consumes it."""
+        cols = jnp.arange(self._ma.nparam)
+        return jnp.where(cols[None, :] == pars[:, None],
+                         jumps[:, None], jnp.zeros((), self.dtype))
 
     def _mh_block(self, x, key, ind: np.ndarray, nsteps: int, loglike_fn,
                   jump_scale=1.0):
@@ -687,8 +702,7 @@ class JaxGibbs(SamplerBackend):
                 nsteps = cfg.mh.n_white_steps
                 pars, jumps, logus = self._mh_draws(
                     kw, ma.white_indices, nsteps, jump_scale)
-                dx = jnp.zeros((nsteps, ma.nparam), self.dtype).at[
-                    jnp.arange(nsteps), pars].set(jumps)
+                dx = self._mh_dx(pars, jumps, nsteps)
                 yred = ma.y - Tb
                 x, acc_w = self._white_block(x, az, yred * yred, dx, logus)
             else:
@@ -738,8 +752,7 @@ class JaxGibbs(SamplerBackend):
             nsteps = cfg.mh.n_hyper_steps
             pars, jumps, logus = self._mh_draws(
                 kh, ma.hyper_indices, nsteps, jump_scale_h)
-            dxh = jnp.zeros((nsteps, ma.nparam), self.dtype).at[
-                jnp.arange(nsteps), pars].set(jumps)
+            dxh = self._mh_dx(pars, jumps, nsteps)
             hc = self._hyper_consts
             if self._schur is not None:
                 base = (const_white + 0.5 * (quad_s - logdetA)

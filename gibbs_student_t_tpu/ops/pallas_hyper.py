@@ -67,7 +67,10 @@ from gibbs_student_t_tpu.models.pta import (
 from gibbs_student_t_tpu.ops.pallas_util import (
     HAVE_PLTPU as _HAVE_PLTPU,
     MIN_BATCH as _MIN_BATCH,
+    fold_batch_vmap,
+    int_from_env,
     mode_from_env,
+    pad_chains_edge,
     pltpu,
     round_up as _round_up,
     vmem_spec as _spec,
@@ -77,12 +80,15 @@ from gibbs_student_t_tpu.ops.pallas_white import _lnprior_cols
 LN10 = float(np.log(10.0))
 _LOG_2PI = float(np.log(2.0 * np.pi))
 
-# Past this column count one tile's two (v, v, lanes) buffers (S0 +
-# scratch) stop fitting; the XLA path handles larger models. Tied to
-# the Cholesky kernel's limit: the fallback below this bound is
-# loss-free exactly because such shapes were never Pallas-chol eligible
-# on the closure path either.
-from gibbs_student_t_tpu.ops.pallas_chol import MAX_PALLAS_DIM as MAX_PALLAS_V  # noqa: E402
+# Past this column count the (vp, vp, 128) working set — the S0 block
+# double-buffered across grid steps by the pipeline, plus the scratch
+# factor buffer — stops fitting in the ~16 MB VMEM at the MINIMUM legal
+# lane tile: the chain axis lives on lanes, so Mosaic requires the tile
+# be a multiple of 128 (or the whole array); it cannot shrink below 128
+# the way a sublane tile can. 3 * 80^2 * 128 * 4 B ~= 9.8 MB leaves
+# headroom; larger models fall back to the XLA loop (still reaching the
+# Pallas *Cholesky* through the closure path, so nothing is lost).
+MAX_PALLAS_V = 80
 
 
 class HyperConsts(NamedTuple):
@@ -353,7 +359,7 @@ def _hyper_kernel(S0_ref, dS0_ref, rt_ref, x_ref, dx_ref, lu_ref, K_ref,
 
 
 def hyper_mh_fused(x, S0, dS0, rt, base, dx, logu, consts: HyperConsts,
-                   jitter: float, chain_tile: int = 128,
+                   jitter: float, chain_tile: int | None = None,
                    interpret: bool = False):
     """``(x_new, acc_rate)`` for the whole hyper MH block, one launch.
 
@@ -367,19 +373,24 @@ def hyper_mh_fused(x, S0, dS0, rt, base, dx, logu, consts: HyperConsts,
     S = dx.shape[-2]
     vp = _round_up(v, 8)
     pp = _round_up(p, 8)
-    tile = chain_tile
-    while tile > 8 and 2 * vp * vp * tile * 4 > 8 * 2 ** 20:
-        tile //= 2
-    tile = max(8, min(tile, _round_up(C, 8)))
+    # GST_HYPER_TILE overrides for on-chip tuning (trace-time snapshot).
+    # The chain axis is the LANE dimension, so the tile must be a
+    # multiple of 128 — or the whole (padded) chain axis for small C;
+    # it cannot be shrunk for VMEM the way a sublane tile can (the
+    # MAX_PALLAS_V cap keeps the 128-lane working set inside VMEM), and
+    # an explicit sub-128 ``chain_tile`` is therefore rounded UP to 128
+    # (unlike the white kernel, whose sublane tile honors any multiple
+    # of 8). Measured on-chip: 128 beats 256 at the flagship shape
+    # (artifacts/fused_tune_r03.json).
+    tile = chain_tile or int_from_env("GST_HYPER_TILE", 128)
+    tile = max(128, _round_up(tile, 128))
+    small = _round_up(C, 8)
+    if small < tile:
+        tile = small          # single whole-array block: legal for any size
     Cp = _round_up(C, tile)
 
     def padc(arr):
-        padn = Cp - arr.shape[0]
-        if not padn:
-            return arr
-        return jnp.concatenate(
-            [arr, jnp.broadcast_to(arr[:1], (padn,) + arr.shape[1:])],
-            axis=0)
+        return pad_chains_edge(arr, Cp)
 
     def padax(arr, axis, to):
         padn = to - arr.shape[axis]
@@ -496,13 +507,5 @@ def make_hyper_block(consts: HyperConsts, jitter: float):
         return hyper_mh_loop_xla(x, S0, dS0, rt, base, dx, logu,
                                  consts, jitter)
 
-    @block.def_vmap
-    def _block_vmap(axis_size, in_batched, *args):
-        out = []
-        for arr, bt in zip(args, in_batched):
-            if not bt:
-                arr = jnp.broadcast_to(arr, (axis_size,) + arr.shape)
-            out.append(arr)
-        return block(*out), (True, True)
-
+    block.def_vmap(fold_batch_vmap(block))
     return block

@@ -53,3 +53,45 @@ def mode_from_env(var: str):
     if env == "auto":
         return jax.default_backend() in ("tpu", "axon"), False, False
     return True, False, True
+
+
+def int_from_env(var: str, default: int, mult: int = 8) -> int:
+    """Tuning integer from the environment: ``default`` when unset,
+    empty, or non-numeric (the same forgiving contract as the GST_*
+    mode flags), rounded up to a legal ``mult``-multiple."""
+    raw = os.environ.get(var, "")
+    try:
+        val = int(raw) if raw else default
+    except ValueError:
+        val = default
+    return round_up(max(val, mult), mult)
+
+
+def pad_chains_edge(arr, to: int):
+    """Pad the leading (chain) axis to ``to`` rows by edge-replication,
+    so padded rows stay finite and in-bounds for any downstream math."""
+    import jax.numpy as jnp
+
+    padn = to - arr.shape[0]
+    if not padn:
+        return arr
+    return jnp.concatenate(
+        [arr, jnp.broadcast_to(arr[:1], (padn,) + arr.shape[1:])],
+        axis=0)
+
+
+def fold_batch_vmap(block):
+    """The shared ``custom_vmap`` rule of the fused-MH dispatchers:
+    broadcast unbatched operands and re-enter the block with the mapped
+    axis folded into the leading batch dimension."""
+    import jax.numpy as jnp
+
+    def rule(axis_size, in_batched, *args):
+        out = []
+        for arr, bt in zip(args, in_batched):
+            if not bt:
+                arr = jnp.broadcast_to(arr, (axis_size,) + arr.shape)
+            out.append(arr)
+        return block(*out), (True, True)
+
+    return rule

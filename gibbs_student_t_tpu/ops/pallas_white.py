@@ -63,7 +63,10 @@ from jax.experimental import pallas as pl
 from gibbs_student_t_tpu.ops.pallas_util import (
     HAVE_PLTPU as _HAVE_PLTPU,
     MIN_BATCH as _MIN_BATCH,
+    fold_batch_vmap,
+    int_from_env,
     mode_from_env,
+    pad_chains_edge,
     pltpu,
     round_up as _round_up,
     vmem_spec as _spec,
@@ -263,7 +266,7 @@ def _pad_lanes(arr, width):
 
 
 def white_mh_fused(x, az, yred2, dx, logu, consts: WhiteConsts,
-                   chain_tile: int = 256, interpret: bool = False):
+                   chain_tile: int | None = None, interpret: bool = False):
     """``(x_new, acc_rate)`` for the whole white MH block, one launch.
 
     ``x (C, p)``, ``az/yred2 (C, n)``, ``dx (C, S, p)`` precomputed
@@ -280,21 +283,17 @@ def white_mh_fused(x, az, yred2, dx, logu, consts: WhiteConsts,
     N = _round_up(n, 128)
     SP = _round_up(S, 128)
     # VMEM-budget the chain tile: ~6 (tile, N)-sized live buffers
-    # (az, y2, nv, nd + pipelining headroom), cap ~4 MB
-    tile = chain_tile
+    # (az, y2, nv, nd + pipelining headroom), cap ~4 MB.
+    # GST_WHITE_TILE overrides for on-chip tuning (trace-time snapshot;
+    # 256 measured best at the flagship shape, fused_tune_r03.json).
+    tile = chain_tile or int_from_env("GST_WHITE_TILE", 256)
     while tile > 8 and 6 * tile * N * 4 > 4 * 2 ** 20:
         tile //= 2
     tile = max(8, min(tile, _round_up(C, 8)))
     Cp = _round_up(C, tile)
 
     def pad_chains(arr):
-        padc = Cp - arr.shape[0]
-        if not padc:
-            return arr
-        # edge-replicate so padded rows stay finite and in-bounds
-        return jnp.concatenate(
-            [arr, jnp.broadcast_to(arr[:1], (padc,) + arr.shape[1:])],
-            axis=0)
+        return pad_chains_edge(arr, Cp)
 
     xp_ = pad_chains(_pad_lanes(x, P))
     azp = pad_chains(_pad_lanes(az, N))
@@ -389,13 +388,5 @@ def make_white_block(consts: WhiteConsts):
             return xf.reshape(batch + (p,)), acc.reshape(batch)
         return white_mh_loop_xla(x, az, yred2, dx, logu, consts)
 
-    @block.def_vmap
-    def _block_vmap(axis_size, in_batched, *args):
-        out = []
-        for arr, bt in zip(args, in_batched):
-            if not bt:
-                arr = jnp.broadcast_to(arr, (axis_size,) + arr.shape)
-            out.append(arr)
-        return block(*out), (True, True)
-
+    block.def_vmap(fold_batch_vmap(block))
     return block

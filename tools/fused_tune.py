@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""One-shot TPU tuning sweep for the fused MH kernels' chain tiles.
+
+Times the full vmapped Gibbs sweep (in-scan, flagship shape) across
+tile-size variants of the fused white/hyper kernels, plus the all-off
+baseline. One process, one relay dial, results flushed per arm.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="artifacts/fused_tune_r03.json")
+    ap.add_argument("--reps", type=int, default=40)
+    ap.add_argument("--nchains", type=int, default=1024)
+    args = ap.parse_args()
+    results: dict = {}
+
+    def flush():
+        with open(args.out, "w") as fh:
+            json.dump(results, fh, indent=1)
+
+    import jax
+    import jax.numpy as jnp
+    from jax import random
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.dirname(here))
+    sys.path.insert(0, here)
+    from benchlib import timed_scan
+
+    d = jax.devices()
+    jnp.ones(8).sum().block_until_ready()
+    results["liveness"] = {"devices": str(d),
+                           "backend": jax.default_backend()}
+    flush()
+
+    from gibbs_student_t_tpu.backends import JaxGibbs
+    from gibbs_student_t_tpu.config import GibbsConfig
+    from gibbs_student_t_tpu.data.demo import make_demo_model_arrays
+
+    C = args.nchains
+    ma = make_demo_model_arrays(n=130, components=30, seed=42)
+    cfg = GibbsConfig(model="mixture", vary_df=True, theta_prior="beta")
+
+    ARMS = [
+        ("off", {"GST_PALLAS_WHITE": "0", "GST_PALLAS_HYPER": "0"}),
+        ("both_w256_h128", {}),
+        ("both_w512_h128", {"GST_WHITE_TILE": "512"}),
+        ("both_w1024_h128", {"GST_WHITE_TILE": "1024"}),
+        ("both_w256_h64", {"GST_HYPER_TILE": "64"}),
+        ("both_w256_h256", {"GST_HYPER_TILE": "256"}),
+        ("both_w1024_h256", {"GST_WHITE_TILE": "1024",
+                             "GST_HYPER_TILE": "256"}),
+    ]
+    KEYS = ("GST_PALLAS_WHITE", "GST_PALLAS_HYPER", "GST_WHITE_TILE",
+            "GST_HYPER_TILE")
+    for name, env in ARMS:
+        for k in KEYS:
+            os.environ.pop(k, None)
+        os.environ.update(env)
+        try:
+            t0 = time.perf_counter()
+            gb = JaxGibbs(ma, cfg, nchains=C, chunk_size=100)
+            st = gb.init_state(seed=0)
+            keys = random.split(random.PRNGKey(0), C)
+            sweep = lambda: jax.vmap(
+                lambda s, k: gb._sweep(s, k, None, 0))(st, keys)
+            ms, comp = timed_scan(sweep, args.reps)
+            results[name] = {"sweep_ms": round(ms, 2),
+                             "compile_s": round(comp, 1),
+                             "arm_s": round(time.perf_counter() - t0, 1)}
+        except Exception as e:  # record and continue
+            results[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        finally:
+            for k in KEYS:
+                os.environ.pop(k, None)
+        print(f"[{name}] {results[name]}", flush=True)
+        flush()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
