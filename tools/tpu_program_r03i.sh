@@ -1,0 +1,42 @@
+#!/bin/bash
+# Round-3 hardware program, part I: re-capture the secondary benchmark
+# shapes under the fused MH kernels (the new production default,
+# artifacts/fused_tune_r03.json): the compute-bound thinned flagship,
+# the adapted flagship, BASELINE config 2 (1e3 TOAs), the notebook
+# shape, and the 1e5-TOA stress config. ONE JAX client at a time;
+# nothing signals a client; each stage is its own process.
+set -u
+cd "$(dirname "$0")/.."
+LOG=artifacts/tpu_program_r03i.log
+say() { echo "[$(date -u +%FT%TZ)] $*" >> "$LOG"; }
+
+say "=== TPU program r03i start ==="
+
+say "stage 13a: bench.py --record-thin 8 --niter 384 --chunk 96 (compute-bound)"
+python bench.py --platform axon --record-thin 8 --niter 384 --chunk 96 \
+  > artifacts/BENCH_THIN8_FUSED_r03.out 2> artifacts/BENCH_THIN8_FUSED_r03.err
+say "stage 13a rc=$? json=$(tail -1 artifacts/BENCH_THIN8_FUSED_r03.out)"
+
+say "stage 13b: bench.py --adapt 100"
+python bench.py --platform axon --adapt 100 \
+  > artifacts/BENCH_ADAPT_FUSED_r03.out 2> artifacts/BENCH_ADAPT_FUSED_r03.err
+say "stage 13b rc=$? json=$(tail -1 artifacts/BENCH_ADAPT_FUSED_r03.out)"
+
+say "stage 13c: bench.py config 2 (n=1000, 64 chains)"
+python bench.py --platform axon --ntoa 1000 --nchains 64 \
+  > artifacts/BENCH_CFG2_FUSED_r03.out 2> artifacts/BENCH_CFG2_FUSED_r03.err
+say "stage 13c rc=$? json=$(tail -1 artifacts/BENCH_CFG2_FUSED_r03.out)"
+
+say "stage 13d: bench.py notebook shape (n=12863, 256 chains, 20 components)"
+python bench.py --platform axon --ntoa 12863 --nchains 256 --components 20 \
+  --niter 100 --chunk 50 --baseline-sweeps 20 \
+  > artifacts/BENCH_NOTEBOOK_FUSED_r03.out \
+  2> artifacts/BENCH_NOTEBOOK_FUSED_r03.err
+say "stage 13d rc=$? json=$(tail -1 artifacts/BENCH_NOTEBOOK_FUSED_r03.out)"
+
+say "stage 13e: bench.py --stress (1e5 TOAs, 64 chains, light record)"
+python bench.py --platform axon --stress \
+  > artifacts/BENCH_STRESS_FUSED_r03.out 2> artifacts/BENCH_STRESS_FUSED_r03.err
+say "stage 13e rc=$? json=$(tail -1 artifacts/BENCH_STRESS_FUSED_r03.out)"
+
+say "=== TPU program r03i done ==="
