@@ -1,0 +1,54 @@
+"""Shared Pallas kernel plumbing (ops/pallas_util.py)."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from gibbs_student_t_tpu.ops import pallas_util as pu
+
+
+def test_mode_from_env_semantics(monkeypatch):
+    for off in ("0", "false", ""):
+        monkeypatch.setenv("GST_TEST_FLAG", off)
+        assert pu.mode_from_env("GST_TEST_FLAG") == (False, False, False)
+    monkeypatch.setenv("GST_TEST_FLAG", "interpret")
+    assert pu.mode_from_env("GST_TEST_FLAG") == (True, True, True)
+    monkeypatch.setenv("GST_TEST_FLAG", "1")
+    assert pu.mode_from_env("GST_TEST_FLAG") == (True, False, True)
+    # auto resolves by backend: off on the CPU test platform
+    monkeypatch.delenv("GST_TEST_FLAG", raising=False)
+    assert pu.mode_from_env("GST_TEST_FLAG")[0] is False
+
+
+def test_int_from_env_forgiving(monkeypatch):
+    monkeypatch.delenv("GST_TEST_TILE", raising=False)
+    assert pu.int_from_env("GST_TEST_TILE", 256) == 256
+    # set-but-empty and garbage fall back to the default, like the
+    # mode flags' forgiving contract — not a trace-time crash
+    monkeypatch.setenv("GST_TEST_TILE", "")
+    assert pu.int_from_env("GST_TEST_TILE", 256) == 256
+    monkeypatch.setenv("GST_TEST_TILE", "banana")
+    assert pu.int_from_env("GST_TEST_TILE", 256) == 256
+    # values round up to a legal multiple and never go below it
+    monkeypatch.setenv("GST_TEST_TILE", "100")
+    assert pu.int_from_env("GST_TEST_TILE", 256) == 104
+    monkeypatch.setenv("GST_TEST_TILE", "3")
+    assert pu.int_from_env("GST_TEST_TILE", 256) == 8
+    monkeypatch.setenv("GST_TEST_TILE", "64")
+    assert pu.int_from_env("GST_TEST_TILE", 128, mult=128) == 128
+
+
+def test_pad_chains_edge_replicates():
+    a = jnp.asarray(np.arange(12.0).reshape(3, 4))
+    out = pu.pad_chains_edge(a, 5)
+    assert out.shape == (5, 4)
+    np.testing.assert_array_equal(np.asarray(out[:3]), np.asarray(a))
+    np.testing.assert_array_equal(np.asarray(out[3]), np.asarray(a[0]))
+    np.testing.assert_array_equal(np.asarray(out[4]), np.asarray(a[0]))
+    assert pu.pad_chains_edge(a, 3) is a
+
+
+def test_round_up():
+    assert pu.round_up(1, 8) == 8
+    assert pu.round_up(8, 8) == 8
+    assert pu.round_up(129, 128) == 256

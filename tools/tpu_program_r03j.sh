@@ -1,0 +1,28 @@
+#!/bin/bash
+# Round-3 hardware program, part J: queued behind the relay outage of
+# 10:14 UTC (artifacts/RELAY_DOWN_r03i.json). Waits for the watcher's
+# .relay_alive, then (a) finishes the stress artifact the outage cut
+# short, and (b) re-confirms the official no-flag number. ONE JAX
+# client at a time; nothing signals a client.
+set -u
+cd "$(dirname "$0")/.."
+LOG=artifacts/tpu_program_r03j.log
+say() { echo "[$(date -u +%FT%TZ)] $*" >> "$LOG"; }
+
+say "=== TPU program r03j queued (waiting for .relay_alive) ==="
+while [ ! -f .relay_alive ]; do
+  sleep 30
+done
+say "relay recovered; starting"
+
+say "stage 14a: bench.py --stress --no-block-timings"
+python bench.py --platform axon --stress --no-block-timings \
+  > artifacts/BENCH_STRESS_FUSED_r03.out 2> artifacts/BENCH_STRESS_FUSED_r03.err
+say "stage 14a rc=$? json=$(tail -1 artifacts/BENCH_STRESS_FUSED_r03.out)"
+
+say "stage 14b: bench.py (official, no flags)"
+python bench.py --platform axon \
+  > artifacts/BENCH_FUSED_r03b.out 2> artifacts/BENCH_FUSED_r03b.err
+say "stage 14b rc=$? json=$(tail -1 artifacts/BENCH_FUSED_r03b.out)"
+
+say "=== TPU program r03j done ==="
