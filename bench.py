@@ -348,6 +348,13 @@ def main(argv=None):
                          "Official metric keeps 0 = the reference's "
                          "fixed scales; a nonzero value is tagged in "
                          "the JSON line")
+    ap.add_argument("--adapt-cov", action="store_true",
+                    help="with --adapt: population-covariance joint "
+                         "proposals, re-estimated across the chain "
+                         "population while adapting then frozen "
+                         "(measured x7.65 ESS/sweep on the flagship, "
+                         "artifacts/ADAPT_ESS_COV_r03.json); tagged in "
+                         "the JSON line")
     ap.add_argument("--record", default=None,
                     choices=("full", "compact", "compact8", "light"),
                     help="chain recording mode (default: compact8, the "
@@ -503,8 +510,10 @@ def main(argv=None):
     from gibbs_student_t_tpu.config import GibbsConfig
 
     cfg = GibbsConfig(model=args.model, vary_df=True, theta_prior="beta")
+    if args.adapt_cov and not args.adapt:
+        ap.error("--adapt-cov requires --adapt N")
     if args.adapt:
-        cfg = cfg.with_adapt(args.adapt)
+        cfg = cfg.with_adapt(args.adapt, adapt_cov=args.adapt_cov)
     ma = build(args.ntoa, args.components, dataset=args.dataset)
 
     numpy_sps, numpy_ess = bench_numpy(ma, cfg, args.baseline_sweeps)
@@ -537,6 +546,8 @@ def main(argv=None):
         line["record"] = record
     if args.adapt:
         line["adapt_sweeps"] = args.adapt
+        if args.adapt_cov:
+            line["adapt_cov"] = True
     if jax_ess is not None:
         line["ess_log10A_per_sec"] = round(jax_ess, 2)
     if jax_ess is not None and numpy_ess:

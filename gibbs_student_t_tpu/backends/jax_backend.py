@@ -85,6 +85,12 @@ class ChainState(NamedTuple):
     # hand-built states (tests) valid without triggering device init at
     # import time.
     mh_log_scale: jnp.ndarray = np.zeros(2, np.float32)
+    # (2, p, p) per-block proposal-direction Cholesky factors [white,
+    # hyper], zero-padded outside each block's coordinates — empty (and
+    # unused) unless MHConfig.adapt_cov enables population-covariance
+    # proposals. Re-estimated across the chain population at chunk
+    # boundaries while adapting, frozen at adapt_until.
+    mh_cov_chol: jnp.ndarray = np.zeros(0, np.float32)
 
 
 _RECORD_FIELDS = ("x", "b", "z", "theta", "alpha", "df", "pout",
@@ -294,11 +300,13 @@ def merge_reinit(state, bad, fresh, batch_ndim: int):
     ``batch_ndim`` leading batch axes ((nchains,) for the single-model
     backend, (npulsars, nchains) for ensembles).
 
-    The adapted MH jump scales survive re-init: a chain diverges in its
-    x/b/alpha state, not its (bounded) step sizes, and Robbins-Monro may
-    already be frozen — a zeroed scale would silently run the rest of
-    the sampling un-adapted."""
-    fresh = fresh._replace(mh_log_scale=state.mh_log_scale)
+    The adapted MH jump scales (and population-covariance proposal
+    factors) survive re-init: a chain diverges in its x/b/alpha state,
+    not its (bounded) step sizes, and Robbins-Monro may already be
+    frozen — a zeroed scale would silently run the rest of the sampling
+    un-adapted."""
+    fresh = fresh._replace(mh_log_scale=state.mh_log_scale,
+                           mh_cov_chol=state.mh_cov_chol)
     mask = jnp.asarray(bad)
     return jax.tree.map(
         lambda cur, fr: jnp.where(
@@ -543,6 +551,8 @@ class JaxGibbs(SamplerBackend):
                                                      config.jitter)
         self._chunk_fn = jax.jit(self._make_chunk_fn(),
                                  static_argnames=("length",))
+        self._prop_cov_fn = (jax.jit(self._prop_cov_update)
+                             if config.mh.adapt_cov else None)
         self.last_state: Optional[ChainState] = None
 
     # ------------------------------------------------------------------
@@ -567,6 +577,17 @@ class JaxGibbs(SamplerBackend):
             # padded TOA rows never count as outliers and carry unit scale
             z0 = jnp.where(self._row_mask, z0, 0.0)
             alpha0 = jnp.where(self._row_mask, alpha0, 1.0)
+        if cfg.mh.adapt_cov:
+            # start from the identity on each block's coordinates: the
+            # first chunk-boundary estimate replaces it almost at once
+            p = ma.nparam
+            L0 = np.zeros((2, p, p), dtype=np.float64)
+            for k, ind in enumerate((ma.white_indices, ma.hyper_indices)):
+                L0[k, ind, ind] = 1.0
+            cov0 = jnp.broadcast_to(jnp.asarray(L0, self.dtype),
+                                    (c, 2, p, p))
+        else:
+            cov0 = jnp.zeros((c, 0), dtype=self.dtype)
         return ChainState(
             x=jnp.asarray(x0),
             b=jnp.zeros((c, m), dtype=self.dtype),
@@ -578,6 +599,7 @@ class JaxGibbs(SamplerBackend):
             acc_white=jnp.zeros((c,), dtype=self.dtype),
             acc_hyper=jnp.zeros((c,), dtype=self.dtype),
             mh_log_scale=jnp.zeros((c, 2), dtype=self.dtype),
+            mh_cov_chol=cov0,
         )
 
     # ------------------------------------------------------------------
@@ -587,50 +609,60 @@ class JaxGibbs(SamplerBackend):
     def _lnprior(self, x):
         return lnprior(self._ma, x, jnp)
 
-    def _mh_draws(self, key, ind: np.ndarray, nsteps: int, jump_scale):
-        """All of one MH block's randomness, drawn up front: coordinate
-        choices, pre-scaled jumps (the discrete scale mixture folded in,
-        reference gibbs.py:91-97/124-130), and log-uniform accept draws.
+    def _mh_draws(self, key, ind: np.ndarray, nsteps: int, jump_scale,
+                  cov_chol=None):
+        """All of one MH block's randomness, drawn up front as dense
+        ``(nsteps, p)`` jump vectors plus log-uniform accept draws.
+
+        Default: the reference's jump kernel — one random coordinate per
+        step with the discrete scale mixture folded in (reference
+        gibbs.py:91-97/124-130), built one-hot by iota comparison
+        (scatters lower poorly on TPU). With ``cov_chol`` (a (p, p)
+        block-embedded Cholesky factor, MHConfig.adapt_cov), the step
+        direction becomes ``L @ xi`` — a joint proposal shaped by the
+        chain population's empirical covariance.
+
         Batching the draws replaces ~4 threefry dispatches *per step*
-        with 4 per block — and hands the fused white kernel
-        (ops/pallas_white.py) the identical random stream the XLA loop
-        consumes, so kernel-on/off A/Bs differ only by reduction order."""
+        with 4 per block — and hands the fused MH kernels the identical
+        random stream the XLA loops consume, so kernel-on/off A/Bs
+        differ only by reduction order."""
         mh = self.config.mh
         sigma = mh.sigma_per_param * len(ind) * jump_scale
         sizes = jnp.asarray(mh.scale_sizes, dtype=self.dtype)
         logits = jnp.log(jnp.asarray(mh.scale_probs, dtype=self.dtype))
         kc, kp, kn, ku = random.split(key, 4)
         scales = sizes[random.categorical(kc, logits, shape=(nsteps,))]
-        pars = jnp.asarray(ind)[random.randint(kp, (nsteps,), 0, len(ind))]
-        jumps = (random.normal(kn, (nsteps,), dtype=self.dtype)
-                 * sigma * scales)
+        p = self._ma.nparam
+        if cov_chol is None:
+            pars = jnp.asarray(ind)[
+                random.randint(kp, (nsteps,), 0, len(ind))]
+            jumps = (random.normal(kn, (nsteps,), dtype=self.dtype)
+                     * sigma * scales)
+            cols = jnp.arange(p)
+            dx = jnp.where(cols[None, :] == pars[:, None],
+                           jumps[:, None], jnp.zeros((), self.dtype))
+        else:
+            xi = random.normal(kn, (nsteps, p), dtype=self.dtype)
+            dx = (sigma * scales)[:, None] * (xi @ cov_chol.T)
         logus = jnp.log(random.uniform(ku, (nsteps,), dtype=self.dtype))
-        return pars, jumps, logus
-
-    def _mh_dx(self, pars, jumps, nsteps: int):
-        """(nsteps, p) one-hot jump vectors from the precomputed draws.
-        Built by comparison against an iota rather than a scatter —
-        scatters lower poorly on TPU, and this sits on every sweep's
-        critical path when a fused MH kernel consumes it."""
-        cols = jnp.arange(self._ma.nparam)
-        return jnp.where(cols[None, :] == pars[:, None],
-                         jumps[:, None], jnp.zeros((), self.dtype))
+        return dx, logus
 
     def _mh_block(self, x, key, ind: np.ndarray, nsteps: int, loglike_fn,
-                  jump_scale=1.0):
+                  jump_scale=1.0, cov_chol=None):
         """Branchless random-walk Metropolis on a coordinate block
         (reference gibbs.py:80-143). ``jump_scale`` multiplies the jump
         sigma (the chain's adapted log-scale, exp'd; exactly 1 when
         adaptation is off — the per-step ``scale`` drawn in ``_mh_draws``
-        is the discrete mixture draw, a different thing)."""
-        pars, jumps, logus = self._mh_draws(key, ind, nsteps, jump_scale)
+        is the discrete mixture draw, a different thing); ``cov_chol``
+        switches to population-covariance joint proposals."""
+        dx, logus = self._mh_draws(key, ind, nsteps, jump_scale, cov_chol)
 
         ll0 = loglike_fn(x)
         lp0 = self._lnprior(x)
 
         def body(i, carry):
             x, ll0, lp0, acc = carry
-            q = x.at[pars[i]].add(jumps[i])
+            q = x + dx[i]
             ll1 = loglike_fn(q)
             lp1 = self._lnprior(q)
             accept = (ll1 + lp1) - (ll0 + lp0) > logus[i]
@@ -643,6 +675,48 @@ class JaxGibbs(SamplerBackend):
             0, nsteps, body,
             (x, ll0, lp0, jnp.zeros((), dtype=self.dtype)))
         return x, acc / nsteps
+
+    def _block_cov(self, state: ChainState, k: int):
+        """The block's proposal Cholesky from the state, or None when
+        population-covariance proposals are off."""
+        return (state.mh_cov_chol[k] if self.config.mh.adapt_cov
+                else None)
+
+    def _prop_cov_update(self, state: ChainState) -> ChainState:
+        """Re-estimate each block's proposal Cholesky from the chain
+        population (MHConfig.adapt_cov; called at chunk boundaries
+        while sweep < adapt_until, then never again).
+
+        The population makes this estimate what a single chain can
+        never have: ``nchains`` independent-draw-ish samples at one
+        time point, with no autocorrelation window to tune. Shrinkage
+        toward the diagonal plus a tiny ridge keeps a collapsed or
+        small population factorable; a non-finite factor (degenerate
+        population) keeps the previous one."""
+        mh = self.config.mh
+        x = state.x                                   # (C, p)
+        C, p = x.shape
+        xm = x - jnp.mean(x, axis=0)
+        cov = (xm.T @ xm) / max(C - 1, 1)
+        Lfull = jnp.zeros((p, p), x.dtype)
+        new = []
+        for k, ind in enumerate((self._ma.white_indices,
+                                 self._ma.hyper_indices)):
+            prev = state.mh_cov_chol[0, k]            # shared across chains
+            if len(ind) == 0:
+                new.append(prev)
+                continue
+            sub = cov[np.ix_(ind, ind)]
+            dsub = jnp.diag(jnp.diagonal(sub))
+            sub = (1.0 - mh.cov_shrinkage) * sub + mh.cov_shrinkage * dsub
+            sub = sub + (1e-8 * jnp.mean(jnp.diagonal(sub))
+                         * jnp.eye(len(ind), dtype=sub.dtype))
+            L = jnp.linalg.cholesky(sub)
+            Lk = Lfull.at[np.ix_(ind, ind)].set(L)
+            ok = jnp.isfinite(Lk).all()
+            new.append(jnp.where(ok, Lk, prev))
+        stacked = jnp.broadcast_to(jnp.stack(new), (C, 2, p, p))
+        return state._replace(mh_cov_chol=stacked)
 
     def _resolve(self, ma: ModelArrays | None):
         """(ma, row_mask, block_size, statistical_n) for a sweep stage.
@@ -698,11 +772,11 @@ class JaxGibbs(SamplerBackend):
         if len(ma.white_indices):
             Tb = matvec_blocked(ma.T, b, bs)
             jump_scale = jnp.exp(state.mh_log_scale[0])
+            cov_w = self._block_cov(state, 0)
             if ma_in is None and self._white_block is not None:
-                nsteps = cfg.mh.n_white_steps
-                pars, jumps, logus = self._mh_draws(
-                    kw, ma.white_indices, nsteps, jump_scale)
-                dx = self._mh_dx(pars, jumps, nsteps)
+                dx, logus = self._mh_draws(
+                    kw, ma.white_indices, cfg.mh.n_white_steps,
+                    jump_scale, cov_w)
                 yred = ma.y - Tb
                 x, acc_w = self._white_block(x, az, yred * yred, dx, logus)
             else:
@@ -714,7 +788,8 @@ class JaxGibbs(SamplerBackend):
 
                 x, acc_w = self._mh_block(x, kw, ma.white_indices,
                                           cfg.mh.n_white_steps, ll_white,
-                                          jump_scale=jump_scale)
+                                          jump_scale=jump_scale,
+                                          cov_chol=cov_w)
         else:
             acc_w = jnp.zeros((), dtype=self.dtype)
         return x, acc_w, self._masked_nvec(ma, mask, x, az)
@@ -745,14 +820,14 @@ class JaxGibbs(SamplerBackend):
                 TNT[np.ix_(s_i, s_i)] + jnp.diag(phiinv_s),
                 TNT[np.ix_(s_i, v_i)], TNT[np.ix_(v_i, v_i)],
                 d[s_i], d[v_i], cfg.jitter)
+        cov_h = self._block_cov(state, 1)
         if (ma_in is None and self._hyper_block is not None
                 and len(ma.hyper_indices)):
             # Fused path (ops/pallas_hyper.py): draws precomputed with
             # the same key schedule, the whole block one Pallas launch.
-            nsteps = cfg.mh.n_hyper_steps
-            pars, jumps, logus = self._mh_draws(
-                kh, ma.hyper_indices, nsteps, jump_scale_h)
-            dxh = self._mh_dx(pars, jumps, nsteps)
+            dxh, logus = self._mh_draws(
+                kh, ma.hyper_indices, cfg.mh.n_hyper_steps, jump_scale_h,
+                cov_h)
             hc = self._hyper_consts
             if self._schur is not None:
                 base = (const_white + 0.5 * (quad_s - logdetA)
@@ -793,7 +868,8 @@ class JaxGibbs(SamplerBackend):
 
             x, acc_h = self._mh_block(x, kh, ma.hyper_indices,
                                       cfg.mh.n_hyper_steps, ll_hyper,
-                                      jump_scale=jump_scale_h)
+                                      jump_scale=jump_scale_h,
+                                      cov_chol=cov_h)
         else:
             acc_h = jnp.zeros((), dtype=self.dtype)
 
@@ -877,12 +953,15 @@ class JaxGibbs(SamplerBackend):
             t = jnp.asarray(sweep, dtype=self.dtype)
             eta = jnp.where(t < cfg.mh.adapt_until,
                             (t + 1.0) ** (-cfg.mh.adapt_decay), 0.0)
-            mh_ls = mh_ls + eta * (
-                jnp.stack([acc_w, acc_h]) - cfg.mh.target_accept)
+            # joint proposals target the multivariate RWM optimum
+            target = (cfg.mh.cov_target_accept if cfg.mh.adapt_cov
+                      else cfg.mh.target_accept)
+            mh_ls = mh_ls + eta * (jnp.stack([acc_w, acc_h]) - target)
 
         return ChainState(x=x, b=b, z=z, alpha=alpha, theta=theta, df=df,
                           pout=pout, acc_white=acc_w, acc_hyper=acc_h,
-                          mh_log_scale=mh_ls)
+                          mh_log_scale=mh_ls,
+                          mh_cov_chol=state.mh_cov_chol)
 
     # ------------------------------------------------------------------
     # chunked driver
@@ -1016,6 +1095,14 @@ class JaxGibbs(SamplerBackend):
         ``reinit_diverged`` re-draws numerically dead chains from the prior
         at chunk boundaries (count reported in ``stats['n_reinits']``).
 
+        With ``MHConfig.adapt_cov``, the population proposal covariance
+        is re-estimated at CHUNK boundaries while ``sweep <
+        adapt_until`` — during that window the chain depends on the
+        chunk grid, so a resume inside the adaptation window must keep
+        the same ``chunk_size`` (and chunk-aligned ``start_sweep``) to
+        reproduce an unbroken run; past ``adapt_until`` the factors are
+        frozen state and any chunking resumes exactly.
+
         Record flushes are double-buffered: chunk k's device->host pull
         happens only after chunk k+1 is dispatched, overlapping transfer
         with the next chunk's compute (the ~30 MB/s relay link otherwise
@@ -1069,10 +1156,16 @@ class JaxGibbs(SamplerBackend):
             else:
                 records.append(host)
 
+        def step(st, off, ln):
+            if self.config.mh.adapt_cov and off < self.config.mh.adapt_until:
+                # chunk-boundary re-estimate of the population proposal
+                # covariance; frozen (never called) past adapt_until
+                st = self._prop_cov_fn(st)
+            return self._chunk_fn(st, keys, off, length=ln)
+
         state, n_reinits = chunked_sweep_loop(
             state, niter, self.chunk_size, start_sweep,
-            step_fn=lambda st, off, ln: self._chunk_fn(st, keys, off,
-                                                       length=ln),
+            step_fn=step,
             flush_fn=flush,
             reinit_fn=((lambda st, end: self._reinit_diverged(
                 st, seed=seed + 7919 * end)) if reinit_diverged else None),

@@ -270,7 +270,10 @@ def white_mh_fused(x, az, yred2, dx, logu, consts: WhiteConsts,
     """``(x_new, acc_rate)`` for the whole white MH block, one launch.
 
     ``x (C, p)``, ``az/yred2 (C, n)``, ``dx (C, S, p)`` precomputed
-    one-hot jump vectors, ``logu (C, S)`` log-uniform accept draws —
+    jump vectors — one-hot for the reference's single-coordinate
+    kernel, DENSE under population-covariance proposals
+    (MHConfig.adapt_cov), so the kernel must always evaluate the full
+    ``q = x + dx[j]`` — and ``logu (C, S)`` log-uniform accept draws.
     float32 only (the production TPU regime; float64 runs take the XLA
     path).
     """

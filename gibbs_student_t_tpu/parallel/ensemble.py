@@ -140,6 +140,12 @@ class EnsembleGibbs:
                  nchains: int = 64, mesh: Optional[Mesh] = None,
                  dtype=jnp.float32, chunk_size: int = 50,
                  record: str = "compact8", record_thin: int = 1):
+        if config.mh.adapt_cov:
+            raise NotImplementedError(
+                "population-covariance proposals (MHConfig.adapt_cov) "
+                "are single-model only: the ensemble would need "
+                "per-pulsar covariance estimates at its sharded chunk "
+                "boundaries (scale adaptation, adapt_until alone, works)")
         self.npulsars = len(mas)
         self.nchains = nchains
         self.mesh = mesh

@@ -43,6 +43,11 @@ def load_checkpoint(path: str) -> Tuple[ChainState, int, int]:
             elif f == "mh_log_scale":
                 vals[f] = np.zeros(data["x"].shape[:-1] + (2,),
                                    data["x"].dtype)
+            elif f == "mh_cov_chol":
+                # pre-adapt_cov checkpoint: the feature was off (it did
+                # not exist), so the neutral empty factor is correct
+                vals[f] = np.zeros(data["x"].shape[:-1] + (0,),
+                                   data["x"].dtype)
             else:
                 raise KeyError(f"checkpoint {path} lacks field {f!r}")
         state = ChainState(**vals)

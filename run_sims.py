@@ -186,6 +186,10 @@ def main(argv=None):
                          "(jax backend; Robbins-Monro, then frozen — set "
                          "--burn to at least N rows). 0 = the "
                          "reference's fixed scales")
+    ap.add_argument("--adapt-cov", action="store_true",
+                    help="with --adapt: population-covariance joint "
+                         "proposals (single-model jax backend only; "
+                         "measured x7.65 ESS/sweep on the flagship)")
     ap.add_argument("--until-rhat", type=float, default=0.0,
                     metavar="TARGET",
                     help="jax backend: stop each config once every "
@@ -222,6 +226,11 @@ def main(argv=None):
     # must not cost a simulation (or, with several models/thetas, crash
     # hours into the sweep)
     all_configs = model_configs(args.pspin)
+    if args.adapt_cov and not args.adapt:
+        ap.error("--adapt-cov requires --adapt N")
+    if args.adapt_cov and args.ensemble:
+        ap.error("--adapt-cov is single-model only (the ensemble would "
+                 "need per-pulsar covariance estimates)")
     if args.adapt and args.backend != "jax":
         ap.error("--adapt is a jax-backend feature; the NumPy oracle "
                  "runs the reference's fixed jump scales "
@@ -253,7 +262,8 @@ def main(argv=None):
         ap.error(f"unknown --models {sorted(unknown)}; "
                  f"choose from {sorted(all_configs)}")
     if args.adapt:
-        all_configs = {k: v.with_adapt(args.adapt)
+        all_configs = {k: v.with_adapt(args.adapt,
+                                       adapt_cov=args.adapt_cov)
                        for k, v in all_configs.items()}
     configs = {k: v for k, v in all_configs.items() if k in args.models}
 

@@ -37,6 +37,26 @@ def test_checkpoint_roundtrip_resume(ma, tmp_path):
     np.testing.assert_array_equal(full.chain[10:], resumed.chain)
 
 
+def test_checkpoint_backcompat_missing_new_fields(ma, tmp_path):
+    """Checkpoints written before a ChainState field existed load with
+    the field at its neutral value — old spools stay resumable."""
+    cfg = GibbsConfig(model="mixture")
+    gb = JaxGibbs(ma, cfg, nchains=2, chunk_size=5)
+    gb.sample(niter=5, seed=4)
+    path = str(tmp_path / "old.npz")
+    save_checkpoint(path, gb.last_state, sweep=5, seed=4)
+    with np.load(path) as data:
+        trimmed = {k: data[k] for k in data.files
+                   if k not in ("mh_log_scale", "mh_cov_chol")}
+    np.savez(path, **trimmed)
+    state, sweep, seed = load_checkpoint(path)
+    assert state.mh_log_scale.shape == (2, 2)
+    assert state.mh_cov_chol.shape == (2, 0)
+    gb2 = JaxGibbs(ma, cfg, nchains=2, chunk_size=5)
+    res = gb2.sample(niter=5, seed=seed, state=state, start_sweep=sweep)
+    assert np.isfinite(res.chain).all()
+
+
 def test_chain_result_save_layout(ma, tmp_path):
     """On-disk tree matches the reference driver's layout
     (reference run_sims.py:118-124)."""

@@ -45,7 +45,16 @@ def main():
     ap.add_argument("--burn-j", type=int, default=200)
     ap.add_argument("--thin-j", type=int, default=20)
     ap.add_argument("--seed", type=int, default=123)
+    ap.add_argument("--adapt-cov", type=int, default=0, metavar="N",
+                    help="run the JAX kernel with population-covariance "
+                         "adaptive proposals for the first N sweeps "
+                         "(frozen after; set burn-j >= N) — the "
+                         "distributional gate for MHConfig.adapt_cov")
     args = ap.parse_args()
+    if args.adapt_cov and args.burn_j < args.adapt_cov:
+        ap.error(f"--burn-j ({args.burn_j} sweeps) must discard at "
+                 f"least the {args.adapt_cov} adapting sweeps, or "
+                 "non-frozen samples enter the gate")
 
     here = os.path.dirname(os.path.abspath(__file__))
     sys.path.insert(0, os.path.dirname(here))
@@ -81,7 +90,9 @@ def main():
     res_b = run_oracle(args.seed + 1000)  # independent null replicate
 
     t0 = time.perf_counter()
-    gb_j = JaxGibbs(ma, cfg, nchains=args.nchains, chunk_size=100)
+    cfg_j = (cfg.with_adapt(args.adapt_cov, adapt_cov=True)
+             if args.adapt_cov else cfg)
+    gb_j = JaxGibbs(ma, cfg_j, nchains=args.nchains, chunk_size=100)
     res_j = gb_j.sample(niter=args.niter_j, seed=args.seed + 1)
     print(f"[kernel] {args.niter_j} sweeps x {args.nchains} chains in "
           f"{time.perf_counter() - t0:.0f}s", flush=True)
