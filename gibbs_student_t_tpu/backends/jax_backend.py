@@ -161,7 +161,8 @@ def record_tuple(st, fields, casts):
 
 
 def chunked_sweep_loop(state, niter, chunk_size, start_sweep,
-                       step_fn, flush_fn, reinit_fn=None, n_reinits=0):
+                       step_fn, flush_fn, reinit_fn=None, n_reinits=0,
+                       pre_chunk_fn=None, pre_chunk_until=0):
     """The chunk-orchestration loop shared by ``JaxGibbs.sample`` and
     ``EnsembleGibbs.sample`` (parallel/ensemble.py) so the flush
     machinery cannot drift between them.
@@ -170,7 +171,11 @@ def chunked_sweep_loop(state, niter, chunk_size, start_sweep,
     chunk; ``flush_fn(recs, chunk_state, sweep_end, n_reinits)`` moves a
     chunk's records to host (spool or in-memory); ``reinit_fn(state,
     sweep_end) -> (state, n_bad)``, when given, repairs diverged chains
-    at each chunk boundary. Without ``reinit_fn``, flushes are
+    at each chunk boundary. ``pre_chunk_fn(state) -> state``, when
+    given, runs before each chunk whose offset is below
+    ``pre_chunk_until`` — the population-covariance re-estimation hook
+    (MHConfig.adapt_cov), shared here so its boundary semantics cannot
+    drift between the two samplers. Without ``reinit_fn``, flushes are
     double-buffered: chunk k+1 is dispatched before the blocking pull of
     chunk k's records, overlapping transfer with compute (crash window:
     up to two chunks — see ``JaxGibbs.sample``). With it, flushes are
@@ -180,6 +185,8 @@ def chunked_sweep_loop(state, niter, chunk_size, start_sweep,
     pending = None
     while done < niter:
         length = min(chunk_size, niter - done)
+        if pre_chunk_fn is not None and start_sweep + done < pre_chunk_until:
+            state = pre_chunk_fn(state)
         state, recs = step_fn(state, start_sweep + done, length)
         done += length
         if reinit_fn is not None:
@@ -1156,17 +1163,14 @@ class JaxGibbs(SamplerBackend):
             else:
                 records.append(host)
 
-        def step(st, off, ln):
-            if self.config.mh.adapt_cov and off < self.config.mh.adapt_until:
-                # chunk-boundary re-estimate of the population proposal
-                # covariance; frozen (never called) past adapt_until
-                st = self._prop_cov_fn(st)
-            return self._chunk_fn(st, keys, off, length=ln)
-
         state, n_reinits = chunked_sweep_loop(
             state, niter, self.chunk_size, start_sweep,
-            step_fn=step,
+            step_fn=lambda st, off, ln: self._chunk_fn(st, keys, off,
+                                                       length=ln),
             flush_fn=flush,
+            pre_chunk_fn=self._prop_cov_fn,
+            pre_chunk_until=(self.config.mh.adapt_until
+                             if self.config.mh.adapt_cov else 0),
             reinit_fn=((lambda st, end: self._reinit_diverged(
                 st, seed=seed + 7919 * end)) if reinit_diverged else None),
             n_reinits=n_reinits0)

@@ -140,12 +140,6 @@ class EnsembleGibbs:
                  nchains: int = 64, mesh: Optional[Mesh] = None,
                  dtype=jnp.float32, chunk_size: int = 50,
                  record: str = "compact8", record_thin: int = 1):
-        if config.mh.adapt_cov:
-            raise NotImplementedError(
-                "population-covariance proposals (MHConfig.adapt_cov) "
-                "are single-model only: the ensemble would need "
-                "per-pulsar covariance estimates at its sharded chunk "
-                "boundaries (scale adaptation, adapt_until alone, works)")
         self.npulsars = len(mas)
         self.nchains = nchains
         self.mesh = mesh
@@ -174,6 +168,13 @@ class EnsembleGibbs:
                                  tnt_block_size=None, use_pallas=False)
         self.dtype = dtype
         self._step = self._build_step()
+        # per-pulsar population-covariance re-estimation at chunk
+        # boundaries (MHConfig.adapt_cov): the single-model update
+        # vmapped over the pulsar axis — the stacked models share one
+        # parameter layout, so the template's static block indices apply
+        # to every pulsar's (nchains, p) population independently.
+        self._prop_cov_fn = (jax.jit(jax.vmap(self.template._prop_cov_update))
+                             if config.mh.adapt_cov else None)
         self.last_state = None
 
     # -- construction -------------------------------------------------------
@@ -330,11 +331,14 @@ class EnsembleGibbs:
 
         # double-buffering/sequential-reinit orchestration shared with
         # JaxGibbs.sample (backends/jax_backend.py chunked_sweep_loop)
+        mh = self.template.config.mh
         state, n_reinits = chunked_sweep_loop(
             state, niter, self.chunk_size, start_sweep,
             step_fn=lambda st, off, ln: self._step(st, keys, off,
                                                    length=ln),
             flush_fn=flush,
+            pre_chunk_fn=self._prop_cov_fn,
+            pre_chunk_until=mh.adapt_until if mh.adapt_cov else 0,
             reinit_fn=((lambda st, end: self._reinit_diverged(
                 st, seed=seed + 7919 * end)) if reinit_diverged else None),
             n_reinits=n_reinits0)

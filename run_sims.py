@@ -188,8 +188,8 @@ def main(argv=None):
                          "reference's fixed scales")
     ap.add_argument("--adapt-cov", action="store_true",
                     help="with --adapt: population-covariance joint "
-                         "proposals (single-model jax backend only; "
-                         "measured x7.65 ESS/sweep on the flagship)")
+                         "proposals, per pulsar under --ensemble "
+                         "(measured x7.65 ESS/sweep on the flagship)")
     ap.add_argument("--until-rhat", type=float, default=0.0,
                     metavar="TARGET",
                     help="jax backend: stop each config once every "
@@ -228,9 +228,6 @@ def main(argv=None):
     all_configs = model_configs(args.pspin)
     if args.adapt_cov and not args.adapt:
         ap.error("--adapt-cov requires --adapt N")
-    if args.adapt_cov and args.ensemble:
-        ap.error("--adapt-cov is single-model only (the ensemble would "
-                 "need per-pulsar covariance estimates)")
     if args.adapt and args.backend != "jax":
         ap.error("--adapt is a jax-backend feature; the NumPy oracle "
                  "runs the reference's fixed jump scales "

@@ -25,4 +25,9 @@ python bench.py --platform axon \
   > artifacts/BENCH_FUSED_r03b.out 2> artifacts/BENCH_FUSED_r03b.err
 say "stage 14b rc=$? json=$(tail -1 artifacts/BENCH_FUSED_r03b.out)"
 
+say "stage 14c: bench.py --adapt 100 --adapt-cov (population-cov ESS/s)"
+python bench.py --platform axon --adapt 100 --adapt-cov \
+  > artifacts/BENCH_ADAPTCOV_r03.out 2> artifacts/BENCH_ADAPTCOV_r03.err
+say "stage 14c rc=$? json=$(tail -1 artifacts/BENCH_ADAPTCOV_r03.out)"
+
 say "=== TPU program r03j done ==="
