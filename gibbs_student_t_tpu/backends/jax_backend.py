@@ -202,6 +202,17 @@ def chunked_sweep_loop(state, niter, chunk_size, start_sweep,
     return state, n_reinits
 
 
+def _ess_per_param(window):
+    """(p,) total effective sample size per parameter over a
+    (rows, nchains, p) window (all chains pooled)."""
+    from gibbs_student_t_tpu.parallel.diagnostics import (
+        effective_sample_size,
+    )
+
+    return np.array([float(effective_sample_size(window[..., pi]))
+                     for pi in range(window.shape[-1])])
+
+
 def _rhat_per_param(window):
     """(p,) split-R-hat per parameter over a (rows, nchains, p) window."""
     from gibbs_student_t_tpu.parallel.diagnostics import split_rhat
@@ -212,11 +223,13 @@ def _rhat_per_param(window):
 
 def _sample_until_loop(sample_fn, last_state_fn, record_thin, rhat_of,
                        rhat_target, max_sweeps, check_every, min_sweeps,
-                       state, spool_mode):
+                       state, spool_mode, ess_of=None, min_ess=None):
     """Shared convergence-stopping loop behind ``JaxGibbs.sample_until``
     and ``EnsembleGibbs.sample_until`` — segments of ``check_every``
     sweeps until ``rhat_of`` (computed on the second half of the
-    accumulated chains) clears ``rhat_target`` everywhere.
+    accumulated chains) clears ``rhat_target`` everywhere, and (when
+    ``min_ess`` is set) ``ess_of`` reports at least ``min_ess``
+    effective samples for EVERY parameter in the same window.
 
     ``sample_fn(length, state, start_sweep) -> ChainResult`` runs one
     segment; ``spool_mode`` means each segment's result is already the
@@ -237,6 +250,7 @@ def _sample_until_loop(sample_fn, last_state_fn, record_thin, rhat_of,
             f"record_thin ({record_thin})")
     segments = []
     history = []
+    ess_history = []
     done = 0
     converged = False
 
@@ -269,8 +283,13 @@ def _sample_until_loop(sample_fn, last_state_fn, record_thin, rhat_of,
         # convention folds early-transient sweeps out of the window
         rhat = rhat_of(window)
         history.append(rhat)
+        ess = None
+        if min_ess is not None:
+            ess = ess_of(window)
+            ess_history.append(ess)
         if done >= max(min_sweeps, 2 * check_every) and (
-                rhat < rhat_target).all():
+                rhat < rhat_target).all() and (
+                min_ess is None or (ess >= min_ess).all()):
             converged = True
             break
     if spool_mode:
@@ -297,6 +316,9 @@ def _sample_until_loop(sample_fn, last_state_fn, record_thin, rhat_of,
         out = ChainResult(**cols, stats=stats)
     out.stats["rhat_history"] = np.stack(history)
     out.stats["rhat"] = history[-1]
+    if ess_history:
+        out.stats["ess_history"] = np.stack(ess_history)
+        out.stats["ess"] = ess_history[-1]
     out.stats["converged"] = np.asarray(converged)
     return out
 
@@ -1202,6 +1224,7 @@ class JaxGibbs(SamplerBackend):
                      x0: Optional[np.ndarray] = None,
                      state: Optional[ChainState] = None,
                      min_sweeps: int = 0,
+                     min_ess: Optional[float] = None,
                      **sample_kwargs) -> ChainResult:
         """Sample until every parameter's split-R-hat across the chain
         axis drops below ``rhat_target`` (checked every ``check_every``
@@ -1212,10 +1235,14 @@ class JaxGibbs(SamplerBackend):
         convergence monitoring nearly free — a per-window host-side
         split-R-hat over (rows, nchains) — and the reference (which
         tracks no diagnostics at all, SURVEY.md §5) has no analog; users
-        there pick niter by folklore. The returned result carries the
-        R-hat trajectory in ``stats['rhat_history']`` ((checks, p)
-        array), the final values in ``stats['rhat']``, and
-        ``stats['converged']``. Extra kwargs (``spool_dir``,
+        there pick niter by folklore. ``min_ess`` adds the
+        complementary criterion: R-hat says the chains agree, ESS says
+        the pooled window actually holds at least that many effective
+        samples of EVERY parameter — both must pass to stop. The
+        returned result carries the R-hat trajectory in
+        ``stats['rhat_history']`` ((checks, p) array), the final values
+        in ``stats['rhat']`` (plus ``stats['ess']``/``ess_history``
+        when ``min_ess`` is set), and ``stats['converged']``. Extra kwargs (``spool_dir``,
         ``reinit_diverged``, ...) pass through to ``sample``;
         ``check_every`` must be a multiple of ``record_thin`` covering
         at least 8 recorded rows (smaller windows degenerate
@@ -1232,7 +1259,8 @@ class JaxGibbs(SamplerBackend):
             sample_fn, lambda: self.last_state, self.record_thin,
             _rhat_per_param, rhat_target, max_sweeps, check_every,
             min_sweeps, state,
-            spool_mode=bool(sample_kwargs.get("spool_dir")))
+            spool_mode=bool(sample_kwargs.get("spool_dir")),
+            ess_of=_ess_per_param, min_ess=min_ess)
 
     @staticmethod
     @jax.jit

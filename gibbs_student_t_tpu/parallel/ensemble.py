@@ -365,13 +365,16 @@ class EnsembleGibbs:
                      max_sweeps: int = 20000, check_every: int = 500,
                      seed: int = 0, state: Optional[ChainState] = None,
                      min_sweeps: int = 0,
+                     min_ess: Optional[float] = None,
                      **sample_kwargs) -> ChainResult:
         """Ensemble convergence stopping: sample until EVERY pulsar's
         every parameter clears ``rhat_target`` (split-R-hat over that
-        pulsar's chain axis). Same loop and result semantics as
+        pulsar's chain axis) and, with ``min_ess``, holds that many
+        pooled effective samples. Same loop and result semantics as
         ``JaxGibbs.sample_until`` (backends/jax_backend.py); the R-hat
         arrays in stats are shaped (npulsars, p)."""
         from gibbs_student_t_tpu.backends.jax_backend import (
+            _ess_per_param,
             _rhat_per_param,
             _sample_until_loop,
         )
@@ -379,6 +382,10 @@ class EnsembleGibbs:
         def rhat_of(window):
             # window: (rows, npulsars, nchains, p) -> (npulsars, p)
             return np.array([_rhat_per_param(window[:, pl])
+                             for pl in range(window.shape[1])])
+
+        def ess_of(window):
+            return np.array([_ess_per_param(window[:, pl])
                              for pl in range(window.shape[1])])
 
         def sample_fn(length, st, start):
@@ -389,7 +396,8 @@ class EnsembleGibbs:
             sample_fn, lambda: self.last_state,
             self.template.record_thin, rhat_of, rhat_target,
             max_sweeps, check_every, min_sweeps, state,
-            spool_mode=bool(sample_kwargs.get("spool_dir")))
+            spool_mode=bool(sample_kwargs.get("spool_dir")),
+            ess_of=ess_of, min_ess=min_ess)
 
     # -- divergence recovery ------------------------------------------------
 

@@ -54,7 +54,8 @@ def model_configs(pspin: float = 0.00457):
 
 def run_one(ma, cfg, backend: str, niter: int, nchains: int, seed: int,
             record: str = "compact8", record_thin: int = 1,
-            until_rhat: float = 0.0, check_every: int = 500):
+            until_rhat: float = 0.0, check_every: int = 500,
+            min_ess: float = 0.0):
     from gibbs_student_t_tpu.backends import get_backend
 
     cls = get_backend(backend)
@@ -65,7 +66,8 @@ def run_one(ma, cfg, backend: str, niter: int, nchains: int, seed: int,
             # convergence-stopped run: --niter becomes the cap
             return gb.sample_until(rhat_target=until_rhat,
                                    max_sweeps=niter,
-                                   check_every=check_every, seed=seed)
+                                   check_every=check_every, seed=seed,
+                                   min_ess=min_ess or None)
         return gb.sample(niter=niter, seed=seed)
     gb = cls(ma, cfg)
     return gb.sample(ma.x_init(np.random.default_rng(seed)), niter,
@@ -145,7 +147,8 @@ def run_ensemble(args, configs, parfile, timfile, rng):
             res = ens.sample_until(rhat_target=args.until_rhat,
                                    max_sweeps=args.niter,
                                    check_every=args.check_every,
-                                   seed=seed)
+                                   seed=seed,
+                                   min_ess=args.min_ess or None)
         else:
             res = ens.sample(niter=args.niter, seed=seed)
         dt = time.perf_counter() - t0
@@ -196,6 +199,10 @@ def main(argv=None):
                          "parameter's split-R-hat over the chain axis "
                          "drops below TARGET (--niter becomes the cap; "
                          "checked every --check-every sweeps)")
+    ap.add_argument("--min-ess", type=float, default=0.0,
+                    help="with --until-rhat: also require this many "
+                         "pooled effective samples of every parameter "
+                         "before stopping")
     ap.add_argument("--check-every", type=int, default=500,
                     help="sweeps between R-hat checks for --until-rhat")
     ap.add_argument("--record", default="compact8",
@@ -228,6 +235,9 @@ def main(argv=None):
     all_configs = model_configs(args.pspin)
     if args.adapt_cov and not args.adapt:
         ap.error("--adapt-cov requires --adapt N")
+    if args.min_ess and not args.until_rhat:
+        ap.error("--min-ess composes with --until-rhat (it is an extra "
+                 "stopping criterion, not a standalone mode)")
     if args.adapt and args.backend != "jax":
         ap.error("--adapt is a jax-backend feature; the NumPy oracle "
                  "runs the reference's fixed jump scales "
@@ -295,7 +305,8 @@ def main(argv=None):
                               args.nchains, seed, record=args.record,
                               record_thin=args.record_thin,
                               until_rhat=args.until_rhat,
-                              check_every=args.check_every)
+                              check_every=args.check_every,
+                              min_ess=args.min_ess)
                 dt = time.perf_counter() - t0
                 out = os.path.join(outdir, key, str(theta), str(idx))
                 res.burn(args.burn).save(out)

@@ -169,6 +169,26 @@ def test_sample_until_converges_and_matches_plain_run(ma):
                                   res.stats["rhat_history"])
 
 
+def test_sample_until_min_ess_gates_stopping(ma):
+    """min_ess is the complementary stop criterion: an easily-met R-hat
+    with an unreachable ESS floor must run to max_sweeps, and a
+    reachable one stops early with the ESS verdict in stats."""
+    cfg = GibbsConfig(model="gaussian", vary_df=False)
+    gb = JaxGibbs(ma, cfg, nchains=8, chunk_size=50)
+    res = gb.sample_until(rhat_target=10.0, max_sweeps=300,
+                          check_every=100, seed=4, min_ess=1e9)
+    assert res.chain.shape[0] == 300
+    assert not bool(res.stats["converged"])
+    assert res.stats["ess"].shape == (res.chain.shape[-1],)
+    assert res.stats["ess_history"].shape[0] == 3
+    gb2 = JaxGibbs(ma, cfg, nchains=8, chunk_size=50)
+    res2 = gb2.sample_until(rhat_target=10.0, max_sweeps=600,
+                            check_every=100, seed=4, min_ess=5.0)
+    assert bool(res2.stats["converged"])
+    assert (res2.stats["ess"] >= 5.0).all()
+    assert res2.chain.shape[0] < 600
+
+
 def test_adaptive_mh_moves_acceptance_toward_target(ma):
     """Opt-in Robbins-Monro jump-scale adaptation: the reference's fixed
     table sits near 0.95 white acceptance (too timid for mixing); with
