@@ -78,6 +78,21 @@ class GibbsConfig:
     alpha: float = 1e10            # fixed alpha when vary_alpha=False
     pspin: float | None = None     # spin period (s), needed by model='vvh17'
     df_max: int = 30               # df grid 1..df_max (reference gibbs.py:248)
+    # Outlier-indicator initialization. "model" reproduces the reference
+    # (gibbs.py:50-51: z starts at 1 for t/mixture/vvh17). "zeros" starts
+    # the outlier models at z == 0 — in the dominant all-inlier posterior
+    # mode. The reference init puts vvh17 (fixed alpha=1e10) into a
+    # METASTABLE all-outlier mode on outlier-contaminated data: with every
+    # TOA inflated by alpha, the coefficient draw is prior-dominated,
+    # residuals are huge, p_in underflows, and q -> 1 keeps z pinned at 1
+    # for O(10^3)+ sweeps until a red-noise-amplitude excursion lets the
+    # unflagging cascade start (measured: NumPy oracle escapes at sweep
+    # ~1700 (seed 3) or not within 8000 (seed 11); the f32 JAX kernel at
+    # sweeps ~70-150). Both settle in the same good mode; "zeros" skips
+    # the trap, which the distributional gates rely on (tools/j1713_gate).
+    # Not meaningful for model='t', where z == 1 is structural (the
+    # auxiliary-scale mixture representation, reference gibbs.py:206-208).
+    z_init: str = "model"
     mh: MHConfig = dataclasses.field(default_factory=MHConfig)
     # Cholesky jitter added to Sigma's (preconditioned) diagonal. Plays the
     # role of the reference's SVD->QR fallback / -inf guard
@@ -93,6 +108,15 @@ class GibbsConfig:
             )
         if self.model == "vvh17" and self.pspin is None:
             raise ValueError("model='vvh17' requires pspin (spin period in s)")
+        if self.z_init not in ("model", "zeros"):
+            raise ValueError(
+                f"z_init must be 'model' or 'zeros', got {self.z_init!r}")
+        if self.z_init == "zeros" and self.model == "t":
+            raise ValueError(
+                "z_init='zeros' is invalid for model='t': z == 1 is "
+                "structural there (every TOA carries an auxiliary "
+                "inverse-gamma scale, reference gibbs.py:206-208), and "
+                "update_z never redraws it")
         if self.mh.adapt_cov and self.mh.adapt_until <= 0:
             raise ValueError(
                 "MHConfig.adapt_cov requires adapt_until > 0 (the "
@@ -118,4 +142,7 @@ class GibbsConfig:
     @property
     def z_init_ones(self) -> bool:
         # reference gibbs.py:50-51: z starts at 1 for t/mixture/vvh17
+        # (unless z_init='zeros' opts into the dominant-mode start)
+        if self.z_init == "zeros":
+            return False
         return self.model in ("t", "mixture", "vvh17")

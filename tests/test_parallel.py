@@ -206,6 +206,43 @@ def test_ess_and_rhat_sane():
     assert effective_sample_size(ar) < 100
 
 
+def test_batched_autocorr_matches_per_column():
+    """The batched FFT autocorrelation (one rfft over all columns) must
+    reproduce the per-column Sokal computation exactly — including the
+    constant-column (tau := 1) and no-window-crossing edge cases."""
+    from gibbs_student_t_tpu.parallel.diagnostics import (
+        autocorr_time_batch, ess_per_param)
+
+    rng = np.random.default_rng(7)
+    cols = [rng.standard_normal(400),            # iid
+            np.cumsum(rng.standard_normal(400)),  # random walk (no cross)
+            np.full(400, 3.14),                   # constant (acf[0] == 0)
+            np.convolve(rng.standard_normal(500),
+                        np.ones(20) / 20, "valid")[:400]]  # smoothed
+    x = np.stack(cols, axis=1)
+    batched = autocorr_time_batch(x)
+    reference = []
+    for k in range(x.shape[1]):  # the pre-batching scalar path
+        xc = x[:, k] - x[:, k].mean()
+        f = np.fft.rfft(xc, n=800)
+        acf = np.fft.irfft(f * np.conj(f))[:400]
+        if acf[0] == 0:
+            reference.append(1.0)
+            continue
+        acf = acf / acf[0]
+        tau = 2.0 * np.cumsum(acf) - 1.0
+        window = np.arange(400) >= 5.0 * tau
+        idx = np.argmax(window) if window.any() else 399
+        reference.append(max(tau[idx], 1.0))
+    np.testing.assert_allclose(batched, reference, rtol=1e-12)
+
+    # ess_per_param pools chains per parameter, matching column sums
+    w = rng.standard_normal((300, 8, 3))
+    got = ess_per_param(w)
+    expect = [effective_sample_size(w[..., pi]) for pi in range(3)]
+    np.testing.assert_allclose(got, expect, rtol=1e-12)
+
+
 def test_graft_entry_dryrun():
     """The driver-facing entry points compile and run on the fake mesh."""
     import __graft_entry__ as ge

@@ -18,7 +18,7 @@ import sys
 import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-LOG = os.path.join(ROOT, "artifacts", "relay_watch_r03.jsonl")
+LOG = os.path.join(ROOT, "artifacts", "relay_watch_r04.jsonl")
 ALIVE = os.path.join(ROOT, ".relay_alive")
 
 CHILD = (
