@@ -93,6 +93,30 @@ class ChainState(NamedTuple):
     mh_cov_chol: jnp.ndarray = np.zeros(0, np.float32)
 
 
+class FusedConsts(NamedTuple):
+    """Per-model constant ARRAYS of the fused MH blocks, as a pytree.
+
+    The single-model backend bakes these into the trace as host
+    constants (``JaxGibbs._white_consts`` / ``_hyper_consts``); the
+    ensemble stacks them along a leading pulsar axis and threads them
+    through ``vmap``/``shard_map`` as traced operands so every pulsar
+    reaches the same fused kernels (ops/pallas_white.py grouped grid,
+    ops/pallas_hyper.py per-lane constant planes). The STATIC structure
+    (``WhiteConsts.var``, ``HyperConsts.hyp_idx``, prior kinds) must be
+    identical across pulsars — parallel/ensemble.py validates that at
+    construction and falls back to the closure path otherwise. Fields
+    are None when the corresponding block is unavailable (float64, no
+    white/hyper params, v > MAX_PALLAS_V)."""
+
+    white_rows: jnp.ndarray | None       # (R, n) / (P, R, n)
+    white_specs: jnp.ndarray | None      # (3, p) / (P, 3, p)
+    hyper_K: jnp.ndarray | None          # (1+nk, v) / (P, 1+nk, v)
+    hyper_sel: jnp.ndarray | None        # (v,) / (P, v)
+    hyper_phiinv_static: jnp.ndarray | None   # (v,) / (P, v)
+    hyper_logdet_phi_static: jnp.ndarray | None  # () / (P,)
+    hyper_specs: jnp.ndarray | None      # (3, p) / (P, 3, p)
+
+
 _RECORD_FIELDS = ("x", "b", "z", "theta", "alpha", "df", "pout",
                   "acc_white", "acc_hyper")
 
@@ -537,6 +561,7 @@ class JaxGibbs(SamplerBackend):
         # trace-time snapshot semantics as GST_PALLAS_CHOL) gates the
         # actual kernel use inside the dispatcher.
         self._white_block = None
+        self._white_consts = None
         if dtype == jnp.float32 and len(self._ma.white_indices):
             from gibbs_student_t_tpu.ops.pallas_white import (
                 build_white_consts,
@@ -547,7 +572,11 @@ class JaxGibbs(SamplerBackend):
                 self._ma,
                 None if self._row_mask is None else np.asarray(
                     self._row_mask))
-            self._white_block = make_white_block(wc)
+            self._white_consts = wc
+            # only the static structure is baked in; the constant arrays
+            # travel per call, so ensembles can substitute traced
+            # per-pulsar constants (parallel/ensemble.py)
+            self._white_block = make_white_block(wc.var)
         # Fused hyper MH block (ops/pallas_hyper.py): the 10-step
         # marginalized-likelihood block as one Pallas launch, with the
         # Schur block (or TNT) resident in VMEM across all proposals.
@@ -576,8 +605,8 @@ class JaxGibbs(SamplerBackend):
             # plain expander instead.
             if _pallas_hyper_mode()[0] and len(cols) <= MAX_PALLAS_V:
                 self._hyper_consts = build_hyper_consts(self._ma, cols)
-                self._hyper_block = make_hyper_block(self._hyper_consts,
-                                                     config.jitter)
+                self._hyper_block = make_hyper_block(
+                    self._hyper_consts.hyp_idx, config.jitter)
         self._chunk_fn = jax.jit(self._make_chunk_fn(),
                                  static_argnames=("length",))
         self._prop_cov_fn = (jax.jit(self._prop_cov_update)
@@ -766,32 +795,37 @@ class JaxGibbs(SamplerBackend):
         return nv if mask is None else jnp.where(mask, nv, 1.0)
 
     def _sweep(self, state: ChainState, key, ma: ModelArrays | None = None,
-               sweep=None) -> ChainState:
+               sweep=None, fused: FusedConsts | None = None) -> ChainState:
         """One full Gibbs sweep. ``ma`` defaults to the backend's frozen
         model (embedded as constants); the ensemble path passes a traced
-        per-pulsar ModelArrays pytree instead (parallel/ensemble.py).
+        per-pulsar ModelArrays pytree instead (parallel/ensemble.py),
+        optionally with ``fused`` — that pulsar's fused-MH constant
+        arrays — so the traced model still reaches the fused kernels.
         ``sweep`` is the (traced) sweep index, needed only when MH
         adaptation is enabled (MHConfig.adapt_until)."""
         keys = random.split(key, 7)
-        x, acc_w, nvec = self._sweep_white(state, keys[0], ma)
+        x, acc_w, nvec = self._sweep_white(state, keys[0], ma, fused)
         ma_r, _, bs, _ = self._resolve(ma)
         # per-sweep inner products (reference gibbs.py:302-304), via the
         # fused dense/blocked reduction (ops/tnt.py)
         TNT, d, const_white = tnt_products(ma_r.T, ma_r.y, nvec, bs)
         return self._sweep_rest(state, x, acc_w, TNT, d, const_white,
-                                keys[1:], ma, sweep)
+                                keys[1:], ma, sweep, fused)
 
-    def _sweep_white(self, state: ChainState, kw, ma: ModelArrays | None):
+    def _sweep_white(self, state: ChainState, kw, ma: ModelArrays | None,
+                     fused: FusedConsts | None = None):
         """Sweep stage 1: the white-noise MH block
         (reference gibbs.py:114-143). Returns the updated parameter
         vector, the block acceptance rate, and the post-block ``nvec``.
 
-        On the backend's own frozen float32 model the whole block runs as
-        ONE fused Pallas launch (ops/pallas_white.py) when enabled — the
-        20 sequential steps are pure elementwise work whose XLA form is
-        bound by per-step fixed costs, not arithmetic
-        (docs/PERFORMANCE.md roofline). The ensemble's traced per-pulsar
-        models and float64 runs keep the XLA loop."""
+        On a float32 model the whole block runs as ONE fused Pallas
+        launch (ops/pallas_white.py) when enabled — the 20 sequential
+        steps are pure elementwise work whose XLA form is bound by
+        per-step fixed costs, not arithmetic (docs/PERFORMANCE.md
+        roofline). The backend's own frozen model bakes the constants
+        into the trace; an ensemble's traced per-pulsar model reaches
+        the same kernel through ``fused``. float64 runs keep the XLA
+        closure loop."""
         ma_in = ma
         ma, mask, bs, _ = self._resolve(ma)
         cfg = self.config
@@ -802,12 +836,22 @@ class JaxGibbs(SamplerBackend):
             Tb = matvec_blocked(ma.T, b, bs)
             jump_scale = jnp.exp(state.mh_log_scale[0])
             cov_w = self._block_cov(state, 0)
-            if ma_in is None and self._white_block is not None:
+            use_fused = (self._white_block is not None
+                         and (ma_in is None
+                              or (fused is not None
+                                  and fused.white_rows is not None)))
+            if use_fused:
+                if ma_in is None:
+                    wrows = self._white_consts.rows
+                    wspecs = self._white_consts.specs
+                else:
+                    wrows, wspecs = fused.white_rows, fused.white_specs
                 dx, logus = self._mh_draws(
                     kw, ma.white_indices, cfg.mh.n_white_steps,
                     jump_scale, cov_w)
                 yred = ma.y - Tb
-                x, acc_w = self._white_block(x, az, yred * yred, dx, logus)
+                x, acc_w = self._white_block(x, az, yred * yred, dx,
+                                             logus, wrows, wspecs)
             else:
                 def ll_white(xq):
                     nvec = self._masked_nvec(ma, mask, xq, az)
@@ -824,7 +868,8 @@ class JaxGibbs(SamplerBackend):
         return x, acc_w, self._masked_nvec(ma, mask, x, az)
 
     def _sweep_rest(self, state: ChainState, x, acc_w, TNT, d, const_white,
-                    keys, ma: ModelArrays | None, sweep=None) -> ChainState:
+                    keys, ma: ModelArrays | None, sweep=None,
+                    fused: FusedConsts | None = None) -> ChainState:
         """Sweep stages 2-7: everything conditioned on the TNT/d inner
         products (hyper MH, coefficient draw, theta/z/alpha/df)."""
         ma_in = ma
@@ -850,21 +895,35 @@ class JaxGibbs(SamplerBackend):
                 TNT[np.ix_(s_i, v_i)], TNT[np.ix_(v_i, v_i)],
                 d[s_i], d[v_i], cfg.jitter)
         cov_h = self._block_cov(state, 1)
-        if (ma_in is None and self._hyper_block is not None
-                and len(ma.hyper_indices)):
+        use_fused_h = (self._hyper_block is not None
+                       and len(ma.hyper_indices)
+                       and (ma_in is None
+                            or (fused is not None
+                                and fused.hyper_K is not None)))
+        if use_fused_h:
             # Fused path (ops/pallas_hyper.py): draws precomputed with
             # the same key schedule, the whole block one Pallas launch.
             dxh, logus = self._mh_draws(
                 kh, ma.hyper_indices, cfg.mh.n_hyper_steps, jump_scale_h,
                 cov_h)
-            hc = self._hyper_consts
+            if ma_in is None:
+                hc = self._hyper_consts
+                hK, hsel, hspecs = hc.K, hc.phi_sel, hc.specs
+                h_phiinv_static = jnp.asarray(hc.phiinv_static,
+                                              self.dtype)
+                h_logdet_static = hc.logdet_phi_static
+            else:
+                hK, hsel, hspecs = (fused.hyper_K, fused.hyper_sel,
+                                    fused.hyper_specs)
+                h_phiinv_static = fused.hyper_phiinv_static
+                h_logdet_static = fused.hyper_logdet_phi_static
             if self._schur is not None:
                 base = (const_white + 0.5 * (quad_s - logdetA)
-                        - 0.5 * hc.logdet_phi_static)
+                        - 0.5 * h_logdet_static)
                 Sh, rh = S0, rt
             else:
                 Sh, rh = TNT, d
-                base = const_white - 0.5 * hc.logdet_phi_static
+                base = const_white - 0.5 * h_logdet_static
             # phiinv_static is exactly zero on the Schur path for
             # per-block static/varying splits, but a mixed ecorr block
             # (const and sampled groups in one block) puts static-phi
@@ -872,9 +931,9 @@ class JaxGibbs(SamplerBackend):
             # precision rides on the diagonal here, matching the closure
             # path's full phiinv[v_i].
             dS0 = (jnp.diagonal(Sh, axis1=-2, axis2=-1)
-                   + jnp.asarray(hc.phiinv_static, self.dtype))
+                   + h_phiinv_static)
             x, acc_h = self._hyper_block(x, Sh, dS0, rh, base, dxh,
-                                         logus)
+                                         logus, hK, hsel, hspecs)
         elif len(ma.hyper_indices):
             if self._schur is not None:
                 def ll_hyper(xq):

@@ -67,7 +67,6 @@ from gibbs_student_t_tpu.models.pta import (
 from gibbs_student_t_tpu.ops.pallas_util import (
     HAVE_PLTPU as _HAVE_PLTPU,
     MIN_BATCH as _MIN_BATCH,
-    fold_batch_vmap,
     int_from_env,
     mode_from_env,
     pad_chains_edge,
@@ -207,35 +206,44 @@ def build_hyper_consts(ma, cols) -> HyperConsts:
 # ---------------------------------------------------------------------------
 
 
-def _phi_eval_xla(q, consts: HyperConsts):
-    """(phiinv_varying, sum_logphi_varying) on (…, v) operands."""
-    K = jnp.asarray(consts.K, q.dtype)
-    sel = jnp.asarray(consts.phi_sel, q.dtype)
-    lph = K[0]
-    for k, idx in enumerate(consts.hyp_idx):
-        lph = lph + K[1 + k] * q[..., idx:idx + 1]
+def _phi_eval_xla(q, K, sel, hyp_idx):
+    """(phiinv_varying, sum_logphi_varying) on (…, v) operands.
+    ``K (…, 1+nk, v)`` / ``sel (…, v)`` pre-aligned via
+    ``pallas_white.align_consts`` so leading group axes broadcast."""
+    lph = K[..., 0, :]
+    for k, idx in enumerate(hyp_idx):
+        lph = lph + K[..., 1 + k, :] * q[..., idx:idx + 1]
     phiinv = sel * jnp.exp(-lph)
     return phiinv, jnp.sum(sel * lph, axis=-1)
 
 
-def _lnprior_sum_xla(q, consts: HyperConsts):
-    sp = jnp.asarray(consts.specs, q.dtype)
-    return jnp.sum(_lnprior_cols(q, sp[0], sp[1], sp[2]), axis=-1)
+def _lnprior_sum_xla(q, sp):
+    return jnp.sum(_lnprior_cols(q, sp[..., 0, :], sp[..., 1, :],
+                                 sp[..., 2, :]), axis=-1)
 
 
-def hyper_mh_loop_xla(x, S0, dS0, rt, base, dx, logu,
-                      consts: HyperConsts, jitter: float):
+def hyper_mh_loop_xla(x, S0, dS0, rt, base, dx, logu, K, sel, specs,
+                      hyp_idx, jitter: float):
     """The full hyper MH block over precomputed draws, plain XLA — the
     non-Pallas dispatch target. Batch-generic. ``S0 (…, v, v)`` is the
     proposal-independent matrix block (Schur complement, or TNT), ``dS0``
     its diagonal plus any static phiinv, ``base`` the per-chain constant
     part of the log-likelihood (white const + Schur quad/logdet + static
-    phi logdet)."""
+    phi logdet). ``K (…, 1+nk, v)``, ``sel (…, v)``, ``specs (…, 3, p)``
+    are per-model constants — rank 2 (1 for sel) for one frozen model,
+    or with leading group axes matching x's leading batch axes (the
+    ensemble's traced per-pulsar constants)."""
+    from gibbs_student_t_tpu.ops.pallas_white import align_consts
+
+    xb = x.ndim - 1
+    K = align_consts(jnp.asarray(K, x.dtype), xb)
+    sel = align_consts(jnp.asarray(sel, x.dtype), xb, core_dims=1)
+    specs = align_consts(jnp.asarray(specs, x.dtype), xb)
     v = S0.shape[-1]
     eye = jnp.eye(v, dtype=S0.dtype)
 
     def ll_lp(q):
-        phiinv, sum_lph = _phi_eval_xla(q, consts)
+        phiinv, sum_lph = _phi_eval_xla(q, K, sel, hyp_idx)
         d = dS0 + phiinv
         isd = 1.0 / jnp.sqrt(d)
         Ssc = S0 * isd[..., :, None] * isd[..., None, :]
@@ -250,7 +258,7 @@ def hyper_mh_loop_xla(x, S0, dS0, rt, base, dx, logu,
         ll = base + 0.5 * (quad - (logdet_S + jnp.sum(jnp.log(d), axis=-1))
                            - sum_lph)
         ll = jnp.where(jnp.isfinite(ll), ll, -jnp.inf)
-        return ll, _lnprior_sum_xla(q, consts)
+        return ll, _lnprior_sum_xla(q, specs)
 
     nsteps = dx.shape[-2]
     ll0, lp0 = ll_lp(x)
@@ -358,21 +366,35 @@ def _hyper_kernel(S0_ref, dS0_ref, rt_ref, x_ref, dx_ref, lu_ref, K_ref,
     ao_ref[:] = jnp.broadcast_to(acc, ao_ref.shape)
 
 
-def hyper_mh_fused(x, S0, dS0, rt, base, dx, logu, consts: HyperConsts,
-                   jitter: float, chain_tile: int | None = None,
+def hyper_mh_fused(x, S0, dS0, rt, base, dx, logu, K, sel, specs,
+                   hyp_idx, jitter: float, chain_tile: int | None = None,
                    interpret: bool = False):
     """``(x_new, acc_rate)`` for the whole hyper MH block, one launch.
 
-    ``x (C, p)``, ``S0 (C, v, v)``, ``dS0/rt (C, v)``, ``base (C,)``,
-    ``dx (C, S, p)``, ``logu (C, S)`` — float32 only.
+    GROUPED form: ``x (G, C, p)``, ``S0 (G, C, v, v)``, ``dS0/rt
+    (G, C, v)``, ``base (G, C)``, ``dx (G, C, S, p)``, ``logu
+    (G, C, S)``, with PER-GROUP constants ``K (G, 1+nk, v)``,
+    ``sel (G, v)``, ``specs (G, 3, p)`` (a single frozen model passes
+    G == 1). The chain axis is the LANE axis and the constants are
+    pre-broadcast per lane anyway, so the grouped call simply repeats
+    each group's constant planes over its own chains — chain tiles may
+    straddle groups freely. float32 only.
     """
     if x.dtype != jnp.float32:
         raise ValueError(f"pallas hyper kernel is float32-only, got {x.dtype}")
-    C, p = x.shape
+    G, C, p = x.shape
     v = S0.shape[-1]
     S = dx.shape[-2]
     vp = _round_up(v, 8)
     pp = _round_up(p, 8)
+
+    def gflat(arr):  # (G, C, ...) -> (G*C, ...), group-major chains
+        return arr.reshape((G * C,) + arr.shape[2:])
+
+    x, S0, dS0, rt, base, dx, logu = (
+        gflat(a) for a in (x, S0, dS0, rt, base, dx, logu))
+    C_per = C
+    C = G * C_per
     # GST_HYPER_TILE overrides for on-chip tuning (trace-time snapshot).
     # The chain axis is the LANE dimension, so the tile must be a
     # multiple of 128 — or the whole (padded) chain axis for small C;
@@ -421,17 +443,29 @@ def hyper_mh_fused(x, S0, dS0, rt, base, dx, logu, consts: HyperConsts,
     bt = padc(base)[None, :]                                 # (1, Cp)
 
     # constants pre-broadcast over the chain lane axis (cheap HBM, and it
-    # sidesteps width-1 lane slicing in-kernel)
-    K = jnp.asarray(consts.K, jnp.float32)
-    nk = K.shape[0]
-    Kt = jnp.broadcast_to(padax(K, -1, vp)[:, :, None], (nk, vp, Cp))
-    selt = jnp.broadcast_to(
-        padax(jnp.asarray(consts.phi_sel, jnp.float32), -1, vp)[:, None],
-        (vp, Cp))
-    sp = jnp.asarray(consts.specs, jnp.float32)
+    # sidesteps width-1 lane slicing in-kernel): each group's constant
+    # planes repeat over its own chains, so a chain tile always reads
+    # the right group's values regardless of group boundaries
+    def lanes(arr):
+        # (G, ..., k) -> (..., k, Cp): per-group chain repeat, edge-pad
+        rep = jnp.repeat(jnp.moveaxis(arr, 0, -1), C_per, axis=-1)
+        padn = Cp - rep.shape[-1]
+        if padn:
+            rep = jnp.concatenate(
+                [rep, jnp.broadcast_to(rep[..., -1:],
+                                       rep.shape[:-1] + (padn,))],
+                axis=-1)
+        return rep
+
+    K = jnp.asarray(K, jnp.float32)
+    nk = K.shape[1]
+    Kt = lanes(padax(K, -1, vp))
+    selt = lanes(padax(jnp.asarray(sel, jnp.float32), -1, vp))
+    sp = jnp.asarray(specs, jnp.float32)
     sp = jnp.concatenate(
-        [sp, jnp.zeros((4 - sp.shape[0], sp.shape[1]), jnp.float32)])
-    spt = jnp.broadcast_to(padax(sp, -1, pp)[:, :, None], (4, pp, Cp))
+        [sp, jnp.zeros((G, 4 - sp.shape[1], sp.shape[2]), jnp.float32)],
+        axis=1)
+    spt = lanes(padax(sp, -1, pp))
 
     if not _HAVE_PLTPU:  # pragma: no cover - no-TPU-extension builds
         raise RuntimeError("pallas TPU extension unavailable")
@@ -439,7 +473,7 @@ def hyper_mh_fused(x, S0, dS0, rt, base, dx, logu, consts: HyperConsts,
         dimension_semantics=("parallel",))}
     scratch = [pltpu.VMEM((vp, vp, tile), jnp.float32)]
     kernel = functools.partial(_hyper_kernel, nsteps=S, v=v, p=p,
-                               hyp_idx=consts.hyp_idx, jitter=jitter)
+                               hyp_idx=hyp_idx, jitter=jitter)
     xo, ao = pl.pallas_call(
         kernel,
         grid=(Cp // tile,),
@@ -467,7 +501,8 @@ def hyper_mh_fused(x, S0, dS0, rt, base, dx, logu, consts: HyperConsts,
         interpret=interpret,
         **kwargs,
     )(S0t, dS0t, rtt, xt, dxt, lut, Kt, selt, spt, bt)
-    return jnp.transpose(xo, (1, 0))[:C, :p], ao[0, :C] / S
+    xf = jnp.transpose(xo, (1, 0))[:C, :p].reshape(G, C_per, p)
+    return xf, (ao[0, :C] / S).reshape(G, C_per)
 
 
 # ---------------------------------------------------------------------------
@@ -482,30 +517,51 @@ def _pallas_hyper_mode():
     return mode_from_env("GST_PALLAS_HYPER")
 
 
-def make_hyper_block(consts: HyperConsts, jitter: float):
-    """Build the dispatched hyper-MH block for one frozen model —
-    ``block(x, S0, dS0, rt, base, dx, logu) -> (x_new, acc_rate)``,
-    custom-vmapped like ops/pallas_white.make_white_block."""
+def make_hyper_block(hyp_idx: Tuple[int, ...], jitter: float):
+    """Build the dispatched hyper-MH block for one model STRUCTURE —
+    ``block(x, S0, dS0, rt, base, dx, logu, K, sel, specs) ->
+    (x_new, acc_rate)``, custom-vmapped like
+    ops/pallas_white.make_white_block: only ``HyperConsts.hyp_idx`` (the
+    static affine-phi structure) is closed over; the constant arrays
+    ``K``/``phi_sel``/``specs`` travel as call operands so ensembles can
+    pass traced per-pulsar constants (leading group axis) through
+    ``vmap``/``shard_map``."""
+    from gibbs_student_t_tpu.ops.pallas_white import consts_batch_vmap
 
     @custom_vmap
-    def block(x, S0, dS0, rt, base, dx, logu):
+    def block(x, S0, dS0, rt, base, dx, logu, K, sel, specs):
         enabled, interp, forced = _pallas_hyper_mode()
-        batch = x.shape[:-1]
-        B = int(np.prod(batch)) if batch else 1
-        ok = (_HAVE_PLTPU and x.dtype == jnp.float32
-              and S0.shape[-1] <= MAX_PALLAS_V
-              and (forced or B >= _MIN_BATCH) and x.ndim >= 2)
-        if enabled and ok:
-            p = x.shape[-1]
-            v = S0.shape[-1]
-            S = dx.shape[-2]
-            xf, acc = hyper_mh_fused(
-                x.reshape(B, p), S0.reshape(B, v, v), dS0.reshape(B, v),
-                rt.reshape(B, v), base.reshape(B), dx.reshape(B, S, p),
-                logu.reshape(B, S), consts, jitter, interpret=interp)
-            return xf.reshape(batch + (p,)), acc.reshape(batch)
+        grouped = K.ndim == 3
+        if grouped:
+            batch = x.shape[:-1]
+            B = int(np.prod(batch)) if batch else 1
+            ok = (_HAVE_PLTPU and x.dtype == jnp.float32
+                  and S0.shape[-1] <= MAX_PALLAS_V
+                  and (forced or B >= _MIN_BATCH)
+                  and x.ndim == 3 and K.shape[0] == x.shape[0])
+            if enabled and ok:
+                return hyper_mh_fused(x, S0, dS0, rt, base, dx, logu,
+                                      K, sel, specs, hyp_idx, jitter,
+                                      interpret=interp)
+        elif K.ndim == 2:
+            batch = x.shape[:-1]
+            B = int(np.prod(batch)) if batch else 1
+            ok = (_HAVE_PLTPU and x.dtype == jnp.float32
+                  and S0.shape[-1] <= MAX_PALLAS_V
+                  and (forced or B >= _MIN_BATCH) and x.ndim >= 2)
+            if enabled and ok:
+                p = x.shape[-1]
+                v = S0.shape[-1]
+                S = dx.shape[-2]
+                xf, acc = hyper_mh_fused(
+                    x.reshape(1, B, p), S0.reshape(1, B, v, v),
+                    dS0.reshape(1, B, v), rt.reshape(1, B, v),
+                    base.reshape(1, B), dx.reshape(1, B, S, p),
+                    logu.reshape(1, B, S), K[None], sel[None],
+                    specs[None], hyp_idx, jitter, interpret=interp)
+                return xf.reshape(batch + (p,)), acc.reshape(batch)
         return hyper_mh_loop_xla(x, S0, dS0, rt, base, dx, logu,
-                                 consts, jitter)
+                                 K, sel, specs, hyp_idx, jitter)
 
-    block.def_vmap(fold_batch_vmap(block))
+    block.def_vmap(consts_batch_vmap(block, n_data=7))
     return block

@@ -80,18 +80,3 @@ def pad_chains_edge(arr, to: int):
         axis=0)
 
 
-def fold_batch_vmap(block):
-    """The shared ``custom_vmap`` rule of the fused-MH dispatchers:
-    broadcast unbatched operands and re-enter the block with the mapped
-    axis folded into the leading batch dimension."""
-    import jax.numpy as jnp
-
-    def rule(axis_size, in_batched, *args):
-        out = []
-        for arr, bt in zip(args, in_batched):
-            if not bt:
-                arr = jnp.broadcast_to(arr, (axis_size,) + arr.shape)
-            out.append(arr)
-        return block(*out), (True, True)
-
-    return rule

@@ -63,10 +63,8 @@ from jax.experimental import pallas as pl
 from gibbs_student_t_tpu.ops.pallas_util import (
     HAVE_PLTPU as _HAVE_PLTPU,
     MIN_BATCH as _MIN_BATCH,
-    fold_batch_vmap,
     int_from_env,
     mode_from_env,
-    pad_chains_edge,
     pltpu,
     round_up as _round_up,
     vmem_spec as _spec,
@@ -159,37 +157,59 @@ def _lnprior_cols(q, kind, a, b):
     return out
 
 
+def align_consts(c, x_batch_dims: int, core_dims: int = 2):
+    """View a consts array whose leading axes are GROUP axes so it
+    broadcasts against per-chain data: insert singleton axes for the
+    chain-batch dims between the group axes and the core dims.
+
+    ``c`` has shape ``G + core``; the result has ``G + (1,)*extra +
+    core`` with ``extra = x_batch_dims - len(G)`` — e.g. rows (G, R, n)
+    against x (G, C, p) views as (G, 1, R, n)."""
+    g_dims = c.ndim - core_dims
+    extra = x_batch_dims - g_dims
+    if extra <= 0:
+        return c
+    shape = c.shape[:g_dims] + (1,) * extra + c.shape[g_dims:]
+    return c.reshape(shape)
+
+
 def _ll_lp_xla(q, az, yred2, rows, var, specs):
     """(ll, lp) for proposal ``q`` (…, p) with per-chain ``az``/``yred2``
     (…, n) — the array-based form of the white conditional likelihood
-    (reference gibbs.py:262-284) plus the full prior."""
-    nd = rows[0]
+    (reference gibbs.py:262-284) plus the full prior. ``rows``/``specs``
+    may carry leading group axes pre-aligned via :func:`align_consts`."""
+    nd = rows[..., 0, :]
     for vkind, idx, slot in var:
         val = q[..., idx:idx + 1]
         c = val * val if vkind == 0 else jnp.exp(2.0 * LN10 * val)
-        nd = nd + c * rows[slot]
+        nd = nd + c * rows[..., slot, :]
     nv = az * nd
-    nv = rows[1] * nv + (1.0 - rows[1])
+    rmask = rows[..., 1, :]
+    nv = rmask * nv + (1.0 - rmask)
     ll = -0.5 * jnp.sum(jnp.log(nv) + yred2 / nv, axis=-1)
-    lp = jnp.sum(_lnprior_cols(q, specs[0], specs[1], specs[2]), axis=-1)
+    lp = jnp.sum(_lnprior_cols(q, specs[..., 0, :], specs[..., 1, :],
+                               specs[..., 2, :]), axis=-1)
     return ll, lp
 
 
-def white_mh_loop_xla(x, az, yred2, dx, logu, consts: WhiteConsts):
+def white_mh_loop_xla(x, az, yred2, dx, logu, rows, specs, var):
     """The full white MH block as a ``fori_loop`` over precomputed draws —
     the non-Pallas dispatch target. Batch-generic: every operand may carry
-    leading batch axes (``dx`` (…, S, p), ``logu`` (…, S))."""
-    rows = jnp.asarray(consts.rows, x.dtype)
-    specs = jnp.asarray(consts.specs, x.dtype)
+    leading batch axes (``dx`` (…, S, p), ``logu`` (…, S)); ``rows``
+    (…, R, n) / ``specs`` (…, 3, p) may be per-model constants (rank 2)
+    or carry leading GROUP axes matching x's leading batch axes (the
+    ensemble's traced per-pulsar constants)."""
+    rows = align_consts(jnp.asarray(rows, x.dtype), x.ndim - 1)
+    specs = align_consts(jnp.asarray(specs, x.dtype), x.ndim - 1)
     nsteps = dx.shape[-2]
-    ll0, lp0 = _ll_lp_xla(x, az, yred2, rows, consts.var, specs)
+    ll0, lp0 = _ll_lp_xla(x, az, yred2, rows, var, specs)
     acc0 = jnp.zeros(ll0.shape, x.dtype)
 
     def body(i, carry):
         x, ll0, lp0, acc = carry
         q = x + lax.dynamic_index_in_dim(dx, i, axis=dx.ndim - 2,
                                          keepdims=False)
-        ll1, lp1 = _ll_lp_xla(q, az, yred2, rows, consts.var, specs)
+        ll1, lp1 = _ll_lp_xla(q, az, yred2, rows, var, specs)
         lu = lax.dynamic_index_in_dim(logu, i, axis=logu.ndim - 1,
                                       keepdims=False)
         accept = (ll1 + lp1) - (ll0 + lp0) > lu
@@ -209,16 +229,19 @@ def white_mh_loop_xla(x, az, yred2, dx, logu, consts: WhiteConsts):
 def _white_kernel(x_ref, az_ref, y2_ref, dx_ref, lu_ref, cn_ref, sp_ref,
                   xo_ref, ao_ref, *, nsteps: int, p: int,
                   var: Tuple[Tuple[int, int, int], ...]):
+    # cn_ref (1, R, N) / sp_ref (1, 8, P): the leading singleton is the
+    # GROUP (pulsar) block axis — each grid tile reads its own group's
+    # constants via the index map (shared across the tile's chains)
     C, P = x_ref.shape
     N = az_ref.shape[1]
     colP = lax.broadcasted_iota(jnp.int32, (1, P), 1)
     colS = lax.broadcasted_iota(jnp.int32, (1, lu_ref.shape[1]), 1)
     pmask = colP < p
-    kind = jnp.where(pmask, sp_ref[0:1, :], -1.0)
-    a = sp_ref[1:2, :]
-    b = sp_ref[2:3, :]
-    nv0 = cn_ref[0:1, :]
-    rmask = cn_ref[1:2, :]
+    kind = jnp.where(pmask, sp_ref[0, 0:1, :], -1.0)
+    a = sp_ref[0, 1:2, :]
+    b = sp_ref[0, 2:3, :]
+    nv0 = cn_ref[0, 0:1, :]
+    rmask = cn_ref[0, 1:2, :]
     az = az_ref[:]
     y2 = y2_ref[:]
     lu_all = lu_ref[:]
@@ -231,7 +254,7 @@ def _white_kernel(x_ref, az_ref, y2_ref, dx_ref, lu_ref, cn_ref, sp_ref,
             val = jnp.sum(jnp.where(colP == idx, q, 0.0), axis=1,
                           keepdims=True)
             c = val * val if vkind == 0 else jnp.exp(2.0 * LN10 * val)
-            nd = nd + c * cn_ref[slot:slot + 1, :]
+            nd = nd + c * cn_ref[0, slot:slot + 1, :]
         nv = az * nd
         nv = rmask * nv + (1.0 - rmask)
         ll = -0.5 * jnp.sum(jnp.log(nv) + y2 / nv, axis=1, keepdims=True)
@@ -265,21 +288,25 @@ def _pad_lanes(arr, width):
         [arr, jnp.zeros(arr.shape[:-1] + (pad,), arr.dtype)], axis=-1)
 
 
-def white_mh_fused(x, az, yred2, dx, logu, consts: WhiteConsts,
+def white_mh_fused(x, az, yred2, dx, logu, rows, specs, var,
                    chain_tile: int | None = None, interpret: bool = False):
     """``(x_new, acc_rate)`` for the whole white MH block, one launch.
 
-    ``x (C, p)``, ``az/yred2 (C, n)``, ``dx (C, S, p)`` precomputed
-    jump vectors — one-hot for the reference's single-coordinate
-    kernel, DENSE under population-covariance proposals
-    (MHConfig.adapt_cov), so the kernel must always evaluate the full
-    ``q = x + dx[j]`` — and ``logu (C, S)`` log-uniform accept draws.
-    float32 only (the production TPU regime; float64 runs take the XLA
-    path).
+    GROUPED form: ``x (G, C, p)``, ``az/yred2 (G, C, n)``,
+    ``dx (G, C, S, p)`` precomputed jump vectors — one-hot for the
+    reference's single-coordinate kernel, DENSE under
+    population-covariance proposals (MHConfig.adapt_cov), so the kernel
+    must always evaluate the full ``q = x + dx[j]`` — ``logu (G, C, S)``
+    log-uniform accept draws, and PER-GROUP constants ``rows (G, R, n)``
+    / ``specs (G, 3, p)`` (the ensemble's traced per-pulsar constants;
+    a single frozen model passes G == 1). Chains are padded per group so
+    no chain tile straddles two groups, and each tile reads its group's
+    constants through the index map. float32 only (the production TPU
+    regime; float64 runs take the XLA path).
     """
     if x.dtype != jnp.float32:
         raise ValueError(f"pallas white kernel is float32-only, got {x.dtype}")
-    C, p = x.shape
+    G, C, p = x.shape
     n = az.shape[-1]
     S = dx.shape[-2]
     P = _round_up(p, 128)
@@ -294,59 +321,72 @@ def white_mh_fused(x, az, yred2, dx, logu, consts: WhiteConsts,
         tile //= 2
     tile = max(8, min(tile, _round_up(C, 8)))
     Cp = _round_up(C, tile)
+    tpg = Cp // tile  # tiles per group
 
     def pad_chains(arr):
-        return pad_chains_edge(arr, Cp)
+        # per-group edge-replication pad of the chain axis (axis 1)
+        padn = Cp - arr.shape[1]
+        if not padn:
+            return arr
+        return jnp.concatenate(
+            [arr, jnp.broadcast_to(arr[:, :1],
+                                   (G, padn) + arr.shape[2:])], axis=1)
 
-    xp_ = pad_chains(_pad_lanes(x, P))
-    azp = pad_chains(_pad_lanes(az, N))
+    def flat(arr):  # (G, Cp, ...) -> (G*Cp, ...)
+        return arr.reshape((G * Cp,) + arr.shape[2:])
+
+    xp_ = flat(pad_chains(_pad_lanes(x, P)))
+    azp = flat(pad_chains(_pad_lanes(az, N)))
     # padded TOA lanes: az must be 1 (not 0) so log(nv)=0 there; the rmask
     # row already zeroes their reduction terms, this keeps them finite
     if N > n:
         lane = lax.broadcasted_iota(jnp.int32, (1, N), 1)
         azp = jnp.where(lane < n, azp, 1.0)
-    y2p = pad_chains(_pad_lanes(yred2, N))
-    dxp = jnp.swapaxes(pad_chains(_pad_lanes(dx, P)), 0, 1)  # (S, Cp, P)
-    lup = pad_chains(_pad_lanes(logu, SP))
+    y2p = flat(pad_chains(_pad_lanes(yred2, N)))
+    # (S, G*Cp, P): step index on the untiled leading axis
+    dxp = jnp.moveaxis(flat(pad_chains(_pad_lanes(dx, P))), 1, 0)
+    lup = flat(pad_chains(_pad_lanes(logu, SP)))
 
-    rows = _pad_lanes(jnp.asarray(consts.rows, jnp.float32), N)
-    R = _round_up(rows.shape[0], 8)
+    rows = _pad_lanes(jnp.asarray(rows, jnp.float32), N)
+    R = _round_up(rows.shape[1], 8)
     rows = jnp.concatenate(
-        [rows, jnp.zeros((R - rows.shape[0], N), jnp.float32)], axis=0)
-    specs = _pad_lanes(jnp.asarray(consts.specs, jnp.float32), P)
+        [rows, jnp.zeros((G, R - rows.shape[1], N), jnp.float32)], axis=1)
+    specs = _pad_lanes(jnp.asarray(specs, jnp.float32), P)
     specs = jnp.concatenate(
-        [specs, jnp.zeros((8 - specs.shape[0], P), jnp.float32)], axis=0)
+        [specs, jnp.zeros((G, 8 - specs.shape[1], P), jnp.float32)],
+        axis=1)
 
     kwargs = {}
     if _HAVE_PLTPU:  # chain tiles are independent
         kwargs["compiler_params"] = pltpu.CompilerParams(
             dimension_semantics=("parallel",))
-    kernel = functools.partial(_white_kernel, nsteps=S, p=p,
-                               var=consts.var)
+    kernel = functools.partial(_white_kernel, nsteps=S, p=p, var=var)
     xo, ao = pl.pallas_call(
         kernel,
-        grid=(Cp // tile,),
+        grid=(G * tpg,),
         in_specs=[
             _spec((tile, P), lambda g: (g, 0)),
             _spec((tile, N), lambda g: (g, 0)),
             _spec((tile, N), lambda g: (g, 0)),
             _spec((S, tile, P), lambda g: (0, g, 0)),
             _spec((tile, SP), lambda g: (g, 0)),
-            _spec((R, N), lambda g: (0, 0)),
-            _spec((8, P), lambda g: (0, 0)),
+            _spec((1, R, N), lambda g: (g // tpg, 0, 0)),
+            _spec((1, 8, P), lambda g: (g // tpg, 0, 0)),
         ],
         out_specs=[
             _spec((tile, P), lambda g: (g, 0)),
             _spec((tile, 8), lambda g: (g, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((Cp, P), jnp.float32),
-            jax.ShapeDtypeStruct((Cp, 8), jnp.float32),
+            jax.ShapeDtypeStruct((G * Cp, P), jnp.float32),
+            jax.ShapeDtypeStruct((G * Cp, 8), jnp.float32),
         ],
         interpret=interpret,
         **kwargs,
     )(xp_, azp, y2p, dxp, lup, rows, specs)
-    return xo[:C, :p], ao[:C, 0] / S
+    xo = xo.reshape(G, Cp, P)[:, :C, :p]
+    ao = ao.reshape(G, Cp, 8)[:, :C, 0] / S
+    return xo, ao
 
 
 # ---------------------------------------------------------------------------
@@ -362,34 +402,86 @@ def _pallas_white_mode():
     return mode_from_env("GST_PALLAS_WHITE")
 
 
-def make_white_block(consts: WhiteConsts):
-    """Build the dispatched white-MH block for one frozen model.
+def consts_batch_vmap(block, n_data: int):
+    """``custom_vmap`` rule for fused-MH dispatchers whose trailing
+    operands are per-MODEL constants (``args[n_data:]``).
 
-    Returns ``block(x, az, yred2, dx, logu) -> (x_new, acc_rate)`` wrapped
-    in ``jax.custom_batching.custom_vmap``: a chain-vmapped call collapses
-    every mapped axis onto the kernel's chain-tile dimension (the same
-    integration pattern as ops/linalg.py's ``_factor_fused``); unbatched
-    or non-TPU calls run the identical-math XLA loop.
+    Two batching levels arise in practice (backends/jax_backend.py
+    ``_sweep`` under the ensemble's vmaps): the CHAIN axis maps only the
+    per-chain data operands — the constants stay unbatched so the block
+    keeps one shared copy — and the PULSAR axis maps constants and data
+    alike, giving the constants a leading group axis the grouped kernel
+    (and the align_consts XLA path) consume directly."""
+    import jax.numpy as jnp
+
+    def rule(axis_size, in_batched, *args):
+        const_batched = any(in_batched[n_data:])
+
+        def bcast(arr, bt):
+            return arr if bt else jnp.broadcast_to(
+                arr, (axis_size,) + arr.shape)
+
+        if not const_batched:
+            # chain-level: broadcast unbatched data, constants untouched
+            out = [bcast(a, b) for a, b in zip(args[:n_data],
+                                               in_batched[:n_data])]
+            return block(*out, *args[n_data:]), (True, True)
+        # group-level: every operand gains the mapped axis
+        out = [bcast(a, b) for a, b in zip(args, in_batched)]
+        return block(*out), (True, True)
+
+    return rule
+
+
+def make_white_block(var: Tuple[Tuple[int, int, int], ...]):
+    """Build the dispatched white-MH block for one model STRUCTURE.
+
+    Only the static structure (``WhiteConsts.var``: which parameters
+    vary and how) is closed over; the constant arrays travel as call
+    operands, so ensembles can pass traced per-pulsar ``rows``/``specs``
+    (stacked along a leading group axis) through ``vmap``/``shard_map``.
+
+    Returns ``block(x, az, yred2, dx, logu, rows, specs) ->
+    (x_new, acc_rate)`` wrapped in ``jax.custom_batching.custom_vmap``:
+    a chain-vmapped call collapses every mapped axis onto the kernel's
+    chain-tile dimension (the same integration pattern as
+    ops/linalg.py's ``_factor_fused``), a pulsar-vmapped call routes the
+    per-group constants to the grouped kernel; unbatched or non-TPU
+    calls run the identical-math XLA loop.
     """
 
     @custom_vmap
-    def block(x, az, yred2, dx, logu):
+    def block(x, az, yred2, dx, logu, rows, specs):
         enabled, interp, forced = _pallas_white_mode()
-        batch = x.shape[:-1]
-        B = int(np.prod(batch)) if batch else 1
-        ok = (_HAVE_PLTPU and x.dtype == jnp.float32
-              and az.shape[-1] <= MAX_PALLAS_N
-              and (forced or B >= _MIN_BATCH) and x.ndim >= 2)
-        if enabled and ok:
-            p = x.shape[-1]
-            n = az.shape[-1]
-            S = dx.shape[-2]
-            xf, acc = white_mh_fused(
-                x.reshape(B, p), az.reshape(B, n), yred2.reshape(B, n),
-                dx.reshape(B, S, p), logu.reshape(B, S),
-                consts, interpret=interp)
-            return xf.reshape(batch + (p,)), acc.reshape(batch)
-        return white_mh_loop_xla(x, az, yred2, dx, logu, consts)
+        grouped = rows.ndim == 3
+        if grouped:
+            batch = x.shape[:-1]
+            B = int(np.prod(batch)) if batch else 1
+            ok = (_HAVE_PLTPU and x.dtype == jnp.float32
+                  and az.shape[-1] <= MAX_PALLAS_N
+                  and (forced or B >= _MIN_BATCH)
+                  and x.ndim == 3 and rows.shape[0] == x.shape[0])
+            if enabled and ok:
+                return white_mh_fused(x, az, yred2, dx, logu, rows,
+                                      specs, var, interpret=interp)
+        elif rows.ndim == 2:
+            batch = x.shape[:-1]
+            B = int(np.prod(batch)) if batch else 1
+            ok = (_HAVE_PLTPU and x.dtype == jnp.float32
+                  and az.shape[-1] <= MAX_PALLAS_N
+                  and (forced or B >= _MIN_BATCH) and x.ndim >= 2)
+            if enabled and ok:
+                p = x.shape[-1]
+                n = az.shape[-1]
+                S = dx.shape[-2]
+                xf, acc = white_mh_fused(
+                    x.reshape(1, B, p), az.reshape(1, B, n),
+                    yred2.reshape(1, B, n), dx.reshape(1, B, S, p),
+                    logu.reshape(1, B, S), rows[None], specs[None],
+                    var, interpret=interp)
+                return xf.reshape(batch + (p,)), acc.reshape(batch)
+        return white_mh_loop_xla(x, az, yred2, dx, logu, rows, specs,
+                                 var)
 
-    block.def_vmap(fold_batch_vmap(block))
+    block.def_vmap(consts_batch_vmap(block, n_data=5))
     return block

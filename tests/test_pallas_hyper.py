@@ -96,6 +96,22 @@ def test_affine_decomposition_schur_subset():
     np.testing.assert_allclose(ld, ld_ref, rtol=1e-6, atol=1e-6)
 
 
+def _loop2(*args, consts, jitter):
+    """Single-model convenience wrapper over the consts-as-operands
+    signature."""
+    return hyper_mh_loop_xla(*args, consts.K, consts.phi_sel,
+                             consts.specs, consts.hyp_idx, jitter)
+
+
+def _fused2(*args, consts, jitter, **kw):
+    """Single-model (G == 1) wrapper over the grouped fused kernel."""
+    xf, acc = hyper_mh_fused(
+        *(a[None] for a in args), jnp.asarray(consts.K)[None],
+        jnp.asarray(consts.phi_sel)[None],
+        jnp.asarray(consts.specs)[None], consts.hyp_idx, jitter, **kw)
+    return xf[0], acc[0]
+
+
 def _block_inputs(ma, cols, C, S=5, seed=4):
     rng = np.random.default_rng(seed)
     p = ma.nparam
@@ -126,10 +142,10 @@ def test_kernel_matches_xla_loop(make_ma):
     cols = np.arange(ma.m)
     consts = build_hyper_consts(ma, cols)
     args = _block_inputs(ma, cols, C=9)
-    x1, a1 = jax.jit(lambda *a: hyper_mh_fused(
+    x1, a1 = jax.jit(lambda *a: _fused2(
         *a, consts=consts, jitter=1e-6, chain_tile=8,
         interpret=True))(*args)
-    x0, a0 = jax.jit(lambda *a: hyper_mh_loop_xla(
+    x0, a0 = jax.jit(lambda *a: _loop2(
         *a, consts=consts, jitter=1e-6))(*args)
     np.testing.assert_allclose(np.asarray(x1), np.asarray(x0),
                                rtol=1e-4, atol=1e-5)
@@ -147,11 +163,11 @@ def test_non_pd_proposals_reject():
         np.eye(len(cols), dtype=np.float32), S0.shape))
     dS0 = -jnp.ones_like(dS0) * 5.0  # negative diagonal: rsqrt -> NaN
     logu = jnp.full_like(logu, -1e30)
-    for fn in (lambda: hyper_mh_loop_xla(x, S0, dS0, rt, base, dx, logu,
-                                         consts, 1e-6),
-               lambda: hyper_mh_fused(x, S0, dS0, rt, base, dx, logu,
-                                      consts, 1e-6, chain_tile=8,
-                                      interpret=True)):
+    for fn in (lambda: _loop2(x, S0, dS0, rt, base, dx, logu,
+                              consts=consts, jitter=1e-6),
+               lambda: _fused2(x, S0, dS0, rt, base, dx, logu,
+                               consts=consts, jitter=1e-6, chain_tile=8,
+                               interpret=True)):
         x1, acc = fn()
         np.testing.assert_array_equal(np.asarray(x1), np.asarray(x))
         assert float(jnp.max(acc)) == 0.0
@@ -161,15 +177,44 @@ def test_dispatch_under_vmap(monkeypatch):
     ma = make_demo_model_arrays(n=30, components=4, seed=6)
     cols = np.arange(ma.m)
     consts = build_hyper_consts(ma, cols)
-    block = make_hyper_block(consts, jitter=1e-6)
+    block = make_hyper_block(consts.hyp_idx, jitter=1e-6)
     args = _block_inputs(ma, cols, C=8, seed=11)
+    carr = (jnp.asarray(consts.K), jnp.asarray(consts.phi_sel),
+            jnp.asarray(consts.specs))
+    axes = (0,) * 7 + (None,) * 3
     monkeypatch.setenv("GST_PALLAS_HYPER", "interpret")
-    x1, a1 = jax.vmap(block)(*args)
+    x1, a1 = jax.vmap(block, in_axes=axes)(*args, *carr)
     monkeypatch.setenv("GST_PALLAS_HYPER", "0")
-    x0, a0 = jax.vmap(block)(*args)
+    x0, a0 = jax.vmap(block, in_axes=axes)(*args, *carr)
     np.testing.assert_allclose(np.asarray(x1), np.asarray(x0),
                                rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(np.asarray(a1), np.asarray(a0))
+
+
+def test_grouped_kernel_matches_per_group_loop():
+    """The grouped (per-pulsar constants) hyper kernel must reproduce
+    the per-group XLA loop: G models with different phi constants, one
+    launch with per-lane constant planes."""
+    G, C = 3, 5
+    mas = [make_demo_model_arrays(n=30, components=4, seed=40 + g)
+           for g in range(G)]
+    cols = np.arange(mas[0].m)
+    hcs = [build_hyper_consts(ma, cols) for ma in mas]
+    assert all(hc.hyp_idx == hcs[0].hyp_idx for hc in hcs)
+    per = [_block_inputs(ma, cols, C=C, seed=50 + g)
+           for g, ma in enumerate(mas)]
+    grouped = tuple(jnp.stack([p[i] for p in per]) for i in range(7))
+    K = jnp.asarray(np.stack([hc.K for hc in hcs]))
+    sel = jnp.asarray(np.stack([hc.phi_sel for hc in hcs]))
+    specs = jnp.asarray(np.stack([hc.specs for hc in hcs]))
+
+    xf, af = hyper_mh_fused(*grouped, K, sel, specs, hcs[0].hyp_idx,
+                            1e-6, chain_tile=8, interpret=True)
+    for g in range(G):
+        x0, a0 = _loop2(*per[g], consts=hcs[g], jitter=1e-6)
+        np.testing.assert_allclose(np.asarray(xf[g]), np.asarray(x0),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(af[g]), np.asarray(a0))
 
 
 def test_auto_mode_stays_off_on_cpu(monkeypatch):

@@ -44,6 +44,22 @@ def _varying_efac_ma(n=24, seed=0):
     )
 
 
+def _loop2(x, az, yred2, dx, logu, wc):
+    """Single-model convenience wrapper over the consts-as-operands
+    signature."""
+    return white_mh_loop_xla(x, az, yred2, dx, logu, wc.rows, wc.specs,
+                             wc.var)
+
+
+def _fused2(x, az, yred2, dx, logu, wc, **kw):
+    """Single-model (G == 1) wrapper over the grouped fused kernel."""
+    xf, acc = white_mh_fused(
+        x[None], az[None], yred2[None], dx[None], logu[None],
+        jnp.asarray(wc.rows)[None], jnp.asarray(wc.specs)[None],
+        wc.var, **kw)
+    return xf[0], acc[0]
+
+
 def _rand_inputs(ma, C, S=7, seed=1):
     rng = np.random.default_rng(seed)
     p = ma.nparam
@@ -94,9 +110,9 @@ def test_kernel_matches_xla_loop(varying_efac):
         n=24, components=4, seed=0)
     wc = build_white_consts(ma)
     args = _rand_inputs(ma, C=11, seed=4)
-    x1, a1 = jax.jit(lambda *a: white_mh_fused(
-        *a, consts=wc, chain_tile=8, interpret=True))(*args)
-    x0, a0 = jax.jit(lambda *a: white_mh_loop_xla(*a, consts=wc))(*args)
+    x1, a1 = jax.jit(lambda *a: _fused2(
+        *a, wc=wc, chain_tile=8, interpret=True))(*args)
+    x0, a0 = jax.jit(lambda *a: _loop2(*a, wc=wc))(*args)
     np.testing.assert_allclose(np.asarray(x1), np.asarray(x0),
                                rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(np.asarray(a1), np.asarray(a0))
@@ -110,11 +126,11 @@ def test_out_of_bounds_proposal_always_rejected():
     big = np.zeros(np.asarray(dx).shape, np.float32)
     big[:, :, ma.white_indices[0]] = 1e4
     logu = jnp.full_like(logu, -1e30)  # accept anything with finite delta
-    x1, acc = white_mh_loop_xla(x, az, yred2, jnp.asarray(big), logu, wc)
+    x1, acc = _loop2(x, az, yred2, jnp.asarray(big), logu, wc)
     np.testing.assert_array_equal(np.asarray(x1), np.asarray(x))
     assert float(jnp.max(acc)) == 0.0
-    x2, acc2 = white_mh_fused(x, az, yred2, jnp.asarray(big), logu, wc,
-                              chain_tile=8, interpret=True)
+    x2, acc2 = _fused2(x, az, yred2, jnp.asarray(big), logu, wc,
+                       chain_tile=8, interpret=True)
     np.testing.assert_array_equal(np.asarray(x2), np.asarray(x))
     assert float(jnp.max(acc2)) == 0.0
 
@@ -146,12 +162,12 @@ def test_padded_rows_contribute_nothing():
     y2_p = jnp.concatenate(
         [yred2, jnp.zeros((yred2.shape[0], pad), yred2.dtype)], axis=1)
 
-    x0, a0 = white_mh_loop_xla(x, az, yred2, dx, logu, wc)
-    x1, a1 = white_mh_loop_xla(x, az_p, y2_p, dx, logu, wc_p)
+    x0, a0 = _loop2(x, az, yred2, dx, logu, wc)
+    x1, a1 = _loop2(x, az_p, y2_p, dx, logu, wc_p)
     np.testing.assert_allclose(np.asarray(x1), np.asarray(x0), rtol=1e-6)
     np.testing.assert_array_equal(np.asarray(a1), np.asarray(a0))
-    x2, a2 = white_mh_fused(x, az_p, y2_p, dx, logu, wc_p,
-                            chain_tile=8, interpret=True)
+    x2, a2 = _fused2(x, az_p, y2_p, dx, logu, wc_p,
+                     chain_tile=8, interpret=True)
     np.testing.assert_allclose(np.asarray(x2), np.asarray(x0),
                                rtol=1e-5, atol=1e-6)
     np.testing.assert_array_equal(np.asarray(a2), np.asarray(a0))
@@ -164,7 +180,7 @@ def test_loop_matches_closure_semantics():
     ma = _varying_efac_ma(n=18, seed=8)
     wc = build_white_consts(ma)
     x, az, yred2, dx, logu = _rand_inputs(ma, C=3, S=9, seed=9)
-    x1, a1 = white_mh_loop_xla(x, az, yred2, dx, logu, wc)
+    x1, a1 = _loop2(x, az, yred2, dx, logu, wc)
 
     specs = jnp.asarray(ma.prior_specs, jnp.float32)
     for c in range(3):
@@ -195,16 +211,61 @@ def test_loop_matches_closure_semantics():
 def test_dispatch_under_vmap(monkeypatch):
     ma = make_demo_model_arrays(n=24, components=4, seed=0)
     wc = build_white_consts(ma)
-    block = make_white_block(wc)
+    block = make_white_block(wc.var)
     args = _rand_inputs(ma, C=9, seed=10)
+    rows = jnp.asarray(wc.rows)
+    specs = jnp.asarray(wc.specs)
 
+    # constants unbatched under the chain vmap (the backend's pattern)
     monkeypatch.setenv("GST_PALLAS_WHITE", "interpret")
-    x1, a1 = jax.vmap(block)(*args)
+    x1, a1 = jax.vmap(block, in_axes=(0, 0, 0, 0, 0, None, None))(
+        *args, rows, specs)
     monkeypatch.setenv("GST_PALLAS_WHITE", "0")
-    x0, a0 = jax.vmap(block)(*args)
+    x0, a0 = jax.vmap(block, in_axes=(0, 0, 0, 0, 0, None, None))(
+        *args, rows, specs)
     np.testing.assert_allclose(np.asarray(x1), np.asarray(x0),
                                rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(np.asarray(a1), np.asarray(a0))
+
+
+def test_grouped_kernel_matches_per_group_loop(monkeypatch):
+    """The grouped (per-pulsar constants) kernel path must reproduce the
+    per-group XLA loop: G models with different variance structure, one
+    launch."""
+    G, C = 3, 6
+    mas = [make_demo_model_arrays(n=24, components=4, seed=20 + g)
+           for g in range(G)]
+    wcs = [build_white_consts(ma) for ma in mas]
+    assert all(wc.var == wcs[0].var for wc in wcs)
+    per = [_rand_inputs(ma, C=C, seed=30 + g) for g, ma in enumerate(mas)]
+    gx, gaz, gy2, gdx, glu = (jnp.stack([p[i] for p in per])
+                              for i in range(5))
+    rows = jnp.asarray(np.stack([wc.rows for wc in wcs]))
+    specs = jnp.asarray(np.stack([wc.specs for wc in wcs]))
+
+    xf, af = white_mh_fused(gx, gaz, gy2, gdx, glu, rows, specs,
+                            wcs[0].var, chain_tile=8, interpret=True)
+    for g in range(G):
+        x0, a0 = _loop2(*per[g], wc=wcs[g])
+        np.testing.assert_allclose(np.asarray(xf[g]), np.asarray(x0),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(af[g]), np.asarray(a0))
+
+    # the same route through the dispatcher's two-level vmap (chain axis
+    # leaves constants unbatched; group axis batches them)
+    block = make_white_block(wcs[0].var)
+    monkeypatch.setenv("GST_PALLAS_WHITE", "interpret")
+    xv, av = jax.vmap(jax.vmap(block, in_axes=(0, 0, 0, 0, 0, None,
+                                               None)))(
+        gx, gaz, gy2, gdx, glu, rows, specs)
+    np.testing.assert_allclose(np.asarray(xv), np.asarray(xf),
+                               rtol=1e-5, atol=1e-6)
+    monkeypatch.setenv("GST_PALLAS_WHITE", "0")
+    x2, a2 = jax.vmap(jax.vmap(block, in_axes=(0, 0, 0, 0, 0, None,
+                                               None)))(
+        gx, gaz, gy2, gdx, glu, rows, specs)
+    np.testing.assert_allclose(np.asarray(x2), np.asarray(xf),
+                               rtol=1e-5, atol=1e-6)
 
 
 def test_auto_mode_stays_on_loop_on_cpu(monkeypatch):

@@ -243,6 +243,34 @@ def test_batched_autocorr_matches_per_column():
     np.testing.assert_allclose(got, expect, rtol=1e-12)
 
 
+def test_ensemble_fused_kernels_match_closure(monkeypatch):
+    """Ensembles reach the fused MH kernels through traced per-pulsar
+    constants (FusedConsts): kernel-on (interpret) and kernel-off runs
+    must agree chain-for-chain, and the constants must actually be
+    built."""
+    mas = _ensemble_mas(3, n=40, components=6)
+    cfg = GibbsConfig(model="mixture", vary_df=True, theta_prior="beta")
+
+    def run(flag):
+        monkeypatch.setenv("GST_PALLAS_WHITE", flag)
+        monkeypatch.setenv("GST_PALLAS_HYPER", flag)
+        ens = EnsembleGibbs(mas, cfg, nchains=4, chunk_size=5,
+                            record="full")
+        if flag == "interpret":
+            assert ens._fused_consts is not None
+            assert ens._fused_consts.white_rows.shape[0] == 3
+            assert ens._fused_consts.hyper_K is not None
+        return ens.sample(niter=10, seed=0)
+
+    r0 = run("0")
+    r1 = run("interpret")
+    np.testing.assert_allclose(np.asarray(r1.chain),
+                               np.asarray(r0.chain),
+                               rtol=2e-3, atol=2e-3)
+    np.testing.assert_array_equal(np.asarray(r1.zchain),
+                                  np.asarray(r0.zchain))
+
+
 def test_graft_entry_dryrun():
     """The driver-facing entry points compile and run on the fake mesh."""
     import __graft_entry__ as ge

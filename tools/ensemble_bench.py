@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""BASELINE config-5 ensemble benchmark with a vs-oracle ratio.
+
+Round 3's only ensemble-on-chip artifact recorded output paths but no
+comparison number (VERDICT r3 weak #4: "config 5's evidence is the
+thinnest of the five BASELINE configs"). This tool produces the missing
+evidence in one self-budgeting process:
+
+1. **vs-oracle ratio** — the ensemble's pulsar-chain-sweeps/s against
+   the single-chain NumPy oracle on the same per-pulsar shape (the same
+   normalization as bench.py's official ``vs_baseline``).
+2. **kernel-parity ratio** — the ensemble's per-chain throughput
+   against the single-model JaxGibbs backend at the SAME total chain
+   count (pulsars*nchains chains of the same shape), i.e. how close the
+   traced-consts fused path (backends FusedConsts) gets to the
+   baked-consts flagship kernel. VERDICT r3 next-round #3's target:
+   within ~1.3x.
+3. **per-pulsar observability** — acceptance rates, ESS(log10_A), and
+   outlier-fraction summaries per pulsar, not just output paths.
+
+Writes ONE JSON artifact (--out). Relay discipline: single process,
+one JAX client, budgets itself, exits cleanly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="artifacts/ENSEMBLE_BENCH_r04.json")
+    ap.add_argument("--pulsars", type=int, default=4)
+    ap.add_argument("--nchains", type=int, default=256)
+    ap.add_argument("--ntoa", type=int, default=130)
+    ap.add_argument("--components", type=int, default=30)
+    ap.add_argument("--niter", type=int, default=200,
+                    help="timed sweeps (multiple of --chunk or the "
+                         "final partial chunk cold-compiles in the "
+                         "timed window)")
+    ap.add_argument("--chunk", type=int, default=100)
+    ap.add_argument("--baseline-sweeps", type=int, default=150)
+    ap.add_argument("--model", default="beta")
+    ap.add_argument("--seed", type=int, default=11)
+    ap.add_argument("--skip-single", action="store_true",
+                    help="skip the single-model parity arm")
+    args = ap.parse_args()
+    if args.niter % args.chunk:
+        ap.error(f"--niter ({args.niter}) must be a multiple of "
+                 f"--chunk ({args.chunk})")
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.dirname(here))
+
+    import numpy as np
+
+    import jax
+
+    out: dict = {"config": vars(args)}
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+
+    def flush():
+        with open(args.out, "w") as fh:
+            json.dump(out, fh, indent=1)
+
+    t0 = time.perf_counter()
+    out["device"] = str(jax.devices())
+    out["backend"] = jax.default_backend()
+    print(f"[liveness] {out['device']} ({time.perf_counter() - t0:.1f}s)",
+          flush=True)
+    flush()
+
+    from gibbs_student_t_tpu.backends import JaxGibbs, NumpyGibbs
+    from gibbs_student_t_tpu.data.demo import make_demo_model_arrays
+    from gibbs_student_t_tpu.parallel import EnsembleGibbs
+    from gibbs_student_t_tpu.parallel.diagnostics import (
+        effective_sample_size,
+    )
+    from run_sims import model_configs
+
+    cfg = model_configs()[args.model]
+    mas = [make_demo_model_arrays(n=args.ntoa,
+                                  components=args.components,
+                                  seed=100 + i)
+           for i in range(args.pulsars)]
+
+    # --- oracle baseline on pulsar 0 (same normalization as bench.py)
+    t0 = time.perf_counter()
+    rng = np.random.default_rng(args.seed)
+    NumpyGibbs(mas[0], cfg).sample(mas[0].x_init(rng),
+                                   args.baseline_sweeps, seed=args.seed)
+    or_dt = time.perf_counter() - t0
+    out["oracle_sweeps_per_sec"] = round(args.baseline_sweeps / or_dt, 2)
+    print(f"[oracle] {out['oracle_sweeps_per_sec']} sweeps/s", flush=True)
+    flush()
+
+    # --- ensemble: warmup chunk compiles, then the timed steady state
+    ens = EnsembleGibbs(mas, cfg, nchains=args.nchains,
+                        chunk_size=args.chunk)
+    out["fused_consts_built"] = ens._fused_consts is not None
+    t0 = time.perf_counter()
+    ens.sample(niter=args.chunk, seed=args.seed)
+    out["warmup_seconds"] = round(time.perf_counter() - t0, 1)
+    t0 = time.perf_counter()
+    res = ens.sample(niter=args.niter, seed=args.seed,
+                     state=ens.last_state, start_sweep=args.chunk)
+    dt = time.perf_counter() - t0
+    pcs = args.niter * args.pulsars * args.nchains / dt
+    out["ensemble_pulsar_chain_sweeps_per_sec"] = round(pcs, 1)
+    out["vs_oracle"] = round(pcs / out["oracle_sweeps_per_sec"], 2)
+    print(f"[ensemble] {pcs:.0f} pulsar-chain-sweeps/s "
+          f"({out['vs_oracle']}x oracle)", flush=True)
+
+    # per-pulsar observability (VERDICT r3 weak #4)
+    burn = max(args.niter // 4, 1)
+    per = []
+    for pi in range(args.pulsars):
+        ch = np.asarray(res.chain[burn:, pi], np.float64)  # (rows, C, p)
+        logA_col = [i for i, nm in enumerate(mas[0].param_names)
+                    if "log10_A" in nm]
+        ess = (float(effective_sample_size(ch[..., logA_col[0]]))
+               if logA_col else None)
+        per.append({
+            "acc_white": round(float(np.asarray(
+                res.stats["acc_white"])[:, pi].mean()), 3),
+            "acc_hyper": round(float(np.asarray(
+                res.stats["acc_hyper"])[:, pi].mean()), 3),
+            "ess_log10A": None if ess is None else round(ess, 1),
+            "z_frac": round(float(np.asarray(
+                res.zchain[burn:, pi], np.float64).mean()), 4),
+        })
+    out["per_pulsar"] = per
+    if per[0]["ess_log10A"] is not None:
+        out["ess_log10A_per_sec"] = round(
+            sum(p["ess_log10A"] for p in per)
+            / (dt * (args.niter - burn) / args.niter), 1)
+    flush()
+
+    # --- single-model parity arm: same per-pulsar shape, same TOTAL
+    # chain count, the baked-consts flagship kernel
+    if not args.skip_single:
+        total = args.pulsars * args.nchains
+        gb = JaxGibbs(mas[0], cfg, nchains=total, chunk_size=args.chunk)
+        gb.sample(niter=args.chunk, seed=args.seed)  # compile warmup
+        t0 = time.perf_counter()
+        gb.sample(niter=args.niter, seed=args.seed, state=gb.last_state,
+                  start_sweep=args.chunk)
+        sdt = time.perf_counter() - t0
+        scs = args.niter * total / sdt
+        out["single_model_chain_sweeps_per_sec"] = round(scs, 1)
+        # >1 means the ensemble path is slower per chain-sweep than the
+        # flagship kernel at the same shapes; target <= ~1.3
+        out["single_over_ensemble"] = round(scs / pcs, 3)
+        print(f"[single] {scs:.0f} chain-sweeps/s -> "
+              f"single/ensemble = {out['single_over_ensemble']}",
+              flush=True)
+    flush()
+    print(f"[done] -> {args.out}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,16 +3,24 @@
 run on real TPU hardware with the production kernels active.
 
 The CPU test suite's posterior gates (tests/test_jax_backend.py,
-tests/test_j1713.py) exercise the expander paths — conftest forces the
-cpu platform, so the Pallas lane-batched Cholesky and fused TNT kernels
-never face a statistical test there. This script runs the same
-oracle-vs-kernel comparison on the device: the J1713+0747 workload
-(BASELINE configs 1/3), 1024 chains through the default TPU kernel
-stack, against the single-chain NumPy oracle on the host, gated on
-posterior-mean gaps (< 0.33 posterior sd) and gross-error KS
-(p > 0.001) per hyperparameter — the same calibrated thresholds as the
-test-suite gates (KS on thinned MCMC draws is a gross-error detector
-only; see tests/test_jax_backend.py::_posterior_gate).
+tests/test_j1713.py, tools/j1713_gate.py) exercise the expander and
+interpret-mode paths — conftest forces the cpu platform, so the Pallas
+lane-batched Cholesky and fused MH kernels never face a statistical
+test there. This script runs the same oracle-vs-kernel comparison on
+the device: the J1713+0747 workload (BASELINE configs 1/3) through the
+default TPU kernel stack against the single-chain NumPy oracle on the
+host, gated on posterior-mean gaps (< 0.33 posterior sd) and
+gross-error KS (p > 0.001) per quantity — the same calibrated
+thresholds as the CPU gates.
+
+``--models`` takes any subset of ``run_sims.model_configs()`` keys
+(default: the flagship mixture/beta at 1024 chains). Per-model gated
+quantities mirror tools/j1713_gate.py: parameter columns everywhere;
+theta/pout_mean/z_frac for the outlier models (vvh17 gated in the
+dominant mode via z_init='zeros' — see GibbsConfig.z_init for the
+metastability analysis); df where it varies; an alpha summary where the
+inverse-gamma draw can fire. The artifact is flushed after every model
+so a relay outage mid-run still leaves completed models on disk.
 
 Single process, budgets itself, exits cleanly (relay discipline — see
 docs/PERFORMANCE.md operational notes).
@@ -21,6 +29,7 @@ docs/PERFORMANCE.md operational notes).
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import sys
@@ -29,7 +38,9 @@ import time
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--out", default="artifacts/tpu_gate_r03.json")
+    ap.add_argument("--out", default="artifacts/tpu_gate_r04.json")
+    ap.add_argument("--models", nargs="+", default=["beta"],
+                    help="run_sims.model_configs() keys to gate")
     ap.add_argument("--niter-np", type=int, default=10000)
     ap.add_argument("--burn-np", type=int, default=1000)
     ap.add_argument("--thin-np", type=int, default=20)
@@ -38,7 +49,13 @@ def main():
     ap.add_argument("--burn-j", type=int, default=150)
     ap.add_argument("--thin-j", type=int, default=20)
     ap.add_argument("--seed", type=int, default=123)
+    ap.add_argument("--adapt-cov", type=int, default=0, metavar="N",
+                    help="run the JAX kernel with population-covariance "
+                         "adaptive proposals for the first N sweeps "
+                         "(set burn-j >= N)")
     args = ap.parse_args()
+    if args.adapt_cov and args.burn_j < args.adapt_cov:
+        ap.error("--burn-j must discard the adapting sweeps")
 
     here = os.path.dirname(os.path.abspath(__file__))
     sys.path.insert(0, os.path.dirname(here))
@@ -48,7 +65,7 @@ def main():
 
     import jax
 
-    out: dict = {"params": {}}
+    out: dict = {"config": vars(args), "models": {}}
     os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
 
     def flush():
@@ -65,73 +82,109 @@ def main():
 
     import bench as bench_mod
     from gibbs_student_t_tpu.backends import JaxGibbs, NumpyGibbs
-    from gibbs_student_t_tpu.config import GibbsConfig
+    from run_sims import model_configs
 
     ma = bench_mod.build(130, 30)
-    cfg = GibbsConfig(model="mixture", vary_df=True, theta_prior="beta")
-
-    t0 = time.perf_counter()
-    rng = np.random.default_rng(args.seed)
-    gb_n = NumpyGibbs(ma, cfg)
-    res_n = gb_n.sample(ma.x_init(rng), args.niter_np, seed=args.seed)
-    out["oracle_seconds"] = round(time.perf_counter() - t0, 1)
-    print(f"[oracle] {args.niter_np} sweeps in {out['oracle_seconds']}s",
-          flush=True)
-    flush()
-
-    t0 = time.perf_counter()
-    gb_j = JaxGibbs(ma, cfg, nchains=args.nchains, chunk_size=100)
-    res_j = gb_j.sample(niter=args.niter_j, seed=args.seed + 1)
-    out["kernel_seconds"] = round(time.perf_counter() - t0, 1)
-    out["kernel_config"] = {
-        "nchains": args.nchains, "niter": args.niter_j,
-        "pallas_chol": os.environ.get("GST_PALLAS_CHOL", "auto"),
-        "use_pallas_tnt": gb_j._use_pallas,
-        "hyper_schur": gb_j._schur is not None,
-    }
-    print(f"[kernel] {args.niter_j} sweeps x {args.nchains} chains in "
-          f"{out['kernel_seconds']}s", flush=True)
-
+    configs = model_configs()
+    unknown = [m for m in args.models if m not in configs]
+    if unknown:
+        ap.error(f"unknown models {unknown}; have {sorted(configs)}")
     sub = np.random.default_rng(0)
-    failures = []
 
-    def gate(name, a, b):
-        """Mean-gap (< 0.33 sd) + gross-error KS (p > 0.001) on thinned
-        draws — one rule for hyperparams AND the latent theta/df chains
-        (VERDICT r2 weak #6: theta/df deserve first-class gating)."""
-        a = np.asarray(a, dtype=np.float64)
-        b = np.asarray(b, dtype=np.float64)
-        if b.size > 4000:  # keep the two-sample KS comparably sized
-            b = sub.choice(b, 4000, replace=False)
-        sd = max(a.std(), b.std(), 1e-12)
-        gap = float(abs(a.mean() - b.mean()) / sd)
-        ks = stats.ks_2samp(a, b)
-        ok = bool(gap <= 0.33 and ks.pvalue >= 0.001)
-        out["params"][name] = {
-            "oracle_mean": round(float(a.mean()), 4),
-            "kernel_mean": round(float(b.mean()), 4),
-            "gap_sd": round(gap, 3), "ks_p": float(ks.pvalue), "ok": ok,
+    def thin_np(arr):
+        return np.asarray(arr[args.burn_np::args.thin_np], np.float64)
+
+    def thin_j(arr):
+        return np.asarray(arr[args.burn_j::args.thin_j], np.float64)
+
+    def gate_model(key, cfg):
+        if cfg.model == "vvh17":
+            # dominant-mode start for both sides (GibbsConfig.z_init)
+            cfg = dataclasses.replace(cfg, z_init="zeros")
+        rows: dict = {}
+        failures = []
+        blk: dict = {"params": rows, "gibbs_config": {
+            "model": cfg.model, "vary_df": cfg.vary_df,
+            "theta_prior": cfg.theta_prior, "vary_alpha": cfg.vary_alpha,
+            "z_init": cfg.z_init}}
+        out["models"][key] = blk
+
+        t0 = time.perf_counter()
+        rng = np.random.default_rng(args.seed)
+        res_n = NumpyGibbs(ma, cfg).sample(ma.x_init(rng), args.niter_np,
+                                           seed=args.seed)
+        blk["oracle_seconds"] = round(time.perf_counter() - t0, 1)
+        print(f"[{key}][oracle] {args.niter_np} sweeps in "
+              f"{blk['oracle_seconds']}s", flush=True)
+        flush()
+
+        t0 = time.perf_counter()
+        cfg_j = (cfg.with_adapt(args.adapt_cov, adapt_cov=True)
+                 if args.adapt_cov else cfg)
+        gb_j = JaxGibbs(ma, cfg_j, nchains=args.nchains, chunk_size=100,
+                        record="compact")  # float16 pout on the wire
+        res_j = gb_j.sample(niter=args.niter_j, seed=args.seed + 1)
+        blk["kernel_seconds"] = round(time.perf_counter() - t0, 1)
+        blk["kernel_config"] = {
+            "nchains": args.nchains, "niter": args.niter_j,
+            "pallas_chol": os.environ.get("GST_PALLAS_CHOL", "auto"),
+            "pallas_white": os.environ.get("GST_PALLAS_WHITE", "auto"),
+            "pallas_hyper": os.environ.get("GST_PALLAS_HYPER", "auto"),
+            "use_pallas_tnt": gb_j._use_pallas,
+            "hyper_schur": gb_j._schur is not None,
         }
-        if not ok:
-            failures.append(name)
-        return gap
+        print(f"[{key}][kernel] {args.niter_j} sweeps x {args.nchains} "
+              f"chains in {blk['kernel_seconds']}s", flush=True)
 
-    for pi, name in enumerate(ma.param_names):
-        gate(name,
-             res_n.chain[args.burn_np:, pi][::args.thin_np],
-             res_j.chain[args.burn_j::args.thin_j, :, pi].ravel())
-    theta_gap = gate("theta",
-                     res_n.thetachain[args.burn_np::args.thin_np],
-                     res_j.thetachain[args.burn_j::args.thin_j].ravel())
-    gate("df",
-         res_n.dfchain[args.burn_np::args.thin_np].ravel(),
-         res_j.dfchain[args.burn_j::args.thin_j].ravel())
-    out["theta_gap_sd"] = round(theta_gap, 3)  # back-compat key
-    out["ok"] = bool(not failures)
-    out["failures"] = failures
+        def gate(name, a, b):
+            a = np.asarray(a, np.float64).ravel()
+            b = np.asarray(b, np.float64).ravel()
+            if b.size > 4000:  # keep the two-sample KS comparably sized
+                b = sub.choice(b, 4000, replace=False)
+            sd = max(a.std(), b.std(), 1e-12)
+            gap = float(abs(a.mean() - b.mean()) / sd)
+            ks = stats.ks_2samp(a, b)
+            ok = bool(gap <= 0.33 and ks.pvalue >= 0.001)
+            rows[name] = {
+                "oracle_mean": round(float(a.mean()), 4),
+                "kernel_mean": round(float(b.mean()), 4),
+                "gap_sd": round(gap, 3), "ks_p": float(ks.pvalue),
+                "ok": ok,
+            }
+            if not ok:
+                failures.append(name)
+
+        for pi, name in enumerate(ma.param_names):
+            gate(name, thin_np(res_n.chain[:, pi]),
+                 thin_j(res_j.chain)[:, :, pi])
+        if cfg.is_outlier_model:
+            gate("theta", thin_np(res_n.thetachain),
+                 thin_j(res_j.thetachain))
+            gate("pout_mean", thin_np(res_n.poutchain).mean(axis=1),
+                 thin_j(res_j.poutchain).mean(axis=-1))
+            gate("z_frac", thin_np(res_n.zchain).mean(axis=1),
+                 thin_j(res_j.zchain).mean(axis=-1))
+        if cfg.vary_df:
+            gate("df", thin_np(res_n.dfchain.ravel()),
+                 thin_j(res_j.dfchain))
+        if cfg.vary_alpha and cfg.model in ("mixture", "t"):
+            gate("alpha_log10_mean",
+                 np.log10(thin_np(res_n.alphachain)).mean(axis=1),
+                 np.log10(np.maximum(thin_j(res_j.alphachain),
+                                     1e-300)).mean(axis=-1))
+        blk["ok"] = bool(not failures)
+        blk["failures"] = failures
+        flush()
+        print(f"[{key}] ok={blk['ok']} "
+              + " ".join(f"{n}:p={r['ks_p']:.4f}" for n, r in
+                         rows.items()), flush=True)
+        return blk["ok"]
+
+    oks = [gate_model(k, configs[k]) for k in args.models]
+    out["ok"] = bool(all(oks))
     flush()
-    print(json.dumps(out["params"], indent=1), flush=True)
-    print(f"[gate] ok={out['ok']} theta_gap={out['theta_gap_sd']}",
+    print(f"[gate] ok={out['ok']} models="
+          + ",".join(f"{k}:{v['ok']}" for k, v in out["models"].items()),
           flush=True)
     return 0 if out["ok"] else 1
 

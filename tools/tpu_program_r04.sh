@@ -1,0 +1,103 @@
+#!/bin/bash
+# Round-4 hardware program: queued behind tools/relay_watch.py's
+# .relay_alive marker; runs every TPU artifact in priority order the
+# moment the relay recovers. Relay discipline (docs/PERFORMANCE.md):
+# exactly ONE JAX client at a time, each stage a fresh process that
+# budgets itself and exits cleanly; nothing here ever signals a client;
+# no pytest or other CPU-heavy work may run concurrently (1-core host).
+# Launch detached:  setsid nohup bash tools/tpu_program_r04.sh &
+set -u
+cd "$(dirname "$0")/.."
+LOG=artifacts/tpu_program_r04.log
+say() { echo "[$(date -u +%FT%TZ)] $*" >> "$LOG"; }
+
+say "=== TPU program r04 queued (waiting for .relay_alive) ==="
+while [ ! -f .relay_alive ]; do
+  sleep 30
+done
+say "relay recovered: $(cat .relay_alive)"
+
+# Stage 1: THE DRIVER'S EXACT COMMAND (VERDICT r3 next-round #1) —
+# plain `python bench.py`, no flags, so the official record finally
+# shows platform=axon. Run FIRST, before anything can contend or wedge.
+say "stage 1: python bench.py (driver's exact command)"
+python bench.py \
+  > artifacts/BENCH_OFFICIAL_r04.out 2> artifacts/BENCH_OFFICIAL_r04.err
+say "stage 1 rc=$? json=$(tail -1 artifacts/BENCH_OFFICIAL_r04.out)"
+
+# Stage 2: on-chip posterior gate, flagship config, default kernel
+# stack — the gate-after-kernel-change rule (the fused MH kernels were
+# refactored to traced-consts form this round).
+say "stage 2: tpu_gate.py flagship (beta, 1024 chains)"
+python tools/tpu_gate.py --out artifacts/tpu_gate_r04.json \
+  > artifacts/tpu_gate_r04.out 2>&1
+say "stage 2 rc=$?"
+
+# Stage 3: kernel on/off A/B after the refactor (parity + timings in
+# one process, four flag combos).
+say "stage 3: fused_ab.py"
+python tools/fused_ab.py --out artifacts/fused_ab_r04.json \
+  > artifacts/fused_ab_r04.out 2>&1
+say "stage 3 rc=$?"
+
+# Stage 4: the reference's own headline shape (n=12863, its ONLY
+# published measurement, ~19 sweeps/s single-chain) at 256 chains —
+# with on-device thinning and the light record tier, the two arms
+# VERDICT r3 weak #2 asked for (the shape was transport-bound at
+# record-every-sweep; thinning makes it compute-bound).
+say "stage 4a: bench.py notebook shape --record-thin 8"
+python bench.py --dataset demo --ntoa 12863 --components 20 \
+  --nchains 256 --niter 48 --chunk 24 --record-thin 8 \
+  --baseline-sweeps 30 \
+  > artifacts/BENCH_NOTEBOOK_THIN8_r04.out \
+  2> artifacts/BENCH_NOTEBOOK_THIN8_r04.err
+say "stage 4a rc=$? json=$(tail -1 artifacts/BENCH_NOTEBOOK_THIN8_r04.out)"
+
+say "stage 4b: bench.py notebook shape --record light"
+python bench.py --dataset demo --ntoa 12863 --components 20 \
+  --nchains 256 --niter 48 --chunk 24 --record light \
+  --baseline-sweeps 30 \
+  > artifacts/BENCH_NOTEBOOK_LIGHT_r04.out \
+  2> artifacts/BENCH_NOTEBOOK_LIGHT_r04.err
+say "stage 4b rc=$? json=$(tail -1 artifacts/BENCH_NOTEBOOK_LIGHT_r04.out)"
+
+# Stage 5: the queued population-covariance hardware stage
+# (VERDICT r3 next-round #5): ESS/s with the adapted kernel + the
+# distributional gate under adaptation.
+say "stage 5a: bench.py --adapt 100 --adapt-cov"
+python bench.py --adapt 100 --adapt-cov \
+  > artifacts/BENCH_ADAPTCOV_r04.out 2> artifacts/BENCH_ADAPTCOV_r04.err
+say "stage 5a rc=$? json=$(tail -1 artifacts/BENCH_ADAPTCOV_r04.out)"
+
+say "stage 5b: tpu_gate.py --adapt-cov 150"
+python tools/tpu_gate.py --adapt-cov 150 \
+  --out artifacts/tpu_gate_adaptcov_r04.json \
+  > artifacts/tpu_gate_adaptcov_r04.out 2>&1
+say "stage 5b rc=$?"
+
+# Stage 6: config-5 ensemble with the vs-oracle ratio and the
+# single-model kernel-parity arm (VERDICT r3 next-round #3 "done"
+# criterion) — the fused ensemble path's first hardware number.
+say "stage 6: ensemble_bench.py (4 pulsars x 256 chains)"
+python tools/ensemble_bench.py --pulsars 4 --nchains 256 \
+  --out artifacts/ENSEMBLE_BENCH_r04.json \
+  > artifacts/ENSEMBLE_BENCH_r04.out 2>&1
+say "stage 6 rc=$?"
+
+# Stage 7: on-chip gates for the remaining four model configs
+# (VERDICT r3 next-round #2's on-chip half). Smaller chains/oracle so
+# the stage stays bounded; the artifact flushes per model.
+say "stage 7: tpu_gate.py vvh17/uniform/gaussian/t"
+python tools/tpu_gate.py --models vvh17 uniform gaussian t \
+  --nchains 256 --niter-np 8000 --burn-np 800 \
+  --out artifacts/tpu_gate_models_r04.json \
+  > artifacts/tpu_gate_models_r04.out 2>&1
+say "stage 7 rc=$?"
+
+# Stage 8: clean official re-confirmation after everything else.
+say "stage 8: python bench.py (re-confirmation)"
+python bench.py \
+  > artifacts/BENCH_OFFICIAL_r04b.out 2> artifacts/BENCH_OFFICIAL_r04b.err
+say "stage 8 rc=$? json=$(tail -1 artifacts/BENCH_OFFICIAL_r04b.out)"
+
+say "=== TPU program r04 done ==="
