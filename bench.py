@@ -355,6 +355,12 @@ def main(argv=None):
                          "(measured x7.65 ESS/sweep on the flagship, "
                          "artifacts/ADAPT_ESS_COV_r03.json); tagged in "
                          "the JSON line")
+    ap.add_argument("--mtm", type=int, default=0, metavar="K",
+                    help="multiple-try Metropolis with K candidates per "
+                         "MH step (MHConfig.mtm_tries; XLA closure "
+                         "path). Official metric keeps 0 = the "
+                         "reference's single-try kernel; a nonzero "
+                         "value is tagged in the JSON line")
     ap.add_argument("--record", default=None,
                     choices=("full", "compact", "compact8", "light"),
                     help="chain recording mode (default: compact8, the "
@@ -514,6 +520,8 @@ def main(argv=None):
         ap.error("--adapt-cov requires --adapt N")
     if args.adapt:
         cfg = cfg.with_adapt(args.adapt, adapt_cov=args.adapt_cov)
+    if args.mtm:
+        cfg = cfg.with_mtm(args.mtm)
     ma = build(args.ntoa, args.components, dataset=args.dataset)
 
     numpy_sps, numpy_ess = bench_numpy(ma, cfg, args.baseline_sweeps)
@@ -548,6 +556,11 @@ def main(argv=None):
         line["adapt_sweeps"] = args.adapt
         if args.adapt_cov:
             line["adapt_cov"] = True
+    if args.mtm:
+        # flagged: MTM changes the proposal mechanism (more likelihood
+        # evaluations per sweep), so it can't pass as the official
+        # reference-kernel number
+        line["mtm_tries"] = args.mtm
     if jax_ess is not None:
         line["ess_log10A_per_sec"] = round(jax_ess, 2)
     if jax_ess is not None and numpy_ess:

@@ -36,7 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax, random
-from jax.scipy.special import gammaln
+from jax.scipy.special import gammaln, logsumexp
 
 from gibbs_student_t_tpu.backends.base import (
     META_STATS,
@@ -734,6 +734,65 @@ class JaxGibbs(SamplerBackend):
             (x, ll0, lp0, jnp.zeros((), dtype=self.dtype)))
         return x, acc / nsteps
 
+    def _mtm_block(self, x, key, ind: np.ndarray, nsteps: int,
+                   loglike_fn, jump_scale=1.0, cov_chol=None):
+        """Multiple-try Metropolis on a coordinate block
+        (MHConfig.mtm_tries; MTM(II) of Liu, Liang & Wong 2000 with
+        importance weights w = pi, valid because the jump kernel is
+        symmetric — coordinate/scale choices are position-independent
+        and the Gaussian jump is centered).
+
+        Per step: K iid candidates from the same jump kernel as
+        ``_mh_block``, one selected by Gumbel-max on its log posterior
+        weight, K-1 reference points drawn around the SELECTED
+        candidate plus the current point itself, accept on
+        ``logsumexp(candidate weights) - logsumexp(reference weights)``.
+        All randomness precomputed up front (the ``_mh_draws``
+        discipline), (2K-1) likelihood evaluations per step."""
+        K = self.config.mh.mtm_tries
+        kc, kr, kg, ku = random.split(key, 4)
+        # K candidate jumps per step + (K-1) reference jumps per step,
+        # each an iid draw from the block's jump kernel. The log-uniform
+        # draws _mh_draws also produces are discarded here — unused
+        # trace outputs, so XLA dead-code-eliminates the threefry work;
+        # MTM's own accept draws come from ``ku`` below.
+        dx, _ = self._mh_draws(kc, ind, nsteps * K, jump_scale, cov_chol)
+        dx = dx.reshape(nsteps, K, -1)
+        dxr, _ = self._mh_draws(kr, ind, nsteps * (K - 1), jump_scale,
+                                cov_chol)
+        dxr = dxr.reshape(nsteps, K - 1, -1)
+        gumb = random.gumbel(kg, (nsteps, K), dtype=self.dtype)
+        logus = jnp.log(random.uniform(ku, (nsteps,), dtype=self.dtype))
+
+        def w(q):
+            return loglike_fn(q) + self._lnprior(q)
+
+        w_batch = jax.vmap(w)
+        wx0 = w(x)
+
+        def body(i, carry):
+            x, wx, acc = carry
+            cands = x[None, :] + dx[i]                     # (K, p)
+            lw = w_batch(cands)                            # (K,)
+            j = jnp.argmax(lw + gumb[i])                   # Gumbel-max
+            y = cands[j]
+            refs = y[None, :] + dxr[i]                     # (K-1, p)
+            lwr = jnp.concatenate([w_batch(refs), wx[None]])
+            num = logsumexp(lw)
+            den = logsumexp(lwr)
+            delta = num - den
+            # -inf - -inf = NaN (every weight dead on both sides) must
+            # reject, same as the single-try blocks' NaN semantics
+            accept = jnp.where(jnp.isnan(delta), False, delta > logus[i])
+            x = jnp.where(accept, y, x)
+            wx = jnp.where(accept, lw[j], wx)
+            return (x, wx, acc + accept)
+
+        x, _, acc = lax.fori_loop(
+            0, nsteps, body,
+            (x, wx0, jnp.zeros((), dtype=self.dtype)))
+        return x, acc / nsteps
+
     def _block_cov(self, state: ChainState, k: int):
         """The block's proposal Cholesky from the state, or None when
         population-covariance proposals are off."""
@@ -836,7 +895,8 @@ class JaxGibbs(SamplerBackend):
             Tb = matvec_blocked(ma.T, b, bs)
             jump_scale = jnp.exp(state.mh_log_scale[0])
             cov_w = self._block_cov(state, 0)
-            use_fused = (self._white_block is not None
+            use_fused = (cfg.mh.mtm_tries == 0
+                         and self._white_block is not None
                          and (ma_in is None
                               or (fused is not None
                                   and fused.white_rows is not None)))
@@ -859,10 +919,12 @@ class JaxGibbs(SamplerBackend):
                     return -0.5 * (jnp.sum(jnp.log(nvec))
                                    + jnp.sum(yred * yred / nvec))
 
-                x, acc_w = self._mh_block(x, kw, ma.white_indices,
-                                          cfg.mh.n_white_steps, ll_white,
-                                          jump_scale=jump_scale,
-                                          cov_chol=cov_w)
+                block = (self._mtm_block if cfg.mh.mtm_tries >= 2
+                         else self._mh_block)
+                x, acc_w = block(x, kw, ma.white_indices,
+                                 cfg.mh.n_white_steps, ll_white,
+                                 jump_scale=jump_scale,
+                                 cov_chol=cov_w)
         else:
             acc_w = jnp.zeros((), dtype=self.dtype)
         return x, acc_w, self._masked_nvec(ma, mask, x, az)
@@ -895,7 +957,8 @@ class JaxGibbs(SamplerBackend):
                 TNT[np.ix_(s_i, v_i)], TNT[np.ix_(v_i, v_i)],
                 d[s_i], d[v_i], cfg.jitter)
         cov_h = self._block_cov(state, 1)
-        use_fused_h = (self._hyper_block is not None
+        use_fused_h = (cfg.mh.mtm_tries == 0
+                       and self._hyper_block is not None
                        and len(ma.hyper_indices)
                        and (ma_in is None
                             or (fused is not None
@@ -954,10 +1017,12 @@ class JaxGibbs(SamplerBackend):
                                               - logdet_phi)
                     return jnp.where(jnp.isfinite(ll), ll, -jnp.inf)
 
-            x, acc_h = self._mh_block(x, kh, ma.hyper_indices,
-                                      cfg.mh.n_hyper_steps, ll_hyper,
-                                      jump_scale=jump_scale_h,
-                                      cov_chol=cov_h)
+            block = (self._mtm_block if cfg.mh.mtm_tries >= 2
+                     else self._mh_block)
+            x, acc_h = block(x, kh, ma.hyper_indices,
+                             cfg.mh.n_hyper_steps, ll_hyper,
+                             jump_scale=jump_scale_h,
+                             cov_chol=cov_h)
         else:
             acc_h = jnp.zeros((), dtype=self.dtype)
 

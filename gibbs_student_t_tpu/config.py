@@ -63,6 +63,18 @@ class MHConfig:
     adapt_cov: bool = False
     cov_target_accept: float = 0.234
     cov_shrinkage: float = 0.1
+    # Opt-in multiple-try Metropolis (JAX backend): each MH step draws
+    # ``mtm_tries`` iid candidates from the (symmetric) jump kernel,
+    # selects one by importance weight (posterior density, Gumbel-max),
+    # draws ``mtm_tries - 1`` reference points around the selected
+    # candidate, and accepts on the weight-sum ratio (Liu, Liang & Wong
+    # 2000, MTM(II) with w = pi). Trades (2K-1)x likelihood evaluations
+    # per step for larger accepted moves — a fit for the fused kernels'
+    # precomputed-draw shape where per-evaluation arithmetic is far
+    # below the VPU roofline (docs/PERFORMANCE.md). 0 (default)
+    # disables; values >= 2 run the XLA closure path (the fused
+    # single-try Pallas kernels are bypassed while MTM is on).
+    mtm_tries: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -117,6 +129,10 @@ class GibbsConfig:
                 "structural there (every TOA carries an auxiliary "
                 "inverse-gamma scale, reference gibbs.py:206-208), and "
                 "update_z never redraws it")
+        if self.mh.mtm_tries not in (0,) and self.mh.mtm_tries < 2:
+            raise ValueError(
+                f"MHConfig.mtm_tries must be 0 (off) or >= 2, got "
+                f"{self.mh.mtm_tries}")
         if self.mh.adapt_cov and self.mh.adapt_until <= 0:
             raise ValueError(
                 "MHConfig.adapt_cov requires adapt_until > 0 (the "
@@ -134,6 +150,12 @@ class GibbsConfig:
             self, mh=dataclasses.replace(self.mh,
                                          adapt_until=adapt_until,
                                          adapt_cov=adapt_cov))
+
+    def with_mtm(self, tries: int) -> "GibbsConfig":
+        """This config with multiple-try Metropolis proposals (the
+        drivers' ``--mtm`` flag; see MHConfig.mtm_tries)."""
+        return dataclasses.replace(
+            self, mh=dataclasses.replace(self.mh, mtm_tries=tries))
 
     @property
     def is_outlier_model(self) -> bool:

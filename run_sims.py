@@ -193,6 +193,10 @@ def main(argv=None):
                     help="with --adapt: population-covariance joint "
                          "proposals, per pulsar under --ensemble "
                          "(measured x7.65 ESS/sweep on the flagship)")
+    ap.add_argument("--mtm", type=int, default=0, metavar="K",
+                    help="jax backend: multiple-try Metropolis with K "
+                         "candidates per MH step (MHConfig.mtm_tries). "
+                         "0 = the reference's single-try kernel")
     ap.add_argument("--until-rhat", type=float, default=0.0,
                     metavar="TARGET",
                     help="jax backend: stop each config once every "
@@ -238,6 +242,9 @@ def main(argv=None):
     if args.min_ess and not args.until_rhat:
         ap.error("--min-ess composes with --until-rhat (it is an extra "
                  "stopping criterion, not a standalone mode)")
+    if args.mtm and args.backend != "jax":
+        ap.error("--mtm is a jax-backend feature; the NumPy oracle "
+                 "keeps the reference's single-try kernel")
     if args.adapt and args.backend != "jax":
         ap.error("--adapt is a jax-backend feature; the NumPy oracle "
                  "runs the reference's fixed jump scales "
@@ -271,6 +278,9 @@ def main(argv=None):
     if args.adapt:
         all_configs = {k: v.with_adapt(args.adapt,
                                        adapt_cov=args.adapt_cov)
+                       for k, v in all_configs.items()}
+    if args.mtm:
+        all_configs = {k: v.with_mtm(args.mtm)
                        for k, v in all_configs.items()}
     configs = {k: v for k, v in all_configs.items() if k in args.models}
 

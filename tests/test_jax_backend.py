@@ -374,6 +374,42 @@ def test_posterior_gate_mixture(ma):
     assert abs(a.mean() - b.mean()) / sd < 0.5, (a.mean(), b.mean())
 
 
+@pytest.mark.slow
+def test_posterior_gate_mtm(ma):
+    """Multiple-try Metropolis (MHConfig.mtm_tries) targets the SAME
+    posterior: the MTM kernel must pass the oracle gate unchanged —
+    the distributional validity check for the MTM(II) weight-sum
+    acceptance rule."""
+    cfg = GibbsConfig(model="mixture", theta_prior="beta").with_mtm(3)
+    _posterior_gate(ma, cfg)
+
+
+def test_mtm_accepts_more_and_matches_default_off(ma, monkeypatch):
+    """MTM raises per-step acceptance (K tries per step), composes with
+    vmap/chunking, and mtm_tries=0 never routes through the MTM block
+    (the dispatch must keep the reference's single-try path)."""
+    cfg = GibbsConfig(model="gaussian", vary_df=False)
+
+    def boom(self, *a, **kw):  # pragma: no cover - trips on regression
+        raise AssertionError("_mtm_block dispatched with mtm_tries=0")
+
+    monkeypatch.setattr(JaxGibbs, "_mtm_block", boom)
+    gb1 = JaxGibbs(ma, cfg, nchains=6, chunk_size=25)
+    r1 = gb1.sample(niter=50, seed=3)  # would raise if MTM dispatched
+    monkeypatch.undo()
+
+    gbm = JaxGibbs(ma, cfg.with_mtm(4), nchains=6, chunk_size=25)
+    rm = gbm.sample(niter=50, seed=3)
+    assert np.isfinite(np.asarray(rm.chain)).all()
+    assert (float(np.asarray(rm.stats["acc_white"]).mean())
+            > float(np.asarray(r1.stats["acc_white"]).mean()))
+
+
+def test_mtm_config_validation():
+    with pytest.raises(ValueError, match="mtm_tries"):
+        GibbsConfig(model="gaussian").with_mtm(1)
+
+
 def test_unrolled_chol_sweep_matches_lapack_path(ma, monkeypatch):
     """The TPU-gated unrolled-Cholesky sweep path produces the same chains
     as the LAPACK/expander path on identical keys — full integration

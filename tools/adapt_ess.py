@@ -28,6 +28,8 @@ def main():
     ap.add_argument("--niter", type=int, default=1500)
     ap.add_argument("--burn", type=int, default=500)
     ap.add_argument("--adapt", type=int, default=400)
+    ap.add_argument("--mtm", type=int, default=0, metavar="K",
+                    help="also run multiple-try arms with K candidates")
     ap.add_argument("--seed", type=int, default=11)
     args = ap.parse_args()
 
@@ -48,11 +50,20 @@ def main():
     idx = [i for i, nm in enumerate(ma.param_names) if "log10_A" in nm][0]
     short = {nm: nm.split("_", 1)[-1] for nm in ma.param_names}
 
+    arms = [("fixed", cfg),
+            ("adapted", cfg.with_adapt(args.adapt)),
+            ("adapted_cov", cfg.with_adapt(args.adapt, adapt_cov=True))]
+    if args.mtm:
+        # MTM alone and MTM on top of the current best lever — the
+        # ESS/sweep number must be read against the (2K-1)x likelihood
+        # evaluations per MH step (wall_s captures the CPU-side cost;
+        # in the fused kernels the evals are far below the VPU roofline)
+        arms += [(f"mtm{args.mtm}", cfg.with_mtm(args.mtm)),
+                 (f"adapted_cov_mtm{args.mtm}",
+                  cfg.with_adapt(args.adapt,
+                                 adapt_cov=True).with_mtm(args.mtm))]
     out = {"config": vars(args), "runs": {}}
-    for label, c in (("fixed", cfg),
-                     ("adapted", cfg.with_adapt(args.adapt)),
-                     ("adapted_cov", cfg.with_adapt(args.adapt,
-                                                    adapt_cov=True))):
+    for label, c in arms:
         t0 = time.perf_counter()
         gb = JaxGibbs(ma, c, nchains=args.nchains, chunk_size=100)
         res = gb.sample(niter=args.niter, seed=args.seed)
@@ -86,6 +97,12 @@ def main():
     gain_cov = (out["runs"]["adapted_cov"]["ess_per_chain_sweep"]
                 / max(out["runs"]["fixed"]["ess_per_chain_sweep"], 1e-12))
     out["ess_per_sweep_gain_cov"] = round(gain_cov, 2)
+    for label in out["runs"]:
+        if label.startswith(("mtm", "adapted_cov_mtm")):
+            out[f"ess_per_sweep_gain_{label}"] = round(
+                out["runs"][label]["ess_per_chain_sweep"]
+                / max(out["runs"]["fixed"]["ess_per_chain_sweep"],
+                      1e-12), 2)
     out["note"] = (
         "ESS-per-sweep is hardware-independent: this gain multiplies the "
         "on-chip chain-sweeps/s throughput (BENCH artifacts) to give the "
