@@ -895,7 +895,9 @@ class JaxGibbs(SamplerBackend):
             Tb = matvec_blocked(ma.T, b, bs)
             jump_scale = jnp.exp(state.mh_log_scale[0])
             cov_w = self._block_cov(state, 0)
-            use_fused = (cfg.mh.mtm_tries == 0
+            mtm_w = (cfg.mh.mtm_tries >= 2
+                     and "white" in cfg.mh.mtm_blocks)
+            use_fused = (not mtm_w
                          and self._white_block is not None
                          and (ma_in is None
                               or (fused is not None
@@ -919,8 +921,7 @@ class JaxGibbs(SamplerBackend):
                     return -0.5 * (jnp.sum(jnp.log(nvec))
                                    + jnp.sum(yred * yred / nvec))
 
-                block = (self._mtm_block if cfg.mh.mtm_tries >= 2
-                         else self._mh_block)
+                block = self._mtm_block if mtm_w else self._mh_block
                 x, acc_w = block(x, kw, ma.white_indices,
                                  cfg.mh.n_white_steps, ll_white,
                                  jump_scale=jump_scale,
@@ -957,7 +958,9 @@ class JaxGibbs(SamplerBackend):
                 TNT[np.ix_(s_i, v_i)], TNT[np.ix_(v_i, v_i)],
                 d[s_i], d[v_i], cfg.jitter)
         cov_h = self._block_cov(state, 1)
-        use_fused_h = (cfg.mh.mtm_tries == 0
+        mtm_h = (cfg.mh.mtm_tries >= 2
+                 and "hyper" in cfg.mh.mtm_blocks)
+        use_fused_h = (not mtm_h
                        and self._hyper_block is not None
                        and len(ma.hyper_indices)
                        and (ma_in is None
@@ -1017,8 +1020,7 @@ class JaxGibbs(SamplerBackend):
                                               - logdet_phi)
                     return jnp.where(jnp.isfinite(ll), ll, -jnp.inf)
 
-            block = (self._mtm_block if cfg.mh.mtm_tries >= 2
-                     else self._mh_block)
+            block = self._mtm_block if mtm_h else self._mh_block
             x, acc_h = block(x, kh, ma.hyper_indices,
                              cfg.mh.n_hyper_steps, ll_hyper,
                              jump_scale=jump_scale_h,

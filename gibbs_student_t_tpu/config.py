@@ -74,7 +74,13 @@ class MHConfig:
     # below the VPU roofline (docs/PERFORMANCE.md). 0 (default)
     # disables; values >= 2 run the XLA closure path (the fused
     # single-try Pallas kernels are bypassed while MTM is on).
+    # ``mtm_blocks`` selects which MH blocks use MTM — the white block's
+    # likelihood evaluations are cheap (elementwise) while the hyper
+    # block's each pay a factorization, so the cost/benefit differs
+    # sharply per block; the per-block A/B (tools/adapt_ess.py --mtm)
+    # is what decides where in-kernel fusion would pay.
     mtm_tries: int = 0
+    mtm_blocks: Tuple[str, ...] = ("white", "hyper")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -133,6 +139,15 @@ class GibbsConfig:
             raise ValueError(
                 f"MHConfig.mtm_tries must be 0 (off) or >= 2, got "
                 f"{self.mh.mtm_tries}")
+        if not set(self.mh.mtm_blocks) <= {"white", "hyper"}:
+            raise ValueError(
+                f"MHConfig.mtm_blocks must be a subset of "
+                f"('white', 'hyper'), got {self.mh.mtm_blocks!r}")
+        if self.mh.mtm_tries >= 2 and not self.mh.mtm_blocks:
+            raise ValueError(
+                "MHConfig.mtm_tries is set but mtm_blocks is empty — "
+                "MTM would silently never run; select ('white',), "
+                "('hyper',) or both")
         if self.mh.adapt_cov and self.mh.adapt_until <= 0:
             raise ValueError(
                 "MHConfig.adapt_cov requires adapt_until > 0 (the "
@@ -151,11 +166,14 @@ class GibbsConfig:
                                          adapt_until=adapt_until,
                                          adapt_cov=adapt_cov))
 
-    def with_mtm(self, tries: int) -> "GibbsConfig":
+    def with_mtm(self, tries: int,
+                 blocks: Tuple[str, ...] = ("white", "hyper"),
+                 ) -> "GibbsConfig":
         """This config with multiple-try Metropolis proposals (the
-        drivers' ``--mtm`` flag; see MHConfig.mtm_tries)."""
+        drivers' ``--mtm`` flag; see MHConfig.mtm_tries/mtm_blocks)."""
         return dataclasses.replace(
-            self, mh=dataclasses.replace(self.mh, mtm_tries=tries))
+            self, mh=dataclasses.replace(self.mh, mtm_tries=tries,
+                                         mtm_blocks=tuple(blocks)))
 
     @property
     def is_outlier_model(self) -> bool:

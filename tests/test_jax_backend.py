@@ -408,6 +408,30 @@ def test_mtm_accepts_more_and_matches_default_off(ma, monkeypatch):
 def test_mtm_config_validation():
     with pytest.raises(ValueError, match="mtm_tries"):
         GibbsConfig(model="gaussian").with_mtm(1)
+    with pytest.raises(ValueError, match="mtm_blocks"):
+        GibbsConfig(model="gaussian").with_mtm(2, blocks=("red",))
+
+
+def test_mtm_per_block_selection(ma, monkeypatch):
+    """mtm_blocks routes MTM to the selected block only: with
+    blocks=('hyper',), the white block must stay on the single-try
+    path (and vice versa)."""
+    calls = []
+    orig = JaxGibbs._mtm_block
+
+    def spy(self, x, key, ind, nsteps, *a, **kw):
+        calls.append(nsteps)
+        return orig(self, x, key, ind, nsteps, *a, **kw)
+
+    monkeypatch.setattr(JaxGibbs, "_mtm_block", spy)
+    cfg = GibbsConfig(model="gaussian", vary_df=False)
+    gb = JaxGibbs(ma, cfg.with_mtm(3, blocks=("hyper",)), nchains=4,
+                  chunk_size=10)
+    res = gb.sample(niter=10, seed=1)
+    assert np.isfinite(np.asarray(res.chain)).all()
+    # traced once per chunk compile; only the hyper block's step count
+    # (n_hyper_steps=10) ever reaches the MTM block
+    assert set(calls) == {cfg.mh.n_hyper_steps}
 
 
 def test_unrolled_chol_sweep_matches_lapack_path(ma, monkeypatch):

@@ -58,10 +58,18 @@ def main():
         # ESS/sweep number must be read against the (2K-1)x likelihood
         # evaluations per MH step (wall_s captures the CPU-side cost;
         # in the fused kernels the evals are far below the VPU roofline)
+        acov = cfg.with_adapt(args.adapt, adapt_cov=True)
         arms += [(f"mtm{args.mtm}", cfg.with_mtm(args.mtm)),
                  (f"adapted_cov_mtm{args.mtm}",
-                  cfg.with_adapt(args.adapt,
-                                 adapt_cov=True).with_mtm(args.mtm))]
+                  acov.with_mtm(args.mtm)),
+                 # per-block arms: the white block's extra evaluations
+                 # are cheap (elementwise), the hyper block's each pay
+                 # a factorization — these decide where in-kernel MTM
+                 # fusion would pay (docs/FUTURE.md #5)
+                 (f"adapted_cov_mtm{args.mtm}_white_only",
+                  acov.with_mtm(args.mtm, blocks=("white",))),
+                 (f"adapted_cov_mtm{args.mtm}_hyper_only",
+                  acov.with_mtm(args.mtm, blocks=("hyper",)))]
     out = {"config": vars(args), "runs": {}}
     for label, c in arms:
         t0 = time.perf_counter()
