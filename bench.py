@@ -357,10 +357,17 @@ def main(argv=None):
                          "the JSON line")
     ap.add_argument("--mtm", type=int, default=0, metavar="K",
                     help="multiple-try Metropolis with K candidates per "
-                         "MH step (MHConfig.mtm_tries; XLA closure "
-                         "path). Official metric keeps 0 = the "
-                         "reference's single-try kernel; a nonzero "
+                         "MH step (MHConfig.mtm_tries; the white block "
+                         "has a fused kernel, the hyper block runs the "
+                         "XLA closure path). Official metric keeps 0 = "
+                         "the reference's single-try kernel; a nonzero "
                          "value is tagged in the JSON line")
+    ap.add_argument("--mtm-blocks", nargs="+",
+                    default=["white", "hyper"],
+                    choices=("white", "hyper"),
+                    help="which MH blocks go multiple-try under --mtm "
+                         "(the per-block A/B recommends white-only: "
+                         "docs/PERFORMANCE.md)")
     ap.add_argument("--record", default=None,
                     choices=("full", "compact", "compact8", "light"),
                     help="chain recording mode (default: compact8, the "
@@ -520,8 +527,10 @@ def main(argv=None):
         ap.error("--adapt-cov requires --adapt N")
     if args.adapt:
         cfg = cfg.with_adapt(args.adapt, adapt_cov=args.adapt_cov)
+    if set(args.mtm_blocks) != {"white", "hyper"} and not args.mtm:
+        ap.error("--mtm-blocks requires --mtm K")
     if args.mtm:
-        cfg = cfg.with_mtm(args.mtm)
+        cfg = cfg.with_mtm(args.mtm, blocks=tuple(args.mtm_blocks))
     ma = build(args.ntoa, args.components, dataset=args.dataset)
 
     numpy_sps, numpy_ess = bench_numpy(ma, cfg, args.baseline_sweeps)
@@ -561,6 +570,8 @@ def main(argv=None):
         # evaluations per sweep), so it can't pass as the official
         # reference-kernel number
         line["mtm_tries"] = args.mtm
+        if set(args.mtm_blocks) != {"white", "hyper"}:
+            line["mtm_blocks"] = sorted(args.mtm_blocks)
     if jax_ess is not None:
         line["ess_log10A_per_sec"] = round(jax_ess, 2)
     if jax_ess is not None and numpy_ess:

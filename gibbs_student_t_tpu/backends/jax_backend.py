@@ -561,6 +561,7 @@ class JaxGibbs(SamplerBackend):
         # trace-time snapshot semantics as GST_PALLAS_CHOL) gates the
         # actual kernel use inside the dispatcher.
         self._white_block = None
+        self._white_mtm_block = None
         self._white_consts = None
         if dtype == jnp.float32 and len(self._ma.white_indices):
             from gibbs_student_t_tpu.ops.pallas_white import (
@@ -577,6 +578,16 @@ class JaxGibbs(SamplerBackend):
             # travel per call, so ensembles can substitute traced
             # per-pulsar constants (parallel/ensemble.py)
             self._white_block = make_white_block(wc.var)
+            if (config.mh.mtm_tries >= 2
+                    and "white" in config.mh.mtm_blocks):
+                from gibbs_student_t_tpu.ops.pallas_white import (
+                    make_white_mtm_block,
+                )
+
+                # the multiple-try twin: the per-block A/B showed
+                # white-block MTM is the arm whose extra evaluations
+                # are cheap enough to fuse (docs/PERFORMANCE.md)
+                self._white_mtm_block = make_white_mtm_block(wc.var)
         # Fused hyper MH block (ops/pallas_hyper.py): the 10-step
         # marginalized-likelihood block as one Pallas launch, with the
         # Schur block (or TNT) resident in VMEM across all proposals.
@@ -734,6 +745,27 @@ class JaxGibbs(SamplerBackend):
             (x, ll0, lp0, jnp.zeros((), dtype=self.dtype)))
         return x, acc / nsteps
 
+    def _mtm_draws(self, key, ind: np.ndarray, nsteps: int,
+                   jump_scale=1.0, cov_chol=None):
+        """All of one MTM block's randomness: per step, K candidate
+        jumps, K-1 reference jumps, K Gumbel selection draws, one
+        log-uniform accept draw — one key schedule shared by the XLA
+        closure block and the fused white-MTM kernel, so kernel on/off
+        runs consume identical streams (the ``_mh_draws`` discipline).
+        The log-uniform draws the two ``_mh_draws`` calls also produce
+        are discarded — unused trace outputs, so XLA dead-code-
+        eliminates the threefry work."""
+        K = self.config.mh.mtm_tries
+        kc, kr, kg, ku = random.split(key, 4)
+        dx, _ = self._mh_draws(kc, ind, nsteps * K, jump_scale, cov_chol)
+        dx = dx.reshape(nsteps, K, -1)
+        dxr, _ = self._mh_draws(kr, ind, nsteps * (K - 1), jump_scale,
+                                cov_chol)
+        dxr = dxr.reshape(nsteps, K - 1, -1)
+        gumb = random.gumbel(kg, (nsteps, K), dtype=self.dtype)
+        logus = jnp.log(random.uniform(ku, (nsteps,), dtype=self.dtype))
+        return dx, dxr, gumb, logus
+
     def _mtm_block(self, x, key, ind: np.ndarray, nsteps: int,
                    loglike_fn, jump_scale=1.0, cov_chol=None):
         """Multiple-try Metropolis on a coordinate block
@@ -747,22 +779,10 @@ class JaxGibbs(SamplerBackend):
         weight, K-1 reference points drawn around the SELECTED
         candidate plus the current point itself, accept on
         ``logsumexp(candidate weights) - logsumexp(reference weights)``.
-        All randomness precomputed up front (the ``_mh_draws``
-        discipline), (2K-1) likelihood evaluations per step."""
-        K = self.config.mh.mtm_tries
-        kc, kr, kg, ku = random.split(key, 4)
-        # K candidate jumps per step + (K-1) reference jumps per step,
-        # each an iid draw from the block's jump kernel. The log-uniform
-        # draws _mh_draws also produces are discarded here — unused
-        # trace outputs, so XLA dead-code-eliminates the threefry work;
-        # MTM's own accept draws come from ``ku`` below.
-        dx, _ = self._mh_draws(kc, ind, nsteps * K, jump_scale, cov_chol)
-        dx = dx.reshape(nsteps, K, -1)
-        dxr, _ = self._mh_draws(kr, ind, nsteps * (K - 1), jump_scale,
-                                cov_chol)
-        dxr = dxr.reshape(nsteps, K - 1, -1)
-        gumb = random.gumbel(kg, (nsteps, K), dtype=self.dtype)
-        logus = jnp.log(random.uniform(ku, (nsteps,), dtype=self.dtype))
+        All randomness precomputed up front (``_mtm_draws``), (2K-1)
+        likelihood evaluations per step."""
+        dx, dxr, gumb, logus = self._mtm_draws(key, ind, nsteps,
+                                               jump_scale, cov_chol)
 
         def w(q):
             return loglike_fn(q) + self._lnprior(q)
@@ -897,23 +917,33 @@ class JaxGibbs(SamplerBackend):
             cov_w = self._block_cov(state, 0)
             mtm_w = (cfg.mh.mtm_tries >= 2
                      and "white" in cfg.mh.mtm_blocks)
-            use_fused = (not mtm_w
-                         and self._white_block is not None
-                         and (ma_in is None
-                              or (fused is not None
-                                  and fused.white_rows is not None)))
-            if use_fused:
+            consts_ok = (ma_in is None
+                         or (fused is not None
+                             and fused.white_rows is not None))
+            use_fused = (not mtm_w and self._white_block is not None
+                         and consts_ok)
+            use_fused_mtm = (mtm_w and self._white_mtm_block is not None
+                             and consts_ok)
+            if use_fused or use_fused_mtm:
                 if ma_in is None:
                     wrows = self._white_consts.rows
                     wspecs = self._white_consts.specs
                 else:
                     wrows, wspecs = fused.white_rows, fused.white_specs
-                dx, logus = self._mh_draws(
-                    kw, ma.white_indices, cfg.mh.n_white_steps,
-                    jump_scale, cov_w)
                 yred = ma.y - Tb
-                x, acc_w = self._white_block(x, az, yred * yred, dx,
-                                             logus, wrows, wspecs)
+                if use_fused_mtm:
+                    dx, dxr, gumb, logus = self._mtm_draws(
+                        kw, ma.white_indices, cfg.mh.n_white_steps,
+                        jump_scale, cov_w)
+                    x, acc_w = self._white_mtm_block(
+                        x, az, yred * yred, dx, dxr, gumb, logus,
+                        wrows, wspecs)
+                else:
+                    dx, logus = self._mh_draws(
+                        kw, ma.white_indices, cfg.mh.n_white_steps,
+                        jump_scale, cov_w)
+                    x, acc_w = self._white_block(x, az, yred * yred, dx,
+                                                 logus, wrows, wspecs)
             else:
                 def ll_white(xq):
                     nvec = self._masked_nvec(ma, mask, xq, az)

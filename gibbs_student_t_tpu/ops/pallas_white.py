@@ -221,30 +221,81 @@ def white_mh_loop_xla(x, az, yred2, dx, logu, rows, specs, var):
     return x, acc / nsteps
 
 
+def white_mtm_loop_xla(x, az, yred2, dx, dxr, gumb, logu, rows, specs,
+                       var):
+    """The white MH block under multiple-try Metropolis, plain XLA —
+    the fused white-MTM kernel's dispatch twin (MHConfig.mtm_tries;
+    MTM(II), see backends.jax_backend._mtm_block for the rule). Batch-
+    generic: ``dx (…, S, K, p)`` candidate jumps, ``dxr (…, S, K-1, p)``
+    reference jumps, ``gumb (…, S, K)`` selection draws, ``logu
+    (…, S)``; ``rows``/``specs`` as in :func:`white_mh_loop_xla`."""
+    from jax.scipy.special import logsumexp
+
+    rows = align_consts(jnp.asarray(rows, x.dtype), x.ndim - 1)
+    specs = align_consts(jnp.asarray(specs, x.dtype), x.ndim - 1)
+    # consts get one more singleton axis so they broadcast against the
+    # candidate axis K inserted before p
+    rows_k = rows[..., None, :, :]
+    specs_k = specs[..., None, :, :]
+    nsteps = dx.shape[-3]
+    ll0, lp0 = _ll_lp_xla(x, az, yred2, rows, var, specs)
+    w0 = ll0 + lp0
+    acc0 = jnp.zeros(w0.shape, x.dtype)
+
+    def body(i, carry):
+        x, wx, acc = carry
+        dxi = lax.dynamic_index_in_dim(dx, i, axis=dx.ndim - 3,
+                                       keepdims=False)
+        cands = x[..., None, :] + dxi                    # (…, K, p)
+        llc, lpc = _ll_lp_xla(cands, az[..., None, :],
+                              yred2[..., None, :], rows_k, var, specs_k)
+        lw = llc + lpc                                   # (…, K)
+        gi = lax.dynamic_index_in_dim(gumb, i, axis=gumb.ndim - 2,
+                                      keepdims=False)
+        j = jnp.argmax(lw + gi, axis=-1)
+        y = jnp.take_along_axis(cands, j[..., None, None],
+                                axis=-2)[..., 0, :]
+        lwy = jnp.take_along_axis(lw, j[..., None], axis=-1)[..., 0]
+        dxri = lax.dynamic_index_in_dim(dxr, i, axis=dxr.ndim - 3,
+                                        keepdims=False)
+        refs = y[..., None, :] + dxri                    # (…, K-1, p)
+        llr, lpr = _ll_lp_xla(refs, az[..., None, :],
+                              yred2[..., None, :], rows_k, var, specs_k)
+        lwr = jnp.concatenate([llr + lpr, wx[..., None]], axis=-1)
+        delta = logsumexp(lw, axis=-1) - logsumexp(lwr, axis=-1)
+        lu = lax.dynamic_index_in_dim(logu, i, axis=logu.ndim - 1,
+                                      keepdims=False)
+        # -inf - -inf = NaN (every weight dead on both sides): reject
+        accept = jnp.where(jnp.isnan(delta), False, delta > lu)
+        am = accept[..., None]
+        return (jnp.where(am, y, x), jnp.where(accept, lwy, wx),
+                acc + accept)
+
+    x, _, acc = lax.fori_loop(0, nsteps, body, (x, w0, acc0))
+    return x, acc / nsteps
+
+
 # ---------------------------------------------------------------------------
 # the kernel
 # ---------------------------------------------------------------------------
 
 
-def _white_kernel(x_ref, az_ref, y2_ref, dx_ref, lu_ref, cn_ref, sp_ref,
-                  xo_ref, ao_ref, *, nsteps: int, p: int,
-                  var: Tuple[Tuple[int, int, int], ...]):
-    # cn_ref (1, R, N) / sp_ref (1, 8, P): the leading singleton is the
-    # GROUP (pulsar) block axis — each grid tile reads its own group's
-    # constants via the index map (shared across the tile's chains)
-    C, P = x_ref.shape
-    N = az_ref.shape[1]
-    colP = lax.broadcasted_iota(jnp.int32, (1, P), 1)
-    colS = lax.broadcasted_iota(jnp.int32, (1, lu_ref.shape[1]), 1)
+def _make_kernel_ll_lp(az, y2, cn_ref, sp_ref, colP, p, var):
+    """The in-kernel white conditional likelihood + prior as a closure
+    over one tile's loaded operands — ONE copy shared by the single-try
+    and MTM kernels, so the rmask/prior/padded-lane contracts cannot
+    drift between them. ``cn_ref (1, R, N)`` / ``sp_ref (1, 8, P)``:
+    the leading singleton is the GROUP (pulsar) block axis — each grid
+    tile reads its own group's constants via the index map (shared
+    across the tile's chains). Returns ``ll_lp(q) -> (ll, lp)`` as
+    (C, 1) rows."""
+    C, N = az.shape
     pmask = colP < p
     kind = jnp.where(pmask, sp_ref[0, 0:1, :], -1.0)
     a = sp_ref[0, 1:2, :]
     b = sp_ref[0, 2:3, :]
     nv0 = cn_ref[0, 0:1, :]
     rmask = cn_ref[0, 1:2, :]
-    az = az_ref[:]
-    y2 = y2_ref[:]
-    lu_all = lu_ref[:]
 
     def ll_lp(q):
         nd = jnp.zeros((C, N), jnp.float32) + nv0
@@ -258,10 +309,23 @@ def _white_kernel(x_ref, az_ref, y2_ref, dx_ref, lu_ref, cn_ref, sp_ref,
         nv = az * nd
         nv = rmask * nv + (1.0 - rmask)
         ll = -0.5 * jnp.sum(jnp.log(nv) + y2 / nv, axis=1, keepdims=True)
-        lp_el = _lnprior_cols(q, kind, a, b)
-        lp_el = jnp.where(pmask, lp_el, 0.0)
+        lp_el = jnp.where(pmask, _lnprior_cols(q, kind, a, b), 0.0)
         lp = jnp.sum(lp_el, axis=1, keepdims=True)
         return ll, lp
+
+    return ll_lp
+
+
+def _white_kernel(x_ref, az_ref, y2_ref, dx_ref, lu_ref, cn_ref, sp_ref,
+                  xo_ref, ao_ref, *, nsteps: int, p: int,
+                  var: Tuple[Tuple[int, int, int], ...]):
+    C, P = x_ref.shape
+    colP = lax.broadcasted_iota(jnp.int32, (1, P), 1)
+    colS = lax.broadcasted_iota(jnp.int32, (1, lu_ref.shape[1]), 1)
+    az = az_ref[:]
+    y2 = y2_ref[:]
+    lu_all = lu_ref[:]
+    ll_lp = _make_kernel_ll_lp(az, y2, cn_ref, sp_ref, colP, p, var)
 
     x = x_ref[:]
     ll0, lp0 = ll_lp(x)
@@ -280,12 +344,136 @@ def _white_kernel(x_ref, az_ref, y2_ref, dx_ref, lu_ref, cn_ref, sp_ref,
     ao_ref[:] = jnp.broadcast_to(acc, ao_ref.shape)
 
 
+def _white_mtm_kernel(x_ref, az_ref, y2_ref, dx_ref, dxr_ref, gu_ref,
+                      lu_ref, cn_ref, sp_ref, xo_ref, ao_ref, *,
+                      nsteps: int, K: int, p: int,
+                      var: Tuple[Tuple[int, int, int], ...]):
+    """Whole white MH block under multiple-try Metropolis, one launch.
+
+    Same layout contract as ``_white_kernel`` (chains on sublanes,
+    constants as (1, R, N)/(1, 8, P) group blocks) plus the MTM draw
+    arrays: ``dx (S*K, tile, P)`` candidate jumps and ``dxr
+    (S*(K-1), tile, P)`` reference jumps on untiled leading axes the
+    in-kernel ``fori_loop`` dynamic-indexes, ``gu (tile, SKp)`` Gumbel
+    selection draws and ``lu (tile, SP)`` accept draws lane-extracted
+    per step. Candidate/reference weight sums run as ONLINE logsumexp
+    (max/rescale streaming) so only (tile, 1) accumulators live across
+    the K-unrolled inner loops; dead weights (-inf) contribute exactly
+    0 and an all-dead step rejects via the NaN > logu = False
+    semantics, matching backends.jax_backend._mtm_block."""
+    C, P = x_ref.shape
+    neg_inf = jnp.float32(-jnp.inf)
+    colP = lax.broadcasted_iota(jnp.int32, (1, P), 1)
+    colSK = lax.broadcasted_iota(jnp.int32, (1, gu_ref.shape[1]), 1)
+    colS = lax.broadcasted_iota(jnp.int32, (1, lu_ref.shape[1]), 1)
+    az = az_ref[:]
+    y2 = y2_ref[:]
+    gu_all = gu_ref[:]
+    lu_all = lu_ref[:]
+    ll_lp_pair = _make_kernel_ll_lp(az, y2, cn_ref, sp_ref, colP, p, var)
+
+    def ll_lp(q):
+        ll, lp = ll_lp_pair(q)
+        return ll + lp
+
+    def lse_update(m, s, lw):
+        # online logsumexp: fold one (C, 1) log-weight into (m, s)
+        m_new = jnp.maximum(m, lw)
+        s = (jnp.where(m == neg_inf, 0.0, s * jnp.exp(m - m_new))
+             + jnp.where(lw == neg_inf, 0.0, jnp.exp(lw - m_new)))
+        return m_new, s
+
+    x0 = x_ref[:]
+    wx0 = ll_lp(x0)
+
+    def step(j, carry):
+        x, wx, acc = carry
+        m = jnp.full((C, 1), neg_inf)
+        s = jnp.zeros((C, 1), jnp.float32)
+        best_g = jnp.full((C, 1), neg_inf)
+        best_lw = jnp.full((C, 1), neg_inf)
+        best_q = x
+        for k in range(K):
+            q = x + dx_ref[j * K + k]
+            lw = ll_lp(q)
+            m, s = lse_update(m, s, lw)
+            g = jnp.sum(jnp.where(colSK == j * K + k, gu_all, 0.0),
+                        axis=1, keepdims=True)
+            gs = lw + g
+            sel = gs > best_g
+            best_g = jnp.where(sel, gs, best_g)
+            best_lw = jnp.where(sel, lw, best_lw)
+            best_q = jnp.where(sel, q, best_q)
+        num = m + jnp.log(s)
+        # references seeded with the current point's weight
+        m2, s2 = wx, jnp.ones((C, 1), jnp.float32)
+        for k in range(K - 1):
+            r = best_q + dxr_ref[j * (K - 1) + k]
+            m2, s2 = lse_update(m2, s2, ll_lp(r))
+        den = m2 + jnp.log(s2)
+        lu = jnp.sum(jnp.where(colS == j, lu_all, 0.0), axis=1,
+                     keepdims=True)
+        am = (num - den) > lu                 # NaN/-inf delta rejects
+        return (jnp.where(am, best_q, x), jnp.where(am, best_lw, wx),
+                acc + am.astype(jnp.float32))
+
+    x, _, acc = lax.fori_loop(
+        0, nsteps, step,
+        (x0, wx0, jnp.zeros((C, 1), jnp.float32)))
+    xo_ref[:] = x
+    ao_ref[:] = jnp.broadcast_to(acc, ao_ref.shape)
+
+
 def _pad_lanes(arr, width):
     pad = width - arr.shape[-1]
     if pad <= 0:
         return arr
     return jnp.concatenate(
         [arr, jnp.zeros(arr.shape[:-1] + (pad,), arr.dtype)], axis=-1)
+
+
+def _prep_grouped(x, az, yred2, rows, specs, tile):
+    """Shared operand prep of the grouped white kernels: chains padded
+    per group to a tile multiple (so no chain tile straddles groups)
+    then flattened group-major, lanes padded to 128 multiples — with
+    padded TOA lanes carrying ``az = 1`` so ``log(nv) = 0`` there (the
+    rmask constant row zeroes their reduction terms) — and the constant
+    rows/specs padded to their block shapes. Returns the prepared
+    operands plus the ``pad_chains``/``flat`` closures so callers pad
+    their own draw arrays identically, and the padded dims."""
+    G, C, p = x.shape
+    n = az.shape[-1]
+    P = _round_up(p, 128)
+    N = _round_up(n, 128)
+    Cp = _round_up(C, tile)
+
+    def pad_chains(arr):
+        padn = Cp - arr.shape[1]
+        if not padn:
+            return arr
+        return jnp.concatenate(
+            [arr, jnp.broadcast_to(arr[:, :1],
+                                   (G, padn) + arr.shape[2:])], axis=1)
+
+    def flat(arr):  # (G, Cp, ...) -> (G*Cp, ...)
+        return arr.reshape((G * Cp,) + arr.shape[2:])
+
+    xp_ = flat(pad_chains(_pad_lanes(x, P)))
+    azp = flat(pad_chains(_pad_lanes(az, N)))
+    if N > n:
+        lane = lax.broadcasted_iota(jnp.int32, (1, N), 1)
+        azp = jnp.where(lane < n, azp, 1.0)
+    y2p = flat(pad_chains(_pad_lanes(yred2, N)))
+    rows = _pad_lanes(jnp.asarray(rows, jnp.float32), N)
+    R = _round_up(rows.shape[1], 8)
+    rows = jnp.concatenate(
+        [rows, jnp.zeros((G, R - rows.shape[1], N), jnp.float32)],
+        axis=1)
+    specs = _pad_lanes(jnp.asarray(specs, jnp.float32), P)
+    specs = jnp.concatenate(
+        [specs, jnp.zeros((G, 8 - specs.shape[1], P), jnp.float32)],
+        axis=1)
+    return xp_, azp, y2p, rows, specs, pad_chains, flat, (P, N, R, Cp)
 
 
 def white_mh_fused(x, az, yred2, dx, logu, rows, specs, var,
@@ -320,41 +508,12 @@ def white_mh_fused(x, az, yred2, dx, logu, rows, specs, var,
     while tile > 8 and 6 * tile * N * 4 > 4 * 2 ** 20:
         tile //= 2
     tile = max(8, min(tile, _round_up(C, 8)))
-    Cp = _round_up(C, tile)
+    xp_, azp, y2p, rows, specs, pad_chains, flat, (P, N, R, Cp) = (
+        _prep_grouped(x, az, yred2, rows, specs, tile))
     tpg = Cp // tile  # tiles per group
-
-    def pad_chains(arr):
-        # per-group edge-replication pad of the chain axis (axis 1)
-        padn = Cp - arr.shape[1]
-        if not padn:
-            return arr
-        return jnp.concatenate(
-            [arr, jnp.broadcast_to(arr[:, :1],
-                                   (G, padn) + arr.shape[2:])], axis=1)
-
-    def flat(arr):  # (G, Cp, ...) -> (G*Cp, ...)
-        return arr.reshape((G * Cp,) + arr.shape[2:])
-
-    xp_ = flat(pad_chains(_pad_lanes(x, P)))
-    azp = flat(pad_chains(_pad_lanes(az, N)))
-    # padded TOA lanes: az must be 1 (not 0) so log(nv)=0 there; the rmask
-    # row already zeroes their reduction terms, this keeps them finite
-    if N > n:
-        lane = lax.broadcasted_iota(jnp.int32, (1, N), 1)
-        azp = jnp.where(lane < n, azp, 1.0)
-    y2p = flat(pad_chains(_pad_lanes(yred2, N)))
     # (S, G*Cp, P): step index on the untiled leading axis
     dxp = jnp.moveaxis(flat(pad_chains(_pad_lanes(dx, P))), 1, 0)
     lup = flat(pad_chains(_pad_lanes(logu, SP)))
-
-    rows = _pad_lanes(jnp.asarray(rows, jnp.float32), N)
-    R = _round_up(rows.shape[1], 8)
-    rows = jnp.concatenate(
-        [rows, jnp.zeros((G, R - rows.shape[1], N), jnp.float32)], axis=1)
-    specs = _pad_lanes(jnp.asarray(specs, jnp.float32), P)
-    specs = jnp.concatenate(
-        [specs, jnp.zeros((G, 8 - specs.shape[1], P), jnp.float32)],
-        axis=1)
 
     kwargs = {}
     if _HAVE_PLTPU:  # chain tiles are independent
@@ -384,6 +543,84 @@ def white_mh_fused(x, az, yred2, dx, logu, rows, specs, var,
         interpret=interpret,
         **kwargs,
     )(xp_, azp, y2p, dxp, lup, rows, specs)
+    xo = xo.reshape(G, Cp, P)[:, :C, :p]
+    ao = ao.reshape(G, Cp, 8)[:, :C, 0] / S
+    return xo, ao
+
+
+def white_mtm_fused(x, az, yred2, dx, dxr, gumb, logu, rows, specs, var,
+                    chain_tile: int | None = None,
+                    interpret: bool = False):
+    """``(x_new, acc_rate)`` for the white MTM block, one launch.
+
+    GROUPED form like :func:`white_mh_fused`: ``x (G, C, p)``,
+    ``az/yred2 (G, C, n)``, ``dx (G, C, S, K, p)``, ``dxr
+    (G, C, S, K-1, p)``, ``gumb (G, C, S, K)``, ``logu (G, C, S)``,
+    ``rows (G, R, n)``, ``specs (G, 3, p)``. float32 only.
+    """
+    if x.dtype != jnp.float32:
+        raise ValueError(f"pallas white kernel is float32-only, got {x.dtype}")
+    G, C, p = x.shape
+    n = az.shape[-1]
+    S, K = dx.shape[-3], dx.shape[-2]
+    P = _round_up(p, 128)
+    N = _round_up(n, 128)
+    SK = _round_up(S * K, 128)
+    SP = _round_up(S, 128)
+    # VMEM budget: the (tile, N) likelihood buffers PLUS the per-tile
+    # draw blocks ((2K-1)*S, tile, P) that the fori_loop dynamic-
+    # indexes, cap ~4 MB (same ceiling as the single-try kernel).
+    tile = chain_tile or int_from_env("GST_WHITE_TILE", 256)
+    per_chain = (6 * N + (2 * K - 1) * S * P + SK + SP) * 4
+    while tile > 8 and tile * per_chain > 4 * 2 ** 20:
+        tile //= 2
+    tile = max(8, min(tile, _round_up(C, 8)))
+    xp_, azp, y2p, rows, specs, pad_chains, flat, (P, N, R, Cp) = (
+        _prep_grouped(x, az, yred2, rows, specs, tile))
+    tpg = Cp // tile
+    # (S*K, G*Cp, P) / (S*(K-1), G*Cp, P): step-major untiled leading
+    # axes for the in-kernel dynamic indexing
+    dxp = jnp.moveaxis(
+        flat(pad_chains(_pad_lanes(dx, P))).reshape(
+            G * Cp, S * K, P), 1, 0)
+    dxrp = jnp.moveaxis(
+        flat(pad_chains(_pad_lanes(dxr, P))).reshape(
+            G * Cp, S * (K - 1), P), 1, 0)
+    gup = flat(pad_chains(_pad_lanes(
+        gumb.reshape(G, C, S * K), SK)))
+    lup = flat(pad_chains(_pad_lanes(logu, SP)))
+
+    kwargs = {}
+    if _HAVE_PLTPU:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel",))
+    kernel = functools.partial(_white_mtm_kernel, nsteps=S, K=K, p=p,
+                               var=var)
+    xo, ao = pl.pallas_call(
+        kernel,
+        grid=(G * tpg,),
+        in_specs=[
+            _spec((tile, P), lambda g: (g, 0)),
+            _spec((tile, N), lambda g: (g, 0)),
+            _spec((tile, N), lambda g: (g, 0)),
+            _spec((S * K, tile, P), lambda g: (0, g, 0)),
+            _spec((S * (K - 1), tile, P), lambda g: (0, g, 0)),
+            _spec((tile, SK), lambda g: (g, 0)),
+            _spec((tile, SP), lambda g: (g, 0)),
+            _spec((1, R, N), lambda g: (g // tpg, 0, 0)),
+            _spec((1, 8, P), lambda g: (g // tpg, 0, 0)),
+        ],
+        out_specs=[
+            _spec((tile, P), lambda g: (g, 0)),
+            _spec((tile, 8), lambda g: (g, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((G * Cp, P), jnp.float32),
+            jax.ShapeDtypeStruct((G * Cp, 8), jnp.float32),
+        ],
+        interpret=interpret,
+        **kwargs,
+    )(xp_, azp, y2p, dxp, dxrp, gup, lup, rows, specs)
     xo = xo.reshape(G, Cp, P)[:, :C, :p]
     ao = ao.reshape(G, Cp, 8)[:, :C, 0] / S
     return xo, ao
@@ -484,4 +721,46 @@ def make_white_block(var: Tuple[Tuple[int, int, int], ...]):
                                  var)
 
     block.def_vmap(consts_batch_vmap(block, n_data=5))
+    return block
+
+
+def make_white_mtm_block(var: Tuple[Tuple[int, int, int], ...]):
+    """Build the dispatched white-MTM block for one model STRUCTURE —
+    ``block(x, az, yred2, dx, dxr, gumb, logu, rows, specs) ->
+    (x_new, acc_rate)``, the multiple-try twin of
+    :func:`make_white_block` (same custom_vmap constants batching,
+    same ``GST_PALLAS_WHITE`` gate, XLA fallback
+    :func:`white_mtm_loop_xla`)."""
+
+    @custom_vmap
+    def block(x, az, yred2, dx, dxr, gumb, logu, rows, specs):
+        enabled, interp, forced = _pallas_white_mode()
+        grouped = rows.ndim == 3
+        batch = x.shape[:-1]
+        B = int(np.prod(batch)) if batch else 1
+        base_ok = (_HAVE_PLTPU and x.dtype == jnp.float32
+                   and az.shape[-1] <= MAX_PALLAS_N
+                   and (forced or B >= _MIN_BATCH))
+        if grouped:
+            if (enabled and base_ok and x.ndim == 3
+                    and rows.shape[0] == x.shape[0]):
+                return white_mtm_fused(x, az, yred2, dx, dxr, gumb,
+                                       logu, rows, specs, var,
+                                       interpret=interp)
+        elif rows.ndim == 2:
+            if enabled and base_ok and x.ndim >= 2:
+                p = x.shape[-1]
+                n = az.shape[-1]
+                S, K = dx.shape[-3], dx.shape[-2]
+                xf, acc = white_mtm_fused(
+                    x.reshape(1, B, p), az.reshape(1, B, n),
+                    yred2.reshape(1, B, n), dx.reshape(1, B, S, K, p),
+                    dxr.reshape(1, B, S, K - 1, p),
+                    gumb.reshape(1, B, S, K), logu.reshape(1, B, S),
+                    rows[None], specs[None], var, interpret=interp)
+                return xf.reshape(batch + (p,)), acc.reshape(batch)
+        return white_mtm_loop_xla(x, az, yred2, dx, dxr, gumb, logu,
+                                  rows, specs, var)
+
+    block.def_vmap(consts_batch_vmap(block, n_data=7))
     return block

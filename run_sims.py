@@ -197,6 +197,10 @@ def main(argv=None):
                     help="jax backend: multiple-try Metropolis with K "
                          "candidates per MH step (MHConfig.mtm_tries). "
                          "0 = the reference's single-try kernel")
+    ap.add_argument("--mtm-blocks", nargs="+",
+                    default=["white", "hyper"],
+                    choices=("white", "hyper"),
+                    help="which MH blocks go multiple-try under --mtm")
     ap.add_argument("--until-rhat", type=float, default=0.0,
                     metavar="TARGET",
                     help="jax backend: stop each config once every "
@@ -242,6 +246,8 @@ def main(argv=None):
     if args.min_ess and not args.until_rhat:
         ap.error("--min-ess composes with --until-rhat (it is an extra "
                  "stopping criterion, not a standalone mode)")
+    if set(args.mtm_blocks) != {"white", "hyper"} and not args.mtm:
+        ap.error("--mtm-blocks requires --mtm K")
     if args.mtm and args.backend != "jax":
         ap.error("--mtm is a jax-backend feature; the NumPy oracle "
                  "keeps the reference's single-try kernel")
@@ -280,7 +286,8 @@ def main(argv=None):
                                        adapt_cov=args.adapt_cov)
                        for k, v in all_configs.items()}
     if args.mtm:
-        all_configs = {k: v.with_mtm(args.mtm)
+        all_configs = {k: v.with_mtm(args.mtm,
+                                     blocks=tuple(args.mtm_blocks))
                        for k, v in all_configs.items()}
     configs = {k: v for k, v in all_configs.items() if k in args.models}
 

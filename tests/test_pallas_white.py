@@ -276,6 +276,100 @@ def test_auto_mode_stays_on_loop_on_cpu(monkeypatch):
     assert not enabled
 
 
+def _rand_mtm_inputs(ma, C, S=5, K=3, seed=1):
+    rng = np.random.default_rng(seed)
+    x, az, yred2, _, _ = _rand_inputs(ma, C, S=1, seed=seed)
+    p = ma.nparam
+    white = ma.white_indices
+
+    def jump_batch(m):
+        pars = rng.integers(0, len(white), m)
+        jumps = rng.standard_normal(m).astype(np.float32) * 0.3
+        dx = np.zeros((m, p), np.float32)
+        dx[np.arange(m), np.asarray(white)[pars]] = jumps
+        return dx
+
+    dx = np.stack([jump_batch(S * K) for _ in range(C)]).reshape(
+        C, S, K, p)
+    dxr = np.stack([jump_batch(S * (K - 1)) for _ in range(C)]).reshape(
+        C, S, K - 1, p)
+    gumb = rng.gumbel(size=(C, S, K)).astype(np.float32)
+    logu = np.log(rng.uniform(size=(C, S))).astype(np.float32)
+    return (x, az, yred2, jnp.asarray(dx), jnp.asarray(dxr),
+            jnp.asarray(gumb), jnp.asarray(logu))
+
+
+def test_mtm_kernel_matches_xla_loop():
+    """The fused white-MTM kernel (interpret) must reproduce the XLA
+    MTM twin on identical precomputed draws — selection, weight-sum
+    acceptance, and acceptance counting."""
+    from gibbs_student_t_tpu.ops.pallas_white import (
+        white_mtm_fused, white_mtm_loop_xla)
+
+    ma = _varying_efac_ma()
+    wc = build_white_consts(ma)
+    args = _rand_mtm_inputs(ma, C=9, seed=21)
+    x0, a0 = white_mtm_loop_xla(*args, wc.rows, wc.specs, wc.var)
+    x1, a1 = white_mtm_fused(
+        *(a[None] for a in args), jnp.asarray(wc.rows)[None],
+        jnp.asarray(wc.specs)[None], wc.var, chain_tile=8,
+        interpret=True)
+    np.testing.assert_allclose(np.asarray(x1[0]), np.asarray(x0),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(a1[0]), np.asarray(a0))
+
+
+def test_mtm_grouped_kernel_matches_per_group_loop():
+    from gibbs_student_t_tpu.ops.pallas_white import (
+        white_mtm_fused, white_mtm_loop_xla)
+
+    G, C = 2, 6
+    mas = [make_demo_model_arrays(n=24, components=4, seed=60 + g)
+           for g in range(G)]
+    wcs = [build_white_consts(ma) for ma in mas]
+    per = [_rand_mtm_inputs(ma, C=C, seed=70 + g)
+           for g, ma in enumerate(mas)]
+    grouped = tuple(jnp.stack([p[i] for p in per]) for i in range(7))
+    rows = jnp.asarray(np.stack([wc.rows for wc in wcs]))
+    specs = jnp.asarray(np.stack([wc.specs for wc in wcs]))
+    xf, af = white_mtm_fused(*grouped, rows, specs, wcs[0].var,
+                             chain_tile=8, interpret=True)
+    for g in range(G):
+        x0, a0 = white_mtm_loop_xla(*per[g], wcs[g].rows, wcs[g].specs,
+                                    wcs[g].var)
+        np.testing.assert_allclose(np.asarray(xf[g]), np.asarray(x0),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(af[g]), np.asarray(a0))
+
+
+def test_sweep_chains_identical_mtm_fused_vs_closure(monkeypatch):
+    """Whole-sweep MTM equivalence across all THREE implementations on
+    identical keys: the validated _mtm_block closure (the reference
+    semantics, forced by disabling the fused dispatcher), the XLA
+    consts twin (kernel off), and the fused kernel (interpret)."""
+    ma = make_demo_model_arrays(n=40, components=6, seed=3)
+    cfg = GibbsConfig(model="mixture", vary_df=True,
+                      theta_prior="beta").with_mtm(3, blocks=("white",))
+
+    def run(flag, force_closure=False):
+        monkeypatch.setenv("GST_PALLAS_WHITE", flag)
+        gb = JaxGibbs(ma, cfg, nchains=6, chunk_size=5, record="full")
+        assert gb._white_mtm_block is not None
+        if force_closure:
+            gb._white_mtm_block = None  # dispatch falls to _mtm_block
+        return gb.sample(niter=10, seed=0)
+
+    rc = run("0", force_closure=True)   # _mtm_block closure reference
+    r0 = run("0")                       # white_mtm_loop_xla twin
+    r1 = run("interpret")               # fused kernel
+    for r in (r0, r1):
+        np.testing.assert_allclose(np.asarray(r.chain),
+                                   np.asarray(rc.chain),
+                                   rtol=2e-3, atol=2e-3)
+        np.testing.assert_array_equal(np.asarray(r.zchain),
+                                      np.asarray(rc.zchain))
+
+
 def test_sweep_chains_identical_fused_vs_loop(monkeypatch):
     """Whole-sweep equivalence through the backend: same keys, kernel on
     (interpret) vs off. The fused path and the XLA loop consume the same

@@ -53,9 +53,17 @@ def main():
                     help="run the JAX kernel with population-covariance "
                          "adaptive proposals for the first N sweeps "
                          "(set burn-j >= N)")
+    ap.add_argument("--mtm", type=int, default=0, metavar="K",
+                    help="run the JAX kernel with multiple-try "
+                         "Metropolis (K candidates per step)")
+    ap.add_argument("--mtm-blocks", nargs="+",
+                    default=["white", "hyper"],
+                    choices=("white", "hyper"))
     args = ap.parse_args()
     if args.adapt_cov and args.burn_j < args.adapt_cov:
         ap.error("--burn-j must discard the adapting sweeps")
+    if set(args.mtm_blocks) != {"white", "hyper"} and not args.mtm:
+        ap.error("--mtm-blocks requires --mtm K")
 
     here = os.path.dirname(os.path.abspath(__file__))
     sys.path.insert(0, os.path.dirname(here))
@@ -121,6 +129,9 @@ def main():
         t0 = time.perf_counter()
         cfg_j = (cfg.with_adapt(args.adapt_cov, adapt_cov=True)
                  if args.adapt_cov else cfg)
+        if args.mtm:
+            cfg_j = cfg_j.with_mtm(args.mtm,
+                                   blocks=tuple(args.mtm_blocks))
         gb_j = JaxGibbs(ma, cfg_j, nchains=args.nchains, chunk_size=100,
                         record="compact")  # float16 pout on the wire
         res_j = gb_j.sample(niter=args.niter_j, seed=args.seed + 1)

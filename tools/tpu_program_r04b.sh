@@ -1,0 +1,37 @@
+#!/bin/bash
+# Round-4 hardware program, part B: the white-MTM stages, queued behind
+# part A's completion (tools/tpu_program_r04.sh appends "done" to its
+# log when all 8 stages have run). Same relay discipline: one client at
+# a time, fresh process per stage, nothing signals a client.
+# Launch detached:  setsid nohup bash tools/tpu_program_r04b.sh &
+set -u
+cd "$(dirname "$0")/.."
+LOG=artifacts/tpu_program_r04b.log
+say() { echo "[$(date -u +%FT%TZ)] $*" >> "$LOG"; }
+
+say "=== TPU program r04b queued (waiting for r04 done) ==="
+while ! grep -q "TPU program r04 done" artifacts/tpu_program_r04.log \
+    2>/dev/null; do
+  sleep 60
+done
+say "part A done; starting"
+
+# Stage 9: the measured best ESS/s combination on chip — adapt-cov
+# plus white-only multiple-try through the fused white-MTM kernel
+# (per-block A/B: docs/PERFORMANCE.md; +21% ESS/sweep at elementwise
+# cost). The first hardware number for the MTM kernel.
+say "stage 9: bench.py --adapt 100 --adapt-cov --mtm 4 --mtm-blocks white"
+python bench.py --adapt 100 --adapt-cov --mtm 4 --mtm-blocks white \
+  > artifacts/BENCH_ADAPTCOV_MTMW_r04.out \
+  2> artifacts/BENCH_ADAPTCOV_MTMW_r04.err
+say "stage 9 rc=$? json=$(tail -1 artifacts/BENCH_ADAPTCOV_MTMW_r04.out)"
+
+# Stage 10: distributional gate under the adapted + white-MTM kernel
+# on chip (the gate-after-kernel-change rule for the new MTM kernel).
+say "stage 10: tpu_gate.py --adapt-cov 150 --mtm 4 --mtm-blocks white"
+python tools/tpu_gate.py --adapt-cov 150 --mtm 4 --mtm-blocks white \
+  --out artifacts/tpu_gate_mtmw_r04.json \
+  > artifacts/tpu_gate_mtmw_r04.out 2>&1
+say "stage 10 rc=$?"
+
+say "=== TPU program r04b done ==="
