@@ -271,6 +271,30 @@ def test_ensemble_fused_kernels_match_closure(monkeypatch):
                                   np.asarray(r0.zchain))
 
 
+def test_ensemble_mtm_fused_matches_xla(monkeypatch):
+    """Multiple-try MH composes with ensembles: the grouped white-MTM
+    kernel (interpret) must reproduce the XLA path chain-for-chain
+    across pulsars."""
+    mas = _ensemble_mas(2, n=40, components=6)
+    cfg = GibbsConfig(model="mixture").with_mtm(3, blocks=("white",))
+
+    def run(flag):
+        monkeypatch.setenv("GST_PALLAS_WHITE", flag)
+        ens = EnsembleGibbs(mas, cfg, nchains=4, chunk_size=5,
+                            record="full")
+        assert ens.template._white_mtm_block is not None
+        assert ens._fused_consts is not None
+        return ens.sample(niter=10, seed=0)
+
+    r0 = run("0")
+    r1 = run("interpret")
+    np.testing.assert_allclose(np.asarray(r1.chain),
+                               np.asarray(r0.chain),
+                               rtol=2e-3, atol=2e-3)
+    np.testing.assert_array_equal(np.asarray(r1.zchain),
+                                  np.asarray(r0.zchain))
+
+
 def test_graft_entry_dryrun():
     """The driver-facing entry points compile and run on the fake mesh."""
     import __graft_entry__ as ge
