@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Collect every bench JSON line under artifacts/ into one table.
+
+Each ``bench.py`` run leaves exactly one JSON line in its ``.out``
+artifact; this tool greps them all (plus BENCH_r0*.json driver records)
+and prints a provenance table — metric, value, vs_baseline, platform,
+and any non-default tags (record/record_thin/adapt/mtm) — so a round's
+scattered hardware evidence reads as one summary. Pure host-side file
+parsing; never dials the relay.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+
+def rows_from(path):
+    out = []
+    try:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not (line.startswith("{") and '"metric"' in line):
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    except OSError:
+        return []
+    return out
+
+
+def main(argv=None):
+    root = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "artifacts")
+    pats = (sys.argv[1:] if argv is None else argv) or ["*"]
+    paths = sorted(set(
+        p for pat in pats
+        for p in glob.glob(os.path.join(root, f"BENCH_{pat}.out"))
+        + glob.glob(os.path.join(root, f"BENCH_{pat}.json"))))
+    tagkeys = ("record", "record_thin", "adapt_sweeps", "adapt_cov",
+               "mtm_tries", "mtm_blocks")
+    print(f"{'artifact':38s} {'platform':8s} {'value':>12s} "
+          f"{'vs_base':>8s} {'ess/s':>9s} tags")
+    for p in paths:
+        for r in rows_from(p):
+            tags = " ".join(f"{k}={r[k]}" for k in tagkeys if k in r)
+            print(f"{os.path.basename(p):38s} "
+                  f"{r.get('platform', '?'):8s} "
+                  f"{r.get('value', float('nan')):12,.1f} "
+                  f"{r.get('vs_baseline', float('nan')):8.1f} "
+                  f"{r.get('ess_log10A_per_sec', float('nan')):9.1f} "
+                  f"{tags}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
