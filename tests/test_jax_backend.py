@@ -412,6 +412,38 @@ def test_mtm_config_validation():
         GibbsConfig(model="gaussian").with_mtm(2, blocks=("red",))
 
 
+def test_z_init_semantics(ma):
+    """z_init='model' reproduces the reference init (ones for the
+    outlier/t models, reference gibbs.py:50-51); 'zeros' starts the
+    dominant all-inlier mode in BOTH backends; 't' rejects 'zeros'
+    (z == 1 is structural there)."""
+    import dataclasses
+
+    from gibbs_student_t_tpu.backends import NumpyGibbs
+
+    cfg = GibbsConfig(model="vvh17", vary_df=False,
+                      theta_prior="uniform", vary_alpha=False,
+                      alpha=1e10, pspin=0.00457)
+    assert cfg.z_init_ones
+    z0 = dataclasses.replace(cfg, z_init="zeros")
+    assert not z0.z_init_ones
+
+    gb_j = JaxGibbs(ma, z0, nchains=3, chunk_size=5)
+    st = gb_j.init_state(seed=0)
+    assert float(np.asarray(st.z).sum()) == 0.0
+    gb_j1 = JaxGibbs(ma, cfg, nchains=3, chunk_size=5)
+    st1 = gb_j1.init_state(seed=0)
+    assert float(np.asarray(st1.z).mean()) == 1.0
+
+    assert NumpyGibbs(ma, z0)._z.sum() == 0.0
+    assert NumpyGibbs(ma, cfg)._z.mean() == 1.0
+
+    with pytest.raises(ValueError, match="z_init"):
+        GibbsConfig(model="t", z_init="zeros")
+    with pytest.raises(ValueError, match="z_init"):
+        GibbsConfig(model="gaussian", z_init="sideways")
+
+
 def test_mtm_per_block_selection(ma, monkeypatch):
     """mtm_blocks routes MTM to the selected block only: with
     blocks=('hyper',), the white block must stay on the single-try
