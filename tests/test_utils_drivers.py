@@ -190,6 +190,37 @@ def test_bench_quick(tmp_path):
     line = json.loads(r.stdout.strip().splitlines()[-1])
     assert {"metric", "value", "unit", "vs_baseline"} <= set(line)
     assert line["value"] > 0
+    # the r04 default flip: adapted proposals are the production default
+    # and the JSON line is self-describing about it
+    assert line["adapt_sweeps"] == 20 and line["adapt_cov"] is True
+
+
+def test_driver_adapt_default_resolution(tmp_path):
+    """The r04 adapt default flip's resolution rules, cheaply (every
+    arm errors or no-ops before any dataset/bench work).
+
+    - explicit --adapt 0 --adapt-cov is still rejected by both drivers
+    - run_sims on the NumPy oracle backend keeps the reference's fixed
+      scales (no spurious --adapt error from the auto default)
+    """
+    r = _run_script(
+        ["/root/repo/bench.py", "--quick", "--adapt", "0",
+         "--adapt-cov"], str(tmp_path))
+    assert r.returncode != 0 and "--adapt-cov requires" in r.stderr
+    r2 = _run_script(
+        ["/root/repo/run_sims.py", "--backend", "jax", "--adapt", "0",
+         "--adapt-cov", "--simdir", str(tmp_path / "s")], str(tmp_path))
+    assert r2.returncode != 0 and "--adapt-cov requires" in r2.stderr
+    # cpu backend + auto default: must NOT trip the jax-only error
+    # (a tiny run proves the resolution picked 0 without flags)
+    r3 = _run_script(
+        ["/root/repo/run_sims.py", "--backend", "cpu", "--niter", "6",
+         "--burn", "2", "--thetas", "0.1", "--ntoa", "30",
+         "--components", "5", "--models", "gaussian",
+         "--simdir", str(tmp_path / "sim"),
+         "--outdirs", str(tmp_path / "o1"), str(tmp_path / "o2")],
+        str(tmp_path))
+    assert r3.returncode == 0, r3.stderr
 
 
 @pytest.fixture()

@@ -1,0 +1,83 @@
+#!/bin/bash
+# Round-4 diagnosis probes. Self-gating on the relay watcher's
+# .relay_alive marker (same pattern as tools/tpu_program_r04.sh), so it
+# can be queued detached while the relay is down. Priority order inside
+# a possibly-short window (~35 min last time):
+#   1. relay transfer bench — the environment snapshot that interprets
+#      every other number (compare artifacts/relay_transfer_r03.json)
+#   2. the white-MTM on-chip gate — the ONLY round-4 kernel without a
+#      hardware gate, already lost once to the 09:06 mid-window wedge;
+#      unique evidence runs before repeatable probes
+#   3. code-vs-environment A/Bs: round-3 code from the .r03_worktree vs
+#      current code, same session. Current-code arms pin --adapt 0 so
+#      the ONLY variable vs the r03 arm is the code version (the r04
+#      adapt default flip would otherwise confound the comparison).
+#   4. variance repeats + one production-default run.
+# Relay discipline: one client at a time, fresh process per stage,
+# nothing signals a client.
+set -u
+cd "$(dirname "$0")/.."
+LOG=artifacts/tpu_probe_r04.log
+say() { echo "[$(date -u +%FT%TZ)] $*" >> "$LOG"; }
+
+say "=== probe r04 queued (waiting for .relay_alive) ==="
+while [ ! -f .relay_alive ]; do
+  sleep 30
+done
+say "relay recovered: $(cat .relay_alive)"
+
+say "probe 1: relay_transfer_bench"
+python tools/relay_transfer_bench.py --out artifacts/relay_transfer_r04.json \
+  > artifacts/relay_transfer_r04.out 2>&1
+say "probe 1 rc=$?"
+
+say "probe 2: tpu_gate.py --adapt-cov 150 --mtm 4 --mtm-blocks white"
+python tools/tpu_gate.py --adapt-cov 150 --mtm 4 --mtm-blocks white \
+  --out artifacts/tpu_gate_mtmw_r04.json \
+  > artifacts/tpu_gate_mtmw_r04.out 2>&1
+say "probe 2 rc=$?"
+
+say "probe 3a: round-3 code bench (worktree)"
+(cd .r03_worktree && python bench.py) \
+  > artifacts/BENCH_R03CODE_r04.out 2> artifacts/BENCH_R03CODE_r04.err
+say "probe 3a rc=$? json=$(tail -1 artifacts/BENCH_R03CODE_r04.out)"
+
+say "probe 3b: current code bench --adapt 0 (same semantics as 3a)"
+python bench.py --adapt 0 \
+  > artifacts/BENCH_R04CODE_NOADAPT_r04.out \
+  2> artifacts/BENCH_R04CODE_NOADAPT_r04.err
+say "probe 3b rc=$? json=$(tail -1 artifacts/BENCH_R04CODE_NOADAPT_r04.out)"
+
+# Same-session kernel A/B: r03 vs r04 fused_ab back to back — the only
+# transport-variance-proof comparison of the grouped-kernel refactor.
+say "probe 3c: fused_ab current code"
+python tools/fused_ab.py --out artifacts/fused_ab_r04b.json \
+  > artifacts/fused_ab_r04b.out 2>&1
+say "probe 3c rc=$?"
+say "probe 3d: fused_ab round-3 code (worktree)"
+(cd .r03_worktree && python tools/fused_ab.py \
+  --out ../artifacts/fused_ab_r03code.json) \
+  > artifacts/fused_ab_r03code.out 2>&1
+say "probe 3d rc=$?"
+
+# Localize the ensemble 2x: same bench with the fused kernels OFF. If
+# the closure-path ensemble is also ~2x slower than single-model, the
+# overhead is structural (vmap/shard_map/record), not the grouped grid.
+say "probe 3e: ensemble_bench kernels off"
+GST_PALLAS_WHITE=0 GST_PALLAS_HYPER=0 \
+python tools/ensemble_bench.py --pulsars 4 --nchains 256 \
+  --out artifacts/ENSEMBLE_BENCH_OFF_r04.json \
+  > artifacts/ENSEMBLE_BENCH_OFF_r04.out 2>&1
+say "probe 3e rc=$?"
+
+for i in 1 2; do
+  say "probe 4.$i: bench.py --adapt 0 variance repeat"
+  python bench.py --adapt 0 \
+    > artifacts/BENCH_VAR${i}_r04.out 2> artifacts/BENCH_VAR${i}_r04.err
+  say "probe 4.$i rc=$? json=$(tail -1 artifacts/BENCH_VAR${i}_r04.out)"
+done
+say "probe 4.3: bench.py production default (adapted)"
+python bench.py \
+  > artifacts/BENCH_VAR3_r04.out 2> artifacts/BENCH_VAR3_r04.err
+say "probe 4.3 rc=$? json=$(tail -1 artifacts/BENCH_VAR3_r04.out)"
+say "=== probe r04 done ==="
