@@ -187,11 +187,14 @@ def main(argv=None):
     ap.add_argument("--adapt", type=int, default=None, metavar="N",
                     help="adapt MH jump scales for the first N sweeps "
                          "(jax backend; Robbins-Monro, then frozen — set "
-                         "--burn to at least N rows). Default: 100 on "
-                         "the jax backend (the r04 default flip: "
-                         "adapted proposals are gate-green and buy "
-                         "x1.92 ESS/sweep on chip for free), 0 on the "
-                         "NumPy oracle = the reference's fixed scales")
+                         "--burn to at least N rows). Default on the "
+                         "jax backend: min(100, burn*record_thin), i.e. "
+                         "adaptation capped to fit inside the burn "
+                         "window so kept rows are always post-freeze "
+                         "(the r04 default flip: adapted proposals are "
+                         "gate-green and buy x1.92 ESS/sweep on chip "
+                         "for free); 0 on the NumPy oracle = the "
+                         "reference's fixed scales")
     ap.add_argument("--adapt-cov", default=None,
                     action=argparse.BooleanOptionalAction,
                     help="with --adapt: population-covariance joint "

@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Attribute the ensemble-vs-single per-chain-sweep gap on chip.
+
+`tools/ensemble_bench.py` measured single/ensemble = 2.0 at equal total
+chains on hardware (artifacts/ENSEMBLE_BENCH_r04.json) where the CPU
+smoke said 1.03. This tool separates the candidate causes with pure
+DEVICE timings (block_until_ready around a jitted multi-sweep step; no
+record transport, so relay variance cannot contaminate the comparison):
+
+  arm single   JaxGibbs at C total chains — baked-constant flagship path
+  arm ens_p1   EnsembleGibbs P=1 x C — traced constants, grouped
+               kernels at G=1, no real multi-pulsar work
+  arm ens_p4   EnsembleGibbs P=4 x C/4 — the measured config-5 shape
+  each x {kernels on, kernels off} (GST_PALLAS_WHITE/HYPER, trace-time)
+
+Reading the table: ens_p1/single isolates the traced-consts + grouped
+machinery cost; ens_p4/ens_p1 isolates the true multi-group cost;
+kernels-off rows tell whether the gap lives in the fused MH kernels or
+in the rest of the sweep (TNT/chol/conditionals). Writes one JSON.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+@contextlib.contextmanager
+def env_flags(white, hyper):
+    prev = {k: os.environ.get(k)
+            for k in ("GST_PALLAS_WHITE", "GST_PALLAS_HYPER")}
+    os.environ["GST_PALLAS_WHITE"] = white
+    os.environ["GST_PALLAS_HYPER"] = hyper
+    try:
+        yield
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="artifacts/ensemble_attrib_r04.json")
+    ap.add_argument("--pulsars", type=int, default=4)
+    ap.add_argument("--nchains", type=int, default=1024,
+                    help="TOTAL chains, split across pulsars in ens arms")
+    ap.add_argument("--ntoa", type=int, default=500)
+    ap.add_argument("--components", type=int, default=20)
+    ap.add_argument("--sweeps", type=int, default=20,
+                    help="sweeps per timed step call")
+    ap.add_argument("--reps", type=int, default=10,
+                    help="reps per arm, inside ONE scan dispatch")
+    ap.add_argument("--model", default="beta")
+    args = ap.parse_args()
+
+    import jax
+    from jax import random
+
+    out: dict = {"config": vars(args)}
+
+    def flush():
+        with open(args.out, "w") as fh:
+            json.dump(out, fh, indent=1)
+
+    t0 = time.perf_counter()
+    out["device"] = str(jax.devices())
+    out["backend"] = jax.default_backend()
+    print(f"[liveness] {out['device']} ({time.perf_counter() - t0:.1f}s)",
+          flush=True)
+    flush()
+
+    from gibbs_student_t_tpu.backends import JaxGibbs
+    from gibbs_student_t_tpu.data.demo import make_demo_model_arrays
+    from gibbs_student_t_tpu.parallel import EnsembleGibbs
+    from run_sims import model_configs
+
+    cfg = model_configs()[args.model]
+    mas = [make_demo_model_arrays(n=args.ntoa, components=args.components,
+                                  seed=100 + i)
+           for i in range(args.pulsars)]
+    C, P = args.nchains, args.pulsars
+
+    from tools.benchlib import timed_scan
+
+    # reps ride INSIDE one lax.scan dispatch (benchlib.timed_scan, the
+    # same helper fused_ab/tpu_microbench use), so the relay's ~65 ms
+    # per-dispatch latency is paid once per arm, not once per rep —
+    # otherwise it skews each arm's ratio by a different fraction
+    def time_single(nchains):
+        gb = JaxGibbs(mas[0], cfg, nchains=nchains, chunk_size=args.sweeps)
+        st = gb.init_state(seed=0)
+        keys = random.split(random.PRNGKey(0), nchains)
+        ms, _ = timed_scan(
+            lambda: gb._chunk_fn(st, keys, 0, length=args.sweeps),
+            args.reps)
+        return args.sweeps * nchains / (ms / 1e3)
+
+    def time_ens(npulsars, per_chains):
+        ens = EnsembleGibbs(mas[:npulsars], cfg, nchains=per_chains,
+                            chunk_size=args.sweeps)
+        st = ens.init_state(seed=0)
+        keys = ens.chain_keys(seed=0)
+        ms, _ = timed_scan(
+            lambda: ens._step(st, keys, 0, length=args.sweeps),
+            args.reps)
+        return args.sweeps * npulsars * per_chains / (ms / 1e3)
+
+    for combo, tag in ((("auto", "auto"), "on"), (("0", "0"), "off")):
+        with env_flags(*combo):
+            row = {}
+            row["single"] = round(time_single(C), 1)
+            print(f"[{tag}] single {row['single']:.0f} ch-sw/s", flush=True)
+            row["ens_p1"] = round(time_ens(1, C), 1)
+            print(f"[{tag}] ens_p1 {row['ens_p1']:.0f} ch-sw/s", flush=True)
+            row["ens_p4"] = round(time_ens(P, C // P), 1)
+            print(f"[{tag}] ens_p4 {row['ens_p4']:.0f} ch-sw/s", flush=True)
+            row["single_over_ens_p1"] = round(row["single"] / row["ens_p1"],
+                                              3)
+            row["single_over_ens_p4"] = round(row["single"] / row["ens_p4"],
+                                              3)
+            out[f"kernels_{tag}"] = row
+            flush()
+
+    print(f"[done] -> {args.out}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,11 +20,19 @@ cd "$(dirname "$0")/.."
 LOG=artifacts/tpu_probe_r04.log
 say() { echo "[$(date -u +%FT%TZ)] $*" >> "$LOG"; }
 
-say "=== probe r04 queued (waiting for .relay_alive) ==="
-while [ ! -f .relay_alive ]; do
+say "=== probe r04 queued (waiting for a FRESH .relay_alive) ==="
+# The watcher writes .relay_alive once on recovery and exits; nothing
+# removes it when the relay wedges again (which it did at 09:06 this
+# round). Gate on marker AGE so a stale marker from a long-dead window
+# cannot fire the probes into a dead relay.
+while :; do
+  if [ -f .relay_alive ]; then
+    age=$(( $(date +%s) - $(stat -c %Y .relay_alive) ))
+    [ "$age" -le 1800 ] && break
+  fi
   sleep 30
 done
-say "relay recovered: $(cat .relay_alive)"
+say "relay recovered: $(cat .relay_alive) (marker age ${age}s)"
 
 say "probe 1: relay_transfer_bench"
 python tools/relay_transfer_bench.py --out artifacts/relay_transfer_r04.json \
@@ -69,6 +77,14 @@ python tools/ensemble_bench.py --pulsars 4 --nchains 256 \
   --out artifacts/ENSEMBLE_BENCH_OFF_r04.json \
   > artifacts/ENSEMBLE_BENCH_OFF_r04.out 2>&1
 say "probe 3e rc=$?"
+
+# Pure-device attribution of the ensemble gap (no record transport):
+# single vs ens P=1 vs ens P=4 at equal total chains, kernels on/off.
+say "probe 3f: ensemble_attrib.py"
+python tools/ensemble_attrib.py \
+  --out artifacts/ensemble_attrib_r04.json \
+  > artifacts/ensemble_attrib_r04.out 2>&1
+say "probe 3f rc=$?"
 
 for i in 1 2; do
   say "probe 4.$i: bench.py --adapt 0 variance repeat"
