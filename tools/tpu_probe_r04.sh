@@ -1,99 +1,134 @@
 #!/bin/bash
-# Round-4 diagnosis probes. Self-gating on the relay watcher's
-# .relay_alive marker (same pattern as tools/tpu_program_r04.sh), so it
-# can be queued detached while the relay is down. Priority order inside
-# a possibly-short window (~35 min last time):
+# Round-4 diagnosis probes — multi-window capable. Self-gating on the
+# relay watcher's .relay_alive marker (age <= 30 min, so a stale marker
+# from a dead window can't fire probes into a dead relay). Each stage
+# is done when its expected OUTPUT artifact has been freshly written
+# (NOT rc==0: tpu_gate.py exits 1 on a statistical gate FAIL, which is
+# still captured evidence); on the first incomplete stage the pass
+# breaks immediately (a failure means the window closed — running the
+# remaining stages would burn ~25 min each against a dead relay), the
+# watcher is re-armed, and the next window retries only the UNFINISHED
+# stages, up to 6 windows. Priority order inside a possibly-short
+# (~35 min) window:
 #   1. relay transfer bench — the environment snapshot that interprets
 #      every other number (compare artifacts/relay_transfer_r03.json)
 #   2. the white-MTM on-chip gate — the ONLY round-4 kernel without a
-#      hardware gate, already lost once to the 09:06 mid-window wedge;
-#      unique evidence runs before repeatable probes
-#   3. code-vs-environment A/Bs: round-3 code from the .r03_worktree vs
-#      current code, same session. Current-code arms pin --adapt 0 so
-#      the ONLY variable vs the r03 arm is the code version (the r04
-#      adapt default flip would otherwise confound the comparison).
-#   4. variance repeats + one production-default run.
+#      hardware gate, already lost once to the 09:06 mid-window wedge
+#   3. code-vs-environment A/Bs: round-3 code from .r03_worktree vs
+#      current code pinned to --adapt 0 (so the r04 adapt default flip
+#      can't confound the comparison), fused_ab both trees,
+#      kernels-off ensemble, pure-device ensemble_attrib
+#   4. variance repeats + one production-default run
 # Relay discipline: one client at a time, fresh process per stage,
-# nothing signals a client.
+# nothing signals a client. NEVER edit this file while a detached
+# instance is running — bash reads scripts lazily by byte offset.
 set -u
 cd "$(dirname "$0")/.."
 LOG=artifacts/tpu_probe_r04.log
 say() { echo "[$(date -u +%FT%TZ)] $*" >> "$LOG"; }
 
-say "=== probe r04 queued (waiting for a FRESH .relay_alive) ==="
-# The watcher writes .relay_alive once on recovery and exits; nothing
-# removes it when the relay wedges again (which it did at 09:06 this
-# round). Gate on marker AGE so a stale marker from a long-dead window
-# cannot fire the probes into a dead relay.
-while :; do
-  if [ -f .relay_alive ]; then
-    age=$(( $(date +%s) - $(stat -c %Y .relay_alive) ))
-    [ "$age" -le 1800 ] && break
+wait_fresh_marker() {
+  # block until .relay_alive exists and is <= 30 min old; restart the
+  # watcher if it is not running (it exits after each success)
+  while :; do
+    if [ -f .relay_alive ]; then
+      local age=$(( $(date +%s) - $(stat -c %Y .relay_alive) ))
+      if [ "$age" -le 1800 ]; then
+        say "relay marker fresh (age ${age}s)"
+        return 0
+      fi
+    fi
+    if ! pgrep -f "relay_watch.py" > /dev/null 2>&1; then
+      rm -f .relay_alive
+      say "watcher not running; restarting relay_watch.py"
+      setsid nohup python tools/relay_watch.py > /dev/null 2>&1 &
+    fi
+    sleep 60
+  done
+}
+
+# run_stage <name> <expect_file> <cmd...>: skip if already done-marked;
+# run; done iff <expect_file> is newer than the stage start AND holds
+# JSON (completion = evidence written, regardless of rc — tpu_gate.py
+# exits 1 on a statistical FAIL verdict, which is still evidence; a
+# redirect-created empty .out from an aborted bench is NOT). Returns 1
+# on an incomplete stage so the caller's && chain breaks the pass.
+run_stage() {
+  local name="$1" expect="$2"; shift 2
+  local done_mark="artifacts/.probe_done_${name}"
+  [ -f "$done_mark" ] && return 0
+  local t0
+  t0=$(date +%s)
+  say "stage ${name}: $*"
+  "$@"
+  local rc=$?
+  if [ -f "$expect" ] && [ "$(stat -c %Y "$expect")" -ge "$t0" ] \
+      && grep -q "{" "$expect"; then
+    say "stage ${name} complete (rc=${rc}, ${expect} written)"
+    touch "$done_mark"
+    return 0
   fi
-  sleep 30
+  say "stage ${name} INCOMPLETE (rc=${rc}); assuming window closed"
+  return 1
+}
+
+say "=== probe r04 queued (multi-window) ==="
+for window in 1 2 3 4 5 6; do
+  wait_fresh_marker
+  say "--- window ${window} ---"
+
+  run_stage transfer artifacts/relay_transfer_r04.json \
+    bash -c "python tools/relay_transfer_bench.py \
+      --out artifacts/relay_transfer_r04.json \
+      > artifacts/relay_transfer_r04.out 2>&1" &&
+  run_stage mtmw_gate artifacts/tpu_gate_mtmw_r04.json \
+    bash -c "python tools/tpu_gate.py --adapt-cov 150 --mtm 4 \
+      --mtm-blocks white --out artifacts/tpu_gate_mtmw_r04.json \
+      > artifacts/tpu_gate_mtmw_r04.out 2>&1" &&
+  run_stage bench_r03code artifacts/BENCH_R03CODE_r04.out \
+    bash -c "cd .r03_worktree && python bench.py \
+      > ../artifacts/BENCH_R03CODE_r04.out \
+      2> ../artifacts/BENCH_R03CODE_r04.err && \
+      grep -q '\"metric\"' ../artifacts/BENCH_R03CODE_r04.out" &&
+  run_stage bench_noadapt artifacts/BENCH_R04CODE_NOADAPT_r04.out \
+    bash -c "python bench.py \
+      --adapt 0 > artifacts/BENCH_R04CODE_NOADAPT_r04.out \
+      2> artifacts/BENCH_R04CODE_NOADAPT_r04.err && \
+      grep -q '\"metric\"' artifacts/BENCH_R04CODE_NOADAPT_r04.out" &&
+  run_stage fused_ab_r04 artifacts/fused_ab_r04b.json \
+    bash -c "python tools/fused_ab.py \
+      --out artifacts/fused_ab_r04b.json \
+      > artifacts/fused_ab_r04b.out 2>&1" &&
+  run_stage fused_ab_r03code artifacts/fused_ab_r03code.json \
+    bash -c "cd .r03_worktree && python tools/fused_ab.py \
+      --out ../artifacts/fused_ab_r03code.json \
+      > ../artifacts/fused_ab_r03code.out 2>&1" &&
+  run_stage ensemble_off artifacts/ENSEMBLE_BENCH_OFF_r04.json \
+    bash -c "GST_PALLAS_WHITE=0 GST_PALLAS_HYPER=0 \
+      python tools/ensemble_bench.py --pulsars 4 --nchains 256 \
+      --out artifacts/ENSEMBLE_BENCH_OFF_r04.json \
+      > artifacts/ENSEMBLE_BENCH_OFF_r04.out 2>&1" &&
+  run_stage ensemble_attrib artifacts/ensemble_attrib_r04.json \
+    bash -c "python tools/ensemble_attrib.py \
+      --out artifacts/ensemble_attrib_r04.json \
+      > artifacts/ensemble_attrib_r04.out 2>&1" &&
+  run_stage bench_var1 artifacts/BENCH_VAR1_r04.out \
+    bash -c "python bench.py --adapt 0 \
+      > artifacts/BENCH_VAR1_r04.out 2> artifacts/BENCH_VAR1_r04.err && \
+      grep -q '\"metric\"' artifacts/BENCH_VAR1_r04.out" &&
+  run_stage bench_var2 artifacts/BENCH_VAR2_r04.out \
+    bash -c "python bench.py --adapt 0 \
+      > artifacts/BENCH_VAR2_r04.out 2> artifacts/BENCH_VAR2_r04.err && \
+      grep -q '\"metric\"' artifacts/BENCH_VAR2_r04.out" &&
+  run_stage bench_default artifacts/BENCH_VAR3_r04.out \
+    bash -c "python bench.py \
+      > artifacts/BENCH_VAR3_r04.out 2> artifacts/BENCH_VAR3_r04.err && \
+      grep -q '\"metric\"' artifacts/BENCH_VAR3_r04.out" &&
+  { say "=== probe r04 done (window ${window}) ==="; exit 0; }
+
+  # a stage came up incomplete: stale-ify the marker so the next pass
+  # demands a NEW recovery before retrying the unfinished stages
+  touch -d '1 hour ago' .relay_alive 2>/dev/null || rm -f .relay_alive
+  say "window ${window} ended with unfinished stages; re-arming"
 done
-say "relay recovered: $(cat .relay_alive) (marker age ${age}s)"
-
-say "probe 1: relay_transfer_bench"
-python tools/relay_transfer_bench.py --out artifacts/relay_transfer_r04.json \
-  > artifacts/relay_transfer_r04.out 2>&1
-say "probe 1 rc=$?"
-
-say "probe 2: tpu_gate.py --adapt-cov 150 --mtm 4 --mtm-blocks white"
-python tools/tpu_gate.py --adapt-cov 150 --mtm 4 --mtm-blocks white \
-  --out artifacts/tpu_gate_mtmw_r04.json \
-  > artifacts/tpu_gate_mtmw_r04.out 2>&1
-say "probe 2 rc=$?"
-
-say "probe 3a: round-3 code bench (worktree)"
-(cd .r03_worktree && python bench.py) \
-  > artifacts/BENCH_R03CODE_r04.out 2> artifacts/BENCH_R03CODE_r04.err
-say "probe 3a rc=$? json=$(tail -1 artifacts/BENCH_R03CODE_r04.out)"
-
-say "probe 3b: current code bench --adapt 0 (same semantics as 3a)"
-python bench.py --adapt 0 \
-  > artifacts/BENCH_R04CODE_NOADAPT_r04.out \
-  2> artifacts/BENCH_R04CODE_NOADAPT_r04.err
-say "probe 3b rc=$? json=$(tail -1 artifacts/BENCH_R04CODE_NOADAPT_r04.out)"
-
-# Same-session kernel A/B: r03 vs r04 fused_ab back to back — the only
-# transport-variance-proof comparison of the grouped-kernel refactor.
-say "probe 3c: fused_ab current code"
-python tools/fused_ab.py --out artifacts/fused_ab_r04b.json \
-  > artifacts/fused_ab_r04b.out 2>&1
-say "probe 3c rc=$?"
-say "probe 3d: fused_ab round-3 code (worktree)"
-(cd .r03_worktree && python tools/fused_ab.py \
-  --out ../artifacts/fused_ab_r03code.json) \
-  > artifacts/fused_ab_r03code.out 2>&1
-say "probe 3d rc=$?"
-
-# Localize the ensemble 2x: same bench with the fused kernels OFF. If
-# the closure-path ensemble is also ~2x slower than single-model, the
-# overhead is structural (vmap/shard_map/record), not the grouped grid.
-say "probe 3e: ensemble_bench kernels off"
-GST_PALLAS_WHITE=0 GST_PALLAS_HYPER=0 \
-python tools/ensemble_bench.py --pulsars 4 --nchains 256 \
-  --out artifacts/ENSEMBLE_BENCH_OFF_r04.json \
-  > artifacts/ENSEMBLE_BENCH_OFF_r04.out 2>&1
-say "probe 3e rc=$?"
-
-# Pure-device attribution of the ensemble gap (no record transport):
-# single vs ens P=1 vs ens P=4 at equal total chains, kernels on/off.
-say "probe 3f: ensemble_attrib.py"
-python tools/ensemble_attrib.py \
-  --out artifacts/ensemble_attrib_r04.json \
-  > artifacts/ensemble_attrib_r04.out 2>&1
-say "probe 3f rc=$?"
-
-for i in 1 2; do
-  say "probe 4.$i: bench.py --adapt 0 variance repeat"
-  python bench.py --adapt 0 \
-    > artifacts/BENCH_VAR${i}_r04.out 2> artifacts/BENCH_VAR${i}_r04.err
-  say "probe 4.$i rc=$? json=$(tail -1 artifacts/BENCH_VAR${i}_r04.out)"
-done
-say "probe 4.3: bench.py production default (adapted)"
-python bench.py \
-  > artifacts/BENCH_VAR3_r04.out 2> artifacts/BENCH_VAR3_r04.err
-say "probe 4.3 rc=$? json=$(tail -1 artifacts/BENCH_VAR3_r04.out)"
-say "=== probe r04 done ==="
+say "=== probe r04 gave up after 6 windows ==="
