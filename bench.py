@@ -419,6 +419,7 @@ def main(argv=None):
         args.niter, args.chunk = 20, 10
         args.baseline_sweeps = 3
         record = "light"
+    adapt_was_auto = args.adapt is None
     if args.adapt is None:
         # production default: adapted proposals (x1.92 ESS/sweep on chip
         # at no sweep-rate cost, gate-green — the r04 default-flip A/B);
@@ -431,6 +432,18 @@ def main(argv=None):
     # minutes (3x300s probe + watchdog children) before erroring
     if args.adapt_cov and not args.adapt:
         ap.error("--adapt-cov requires --adapt N")
+    # bench_jax warms up exactly ONE chunk and times sweeps
+    # [chunk, chunk+niter): adapting sweeps inside the timed window would
+    # bias ess_log10A_per_sec with pre-freeze Robbins-Monro moves and
+    # adapt_cov chunk-boundary recomputes (ADVICE r4). The auto default
+    # is capped to the chunk; an explicit over-long --adapt is an error.
+    if args.adapt > args.chunk:
+        if adapt_was_auto:
+            args.adapt = args.chunk
+        else:
+            ap.error(f"--adapt {args.adapt} exceeds the warmup chunk "
+                     f"({args.chunk}); adaptation must freeze before "
+                     "the timed window (raise --chunk or lower --adapt)")
     if set(args.mtm_blocks) != {"white", "hyper"} and not args.mtm:
         ap.error("--mtm-blocks requires --mtm K")
     if args.record is not None:
@@ -544,14 +557,21 @@ def main(argv=None):
 
     from gibbs_student_t_tpu.config import GibbsConfig
 
-    cfg = GibbsConfig(model=args.model, vary_df=True, theta_prior="beta")
+    cfg_base = GibbsConfig(model=args.model, vary_df=True,
+                           theta_prior="beta")
+    cfg = cfg_base
     if args.adapt:
         cfg = cfg.with_adapt(args.adapt, adapt_cov=args.adapt_cov)
     if args.mtm:
         cfg = cfg.with_mtm(args.mtm, blocks=tuple(args.mtm_blocks))
     ma = build(args.ntoa, args.components, dataset=args.dataset)
 
-    numpy_sps, numpy_ess = bench_numpy(ma, cfg, args.baseline_sweeps)
+    # The oracle is the REFERENCE's fixed-scale sampler (reference
+    # gibbs.py:92-94,125-127 hard-codes the jump tables): pass the
+    # pre-adapt config explicitly so the baseline semantics of
+    # vs_baseline/vs_baseline_ess cannot drift if NumpyGibbs ever grows
+    # adaptation support or config validation (ADVICE r4).
+    numpy_sps, numpy_ess = bench_numpy(ma, cfg_base, args.baseline_sweeps)
     jax_sps, jax_ess, gb = bench_jax(ma, cfg, args.nchains, args.niter,
                                      args.chunk, record=record,
                                      record_thin=args.record_thin,

@@ -1,0 +1,120 @@
+"""vvh17 metastable-trap ESCAPE pinning (VERDICT r4 weak #5 / next #7).
+
+The reference initializes z = 1 everywhere (reference gibbs.py:50-51).
+Under vvh17's fixed alpha=1e10 that start is METASTABLE on
+outlier-contaminated data: every TOA's variance is inflated by alpha,
+the coefficient draw is prior-dominated, p_in underflows and the z-draw
+posterior q -> 1 keeps z pinned (the full analysis lives on
+``GibbsConfig.z_init``).  The distributional gates deliberately start
+both backends in the dominant mode (``z_init='zeros'``) — which means a
+kernel change that DEEPENED the trap (e.g. a likelihood underflow that
+never recovers, or an f32 path that kills the red-noise-amplitude
+excursions that trigger the unflagging cascade) would pass every gate.
+
+These tests run the reference initialization itself and assert the
+escape happens inside a seed-bracketed sweep budget, on both backends:
+
+- measured escape sweeps (J1713 dataset, bench.build(130, 30)):
+  NumPy oracle ~1700 (seed 3, not within 8000 for seed 11); the f32 JAX
+  kernel escapes at sweeps ~70-150 per chain.  Budgets below carry
+  >= 2x margin over those measurements.
+
+Escape is witnessed, not assumed: the trap must actually hold early
+(z_frac == 1 over the first sweeps) before the all-inlier mode is
+reached, so the assertions fail loudly if the dynamics change in either
+direction in a way that invalidates the z_init='zeros' gate rationale.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from gibbs_student_t_tpu.backends import JaxGibbs, NumpyGibbs
+
+REF_PAR = "/root/reference/J1713+0747.par"
+REF_TIM = "/root/reference/J1713+0747.tim"
+
+pytestmark = pytest.mark.skipif(
+    not (os.path.exists(REF_PAR) and os.path.exists(REF_TIM)),
+    reason="reference J1713+0747 files not present")
+
+
+@pytest.fixture(scope="module")
+def ma():
+    """The benchmark J1713 workload (reference epochs + par, simulated
+    red noise + 10% outliers) — the same dataset the gates run on."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, root)
+    try:
+        import bench
+    finally:
+        sys.path.remove(root)
+    return bench.build(130, 30)
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, root)
+    try:
+        from run_sims import model_configs
+    finally:
+        sys.path.remove(root)
+    cfg = model_configs()["vvh17"]
+    assert cfg.z_init_ones  # the reference initialization under test
+    return cfg
+
+
+@pytest.mark.slow
+def test_oracle_escapes_reference_z_init(ma, cfg):
+    """NumPy oracle, z=1 start: trapped early, escaped and settled in
+    the dominant all-inlier mode within the bracketed budget (measured
+    escape ~1700 sweeps at this seed; budget 4000 = 2.3x margin)."""
+    niter = 4000
+    rng = np.random.default_rng(3)
+    res = NumpyGibbs(ma, cfg).sample(ma.x_init(rng), niter, seed=3)
+    zfrac = np.asarray(res.zchain, np.float64).mean(axis=1)  # (niter,)
+
+    # the trap is real: the reference init pins z == 1 at the start
+    assert zfrac[:10].min() > 0.95, (
+        "vvh17 z=1 start no longer traps — the z_init='zeros' gate "
+        f"rationale needs re-examination (early z_frac {zfrac[:10]})")
+    escape = int(np.argmax(zfrac < 0.5))
+    assert zfrac.min() < 0.5 and escape < niter, (
+        f"oracle never escaped the all-outlier mode in {niter} sweeps "
+        "(measured escape ~1700 at seed 3): the metastable trap has "
+        "deepened")
+    # settled: after escape the dominant mode holds (z_frac near the
+    # true ~10% contamination, nowhere near the trap)
+    tail = zfrac[max(escape, 3 * niter // 4):]
+    assert tail.mean() < 0.3, (
+        f"oracle escaped at sweep {escape} but did not settle "
+        f"(tail z_frac {tail.mean():.3f})")
+
+
+@pytest.mark.slow
+def test_jax_kernel_escapes_reference_z_init(ma, cfg):
+    """f32 JAX kernel, z=1 start, 16 chains: nearly all chains escape
+    well inside the budget (measured per-chain escape ~70-150 sweeps;
+    budget 800 = >5x margin).  A numerics change that deepened the trap
+    (underflow in the z posterior, dead amplitude excursions) shows up
+    here as chains still pinned at z == 1."""
+    nchains, niter = 16, 800
+    gb = JaxGibbs(ma, cfg, nchains=nchains, chunk_size=100,
+                  record="compact")
+    res = gb.sample(niter=niter, seed=7)
+    # (niter, nchains, n) -> per-chain outlier fraction per sweep
+    zfrac = np.asarray(res.zchain, np.float64).mean(axis=-1)
+
+    assert zfrac[0].min() > 0.95, (
+        f"z=1 start did not trap the kernel (sweep-0 z_frac {zfrac[0]})")
+    final = zfrac[-50:].mean(axis=0)  # (nchains,)
+    n_escaped = int((final < 0.5).sum())
+    assert n_escaped >= int(0.75 * nchains), (
+        f"only {n_escaped}/{nchains} chains escaped the all-outlier "
+        f"trap within {niter} sweeps (measured escape ~70-150): the "
+        "metastable trap has deepened under the f32 kernel")
+    # settled chains sit in the same dominant mode the gates compare
+    assert final[final < 0.5].mean() < 0.3

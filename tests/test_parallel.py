@@ -80,13 +80,53 @@ def test_ensemble_sharded_matches_unsharded():
 
     ens_mesh = EnsembleGibbs(mas, cfg, nchains=8, mesh=mesh, chunk_size=5)
     res_mesh = ens_mesh.sample(niter=10, seed=0)
-    ens_flat = EnsembleGibbs(mas, cfg, nchains=8, mesh=None, chunk_size=5)
+    # unroll=False keeps both arms on the grouped step form — this test
+    # isolates sharding; step-form equality has its own test below
+    ens_flat = EnsembleGibbs(mas, cfg, nchains=8, mesh=None, chunk_size=5,
+                             unroll=False)
     res_flat = ens_flat.sample(niter=10, seed=0)
 
     assert res_mesh.chain.shape == (10, 4, 8, 3)
     assert np.isfinite(res_mesh.chain).all()
     np.testing.assert_allclose(res_mesh.chain, res_flat.chain,
                                rtol=2e-4, atol=1e-5)
+
+
+def test_ensemble_unrolled_matches_grouped():
+    """The baked-consts UNROLLED step (per-pulsar single-model traces,
+    VERDICT r4 #1) must reproduce the grouped traced-consts step — the
+    two forms are layouts of the same math, so switching the default
+    can never change samples."""
+    mas = _ensemble_mas()
+    cfg = GibbsConfig(model="mixture")
+    ens_u = EnsembleGibbs(mas, cfg, nchains=6, chunk_size=5, unroll=True)
+    assert ens_u._unrolled
+    res_u = ens_u.sample(niter=10, seed=3)
+    ens_g = EnsembleGibbs(mas, cfg, nchains=6, chunk_size=5,
+                          unroll=False)
+    assert not ens_g._unrolled
+    res_g = ens_g.sample(niter=10, seed=3)
+    np.testing.assert_allclose(res_u.chain, res_g.chain,
+                               rtol=2e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(res_u.thetachain, np.float64),
+        np.asarray(res_g.thetachain, np.float64), rtol=2e-4, atol=1e-5)
+
+    # chain-only sharding composes with unrolling (pulsar axis size 1)
+    mesh1 = make_mesh({"pulsar": 1, "chain": 8})
+    ens_m = EnsembleGibbs(mas, cfg, nchains=8, mesh=mesh1, chunk_size=5,
+                          unroll=True)
+    assert ens_m._unrolled
+    res_m = ens_m.sample(niter=5, seed=4)
+    assert np.isfinite(res_m.chain).all()
+
+    # a pulsar-sharded mesh cannot bake per-device constants
+    mesh2 = make_mesh({"pulsar": 2, "chain": 4})
+    with pytest.raises(ValueError, match="unsharded"):
+        EnsembleGibbs(mas, cfg, nchains=8, mesh=mesh2, unroll=True)
+    # and 'auto' silently takes the grouped form there
+    assert not EnsembleGibbs(mas, cfg, nchains=8, mesh=mesh2,
+                             chunk_size=5)._unrolled
 
 
 def test_ensemble_pulsars_get_distinct_posteriors():

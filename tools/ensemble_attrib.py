@@ -7,16 +7,20 @@ smoke said 1.03. This tool separates the candidate causes with pure
 DEVICE timings (block_until_ready around a jitted multi-sweep step; no
 record transport, so relay variance cannot contaminate the comparison):
 
-  arm single   JaxGibbs at C total chains — baked-constant flagship path
-  arm ens_p1   EnsembleGibbs P=1 x C — traced constants, grouped
-               kernels at G=1, no real multi-pulsar work
-  arm ens_p4   EnsembleGibbs P=4 x C/4 — the measured config-5 shape
+  arm single       JaxGibbs at C total chains — baked-constant flagship
+  arm ens_p1_g/u   EnsembleGibbs P=1 x C — grouped traced-consts (g,
+                   the r04 path) vs unrolled baked-consts (u, the r05
+                   fix, parallel/ensemble.py unroll=True)
+  arm ens_p4_g/u   EnsembleGibbs P=4 x C/4 — the measured config-5
+                   shape, both step forms
   each x {kernels on, kernels off} (GST_PALLAS_WHITE/HYPER, trace-time)
 
-Reading the table: ens_p1/single isolates the traced-consts + grouped
-machinery cost; ens_p4/ens_p1 isolates the true multi-group cost;
-kernels-off rows tell whether the gap lives in the fused MH kernels or
-in the rest of the sweep (TNT/chol/conditionals). Writes one JSON.
+Reading the table: ens_p1_g/single isolates the traced-consts + grouped
+machinery cost; ens_p4_g/ens_p1_g isolates the true multi-group cost;
+the _u twins measure whether baked unrolling closes each gap (VERDICT
+r4 #1 done-criterion: single/ens_p4_u <= ~1.2); kernels-off rows tell
+whether the gap lives in the fused MH kernels or in the rest of the
+sweep (TNT/chol/conditionals). Writes one JSON.
 """
 
 from __future__ import annotations
@@ -76,6 +80,9 @@ def main():
     t0 = time.perf_counter()
     out["device"] = str(jax.devices())
     out["backend"] = jax.default_backend()
+    out["platform"] = jax.default_backend()
+    out["timestamp_utc"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                         time.gmtime())
     print(f"[liveness] {out['device']} ({time.perf_counter() - t0:.1f}s)",
           flush=True)
     flush()
@@ -106,9 +113,9 @@ def main():
             args.reps)
         return args.sweeps * nchains / (ms / 1e3)
 
-    def time_ens(npulsars, per_chains):
+    def time_ens(npulsars, per_chains, unroll):
         ens = EnsembleGibbs(mas[:npulsars], cfg, nchains=per_chains,
-                            chunk_size=args.sweeps)
+                            chunk_size=args.sweeps, unroll=unroll)
         st = ens.init_state(seed=0)
         keys = ens.chain_keys(seed=0)
         ms, _ = timed_scan(
@@ -121,17 +128,24 @@ def main():
             row = {}
             row["single"] = round(time_single(C), 1)
             print(f"[{tag}] single {row['single']:.0f} ch-sw/s", flush=True)
-            row["ens_p1"] = round(time_ens(1, C), 1)
-            print(f"[{tag}] ens_p1 {row['ens_p1']:.0f} ch-sw/s", flush=True)
-            row["ens_p4"] = round(time_ens(P, C // P), 1)
-            print(f"[{tag}] ens_p4 {row['ens_p4']:.0f} ch-sw/s", flush=True)
-            row["single_over_ens_p1"] = round(row["single"] / row["ens_p1"],
-                                              3)
-            row["single_over_ens_p4"] = round(row["single"] / row["ens_p4"],
-                                              3)
-            out[f"kernels_{tag}"] = row
-            flush()
+            for name, (np_, pc, un) in (
+                    ("ens_p1_g", (1, C, False)),
+                    ("ens_p1_u", (1, C, True)),
+                    ("ens_p4_g", (P, C // P, False)),
+                    ("ens_p4_u", (P, C // P, True))):
+                row[name] = round(time_ens(np_, pc, un), 1)
+                print(f"[{tag}] {name} {row[name]:.0f} ch-sw/s",
+                      flush=True)
+                row[f"single_over_{name}"] = round(
+                    row["single"] / row[name], 3)
+                out[f"kernels_{tag}"] = row
+                flush()
 
+    # terminal marker: present ONLY when every arm ran (the probe
+    # queue's stage-done criterion greps for it — ADVICE r4: a fresh
+    # partially-flushed JSON must not done-mark a stage)
+    out["complete"] = True
+    flush()
     print(f"[done] -> {args.out}", flush=True)
     return 0
 

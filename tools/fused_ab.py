@@ -157,8 +157,17 @@ def main():
                                                 - chains["off"])))
         return out
 
+    # Terminal marker for the probe queue's stage-done criterion
+    # (ADVICE r4: fresh-but-partial JSON must not done-mark a stage).
+    # stage() swallows per-stage exceptions into {'error': ...} rows, so
+    # "reached the end" is NOT "measured everything" here — the marker
+    # is written only when every stage produced a real measurement.
+    errored = [k for k, v in results.items()
+               if isinstance(v, dict) and "error" in v]
+    if not errored:
+        results["complete"] = True
     flush()
-    return 0
+    return 0 if not errored else 1
 
 
 if __name__ == "__main__":

@@ -86,10 +86,18 @@ def main():
     if unknown:
         ap.error(f"unknown models {unknown}; have {sorted(configs)}")
 
+    import jax
+
     out: dict = {
         "dataset": "J1713+0747 reference-equivalent (epochs+par from "
                    "/root/reference)",
         "config": vars(args),
+        # in-band provenance (VERDICT r4 weak #4): platform/device and
+        # a UTC stamp live in the artifact itself, not its .out twin
+        "platform": jax.default_backend(),
+        "device": str(jax.devices()),
+        "timestamp_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                       time.gmtime()),
         "models": {},
     }
     sub = np.random.default_rng(0)

@@ -1,0 +1,191 @@
+#!/bin/bash
+# Round-5 hardware queue — multi-window, self-gating on the relay
+# watcher's .relay_alive marker (age <= 30 min). Fixes both ADVICE r4
+# medium findings in the r04 queue design:
+#
+#   1. Stage completion requires a STAGE-SPECIFIC TERMINAL KEY in the
+#      artifact, not "fresh file containing '{'": every incremental-
+#      flush tool now writes `"complete": true` only after its last
+#      stage succeeded (tools/tpu_gate.py, ensemble_bench.py,
+#      ensemble_attrib.py, fused_ab.py), bench stages grep for the
+#      '"metric"' JSON line, single-shot writers for their last-written
+#      key. A mid-window wedge can no longer done-mark a stage it lost
+#      (the r04 mtmw gate was exactly that failure).
+#   2. Each client runs DETACHED with a polling deadline: on expiry the
+#      child is abandoned ALIVE (never signalled — killing an in-flight
+#      client wedges the relay) and the pass breaks, so one wedged
+#      stage can no longer stall the whole queue forever. In LATER
+#      windows a still-alive abandoned child blocks only ITS OWN
+#      stage's retry (two writers on one artifact would corrupt it);
+#      the remaining stages still run.
+#
+# Priority inside a possibly-short (~35 min) window, per VERDICT r4:
+#   1. relay transfer snapshot (interprets every other number)
+#   2. the driver's EXACT `python bench.py` — the axon official record
+#   3. white-MTM on-chip gate (the only kernel still ungated on chip)
+#   4. ensemble attribution incl. grouped-vs-UNROLLED arms (the r05
+#      baked-consts fix for the 2.0x gap) and the production-default
+#      (adapt-cov) ensemble bench — VERDICT #1/#4 done-criteria
+#   5. uncontended notebook-shape thin-8 (the 47.2x -> >=50x repeat)
+#   6. white-MTM on-chip ESS A/B (decides the default, VERDICT #8)
+#   7. variance repeats + the grouped-form ensemble A/B twin
+# Relay discipline: one client at a time, fresh process per stage,
+# nothing signals a client. NEVER edit this file while a detached
+# instance runs — bash reads scripts lazily by byte offset.
+set -u
+cd "$(dirname "$0")/.."
+LOG=artifacts/tpu_probe_r05.log
+say() { echo "[$(date -u +%FT%TZ)] $*" >> "$LOG"; }
+
+wait_fresh_marker() {
+  # block until .relay_alive exists and is <= 30 min old; restart the
+  # watcher if it is not running (it exits after each success)
+  while :; do
+    if [ -f .relay_alive ]; then
+      local age=$(( $(date +%s) - $(stat -c %Y .relay_alive) ))
+      if [ "$age" -le 1800 ]; then
+        say "relay marker fresh (age ${age}s)"
+        return 0
+      fi
+    fi
+    if ! pgrep -f "relay_watch.py" > /dev/null 2>&1; then
+      rm -f .relay_alive
+      say "watcher not running; restarting relay_watch.py"
+      setsid nohup python tools/relay_watch.py > /dev/null 2>&1 &
+    fi
+    sleep 60
+  done
+}
+
+# run_stage <name> <expect_file> <done_key> <deadline_s> <cmd...>
+# Returns 0 = done (evidence on disk: <expect_file> fresh AND contains
+# <done_key>; rc of the client is irrelevant — tpu_gate exits 1 on a
+# statistical FAIL, which is still complete evidence), 2 = skipped
+# because a previously-abandoned child for THIS stage is still alive,
+# 1 = incomplete (deadline hit or client exited without the key).
+run_stage() {
+  local name="$1" expect="$2" key="$3" deadline="$4"; shift 4
+  local done_mark="artifacts/.probe5_done_${name}"
+  local pidfile="artifacts/.probe5_pid_${name}"
+  [ -f "$done_mark" ] && return 0
+  if [ -f "$pidfile" ]; then
+    local old_pid old_t0
+    read -r old_pid old_t0 < "$pidfile"
+    if kill -0 "$old_pid" 2>/dev/null; then
+      say "stage ${name}: abandoned child ${old_pid} still alive;" \
+          "skipping (no second writer on ${expect})"
+      return 2
+    fi
+    # the abandoned child finished between windows: accept its output
+    if [ -f "$expect" ] && [ "$(stat -c %Y "$expect")" -ge "$old_t0" ] \
+        && grep -q "$key" "$expect"; then
+      say "stage ${name}: abandoned child finished successfully"
+      touch "$done_mark"
+      return 0
+    fi
+  fi
+  local t0
+  t0=$(date +%s)
+  say "stage ${name}: $* (deadline ${deadline}s)"
+  setsid nohup "$@" < /dev/null > /dev/null 2>&1 &
+  local pid=$!
+  echo "$pid $t0" > "$pidfile"
+  while kill -0 "$pid" 2>/dev/null; do
+    if [ $(( $(date +%s) - t0 )) -ge "$deadline" ]; then
+      say "stage ${name} DEADLINE ${deadline}s: abandoning child" \
+          "${pid} alive (no signal); breaking pass"
+      return 1
+    fi
+    sleep 20
+  done
+  if [ -f "$expect" ] && [ "$(stat -c %Y "$expect")" -ge "$t0" ] \
+      && grep -q "$key" "$expect"; then
+    say "stage ${name} complete (${expect} has ${key})"
+    touch "$done_mark"
+    return 0
+  fi
+  say "stage ${name} INCOMPLETE (child exited without ${key})"
+  return 1
+}
+
+# st: run_stage wrapper for the pass loop. A skip (alive abandoned
+# child, rc 2) costs only that stage; any other failure means the
+# window is gone — stop launching clients into a dead relay.
+st() {
+  [ "$PASS_BROKEN" = 1 ] && { ALL_DONE=0; return; }
+  run_stage "$@"
+  local rc=$?
+  if [ "$rc" = 2 ]; then
+    ALL_DONE=0
+  elif [ "$rc" != 0 ]; then
+    ALL_DONE=0
+    PASS_BROKEN=1
+  fi
+}
+
+say "=== probe r05 queued (multi-window) ==="
+for window in 1 2 3 4 5 6; do
+  wait_fresh_marker
+  say "--- window ${window} ---"
+  PASS_BROKEN=0
+  ALL_DONE=1
+
+  st transfer artifacts/relay_transfer_r05.json \
+    '"tiny_fetch_sec"' 900 \
+    bash -c "python tools/relay_transfer_bench.py \
+      --out artifacts/relay_transfer_r05.json \
+      > artifacts/relay_transfer_r05.out 2>&1"
+  st bench_official artifacts/BENCH_OFFICIAL_r05.out \
+    '"metric"' 2100 \
+    bash -c "python bench.py > artifacts/BENCH_OFFICIAL_r05.out \
+      2> artifacts/BENCH_OFFICIAL_r05.err"
+  st mtmw_gate artifacts/tpu_gate_mtmw_r05.json \
+    '"complete"' 2700 \
+    bash -c "python tools/tpu_gate.py --adapt-cov 150 --mtm 4 \
+      --mtm-blocks white --out artifacts/tpu_gate_mtmw_r05.json \
+      > artifacts/tpu_gate_mtmw_r05.out 2>&1"
+  st ensemble_attrib artifacts/ensemble_attrib_r05.json \
+    '"complete"' 2700 \
+    bash -c "python tools/ensemble_attrib.py \
+      --out artifacts/ensemble_attrib_r05.json \
+      > artifacts/ensemble_attrib_r05.out 2>&1"
+  st ensemble_bench artifacts/ENSEMBLE_BENCH_r05.json \
+    '"complete"' 2700 \
+    bash -c "python tools/ensemble_bench.py --pulsars 4 --nchains 256 \
+      --adapt 100 --adapt-cov \
+      --out artifacts/ENSEMBLE_BENCH_r05.json \
+      > artifacts/ENSEMBLE_BENCH_r05.out 2>&1"
+  st notebook_thin8 artifacts/BENCH_NOTEBOOK_THIN8_r05.out \
+    '"metric"' 2100 \
+    bash -c "python bench.py --dataset demo --ntoa 12863 \
+      --components 20 --nchains 256 --niter 48 --chunk 24 \
+      --record-thin 8 --baseline-sweeps 30 \
+      > artifacts/BENCH_NOTEBOOK_THIN8_r05.out \
+      2> artifacts/BENCH_NOTEBOOK_THIN8_r05.err"
+  st mtmw_ess artifacts/ADAPT_ESS_MTMW_r05.json \
+    '"ess_per_sweep_gain"' 2700 \
+    bash -c "python tools/adapt_ess.py --mtm 4 --nchains 64 \
+      --out artifacts/ADAPT_ESS_MTMW_r05.json \
+      > artifacts/ADAPT_ESS_MTMW_r05.out 2>&1"
+  st bench_noadapt artifacts/BENCH_NOADAPT_r05.out \
+    '"metric"' 2100 \
+    bash -c "python bench.py --adapt 0 \
+      > artifacts/BENCH_NOADAPT_r05.out \
+      2> artifacts/BENCH_NOADAPT_r05.err"
+  st ensemble_grouped artifacts/ENSEMBLE_BENCH_G_r05.json \
+    '"complete"' 2700 \
+    bash -c "python tools/ensemble_bench.py --pulsars 4 --nchains 256 \
+      --adapt 100 --adapt-cov --unroll 0 --skip-single \
+      --out artifacts/ENSEMBLE_BENCH_G_r05.json \
+      > artifacts/ENSEMBLE_BENCH_G_r05.out 2>&1"
+
+  if [ "$ALL_DONE" = 1 ]; then
+    say "=== probe r05 done (window ${window}) ==="
+    exit 0
+  fi
+  # a stage came up incomplete: stale-ify the marker so the next pass
+  # demands a NEW recovery before retrying the unfinished stages
+  touch -d '1 hour ago' .relay_alive 2>/dev/null || rm -f .relay_alive
+  say "window ${window} ended with unfinished stages; re-arming"
+done
+say "=== probe r05 gave up after 6 windows ==="
