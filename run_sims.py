@@ -141,7 +141,9 @@ def run_ensemble(args, configs, parfile, timfile, rng):
         seed = int(rng.integers(0, 2 ** 31))
         ens = EnsembleGibbs(mas, cfg, nchains=args.nchains, mesh=mesh,
                             record=args.record,
-                            record_thin=args.record_thin)
+                            record_thin=args.record_thin,
+                            unroll=("auto" if args.unroll == "auto"
+                                    else bool(int(args.unroll))))
         t0 = time.perf_counter()
         if args.until_rhat:
             res = ens.sample_until(rhat_target=args.until_rhat,
@@ -184,6 +186,14 @@ def main(argv=None):
                          "(pulsar x chain) population instead of the "
                          "sequential per-dataset pipeline (BASELINE "
                          "config 5; uses --thetas[0])")
+    ap.add_argument("--unroll", default="auto",
+                    choices=("auto", "0", "1"),
+                    help="--ensemble step form: 1 = per-pulsar baked-"
+                         "consts unrolling (single-model kernel shape "
+                         "per pulsar; needs the pulsar mesh axis "
+                         "unsharded), 0 = grouped traced-consts, "
+                         "auto = unroll when the mesh allows and the "
+                         "ensemble is small (parallel/ensemble.py)")
     ap.add_argument("--adapt", type=int, default=None, metavar="N",
                     help="adapt MH jump scales for the first N sweeps "
                          "(jax backend; Robbins-Monro, then frozen — set "

@@ -129,6 +129,28 @@ def test_ensemble_unrolled_matches_grouped():
                              chunk_size=5)._unrolled
 
 
+def test_ensemble_unroll_env_override(monkeypatch):
+    """GST_ENSEMBLE_UNROLL steers only the 'auto' resolution — an
+    explicit constructor argument always wins (A/B harnesses must
+    measure the form they asked for regardless of the caller's
+    environment), and a non-0/1 value fails loudly."""
+    mas = _ensemble_mas(2, n=24, components=4)
+    cfg = GibbsConfig(model="gaussian")
+
+    def build(**kw):
+        return EnsembleGibbs(mas, cfg, nchains=2, chunk_size=2, **kw)
+
+    monkeypatch.setenv("GST_ENSEMBLE_UNROLL", "0")
+    assert not build()._unrolled
+    assert build(unroll=True)._unrolled          # explicit wins
+    monkeypatch.setenv("GST_ENSEMBLE_UNROLL", "1")
+    assert build()._unrolled
+    assert not build(unroll=False)._unrolled     # explicit wins
+    monkeypatch.setenv("GST_ENSEMBLE_UNROLL", "true")
+    with pytest.raises(ValueError, match="GST_ENSEMBLE_UNROLL"):
+        build()
+
+
 def test_ensemble_pulsars_get_distinct_posteriors():
     mas = _ensemble_mas()
     cfg = GibbsConfig(model="gaussian")
@@ -291,24 +313,36 @@ def test_ensemble_fused_kernels_match_closure(monkeypatch):
     mas = _ensemble_mas(3, n=40, components=6)
     cfg = GibbsConfig(model="mixture", vary_df=True, theta_prior="beta")
 
-    def run(flag):
+    def run(flag, unroll):
         monkeypatch.setenv("GST_PALLAS_WHITE", flag)
         monkeypatch.setenv("GST_PALLAS_HYPER", flag)
+        # unroll=False pins the GROUPED traced-consts path this test
+        # exercises; the unrolled arm below covers the baked G==1 form
         ens = EnsembleGibbs(mas, cfg, nchains=4, chunk_size=5,
-                            record="full")
-        if flag == "interpret":
+                            record="full", unroll=unroll)
+        if flag == "interpret" and not unroll:
             assert ens._fused_consts is not None
             assert ens._fused_consts.white_rows.shape[0] == 3
             assert ens._fused_consts.hyper_K is not None
         return ens.sample(niter=10, seed=0)
 
-    r0 = run("0")
-    r1 = run("interpret")
+    r0 = run("0", unroll=False)
+    r1 = run("interpret", unroll=False)
     np.testing.assert_allclose(np.asarray(r1.chain),
                                np.asarray(r0.chain),
                                rtol=2e-3, atol=2e-3)
     np.testing.assert_array_equal(np.asarray(r1.zchain),
                                   np.asarray(r0.zchain))
+    # the UNROLLED step reaches the same kernels through each pulsar's
+    # baked backend (rank-2 consts, G==1 dispatch): kernel-on must
+    # reproduce its own kernel-off run the same way
+    r2 = run("0", unroll=True)
+    r3 = run("interpret", unroll=True)
+    np.testing.assert_allclose(np.asarray(r3.chain),
+                               np.asarray(r2.chain),
+                               rtol=2e-3, atol=2e-3)
+    np.testing.assert_array_equal(np.asarray(r3.zchain),
+                                  np.asarray(r2.zchain))
 
 
 def test_ensemble_mtm_fused_matches_xla(monkeypatch):
@@ -320,8 +354,9 @@ def test_ensemble_mtm_fused_matches_xla(monkeypatch):
 
     def run(flag):
         monkeypatch.setenv("GST_PALLAS_WHITE", flag)
+        # unroll=False: this test pins the GROUPED white-MTM kernel
         ens = EnsembleGibbs(mas, cfg, nchains=4, chunk_size=5,
-                            record="full")
+                            record="full", unroll=False)
         assert ens.template._white_mtm_block is not None
         assert ens._fused_consts is not None
         return ens.sample(niter=10, seed=0)
@@ -353,7 +388,11 @@ def test_ensemble_unrolled_chol_matches_expander(monkeypatch):
     outs = {}
     for flag in ("1", "0"):
         monkeypatch.setenv("GST_UNROLLED_CHOL", flag)
-        ens = EnsembleGibbs(mas, cfg, nchains=3, chunk_size=4)
+        # unroll=False keeps the traced per-pulsar models this test is
+        # about (the baked form runs the single-model linalg paths,
+        # covered by tests/test_ops.py)
+        ens = EnsembleGibbs(mas, cfg, nchains=3, chunk_size=4,
+                            unroll=False)
         outs[flag] = ens.sample(niter=8, seed=0).chain
     np.testing.assert_allclose(outs["1"], outs["0"], rtol=2e-3, atol=2e-3)
 
