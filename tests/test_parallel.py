@@ -413,6 +413,28 @@ def test_ensemble_resume_matches_unbroken():
     np.testing.assert_allclose(stitched, full, rtol=1e-6, atol=1e-7)
 
 
+def test_ensemble_resume_across_step_forms():
+    """A checkpoint written by the GROUPED step resumes on the UNROLLED
+    step (and continues the same chains): the state pytree and the
+    per-sweep fold_in keying are form-independent, so operators can
+    flip `unroll` (or upgrade across rounds) without invalidating
+    spooled runs."""
+    mas = _ensemble_mas()
+    cfg = GibbsConfig(model="mixture")
+    full = EnsembleGibbs(mas, cfg, nchains=2, chunk_size=3,
+                         unroll=True).sample(niter=8, seed=4).chain
+
+    ens_g = EnsembleGibbs(mas, cfg, nchains=2, chunk_size=3,
+                          unroll=False)
+    first = ens_g.sample(niter=5, seed=4)
+    ens_u = EnsembleGibbs(mas, cfg, nchains=2, chunk_size=3,
+                          unroll=True)
+    rest = ens_u.sample(niter=3, seed=4, state=ens_g.last_state,
+                        start_sweep=5)
+    stitched = np.concatenate([first.chain, rest.chain])
+    np.testing.assert_allclose(stitched, full, rtol=2e-4, atol=1e-5)
+
+
 def test_ensemble_compact_record_matches_full():
     """The ensemble's compact record transport (same wire casts as the
     single-model backend) reproduces full-precision recording: x/z
