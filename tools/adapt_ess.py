@@ -39,6 +39,9 @@ def main():
     import numpy as np
 
     import bench as bench_mod
+    from tools.benchlib import enable_compile_cache
+
+    enable_compile_cache()
     from gibbs_student_t_tpu.backends import JaxGibbs
     from gibbs_student_t_tpu.config import GibbsConfig
     from gibbs_student_t_tpu.parallel.diagnostics import (

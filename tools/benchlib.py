@@ -10,7 +10,29 @@ being dead-code-eliminated.
 
 from __future__ import annotations
 
+import os
 import time
+
+
+def enable_compile_cache():
+    """Persistent XLA compile cache, the same knobs as bench.py.
+
+    The probe queue re-runs tools across relay windows in fresh
+    processes; without the cache every retry re-pays each trace's
+    compile (~20-60 s apiece on chip), which is pure loss inside a
+    ~35-minute window. Call after ``import jax``, before any tracing.
+    """
+    import jax
+
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          5.0)
+    except Exception:
+        pass  # older jax without the cache knobs
 
 
 def timed_scan(fn, reps: int):

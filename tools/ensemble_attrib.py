@@ -71,6 +71,10 @@ def main():
     import jax
     from jax import random
 
+    from tools.benchlib import enable_compile_cache
+
+    enable_compile_cache()
+
     out: dict = {"config": vars(args)}
 
     def flush():

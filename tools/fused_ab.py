@@ -76,7 +76,9 @@ def main():
     here = os.path.dirname(os.path.abspath(__file__))
     sys.path.insert(0, os.path.dirname(here))
     sys.path.insert(0, here)
-    from benchlib import timed_scan
+    from benchlib import enable_compile_cache, timed_scan
+
+    enable_compile_cache()
 
     @stage("liveness")
     def _():

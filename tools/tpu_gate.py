@@ -73,6 +73,10 @@ def main():
 
     import jax
 
+    from tools.benchlib import enable_compile_cache
+
+    enable_compile_cache()
+
     out: dict = {"config": vars(args), "models": {}}
     os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
 
