@@ -7,10 +7,12 @@
 #      artifact, not "fresh file containing '{'": every incremental-
 #      flush tool now writes `"complete": true` only after its last
 #      stage succeeded (tools/tpu_gate.py, ensemble_bench.py,
-#      ensemble_attrib.py, fused_ab.py), bench stages grep for the
-#      '"metric"' JSON line, single-shot writers for their last-written
-#      key. A mid-window wedge can no longer done-mark a stage it lost
-#      (the r04 mtmw gate was exactly that failure).
+#      ensemble_attrib.py, fused_ab.py), bench stages grep for
+#      '"platform": "axon"' (bench.py falls back to CPU on a dead
+#      relay and still prints a metric line — a CPU fallback must NOT
+#      done-mark an on-chip stage), single-shot writers for their
+#      last-written key. A mid-window wedge can no longer done-mark a
+#      stage it lost (the r04 mtmw gate was exactly that failure).
 #   2. Each client runs DETACHED with a polling deadline: on expiry the
 #      child is abandoned ALIVE (never signalled — killing an in-flight
 #      client wedges the relay) and the pass breaks, so one wedged
@@ -136,7 +138,7 @@ for window in 1 2 3 4 5 6; do
       --out artifacts/relay_transfer_r05.json \
       > artifacts/relay_transfer_r05.out 2>&1"
   st bench_official artifacts/BENCH_OFFICIAL_r05.out \
-    '"metric"' 2100 \
+    '"platform": "axon"' 2100 \
     bash -c "python bench.py > artifacts/BENCH_OFFICIAL_r05.out \
       2> artifacts/BENCH_OFFICIAL_r05.err"
   st mtmw_gate artifacts/tpu_gate_mtmw_r05.json \
@@ -156,7 +158,7 @@ for window in 1 2 3 4 5 6; do
       --out artifacts/ENSEMBLE_BENCH_r05.json \
       > artifacts/ENSEMBLE_BENCH_r05.out 2>&1"
   st notebook_thin8 artifacts/BENCH_NOTEBOOK_THIN8_r05.out \
-    '"metric"' 2100 \
+    '"platform": "axon"' 2100 \
     bash -c "python bench.py --dataset demo --ntoa 12863 \
       --components 20 --nchains 256 --niter 48 --chunk 24 \
       --record-thin 8 --baseline-sweeps 30 \
@@ -168,7 +170,7 @@ for window in 1 2 3 4 5 6; do
       --out artifacts/ADAPT_ESS_MTMW_r05.json \
       > artifacts/ADAPT_ESS_MTMW_r05.out 2>&1"
   st bench_noadapt artifacts/BENCH_NOADAPT_r05.out \
-    '"metric"' 2100 \
+    '"platform": "axon"' 2100 \
     bash -c "python bench.py --adapt 0 \
       > artifacts/BENCH_NOADAPT_r05.out \
       2> artifacts/BENCH_NOADAPT_r05.err"
@@ -178,6 +180,11 @@ for window in 1 2 3 4 5 6; do
       --adapt 100 --adapt-cov --unroll 0 --skip-single \
       --out artifacts/ENSEMBLE_BENCH_G_r05.json \
       > artifacts/ENSEMBLE_BENCH_G_r05.out 2>&1"
+  st fused_ab artifacts/fused_ab_r05.json \
+    '"complete"' 2700 \
+    bash -c "python tools/fused_ab.py \
+      --out artifacts/fused_ab_r05.json \
+      > artifacts/fused_ab_r05.out 2>&1"
 
   if [ "$ALL_DONE" = 1 ]; then
     say "=== probe r05 done (window ${window}) ==="
