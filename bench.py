@@ -468,6 +468,16 @@ def main(argv=None):
     platform = resolve_platform(args.platform,
                                 probe_timeout=args.probe_timeout,
                                 retries=args.probe_retries)
+    # In-band fallback provenance: when the graded JSON line says
+    # platform=cpu, it should also say WHY (four consecutive rounds of
+    # BENCH_r0N.json needed the probe log / stderr to explain a relay
+    # outage at grading time).
+    accel_fallback = None
+    if (args.platform == "auto" and platform == "cpu"
+            and os.environ.get("JAX_PLATFORMS", "") != "cpu"):
+        accel_fallback = ("device probe found no accelerator after "
+                          f"{args.probe_retries} attempts (wedged/down "
+                          "relay; attempts in bench_probe_log.json)")
 
     # Accelerator watchdog: the relay can wedge *between* a successful
     # probe and the first dispatch/compile, which would hang this process
@@ -541,6 +551,9 @@ def main(argv=None):
                 break
             os.unlink(out_path)
         platform = "cpu"
+        accel_fallback = ("accelerator attempts exhausted (watchdog "
+                          "timeout/failure on every ladder rung); "
+                          "relay died between probe and dispatch")
 
     import jax
 
@@ -590,6 +603,8 @@ def main(argv=None):
         "vs_baseline": round(vs_baseline, 2),
         "platform": platform,
     }
+    if accel_fallback is not None:
+        line["accel_fallback"] = accel_fallback
     if args.record_thin != 1:
         # flagged so a thinned experiment can never be mistaken for the
         # official every-sweep-recorded metric
