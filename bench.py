@@ -11,11 +11,20 @@ NumPy oracle for the same number of per-chain sweeps — the north-star's
 Prints exactly one JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 with ``ess_log10A_per_sec`` / ``vs_baseline_ess`` (the effective-samples
-metric) and ``platform`` as informative extra keys.
+metric) and ``platform`` as informative extra keys. The line is the
+LAST stdout line of the process (everything else goes to stderr, and it
+prints after the per-block timing breakdown) and is also written to
+``bench_summary.json`` — so a harness that reads a combined
+stdout+stderr stream, or loses the stream entirely, still gets the
+parsed record (the r05 ``parsed: null`` failure mode,
+tools/bench_summary.py reads the file).
 
 Observability (VERDICT r1 weak #6): stderr carries the device-probe
 history, per-block wall timings (white MH / TNT reduction / hyper+draws),
-and MH acceptance-rate summaries.
+and MH acceptance-rate summaries; ``--trace-dir`` captures an XLA trace
+of the timed window; ``--no-telemetry`` disables the in-kernel
+telemetry pytree (obs/telemetry.py) for overhead A/Bs — the effective
+setting is tagged in the JSON line when non-default.
 """
 
 from __future__ import annotations
@@ -151,6 +160,28 @@ def probe_device(probe_timeout: float, retries: int,
     return None, attempts
 
 
+def _host_cache_dir() -> str:
+    """``.jax_cache/<machine>-<cpu-flag-hash>``: one compile-cache
+    subdirectory per distinct host CPU, so an AOT executable is only
+    ever loaded on the feature set it was compiled for."""
+    import hashlib
+    import platform as _platform
+
+    tag = _platform.machine() or "unknown"
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for cl in fh:
+                if cl.startswith(("flags", "Features")):
+                    feats = " ".join(sorted(cl.split(":", 1)[1].split()))
+                    tag += "-" + hashlib.sha1(
+                        feats.encode()).hexdigest()[:12]
+                    break
+    except OSError:
+        pass  # no /proc (non-Linux): machine-level split still helps
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        ".jax_cache", tag)
+
+
 def _cleanup_probe_files(result_path: str):
     for p in (result_path, result_path + ".tmp", result_path + ".stderr"):
         try:
@@ -247,24 +278,20 @@ def bench_numpy(ma, cfg, nsweeps: int, seed: int = 0):
 def bench_jax(ma, cfg, nchains: int, nsweeps: int, chunk: int,
               seed: int = 0, record: str = "compact",
               record_thin: int = 1,
-              tnt_block_size="auto", profile_dir: str | None = None):
-    import contextlib
-
-    import jax
-
+              tnt_block_size="auto", profile_dir: str | None = None,
+              telemetry: bool = True):
     from gibbs_student_t_tpu.backends import JaxGibbs
+    from gibbs_student_t_tpu.obs.tracing import trace_to
 
     gb = JaxGibbs(ma, cfg, nchains=nchains, chunk_size=chunk,
                   record=record, record_thin=record_thin,
-                  tnt_block_size=tnt_block_size)
+                  tnt_block_size=tnt_block_size, telemetry=telemetry)
     # warmup: compile + one chunk
     state = gb.init_state(seed=seed)
     gb.sample(niter=chunk, seed=seed, state=state)
     state = gb.last_state
-    trace = (jax.profiler.trace(profile_dir) if profile_dir
-             else contextlib.nullcontext())
     t0 = time.perf_counter()
-    with trace:
+    with trace_to(profile_dir):
         res = gb.sample(niter=nsweeps, seed=seed, state=state,
                         start_sweep=chunk)
     dt = time.perf_counter() - t0
@@ -275,6 +302,16 @@ def bench_jax(ma, cfg, nchains: int, nsweeps: int, chunk: int,
               f"min={acc.mean(axis=0).min():.3f} "
               f"max={acc.mean(axis=0).max():.3f} over {acc.shape[1]} "
               f"chains", file=sys.stderr)
+    if "tele_diverged" in res.stats:
+        # in-kernel telemetry verdict for the timed window
+        nonf = int(np.asarray(res.stats["tele_nonfinite"]).sum())
+        ndiv = int(np.asarray(res.stats["tele_diverged"]).sum())
+        lp = np.asarray(res.stats["tele_logpost"])
+        lp = lp[np.isfinite(lp)]
+        print(f"# telemetry: diverged={ndiv}/{nchains} chains, "
+              f"nonfinite_sweeps={nonf}, logpost mean="
+              f"{lp.mean():.1f}" if lp.size else
+              "# telemetry: all chains non-finite", file=sys.stderr)
     return nsweeps / dt, _ess(res, ma.param_names, dt), gb
 
 
@@ -401,9 +438,25 @@ def main(argv=None):
     ap.add_argument("--no-block-timings", action="store_true",
                     help="skip the per-block timing breakdown (saves a few "
                          "extra stage compiles)")
-    ap.add_argument("--profile", metavar="DIR", default=None,
+    ap.add_argument("--profile", "--trace-dir", metavar="DIR",
+                    default=None, dest="profile",
                     help="capture a jax.profiler trace of the timed JAX "
-                         "window into DIR (view with xprof/tensorboard)")
+                         "window into DIR (view with xprof/tensorboard; "
+                         "the sweep stages carry gibbs/* named spans, "
+                         "obs/tracing.py)")
+    ap.add_argument("--telemetry", default=True,
+                    action=argparse.BooleanOptionalAction,
+                    help="carry the in-kernel Telemetry pytree through "
+                         "the timed window (per-block accept counters, "
+                         "non-finite divergence flags, log-posterior; "
+                         "obs/telemetry.py). --no-telemetry measures the "
+                         "bare kernel for overhead A/Bs and is tagged "
+                         "in the JSON line")
+    ap.add_argument("--summary-json", metavar="PATH",
+                    default="bench_summary.json",
+                    help="also write the JSON metric line to PATH "
+                         "(machine-readable even when stdout is lost or "
+                         "interleaved; '' disables)")
     ap.add_argument("--accel-timeout", type=float, default=1800.0,
                     help="hard deadline (s) for the accelerator attempt; "
                          "on expiry the benchmark reruns on CPU so a JSON "
@@ -559,11 +612,14 @@ def main(argv=None):
 
     jax.config.update("jax_platforms", platform)
     # persistent compile cache: repeated bench runs (and the driver's
-    # end-of-round invocation) skip the sweep kernel's first-compile cost
+    # end-of-round invocation) skip the sweep kernel's first-compile
+    # cost. The directory is fingerprinted by host CPU features: an
+    # XLA:CPU AOT executable cached on one machine and loaded on another
+    # spews a ~2 KB feature-mismatch warning and risks SIGILL
+    # (VERDICT r5 #2 / docs/ROUND5_NOTES.md) — a per-CPU cache directory
+    # removes the condition instead of filtering the warning.
     try:
-        jax.config.update("jax_compilation_cache_dir",
-                          os.path.join(os.path.dirname(
-                              os.path.abspath(__file__)), ".jax_cache"))
+        jax.config.update("jax_compilation_cache_dir", _host_cache_dir())
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
     except Exception:
         pass  # older jax without the cache knobs
@@ -588,7 +644,8 @@ def main(argv=None):
     jax_sps, jax_ess, gb = bench_jax(ma, cfg, args.nchains, args.niter,
                                      args.chunk, record=record,
                                      record_thin=args.record_thin,
-                                     profile_dir=args.profile)
+                                     profile_dir=args.profile,
+                                     telemetry=args.telemetry)
 
     # wall-clock speedup for the same per-chain sweep count, i.e. the
     # north-star "1024 chains vs single-chain NumPy" factor: each JAX sweep
@@ -625,11 +682,22 @@ def main(argv=None):
         line["mtm_tries"] = args.mtm
         if set(args.mtm_blocks) != {"white", "hyper"}:
             line["mtm_blocks"] = sorted(args.mtm_blocks)
+    if not args.telemetry:
+        # flagged: an overhead-A/B arm must not pass as the default
+        # (telemetry-on) production metric
+        line["telemetry"] = False
     if jax_ess is not None:
         line["ess_log10A_per_sec"] = round(jax_ess, 2)
     if jax_ess is not None and numpy_ess:
         line["vs_baseline_ess"] = round(jax_ess / numpy_ess, 2)
-    print(json.dumps(line))
+    # machine-readable summary FILE first: even if the process dies in
+    # the block-timing epilogue (or stdout is lost/interleaved by the
+    # harness), the parsed record exists on disk
+    if args.summary_json:
+        tmp = args.summary_json + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(line, fh)
+        os.replace(tmp, args.summary_json)
     print(f"# platform={platform}; numpy single-chain: {numpy_sps:.1f} "
           f"sweeps/s (ess/s {numpy_ess if numpy_ess is None else round(numpy_ess, 2)}); "
           f"jax {args.nchains} chains: {jax_sps:.1f} sweeps/s/chain "
@@ -640,6 +708,12 @@ def main(argv=None):
               file=sys.stderr)
         for ln in block_timings(gb).splitlines():
             print(f"#   {ln}", file=sys.stderr)
+    # the graded JSON line goes LAST, after every stderr epilogue, so a
+    # harness reading a combined stdout+stderr stream still finds it as
+    # the final line (BENCH_r05.json "parsed": null — the block timings
+    # used to print after it)
+    sys.stderr.flush()
+    print(json.dumps(line), flush=True)
 
 
 if __name__ == "__main__":

@@ -28,8 +28,15 @@ from gibbs_student_t_tpu.models.pta import ModelArrays
 #: sweep-thinning factor (rows = every ``record_thin``-th sweep);
 #: ``rhat``/``rhat_history``/``converged`` are ``sample_until``'s
 #: convergence verdict (per-parameter / per-check, not per-sweep).
+#: Keys under ``obs.telemetry.TELE_PREFIX`` (``tele_*``) are run-level
+#: per-chain telemetry aggregates: ``burn`` passes them through like
+#: META_STATS, and ``select_pulsar`` indexes their leading pulsar axis
+#: (they are ``(npulsars, nchains)`` in ensemble results, not
+#: ``(niter, ...)``).
 META_STATS = ("n_toa", "n_reinits", "record_mode", "record_thin",
               "rhat", "rhat_history", "converged")
+
+TELE_PREFIX = "tele_"
 
 
 @dataclasses.dataclass
@@ -57,8 +64,9 @@ class ChainResult:
                 if f.name not in ("stats",)
             },
             # per-sweep stats stay sweep-aligned; run-level metadata
-            # (META_STATS) passes through untouched
+            # (META_STATS, tele_* aggregates) passes through untouched
             stats={k: (v[nburn:] if np.ndim(v) and k not in META_STATS
+                       and not k.startswith(TELE_PREFIX)
                        else v)
                    for k, v in self.stats.items()},
         )
@@ -79,8 +87,15 @@ class ChainResult:
             for f in dataclasses.fields(self)
             if f.name not in ("stats",)
         }
-        stats = {k: (v if k in META_STATS or np.ndim(v) < 2 else v[:, i])
-                 for k, v in self.stats.items()}
+        stats = {}
+        for k, v in self.stats.items():
+            if k.startswith(TELE_PREFIX):
+                # (npulsars, nchains) per-chain aggregates -> (nchains,)
+                stats[k] = v[i] if np.ndim(v) >= 2 else v
+            elif k in META_STATS or np.ndim(v) < 2:
+                stats[k] = v
+            else:
+                stats[k] = v[:, i]
         n_toa = self.stats.get("n_toa")
         if n_toa is not None:
             n_i = int(np.asarray(n_toa)[i])

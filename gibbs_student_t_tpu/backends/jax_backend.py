@@ -51,6 +51,13 @@ from gibbs_student_t_tpu.models.pta import (
     phiinv_logdet,
     static_phi_columns,
 )
+from gibbs_student_t_tpu.obs.telemetry import (
+    combine_tele_stats,
+    telemetry_init,
+    telemetry_update,
+    TelemetryAccumulator,
+)
+from gibbs_student_t_tpu.obs.tracing import block_span
 
 from gibbs_student_t_tpu.ops.linalg import (
     backward_solve,
@@ -275,6 +282,7 @@ def _sample_until_loop(sample_fn, last_state_fn, record_thin, rhat_of,
     segments = []
     history = []
     ess_history = []
+    tele_segs = []  # per-segment tele_* stats (sweep-weighted merge below)
     done = 0
     converged = False
 
@@ -296,6 +304,8 @@ def _sample_until_loop(sample_fn, last_state_fn, record_thin, rhat_of,
         res = sample_fn(length, state, done)
         state = last_state_fn()
         done += length
+        tele_segs.append({k: v for k, v in res.stats.items()
+                          if k.startswith("tele_")})
         if spool_mode:
             total_rows = res.chain.shape[0]
             window = res.chain[total_rows // 2:]
@@ -329,6 +339,8 @@ def _sample_until_loop(sample_fn, last_state_fn, record_thin, rhat_of,
         stats = {}
         for k in segments[0].stats:
             v0 = segments[0].stats[k]
+            if k.startswith("tele_"):
+                continue  # merged below with sweep-count weighting
             if k == "n_reinits":
                 # per-call counters: the run's total is the sum
                 stats[k] = np.asarray(sum(
@@ -338,6 +350,10 @@ def _sample_until_loop(sample_fn, last_state_fn, record_thin, rhat_of,
             else:
                 stats[k] = np.concatenate([s.stats[k] for s in segments])
         out = ChainResult(**cols, stats=stats)
+    # in spool mode each segment's result is the reloaded FULL history
+    # but its tele_* stats cover only that call's chunks, so the merge
+    # is identical in both modes
+    out.stats.update(combine_tele_stats(tele_segs))
     out.stats["rhat_history"] = np.stack(history)
     out.stats["rhat"] = history[-1]
     if ess_history:
@@ -382,7 +398,9 @@ class JaxGibbs(SamplerBackend):
                  record_thin: int = 1,
                  use_pallas: bool | str = "auto",
                  pallas_interpret: bool = False,
-                 hyper_schur: bool | str = "auto"):
+                 hyper_schur: bool | str = "auto",
+                 telemetry: bool = True,
+                 metrics=None):
         """``tnt_block_size`` selects the TOA reduction: ``None`` dense,
         an int for a ``lax.scan`` over row blocks (the 1e5-TOA stress path,
         BASELINE.json config 4; TOA axis zero-padded to a block multiple),
@@ -436,7 +454,18 @@ class JaxGibbs(SamplerBackend):
         the backend; flipping them afterwards does not affect an existing
         instance (ops/linalg.py ``_pallas_chol_mode``). The white/hyper
         flags gate the fused whole-MH-block kernels (ops/pallas_white.py,
-        ops/pallas_hyper.py), both ``auto``-on for TPU backends."""
+        ops/pallas_hyper.py), both ``auto``-on for TPU backends.
+
+        ``telemetry`` (default on) carries the in-kernel ``Telemetry``
+        pytree through each chunk's scan — per-block MH accept sums,
+        per-chain non-finite divergence counters, chunk-end
+        log-posterior (obs/telemetry.py) — drained to host with the
+        record flush (no extra device syncs; updates never touch the
+        RNG stream, so chains are bit-identical either way). Aggregates
+        land in ``ChainResult.stats`` under ``tele_*`` keys. ``metrics``
+        optionally attaches an ``obs.metrics.MetricsRegistry``: each
+        chunk then also increments its counters and appends one
+        ``chunk`` event to the registry's JSONL sink."""
         super().__init__(ma, config)
         self.nchains = nchains
         self.dtype = dtype
@@ -618,6 +647,8 @@ class JaxGibbs(SamplerBackend):
                 self._hyper_consts = build_hyper_consts(self._ma, cols)
                 self._hyper_block = make_hyper_block(
                     self._hyper_consts.hyp_idx, config.jitter)
+        self._telemetry = bool(telemetry)
+        self.metrics = metrics
         self._chunk_fn = jax.jit(self._make_chunk_fn(),
                                  static_argnames=("length",))
         self._prop_cov_fn = (jax.jit(self._prop_cov_update)
@@ -883,11 +914,16 @@ class JaxGibbs(SamplerBackend):
         ``sweep`` is the (traced) sweep index, needed only when MH
         adaptation is enabled (MHConfig.adapt_until)."""
         keys = random.split(key, 7)
-        x, acc_w, nvec = self._sweep_white(state, keys[0], ma, fused)
+        # block_span: trace-time XLA op naming (obs/tracing.py) so a
+        # --trace-dir capture attributes device time per Gibbs block;
+        # zero runtime cost (HLO metadata only)
+        with block_span("gibbs/white_mh"):
+            x, acc_w, nvec = self._sweep_white(state, keys[0], ma, fused)
         ma_r, _, bs, _ = self._resolve(ma)
         # per-sweep inner products (reference gibbs.py:302-304), via the
         # fused dense/blocked reduction (ops/tnt.py)
-        TNT, d, const_white = tnt_products(ma_r.T, ma_r.y, nvec, bs)
+        with block_span("gibbs/tnt_reduction"):
+            TNT, d, const_white = tnt_products(ma_r.T, ma_r.y, nvec, bs)
         return self._sweep_rest(state, x, acc_w, TNT, d, const_white,
                                 keys[1:], ma, sweep, fused)
 
@@ -1028,8 +1064,9 @@ class JaxGibbs(SamplerBackend):
             # path's full phiinv[v_i].
             dS0 = (jnp.diagonal(Sh, axis1=-2, axis2=-1)
                    + h_phiinv_static)
-            x, acc_h = self._hyper_block(x, Sh, dS0, rh, base, dxh,
-                                         logus, hK, hsel, hspecs)
+            with block_span("gibbs/hyper_mh"):
+                x, acc_h = self._hyper_block(x, Sh, dS0, rh, base, dxh,
+                                             logus, hK, hsel, hspecs)
         elif len(ma.hyper_indices):
             if self._schur is not None:
                 def ll_hyper(xq):
@@ -1051,10 +1088,11 @@ class JaxGibbs(SamplerBackend):
                     return jnp.where(jnp.isfinite(ll), ll, -jnp.inf)
 
             block = self._mtm_block if mtm_h else self._mh_block
-            x, acc_h = block(x, kh, ma.hyper_indices,
-                             cfg.mh.n_hyper_steps, ll_hyper,
-                             jump_scale=jump_scale_h,
-                             cov_chol=cov_h)
+            with block_span("gibbs/hyper_mh"):
+                x, acc_h = block(x, kh, ma.hyper_indices,
+                                 cfg.mh.n_hyper_steps, ll_hyper,
+                                 jump_scale=jump_scale_h,
+                                 cov_chol=cov_h)
         else:
             acc_h = jnp.zeros((), dtype=self.dtype)
 
@@ -1063,15 +1101,17 @@ class JaxGibbs(SamplerBackend):
         # The draw cannot MH-reject, so it uses the escalating-jitter
         # factorization (the reference's SVD->QR fallback role,
         # gibbs.py:168-178).
-        phiinv, _ = phiinv_logdet(ma, x, jnp)
-        Sigma = TNT + jnp.diag(phiinv)
-        L, isd, _, u = robust_precond_cholesky(
-            Sigma, jitters=(cfg.jitter, 1e-4, 1e-2, 1e-1), rhs=d)
-        # b = mean + fluct = D^-1/2 L^-T (u + xi): the forward solve rode
-        # along with the factorization, so one backward substitution
-        # yields the draw (reference gibbs.py:169-180's mn + Li*xi)
-        xi = random.normal(kb, (m,), dtype=self.dtype)
-        b = backward_solve(L, u + xi) * isd
+        with block_span("gibbs/b_draw"):
+            phiinv, _ = phiinv_logdet(ma, x, jnp)
+            Sigma = TNT + jnp.diag(phiinv)
+            L, isd, _, u = robust_precond_cholesky(
+                Sigma, jitters=(cfg.jitter, 1e-4, 1e-2, 1e-1), rhs=d)
+            # b = mean + fluct = D^-1/2 L^-T (u + xi): the forward solve
+            # rode along with the factorization, so one backward
+            # substitution yields the draw (reference gibbs.py:169-180's
+            # mn + Li*xi)
+            xi = random.normal(kb, (m,), dtype=self.dtype)
+            b = backward_solve(L, u + xi) * isd
 
         resid = ma.y - matvec_blocked(ma.T, b, bs)
         nvec0 = ndiag(ma, x, jnp)
@@ -1178,6 +1218,7 @@ class JaxGibbs(SamplerBackend):
         fields = self._record_fields
         casts = self._record_casts
         thin = self.record_thin
+        use_tele = self._telemetry
 
         def rec_of(st):
             # transport casts happen on device, inside the scan, so the
@@ -1190,49 +1231,74 @@ class JaxGibbs(SamplerBackend):
         # the SAME per-sweep fold_in keying as an unthinned run, so row k
         # of a thinned chain is bit-identical to row k*thin of a full one
         # (tests/test_jax_backend.py::test_record_thin_rows_match_unthinned).
+        # The Telemetry pytree rides the same carry (zeroed per chunk,
+        # updated per SWEEP — including the thinned-away ones — from the
+        # post-sweep state only, so the RNG stream and recorded chains
+        # are untouched); the chunk returns it alongside the records and
+        # it crosses to host with the same flush (obs/telemetry.py).
 
         def one_chain(state, chain_key, offset, length):
-            def body(st, i0):
+            def advance(st, tl, i):
+                st = self._sweep(st, random.fold_in(chain_key, i),
+                                 sweep=i)
+                return st, (telemetry_update(tl, st) if use_tele else tl)
+
+            def body(carry, i0):
+                st, tl = carry
                 rec = rec_of(st)
                 if thin == 1:  # default path: no inner loop machinery
-                    st = self._sweep(st, random.fold_in(chain_key, i0),
-                                     sweep=i0)
+                    st, tl = advance(st, tl, i0)
                 else:
-                    st = lax.fori_loop(
+                    st, tl = lax.fori_loop(
                         0, thin,
-                        lambda j, s: self._sweep(
-                            s, random.fold_in(chain_key, i0 + j),
-                            sweep=i0 + j),
-                        st)
-                return st, rec
+                        lambda j, c: advance(c[0], c[1], i0 + j),
+                        (st, tl))
+                return (st, tl), rec
 
-            return lax.scan(body, state,
-                            offset + jnp.arange(0, length, thin))
+            (st, tl), recs = lax.scan(
+                body, (state, telemetry_init(self.dtype)),
+                offset + jnp.arange(0, length, thin))
+            if use_tele:
+                tl = tl._replace(logpost=self._logpost_chain(st))
+            return st, recs, tl
 
         def chunk(states, keys, offset, length):
-            return jax.vmap(
+            sts, recs, tl = jax.vmap(
                 functools.partial(one_chain, offset=offset, length=length)
             )(states, keys)
+            return sts, (recs, tl if use_tele else None)
 
         def chunk_batched(states, keys, offset, length):
             # outer scan over recorded rows; each step advances all
             # chains via the batched sweep (the Pallas TNT path)
-            def body(sts, i0):
+            tele_up = jax.vmap(telemetry_update)
+
+            def body(carry, i0):
+                sts, tl = carry
                 rec = rec_of(sts)
 
-                def inner(j, s):
+                def inner(j, c):
+                    s, t = c
                     ki = jax.vmap(
                         lambda k: random.fold_in(k, i0 + j))(keys)
-                    return self._batched_sweep(s, ki, sweep=i0 + j)
+                    s = self._batched_sweep(s, ki, sweep=i0 + j)
+                    return s, (tele_up(t, s) if use_tele else t)
 
-                sts = (inner(0, sts) if thin == 1
-                       else lax.fori_loop(0, thin, inner, sts))
-                return sts, rec
+                sts, tl = (inner(0, (sts, tl)) if thin == 1
+                           else lax.fori_loop(0, thin, inner, (sts, tl)))
+                return (sts, tl), rec
 
-            sts, recs = lax.scan(body, states,
-                                 offset + jnp.arange(0, length, thin))
+            tl0 = jax.tree.map(
+                lambda a: jnp.broadcast_to(a, (self.nchains,) + a.shape),
+                telemetry_init(self.dtype))
+            (sts, tl), recs = lax.scan(body, (states, tl0),
+                                       offset + jnp.arange(0, length, thin))
+            if use_tele:
+                tl = tl._replace(
+                    logpost=jax.vmap(self._logpost_chain)(sts))
             # (rows, C, ...) -> (C, rows, ...) to match the vmap path
-            return sts, tuple(jnp.swapaxes(r, 0, 1) for r in recs)
+            return sts, (tuple(jnp.swapaxes(r, 0, 1) for r in recs),
+                         tl if use_tele else None)
 
         return chunk_batched if self._use_pallas else chunk
 
@@ -1265,6 +1331,28 @@ class JaxGibbs(SamplerBackend):
         quad, logdet_sigma = precond_quad_logdet(Sigma, d, cfg.jitter)
         ll = const_white + 0.5 * (quad - logdet_sigma - logdet_phi)
         return float(jnp.where(jnp.isfinite(ll), ll, -jnp.inf))
+
+    def _logpost_chain(self, state: ChainState,
+                       ma: ModelArrays | None = None):
+        """Traced single-chain marginalized log-posterior (the hyper
+        block's ``ll_hyper`` math plus ``lnprior``), at the chain's
+        current z/alpha — the telemetry's running log-posterior
+        (obs/telemetry.py). Evaluated once per CHUNK (after the scan),
+        so its one extra TNT reduction + factorization costs
+        ~1/chunk_size of a sweep. ``vmap`` for batched states; the
+        ensemble passes its traced per-pulsar model as ``ma``."""
+        ma_r, mask, bs, _ = self._resolve(ma)
+        az = state.alpha ** state.z
+        nvec = self._masked_nvec(ma_r, mask, state.x, az)
+        TNT, d, const_white = tnt_products(ma_r.T, ma_r.y, nvec, bs)
+        phiinv, logdet_phi = phiinv_logdet(ma_r, state.x, jnp)
+        Sigma = TNT + jnp.diag(phiinv)
+        quad, logdet_sigma = precond_quad_logdet(Sigma, d,
+                                                 self.config.jitter)
+        lp = (const_white + 0.5 * (quad - logdet_sigma - logdet_phi)
+              + lnprior(ma_r, state.x, jnp))
+        return jnp.where(jnp.isfinite(lp), lp,
+                         -jnp.inf).astype(self.dtype)
 
     def sample(self, x0: Optional[np.ndarray] = None, niter: int = 1000,
                seed: int = 0, state: Optional[ChainState] = None,
@@ -1328,8 +1416,17 @@ class JaxGibbs(SamplerBackend):
         # carried forward from run_stats.json instead of resetting
         n_reinits0 = (int(spool.load_run_stats().get("n_reinits", 0))
                       if spool is not None and resume else 0)
+        tele_acc = TelemetryAccumulator() if self._telemetry else None
 
         def flush(recs, chunk_state, sweep_end, n_reinits):
+            recs, tl = recs
+            if tele_acc is not None and tl is not None:
+                # rides the flush's existing host sync; the pytree is a
+                # handful of per-chain scalars, so the pull is free next
+                # to the record buffers
+                summary = tele_acc.add(jax.device_get(tl))
+                if self.metrics is not None:
+                    tele_acc.emit_chunk(self.metrics, sweep_end, summary)
             host = self._materialize(jax.device_get(recs))
             if spool is not None:
                 spool.append(
@@ -1360,6 +1457,8 @@ class JaxGibbs(SamplerBackend):
             res = load_spool(spool_dir)
             if reinit_diverged:
                 res.stats["n_reinits"] = np.asarray(n_reinits)
+            if tele_acc is not None and not tele_acc.empty:
+                res.stats.update(tele_acc.stats())
             return res
         self.last_state = state
 
@@ -1372,6 +1471,8 @@ class JaxGibbs(SamplerBackend):
         res = self._to_result(cols)
         if reinit_diverged:
             res.stats["n_reinits"] = np.asarray(n_reinits)
+        if tele_acc is not None and not tele_acc.empty:
+            res.stats.update(tele_acc.stats())
         return res
 
     def sample_until(self, rhat_target: float = 1.01,

@@ -1,0 +1,57 @@
+"""Observability: metrics registry, in-kernel telemetry, health, tracing.
+
+The reference sampler's only observability is a progress print every 100
+sweeps (reference gibbs.py:382-385); the north-star metric — effective
+samples/sec at 1024 data-parallel chains — cannot be trusted, debugged,
+or improved without per-block acceptance rates, divergence detection and
+machine-readable run records. This package supplies them:
+
+- :mod:`~gibbs_student_t_tpu.obs.metrics` — a process-local registry of
+  counters/gauges/histograms with a JSONL event sink and a run-manifest
+  writer (git SHA, config, device topology, RNG seeds).
+  ``utils/timing.BlockTimer`` is the registry's wall-clock source.
+- :mod:`~gibbs_student_t_tpu.obs.telemetry` — the ``Telemetry`` pytree
+  carried through the jit'd Gibbs chunk: per-block MH accept sums,
+  per-chain non-finite divergence counters, running log-posterior.
+  Drained to host once per chunk with the record flush, so it adds no
+  extra device syncs.
+- :mod:`~gibbs_student_t_tpu.obs.health` — stuck/dead/diverged chain
+  classification combining the drained counters with the
+  ``parallel/diagnostics`` ESS/R-hat machinery.
+- :mod:`~gibbs_student_t_tpu.obs.tracing` — ``jax.profiler.trace`` and
+  named-span helpers (``--trace-dir`` in the drivers).
+
+Import discipline: this package is imported by ``backends/jax_backend.py``
+at module load, so nothing here may import ``backends``/``parallel`` at
+module scope (``health`` defers its diagnostics import to call time).
+"""
+
+from gibbs_student_t_tpu.obs.metrics import (
+    MetricsRegistry,
+    read_events,
+    write_manifest,
+)
+from gibbs_student_t_tpu.obs.telemetry import (
+    TELE_PREFIX,
+    Telemetry,
+    TelemetryAccumulator,
+    combine_tele_stats,
+    telemetry_init,
+    telemetry_update,
+)
+from gibbs_student_t_tpu.obs.tracing import block_span, host_span, trace_to
+
+__all__ = [
+    "MetricsRegistry",
+    "read_events",
+    "write_manifest",
+    "TELE_PREFIX",
+    "Telemetry",
+    "TelemetryAccumulator",
+    "combine_tele_stats",
+    "telemetry_init",
+    "telemetry_update",
+    "block_span",
+    "host_span",
+    "trace_to",
+]

@@ -1,0 +1,309 @@
+"""Metrics registry with a JSONL event sink and run-manifest writer.
+
+Host-side only — nothing here is traced. The sampler's device-side
+counters live in :mod:`~gibbs_student_t_tpu.obs.telemetry`; what lands
+here is their per-chunk drain, plus whatever the drivers want to record
+(throughput gauges, per-block wall timings, run lifecycle events).
+
+Wall-clock attribution goes through ``utils/timing.BlockTimer`` — the
+registry owns one and exposes it as :attr:`MetricsRegistry.timer`, so
+``bench.py``'s per-block breakdown and the registry's snapshot share a
+single timing source instead of two drifting ones.
+
+File layout of a run directory (``MetricsRegistry(run_dir=...)``):
+
+- ``manifest.json`` — one JSON object identifying the run: git SHA,
+  config, device topology, RNG seeds, versions, argv (schema in
+  docs/OBSERVABILITY.md).
+- ``events.jsonl`` — append-only, one JSON object per line, each with
+  ``event`` (kind), ``t`` (unix seconds) and ``elapsed_s`` (seconds
+  since the registry opened). Crash-tolerant: every line is flushed, so
+  a killed run keeps its readable prefix.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+# BlockTimer is imported lazily in MetricsRegistry.__init__:
+# utils/__init__ pulls in checkpoint.py, which imports the backend,
+# which imports this package — a module-scope import here would close
+# that cycle during backend load.
+
+
+class Counter:
+    """Monotonic float counter (e.g. sweeps, accepted MH steps)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError(f"counter {self.name!r} cannot decrease "
+                             f"(inc by {amount})")
+        self.value += amount
+
+
+class Gauge:
+    """Last-value metric (e.g. sweeps/sec, diverged-chain count)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value: Optional[float] = None
+
+    def set(self, value: float) -> None:
+        self.value = float(value)
+
+
+class Histogram:
+    """Fixed-bucket histogram with exact count/sum/min/max.
+
+    ``buckets`` are upper bounds of cumulative-style bins (a +inf bucket
+    is implicit); the default decade grid suits wall-clock seconds and
+    acceptance-ish ratios alike without tuning.
+    """
+
+    DEFAULT_BUCKETS = (1e-4, 1e-3, 1e-2, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                       10.0, 60.0, 600.0)
+
+    def __init__(self, name: str, buckets: Optional[Sequence[float]] = None):
+        self.name = name
+        self.buckets = tuple(sorted(buckets or self.DEFAULT_BUCKETS))
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        self.counts[int(np.searchsorted(self.buckets, value))] += 1
+        self.count += 1
+        self.sum += value
+        self.min = value if self.min is None else min(self.min, value)
+        self.max = value if self.max is None else max(self.max, value)
+
+    def summary(self) -> Dict[str, object]:
+        return {
+            "count": self.count, "sum": self.sum,
+            "min": self.min, "max": self.max,
+            "mean": self.sum / self.count if self.count else None,
+            "buckets": dict(zip([*map(str, self.buckets), "+inf"],
+                                self.counts)),
+        }
+
+
+class MetricsRegistry:
+    """Named counters/gauges/histograms + JSONL events + run manifest.
+
+    With ``run_dir=None`` the registry is purely in-memory (tests, quick
+    scripts); ``snapshot()`` still works. ``emit()`` without a run
+    directory is a no-op, so instrumented code never branches on whether
+    a sink exists.
+    """
+
+    def __init__(self, run_dir: Optional[str] = None):
+        from gibbs_student_t_tpu.utils.timing import BlockTimer
+
+        self.run_dir = run_dir
+        self._metrics: Dict[str, object] = {}
+        self.timer = BlockTimer()  # the registry's wall-clock source
+        self._t0 = time.time()
+        self._events_fh = None
+        if run_dir is not None:
+            os.makedirs(run_dir, exist_ok=True)
+            self._events_fh = open(os.path.join(run_dir, "events.jsonl"),
+                                   "a", buffering=1)
+
+    # -- metric accessors (get-or-create, kind-checked) -----------------
+
+    def _get(self, name: str, cls, **kwargs):
+        m = self._metrics.get(name)
+        if m is None:
+            m = self._metrics[name] = cls(name, **kwargs)
+        elif not isinstance(m, cls):
+            raise TypeError(f"metric {name!r} already registered as "
+                            f"{type(m).__name__}, not {cls.__name__}")
+        return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str,
+                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+        return self._get(name, Histogram, buckets=buckets)
+
+    def time(self, name: str, fn, *args, **kwargs):
+        """Run ``fn`` with device-fenced wall attribution (BlockTimer)
+        and mirror the duration into ``histogram(name + "_seconds")``."""
+        t0 = time.perf_counter()
+        out = self.timer.time(name, fn, *args, **kwargs)
+        self.histogram(name + "_seconds").observe(time.perf_counter() - t0)
+        return out
+
+    # -- snapshot / events ----------------------------------------------
+
+    def snapshot(self) -> Dict[str, object]:
+        """All metric values plus the timer summary, JSON-ready."""
+        out: Dict[str, object] = {"counters": {}, "gauges": {},
+                                  "histograms": {},
+                                  "timers": self.timer.summary()}
+        for name, m in sorted(self._metrics.items()):
+            if isinstance(m, Counter):
+                out["counters"][name] = m.value
+            elif isinstance(m, Gauge):
+                out["gauges"][name] = m.value
+            else:
+                out["histograms"][name] = m.summary()
+        return out
+
+    def emit(self, event: str, **fields) -> None:
+        """Append one event line to ``events.jsonl`` (no-op without a
+        run_dir). Values go through the JSON sanitizer, so numpy scalars
+        and small arrays are fine."""
+        if self._events_fh is None:
+            return
+        rec = {"event": event, "t": round(time.time(), 3),
+               "elapsed_s": round(time.time() - self._t0, 3)}
+        rec.update(fields)
+        self._events_fh.write(json.dumps(_jsonable(rec)) + "\n")
+
+    def write_manifest(self, **fields) -> Optional[str]:
+        """Write ``manifest.json`` into the run directory (see
+        :func:`write_manifest`); returns its path, or None without a
+        run_dir."""
+        if self.run_dir is None:
+            return None
+        return write_manifest(self.run_dir, **fields)
+
+    def close(self) -> None:
+        """Emit a final ``snapshot`` event and close the JSONL sink."""
+        if self._events_fh is not None:
+            self.emit("snapshot", metrics=self.snapshot())
+            self._events_fh.close()
+            self._events_fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# ----------------------------------------------------------------------
+# run manifest
+# ----------------------------------------------------------------------
+
+
+def _git_sha() -> Optional[str]:
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip() or None
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def _device_topology() -> Dict[str, object]:
+    """Best-effort device inventory. Never *initializes* a backend the
+    process hasn't already touched being the wrong place to first dial a
+    TPU relay — if jax is unimported, record exactly that."""
+    if "jax" not in sys.modules:
+        return {"probed": False, "reason": "jax not imported yet"}
+    jax = sys.modules["jax"]
+    try:
+        devs = jax.devices()
+        return {"probed": True, "backend": jax.default_backend(),
+                "device_count": len(devs),
+                "process_count": jax.process_count(),
+                "kinds": sorted({d.device_kind for d in devs})}
+    except Exception as e:  # noqa: BLE001 - manifest must always write
+        return {"probed": False, "reason": f"{type(e).__name__}: {e}"[:200]}
+
+
+def _jsonable(obj):
+    """Recursively coerce numpy/dataclass values into JSON-native ones."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return _jsonable(dataclasses.asdict(obj))
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if isinstance(obj, np.ndarray):
+        return _jsonable(obj.tolist())
+    if isinstance(obj, (np.bool_, bool)):
+        return bool(obj)
+    if isinstance(obj, (np.integer, int)):
+        return int(obj)
+    if isinstance(obj, (np.floating, float)):
+        f = float(obj)
+        return f if np.isfinite(f) else repr(f)  # JSON has no inf/nan
+    return obj if isinstance(obj, (str, type(None))) else repr(obj)
+
+
+def write_manifest(run_dir: str, config=None, seeds=None, argv=None,
+                   extra: Optional[Dict] = None) -> str:
+    """Write ``manifest.json``: everything needed to attribute a
+    telemetry stream to an exact code + config + hardware state.
+
+    ``config`` may be a GibbsConfig (dataclass), dict, or None; ``seeds``
+    a scalar/sequence of the RNG seeds in play. Atomic write — a crash
+    cannot leave a torn manifest.
+    """
+    import jax as _jax  # manifest wants versions; import is cheap by now
+
+    manifest = {
+        "schema": 1,
+        "created_unix": round(time.time(), 3),
+        "git_sha": _git_sha(),
+        "argv": list(argv if argv is not None else sys.argv),
+        "python": sys.version.split()[0],
+        "jax_version": _jax.__version__,
+        "devices": _device_topology(),
+        "seeds": _jsonable(seeds),
+        "config": _jsonable(config),
+        "env": {k: v for k, v in sorted(os.environ.items())
+                if k.startswith(("GST_", "JAX_", "XLA_FLAGS"))},
+    }
+    if extra:
+        manifest.update(_jsonable(extra))
+    os.makedirs(run_dir, exist_ok=True)
+    path = os.path.join(run_dir, "manifest.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(manifest, fh, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+def read_events(path: str) -> List[Dict]:
+    """Parse an ``events.jsonl`` (tolerating a torn final line from a
+    crash) — the round-trip counterpart of :meth:`MetricsRegistry.emit`.
+    ``path`` may be the file or its run directory."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "events.jsonl")
+    out = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # torn tail of a killed run
+    return out

@@ -1,0 +1,202 @@
+"""In-kernel sampler telemetry: the ``Telemetry`` pytree.
+
+A small per-chain pytree carried through the jit'd Gibbs chunk scan
+(``backends/jax_backend.py`` ``_make_chunk_fn``, and both ensemble step
+forms in ``parallel/ensemble.py``). Per sweep it accumulates, entirely
+on device:
+
+- per-MH-block accept sums (the sweep's ``acc_white``/``acc_hyper``
+  rates summed, so the drain yields exact per-chunk acceptance rates);
+- a per-chain non-finite divergence counter plus a sticky flag, with
+  the same state predicate as ``JaxGibbs.diverged_mask``;
+- the chunk-end log-posterior (filled once per chunk after the scan —
+  a per-sweep evaluation would pay an extra factorization per sweep).
+
+The pytree is zeroed at each chunk start and drained to host WITH the
+chunk's record flush, so telemetry adds no device synchronization points
+beyond the ones chain recording already pays; host-side accumulation
+across chunks lives in :class:`TelemetryAccumulator`. Updates read the
+post-sweep state only — they never touch the RNG stream — so chains with
+telemetry on are bit-identical to chains with it off
+(tests/test_obs.py::test_telemetry_leaves_chains_bit_identical).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+#: ``ChainResult.stats`` key prefix for drained telemetry. These are
+#: run-level per-chain aggregates, not per-sweep arrays: ``burn`` passes
+#: them through and ``select_pulsar`` indexes their leading pulsar axis
+#: (backends/base.py).
+TELE_PREFIX = "tele_"
+
+
+class Telemetry(NamedTuple):
+    """Per-chain telemetry carried through one chunk's scan. All fields
+    are scalars per chain; batching (chains, pulsars) comes from the
+    surrounding ``vmap``/``shard_map``, exactly like ``ChainState``."""
+
+    sweeps: jnp.ndarray        # () int32 — sweeps folded into this chunk
+    accept_white: jnp.ndarray  # () f32 — sum of per-sweep block accept rates
+    accept_hyper: jnp.ndarray  # () f32
+    nonfinite: jnp.ndarray     # () int32 — sweeps whose state went non-finite
+    diverged: jnp.ndarray      # () bool — sticky non-finite flag
+    logpost: jnp.ndarray       # () f32 — chunk-end log-posterior
+
+
+def telemetry_init(dtype=jnp.float32) -> Telemetry:
+    """Chunk-start zeros (a fresh pytree per chunk; cross-chunk totals
+    accumulate on host so float32 sums cannot saturate on long runs)."""
+    f = jnp.zeros((), dtype)
+    return Telemetry(sweeps=jnp.zeros((), jnp.int32), accept_white=f,
+                     accept_hyper=f, nonfinite=jnp.zeros((), jnp.int32),
+                     diverged=jnp.zeros((), bool), logpost=f)
+
+
+def _chain_bad(state) -> jnp.ndarray:
+    """Single-chain divergence predicate — the same state fields and
+    semantics as ``JaxGibbs._diverged_mask_device`` (non-finite anywhere,
+    or a non-positive auxiliary scale), without the batch axes."""
+    def nf(a):
+        return ~jnp.isfinite(a).all()
+
+    return (nf(state.x) | nf(state.b) | nf(state.theta) | nf(state.alpha)
+            | nf(state.df) | (state.alpha <= 0).any())
+
+
+def telemetry_update(tl: Telemetry, state) -> Telemetry:
+    """Fold one post-sweep single-chain state into the chunk telemetry.
+    Pure elementwise reductions — O(n) against the sweep's O(n·m + m³),
+    and no new host syncs; ``vmap`` for batched (chain-axis) states."""
+    bad = _chain_bad(state)
+    return Telemetry(
+        sweeps=tl.sweeps + 1,
+        accept_white=tl.accept_white + state.acc_white,
+        accept_hyper=tl.accept_hyper + state.acc_hyper,
+        nonfinite=tl.nonfinite + bad.astype(jnp.int32),
+        diverged=tl.diverged | bad,
+        logpost=tl.logpost,
+    )
+
+
+class TelemetryAccumulator:
+    """Host-side cross-chunk aggregation of drained ``Telemetry`` pytrees.
+
+    ``add`` takes one chunk's device_get result (leaves shaped ``(C,)``
+    for the single-model backend, ``(P, C)`` for ensembles) and folds it
+    into running totals; ``stats()`` renders the run-level per-chain
+    aggregates under :data:`TELE_PREFIX` keys for ``ChainResult.stats``;
+    ``emit_chunk`` writes the per-chunk JSONL event and updates registry
+    counters/gauges when a :class:`~gibbs_student_t_tpu.obs.metrics.
+    MetricsRegistry` is attached.
+    """
+
+    def __init__(self):
+        self._sweeps = 0
+        self._acc_w = None
+        self._acc_h = None
+        self._nonfinite = None
+        self._diverged = None
+        self._logpost = None
+
+    def add(self, tl: Telemetry) -> Dict[str, object]:
+        """Fold one drained chunk in; returns that chunk's own summary
+        (the payload ``emit_chunk`` writes)."""
+        sweeps = int(np.asarray(tl.sweeps).flat[0])
+        acc_w = np.asarray(tl.accept_white, np.float64)
+        acc_h = np.asarray(tl.accept_hyper, np.float64)
+        nonf = np.asarray(tl.nonfinite, np.int64)
+        div = np.asarray(tl.diverged, bool)
+        self._sweeps += sweeps
+        self._acc_w = acc_w if self._acc_w is None else self._acc_w + acc_w
+        self._acc_h = acc_h if self._acc_h is None else self._acc_h + acc_h
+        self._nonfinite = (nonf if self._nonfinite is None
+                           else self._nonfinite + nonf)
+        self._diverged = (div if self._diverged is None
+                          else self._diverged | div)
+        self._logpost = np.asarray(tl.logpost, np.float64)
+        denom = max(sweeps, 1)
+        finite_lp = self._logpost[np.isfinite(self._logpost)]
+        return {
+            "sweeps": sweeps,
+            "acc_white": round(float(acc_w.mean()) / denom, 4),
+            "acc_hyper": round(float(acc_h.mean()) / denom, 4),
+            "nonfinite_sweeps": int(nonf.sum()),
+            "diverged_chains": int(div.sum()),
+            "logpost_mean": (round(float(finite_lp.mean()), 3)
+                             if finite_lp.size else None),
+            "logpost_min": (round(float(finite_lp.min()), 3)
+                            if finite_lp.size else None),
+        }
+
+    def emit_chunk(self, registry, sweep_end: int,
+                   chunk_summary: Dict[str, object]) -> None:
+        nchains = int(np.asarray(self._acc_w).size)
+        registry.counter("sweeps_total").inc(
+            chunk_summary["sweeps"] * nchains)
+        registry.counter("nonfinite_sweeps_total").inc(
+            chunk_summary["nonfinite_sweeps"])
+        registry.gauge("diverged_chains").set(
+            chunk_summary["diverged_chains"])
+        for blk in ("white", "hyper"):
+            registry.gauge(f"accept_{blk}").set(
+                chunk_summary[f"acc_{blk}"])
+        registry.emit("chunk", sweep_end=sweep_end, **chunk_summary)
+
+    @property
+    def empty(self) -> bool:
+        return self._acc_w is None
+
+    def stats(self) -> Dict[str, np.ndarray]:
+        """Run-level ``ChainResult.stats`` entries (TELE_PREFIX keys)."""
+        if self.empty:
+            return {}
+        denom = max(self._sweeps, 1)
+        return {
+            "tele_sweeps": np.asarray(self._sweeps),
+            "tele_accept_white": (self._acc_w / denom).astype(np.float32),
+            "tele_accept_hyper": (self._acc_h / denom).astype(np.float32),
+            "tele_nonfinite": self._nonfinite,
+            "tele_diverged": self._diverged,
+            "tele_logpost": self._logpost.astype(np.float32),
+        }
+
+
+def combine_tele_stats(per_segment: List[Dict[str, np.ndarray]]
+                       ) -> Dict[str, np.ndarray]:
+    """Merge TELE_PREFIX stats across ``sample_until`` segments: sweep
+    counts and non-finite counters sum, acceptance means reweight by
+    each segment's sweep count, the sticky flag ORs, and the running
+    log-posterior keeps the last segment's value."""
+    per_segment = [s for s in per_segment if "tele_sweeps" in s]
+    if not per_segment:
+        return {}
+    weights = np.array([int(s["tele_sweeps"]) for s in per_segment],
+                       np.float64)
+    total = max(weights.sum(), 1.0)
+    out = {
+        "tele_sweeps": np.asarray(int(weights.sum())),
+        "tele_nonfinite": np.sum(
+            [s["tele_nonfinite"] for s in per_segment], axis=0),
+        "tele_diverged": np.logical_or.reduce(
+            [s["tele_diverged"] for s in per_segment]),
+        "tele_logpost": per_segment[-1]["tele_logpost"],
+    }
+    for blk in ("white", "hyper"):
+        k = f"tele_accept_{blk}"
+        out[k] = (np.sum([w * np.asarray(s[k], np.float64) for w, s
+                          in zip(weights, per_segment)], axis=0)
+                  / total).astype(np.float32)
+    return out
+
+
+def tele_stats_of(stats: Dict[str, np.ndarray]
+                  ) -> Optional[Dict[str, np.ndarray]]:
+    """The TELE_PREFIX subset of a ``ChainResult.stats`` dict, or None
+    when the run carried no telemetry."""
+    sub = {k: v for k, v in stats.items() if k.startswith(TELE_PREFIX)}
+    return sub or None

@@ -1,0 +1,48 @@
+"""Profiler hooks: XLA trace capture and named spans.
+
+Two kinds of span, matching where the code runs:
+
+- :func:`block_span` — ``jax.named_scope``, a TRACE-time annotation that
+  names the enclosed ops in the lowered XLA program. Zero runtime cost
+  (it only renames HLO metadata), so the Gibbs sweep stages carry these
+  unconditionally (backends/jax_backend.py) and an xprof/perfetto view
+  of a ``--trace-dir`` capture shows ``gibbs/white_mh``,
+  ``gibbs/tnt_reduction``, ``gibbs/hyper_mh``, ``gibbs/b_draw``,
+  ``gibbs/aux_draws`` instead of one opaque fused blob.
+- :func:`host_span` — ``jax.profiler.TraceAnnotation``, a host-side
+  wall-clock span for un-jitted work (chunk flush, spool append).
+
+:func:`trace_to` wraps ``jax.profiler.trace`` and degrades to a no-op
+when the directory is falsy, so drivers pass their ``--trace-dir`` flag
+straight through without branching.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+def trace_to(trace_dir):
+    """``jax.profiler.trace(trace_dir)`` or a null context when
+    ``trace_dir`` is None/empty — view captures with xprof/tensorboard."""
+    if not trace_dir:
+        return contextlib.nullcontext()
+    return jax.profiler.trace(trace_dir)
+
+
+def block_span(name: str):
+    """Trace-time span for jitted code: names the ops compiled under it
+    (``jax.named_scope``); shows up in XLA traces, costs nothing at
+    runtime."""
+    return jax.named_scope(name)
+
+
+def host_span(name: str):
+    """Host-side profiler span for Python-level work between dispatches
+    (no-op outside an active ``trace_to`` capture)."""
+    try:
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:  # noqa: BLE001 - observability must never crash a run
+        return contextlib.nullcontext()

@@ -58,6 +58,8 @@ except ImportError:  # pragma: no cover
     pltpu = None
     _HAVE_PLTPU = False
 
+from gibbs_student_t_tpu.ops.pallas_util import tpu_compiler_params
+
 # Above this the statically-unrolled kernel program gets large and the
 # O(m^2)-per-tile VMEM working set stops fitting comfortably.
 MAX_PALLAS_DIM = 160
@@ -164,10 +166,8 @@ def chol_fused_lane(S, rhs, chain_tile: int = 128, interpret: bool = False
     Sf, rf = _pad_batch_identity(Sf, rf, Bp - B)
     St, rt = _to_lane_layout(Sf, rf)
 
-    kwargs = {}
-    if _HAVE_PLTPU:  # chain tiles are independent
-        kwargs["compiler_params"] = pltpu.CompilerParams(
-            dimension_semantics=("parallel",))
+    # chain tiles are independent
+    kwargs = tpu_compiler_params(("parallel",))
     kernel = functools.partial(_chol_kernel, mp=mp)
     Lt, ut, ld = pl.pallas_call(
         kernel,
@@ -219,10 +219,7 @@ def tri_solve_T_lane(L, rhs, chain_tile: int = 128,
     Lf, rf = _pad_batch_identity(Lf, rf, Bp - B)
     Lt, rt = _to_lane_layout(Lf, rf)
 
-    kwargs = {}
-    if _HAVE_PLTPU:
-        kwargs["compiler_params"] = pltpu.CompilerParams(
-            dimension_semantics=("parallel",))
+    kwargs = tpu_compiler_params(("parallel",))
     kernel = functools.partial(_backsolve_kernel, mp=mp)
     xt = pl.pallas_call(
         kernel,

@@ -72,6 +72,7 @@ from gibbs_student_t_tpu.ops.pallas_util import (
     pad_chains_edge,
     pltpu,
     round_up as _round_up,
+    tpu_compiler_params,
     vmem_spec as _spec,
 )
 from gibbs_student_t_tpu.ops.pallas_white import _lnprior_cols
@@ -469,8 +470,7 @@ def hyper_mh_fused(x, S0, dS0, rt, base, dx, logu, K, sel, specs,
 
     if not _HAVE_PLTPU:  # pragma: no cover - no-TPU-extension builds
         raise RuntimeError("pallas TPU extension unavailable")
-    kwargs = {"compiler_params": pltpu.CompilerParams(
-        dimension_semantics=("parallel",))}
+    kwargs = tpu_compiler_params(("parallel",))
     scratch = [pltpu.VMEM((vp, vp, tile), jnp.float32)]
     kernel = functools.partial(_hyper_kernel, nsteps=S, v=v, p=p,
                                hyp_idx=hyp_idx, jitter=jitter)

@@ -40,6 +40,8 @@ except ImportError:  # pragma: no cover
     pltpu = None
     _HAVE_PLTPU = False
 
+from gibbs_student_t_tpu.ops.pallas_util import tpu_compiler_params
+
 
 def _round_up(x: int, mult: int) -> int:
     return -(-x // mult) * mult
@@ -124,12 +126,9 @@ def tnt_batched_pallas(T, y, nvec, block_size: int = 256,
 
     kernel = functools.partial(_tnt_kernel, chain_tile=Ct)
     vmem = pltpu.VMEM if _HAVE_PLTPU else None
-    kwargs = {}
-    if _HAVE_PLTPU:
-        # chain tiles are independent ("parallel"); the TOA dimension
-        # accumulates in order ("arbitrary")
-        kwargs["compiler_params"] = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary"))
+    # chain tiles are independent ("parallel"); the TOA dimension
+    # accumulates in order ("arbitrary")
+    kwargs = tpu_compiler_params(("parallel", "arbitrary"))
 
     def spec(shape, index_map):
         if vmem is None:

@@ -67,6 +67,22 @@ def int_from_env(var: str, default: int, mult: int = 8) -> int:
     return round_up(max(val, mult), mult)
 
 
+def tpu_compiler_params(dimension_semantics) -> dict:
+    """``{"compiler_params": ...}`` for a ``pl.pallas_call``, or ``{}``
+    when the TPU extension is absent. The class moved names across jax
+    releases (``TPUCompilerParams`` → ``CompilerParams``) — resolve
+    whichever the installed build exports, same version-tolerance
+    contract as ``parallel/compat.shard_map``."""
+    if not HAVE_PLTPU:
+        return {}
+    cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams", None)
+    if cls is None:  # pragma: no cover - unexpected pltpu surface
+        return {}
+    return {"compiler_params": cls(
+        dimension_semantics=tuple(dimension_semantics))}
+
+
 def pad_chains_edge(arr, to: int):
     """Pad the leading (chain) axis to ``to`` rows by edge-replication,
     so padded rows stay finite and in-bounds for any downstream math."""

@@ -67,6 +67,7 @@ from gibbs_student_t_tpu.ops.pallas_util import (
     mode_from_env,
     pltpu,
     round_up as _round_up,
+    tpu_compiler_params,
     vmem_spec as _spec,
 )
 
@@ -515,10 +516,8 @@ def white_mh_fused(x, az, yred2, dx, logu, rows, specs, var,
     dxp = jnp.moveaxis(flat(pad_chains(_pad_lanes(dx, P))), 1, 0)
     lup = flat(pad_chains(_pad_lanes(logu, SP)))
 
-    kwargs = {}
-    if _HAVE_PLTPU:  # chain tiles are independent
-        kwargs["compiler_params"] = pltpu.CompilerParams(
-            dimension_semantics=("parallel",))
+    # chain tiles are independent
+    kwargs = tpu_compiler_params(("parallel",))
     kernel = functools.partial(_white_kernel, nsteps=S, p=p, var=var)
     xo, ao = pl.pallas_call(
         kernel,
@@ -590,10 +589,7 @@ def white_mtm_fused(x, az, yred2, dx, dxr, gumb, logu, rows, specs, var,
         gumb.reshape(G, C, S * K), SK)))
     lup = flat(pad_chains(_pad_lanes(logu, SP)))
 
-    kwargs = {}
-    if _HAVE_PLTPU:
-        kwargs["compiler_params"] = pltpu.CompilerParams(
-            dimension_semantics=("parallel",))
+    kwargs = tpu_compiler_params(("parallel",))
     kernel = functools.partial(_white_mtm_kernel, nsteps=S, K=K, p=p,
                                var=var)
     xo, ao = pl.pallas_call(

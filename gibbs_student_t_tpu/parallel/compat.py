@@ -1,0 +1,36 @@
+"""Version-tolerant ``shard_map``.
+
+The installed jax moved ``shard_map`` twice: old releases expose it only
+as ``jax.experimental.shard_map`` (replication checking spelled
+``check_rep``), newer ones promote it to ``jax.shard_map`` and rename the
+flag ``check_vma``. Every in-repo caller goes through this wrapper so the
+sharded ensemble (parallel/ensemble.py) and the collective-diagnostic
+tests import cleanly on either API.
+"""
+
+from __future__ import annotations
+
+import functools
+
+try:  # jax >= 0.6: top-level export, check_vma spelling
+    from jax import shard_map as _shard_map
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # older jax: experimental module, check_rep spelling
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+@functools.wraps(_shard_map)
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``shard_map`` with the new-API surface on any installed jax.
+
+    ``check_vma`` maps onto the installed API's replication-check flag
+    (``check_rep`` on pre-promotion releases — same semantics, renamed).
+    """
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **{_CHECK_KW: check_vma})
+
+
+__all__ = ["shard_map"]

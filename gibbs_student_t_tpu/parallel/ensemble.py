@@ -22,8 +22,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import random
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from gibbs_student_t_tpu.parallel.compat import shard_map
 
 from gibbs_student_t_tpu.backends.base import ChainResult
 from gibbs_student_t_tpu.backends.jax_backend import (
@@ -36,6 +37,12 @@ from gibbs_student_t_tpu.backends.jax_backend import (
 )
 from gibbs_student_t_tpu.config import GibbsConfig
 from gibbs_student_t_tpu.models.pta import ModelArrays
+from gibbs_student_t_tpu.obs.telemetry import (
+    Telemetry,
+    TelemetryAccumulator,
+    telemetry_init,
+    telemetry_update,
+)
 
 
 def _localize_names(ma: ModelArrays) -> ModelArrays:
@@ -162,7 +169,14 @@ class EnsembleGibbs:
                  nchains: int = 64, mesh: Optional[Mesh] = None,
                  dtype=jnp.float32, chunk_size: int = 50,
                  record: str = "compact8", record_thin: int = 1,
-                 unroll: bool | str = "auto"):
+                 unroll: bool | str = "auto",
+                 telemetry: bool = True, metrics=None):
+        """``telemetry``/``metrics`` as in ``JaxGibbs``: the in-kernel
+        ``Telemetry`` pytree rides each (pulsar, chain) population's
+        chunk scan — sharded with the state when a mesh is present —
+        and drains with the record flush; aggregates land in
+        ``ChainResult.stats`` under ``tele_*`` keys with leading
+        ``(npulsars, nchains)`` axes (``select_pulsar`` slices them)."""
         self.npulsars = len(mas)
         self.nchains = nchains
         self.mesh = mesh
@@ -209,6 +223,8 @@ class EnsembleGibbs:
         # white/hyper constant construction for dead host memory
         self._fused_consts = (None if self._unrolled
                               else self._build_fused_consts())
+        self._telemetry = bool(telemetry)
+        self.metrics = metrics
         self._step = self._build_step()
         # per-pulsar population-covariance re-estimation at chunk
         # boundaries (MHConfig.adapt_cov): the single-model update
@@ -345,6 +361,12 @@ class EnsembleGibbs:
         fields = template._record_fields
         casts = template._record_casts
         thin = template.record_thin
+        use_tele = self._telemetry
+        # telemetry leaves shard exactly like the state: per (pulsar,
+        # chain) scalars
+        tele_spec = (Telemetry(*(P("pulsar", "chain"),)
+                               * len(Telemetry._fields))
+                     if use_tele else None)
 
         if self._unrolled:
             # UNROLLED step: a Python loop over the per-pulsar baked
@@ -357,20 +379,29 @@ class EnsembleGibbs:
             backends = self._pulsar_backends
 
             def baked_chunk(gb_p, state, chain_key, offset, length):
-                def body(st, i0):
+                def one(j, c):
+                    s, t = c
+                    s = gb_p._sweep(s, random.fold_in(chain_key, j),
+                                    sweep=j)
+                    return s, (telemetry_update(t, s) if use_tele else t)
+
+                def body(carry, i0):
+                    st, tl = carry
                     rec = record_tuple(st, fields, casts)
+                    if thin == 1:
+                        st, tl = one(i0, (st, tl))
+                    else:
+                        st, tl = jax.lax.fori_loop(
+                            0, thin,
+                            lambda j, c: one(i0 + j, c), (st, tl))
+                    return (st, tl), rec
 
-                    def one(j, s):
-                        return gb_p._sweep(
-                            s, random.fold_in(chain_key, i0 + j),
-                            sweep=i0 + j)
-
-                    st = (one(0, st) if thin == 1
-                          else jax.lax.fori_loop(0, thin, one, st))
-                    return st, rec
-
-                return jax.lax.scan(body, state,
-                                    offset + jnp.arange(0, length, thin))
+                (st, tl), recs = jax.lax.scan(
+                    body, (state, telemetry_init(self.dtype)),
+                    offset + jnp.arange(0, length, thin))
+                if use_tele:
+                    tl = tl._replace(logpost=gb_p._logpost_chain(st))
+                return st, recs, tl
 
             def step_unrolled(states, keys, offset, length):
                 def run(st_block, key_block):
@@ -381,7 +412,9 @@ class EnsembleGibbs:
                         outs.append(jax.vmap(functools.partial(
                             baked_chunk, gb_p, offset=offset,
                             length=length))(st_p, key_block[pi]))
-                    return jax.tree.map(lambda *xs: jnp.stack(xs), *outs)
+                    st, recs, tl = jax.tree.map(
+                        lambda *xs: jnp.stack(xs), *outs)
+                    return st, (recs, tl if use_tele else None)
 
                 if self.mesh is None:
                     return run(states, keys)
@@ -392,7 +425,7 @@ class EnsembleGibbs:
                 return shard_map(
                     run, mesh=self.mesh,
                     in_specs=(specs_state, key_spec),
-                    out_specs=(specs_state, out_rec_spec),
+                    out_specs=(specs_state, (out_rec_spec, tele_spec)),
                     check_vma=False,
                 )(states, keys)
 
@@ -410,22 +443,31 @@ class EnsembleGibbs:
             # scan over recorded rows, inner loop over the thin sweeps
             # between them — same structure and keying as the
             # single-model chunk fn (backends/jax_backend.py)
-            def body(st, i0):
+            def one(j, c):
+                s, t = c
+                s = template._sweep(s, random.fold_in(chain_key, j),
+                                    ma=ma_p, sweep=j, fused=fc_p)
+                return s, (telemetry_update(t, s) if use_tele else t)
+
+            def body(carry, i0):
+                st, tl = carry
                 # same compact device-side transport casts as the
                 # single-model backend
                 rec = record_tuple(st, fields, casts)
+                if thin == 1:
+                    st, tl = one(i0, (st, tl))
+                else:
+                    st, tl = jax.lax.fori_loop(
+                        0, thin, lambda j, c: one(i0 + j, c), (st, tl))
+                return (st, tl), rec
 
-                def one(j, s):
-                    return template._sweep(
-                        s, random.fold_in(chain_key, i0 + j), ma=ma_p,
-                        sweep=i0 + j, fused=fc_p)
-
-                st = (one(0, st) if thin == 1
-                      else jax.lax.fori_loop(0, thin, one, st))
-                return st, rec
-
-            return jax.lax.scan(body, state,
-                                offset + jnp.arange(0, length, thin))
+            (st, tl), recs = jax.lax.scan(
+                body, (state, telemetry_init(self.dtype)),
+                offset + jnp.arange(0, length, thin))
+            if use_tele:
+                tl = tl._replace(
+                    logpost=template._logpost_chain(st, ma=ma_p))
+            return st, recs, tl
 
         def step(stacked_ma, fc, states, keys, offset, length):
             def run(ma_block, fc_block, st_block, key_block):
@@ -435,8 +477,9 @@ class EnsembleGibbs:
                                           offset=offset, length=length)
                     )(st_p, keys_p)
 
-                return jax.vmap(per_pulsar)(ma_block, fc_block, st_block,
-                                            key_block)
+                st, recs, tl = jax.vmap(per_pulsar)(
+                    ma_block, fc_block, st_block, key_block)
+                return st, (recs, tl if use_tele else None)
 
             if self.mesh is None:
                 return run(stacked_ma, fc, states, keys)
@@ -453,7 +496,7 @@ class EnsembleGibbs:
             return shard_map(
                 run, mesh=self.mesh,
                 in_specs=(specs_ma, specs_fc, specs_state, key_spec),
-                out_specs=(specs_state, out_rec_spec),
+                out_specs=(specs_state, (out_rec_spec, tele_spec)),
                 check_vma=False,
             )(stacked_ma, fc, states, keys)
 
@@ -505,8 +548,14 @@ class EnsembleGibbs:
         fields = self.template._record_fields
         n_reinits0 = (int(spool.load_run_stats().get("n_reinits", 0))
                       if spool is not None and resume else 0)
+        tele_acc = TelemetryAccumulator() if self._telemetry else None
 
         def flush(recs, chunk_state, sweep_end, n_reinits):
+            recs, tl = recs
+            if tele_acc is not None and tl is not None:
+                summary = tele_acc.add(jax.device_get(tl))
+                if self.metrics is not None:
+                    tele_acc.emit_chunk(self.metrics, sweep_end, summary)
             # n_last: ensemble records are padded to n_max (stacked
             # models), not the template pulsar's own TOA count
             host = self.template._materialize(
@@ -554,6 +603,8 @@ class EnsembleGibbs:
         res.stats["n_toa"] = self.n_toa
         if reinit_diverged:
             res.stats["n_reinits"] = np.asarray(n_reinits)
+        if tele_acc is not None and not tele_acc.empty:
+            res.stats.update(tele_acc.stats())
         return res
 
     def sample_until(self, rhat_target: float = 1.01,

@@ -2,8 +2,12 @@
 
 The reference's only observability is a progress line every 100 sweeps
 (reference gibbs.py:382-385). ``BlockTimer`` adds per-block wall timing with
-``block_until_ready`` fencing so device work is attributed correctly;
-XLA-level traces are one ``jax.profiler.trace`` away (SURVEY.md §5).
+``block_until_ready`` fencing so device work is attributed correctly; it
+is also the wall-clock source of the metrics registry
+(``obs.metrics.MetricsRegistry.timer`` — ``registry.time(...)`` delegates
+here and mirrors durations into histograms), so bench breakdowns and
+telemetry snapshots share one timing implementation. XLA-level traces
+live in ``obs/tracing.py`` (``trace_to`` / per-block ``gibbs/*`` spans).
 """
 
 from __future__ import annotations

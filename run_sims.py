@@ -55,13 +55,14 @@ def model_configs(pspin: float = 0.00457):
 def run_one(ma, cfg, backend: str, niter: int, nchains: int, seed: int,
             record: str = "compact8", record_thin: int = 1,
             until_rhat: float = 0.0, check_every: int = 500,
-            min_ess: float = 0.0):
+            min_ess: float = 0.0, telemetry: bool = True, metrics=None):
     from gibbs_student_t_tpu.backends import get_backend
 
     cls = get_backend(backend)
     if cls.supports_chains:
         gb = cls(ma, cfg, nchains=nchains, record=record,
-                 record_thin=record_thin)
+                 record_thin=record_thin, telemetry=telemetry,
+                 metrics=metrics)
         if until_rhat:
             # convergence-stopped run: --niter becomes the cap
             return gb.sample_until(rhat_target=until_rhat,
@@ -89,6 +90,39 @@ def _summarize(key: str, res, dt: float, niter: int) -> str:
                      f" converged={bool(res.stats['converged'])}"
                      f" rows={res.chain.shape[0]}")
     return "  # " + ", ".join(parts)
+
+
+def _health_line(res) -> str | None:
+    """Per-config chain-health verdict from the drained in-kernel
+    telemetry (obs/health.py) — None when the run carried none (NumPy
+    backend, or --no-telemetry)."""
+    if "tele_diverged" not in res.stats:
+        return None
+    from gibbs_student_t_tpu.obs.health import chain_health, format_health
+
+    window = None
+    if res.chain.ndim == 3 and res.chain.shape[0] >= 8:
+        window = res.chain[res.chain.shape[0] // 2:]
+    return "  # " + format_health(chain_health(res.stats, window=window))
+
+
+def _tele_chain_fields(res) -> dict:
+    """Per-chain telemetry arrays for the ``config_end`` event: run-mean
+    per-block acceptance rates and the non-finite/diverged counters, one
+    entry per chain ((C,) lists; (P, C) nested for ensembles). The chunk
+    events carry only cross-chain aggregates — this is where a JSONL
+    consumer finds which chain went bad."""
+    tele = res.stats
+    if "tele_diverged" not in tele:
+        return {}
+    return {"chains": {
+        "accept_white": np.round(np.asarray(tele["tele_accept_white"],
+                                            np.float64), 4),
+        "accept_hyper": np.round(np.asarray(tele["tele_accept_hyper"],
+                                            np.float64), 4),
+        "nonfinite": tele["tele_nonfinite"],
+        "diverged": tele["tele_diverged"],
+    }}
 
 
 def run_ensemble(args, configs, parfile, timfile, rng):
@@ -137,22 +171,30 @@ def run_ensemble(args, configs, parfile, timfile, rng):
           + (f", mesh {dict(zip(mesh.axis_names, mesh.devices.shape))}"
              if mesh else ""), file=sys.stderr, flush=True)
 
+    from gibbs_student_t_tpu.obs.tracing import trace_to
+
     for key, cfg in configs.items():
         seed = int(rng.integers(0, 2 ** 31))
         ens = EnsembleGibbs(mas, cfg, nchains=args.nchains, mesh=mesh,
                             record=args.record,
                             record_thin=args.record_thin,
                             unroll=("auto" if args.unroll == "auto"
-                                    else bool(int(args.unroll))))
+                                    else bool(int(args.unroll))),
+                            telemetry=args.telemetry,
+                            metrics=args.registry)
+        if args.registry is not None:
+            args.registry.emit("config_start", config=key, seed=seed,
+                               ensemble=args.ensemble)
         t0 = time.perf_counter()
-        if args.until_rhat:
-            res = ens.sample_until(rhat_target=args.until_rhat,
-                                   max_sweeps=args.niter,
-                                   check_every=args.check_every,
-                                   seed=seed,
-                                   min_ess=args.min_ess or None)
-        else:
-            res = ens.sample(niter=args.niter, seed=seed)
+        with trace_to(args.trace_dir):
+            if args.until_rhat:
+                res = ens.sample_until(rhat_target=args.until_rhat,
+                                       max_sweeps=args.niter,
+                                       check_every=args.check_every,
+                                       seed=seed,
+                                       min_ess=args.min_ess or None)
+            else:
+                res = ens.sample(niter=args.niter, seed=seed)
         dt = time.perf_counter() - t0
         sweeps = (res.chain.shape[0] * args.record_thin
                   * args.ensemble * args.nchains)
@@ -162,6 +204,14 @@ def run_ensemble(args, configs, parfile, timfile, rng):
                      f" converged={bool(res.stats['converged'])}")
         print(f"  # {key}: {dt:.1f}s, {sweeps / dt:.0f} "
               f"pulsar-chain-sweeps/s{extra}", file=sys.stderr, flush=True)
+        health = _health_line(res)
+        if health:
+            print(health, file=sys.stderr, flush=True)
+        if args.registry is not None:
+            args.registry.emit("config_end", config=key, seconds=round(dt, 2),
+                               pulsar_chain_sweeps_per_sec=round(
+                                   sweeps / dt, 1),
+                               **_tele_chain_fields(res))
         burned = res.burn(args.burn)
         for i, ma in enumerate(mas):
             # simulated ensembles reuse the base pulsar's name; the index
@@ -242,6 +292,23 @@ def main(argv=None):
                          "backend). --niter stays in SWEEPS (must be a "
                          "multiple of N; niter/N rows come back); "
                          "--burn counts recorded ROWS")
+    ap.add_argument("--telemetry", default=True,
+                    action=argparse.BooleanOptionalAction,
+                    help="jax backend: carry the in-kernel Telemetry "
+                         "pytree (per-block accept counters, per-chain "
+                         "non-finite divergence flags, log-posterior; "
+                         "obs/telemetry.py) and print a per-config "
+                         "chain-health line (obs/health.py)")
+    ap.add_argument("--telemetry-dir", metavar="DIR", default=None,
+                    help="write a run manifest (manifest.json: git SHA, "
+                         "config, device topology, seeds) and stream "
+                         "per-chunk telemetry events (events.jsonl) "
+                         "into DIR (obs/metrics.py; schema in "
+                         "docs/OBSERVABILITY.md)")
+    ap.add_argument("--trace-dir", metavar="DIR", default=None,
+                    help="capture a jax.profiler trace of each config's "
+                         "sampling into DIR; the sweep stages carry "
+                         "gibbs/* named spans (obs/tracing.py)")
     ap.add_argument("--models", nargs="+",
                     default=["vvh17", "uniform", "beta", "gaussian", "t"])
     ap.add_argument("--par", default=None)
@@ -327,9 +394,48 @@ def main(argv=None):
     parfile, timfile = ensure_base_dataset(args.par, args.tim, args.simdir,
                                            args.ntoa, args.seed)
 
-    if args.ensemble:
-        run_ensemble(args, configs, parfile, timfile, rng)
-        return
+    # run-level observability sink: manifest once, then per-chunk events
+    # stream in from the backends (obs/metrics.py)
+    args.registry = None
+    if args.telemetry_dir:
+        if args.backend != "jax" or not args.telemetry:
+            ap.error("--telemetry-dir needs --backend jax with "
+                     "telemetry enabled (the NumPy oracle carries no "
+                     "in-kernel counters)")
+        from gibbs_student_t_tpu.obs import MetricsRegistry
+
+        args.registry = MetricsRegistry(run_dir=args.telemetry_dir)
+        args.registry.write_manifest(
+            config={k: dataclasses_asdict_safe(v)
+                    for k, v in configs.items()},
+            seeds=args.seed,
+            extra={"backend": args.backend, "nchains": args.nchains,
+                   "niter": args.niter, "thetas": args.thetas,
+                   "ensemble": args.ensemble})
+        print(f"# telemetry -> {args.telemetry_dir} "
+              "(manifest.json, events.jsonl)", file=sys.stderr)
+
+    try:
+        if args.ensemble:
+            run_ensemble(args, configs, parfile, timfile, rng)
+            return
+        run_sequential(args, configs, rng, parfile, timfile)
+    finally:
+        if args.registry is not None:
+            args.registry.close()
+
+
+def dataclasses_asdict_safe(cfg):
+    """GibbsConfig -> manifest-ready dict (tolerates non-dataclasses)."""
+    import dataclasses as _dc
+
+    return _dc.asdict(cfg) if _dc.is_dataclass(cfg) else repr(cfg)
+
+
+def run_sequential(args, configs, rng, parfile, timfile):
+    from gibbs_student_t_tpu.data.pulsar import Pulsar
+    from gibbs_student_t_tpu.data.simulate import simulate_data
+    from gibbs_student_t_tpu.obs.tracing import trace_to
 
     for theta in args.thetas:
         idx = int(rng.integers(0, 2 ** 32))
@@ -345,19 +451,35 @@ def main(argv=None):
             ma = build_pta(psr, args.components).frozen()
             for key, cfg in configs.items():
                 seed = int(rng.integers(0, 2 ** 31))
+                if args.registry is not None:
+                    args.registry.emit("config_start", config=key,
+                                       theta=theta, seed=seed,
+                                       outdir=outdir)
                 t0 = time.perf_counter()
-                res = run_one(ma, cfg, args.backend, args.niter,
-                              args.nchains, seed, record=args.record,
-                              record_thin=args.record_thin,
-                              until_rhat=args.until_rhat,
-                              check_every=args.check_every,
-                              min_ess=args.min_ess)
+                with trace_to(args.trace_dir):
+                    res = run_one(ma, cfg, args.backend, args.niter,
+                                  args.nchains, seed, record=args.record,
+                                  record_thin=args.record_thin,
+                                  until_rhat=args.until_rhat,
+                                  check_every=args.check_every,
+                                  min_ess=args.min_ess,
+                                  telemetry=args.telemetry,
+                                  metrics=args.registry)
                 dt = time.perf_counter() - t0
                 out = os.path.join(outdir, key, str(theta), str(idx))
                 res.burn(args.burn).save(out)
                 print(out, flush=True)
                 print(_summarize(key, res, dt, args.niter), file=sys.stderr,
                       flush=True)
+                health = _health_line(res)
+                if health:
+                    print(health, file=sys.stderr, flush=True)
+                if args.registry is not None:
+                    args.registry.emit("config_end", config=key,
+                                       theta=theta, seconds=round(dt, 2),
+                                       sweeps_per_sec=round(
+                                           args.niter / dt, 2),
+                                       **_tele_chain_fields(res))
 
 
 if __name__ == "__main__":

@@ -90,7 +90,8 @@ def test_vmap_consistency(ma):
         k = 3
         sub_state = jax.tree.map(lambda a: a[k:k + 1], state0)
         keys = jrandom.split(jrandom.PRNGKey(11), 8)
-        state, recs = gb1._chunk_fn(sub_state, keys[k:k + 1], 0, length=10)
+        state, (recs, _tl) = gb1._chunk_fn(sub_state, keys[k:k + 1], 0,
+                                           length=10)
         sub_chain = np.swapaxes(np.asarray(recs[0]), 0, 1)
         np.testing.assert_allclose(r8.chain[:, k], sub_chain[:, 0],
                                    rtol=1e-9)

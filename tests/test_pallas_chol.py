@@ -131,6 +131,7 @@ def test_auto_mode_stays_on_expander_on_cpu(monkeypatch):
     assert not enabled
 
 
+@pytest.mark.slow
 def test_sweep_chains_identical_pallas_vs_expander(monkeypatch):
     """Full jitted sweep (MH blocks, robust stacked-jitter b-draw,
     backward solve) produces identical chains on identical keys whether

@@ -137,6 +137,7 @@ def _block_inputs(ma, cols, C, S=5, seed=4):
     lambda: make_demo_model_arrays(n=40, components=5, seed=2),
     _ecorr_ma,
 ])
+@pytest.mark.slow
 def test_kernel_matches_xla_loop(make_ma):
     ma = make_ma()
     cols = np.arange(ma.m)
@@ -152,6 +153,7 @@ def test_kernel_matches_xla_loop(make_ma):
     np.testing.assert_allclose(np.asarray(a1), np.asarray(a0))
 
 
+@pytest.mark.slow
 def test_non_pd_proposals_reject():
     """A matrix block that goes non-PD under every proposal must reject
     all of them (NaN -> -inf -> reject, reference gibbs.py:320-324)."""
@@ -173,6 +175,7 @@ def test_non_pd_proposals_reject():
         assert float(jnp.max(acc)) == 0.0
 
 
+@pytest.mark.slow
 def test_dispatch_under_vmap(monkeypatch):
     ma = make_demo_model_arrays(n=30, components=4, seed=6)
     cols = np.arange(ma.m)
@@ -191,6 +194,7 @@ def test_dispatch_under_vmap(monkeypatch):
     np.testing.assert_allclose(np.asarray(a1), np.asarray(a0))
 
 
+@pytest.mark.slow
 def test_grouped_kernel_matches_per_group_loop():
     """The grouped (per-pulsar constants) hyper kernel must reproduce
     the per-group XLA loop: G models with different phi constants, one
@@ -225,6 +229,7 @@ def test_auto_mode_stays_off_on_cpu(monkeypatch):
     assert not enabled
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("schur", ["auto", False])
 def test_sweep_chains_identical_fused_vs_closure(monkeypatch, schur):
     """Whole-sweep equivalence through the backend: closure path vs the

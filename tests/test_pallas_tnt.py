@@ -107,6 +107,7 @@ def test_auto_chain_tile_respects_vmem_budget():
     np.testing.assert_allclose(out[0], ref[0], rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.slow
 def test_backend_pallas_sweep_matches_vmap_path():
     """The batched-sweep chunk driver (Pallas TNT between vmapped stages)
     must reproduce the per-chain vmap path — same keys, same math."""
@@ -129,6 +130,7 @@ def test_backend_pallas_sweep_matches_vmap_path():
     np.testing.assert_allclose(r_pal.dfchain, r_ref.dfchain)
 
 
+@pytest.mark.slow
 def test_backend_pallas_sweep_record_thin_rows_match():
     """record_thin on the batched (Pallas TNT) chunk driver: thinned
     rows must be bit-identical to every t-th row of the unthinned

@@ -135,6 +135,7 @@ def test_out_of_bounds_proposal_always_rejected():
     assert float(jnp.max(acc2)) == 0.0
 
 
+@pytest.mark.slow
 def test_padded_rows_contribute_nothing():
     """A suffix-padded model (rmask zeros) must give the same block
     output as the unpadded model: pads carry az=1, yred2=0, rmask=0."""
@@ -208,6 +209,7 @@ def test_loop_matches_closure_semantics():
         assert acc == round(float(a1[c]) * dx.shape[1])
 
 
+@pytest.mark.slow
 def test_dispatch_under_vmap(monkeypatch):
     ma = make_demo_model_arrays(n=24, components=4, seed=0)
     wc = build_white_consts(ma)
@@ -228,6 +230,7 @@ def test_dispatch_under_vmap(monkeypatch):
     np.testing.assert_allclose(np.asarray(a1), np.asarray(a0))
 
 
+@pytest.mark.slow
 def test_grouped_kernel_matches_per_group_loop(monkeypatch):
     """The grouped (per-pulsar constants) kernel path must reproduce the
     per-group XLA loop: G models with different variance structure, one
@@ -299,6 +302,7 @@ def _rand_mtm_inputs(ma, C, S=5, K=3, seed=1):
             jnp.asarray(gumb), jnp.asarray(logu))
 
 
+@pytest.mark.slow
 def test_mtm_kernel_matches_xla_loop():
     """The fused white-MTM kernel (interpret) must reproduce the XLA
     MTM twin on identical precomputed draws — selection, weight-sum
@@ -319,6 +323,7 @@ def test_mtm_kernel_matches_xla_loop():
     np.testing.assert_allclose(np.asarray(a1[0]), np.asarray(a0))
 
 
+@pytest.mark.slow
 def test_mtm_grouped_kernel_matches_per_group_loop():
     from gibbs_student_t_tpu.ops.pallas_white import (
         white_mtm_fused, white_mtm_loop_xla)
@@ -342,6 +347,7 @@ def test_mtm_grouped_kernel_matches_per_group_loop():
         np.testing.assert_allclose(np.asarray(af[g]), np.asarray(a0))
 
 
+@pytest.mark.slow
 def test_sweep_chains_identical_mtm_fused_vs_closure(monkeypatch):
     """Whole-sweep MTM equivalence across all THREE implementations on
     identical keys: the validated _mtm_block closure (the reference
@@ -370,6 +376,7 @@ def test_sweep_chains_identical_mtm_fused_vs_closure(monkeypatch):
                                       np.asarray(rc.zchain))
 
 
+@pytest.mark.slow
 def test_sweep_chains_identical_fused_vs_loop(monkeypatch):
     """Whole-sweep equivalence through the backend: same keys, kernel on
     (interpret) vs off. The fused path and the XLA loop consume the same

@@ -71,6 +71,7 @@ def test_stack_model_arrays_shapes():
     assert "log10_equad" in stacked.param_names[0]
 
 
+@pytest.mark.slow
 def test_ensemble_sharded_matches_unsharded():
     """shard_map over ('pulsar','chain') must be numerically identical to
     the plain vmap path — sharding is layout, not math."""
@@ -92,6 +93,7 @@ def test_ensemble_sharded_matches_unsharded():
                                rtol=2e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_ensemble_unrolled_matches_grouped():
     """The baked-consts UNROLLED step (per-pulsar single-model traces,
     VERDICT r4 #1) must reproduce the grouped traced-consts step — the
@@ -151,6 +153,7 @@ def test_ensemble_unroll_env_override(monkeypatch):
         build()
 
 
+@pytest.mark.slow
 def test_ensemble_pulsars_get_distinct_posteriors():
     mas = _ensemble_mas()
     cfg = GibbsConfig(model="gaussian")
@@ -186,6 +189,7 @@ def test_pad_model_arrays_likelihood_exact():
     assert mask is not None and int(n_stat) == 40
 
 
+@pytest.mark.slow
 def test_heterogeneous_ensemble_matches_manual_replay():
     """Pulsars with different TOA counts stack via auto-padding, sample
     finite, and each pulsar's trajectory equals a direct vmapped replay of
@@ -241,8 +245,9 @@ def test_heterogeneous_ensemble_matches_manual_replay():
 
 def test_rhat_collective_matches_host():
     """psum-based R-hat inside shard_map == host gelman_rubin."""
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from gibbs_student_t_tpu.parallel.compat import shard_map
 
     rng = np.random.default_rng(0)
     samples = rng.standard_normal((8, 200)) + rng.standard_normal((8, 1)) * 0.3
@@ -305,6 +310,7 @@ def test_batched_autocorr_matches_per_column():
     np.testing.assert_allclose(got, expect, rtol=1e-12)
 
 
+@pytest.mark.slow
 def test_ensemble_fused_kernels_match_closure(monkeypatch):
     """Ensembles reach the fused MH kernels through traced per-pulsar
     constants (FusedConsts): kernel-on (interpret) and kernel-off runs
@@ -345,6 +351,7 @@ def test_ensemble_fused_kernels_match_closure(monkeypatch):
                                   np.asarray(r2.zchain))
 
 
+@pytest.mark.slow
 def test_ensemble_mtm_fused_matches_xla(monkeypatch):
     """Multiple-try MH composes with ensembles: the grouped white-MTM
     kernel (interpret) must reproduce the XLA path chain-for-chain
@@ -370,6 +377,7 @@ def test_ensemble_mtm_fused_matches_xla(monkeypatch):
                                   np.asarray(r0.zchain))
 
 
+@pytest.mark.slow
 def test_graft_entry_dryrun():
     """The driver-facing entry points compile and run on the fake mesh."""
     import __graft_entry__ as ge
@@ -380,6 +388,7 @@ def test_graft_entry_dryrun():
     ge.dryrun_multichip(8)
 
 
+@pytest.mark.slow
 def test_ensemble_unrolled_chol_matches_expander(monkeypatch):
     """The TPU-gated unrolled linalg path must hold under the ensemble's
     traced per-pulsar ModelArrays too (vmap over pulsars x chains)."""
@@ -397,6 +406,7 @@ def test_ensemble_unrolled_chol_matches_expander(monkeypatch):
     np.testing.assert_allclose(outs["1"], outs["0"], rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.slow
 def test_ensemble_resume_matches_unbroken():
     """Ensemble sampling resumed from last_state reproduces the unbroken
     run exactly (per-sweep fold_in keying, as the single-model backend)."""
@@ -413,6 +423,7 @@ def test_ensemble_resume_matches_unbroken():
     np.testing.assert_allclose(stitched, full, rtol=1e-6, atol=1e-7)
 
 
+@pytest.mark.slow
 def test_ensemble_resume_across_step_forms():
     """A checkpoint written by the GROUPED step resumes on the UNROLLED
     step (and continues the same chains): the state pytree and the
@@ -435,6 +446,7 @@ def test_ensemble_resume_across_step_forms():
     np.testing.assert_allclose(stitched, full, rtol=2e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_ensemble_compact_record_matches_full():
     """The ensemble's compact record transport (same wire casts as the
     single-model backend) reproduces full-precision recording: x/z
@@ -457,6 +469,7 @@ def test_ensemble_compact_record_matches_full():
     np.testing.assert_allclose(f.alphachain, c.alphachain, rtol=1e-2)
 
 
+@pytest.mark.slow
 def test_ensemble_compact8_heterogeneous():
     """compact8 through the ensemble path, with UNEQUAL TOA counts: the
     bit-packed z must unpack at the stacked n_max, not the template
@@ -482,6 +495,7 @@ def test_ensemble_compact8_heterogeneous():
     assert str(c8.stats["record_mode"]) == "compact8"
 
 
+@pytest.mark.slow
 def test_pallas_chol_engages_inside_shard_map(monkeypatch):
     """The custom_vmap Pallas Cholesky dispatch must survive the
     ensemble's shard_map + nested vmap and land in the traced program
@@ -517,6 +531,7 @@ def _native_or_skip():
     assert native.available(), "native build failed"
 
 
+@pytest.mark.slow
 def test_ensemble_spool_resume_matches_unbroken(tmp_path):
     """Ensemble twin of the single-model kill/resume spool flow
     (tests/test_native.py; VERDICT r2 weak #4): 6 sweeps spooled,
@@ -571,6 +586,7 @@ def test_ensemble_diverged_mask_and_reinit():
                                       np.asarray(state.x)[p, c])
 
 
+@pytest.mark.slow
 def test_ensemble_sample_recovers_injected_divergence():
     mas = [make_demo_pta(make_demo_pulsar(seed=97 + i, n=24)[0],
                          components=4).frozen() for i in range(2)]
@@ -585,6 +601,7 @@ def test_ensemble_sample_recovers_injected_divergence():
     assert np.isfinite(res.chain[-1]).all()
 
 
+@pytest.mark.slow
 def test_ensemble_sample_until():
     """Ensemble convergence stopping: per-(pulsar, param) split-R-hat
     gates the stop; chains are bit-identical to a plain run of the same
@@ -605,6 +622,7 @@ def test_ensemble_sample_until():
     np.testing.assert_array_equal(res.chain, plain.chain)
 
 
+@pytest.mark.slow
 def test_ensemble_adaptive_mh_engages():
     """The sweep index threads through the ensemble chunk, so MH
     adaptation works under shard_map-less ensembles too: acceptance
@@ -624,6 +642,7 @@ def test_ensemble_adaptive_mh_engages():
     assert np.abs(np.asarray(ens.last_state.mh_log_scale)).max() > 0.1
 
 
+@pytest.mark.slow
 def test_ensemble_record_thin_rows_match():
     """Ensemble twin of the single-model thinning guarantee: identical
     keying, rows = every t-th sweep, bit-exact vs the unthinned run."""
@@ -640,6 +659,7 @@ def test_ensemble_record_thin_rows_match():
     assert int(thin.stats["record_thin"]) == 2
 
 
+@pytest.mark.slow
 def test_ensemble_light_record_mode():
     """record="light" drops the per-TOA chains from the ensemble's
     transfer too (the stress-scale transport knob)."""

@@ -1,20 +1,31 @@
 #!/usr/bin/env python
-"""Collect every bench JSON line under artifacts/ into one table.
+"""Collect bench JSON records and telemetry streams into one table.
 
 Each ``bench.py`` run leaves exactly one JSON line in its ``.out``
-artifact; this tool greps them all (plus BENCH_r0*.json driver records)
+artifact AND writes it to ``bench_summary.json`` (the file survives a
+lost/interleaved stdout stream — the r05 ``parsed: null`` failure). This
+tool greps the artifacts (plus BENCH_r0*.json driver records), folds in
+any ``bench_summary.json`` found at the repo root or under artifacts/,
 and prints a provenance table — metric, value, vs_baseline, platform,
-and any non-default tags (record/record_thin/adapt/mtm) — so a round's
-scattered hardware evidence reads as one summary. Pure host-side file
-parsing; never dials the relay.
+and any non-default tags (record/record_thin/adapt/mtm/telemetry).
+
+``--events DIR_OR_FILE`` additionally summarizes a telemetry run
+(``manifest.json`` + ``events.jsonl`` from ``run_sims.py
+--telemetry-dir``, obs/metrics.py): per-chunk acceptance trajectory,
+non-finite counters, divergences. Pure host-side file parsing; never
+dials the relay.
 """
 
 from __future__ import annotations
 
+import argparse
 import glob
 import json
 import os
 import sys
+
+TAGKEYS = ("record", "record_thin", "adapt_sweeps", "adapt_cov",
+           "mtm_tries", "mtm_blocks", "telemetry")
 
 
 def rows_from(path):
@@ -34,27 +45,91 @@ def rows_from(path):
     return out
 
 
-def main(argv=None):
-    root = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "artifacts")
-    pats = (sys.argv[1:] if argv is None else argv) or ["*"]
+def print_bench_table(pats):
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    art = os.path.join(root, "artifacts")
     paths = sorted(set(
         p for pat in pats
-        for p in glob.glob(os.path.join(root, f"BENCH_{pat}.out"))
-        + glob.glob(os.path.join(root, f"BENCH_{pat}.json"))))
-    tagkeys = ("record", "record_thin", "adapt_sweeps", "adapt_cov",
-               "mtm_tries", "mtm_blocks")
+        for p in glob.glob(os.path.join(art, f"BENCH_{pat}.out"))
+        + glob.glob(os.path.join(art, f"BENCH_{pat}.json"))))
+    # bench_summary.json files: the always-written machine-readable
+    # record (repo root for the latest local run, artifacts/ for
+    # archived ones)
+    paths += sorted(
+        p for p in (glob.glob(os.path.join(root, "bench_summary.json"))
+                    + glob.glob(os.path.join(art, "*bench_summary*.json")))
+        if os.path.exists(p))
     print(f"{'artifact':38s} {'platform':8s} {'value':>12s} "
           f"{'vs_base':>8s} {'ess/s':>9s} tags")
     for p in paths:
         for r in rows_from(p):
-            tags = " ".join(f"{k}={r[k]}" for k in tagkeys if k in r)
+            tags = " ".join(f"{k}={r[k]}" for k in TAGKEYS if k in r)
             print(f"{os.path.basename(p):38s} "
                   f"{r.get('platform', '?'):8s} "
                   f"{r.get('value', float('nan')):12,.1f} "
                   f"{r.get('vs_baseline', float('nan')):8.1f} "
                   f"{r.get('ess_log10A_per_sec', float('nan')):9.1f} "
                   f"{tags}")
+
+
+def print_events_summary(path):
+    """One run directory's telemetry: manifest provenance line, then the
+    per-chunk acceptance / divergence trajectory."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))  # tools/ run directly, not -m
+    from gibbs_student_t_tpu.obs.metrics import read_events
+
+    run_dir = path if os.path.isdir(path) else os.path.dirname(path)
+    man_path = os.path.join(run_dir, "manifest.json")
+    if os.path.exists(man_path):
+        with open(man_path) as fh:
+            man = json.load(fh)
+        dev = man.get("devices", {})
+        print(f"run {run_dir}: sha={str(man.get('git_sha'))[:10]} "
+              f"jax={man.get('jax_version')} "
+              f"backend={dev.get('backend', '?')}"
+              f"x{dev.get('device_count', '?')} "
+              f"seeds={man.get('seeds')}")
+    events = read_events(path)
+    chunks = [e for e in events if e.get("event") == "chunk"]
+    others = [e for e in events if e.get("event") != "chunk"]
+    for e in others:
+        extra = {k: v for k, v in e.items()
+                 if k not in ("event", "t", "elapsed_s", "metrics")}
+        print(f"  [{e.get('elapsed_s', 0):8.1f}s] {e['event']} {extra}")
+    if chunks:
+        print(f"  {len(chunks)} chunk events:")
+        print(f"  {'sweep_end':>9s} {'acc_w':>6s} {'acc_h':>6s} "
+              f"{'nonfin':>6s} {'divg':>4s} {'logpost':>10s}")
+        for e in chunks:
+            lp = e.get("logpost_mean")
+            print(f"  {e.get('sweep_end', '?'):>9} "
+                  f"{e.get('acc_white', float('nan')):6.3f} "
+                  f"{e.get('acc_hyper', float('nan')):6.3f} "
+                  f"{e.get('nonfinite_sweeps', 0):6d} "
+                  f"{e.get('diverged_chains', 0):4d} "
+                  f"{lp if lp is None else format(lp, '10.2f')}")
+        ndiv = max(e.get("diverged_chains", 0) for e in chunks)
+        nonf = sum(e.get("nonfinite_sweeps", 0) for e in chunks)
+        print(f"  totals: nonfinite_sweeps={nonf}, "
+              f"diverged_chains(max)={ndiv}")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("patterns", nargs="*", default=None,
+                    help="artifact glob fragments (BENCH_<pat>.out/json); "
+                         "default: all")
+    ap.add_argument("--events", metavar="DIR",
+                    help="summarize a telemetry run directory "
+                         "(events.jsonl + manifest.json) instead of / in "
+                         "addition to the bench table")
+    args = ap.parse_args(argv)
+    if args.events:
+        print_events_summary(args.events)
+        if not args.patterns:
+            return 0
+    print_bench_table(args.patterns or ["*"])
     return 0
 
 
