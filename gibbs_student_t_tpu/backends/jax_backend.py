@@ -649,8 +649,18 @@ class JaxGibbs(SamplerBackend):
                     self._hyper_consts.hyp_idx, config.jitter)
         self._telemetry = bool(telemetry)
         self.metrics = metrics
-        self._chunk_fn = jax.jit(self._make_chunk_fn(),
-                                 static_argnames=("length",))
+        # the chunk program goes through the explicit lower->compile
+        # introspection path (obs/introspect.py): same compile count as
+        # plain jit, but compile wall time + XLA cost/memory analyses
+        # land in the process log (and, via the registry getter, as
+        # `compile` events when a MetricsRegistry is attached)
+        from gibbs_student_t_tpu.obs.introspect import introspect_jit
+
+        self._chunk_fn = introspect_jit(
+            jax.jit(self._make_chunk_fn(), static_argnames=("length",)),
+            label=f"jaxgibbs_chunk_c{nchains}",
+            registry=lambda: self.metrics,
+            static_argnames=("length",))
         self._prop_cov_fn = (jax.jit(self._prop_cov_update)
                              if config.mh.adapt_cov else None)
         self.last_state: Optional[ChainState] = None

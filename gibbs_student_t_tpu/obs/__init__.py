@@ -20,12 +20,29 @@ machine-readable run records. This package supplies them:
   ``parallel/diagnostics`` ESS/R-hat machinery.
 - :mod:`~gibbs_student_t_tpu.obs.tracing` — ``jax.profiler.trace`` and
   named-span helpers (``--trace-dir`` in the drivers).
+- :mod:`~gibbs_student_t_tpu.obs.introspect` — XLA compile/memory
+  introspection: explicit lower->compile wrapping of the jit entry
+  points (compile wall time, cost-analysis FLOPs, peak device bytes)
+  plus the Pallas kernel-build log.
+- :mod:`~gibbs_student_t_tpu.obs.ledger` — the durable append-only
+  run ledger (``artifacts/ledger.jsonl``): one schema-versioned record
+  per graded driver/tool invocation, immune to lost stdout.
 
 Import discipline: this package is imported by ``backends/jax_backend.py``
 at module load, so nothing here may import ``backends``/``parallel`` at
 module scope (``health`` defers its diagnostics import to call time).
 """
 
+from gibbs_student_t_tpu.obs.introspect import (
+    compile_summary,
+    introspect_jit,
+    register_kernel,
+)
+from gibbs_student_t_tpu.obs.ledger import (
+    append_record,
+    make_record,
+    read_ledger,
+)
 from gibbs_student_t_tpu.obs.metrics import (
     MetricsRegistry,
     read_events,
@@ -42,6 +59,12 @@ from gibbs_student_t_tpu.obs.telemetry import (
 from gibbs_student_t_tpu.obs.tracing import block_span, host_span, trace_to
 
 __all__ = [
+    "compile_summary",
+    "introspect_jit",
+    "register_kernel",
+    "append_record",
+    "make_record",
+    "read_ledger",
     "MetricsRegistry",
     "read_events",
     "write_manifest",

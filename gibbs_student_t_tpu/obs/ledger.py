@@ -1,0 +1,164 @@
+"""Durable run ledger: one append-only JSONL record per graded run.
+
+Five rounds of artifacts have shown the failure mode this closes:
+``BENCH_r05.json`` graded ``parsed: null`` because stderr noise pushed
+the metric line out of a 2000-char stdout tail. The metric itself was
+computed and printed — only the *transport* died. The ledger makes that
+structurally impossible: every ``bench.py`` / ``run_sims.py`` /
+``tools/tpu_gate.py`` invocation lands one schema-versioned record in
+``artifacts/ledger.jsonl`` regardless of what happens to its streams,
+carrying the same metric values as the final stdout JSON line plus the
+provenance a grader needs (git SHA, the platform actually probed,
+device kinds, XLA compile stats, config fingerprint).
+
+Write discipline:
+
+- **append-only** — records are never rewritten; history is the point.
+- **atomic appends** — each record is one compact JSON line written by
+  a single ``os.write`` on an ``O_APPEND`` descriptor and fsync'd, so
+  concurrent writers interleave at line granularity and a crash can at
+  worst leave one torn final line, which :func:`read_ledger` skips
+  (same tolerance contract as ``obs/metrics.read_events``).
+
+Path resolution: an explicit path wins, then ``GST_LEDGER_PATH``, then
+``artifacts/ledger.jsonl`` relative to the current directory — the repo
+ledger when tools run from the checkout root (the graded case), an
+isolated scratch ledger when tests/smokes run from a temp dir.
+
+Schema v1 (also documented in docs/OBSERVABILITY.md):
+
+``schema``, ``t`` (unix), ``timestamp_utc``, ``tool``, ``git_sha``,
+``platform``, ``devices`` (the obs/metrics topology block), ``argv``,
+``metrics`` (the tool's graded values — for bench, exactly the stdout
+JSON line), ``xla`` (obs/introspect compile summary: total
+``compile_s``, ``flops``, ``peak_bytes`` — each the explicit string
+``"unavailable"`` when the installed jax cannot report it — plus
+per-program records and Pallas kernel builds), ``config_fingerprint``
+(sha1 of the canonicalized config), optional tool extras.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+LEDGER_SCHEMA = 1
+DEFAULT_LEDGER = os.path.join("artifacts", "ledger.jsonl")
+
+
+def ledger_path(path: Optional[str] = None) -> str:
+    """Resolve the ledger file path (explicit > env > cwd default)."""
+    if path:
+        return path
+    return os.environ.get("GST_LEDGER_PATH") or DEFAULT_LEDGER
+
+
+def config_fingerprint(config) -> str:
+    """12-hex-digit sha1 of the canonical JSON form of ``config`` —
+    key order independent, numpy/dataclass tolerant, so two runs with
+    the same effective configuration fingerprint identically."""
+    from gibbs_student_t_tpu.obs.metrics import _jsonable
+
+    blob = json.dumps(_jsonable(config), sort_keys=True,
+                      separators=(",", ":"))
+    return hashlib.sha1(blob.encode()).hexdigest()[:12]
+
+
+def make_record(tool: str, metrics: Dict[str, Any], *,
+                platform: Optional[str] = None,
+                config=None,
+                argv: Optional[List[str]] = None,
+                xla: Any = "auto",
+                extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Build one schema-v1 ledger record.
+
+    ``metrics`` is the tool's graded payload (for bench, the exact
+    stdout JSON line). ``xla="auto"`` pulls the process's compile
+    introspection summary (obs/introspect.py); pass None to omit.
+    ``config`` (any JSON-able/dataclass value) is fingerprinted, not
+    stored — the full argv is already in the record.
+    """
+    from gibbs_student_t_tpu.obs.introspect import compile_summary
+    from gibbs_student_t_tpu.obs.metrics import (
+        _device_topology,
+        _git_sha,
+        _jsonable,
+    )
+
+    if xla == "auto":
+        xla = compile_summary()
+    rec: Dict[str, Any] = {
+        "schema": LEDGER_SCHEMA,
+        "t": round(time.time(), 3),
+        "timestamp_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                       time.gmtime()),
+        "tool": str(tool),
+        "git_sha": _git_sha(),
+        "platform": platform,
+        "devices": _device_topology(),
+        "argv": list(argv if argv is not None else sys.argv),
+        "metrics": _jsonable(metrics),
+        "xla": _jsonable(xla),
+        "config_fingerprint": (config_fingerprint(config)
+                               if config is not None else None),
+    }
+    if extra:
+        rec.update(_jsonable(extra))
+    return rec
+
+
+def append_record(record: Dict[str, Any],
+                  path: Optional[str] = None) -> str:
+    """Append one record as a single atomic line write; returns the
+    resolved path. Compact separators keep a record ~1-2 KB so the
+    single ``os.write`` stays atomic on any POSIX filesystem."""
+    from gibbs_student_t_tpu.obs.metrics import _jsonable
+
+    path = ledger_path(path)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    line = json.dumps(_jsonable(record), separators=(",", ":")) + "\n"
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    try:
+        os.write(fd, line.encode())
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    return path
+
+
+def read_ledger(path: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Every parseable record, in file order; torn/garbage lines (a
+    crash mid-append) are skipped, not fatal. Missing file -> []."""
+    path = ledger_path(path)
+    out: List[Dict[str, Any]] = []
+    try:
+        fh = open(path)
+    except OSError:
+        return out
+    with fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+    return out
+
+
+def last_record(tool: Optional[str] = None,
+                path: Optional[str] = None) -> Optional[Dict[str, Any]]:
+    """Most recent record (optionally of one tool), or None."""
+    recs = read_ledger(path)
+    if tool is not None:
+        recs = [r for r in recs if r.get("tool") == tool]
+    return recs[-1] if recs else None

@@ -189,11 +189,39 @@ class MetricsRegistry:
         return write_manifest(self.run_dir, **fields)
 
     def close(self) -> None:
-        """Emit a final ``snapshot`` event and close the JSONL sink."""
+        """Emit a final ``snapshot`` event, fold the process's XLA
+        compile introspection into the manifest, and close the sink."""
         if self._events_fh is not None:
             self.emit("snapshot", metrics=self.snapshot())
             self._events_fh.close()
             self._events_fh = None
+            self._augment_manifest_xla()
+
+    def _augment_manifest_xla(self) -> None:
+        """Add/refresh the manifest's ``xla`` block at close time —
+        the manifest is written before sampling, but compiles happen
+        during it, so the block can only be complete here. Atomic
+        rewrite; any failure leaves the original manifest intact."""
+        if self.run_dir is None:
+            return
+        path = os.path.join(self.run_dir, "manifest.json")
+        if not os.path.exists(path):
+            return
+        try:
+            from gibbs_student_t_tpu.obs.introspect import compile_summary
+
+            summ = compile_summary()
+            if not summ["n_programs"] and not summ["pallas_kernels"]:
+                return
+            with open(path) as fh:
+                manifest = json.load(fh)
+            manifest["xla"] = _jsonable(summ)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(manifest, fh, indent=1, sort_keys=True)
+            os.replace(tmp, path)
+        except Exception:  # noqa: BLE001 - observability must not raise
+            pass
 
     def __enter__(self):
         return self

@@ -58,7 +58,10 @@ except ImportError:  # pragma: no cover
     pltpu = None
     _HAVE_PLTPU = False
 
-from gibbs_student_t_tpu.ops.pallas_util import tpu_compiler_params
+from gibbs_student_t_tpu.ops.pallas_util import (
+    note_kernel_build,
+    tpu_compiler_params,
+)
 
 # Above this the statically-unrolled kernel program gets large and the
 # O(m^2)-per-tile VMEM working set stops fitting comfortably.
@@ -154,6 +157,10 @@ def chol_fused_lane(S, rhs, chain_tile: int = 128, interpret: bool = False
         raise ValueError(f"pallas chol kernel is float32-only, got {S.dtype}")
     batch = S.shape[:-2]
     m = S.shape[-1]
+    # trace-time: fires once per XLA compile that embeds this kernel
+    note_kernel_build("pallas_chol_fused_lane", m=int(m),
+                      chain_tile=int(chain_tile),
+                      interpret=bool(interpret))
     from gibbs_student_t_tpu.ops.unrolled_chol import _pad_identity
 
     Sf = S.reshape((-1,) + S.shape[-2:])

@@ -69,6 +69,7 @@ from gibbs_student_t_tpu.ops.pallas_util import (
     MIN_BATCH as _MIN_BATCH,
     int_from_env,
     mode_from_env,
+    note_kernel_build,
     pad_chains_edge,
     pltpu,
     round_up as _round_up,
@@ -527,6 +528,10 @@ def make_hyper_block(hyp_idx: Tuple[int, ...], jitter: float):
     pass traced per-pulsar constants (leading group axis) through
     ``vmap``/``shard_map``."""
     from gibbs_student_t_tpu.ops.pallas_white import consts_batch_vmap
+
+    note_kernel_build("pallas_hyper_mh", n_hyper=len(hyp_idx),
+                      jitter=float(jitter),
+                      mode=mode_from_env("GST_PALLAS_HYPER")[0])
 
     @custom_vmap
     def block(x, S0, dS0, rt, base, dx, logu, K, sel, specs):

@@ -40,7 +40,10 @@ except ImportError:  # pragma: no cover
     pltpu = None
     _HAVE_PLTPU = False
 
-from gibbs_student_t_tpu.ops.pallas_util import tpu_compiler_params
+from gibbs_student_t_tpu.ops.pallas_util import (
+    note_kernel_build,
+    tpu_compiler_params,
+)
 
 
 def _round_up(x: int, mult: int) -> int:
@@ -110,6 +113,10 @@ def tnt_batched_pallas(T, y, nvec, block_size: int = 256,
     if n % block_size != 0:
         raise ValueError(f"n ({n}) must be a multiple of block_size "
                          f"({block_size}); use ops.tnt.pad_rows")
+    # trace-time: fires once per XLA compile that embeds this kernel
+    note_kernel_build("pallas_tnt_batched", n=int(n), m=int(m),
+                      block_size=int(block_size),
+                      interpret=bool(interpret))
     mp = _round_up(m, 128)
     if chain_tile is None:
         chain_tile = _auto_chain_tile(block_size, mp, C)

@@ -83,6 +83,22 @@ def tpu_compiler_params(dimension_semantics) -> dict:
         dimension_semantics=tuple(dimension_semantics))}
 
 
+def note_kernel_build(name: str, **meta):
+    """Log a Pallas kernel construction/trace in the introspection
+    registry (obs/introspect.py), so run manifests and the durable
+    ledger can say WHICH custom kernels a compiled program contained
+    (and with what static shape parameters). Build-time call sites fire
+    once per backend construction; trace-time call sites once per XLA
+    compile — the registry deduplicates by content either way. Must
+    never raise: observability cannot take down a kernel build."""
+    try:
+        from gibbs_student_t_tpu.obs.introspect import register_kernel
+
+        register_kernel(name, **meta)
+    except Exception:  # noqa: BLE001
+        pass
+
+
 def pad_chains_edge(arr, to: int):
     """Pad the leading (chain) axis to ``to`` rows by edge-replication,
     so padded rows stay finite and in-bounds for any downstream math."""

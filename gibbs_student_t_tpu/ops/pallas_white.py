@@ -65,6 +65,7 @@ from gibbs_student_t_tpu.ops.pallas_util import (
     MIN_BATCH as _MIN_BATCH,
     int_from_env,
     mode_from_env,
+    note_kernel_build,
     pltpu,
     round_up as _round_up,
     tpu_compiler_params,
@@ -682,6 +683,8 @@ def make_white_block(var: Tuple[Tuple[int, int, int], ...]):
     per-group constants to the grouped kernel; unbatched or non-TPU
     calls run the identical-math XLA loop.
     """
+    note_kernel_build("pallas_white_mh", n_varying=len(var),
+                      mode=mode_from_env("GST_PALLAS_WHITE")[0])
 
     @custom_vmap
     def block(x, az, yred2, dx, logu, rows, specs):
@@ -727,6 +730,8 @@ def make_white_mtm_block(var: Tuple[Tuple[int, int, int], ...]):
     :func:`make_white_block` (same custom_vmap constants batching,
     same ``GST_PALLAS_WHITE`` gate, XLA fallback
     :func:`white_mtm_loop_xla`)."""
+    note_kernel_build("pallas_white_mtm", n_varying=len(var),
+                      mode=mode_from_env("GST_PALLAS_WHITE")[0])
 
     @custom_vmap
     def block(x, az, yred2, dx, dxr, gumb, logu, rows, specs):

@@ -225,7 +225,16 @@ class EnsembleGibbs:
                               else self._build_fused_consts())
         self._telemetry = bool(telemetry)
         self.metrics = metrics
-        self._step = self._build_step()
+        # compile introspection on the sharded chunk program, same as
+        # the single-model backend (obs/introspect.py)
+        from gibbs_student_t_tpu.obs.introspect import introspect_jit
+
+        self._step = introspect_jit(
+            self._build_step(),
+            label=(f"ensemble_{'unrolled' if self._unrolled else 'grouped'}"
+                   f"_chunk_p{self.npulsars}_c{nchains}"),
+            registry=lambda: self.metrics,
+            static_argnames=("length",))
         # per-pulsar population-covariance re-estimation at chunk
         # boundaries (MHConfig.adapt_cov): the single-model update
         # vmapped over the pulsar axis — the stacked models share one
@@ -271,11 +280,14 @@ class EnsembleGibbs:
         import os
 
         env = os.environ.get("GST_ENSEMBLE_UNROLL", "")
+        if env != "" and env not in ("0", "1"):
+            # validated whenever SET, even when an explicit unroll=
+            # argument means it won't be consulted: a typo'd override
+            # must fail loudly, not silently measure the wrong arm
+            # (ADVICE r5)
+            raise ValueError(
+                f"GST_ENSEMBLE_UNROLL must be '0' or '1', got {env!r}")
         if env != "" and unroll == "auto":
-            if env not in ("0", "1"):
-                raise ValueError(
-                    f"GST_ENSEMBLE_UNROLL must be '0' or '1', got "
-                    f"{env!r}")
             unroll = env == "1"
         mesh_ok = (self.mesh is None
                    or self.mesh.shape.get("pulsar", 1) == 1)

@@ -212,6 +212,10 @@ def run_ensemble(args, configs, parfile, timfile, rng):
                                pulsar_chain_sweeps_per_sec=round(
                                    sweeps / dt, 1),
                                **_tele_chain_fields(res))
+        args.ledger_rows.append({
+            "config": key, "ensemble": args.ensemble,
+            "seconds": round(dt, 2),
+            "pulsar_chain_sweeps_per_sec": round(sweeps / dt, 1)})
         burned = res.burn(args.burn)
         for i, ma in enumerate(mas):
             # simulated ensembles reuse the base pulsar's name; the index
@@ -309,6 +313,17 @@ def main(argv=None):
                     help="capture a jax.profiler trace of each config's "
                          "sampling into DIR; the sweep stages carry "
                          "gibbs/* named spans (obs/tracing.py)")
+    ap.add_argument("--ledger", metavar="PATH", default=None,
+                    help="append one durable run-ledger record per "
+                         "invocation (obs/ledger.py: per-config "
+                         "throughput + git SHA + platform + XLA "
+                         "compile stats). Default: GST_LEDGER_PATH or "
+                         "artifacts/ledger.jsonl; '' disables")
+    ap.add_argument("--introspect", action="store_true",
+                    help="print per-program XLA compile/cost/memory "
+                         "summaries to stderr after the run "
+                         "(obs/introspect.py; collection is always on "
+                         "and lands in the ledger record regardless)")
     ap.add_argument("--models", nargs="+",
                     default=["vvh17", "uniform", "beta", "gaussian", "t"])
     ap.add_argument("--par", default=None)
@@ -396,6 +411,7 @@ def main(argv=None):
 
     # run-level observability sink: manifest once, then per-chunk events
     # stream in from the backends (obs/metrics.py)
+    args.ledger_rows = []  # per-config throughput rows for the ledger
     args.registry = None
     if args.telemetry_dir:
         if args.backend != "jax" or not args.telemetry:
@@ -423,6 +439,41 @@ def main(argv=None):
     finally:
         if args.registry is not None:
             args.registry.close()
+        # the ledger record lands in the finally so a crash mid-run
+        # still documents the configs that DID complete (obs/ledger.py)
+        _write_ledger(args)
+        if args.introspect:
+            from gibbs_student_t_tpu.obs.introspect import format_summary
+
+            for ln in format_summary("  # "):
+                print(ln, file=sys.stderr)
+
+
+def _write_ledger(args):
+    """One durable run-ledger record for this invocation: the per-config
+    throughput rows plus provenance and XLA compile stats."""
+    if args.ledger == "":
+        return
+    try:
+        from gibbs_student_t_tpu.obs import ledger as ledger_mod
+
+        platform = None
+        if "jax" in sys.modules:
+            try:
+                platform = sys.modules["jax"].default_backend()
+            except Exception:  # noqa: BLE001
+                platform = None
+        cfg = {k: v for k, v in vars(args).items()
+               if k not in ("registry", "ledger_rows")}
+        path = ledger_mod.append_record(ledger_mod.make_record(
+            "run_sims",
+            {"configs": args.ledger_rows,
+             "n_configs_done": len(args.ledger_rows)},
+            platform=platform, config=cfg), args.ledger)
+        print(f"# ledger record -> {path}", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 - never fail the run over it
+        print(f"# ledger write failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
 
 
 def dataclasses_asdict_safe(cfg):
@@ -480,6 +531,10 @@ def run_sequential(args, configs, rng, parfile, timfile):
                                        sweeps_per_sec=round(
                                            args.niter / dt, 2),
                                        **_tele_chain_fields(res))
+                args.ledger_rows.append({
+                    "config": key, "theta": theta,
+                    "seconds": round(dt, 2),
+                    "sweeps_per_sec": round(args.niter / dt, 2)})
 
 
 if __name__ == "__main__":

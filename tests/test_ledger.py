@@ -1,0 +1,391 @@
+"""Performance-evidence pipeline tests (obs/ledger.py, obs/introspect.py,
+tools/perf_report.py): ledger append atomicity and torn-line tolerance,
+the version-tolerant XLA compile-introspection shim, the wrapped jit
+entry points, and the regression gate on synthetic ledgers.
+
+All CPU, tier-1 speed except the end-to-end bench smoke (slow — it
+pays a fresh-process sweep-kernel compile).
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from gibbs_student_t_tpu.obs import introspect
+from gibbs_student_t_tpu.obs import ledger as ledger_mod
+
+pytestmark = pytest.mark.ledger
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ----------------------------------------------------------------------
+# ledger: append / read / atomicity contract
+# ----------------------------------------------------------------------
+
+
+def test_append_and_read_round_trip(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    r1 = ledger_mod.make_record(
+        "bench", {"metric": "m", "value": 100.0, "unit": "x/s"},
+        platform="cpu", config={"a": 1, "b": [2, 3]}, argv=["bench.py"])
+    r2 = ledger_mod.make_record("tpu_gate", {"ok": True},
+                                platform="cpu", argv=["tpu_gate.py"])
+    assert ledger_mod.append_record(r1, path) == path
+    ledger_mod.append_record(r2, path)
+    recs = ledger_mod.read_ledger(path)
+    assert [r["tool"] for r in recs] == ["bench", "tpu_gate"]
+    assert recs[0]["schema"] == ledger_mod.LEDGER_SCHEMA
+    for key in ("t", "timestamp_utc", "git_sha", "platform", "devices",
+                "argv", "metrics", "xla", "config_fingerprint"):
+        assert key in recs[0], key
+    assert recs[0]["metrics"]["value"] == 100.0
+    assert recs[0]["config_fingerprint"] is not None
+    assert recs[1]["config_fingerprint"] is None  # no config passed
+    # each record is exactly one line (the single-write append contract)
+    with open(path) as fh:
+        assert len(fh.readlines()) == 2
+    assert ledger_mod.last_record("bench", path)["metrics"]["value"] == 100.0
+    assert ledger_mod.last_record("nope", path) is None
+
+
+def test_read_tolerates_torn_and_garbage_lines(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    ledger_mod.append_record(
+        ledger_mod.make_record("bench", {"value": 1}), path)
+    with open(path, "a") as fh:
+        fh.write("not json at all\n")
+        fh.write('{"tool": "bench", "metrics": {"value": 2}}\n')
+        fh.write('{"tool": "bench", "met')  # torn tail: crash mid-append
+    recs = ledger_mod.read_ledger(path)
+    assert len(recs) == 2
+    assert recs[1]["metrics"]["value"] == 2
+    # missing file is empty, not an error
+    assert ledger_mod.read_ledger(str(tmp_path / "absent.jsonl")) == []
+
+
+def test_path_resolution(tmp_path, monkeypatch):
+    monkeypatch.delenv("GST_LEDGER_PATH", raising=False)
+    assert ledger_mod.ledger_path() == ledger_mod.DEFAULT_LEDGER
+    monkeypatch.setenv("GST_LEDGER_PATH", str(tmp_path / "env.jsonl"))
+    assert ledger_mod.ledger_path() == str(tmp_path / "env.jsonl")
+    # explicit always wins
+    assert ledger_mod.ledger_path("x.jsonl") == "x.jsonl"
+
+
+def test_config_fingerprint_canonical():
+    fp1 = ledger_mod.config_fingerprint({"a": 1, "b": np.float32(2.5)})
+    fp2 = ledger_mod.config_fingerprint({"b": 2.5, "a": 1})
+    fp3 = ledger_mod.config_fingerprint({"a": 1, "b": 2.6})
+    assert fp1 == fp2          # key order / numpy scalars canonicalized
+    assert fp1 != fp3          # value changes move the fingerprint
+    assert len(fp1) == 12
+
+
+# ----------------------------------------------------------------------
+# introspection: version-tolerant analysis shim
+# ----------------------------------------------------------------------
+
+
+class _FakeCompiled:
+    """Stand-in for jax's Compiled with controllable API surface."""
+
+    def __init__(self, cost=None, mem=None, raise_cost=False,
+                 raise_mem=False):
+        self._cost, self._mem = cost, mem
+        self._rc, self._rm = raise_cost, raise_mem
+
+    def cost_analysis(self):
+        if self._rc:
+            raise NotImplementedError("no cost analysis on this backend")
+        return self._cost
+
+    def memory_analysis(self):
+        if self._rm:
+            raise NotImplementedError("no memory analysis")
+        return self._mem
+
+
+class _MemStats:
+    argument_size_in_bytes = 100
+    output_size_in_bytes = 40
+    temp_size_in_bytes = 60
+    alias_size_in_bytes = 0
+    generated_code_size_in_bytes = 7
+
+
+def test_analysis_shim_handles_every_api_shape():
+    # list-of-dict (older jax), dict (newer jax), absent, raising
+    assert introspect.cost_analysis_of(
+        _FakeCompiled(cost=[{"flops": 8.0}]))["flops"] == 8.0
+    assert introspect.cost_analysis_of(
+        _FakeCompiled(cost={"flops": 9.0}))["flops"] == 9.0
+    assert introspect.cost_analysis_of(_FakeCompiled(cost=[])) is None
+    assert introspect.cost_analysis_of(
+        _FakeCompiled(raise_cost=True)) is None
+    assert introspect.cost_analysis_of(object()) is None  # no method
+    mem = introspect.memory_analysis_of(_FakeCompiled(mem=_MemStats()))
+    assert mem["temp_size_in_bytes"] == 60
+    assert introspect.memory_analysis_of(
+        _FakeCompiled(raise_mem=True)) is None
+
+
+def test_analyze_compiled_marks_unavailable_explicitly():
+    full = introspect.analyze_compiled(
+        _FakeCompiled(cost=[{"flops": 8.0, "bytes accessed": 32.0}],
+                      mem=_MemStats()), label="x", compile_s=0.5)
+    assert full["analysis"] == "ok"
+    assert full["flops"] == 8.0 and full["peak_bytes"] == 200
+    bare = introspect.analyze_compiled(
+        _FakeCompiled(raise_cost=True, raise_mem=True), label="y")
+    # present-with-None plus an explicit marker, never silent omission
+    assert bare["flops"] is None and bare["peak_bytes"] is None
+    assert bare["analysis"].startswith(introspect.UNAVAILABLE)
+    assert "cost_analysis" in bare["analysis"]
+    assert "memory_analysis" in bare["analysis"]
+
+
+def test_compile_summary_totals_and_unavailable_marker():
+    introspect.clear_introspection()
+    try:
+        assert introspect.compile_summary()["flops"] == "unavailable"
+        with introspect._LOCK:
+            introspect._COMPILE_LOG.append(
+                {"label": "a", "compile_s": 1.0, "flops": 10.0,
+                 "bytes_accessed": None, "peak_bytes": 5})
+            introspect._COMPILE_LOG.append(
+                {"label": "b", "compile_s": 2.0, "flops": 30.0,
+                 "bytes_accessed": None, "peak_bytes": 50})
+        s = introspect.compile_summary()
+        assert s["n_programs"] == 2 and s["compile_s"] == 3.0
+        assert s["flops"] == 40.0 and s["peak_bytes"] == 50
+        assert s["bytes_accessed"] == "unavailable"
+    finally:
+        introspect.clear_introspection()
+
+
+def test_introspect_jit_compiles_once_and_matches_plain_jit():
+    import jax
+    import jax.numpy as jnp
+
+    introspect.clear_introspection()
+    try:
+        def f(x, off, length):
+            return x * length + off
+
+        jf = jax.jit(f, static_argnames=("length",))
+        wf = introspect.introspect_jit(jf, label="toy",
+                                       static_argnames=("length",))
+        x = jnp.arange(4.0)
+        r1 = wf(x, 2, length=3)
+        r2 = wf(x, 5, length=3)   # same signature: cached executable
+        np.testing.assert_array_equal(np.asarray(r1), [2, 5, 8, 11])
+        np.testing.assert_array_equal(np.asarray(r2),
+                                      np.asarray(jf(x, 5, length=3)))
+        recs = introspect.compile_records()
+        assert len(recs) == 1 and recs[0]["label"] == "toy"
+        assert recs[0]["compile_s"] >= 0.0
+        wf(jnp.arange(8.0), 2, length=3)  # new shape: second program
+        assert len(introspect.compile_records()) == 2
+        # a different STATIC value is a distinct program too
+        wf(x, 2, length=4)
+        assert len(introspect.compile_records()) == 3
+    finally:
+        introspect.clear_introspection()
+
+
+def test_introspect_jit_falls_back_on_convention_violation():
+    import jax
+    import jax.numpy as jnp
+
+    introspect.clear_introspection()
+    try:
+        jf = jax.jit(lambda x, y: x + y)
+        wf = introspect.introspect_jit(jf, label="fb")
+        # dynamic kwarg breaks the statics-as-kwargs convention: the
+        # wrapper must degrade to the plain jit, not fail or miscompute
+        out = wf(jnp.ones(3), y=jnp.ones(3))
+        np.testing.assert_array_equal(np.asarray(out), [2, 2, 2])
+        assert wf._broken
+        assert introspect.compile_records() == []
+    finally:
+        introspect.clear_introspection()
+
+
+def test_introspect_env_kill_switch(monkeypatch):
+    import jax
+
+    monkeypatch.setenv("GST_INTROSPECT", "0")
+    jf = jax.jit(lambda x: x)
+    assert introspect.introspect_jit(jf, label="off") is jf
+
+
+def test_sampler_chunk_fn_records_compile_and_registry_event(tmp_path):
+    """The real wiring: a JaxGibbs sample records its chunk program's
+    compile (with cost/memory analysis on CPU) and, with a registry
+    attached, lands a `compile` event plus the manifest xla block."""
+    import warnings
+
+    from gibbs_student_t_tpu.backends.jax_backend import JaxGibbs
+    from gibbs_student_t_tpu.config import GibbsConfig
+    from gibbs_student_t_tpu.data.demo import make_demo_model_arrays
+    from gibbs_student_t_tpu.obs import MetricsRegistry, read_events
+
+    introspect.clear_introspection()
+    try:
+        ma = make_demo_model_arrays(n=16, components=2, seed=3)
+        cfg = GibbsConfig(model="mixture", vary_df=True,
+                          theta_prior="beta")
+        run = str(tmp_path / "run")
+        reg = MetricsRegistry(run_dir=run)
+        reg.write_manifest(config=cfg, seeds=0)
+        gb = JaxGibbs(ma, cfg, nchains=2, chunk_size=4, metrics=reg)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            res = gb.sample(niter=4, seed=0)
+        reg.close()
+        assert res.chain.shape[0] == 4
+        recs = [r for r in introspect.compile_records()
+                if r["label"].startswith("jaxgibbs_chunk")]
+        assert recs, introspect.compile_records()
+        assert recs[0]["compile_s"] > 0
+        # CPU jax reports both analyses; if a future jax drops one the
+        # record still says so explicitly rather than omitting fields
+        assert "analysis" in recs[0] and "peak_bytes" in recs[0]
+        events = [e for e in read_events(run) if e["event"] == "compile"]
+        assert events and events[0]["label"] == recs[0]["label"]
+        with open(os.path.join(run, "manifest.json")) as fh:
+            man = json.load(fh)
+        assert man["xla"]["n_programs"] >= 1
+        assert man["xla"]["compile_s"] > 0
+    finally:
+        introspect.clear_introspection()
+
+
+# ----------------------------------------------------------------------
+# perf_report regression gate
+# ----------------------------------------------------------------------
+
+
+def _perf_report():
+    spec = importlib.util.spec_from_file_location(
+        "perf_report", os.path.join(REPO, "tools", "perf_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _bench_rec(value, compile_s=5.0, peak=1000, metric="m",
+               platform="cpu"):
+    return {"schema": 1, "tool": "bench", "platform": platform,
+            "timestamp_utc": "t", "git_sha": "abc",
+            "config_fingerprint": "f",
+            "metrics": {"metric": metric, "value": value, "unit": "x/s"},
+            "xla": {"compile_s": compile_s, "peak_bytes": peak}}
+
+
+def _write_ledger(tmp_path, recs):
+    path = str(tmp_path / "ledger.jsonl")
+    with open(path, "w") as fh:
+        for r in recs:
+            fh.write(json.dumps(r) + "\n")
+    return path
+
+
+def test_perf_report_detects_value_regression(tmp_path, capsys):
+    pr = _perf_report()
+    path = _write_ledger(tmp_path, [_bench_rec(100.0), _bench_rec(60.0)])
+    rc = pr.main(["--ledger", path, "--check", "--no-rounds"])
+    assert rc == 2
+    assert "dropped" in capsys.readouterr().out
+    # within tolerance passes
+    path = _write_ledger(tmp_path, [_bench_rec(100.0), _bench_rec(95.0)])
+    assert pr.main(["--ledger", path, "--check", "--no-rounds"]) == 0
+
+
+def test_perf_report_detects_compile_and_hbm_growth(tmp_path, capsys):
+    pr = _perf_report()
+    path = _write_ledger(tmp_path, [
+        _bench_rec(100.0, compile_s=5.0), _bench_rec(100.0,
+                                                     compile_s=20.0)])
+    assert pr.main(["--ledger", path, "--check", "--no-rounds"]) == 2
+    assert "compile time grew" in capsys.readouterr().out
+    path = _write_ledger(tmp_path, [
+        _bench_rec(100.0, peak=1000), _bench_rec(100.0, peak=2000)])
+    assert pr.main(["--ledger", path, "--check", "--no-rounds"]) == 2
+    assert "peak program bytes grew" in capsys.readouterr().out
+    # unavailable analyses skip those gates instead of failing them
+    path = _write_ledger(tmp_path, [
+        _bench_rec(100.0, compile_s="unavailable", peak="unavailable"),
+        _bench_rec(100.0, compile_s="unavailable", peak="unavailable")])
+    assert pr.main(["--ledger", path, "--check", "--no-rounds"]) == 0
+
+
+def test_perf_report_baselines_and_unusable_records(tmp_path):
+    pr = _perf_report()
+    # empty ledger / no bench record -> exit 3 (ungradeable)
+    path = _write_ledger(tmp_path, [])
+    assert pr.main(["--ledger", path, "--check", "--no-rounds"]) == 3
+    path = _write_ledger(
+        tmp_path, [{"tool": "bench", "metrics": {}, "xla": {}}])
+    assert pr.main(["--ledger", path, "--check", "--no-rounds"]) == 3
+    # first comparable record passes (nothing to regress against);
+    # platform/metric mismatches are not comparable baselines
+    path = _write_ledger(tmp_path, [
+        _bench_rec(500.0, platform="tpu"), _bench_rec(100.0,
+                                                      metric="other"),
+        _bench_rec(90.0)])
+    assert pr.main(["--ledger", path, "--check", "--no-rounds"]) == 0
+    # --baseline best compares against the best ever, not the previous
+    path = _write_ledger(tmp_path, [
+        _bench_rec(200.0), _bench_rec(90.0), _bench_rec(95.0)])
+    assert pr.main(["--ledger", path, "--check", "--no-rounds"]) == 0
+    assert pr.main(["--ledger", path, "--check", "--no-rounds",
+                    "--baseline", "best"]) == 2
+
+
+# ----------------------------------------------------------------------
+# bench end-to-end smoke (slow: fresh-process sweep-kernel compile)
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_bench_ledger_record_matches_stdout_line(tmp_path):
+    """The acceptance contract: a bench run writes a ledger record whose
+    metric values equal the final-stdout JSON line, with compile_s and
+    explicit (un)availability of the XLA analyses, and perf_report
+    --check passes on it."""
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--platform", "cpu", "--nchains", "2", "--niter", "4",
+         "--chunk", "2", "--baseline-sweeps", "2", "--ntoa", "40",
+         "--components", "5", "--dataset", "demo", "--adapt", "0",
+         "--no-block-timings", "--introspect"],
+        cwd=str(tmp_path), capture_output=True, text=True, env=env,
+        timeout=600)
+    assert r.returncode == 0, r.stderr
+    line = json.loads(r.stdout.strip().splitlines()[-1])
+    ledger_file = tmp_path / "artifacts" / "ledger.jsonl"
+    assert ledger_file.exists(), r.stderr
+    recs = ledger_mod.read_ledger(str(ledger_file))
+    assert len(recs) == 1 and recs[0]["tool"] == "bench"
+    assert recs[0]["metrics"] == line  # byte-for-byte the graded values
+    xla = recs[0]["xla"]
+    assert xla["n_programs"] >= 1 and xla["compile_s"] > 0
+    for key in ("flops", "peak_bytes"):
+        assert (xla[key] == "unavailable"
+                or isinstance(xla[key], (int, float))), (key, xla[key])
+    assert "compile[" in r.stderr  # --introspect stderr summary
+    # the gate passes on a single healthy record
+    pr = _perf_report()
+    assert pr.main(["--ledger", str(ledger_file), "--check",
+                    "--no-rounds"]) == 0

@@ -151,6 +151,11 @@ def test_ensemble_unroll_env_override(monkeypatch):
     monkeypatch.setenv("GST_ENSEMBLE_UNROLL", "true")
     with pytest.raises(ValueError, match="GST_ENSEMBLE_UNROLL"):
         build()
+    # a bad value fails loudly even when an explicit unroll= means it
+    # would not be consulted — a typo'd override must never silently
+    # measure the wrong arm (ADVICE r5)
+    with pytest.raises(ValueError, match="GST_ENSEMBLE_UNROLL"):
+        build(unroll=True)
 
 
 @pytest.mark.slow

@@ -139,7 +139,15 @@ def main():
     unroll = "auto" if args.unroll == "auto" else bool(int(args.unroll))
     ens = EnsembleGibbs(mas, cfg, nchains=args.nchains,
                         chunk_size=args.chunk, unroll=unroll)
-    out["fused_consts_built"] = ens._fused_consts is not None
+    # ADVICE r5: the old "fused_consts_built" key read False for
+    # UNROLLED runs, where per-pulsar backends bake their fused-MH
+    # constants into the trace and the grouped consts bundle is
+    # (correctly) never built — which misreported the fused kernels as
+    # disabled. Report the form-independent truth plus the grouped
+    # bundle under an honest name.
+    out["fused_kernels_available"] = (ens._fused_consts is not None
+                                      or ens._unrolled)
+    out["grouped_fused_consts_built"] = ens._fused_consts is not None
     out["unrolled"] = ens._unrolled
     t0 = time.perf_counter()
     ens.sample(niter=args.chunk, seed=args.seed)
@@ -201,6 +209,21 @@ def main():
     # (ADVICE r4: fresh-but-partial JSON must not done-mark a stage)
     out["complete"] = True
     flush()
+    # durable run-ledger record (obs/ledger.py)
+    try:
+        from gibbs_student_t_tpu.obs import ledger as ledger_mod
+
+        path = ledger_mod.append_record(ledger_mod.make_record(
+            "ensemble_bench",
+            {k: out.get(k) for k in
+             ("ensemble_pulsar_chain_sweeps_per_sec", "vs_oracle",
+              "single_over_ensemble", "ess_log10A_per_sec",
+              "fused_kernels_available", "unrolled")},
+            platform=out["platform"], config=vars(args)))
+        print(f"[ledger] -> {path}", flush=True)
+    except Exception as e:  # noqa: BLE001
+        print(f"[ledger] write failed: {type(e).__name__}: {e}",
+              flush=True)
     print(f"[done] -> {args.out}", flush=True)
     return 0
 

@@ -1,0 +1,261 @@
+#!/usr/bin/env python
+"""Performance trajectory report + regression gate over the run ledger.
+
+Folds three evidence sources into one table:
+
+1. the durable run ledger (``artifacts/ledger.jsonl``, obs/ledger.py) —
+   every bench/run_sims/tpu_gate/ensemble_bench invocation's metric
+   values, platform, XLA compile stats, and config fingerprint;
+2. the graded round artifacts ``BENCH_r*.json`` at the repo root —
+   including the ones whose ``parsed`` is null (the r05 tail-truncation
+   failure), which print as explicit ``UNPARSEABLE`` rows instead of
+   vanishing;
+3. ``MULTICHIP_r*.json`` pass/fail/skip verdicts.
+
+``--check`` turns the report into a CI/pre-round gate: it compares the
+latest bench ledger record against a baseline record of the SAME metric
+name and platform (``--baseline prev``: the one before it; ``best``:
+the best value ever) and exits nonzero when
+
+- the metric value dropped more than ``--max-drop`` percent,
+- total XLA compile time grew more than ``--max-compile-growth``
+  percent (both sides must report it),
+- peak program bytes (HBM on device) grew more than
+  ``--max-hbm-growth`` percent (both sides must report it),
+- or the latest record is missing/unparseable — a record that cannot
+  be graded must fail loudly BEFORE it becomes a round artifact.
+
+Exit codes: 0 ok, 2 regression, 3 no/unusable latest record. Pure
+host-side file parsing; never imports jax or dials the relay.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _read_ledger(path):
+    sys.path.insert(0, REPO_ROOT)
+    from gibbs_student_t_tpu.obs.ledger import read_ledger
+
+    return read_ledger(path)
+
+
+def _round_rows():
+    """BENCH_r*.json / MULTICHIP_r*.json driver records at the repo
+    root, oldest first."""
+    rows = []
+    for p in sorted(glob.glob(os.path.join(REPO_ROOT, "BENCH_r*.json"))):
+        try:
+            with open(p) as fh:
+                rec = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            continue
+        parsed = rec.get("parsed")
+        rows.append({
+            "source": os.path.basename(p),
+            "kind": "bench_round",
+            "round": rec.get("n"),
+            "parsed": parsed,
+        })
+    for p in sorted(glob.glob(os.path.join(REPO_ROOT,
+                                           "MULTICHIP_r*.json"))):
+        try:
+            with open(p) as fh:
+                rec = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            continue
+        rows.append({
+            "source": os.path.basename(p),
+            "kind": "multichip_round",
+            "ok": rec.get("ok"),
+            "skipped": rec.get("skipped"),
+            "n_devices": rec.get("n_devices"),
+        })
+    return rows
+
+
+def _fmt_num(v, width=12):
+    if v is None:
+        return " " * (width - 1) + "?"
+    if isinstance(v, str):
+        return f"{v:>{width}s}"[:width]
+    return f"{v:{width},.1f}"
+
+
+def _xla_of(rec):
+    """(compile_s, peak_bytes) from a ledger record; None for anything
+    the record marks unavailable."""
+    xla = rec.get("xla") or {}
+    comp = xla.get("compile_s")
+    peak = xla.get("peak_bytes")
+    comp = comp if isinstance(comp, (int, float)) else None
+    peak = peak if isinstance(peak, (int, float)) else None
+    return comp, peak
+
+
+def print_report(ledger_recs, include_rounds=True):
+    if include_rounds:
+        print("== graded round artifacts ==")
+        for r in _round_rows():
+            if r["kind"] == "bench_round":
+                p = r["parsed"]
+                if not p:
+                    print(f"  {r['source']:22s} round {r['round']}: "
+                          "UNPARSEABLE (metric line lost from the "
+                          "graded stream — the failure mode the ledger "
+                          "closes)")
+                else:
+                    print(f"  {r['source']:22s} round {r['round']}: "
+                          f"{p.get('value', '?'):>12} "
+                          f"{p.get('unit', '')} "
+                          f"vs_baseline={p.get('vs_baseline', '?')} "
+                          f"platform={p.get('platform', '?')}")
+            else:
+                verdict = ("skipped" if r.get("skipped")
+                           else "ok" if r.get("ok") else "FAIL")
+                print(f"  {r['source']:22s} {verdict} "
+                      f"(n_devices={r.get('n_devices', '?')})")
+    print("== ledger trajectory ==")
+    if not ledger_recs:
+        print("  (empty ledger)")
+    for rec in ledger_recs:
+        m = rec.get("metrics") or {}
+        comp, peak = _xla_of(rec)
+        if rec.get("tool") == "bench":
+            val = m.get("value")
+            print(f"  {rec.get('timestamp_utc', '?'):20s} "
+                  f"{rec.get('tool', '?'):14s} "
+                  f"{rec.get('platform') or '?':8s} "
+                  f"{_fmt_num(val)} {m.get('unit', ''):>14s} "
+                  f"vs_base={m.get('vs_baseline', '?'):>8} "
+                  f"compile={comp if comp is not None else '?':>7}s "
+                  f"peak={'?' if peak is None else f'{peak / 1e6:.0f}MB':>7} "
+                  f"cfg={rec.get('config_fingerprint')} "
+                  f"sha={str(rec.get('git_sha'))[:8]}")
+        else:
+            brief = {k: v for k, v in m.items()
+                     if isinstance(v, (int, float, bool, str))}
+            print(f"  {rec.get('timestamp_utc', '?'):20s} "
+                  f"{rec.get('tool', '?'):14s} "
+                  f"{rec.get('platform') or '?':8s} {brief}")
+
+
+def check_latest(ledger_recs, max_drop, max_compile_growth,
+                 max_hbm_growth, baseline_mode):
+    """The regression gate; returns the process exit code."""
+    bench = [r for r in ledger_recs if r.get("tool") == "bench"]
+    if not bench:
+        print("check: FAIL — no bench record in the ledger (run "
+              "`python bench.py` first; a graded round without a "
+              "ledger record is exactly the r05 failure)")
+        return 3
+    latest = bench[-1]
+    m = latest.get("metrics") or {}
+    metric, value = m.get("metric"), m.get("value")
+    if not metric or not isinstance(value, (int, float)):
+        print(f"check: FAIL — latest bench record has no usable "
+              f"metric/value ({metric!r}/{value!r})")
+        return 3
+    pool = [r for r in bench[:-1]
+            if (r.get("metrics") or {}).get("metric") == metric
+            and r.get("platform") == latest.get("platform")
+            and isinstance((r.get("metrics") or {}).get("value"),
+                           (int, float))]
+    print(f"check: latest {metric} = {value} "
+          f"(platform={latest.get('platform')}, "
+          f"cfg={latest.get('config_fingerprint')})")
+    if not pool:
+        print("check: PASS — no comparable baseline record yet "
+              "(same metric + platform); nothing to regress against")
+        return 0
+    if baseline_mode == "best":
+        base = max(pool, key=lambda r: r["metrics"]["value"])
+    else:
+        base = pool[-1]
+    bval = base["metrics"]["value"]
+    failures = []
+
+    drop = (bval - value) / bval * 100.0 if bval else 0.0
+    print(f"check: baseline({baseline_mode}) {bval} from "
+          f"{base.get('timestamp_utc')} -> drop {drop:+.1f}% "
+          f"(limit {max_drop}%)")
+    if drop > max_drop:
+        failures.append(
+            f"{metric} dropped {drop:.1f}% (> {max_drop}%)")
+
+    comp, peak = _xla_of(latest)
+    bcomp, bpeak = _xla_of(base)
+    if comp is not None and bcomp is not None and bcomp > 0:
+        growth = (comp - bcomp) / bcomp * 100.0
+        print(f"check: compile_s {bcomp} -> {comp} ({growth:+.1f}%, "
+              f"limit {max_compile_growth}%)")
+        if growth > max_compile_growth:
+            failures.append(f"compile time grew {growth:.1f}% "
+                            f"(> {max_compile_growth}%)")
+    else:
+        print("check: compile_s unavailable on one side — skipped")
+    if peak is not None and bpeak is not None and bpeak > 0:
+        growth = (peak - bpeak) / bpeak * 100.0
+        print(f"check: peak_bytes {bpeak} -> {peak} ({growth:+.1f}%, "
+              f"limit {max_hbm_growth}%)")
+        if growth > max_hbm_growth:
+            failures.append(f"peak program bytes grew {growth:.1f}% "
+                            f"(> {max_hbm_growth}%)")
+    else:
+        print("check: peak_bytes unavailable on one side — skipped")
+
+    if failures:
+        for f in failures:
+            print(f"check: FAIL — {f}")
+        return 2
+    print("check: PASS")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--ledger", default=None,
+                    help="ledger path (default: GST_LEDGER_PATH or the "
+                         "repo's artifacts/ledger.jsonl)")
+    ap.add_argument("--check", action="store_true",
+                    help="regression-gate the latest bench record "
+                         "(nonzero exit on regression or an ungradeable "
+                         "record)")
+    ap.add_argument("--max-drop", type=float, default=30.0,
+                    metavar="PCT",
+                    help="max tolerated metric-value drop vs baseline")
+    ap.add_argument("--max-compile-growth", type=float, default=100.0,
+                    metavar="PCT",
+                    help="max tolerated total-compile-time growth")
+    ap.add_argument("--max-hbm-growth", type=float, default=50.0,
+                    metavar="PCT",
+                    help="max tolerated peak-program-bytes growth")
+    ap.add_argument("--baseline", choices=("prev", "best"),
+                    default="prev",
+                    help="compare against the previous comparable "
+                         "record or the best ever")
+    ap.add_argument("--no-rounds", action="store_true",
+                    help="skip the BENCH_r*/MULTICHIP_r* history fold")
+    args = ap.parse_args(argv)
+
+    ledger = args.ledger
+    if ledger is None and not os.environ.get("GST_LEDGER_PATH"):
+        ledger = os.path.join(REPO_ROOT, "artifacts", "ledger.jsonl")
+    recs = _read_ledger(ledger)
+    print_report(recs, include_rounds=not args.no_rounds)
+    if args.check:
+        return check_latest(recs, args.max_drop,
+                            args.max_compile_growth,
+                            args.max_hbm_growth, args.baseline)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

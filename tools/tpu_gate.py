@@ -208,6 +208,21 @@ def main():
     # artifacts/tpu_gate_mtmw_r04.json was exactly that shape)
     out["complete"] = True
     flush()
+    # durable run-ledger record (obs/ledger.py): the gate verdict with
+    # provenance + XLA compile stats, immune to lost stdout/artifacts
+    try:
+        from gibbs_student_t_tpu.obs import ledger as ledger_mod
+
+        path = ledger_mod.append_record(ledger_mod.make_record(
+            "tpu_gate",
+            {"ok": out["ok"],
+             "models": {k: v["ok"] for k, v in out["models"].items()},
+             "artifact": args.out},
+            platform=out["platform"], config=vars(args)))
+        print(f"[ledger] -> {path}", flush=True)
+    except Exception as e:  # noqa: BLE001 - the gate verdict stands
+        print(f"[ledger] write failed: {type(e).__name__}: {e}",
+              flush=True)
     print(f"[gate] ok={out['ok']} models="
           + ",".join(f"{k}:{v['ok']}" for k, v in out["models"].items()),
           flush=True)
