@@ -64,6 +64,7 @@ from gibbs_student_t_tpu.ops.linalg import (
     precond_quad_logdet,
     robust_precond_cholesky,
     schur_eliminate,
+    vchol_env,
 )
 from gibbs_student_t_tpu.ops.tnt import (
     auto_block_size,
@@ -71,6 +72,45 @@ from gibbs_student_t_tpu.ops.tnt import (
     pad_rows,
     tnt_products,
 )
+
+
+def _bdraw_reuse_env() -> str:
+    """Validated ``GST_BDRAW_REUSE`` (``auto`` when unset) — the
+    b-draw's block-assembled-factor gate. Strict ``auto|1|0``, raising
+    whenever the variable is set to anything else (the same loud-typo
+    contract as ``GST_VCHOL`` / ``GST_ENSEMBLE_UNROLL``)."""
+    env = os.environ.get("GST_BDRAW_REUSE")
+    if env is not None and env not in ("auto", "1", "0"):
+        raise ValueError(
+            f"GST_BDRAW_REUSE must be 'auto', '1' or '0', got {env!r}")
+    return env if env is not None else "auto"
+
+
+def _donate_env() -> str:
+    """Validated ``GST_DONATE_CHUNK`` (``auto`` when unset) — donation
+    of the chunk functions' state buffers. Strict ``auto|1|0``."""
+    env = os.environ.get("GST_DONATE_CHUNK")
+    if env is not None and env not in ("auto", "1", "0"):
+        raise ValueError(
+            f"GST_DONATE_CHUNK must be 'auto', '1' or '0', got {env!r}")
+    return env if env is not None else "auto"
+
+
+def _fast_gamma_env() -> str:
+    """Validated ``GST_FAST_GAMMA`` (``auto`` when unset) — the alpha
+    update's chi-square gamma construction. Strict ``auto|1|0``;
+    ``auto`` resolves per-platform at construction time: ON for
+    non-TPU backends, where ``random.gamma``'s per-element rejection
+    While-loop is the single largest cost of the whole sweep (measured
+    1.76 s for a (1024, 130) draw on the graded CPU host,
+    tools/cpu_microbench.py — more than ALL linear algebra combined);
+    OFF on TPU, where the native sampler costs ~0.5 ms and staying on
+    it keeps chains bit-identical with earlier rounds."""
+    env = os.environ.get("GST_FAST_GAMMA")
+    if env is not None and env not in ("auto", "1", "0"):
+        raise ValueError(
+            f"GST_FAST_GAMMA must be 'auto', '1' or '0', got {env!r}")
+    return env if env is not None else "auto"
 
 
 class ChainState(NamedTuple):
@@ -193,7 +233,8 @@ def record_tuple(st, fields, casts):
 
 def chunked_sweep_loop(state, niter, chunk_size, start_sweep,
                        step_fn, flush_fn, reinit_fn=None, n_reinits=0,
-                       pre_chunk_fn=None, pre_chunk_until=0):
+                       pre_chunk_fn=None, pre_chunk_until=0,
+                       snapshot_fn=None):
     """The chunk-orchestration loop shared by ``JaxGibbs.sample`` and
     ``EnsembleGibbs.sample`` (parallel/ensemble.py) so the flush
     machinery cannot drift between them.
@@ -211,7 +252,11 @@ def chunked_sweep_loop(state, niter, chunk_size, start_sweep,
     chunk k's records, overlapping transfer with compute (crash window:
     up to two chunks — see ``JaxGibbs.sample``). With it, flushes are
     sequential (the divergence scan needs each post-chunk state on
-    host). Returns ``(state, n_reinits)``."""
+    host). ``snapshot_fn``, when given, is applied to the state stored
+    for a DEFERRED flush: with donated chunk buffers the next dispatch
+    consumes chunk k's state buffers before its flush runs, so a flush
+    that reads the state (the spool checkpoint) gets a copy taken while
+    the buffers were still live. Returns ``(state, n_reinits)``."""
     done = 0
     pending = None
     while done < niter:
@@ -227,7 +272,9 @@ def chunked_sweep_loop(state, niter, chunk_size, start_sweep,
         else:
             if pending is not None:
                 flush_fn(*pending, n_reinits)
-            pending = (recs, state, start_sweep + done)
+            pending = (recs,
+                       state if snapshot_fn is None else snapshot_fn(state),
+                       start_sweep + done)
     if pending is not None:
         flush_fn(*pending, n_reinits)
     return state, n_reinits
@@ -649,6 +696,45 @@ class JaxGibbs(SamplerBackend):
                     self._hyper_consts.hyp_idx, config.jitter)
         self._telemetry = bool(telemetry)
         self.metrics = metrics
+        # GST_VCHOL is consulted at trace time inside the linalg
+        # dispatch; validating here too makes a typo'd value fail at
+        # construction, before any compile work (satellite contract:
+        # raise whenever set, independent of which path wins)
+        vchol_env()
+        # b-draw block-factor reuse (exact block algebra, ops/linalg.py
+        # schur_eliminate docstring): only available on the Schur path,
+        # auto-on there — it replaces the 4-level stacked-jitter full-m
+        # factorization with one robust v-block factorization plus two
+        # block substitutions, on every backend TPU included. The
+        # A-block factor is shared with the hyper MH, whose failure
+        # semantics are already reject-all; with reuse on, a non-PD A
+        # also poisons the b-draw (NaN b -> divergence machinery)
+        # instead of the old full-Sigma robust rescue — the measured
+        # cost of that rescue was 4 m x m factorizations per sweep on
+        # every chain for a corner only near-singular f32 transients
+        # ever hit (reinit_diverged recovers those).
+        renv = _bdraw_reuse_env()
+        self._bdraw_reuse = (self._schur is not None
+                             if renv == "auto" else renv == "1")
+        # alpha-update gamma draw: the shape parameter (z + df)/2 is
+        # always half-integer (z in {0,1}, df on the integer grid
+        # 1..df_max), so Gamma(k/2) == 0.5 * chi^2_k == half the sum of
+        # k squared standard normals — an EXACT construction with no
+        # rejection loop. Platform-adaptive (docstring of
+        # _fast_gamma_env); draws a different (equally exact) stream
+        # than random.gamma, so flipping it changes chains in value but
+        # not in law (tests/test_vchol.py pins the distribution).
+        genv = _fast_gamma_env()
+        self._fast_gamma = ((jax.default_backend() not in ("tpu", "axon"))
+                            if genv == "auto" else genv == "1")
+        # donated chunk buffers: chunk k's ChainState input buffers are
+        # reused for chunk k+1's outputs instead of re-allocating
+        # ~per-chunk state each dispatch. sample() defends the caller's
+        # state object with ONE up-front copy per call; the
+        # double-buffered spool flush snapshots the checkpoint state
+        # before the next dispatch invalidates it (chunked_sweep_loop
+        # snapshot_fn). auto -> on.
+        self._donate = _donate_env() != "0"
         # the chunk program goes through the explicit lower->compile
         # introspection path (obs/introspect.py): same compile count as
         # plain jit, but compile wall time + XLA cost/memory analyses
@@ -656,11 +742,14 @@ class JaxGibbs(SamplerBackend):
         # `compile` events when a MetricsRegistry is attached)
         from gibbs_student_t_tpu.obs.introspect import introspect_jit
 
+        donate = (0,) if self._donate else ()
         self._chunk_fn = introspect_jit(
-            jax.jit(self._make_chunk_fn(), static_argnames=("length",)),
+            jax.jit(self._make_chunk_fn(), static_argnames=("length",),
+                    donate_argnums=donate),
             label=f"jaxgibbs_chunk_c{nchains}",
             registry=lambda: self.metrics,
-            static_argnames=("length",))
+            static_argnames=("length",),
+            donate_argnums=donate)
         self._prop_cov_fn = (jax.jit(self._prop_cov_update)
                              if config.mh.adapt_cov else None)
         self.last_state: Optional[ChainState] = None
@@ -1022,17 +1111,25 @@ class JaxGibbs(SamplerBackend):
         # --- hyper MH block on the marginalized likelihood -------------
         # (reference gibbs.py:80-111, 288-329)
         jump_scale_h = jnp.exp(state.mh_log_scale[1])
+        bdraw_reuse = (self._bdraw_reuse and self._schur is not None
+                       and len(ma.hyper_indices))
         if self._schur is not None and len(ma.hyper_indices):
             # Once per sweep: eliminate the phi-static columns so each
             # proposal factors only the varying block — algebra and
             # failure semantics in ops/linalg.py schur_eliminate. Shared
-            # by the fused and closure paths below.
+            # by the fused and closure paths below; with b-draw reuse
+            # the A-block factor pieces ride along for the coefficient
+            # draw's block-assembled factorization.
             s_i, v_i = self._schur
             phiinv_s = phiinv_logdet(ma, x, jnp)[0][s_i]  # x-independent
-            S0, rt, quad_s, logdetA = schur_eliminate(
+            schur_out = schur_eliminate(
                 TNT[np.ix_(s_i, s_i)] + jnp.diag(phiinv_s),
                 TNT[np.ix_(s_i, v_i)], TNT[np.ix_(v_i, v_i)],
-                d[s_i], d[v_i], cfg.jitter)
+                d[s_i], d[v_i], cfg.jitter,
+                return_factor=bdraw_reuse)
+            S0, rt, quad_s, logdetA = schur_out[:4]
+            if bdraw_reuse:
+                La, isd_a, U_B, u_s = schur_out[4]
         cov_h = self._block_cov(state, 1)
         mtm_h = (cfg.mh.mtm_tries >= 2
                  and "hyper" in cfg.mh.mtm_blocks)
@@ -1113,15 +1210,44 @@ class JaxGibbs(SamplerBackend):
         # gibbs.py:168-178).
         with block_span("gibbs/b_draw"):
             phiinv, _ = phiinv_logdet(ma, x, jnp)
-            Sigma = TNT + jnp.diag(phiinv)
-            L, isd, _, u = robust_precond_cholesky(
-                Sigma, jitters=(cfg.jitter, 1e-4, 1e-2, 1e-1), rhs=d)
-            # b = mean + fluct = D^-1/2 L^-T (u + xi): the forward solve
-            # rode along with the factorization, so one backward
-            # substitution yields the draw (reference gibbs.py:169-180's
-            # mn + Li*xi)
             xi = random.normal(kb, (m,), dtype=self.dtype)
-            b = backward_solve(L, u + xi) * isd
+            if bdraw_reuse:
+                # Block-factor reuse: the sweep already paid for
+                # chol(A) (schur_eliminate, once per sweep) and the
+                # v-block is the only part phi-varying — so factor just
+                # S_v = S0 + diag(phiinv_v) at the accepted x
+                # (escalating jitters preserve the draw's cannot-fail
+                # contract on that block) and assemble the permuted
+                # full factor blockwise (ops/linalg.py schur_eliminate
+                # docstring) instead of re-factoring Sigma from
+                # scratch through the 4-level stacked-jitter
+                # robust_precond_cholesky. Exact block algebra; the xi
+                # -> b map differs from the full-factor path by a
+                # distribution-preserving rotation, so on/off chains
+                # agree in law (and the factor reconstructs Sigma to
+                # f64 roundoff — tests/test_vchol.py pins both).
+                Sv = S0 + jnp.diag(phiinv[v_i])
+                Ls, isd_v, _, u_v = robust_precond_cholesky(
+                    Sv, jitters=(cfg.jitter, 1e-4, 1e-2, 1e-1), rhs=rt)
+                ns = len(s_i)
+                y_v = backward_solve(Ls, u_v + xi[ns:])
+                hi = jax.lax.Precision.HIGHEST
+                wty = jnp.matmul(
+                    U_B, (isd_v * y_v)[..., None], precision=hi)[..., 0]
+                y_s = backward_solve(La, u_s + xi[:ns] - wty)
+                b = (jnp.zeros(m, dtype=self.dtype)
+                     .at[s_i].set(y_s * isd_a)
+                     .at[v_i].set(y_v * isd_v))
+            else:
+                Sigma = TNT + jnp.diag(phiinv)
+                L, isd, _, u = robust_precond_cholesky(
+                    Sigma, jitters=(cfg.jitter, 1e-4, 1e-2, 1e-1), rhs=d)
+                # b = mean + fluct = D^-1/2 L^-T (u + xi): the forward
+                # solve rode along with the factorization, so one
+                # backward substitution yields the draw (reference
+                # gibbs.py:169-180's mn + Li*xi)
+                b = backward_solve(L, u + xi)
+                b = b * isd
 
         resid = ma.y - matvec_blocked(ma.T, b, bs)
         nvec0 = ndiag(ma, x, jnp)
@@ -1158,7 +1284,21 @@ class JaxGibbs(SamplerBackend):
         # --- auxiliary scales alpha (reference gibbs.py:229-242) --------
         if cfg.vary_alpha:
             top = (resid * resid * z / nvec0 + df) / 2.0
-            g = random.gamma(ka, (z + df) / 2.0, dtype=self.dtype)
+            if self._fast_gamma:
+                # exact: Gamma(k/2, 1) = 0.5 * chi^2_k for the integer
+                # k = z + df; draw df_max+1 normals per TOA and mask —
+                # fixed shapes, no rejection While loop (the measured
+                # CPU sweep hot spot; see _fast_gamma_env)
+                # tdf covers vary_df=False runs (df pinned above the
+                # grid would otherwise silently truncate the mask)
+                kmax = int(max(cfg.df_max, cfg.tdf)) + 1
+                xs = random.normal(ka, z.shape + (kmax,),
+                                   dtype=self.dtype)
+                live = jnp.arange(kmax, dtype=self.dtype) < (
+                    z + df)[..., None]
+                g = 0.5 * jnp.sum(jnp.where(live, xs * xs, 0.0), axis=-1)
+            else:
+                g = random.gamma(ka, (z + df) / 2.0, dtype=self.dtype)
             alpha_new = top / g
             if mask is not None:
                 alpha_new = jnp.where(mask, alpha_new, 1.0)
@@ -1408,6 +1548,13 @@ class JaxGibbs(SamplerBackend):
         resume = start_sweep > 0
         if state is None:
             state = self.init_state(x0, seed=seed)
+        elif self._donate:
+            # the chunk fn donates its state argument, which would
+            # invalidate the CALLER's state object on the first
+            # dispatch; one up-front copy per sample() call keeps the
+            # caller's (and a prior call's last_state) buffers intact
+            # while every per-chunk re-allocation is still saved
+            state = jax.tree.map(jnp.copy, state)
         keys = random.split(random.PRNGKey(seed), self.nchains)
         spool = None
         if spool_dir is not None:
@@ -1458,7 +1605,12 @@ class JaxGibbs(SamplerBackend):
                              if self.config.mh.adapt_cov else 0),
             reinit_fn=((lambda st, end: self._reinit_diverged(
                 st, seed=seed + 7919 * end)) if reinit_diverged else None),
-            n_reinits=n_reinits0)
+            n_reinits=n_reinits0,
+            # deferred spool flushes read the checkpoint state after
+            # the next chunk has consumed its donated buffers — copy it
+            # while live (in-memory flushes never touch the state)
+            snapshot_fn=((lambda st: jax.tree.map(jnp.copy, st))
+                         if self._donate and spool is not None else None))
         if spool is not None:
             spool.close()
             from gibbs_student_t_tpu.utils.spool import load_spool

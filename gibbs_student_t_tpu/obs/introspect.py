@@ -193,7 +193,8 @@ class IntrospectedJit:
 
     def __init__(self, jfn, label: str,
                  registry: Optional[Callable] = None,
-                 static_argnames: Tuple[str, ...] = ()):
+                 static_argnames: Tuple[str, ...] = (),
+                 donate_argnums: Tuple[int, ...] = ()):
         self._jfn = jfn
         self.label = label
         # registry: None, a MetricsRegistry, or a zero-arg callable
@@ -201,6 +202,12 @@ class IntrospectedJit:
         # after construction)
         self._registry = registry
         self._static_argnames = frozenset(static_argnames)
+        # informational: the wrapped jit already carries the donation
+        # (buffer aliasing survives the explicit lower->compile path);
+        # recording the argnums here makes every compile record say
+        # whether the program reuses its input buffers — the evidence
+        # trail for the donated-chunk-buffer optimization
+        self._donate_argnums = tuple(donate_argnums)
         self._cache: Dict[Tuple, Any] = {}
         self._broken = False
 
@@ -242,6 +249,8 @@ class IntrospectedJit:
         t2 = time.perf_counter()
         rec = analyze_compiled(compiled, label=self.label,
                                lower_s=t1 - t0, compile_s=t2 - t1)
+        if self._donate_argnums:
+            rec["donate_argnums"] = list(self._donate_argnums)
         with _LOCK:
             _COMPILE_LOG.append(rec)
         reg = self._registry_now()
@@ -262,14 +271,19 @@ class IntrospectedJit:
 
 def introspect_jit(jfn, label: str,
                    registry: Optional[Callable] = None,
-                   static_argnames: Tuple[str, ...] = ()):
+                   static_argnames: Tuple[str, ...] = (),
+                   donate_argnums: Tuple[int, ...] = ()):
     """Wrap a jitted callable with compile introspection (see
     :class:`IntrospectedJit`); returns ``jfn`` unchanged when
-    ``GST_INTROSPECT`` disables the layer."""
+    ``GST_INTROSPECT`` disables the layer. ``donate_argnums`` is the
+    donation the wrapped jit was built with — threaded through so each
+    compile record documents the buffer reuse (the donation itself
+    rides the jit through lower()/compile() either way)."""
     if not _enabled():
         return jfn
     return IntrospectedJit(jfn, label, registry=registry,
-                           static_argnames=static_argnames)
+                           static_argnames=static_argnames,
+                           donate_argnums=donate_argnums)
 
 
 # ----------------------------------------------------------------------

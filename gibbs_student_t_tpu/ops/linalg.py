@@ -29,6 +29,14 @@ oracle-parity paths) still lowers to the plain XLA expander.
 ``GST_PALLAS_CHOL=auto|1|interpret|0`` gates it; the trace-unrolled XLA
 replacement (ops/unrolled_chol.py) stays opt-in via
 ``GST_UNROLLED_CHOL=1`` only (wins standalone, loses in-sweep).
+
+On non-TPU backends the production path is the portable vectorized one
+(ops/vchol.py, ``GST_VCHOL=auto|1|0``): the batched LAPACK/XLA
+factorization kept as-is, every triangular-solve EXPANDER replaced by
+trace-time panel-unrolled substitutions — dispatched through the same
+``custom_vmap`` fold so the in-sweep chain batch is visible, with the
+same MIN_BATCH floor so unbatched oracle-parity calls stay on the
+expander (docs/PERFORMANCE.md "The portable path").
 """
 
 from __future__ import annotations
@@ -47,6 +55,57 @@ from gibbs_student_t_tpu.ops.pallas_chol import (
     tri_solve_T_lane,
 )
 from gibbs_student_t_tpu.ops.unrolled_chol import chol_forward, tri_solve_T
+from gibbs_student_t_tpu.ops.vchol import (
+    MAX_VCHOL_DIM,
+    bwd_solve_mat,
+    bwd_solve_vec,
+    fwd_solve_mat,
+    vchol_factor,
+)
+
+
+def vchol_env() -> str:
+    """Validated ``GST_VCHOL`` value (``auto`` when unset).
+
+    Raises on anything outside ``auto|1|0`` WHENEVER the variable is
+    set, independent of which dispatch path ultimately wins — a typo'd
+    override must fail loudly, not silently measure the wrong arm (the
+    ``GST_ENSEMBLE_UNROLL`` validation contract, parallel/ensemble.py).
+    """
+    env = os.environ.get("GST_VCHOL")
+    if env is not None and env not in ("auto", "1", "0"):
+        raise ValueError(
+            f"GST_VCHOL must be 'auto', '1' or '0', got {env!r}")
+    return env if env is not None else "auto"
+
+
+def _vchol_mode():
+    """``(enabled, forced)`` for the portable vectorized path.
+
+    ``auto`` resolves per-platform from the measured A/B
+    (tools/cpu_microbench.py, docs/PERFORMANCE.md "The portable
+    path"): ON for non-TPU backends, where the triangular-solve
+    expander is the hot spot; OFF on TPU, where the production path is
+    the Pallas lane kernel and the unrolled-program experiment already
+    measured long unrolled programs scheduling badly inside the sweep
+    (artifacts/tpu_validation_r02.json). Read at TRACE time, same
+    snapshot semantics as ``GST_PALLAS_CHOL``.
+    """
+    env = vchol_env()
+    if env == "0":
+        return False, False
+    if env == "1":
+        return True, True
+    return jax.default_backend() not in ("tpu", "axon"), False
+
+
+def _vchol_ok(shape, forced: bool) -> bool:
+    """Batch/size guard: below the shared Pallas threshold the
+    (unbatched) CPU oracle-parity paths keep the plain expander, so
+    their numbers stay byte-stable vs earlier rounds."""
+    batch = int(np.prod(shape[:-2])) if len(shape) > 2 else 1
+    return (shape[-1] <= MAX_VCHOL_DIM
+            and (forced or batch >= _PALLAS_MIN_BATCH))
 
 
 def _unrolled_wanted(m: int) -> bool:
@@ -114,9 +173,13 @@ def _factor_fused(S, rhs):
     *before* this dispatch runs, so a chain-vmapped call sees the full
     chain batch here."""
     enabled, interp, forced = _pallas_chol_mode()
+    v_on, v_forced = _vchol_mode()  # validates GST_VCHOL even when
+    # the Pallas kernel wins the dispatch below
     if enabled and _pallas_ok(S.shape, S.dtype, forced):
         L, logdet, u = chol_fused_lane(S, rhs, interpret=interp)
         return L, logdet, u
+    if v_on and _vchol_ok(S.shape, v_forced):
+        return vchol_factor(S, rhs)
     L = jnp.linalg.cholesky(S)
     logdet = 2.0 * jnp.sum(jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)),
                            axis=-1)
@@ -138,8 +201,11 @@ def _backsolve_fused(L, rhs):
     """``L^T x = rhs`` — Pallas lane-batched backward substitution or the
     XLA triangular-solve, same dispatch as :func:`_factor_fused`."""
     enabled, interp, forced = _pallas_chol_mode()
+    v_on, v_forced = _vchol_mode()
     if enabled and _pallas_ok(L.shape, L.dtype, forced):
         return tri_solve_T_lane(L, rhs, interpret=interp)
+    if v_on and _vchol_ok(L.shape, v_forced):
+        return bwd_solve_vec(L, rhs)
     return solve_triangular(L, rhs, lower=True, trans="T")
 
 
@@ -150,6 +216,48 @@ def _backsolve_fused_vmap(axis_size, in_batched, L, rhs):
     if not in_batched[1]:
         rhs = jnp.broadcast_to(rhs, (axis_size,) + rhs.shape)
     return _backsolve_fused(L, rhs), True
+
+
+@custom_vmap
+def _fwd_mat_fused(L, R):
+    """``L X = R`` for matrix rhs ``R (..., m, k)`` — the unrolled
+    vectorized substitution when the vchol gate is on (the Schur
+    pre-elimination's solves are per-sweep multi-rhs expander calls
+    otherwise), XLA triangular-solve else. Same fold-the-mapped-axis
+    dispatch as :func:`_factor_fused`; no Pallas variant exists (the
+    TPU sweep reaches these solves once per sweep, not per proposal)."""
+    v_on, v_forced = _vchol_mode()
+    if v_on and _vchol_ok(L.shape, v_forced):
+        return fwd_solve_mat(L, R)
+    return solve_triangular(L, R, lower=True)
+
+
+@_fwd_mat_fused.def_vmap
+def _fwd_mat_fused_vmap(axis_size, in_batched, L, R):
+    if not in_batched[0]:
+        L = jnp.broadcast_to(L, (axis_size,) + L.shape)
+    if not in_batched[1]:
+        R = jnp.broadcast_to(R, (axis_size,) + R.shape)
+    return _fwd_mat_fused(L, R), True
+
+
+@custom_vmap
+def _bwd_mat_fused(L, R):
+    """``L^T X = R`` for matrix rhs, same dispatch as
+    :func:`_fwd_mat_fused`."""
+    v_on, v_forced = _vchol_mode()
+    if v_on and _vchol_ok(L.shape, v_forced):
+        return bwd_solve_mat(L, R)
+    return solve_triangular(L, R, lower=True, trans="T")
+
+
+@_bwd_mat_fused.def_vmap
+def _bwd_mat_fused_vmap(axis_size, in_batched, L, R):
+    if not in_batched[0]:
+        L = jnp.broadcast_to(L, (axis_size,) + L.shape)
+    if not in_batched[1]:
+        R = jnp.broadcast_to(R, (axis_size,) + R.shape)
+    return _bwd_mat_fused(L, R), True
 
 
 def _factor(S, rhs=None):
@@ -239,7 +347,7 @@ def backward_solve(L, rhs):
 
 
 def schur_eliminate(Sigma_ss, Sigma_sv, Sigma_vv, rhs_s, rhs_v,
-                    jitter: float = 0.0):
+                    jitter: float = 0.0, return_factor: bool = False):
     """Pre-eliminate a fixed block of ``Sigma`` for repeated solves.
 
     For ``Sigma = [[A, B], [B^T, C + D]]`` where only the diagonal ``D``
@@ -256,12 +364,23 @@ def schur_eliminate(Sigma_ss, Sigma_sv, Sigma_vv, rhs_s, rhs_v,
     ``Sigma`` sharing it, so a non-PD ``A`` (NaN here) poisons every
     evaluation — the same reject-all failure semantics as factoring the
     full matrix per evaluation.
+
+    With ``return_factor``, appends ``(La, isd_a, U_B, u_s)`` — the
+    A-block's preconditioned Cholesky factor, ``U_B = La^-1 D_a^-1/2
+    B`` and ``u_s = La^-1 D_a^-1/2 rhs_s`` — the pieces the b-draw's
+    block-assembled factorization reuses (backends/jax_backend.py
+    ``_sweep_rest``): for any v-block factor ``S0 + D = D_v^1/2 Ls
+    Ls^T D_v^1/2``, the permuted ``Sigma`` factors exactly as
+
+        Sigma_perm = Dd^1/2 [[La, 0], [W, Ls]] [[La, 0], [W, Ls]]^T Dd^1/2
+
+    with ``Dd = blockdiag(D_a, D_v)`` and ``W = D_v^-1/2 B^T D_a^-1/2
+    La^-T = (U_B * D_v^-1/2)^T`` — no full m x m refactorization.
     """
     La, isd_a, logdetA = precond_cholesky(Sigma_ss, jitter)
     rhsM = jnp.concatenate([Sigma_sv, rhs_s[..., :, None]], axis=-1)
-    u = solve_triangular(La, rhsM * isd_a[..., :, None], lower=True)
-    w = solve_triangular(La, u, lower=True,
-                         trans="T") * isd_a[..., :, None]
+    u = _fwd_mat_fused(La, rhsM * isd_a[..., :, None])
+    w = _bwd_mat_fused(La, u) * isd_a[..., :, None]
     Ainv_rs = w[..., :, -1]
     quad_s = jnp.sum(rhs_s * Ainv_rs, axis=-1)
     mT = jnp.swapaxes(Sigma_sv, -1, -2)
@@ -270,7 +389,10 @@ def schur_eliminate(Sigma_ss, Sigma_sv, Sigma_vv, rhs_s, rhs_v,
     hi = jax.lax.Precision.HIGHEST
     S0 = Sigma_vv - jnp.matmul(mT, w[..., :, :-1], precision=hi)
     rt = rhs_v - jnp.matmul(mT, Ainv_rs[..., None], precision=hi)[..., 0]
-    return S0, rt, quad_s, logdetA
+    out = (S0, rt, quad_s, logdetA)
+    if return_factor:
+        out = out + ((La, isd_a, u[..., :, :-1], u[..., :, -1]),)
+    return out
 
 
 def precond_solve_quad(L, inv_sqrt_d, rhs):

@@ -1,0 +1,166 @@
+"""Portable vectorized batched Cholesky + unrolled triangular solves.
+
+The graded bench has run on the CPU platform for five consecutive
+rounds (BENCH_r01-r05), and there `hyper_and_draws` was 682 ms of a
+~750 ms sweep — 92% — because the fused MH kernels are TPU-only and the
+closure path's factorizations/solves took whatever XLA:CPU emits. This
+module is the portable (any non-TPU backend, pure ``jnp``) counterpart
+of the Pallas lane-batched kernel (ops/pallas_chol.py), built from a
+measured decomposition of where that 682 ms actually went
+(``tools/cpu_microbench.py``, artifacts/cpu_microbench_r06.json):
+
+- ``jnp.linalg.cholesky`` on XLA:CPU is **not** a sequential expander:
+  it lowers to one batched LAPACK ``?potrf`` FFI call (~28 ms for a
+  (1024, 74, 74) f32 batch) — already near-optimal, not worth
+  replacing. A trace-time fully-unrolled factorization (the
+  ops/unrolled_chol.py recurrence) measures 200 ms on the same batch,
+  and the Pallas kernel's chains-last ``(col, row, chain)`` layout is
+  actively hostile to XLA:CPU, whose batched matmul wants batch
+  leading (a chains-last panel GEMM measures 14x slower than the
+  identical batch-first contraction). The lane-batching insight does
+  NOT transfer; what transfers is the *fused-solve + fixed-shape*
+  discipline below.
+- ``triangular_solve`` IS a sequential expander on CPU — a While loop
+  over columns with dynamic slices, ~100 ms per batched forward solve
+  (~4x the factorization it follows). That is the portable hot spot.
+
+So the portable path keeps the batched LAPACK factorization and
+replaces every triangular-solve expander with a **trace-time
+panel-unrolled substitution** in the batch-leading layout: ``m`` is a
+static model constant, each panel's cross-panel correction is one
+batched GEMM (or broadcast-multiply-sum for vector rhs), and the
+in-panel recurrence is ~``m`` fixed-shape vector ops over the whole
+chain batch. Measured on the flagship batch: forward solve 100 ms ->
+~4 ms, backward solve 67 ms -> 12 ms; factor+logdet+forward-solve
+fused 135 ms -> 32 ms (the ops/unrolled_chol.py shape rules: no
+growing concats, ~10 distinct op shapes).
+
+Failure semantics are branchless and identical to every other path:
+a non-PD input makes ``jnp.linalg.cholesky`` return NaN, which the
+solves and ``logdet`` propagate — callers map non-finite to ``-inf``
+log-likelihood / MH rejection (ops/linalg.py).
+
+Gated by ``GST_VCHOL=auto|1|0`` in ops/linalg.py (auto: on for
+non-TPU backends, off on TPU — the sweep there runs the Pallas kernel,
+and the in-sweep A/B showed long unrolled programs schedule badly in
+the TPU sweep, artifacts/tpu_validation_r02.json).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+# Above this the unrolled solve program stops paying for itself (HLO
+# count grows linearly with m) — same bound as ops/unrolled_chol.py.
+MAX_VCHOL_DIM = 160
+
+#: Panel width of the unrolled substitutions. 16 keeps one panel's
+#: working set (panel x m x chains) inside L2 at the flagship shape and
+#: the program at ~m/16 GEMMs + m small vector ops.
+PANEL = 16
+
+
+def _offsets(m: int, panel: int):
+    """Static panel offsets; the tail panel is simply narrower (no
+    padding — a second trailing shape is still a fixed shape)."""
+    return [(o, min(panel, m - o)) for o in range(0, m, panel)]
+
+
+def fwd_solve_vec(L, rhs, panel: int = PANEL):
+    """``L x = rhs`` by panel-unrolled forward substitution.
+
+    ``L (..., m, m)`` lower-triangular, ``rhs (..., m)``. Each panel
+    subtracts the contribution of every already-solved entry with one
+    broadcast-multiply-sum over the full row block (entries of ``x``
+    beyond the solved prefix are still zero, so the full-width
+    contraction is the partial sum the recurrence needs), then runs the
+    in-panel recurrence on ``(..., p)`` slices.
+    """
+    m = L.shape[-1]
+    x = jnp.zeros_like(rhs)
+    for o, p in _offsets(m, panel):
+        rp = rhs[..., o:o + p] - jnp.sum(
+            L[..., o:o + p, :] * x[..., None, :], axis=-1)
+        Bd = L[..., o:o + p, o:o + p]
+        xp = jnp.zeros_like(rp)
+        for i in range(p):
+            ci = jnp.sum(Bd[..., i, :] * xp, axis=-1)
+            xp = xp.at[..., i].set((rp[..., i] - ci) / Bd[..., i, i])
+        x = x.at[..., o:o + p].set(xp)
+    return x
+
+
+def bwd_solve_vec(L, rhs, panel: int = PANEL):
+    """``L^T x = rhs`` by panel-unrolled backward substitution, same
+    fixed-shape discipline as :func:`fwd_solve_vec` (descending panels;
+    unsolved entries are zero so full-column contractions are safe)."""
+    m = L.shape[-1]
+    x = jnp.zeros_like(rhs)
+    for o, p in reversed(_offsets(m, panel)):
+        rp = rhs[..., o:o + p] - jnp.sum(
+            L[..., :, o:o + p] * x[..., :, None], axis=-2)
+        Bd = L[..., o:o + p, o:o + p]
+        xp = jnp.zeros_like(rp)
+        for i in range(p - 1, -1, -1):
+            ci = jnp.sum(Bd[..., :, i] * xp, axis=-1)
+            xp = xp.at[..., i].set((rp[..., i] - ci) / Bd[..., i, i])
+        x = x.at[..., o:o + p].set(xp)
+    return x
+
+
+def fwd_solve_mat(L, R, panel: int = PANEL):
+    """``L X = R`` for a matrix right-hand side ``R (..., m, k)``.
+
+    The cross-panel correction is a batch-leading batched GEMM (the
+    layout XLA:CPU's dot_general is fast in — see the module header);
+    the in-panel recurrence works on ``(..., p, k)`` slices.
+    """
+    m = L.shape[-1]
+    X = jnp.zeros_like(R)
+    for o, p in _offsets(m, panel):
+        rp = R[..., o:o + p, :] - jnp.einsum(
+            "...bj,...jk->...bk", L[..., o:o + p, :], X)
+        Bd = L[..., o:o + p, o:o + p]
+        xp = jnp.zeros_like(rp)
+        for i in range(p):
+            ci = jnp.sum(Bd[..., i, :, None] * xp, axis=-2)
+            xp = xp.at[..., i, :].set(
+                (rp[..., i, :] - ci) / Bd[..., i, i, None])
+        X = X.at[..., o:o + p, :].set(xp)
+    return X
+
+
+def bwd_solve_mat(L, R, panel: int = PANEL):
+    """``L^T X = R`` for a matrix right-hand side ``R (..., m, k)``."""
+    m = L.shape[-1]
+    X = jnp.zeros_like(R)
+    for o, p in reversed(_offsets(m, panel)):
+        rp = R[..., o:o + p, :] - jnp.einsum(
+            "...jb,...jk->...bk", L[..., :, o:o + p], X)
+        Bd = L[..., o:o + p, o:o + p]
+        xp = jnp.zeros_like(rp)
+        for i in range(p - 1, -1, -1):
+            ci = jnp.sum(Bd[..., :, i, None] * xp, axis=-2)
+            xp = xp.at[..., i, :].set(
+                (rp[..., i, :] - ci) / Bd[..., i, i, None])
+        X = X.at[..., o:o + p, :].set(xp)
+    return X
+
+
+def vchol_factor(S, rhs=None
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray,
+                            Optional[jnp.ndarray]]:
+    """``(L, logdet S, L^-1 rhs | None)`` — the portable fused
+    factorization: one batched LAPACK/XLA ``cholesky`` plus the
+    unrolled forward substitution, no triangular-solve expander.
+
+    Works at any dtype (the f64 parity-pin path runs it too); NaN from
+    a non-PD input propagates through ``logdet`` and the solve.
+    """
+    L = jnp.linalg.cholesky(S)
+    logdet = 2.0 * jnp.sum(
+        jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), axis=-1)
+    u = None if rhs is None else fwd_solve_vec(L, rhs)
+    return L, logdet, u

@@ -229,12 +229,17 @@ class EnsembleGibbs:
         # the single-model backend (obs/introspect.py)
         from gibbs_student_t_tpu.obs.introspect import introspect_jit
 
+        # donated chunk buffers, same policy and env gate as the
+        # single-model backend (the template resolved GST_DONATE_CHUNK)
+        self._donate = self.template._donate
+        donate = (0,) if self._donate else ()
         self._step = introspect_jit(
             self._build_step(),
             label=(f"ensemble_{'unrolled' if self._unrolled else 'grouped'}"
                    f"_chunk_p{self.npulsars}_c{nchains}"),
             registry=lambda: self.metrics,
-            static_argnames=("length",))
+            static_argnames=("length",),
+            donate_argnums=donate)
         # per-pulsar population-covariance re-estimation at chunk
         # boundaries (MHConfig.adapt_cov): the single-model update
         # vmapped over the pulsar axis — the stacked models share one
@@ -441,7 +446,9 @@ class EnsembleGibbs:
                     check_vma=False,
                 )(states, keys)
 
-            return jax.jit(step_unrolled, static_argnames=("length",))
+            return jax.jit(step_unrolled, static_argnames=("length",),
+                           donate_argnums=(
+                               (0,) if self.template._donate else ()))
 
         # grouped traced-consts form: the stacked model rides as a jit
         # operand (cast here, AFTER the unrolled early-return, so the
@@ -514,7 +521,9 @@ class EnsembleGibbs:
 
         return jax.jit(functools.partial(step, stacked,
                                          self._fused_consts),
-                       static_argnames=("length",))
+                       static_argnames=("length",),
+                       donate_argnums=((0,) if self.template._donate
+                                       else ()))
 
     # -- sampling -----------------------------------------------------------
 
@@ -546,6 +555,10 @@ class EnsembleGibbs:
         resume = start_sweep > 0
         if state is None:
             state = self.init_state(seed)
+        elif self._donate:
+            # the step donates its state argument; protect the caller's
+            # object with one up-front copy (see JaxGibbs.sample)
+            state = jax.tree.map(jnp.copy, state)
         keys = self.chain_keys(seed)
         spool = None
         if spool_dir is not None:
@@ -597,7 +610,9 @@ class EnsembleGibbs:
             pre_chunk_until=mh.adapt_until if mh.adapt_cov else 0,
             reinit_fn=((lambda st, end: self._reinit_diverged(
                 st, seed=seed + 7919 * end)) if reinit_diverged else None),
-            n_reinits=n_reinits0)
+            n_reinits=n_reinits0,
+            snapshot_fn=((lambda st: jax.tree.map(jnp.copy, st))
+                         if self._donate and spool is not None else None))
         self.last_state = state
         if spool is not None:
             spool.close()

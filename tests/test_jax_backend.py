@@ -388,8 +388,18 @@ def test_posterior_gate_mtm(ma):
 def test_mtm_accepts_more_and_matches_default_off(ma, monkeypatch):
     """MTM raises per-step acceptance (K tries per step), composes with
     vmap/chunking, and mtm_tries=0 never routes through the MTM block
-    (the dispatch must keep the reference's single-try path)."""
-    cfg = GibbsConfig(model="gaussian", vary_df=False)
+    (the dispatch must keep the reference's single-try path).
+
+    Deflaked (ISSUE 3): at the reference jump scale the white block
+    accepts ~0.92 — saturated, so the K-try gain drowned in seed noise
+    (measured across 5 seeds: -0.017..+0.055). At sigma_per_param=0.6
+    single-try acceptance sits ~0.70 and the measured MTM(4) gain is
+    +0.10..+0.12 on every seed tried (0,1,2,3,7), so a +0.05 margin
+    has ~2x headroom."""
+    from gibbs_student_t_tpu.config import MHConfig
+
+    cfg = GibbsConfig(model="gaussian", vary_df=False,
+                      mh=MHConfig(sigma_per_param=0.6))
 
     def boom(self, *a, **kw):  # pragma: no cover - trips on regression
         raise AssertionError("_mtm_block dispatched with mtm_tries=0")
@@ -403,7 +413,7 @@ def test_mtm_accepts_more_and_matches_default_off(ma, monkeypatch):
     rm = gbm.sample(niter=50, seed=3)
     assert np.isfinite(np.asarray(rm.chain)).all()
     assert (float(np.asarray(rm.stats["acc_white"]).mean())
-            > float(np.asarray(r1.stats["acc_white"]).mean()))
+            > float(np.asarray(r1.stats["acc_white"]).mean()) + 0.05)
 
 
 def test_mtm_config_validation():
@@ -486,10 +496,16 @@ def test_unrolled_chol_sweep_matches_lapack_path(ma, monkeypatch):
                                atol=5e-4)
 
 
-def test_hyper_schur_sweep_matches_full(ma):
+def test_hyper_schur_sweep_matches_full(ma, monkeypatch):
     """The Schur-eliminated hyper block is exact block algebra: with
     identical keys it must reproduce the full-factorization chains to
-    float precision (f64 here, so any algebra error is glaring)."""
+    float precision (f64 here, so any algebra error is glaring).
+
+    b-draw block-factor reuse is pinned OFF: it only exists on the
+    Schur arm and maps xi -> b through a different (equally exact)
+    factor, so leaving it on would compare two different draws — its
+    own exactness pin lives in tests/test_vchol.py."""
+    monkeypatch.setenv("GST_BDRAW_REUSE", "0")
     cfg = GibbsConfig(model="mixture", vary_df=True, jitter=0.0)
     jax.config.update("jax_enable_x64", True)
     try:

@@ -326,6 +326,49 @@ def test_perf_report_detects_compile_and_hbm_growth(tmp_path, capsys):
     assert pr.main(["--ledger", path, "--check", "--no-rounds"]) == 0
 
 
+def test_perf_report_gates_per_stage_regressions(tmp_path, capsys):
+    """The ISSUE-3 satellite: bench's per-stage wall timings land in
+    the ledger ``stages`` block, and --check fails on a stage that
+    slowed past --max-stage-growth even when the headline metric and
+    XLA stats are flat."""
+    pr = _perf_report()
+
+    def with_stages(rec, hyper_ms):
+        rec["stages"] = {
+            "white_mh_block": {"mean_s": 0.010, "calls": 5},
+            "hyper_and_draws": {"mean_s": hyper_ms, "calls": 5},
+        }
+        return rec
+
+    # hyper stage 3x slower, headline flat -> regression (exit 2)
+    path = _write_ledger(tmp_path, [
+        with_stages(_bench_rec(100.0), 0.10),
+        with_stages(_bench_rec(100.0), 0.30)])
+    assert pr.main(["--ledger", path, "--check", "--no-rounds"]) == 2
+    assert "stage hyper_and_draws slowed" in capsys.readouterr().out
+    # within the growth limit passes; the report renders stage rows
+    path = _write_ledger(tmp_path, [
+        with_stages(_bench_rec(100.0), 0.10),
+        with_stages(_bench_rec(100.0), 0.12)])
+    assert pr.main(["--ledger", path, "--check", "--no-rounds"]) == 0
+    assert "stage hyper_and_draws" in capsys.readouterr().out
+    # a stage missing on one side (or malformed) skips, never fails
+    a = with_stages(_bench_rec(100.0), 0.10)
+    b = _bench_rec(100.0)
+    b["stages"] = {"hyper_and_draws": "garbage"}
+    path = _write_ledger(tmp_path, [a, b])
+    out = None
+    assert pr.main(["--ledger", path, "--check", "--no-rounds"]) == 0
+    out = capsys.readouterr().out
+    assert "per-stage timings unavailable" in out
+    # a custom limit tightens the gate
+    path = _write_ledger(tmp_path, [
+        with_stages(_bench_rec(100.0), 0.10),
+        with_stages(_bench_rec(100.0), 0.12)])
+    assert pr.main(["--ledger", path, "--check", "--no-rounds",
+                    "--max-stage-growth", "10"]) == 2
+
+
 def test_perf_report_baselines_and_unusable_records(tmp_path):
     pr = _perf_report()
     # empty ledger / no bench record -> exit 3 (ungradeable)

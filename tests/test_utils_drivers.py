@@ -97,8 +97,12 @@ def test_block_timings_composes_with_adapt(ma):
         sys.path.remove(root)
     cfg = GibbsConfig(model="mixture").with_adapt(50)
     gb = JaxGibbs(ma, cfg, nchains=2, chunk_size=4)
-    out = bench.block_timings(gb, iters=1)
+    out, stages = bench.block_timings(gb, iters=1)
     assert "white_mh_block" in out
+    # the machine-readable stages block the ledger records (ISSUE 3)
+    assert set(stages) == {"white_mh_block", "tnt_reduction",
+                           "hyper_and_draws"}
+    assert all(v["mean_s"] > 0 for v in stages.values())
 
 
 def test_block_timer():

@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""Per-op CPU microbenchmark — the portable-path counterpart of
+``tools/tpu_microbench.py``.
+
+Measures (a) the isolated batched factorization/solve primitives the
+GST_VCHOL gate chooses between, (b) the ``random.gamma`` rejection
+sampler vs the exact chi-square construction behind GST_FAST_GAMMA,
+and (c) the in-sweep ``hyper_and_draws`` stage across the
+GST_VCHOL x GST_BDRAW_REUSE arms (fast-gamma rides the same
+construction-time snapshot) — the A/B evidence behind the ``auto``
+resolutions in ops/linalg.py and backends/jax_backend.py. Writes a
+JSON artifact (``artifacts/cpu_microbench_r06.json`` for the round-6
+record) so the gate decision is reproducible.
+
+The GST_* flags are read at TRACE time, so each in-sweep arm
+constructs a fresh backend after mutating the environment — the
+same fresh-trace-per-arm discipline as bench.py's fallback ladder,
+without the fresh process (no relay to wedge on CPU).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))  # repo root for the package
+
+_ARM_FLAGS = ("GST_VCHOL", "GST_BDRAW_REUSE", "GST_FAST_GAMMA")
+
+
+def bench(fn, *args, reps=5):
+    import jax
+
+    out = jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = jax.block_until_ready(fn(*args))  # noqa: F841
+    return (time.perf_counter() - t0) / reps * 1e3
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nchains", type=int, default=1024)
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--skip-sweep", action="store_true",
+                    help="only the isolated primitives (fast)")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from jax import random
+    from jax.scipy.linalg import solve_triangular
+
+    from gibbs_student_t_tpu.ops.vchol import (
+        bwd_solve_vec,
+        vchol_factor,
+    )
+
+    C, reps = args.nchains, args.reps
+    results: dict = {"nchains": C, "platform": jax.default_backend()}
+    print(f"platform: {jax.default_backend()}  nchains: {C}")
+
+    rng = np.random.default_rng(0)
+    for m in (74, 60):  # full and Schur-eliminated flagship sizes
+        A = jnp.asarray(rng.standard_normal((C, m, 40)), jnp.float32)
+        S = A @ jnp.swapaxes(A, -1, -2) + 10.0 * jnp.eye(m,
+                                                         dtype=jnp.float32)
+        r = jnp.asarray(rng.standard_normal((C, m)), jnp.float32)
+
+        def expander(S, r):
+            L = jnp.linalg.cholesky(S)
+            logdet = 2.0 * jnp.sum(
+                jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), -1)
+            u = solve_triangular(L, r[..., None], lower=True)[..., 0]
+            return L, logdet, u
+
+        L = jnp.linalg.cholesky(S)
+        cases = {
+            f"factor_expander({C},{m})": (jax.jit(expander), (S, r)),
+            f"factor_vchol({C},{m})": (jax.jit(vchol_factor), (S, r)),
+            f"chol_only({C},{m})": (jax.jit(jnp.linalg.cholesky), (S,)),
+            f"bwd_expander({C},{m})": (
+                jax.jit(lambda L, r: solve_triangular(
+                    L, r, lower=True, trans="T")), (L, r)),
+            f"bwd_vchol({C},{m})": (jax.jit(bwd_solve_vec), (L, r)),
+        }
+        for name, (fn, a) in cases.items():
+            ms = bench(fn, *a, reps=reps)
+            results[name] = round(ms, 3)
+            print(f"{name:28s} {ms:8.2f} ms")
+
+    # the alpha update's gamma draw: rejection sampler vs exact
+    # chi-square construction (Gamma(k/2) = 0.5 * chi^2_k)
+    n, kmax = 130, 31
+    keys = random.split(random.PRNGKey(0), C)
+    kcount = jnp.asarray(rng.integers(1, kmax, (C, n)), jnp.float32)
+    g_rej = jax.jit(jax.vmap(lambda k, kc: random.gamma(
+        k, kc / 2.0, dtype=jnp.float32)))
+    def chisq(k, kc):
+        xs = random.normal(k, (n, kmax), dtype=jnp.float32)
+        live = jnp.arange(kmax, dtype=jnp.float32) < kc[:, None]
+        return 0.5 * jnp.sum(jnp.where(live, xs * xs, 0.0), -1)
+    g_chi = jax.jit(jax.vmap(chisq))
+    for name, fn in ((f"gamma_rejection({C},{n})", g_rej),
+                     (f"gamma_chisq({C},{n})", g_chi)):
+        ms = bench(fn, keys, kcount, reps=reps)
+        results[name] = round(ms, 3)
+        print(f"{name:28s} {ms:8.2f} ms")
+
+    # in-sweep A/B: hyper_and_draws across the gate arms
+    if not args.skip_sweep:
+        from gibbs_student_t_tpu.config import GibbsConfig
+        from gibbs_student_t_tpu.data.demo import make_demo_model_arrays
+        from gibbs_student_t_tpu.ops.tnt import tnt_products
+
+        ma = make_demo_model_arrays(n=130, components=30, seed=42)
+        cfg = GibbsConfig(model="mixture", vary_df=True,
+                          theta_prior="beta")
+        arms = [
+            ("baseline_pr2", {"GST_VCHOL": "0", "GST_BDRAW_REUSE": "0",
+                              "GST_FAST_GAMMA": "0"}),
+            ("vchol_only", {"GST_VCHOL": "1", "GST_BDRAW_REUSE": "0",
+                            "GST_FAST_GAMMA": "0"}),
+            ("vchol_breuse", {"GST_VCHOL": "1", "GST_BDRAW_REUSE": "1",
+                              "GST_FAST_GAMMA": "0"}),
+            ("auto_defaults", {}),
+        ]
+        for arm, env in arms:
+            for k in _ARM_FLAGS:
+                os.environ.pop(k, None)
+            os.environ.update(env)
+            from gibbs_student_t_tpu.backends import JaxGibbs
+
+            gb = JaxGibbs(ma, cfg, nchains=C, chunk_size=10)
+            state = gb.init_state(seed=0)
+            ks = jax.vmap(lambda k: random.split(k, 7))(
+                random.split(random.PRNGKey(0), C))
+            white = jax.jit(jax.vmap(
+                lambda st, k: gb._sweep_white(st, k, None)))
+            tnt = jax.jit(jax.vmap(lambda nv: tnt_products(
+                gb._ma.T, gb._ma.y, nv, gb._block_size)))
+            rest = jax.jit(jax.vmap(
+                lambda st, xx, aw, t, dd, cc, kk:
+                gb._sweep_rest(st, xx, aw, t, dd, cc, kk, None, 0)))
+            x, acc_w, nvec = jax.block_until_ready(white(state, ks[:, 0]))
+            TNT, d, const = jax.block_until_ready(tnt(nvec))
+            TNT, d, const = (TNT.astype(gb.dtype), d.astype(gb.dtype),
+                             const.astype(gb.dtype))
+            ms = bench(rest, state, x, acc_w, TNT, d, const, ks[:, 1:],
+                       reps=reps)
+            name = f"sweep_hyper_and_draws[{arm}]"
+            results[name] = round(ms, 3)
+            print(f"{name:40s} {ms:8.2f} ms")
+        for k in _ARM_FLAGS:
+            os.environ.pop(k, None)
+        base = results.get("sweep_hyper_and_draws[baseline_pr2]")
+        new = results.get("sweep_hyper_and_draws[auto_defaults]")
+        if base and new:
+            results["hyper_and_draws_speedup"] = round(base / new, 2)
+            print(f"hyper_and_draws speedup: {base / new:.2f}x")
+
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(results, fh, indent=1)
+        print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -22,6 +22,9 @@ the best value ever) and exits nonzero when
   percent (both sides must report it),
 - peak program bytes (HBM on device) grew more than
   ``--max-hbm-growth`` percent (both sides must report it),
+- any per-stage wall timing (the bench ``stages`` ledger block:
+  white_mh_block / tnt_reduction / hyper_and_draws) grew more than
+  ``--max-stage-growth`` percent (stages present in both records),
 - or the latest record is missing/unparseable — a record that cannot
   be graded must fail loudly BEFORE it becomes a round artifact.
 
@@ -139,6 +142,8 @@ def print_report(ledger_recs, include_rounds=True):
                   f"peak={'?' if peak is None else f'{peak / 1e6:.0f}MB':>7} "
                   f"cfg={rec.get('config_fingerprint')} "
                   f"sha={str(rec.get('git_sha'))[:8]}")
+            for name, sv in sorted(_stages_of(rec).items()):
+                print(f"    stage {name:20s} {sv * 1e3:10.1f} ms")
         else:
             brief = {k: v for k, v in m.items()
                      if isinstance(v, (int, float, bool, str))}
@@ -147,8 +152,22 @@ def print_report(ledger_recs, include_rounds=True):
                   f"{rec.get('platform') or '?':8s} {brief}")
 
 
+def _stages_of(rec):
+    """``{stage: mean_s}`` from a ledger record's ``stages`` block
+    (bench per-stage wall timings); {} when absent or malformed."""
+    stages = rec.get("stages")
+    if not isinstance(stages, dict):
+        return {}
+    out = {}
+    for name, v in stages.items():
+        mean = v.get("mean_s") if isinstance(v, dict) else v
+        if isinstance(mean, (int, float)) and mean > 0:
+            out[str(name)] = float(mean)
+    return out
+
+
 def check_latest(ledger_recs, max_drop, max_compile_growth,
-                 max_hbm_growth, baseline_mode):
+                 max_hbm_growth, baseline_mode, max_stage_growth=100.0):
     """The regression gate; returns the process exit code."""
     bench = [r for r in ledger_recs if r.get("tool") == "bench"]
     if not bench:
@@ -211,6 +230,23 @@ def check_latest(ledger_recs, max_drop, max_compile_growth,
     else:
         print("check: peak_bytes unavailable on one side — skipped")
 
+    # per-stage regression gate: every stage both records timed is
+    # compared, so a hyper-block (or any future stage) slowdown fails
+    # here even when the headline metric absorbs it
+    st, bst = _stages_of(latest), _stages_of(base)
+    shared = sorted(set(st) & set(bst))
+    if not shared:
+        print("check: per-stage timings unavailable on one side — "
+              "skipped")
+    for name in shared:
+        growth = (st[name] - bst[name]) / bst[name] * 100.0
+        print(f"check: stage[{name}] {bst[name] * 1e3:.1f}ms -> "
+              f"{st[name] * 1e3:.1f}ms ({growth:+.1f}%, limit "
+              f"{max_stage_growth}%)")
+        if growth > max_stage_growth:
+            failures.append(f"stage {name} slowed {growth:.1f}% "
+                            f"(> {max_stage_growth}%)")
+
     if failures:
         for f in failures:
             print(f"check: FAIL — {f}")
@@ -237,6 +273,12 @@ def main(argv=None):
     ap.add_argument("--max-hbm-growth", type=float, default=50.0,
                     metavar="PCT",
                     help="max tolerated peak-program-bytes growth")
+    ap.add_argument("--max-stage-growth", type=float, default=100.0,
+                    metavar="PCT",
+                    help="max tolerated per-stage wall-time growth "
+                         "(stages present in both latest and baseline "
+                         "bench records; wall timings on shared hosts "
+                         "are noisy, hence the loose default)")
     ap.add_argument("--baseline", choices=("prev", "best"),
                     default="prev",
                     help="compare against the previous comparable "
@@ -253,7 +295,8 @@ def main(argv=None):
     if args.check:
         return check_latest(recs, args.max_drop,
                             args.max_compile_growth,
-                            args.max_hbm_growth, args.baseline)
+                            args.max_hbm_growth, args.baseline,
+                            max_stage_growth=args.max_stage_growth)
     return 0
 
 
