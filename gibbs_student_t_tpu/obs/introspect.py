@@ -46,6 +46,10 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 _LOCK = threading.Lock()
 _COMPILE_LOG: List[Dict[str, Any]] = []
 _KERNEL_LOG: List[Dict[str, Any]] = []
+# Trace-time linalg dispatch decisions (ops/linalg.py _note_impl):
+# append-only so a lower() in progress can slice off "the impls THIS
+# program chose" by index range; deduplicated at read time.
+_LINALG_LOG: List[Dict[str, Any]] = []
 
 #: Fields copied (when present) off the CompiledMemoryStats object.
 _MEM_FIELDS = (
@@ -242,6 +246,8 @@ class IntrospectedJit:
             return self._jfn(*args, **kwargs)
 
     def _compile(self, args, kwargs):
+        with _LOCK:
+            mark = len(_LINALG_LOG)
         t0 = time.perf_counter()
         lowered = self._jfn.lower(*args, **kwargs)
         t1 = time.perf_counter()
@@ -251,6 +257,14 @@ class IntrospectedJit:
                                lower_s=t1 - t0, compile_s=t2 - t1)
         if self._donate_argnums:
             rec["donate_argnums"] = list(self._donate_argnums)
+        # linalg dispatch decisions made while THIS program lowered
+        # (trace time is when ops/linalg.py's gates resolve): the
+        # per-program evidence of which Cholesky/solve implementation
+        # the compiled sweep actually contains
+        with _LOCK:
+            chosen = _dedup(_LINALG_LOG[mark:])
+        if chosen:
+            rec["linalg_impls"] = chosen
         with _LOCK:
             _COMPILE_LOG.append(rec)
         reg = self._registry_now()
@@ -291,6 +305,33 @@ def introspect_jit(jfn, label: str,
 # ----------------------------------------------------------------------
 
 
+def _dedup(recs: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    for r in recs:
+        if r not in out:
+            out.append(r)
+    return out
+
+
+def register_linalg_impl(op: str, impl: str, **meta) -> None:
+    """Record one trace-time linalg dispatch decision (called from
+    ops/linalg.py's dispatchers). ``op`` is the dispatcher (factor /
+    bwd_vec / fwd_mat / bwd_mat / chisq), ``impl`` the winning
+    implementation (pallas / nchol / vchol / expander / jnp)."""
+    rec = {"op": str(op), "impl": str(impl)}
+    for k, v in sorted(meta.items()):
+        rec[str(k)] = (v if isinstance(v, (int, float, bool, str,
+                                           type(None))) else repr(v))
+    with _LOCK:
+        _LINALG_LOG.append(rec)
+
+
+def linalg_impls() -> List[Dict[str, Any]]:
+    """Every distinct (op, impl, meta) decision seen so far."""
+    with _LOCK:
+        return _dedup([dict(r) for r in _LINALG_LOG])
+
+
 def register_kernel(name: str, **meta) -> None:
     """Record a Pallas kernel construction/trace (deduplicated by
     content — trace-time call sites fire once per compile)."""
@@ -318,6 +359,7 @@ def clear_introspection() -> None:
     with _LOCK:
         _COMPILE_LOG.clear()
         _KERNEL_LOG.clear()
+        _LINALG_LOG.clear()
 
 
 def compile_summary() -> Dict[str, Any]:
@@ -343,6 +385,7 @@ def compile_summary() -> Dict[str, Any]:
         "peak_bytes": agg("peak_bytes", max),
         "programs": recs,
         "pallas_kernels": kernel_builds(),
+        "linalg_impls": linalg_impls(),
     }
 
 
@@ -363,6 +406,11 @@ def format_summary(prefix: str = "# ") -> List[str]:
     if kern:
         names = ", ".join(sorted({k["kernel"] for k in kern}))
         lines.append(f"{prefix}pallas kernels: {names}")
+    impls = linalg_impls()
+    if impls:
+        pairs = ", ".join(sorted({f"{r['op']}={r['impl']}"
+                                  for r in impls}))
+        lines.append(f"{prefix}linalg impls: {pairs}")
     if not lines:
         lines.append(f"{prefix}no programs compiled through the "
                      "introspection layer")

@@ -37,6 +37,20 @@ trace-time panel-unrolled substitutions — dispatched through the same
 ``custom_vmap`` fold so the in-sweep chain batch is visible, with the
 same MIN_BATCH floor so unbatched oracle-parity calls stay on the
 expander (docs/PERFORMANCE.md "The portable path").
+
+On **CPU** specifically there is a fourth implementation ABOVE vchol in
+priority: the first-party native lane-batched kernels
+(``native/src/gst_ffi.cpp``, reached as XLA FFI custom calls through
+``gibbs_student_t_tpu/native/ffi.py``; ``GST_NCHOL=auto|1|0``). They
+apply the TPU Pallas insight to the host ISA — a 1024-chain batch of
+60-column factorizations is ONE factorization whose every scalar is a
+SIMD vector over a chains-contiguous tile — where batched LAPACK loops
+over matrices each too small for BLAS-3 (~4.7 GFLOP/s measured,
+artifacts/cpu_microbench_r06.json). ``auto``: on when the platform is
+CPU *and* the library loads with its handlers (the capability probe
+checks the .so, the jax FFI API, and the host SIMD level); anything
+missing degrades silently to the vchol path, so no runtime ever
+requires a C toolchain.
 """
 
 from __future__ import annotations
@@ -108,6 +122,71 @@ def _vchol_ok(shape, forced: bool) -> bool:
             and (forced or batch >= _PALLAS_MIN_BATCH))
 
 
+def nchol_env() -> str:
+    """Validated ``GST_NCHOL`` value (``auto`` when unset) — the native
+    lane-batched CPU kernel gate. Strict ``auto|1|0``, raising whenever
+    the variable is set to anything else (the loud-typo contract of
+    every GST_* gate). Note the asymmetry with availability: the VALUE
+    is validated strictly, but a well-formed ``1`` on a host without
+    the library degrades silently to the vchol path — forcing the arm
+    must never make a toolchain a runtime requirement."""
+    env = os.environ.get("GST_NCHOL")
+    if env is not None and env not in ("auto", "1", "0"):
+        raise ValueError(
+            f"GST_NCHOL must be 'auto', '1' or '0', got {env!r}")
+    return env if env is not None else "auto"
+
+
+def _nchol_ready() -> bool:
+    """Capability probe (latched per process): library built with the
+    FFI kernels, host SIMD level sufficient, jax FFI API present,
+    targets registered. Never raises — an import/probe failure means
+    the kernels are simply absent."""
+    try:
+        from gibbs_student_t_tpu.native import ffi as nffi
+
+        return nffi.ready()
+    except Exception:  # noqa: BLE001 - absence, not an error
+        return False
+
+
+def _nchol_mode():
+    """``(enabled, forced)`` for the native kernel path. The kernels
+    are XLA:**CPU** custom calls, so even a forced ``1`` requires the
+    CPU backend (on TPU the Pallas kernel is the production path and
+    the custom-call target simply does not exist there). Read at TRACE
+    time, same snapshot semantics as every other linalg gate."""
+    env = nchol_env()
+    if env == "0":
+        return False, False
+    if jax.default_backend() != "cpu" or not _nchol_ready():
+        return False, False
+    return True, env == "1"
+
+
+def _nchol_ok(shape, dtype, forced: bool) -> bool:
+    """Same MIN_BATCH floor and size ceiling as the vchol guard (one
+    shared threshold keeps the three-way dispatch matrix coherent);
+    f32/f64 only — the two dtypes the kernel family instantiates."""
+    batch = int(np.prod(shape[:-2])) if len(shape) > 2 else 1
+    return (dtype in (jnp.float32, jnp.float64)
+            and shape[-1] <= MAX_VCHOL_DIM
+            and (forced or batch >= _PALLAS_MIN_BATCH))
+
+
+def _note_impl(op: str, impl: str, shape) -> None:
+    """Trace-time record of which implementation a dispatcher chose —
+    lands on the current compile record (obs/introspect.py), so every
+    run ledger entry can say WHICH linalg each compiled program used.
+    Must never raise (the note_kernel_build contract)."""
+    try:
+        from gibbs_student_t_tpu.obs.introspect import register_linalg_impl
+
+        register_linalg_impl(op, impl, shape=tuple(int(s) for s in shape))
+    except Exception:  # noqa: BLE001
+        pass
+
+
 def _unrolled_wanted(m: int) -> bool:
     """Opt-in only (``GST_UNROLLED_CHOL=1``): hardware A/B on the v5e
     (artifacts/tpu_validation_r02.json) showed the trace-unrolled kernel
@@ -175,11 +254,20 @@ def _factor_fused(S, rhs):
     enabled, interp, forced = _pallas_chol_mode()
     v_on, v_forced = _vchol_mode()  # validates GST_VCHOL even when
     # the Pallas kernel wins the dispatch below
+    n_on, n_forced = _nchol_mode()  # ... and GST_NCHOL likewise
     if enabled and _pallas_ok(S.shape, S.dtype, forced):
         L, logdet, u = chol_fused_lane(S, rhs, interpret=interp)
+        _note_impl("factor", "pallas", S.shape)
         return L, logdet, u
+    if n_on and _nchol_ok(S.shape, S.dtype, n_forced):
+        from gibbs_student_t_tpu.native import ffi as nffi
+
+        _note_impl("factor", "nchol", S.shape)
+        return nffi.nchol_factor(S, rhs)
     if v_on and _vchol_ok(S.shape, v_forced):
+        _note_impl("factor", "vchol", S.shape)
         return vchol_factor(S, rhs)
+    _note_impl("factor", "expander", S.shape)
     L = jnp.linalg.cholesky(S)
     logdet = 2.0 * jnp.sum(jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)),
                            axis=-1)
@@ -202,10 +290,19 @@ def _backsolve_fused(L, rhs):
     XLA triangular-solve, same dispatch as :func:`_factor_fused`."""
     enabled, interp, forced = _pallas_chol_mode()
     v_on, v_forced = _vchol_mode()
+    n_on, n_forced = _nchol_mode()
     if enabled and _pallas_ok(L.shape, L.dtype, forced):
+        _note_impl("bwd_vec", "pallas", L.shape)
         return tri_solve_T_lane(L, rhs, interpret=interp)
+    if n_on and _nchol_ok(L.shape, L.dtype, n_forced):
+        from gibbs_student_t_tpu.native import ffi as nffi
+
+        _note_impl("bwd_vec", "nchol", L.shape)
+        return nffi.bwd_vec(L, rhs)
     if v_on and _vchol_ok(L.shape, v_forced):
+        _note_impl("bwd_vec", "vchol", L.shape)
         return bwd_solve_vec(L, rhs)
+    _note_impl("bwd_vec", "expander", L.shape)
     return solve_triangular(L, rhs, lower=True, trans="T")
 
 
@@ -227,8 +324,16 @@ def _fwd_mat_fused(L, R):
     dispatch as :func:`_factor_fused`; no Pallas variant exists (the
     TPU sweep reaches these solves once per sweep, not per proposal)."""
     v_on, v_forced = _vchol_mode()
+    n_on, n_forced = _nchol_mode()
+    if n_on and _nchol_ok(L.shape, L.dtype, n_forced):
+        from gibbs_student_t_tpu.native import ffi as nffi
+
+        _note_impl("fwd_mat", "nchol", L.shape)
+        return nffi.fwd_mat(L, R)
     if v_on and _vchol_ok(L.shape, v_forced):
+        _note_impl("fwd_mat", "vchol", L.shape)
         return fwd_solve_mat(L, R)
+    _note_impl("fwd_mat", "expander", L.shape)
     return solve_triangular(L, R, lower=True)
 
 
@@ -246,8 +351,16 @@ def _bwd_mat_fused(L, R):
     """``L^T X = R`` for matrix rhs, same dispatch as
     :func:`_fwd_mat_fused`."""
     v_on, v_forced = _vchol_mode()
+    n_on, n_forced = _nchol_mode()
+    if n_on and _nchol_ok(L.shape, L.dtype, n_forced):
+        from gibbs_student_t_tpu.native import ffi as nffi
+
+        _note_impl("bwd_mat", "nchol", L.shape)
+        return nffi.bwd_mat(L, R)
     if v_on and _vchol_ok(L.shape, v_forced):
+        _note_impl("bwd_mat", "vchol", L.shape)
         return bwd_solve_mat(L, R)
+    _note_impl("bwd_mat", "expander", L.shape)
     return solve_triangular(L, R, lower=True, trans="T")
 
 
@@ -403,6 +516,47 @@ def precond_solve_quad(L, inv_sqrt_d, rhs):
     quad = jnp.sum(u * u, axis=-1)
     v = solve_triangular(L, u, lower=True, trans="T")
     return v * inv_sqrt_d, quad
+
+
+@custom_vmap
+def masked_chisq(xs, counts):
+    """``0.5 * sum_{j < counts} xs[..., j]^2`` — the exact chi-square
+    construction behind the fast alpha draw (``Gamma(k/2) = 0.5 *
+    chi^2_k``, backends/jax_backend.py). Not linear algebra, but it
+    shares the native kernel family's dispatch: the jnp formulation
+    materializes the mask and the squared array before reducing, the
+    FFI kernel is one fused pass per row. FORCED (``GST_NCHOL=1``)
+    only: the measured A/B on the graded host has XLA's fused
+    mask-square-sum already at memory bandwidth (2.1 ms vs the
+    kernel's 2.8 ms at the (1024, 130, 31) flagship shape,
+    artifacts/cpu_microbench_r07.json — the FFI boundary pays an extra
+    buffer round trip the fusion avoids), so ``auto`` keeps the jnp
+    path; the kernel is the A/B arm and the escape hatch for hosts
+    whose XLA reduction underperforms. The jnp fallback is the exact
+    expression the backend used before, so the off-path is unchanged
+    math."""
+    kmax = xs.shape[-1]
+    n_on, n_forced = _nchol_mode()
+    rows_shape = xs.shape[:-1] + (1, 1)  # reuse the matrix batch guard
+    if (n_on and n_forced and xs.dtype in (jnp.float32, jnp.float64)
+            and xs.dtype == counts.dtype
+            and _nchol_ok(rows_shape, xs.dtype, n_forced)):
+        from gibbs_student_t_tpu.native import ffi as nffi
+
+        _note_impl("chisq", "nchol", xs.shape)
+        return nffi.chisq(xs, counts)
+    _note_impl("chisq", "jnp", xs.shape)
+    live = jnp.arange(kmax, dtype=xs.dtype) < counts[..., None]
+    return 0.5 * jnp.sum(jnp.where(live, xs * xs, 0.0), axis=-1)
+
+
+@masked_chisq.def_vmap
+def _masked_chisq_vmap(axis_size, in_batched, xs, counts):
+    if not in_batched[0]:
+        xs = jnp.broadcast_to(xs, (axis_size,) + xs.shape)
+    if not in_batched[1]:
+        counts = jnp.broadcast_to(counts, (axis_size,) + counts.shape)
+    return masked_chisq(xs, counts), True
 
 
 def gaussian_draw(L, inv_sqrt_d, mean, xi):

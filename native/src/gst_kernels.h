@@ -1,0 +1,430 @@
+// gst_kernels.h: the lane-batched compute templates shared by the XLA
+// FFI handlers (gst_ffi.cpp) and any standalone harness. Header-only,
+// no dependencies beyond libm — see gst_ffi.cpp for the design notes
+// (chains-contiguous tiles, pad-lane handling, NaN propagation).
+//
+// The hot loops use GCC/Clang vector extensions (one `V` value = one
+// W-lane SIMD register) with explicit 4-way register blocking: the
+// plain lane-loop formulation auto-vectorizes, but GCC keeps the
+// accumulator array in memory across the reduction loop — every FMA
+// pays a store-to-load forward, measured ~9x slower than the
+// register-resident form below. Tile transposes are chunked so the
+// strided side stays inside L1 across the W lane passes.
+#pragma once
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <new>
+
+#if !defined(__GNUC__) && !defined(__clang__)
+#error "gst_kernels.h needs GCC/Clang vector extensions (define GST_NO_FFI to skip the kernels)"
+#endif
+
+namespace gst {
+
+// Lane counts: one 512-bit vector per scalar of the recurrence at f32,
+// the same byte width at f64. Narrower ISAs split each vector op into
+// 2-4 native ops — still vertical, still register-resident.
+template <typename T> struct Lanes;
+template <> struct Lanes<float> { static constexpr int W = 16; };
+template <> struct Lanes<double> { static constexpr int W = 8; };
+
+template <typename T, int W>
+struct VecOf {
+  typedef T type __attribute__((vector_size(W * sizeof(T))));
+};
+
+template <typename T, int W>
+inline typename VecOf<T, W>::type splat(T x) {
+  // scalar-vector binary op = ONE hardware broadcast. A per-lane
+  // assignment loop compiles to W serial masked broadcasts (measured
+  // 2x on the whole chisq kernel when a splat sat in the inner loop).
+  return typename VecOf<T, W>::type{} + x;
+}
+
+template <typename T>
+struct Scratch {
+  // 64-byte aligned so a lane vector is one aligned register load.
+  explicit Scratch(size_t n)
+      : p(static_cast<T*>(::operator new(n * sizeof(T),
+                                         std::align_val_t(64)))) {}
+  ~Scratch() { ::operator delete(p, std::align_val_t(64)); }
+  T* get() const { return p; }
+  T* p;
+};
+
+// ---------------------------------------------------------------------
+// tile transposes: (B, m, m) row-major <-> (row, col, lane) scratch
+// ---------------------------------------------------------------------
+
+// Elements per transpose chunk: the strided side touches one cache
+// line per element, so a chunk (256 * 64 B = 16 KB) stays L1-resident
+// across all W lane passes instead of re-walking the whole tile.
+constexpr int64_t kTransposeChunk = 256;
+
+template <typename T, int W>
+inline void load_tile(const T* __restrict src, T* __restrict dst,
+                      int64_t b0, int64_t lanes, int64_t elems,
+                      int64_t stride) {
+  for (int64_t e0 = 0; e0 < elems; e0 += kTransposeChunk) {
+    const int64_t e1 = std::min(elems, e0 + kTransposeChunk);
+    for (int64_t l = 0; l < lanes; ++l) {
+      const T* s = src + (b0 + l) * stride;
+      for (int64_t e = e0; e < e1; ++e) dst[e * W + l] = s[e];
+    }
+    for (int64_t l = lanes; l < W; ++l) {  // pad lanes: replicate lane 0
+      const T* s = src + b0 * stride;
+      for (int64_t e = e0; e < e1; ++e) dst[e * W + l] = s[e];
+    }
+  }
+}
+
+template <typename T, int W>
+inline void store_tile(const T* __restrict src, T* __restrict dst,
+                       int64_t b0, int64_t lanes, int64_t elems,
+                       int64_t stride) {
+  for (int64_t e0 = 0; e0 < elems; e0 += kTransposeChunk) {
+    const int64_t e1 = std::min(elems, e0 + kTransposeChunk);
+    for (int64_t l = 0; l < lanes; ++l) {
+      T* d = dst + (b0 + l) * stride;
+      for (int64_t e = e0; e < e1; ++e) d[e] = src[e * W + l];
+    }
+  }
+}
+
+// Triangle-aware variants: the factorization reads only the lower
+// triangle of a symmetric input and the solves read only the lower
+// triangle of L, so half the transpose traffic is skippable. Each row's
+// lower run is contiguous in the row-major source, and one row's
+// strided tile window ((r+1) cache lines) stays L1-resident across the
+// W lane passes without extra chunking.
+
+template <typename T, int W>
+inline void load_tile_lower(const T* __restrict src, T* __restrict dst,
+                            int64_t b0, int64_t lanes, int64_t m,
+                            int64_t stride) {
+  for (int64_t r = 0; r < m; ++r) {
+    const int64_t o = r * m;
+    for (int64_t l = 0; l < lanes; ++l) {
+      const T* s = src + (b0 + l) * stride + o;
+      T* d = dst + o * W + l;
+      for (int64_t e = 0; e <= r; ++e) d[e * W] = s[e];
+    }
+    for (int64_t l = lanes; l < W; ++l) {
+      const T* s = src + b0 * stride + o;
+      T* d = dst + o * W + l;
+      for (int64_t e = 0; e <= r; ++e) d[e * W] = s[e];
+    }
+  }
+}
+
+// Stores the lower triangle only — callers that need a dense L zero the
+// destination buffer up front (memset is far cheaper than transposing
+// W lanes of zeros through the strided window).
+template <typename T, int W>
+inline void store_tile_lower(const T* __restrict src, T* __restrict dst,
+                             int64_t b0, int64_t lanes, int64_t m,
+                             int64_t stride) {
+  for (int64_t r = 0; r < m; ++r) {
+    const int64_t o = r * m;
+    for (int64_t l = 0; l < lanes; ++l) {
+      T* d = dst + (b0 + l) * stride + o;
+      const T* s = src + o * W + l;
+      for (int64_t e = 0; e <= r; ++e) d[e] = s[e * W];
+    }
+  }
+}
+
+// ---------------------------------------------------------------------
+// in-tile recurrences (a = (m, m, W) chains-last scratch, one V value
+// per (row, col) scalar)
+// ---------------------------------------------------------------------
+
+template <typename T, int W>
+inline void chol_tile(T* __restrict at, T* __restrict logdet, int64_t m) {
+  using V = typename VecOf<T, W>::type;
+  using D = typename VecOf<double, W>::type;
+  V* a = reinterpret_cast<V*>(at);
+  // logdet via chunked diagonal products in double: one log per lane
+  // per 8 columns instead of per column. 8 finite factors cannot
+  // under/overflow a double, so the product only hits 0/inf/NaN when a
+  // factor already is — exactly the cases whose log must poison the
+  // result (zero pivot -> -inf, negative pivot -> sqrt NaN -> NaN).
+  D ld = {};
+  D prod = splat<double, W>(1.0);
+  int since_flush = 0;
+  for (int64_t j = 0; j < m; ++j) {
+    V* rowj = a + j * m;
+    V acc = rowj[j];
+    for (int64_t k = 0; k < j; ++k) acc -= rowj[k] * rowj[k];
+    V diag;
+    for (int l = 0; l < W; ++l) diag[l] = std::sqrt(acc[l]);
+    rowj[j] = diag;
+    const V inv = splat<T, W>(T(1)) / diag;
+    for (int l = 0; l < W; ++l) prod[l] *= double(diag[l]);
+    if (++since_flush == 8 || j == m - 1) {
+      for (int l = 0; l < W; ++l) ld[l] += std::log(prod[l]);
+      prod = splat<double, W>(1.0);
+      since_flush = 0;
+    }
+    // trailing update, 4-row register blocking: rowj[k] is loaded once
+    // per k and shared by four FMA chains held in registers.
+    int64_t i = j + 1;
+    for (; i + 4 <= m; i += 4) {
+      V* r0 = a + (i + 0) * m;
+      V* r1 = a + (i + 1) * m;
+      V* r2 = a + (i + 2) * m;
+      V* r3 = a + (i + 3) * m;
+      V s0 = r0[j], s1 = r1[j], s2 = r2[j], s3 = r3[j];
+      for (int64_t k = 0; k < j; ++k) {
+        const V c = rowj[k];
+        s0 -= r0[k] * c;
+        s1 -= r1[k] * c;
+        s2 -= r2[k] * c;
+        s3 -= r3[k] * c;
+      }
+      r0[j] = s0 * inv;
+      r1[j] = s1 * inv;
+      r2[j] = s2 * inv;
+      r3[j] = s3 * inv;
+    }
+    for (; i < m; ++i) {
+      V* ri = a + i * m;
+      V s = ri[j];
+      for (int64_t k = 0; k < j; ++k) s -= ri[k] * rowj[k];
+      ri[j] = s * inv;
+    }
+    // the tile's strict upper triangle is never read or stored (the
+    // lower-triangle transposes skip it; dense callers memset instead)
+  }
+  for (int l = 0; l < W; ++l) logdet[l] = T(2.0 * ld[l]);
+}
+
+// L x = r, both (m, W) in-tile; solves in place.
+template <typename T, int W>
+inline void fwd_tile(const T* __restrict at, T* __restrict xt, int64_t m) {
+  using V = typename VecOf<T, W>::type;
+  const V* a = reinterpret_cast<const V*>(at);
+  V* x = reinterpret_cast<V*>(xt);
+  for (int64_t i = 0; i < m; ++i) {
+    const V* rowi = a + i * m;
+    V acc = x[i];
+    for (int64_t k = 0; k < i; ++k) acc -= rowi[k] * x[k];
+    x[i] = acc / rowi[i];
+  }
+}
+
+// L^T x = r (reads column i of L below the diagonal).
+template <typename T, int W>
+inline void bwd_tile(const T* __restrict at, T* __restrict xt, int64_t m) {
+  using V = typename VecOf<T, W>::type;
+  const V* a = reinterpret_cast<const V*>(at);
+  V* x = reinterpret_cast<V*>(xt);
+  for (int64_t i = m - 1; i >= 0; --i) {
+    V acc = x[i];
+    for (int64_t k = i + 1; k < m; ++k) acc -= a[k * m + i] * x[k];
+    x[i] = acc / a[i * m + i];
+  }
+}
+
+// L X = R with X/R (m, k, W) in-tile (k right-hand sides per chain),
+// 4-column register blocking on the rhs.
+template <typename T, int W>
+inline void fwd_mat_tile(const T* __restrict at, T* __restrict xt,
+                         int64_t m, int64_t k) {
+  using V = typename VecOf<T, W>::type;
+  const V* a = reinterpret_cast<const V*>(at);
+  V* x = reinterpret_cast<V*>(xt);
+  for (int64_t i = 0; i < m; ++i) {
+    const V* rowi = a + i * m;
+    V* xi = x + i * k;
+    const V inv = splat<T, W>(T(1)) / rowi[i];
+    int64_t c = 0;
+    for (; c + 4 <= k; c += 4) {
+      V s0 = xi[c], s1 = xi[c + 1], s2 = xi[c + 2], s3 = xi[c + 3];
+      for (int64_t kk = 0; kk < i; ++kk) {
+        const V lik = rowi[kk];
+        const V* xk = x + kk * k + c;
+        s0 -= lik * xk[0];
+        s1 -= lik * xk[1];
+        s2 -= lik * xk[2];
+        s3 -= lik * xk[3];
+      }
+      xi[c] = s0 * inv;
+      xi[c + 1] = s1 * inv;
+      xi[c + 2] = s2 * inv;
+      xi[c + 3] = s3 * inv;
+    }
+    for (; c < k; ++c) {
+      V s = xi[c];
+      for (int64_t kk = 0; kk < i; ++kk) s -= rowi[kk] * x[kk * k + c];
+      xi[c] = s * inv;
+    }
+  }
+}
+
+template <typename T, int W>
+inline void bwd_mat_tile(const T* __restrict at, T* __restrict xt,
+                         int64_t m, int64_t k) {
+  using V = typename VecOf<T, W>::type;
+  const V* a = reinterpret_cast<const V*>(at);
+  V* x = reinterpret_cast<V*>(xt);
+  for (int64_t i = m - 1; i >= 0; --i) {
+    V* xi = x + i * k;
+    const V inv = splat<T, W>(T(1)) / a[i * m + i];
+    int64_t c = 0;
+    for (; c + 4 <= k; c += 4) {
+      V s0 = xi[c], s1 = xi[c + 1], s2 = xi[c + 2], s3 = xi[c + 3];
+      for (int64_t kk = i + 1; kk < m; ++kk) {
+        const V lki = a[kk * m + i];
+        const V* xk = x + kk * k + c;
+        s0 -= lki * xk[0];
+        s1 -= lki * xk[1];
+        s2 -= lki * xk[2];
+        s3 -= lki * xk[3];
+      }
+      xi[c] = s0 * inv;
+      xi[c + 1] = s1 * inv;
+      xi[c + 2] = s2 * inv;
+      xi[c + 3] = s3 * inv;
+    }
+    for (; c < k; ++c) {
+      V s = xi[c];
+      for (int64_t kk = i + 1; kk < m; ++kk)
+        s -= a[kk * m + i] * x[kk * k + c];
+      xi[c] = s * inv;
+    }
+  }
+}
+
+// ---------------------------------------------------------------------
+// batch drivers
+// ---------------------------------------------------------------------
+
+template <typename T>
+void factor_batch(const T* S, const T* rhs, T* L, T* logdet, T* u,
+                  int64_t B, int64_t m) {
+  constexpr int W = Lanes<T>::W;
+  Scratch<T> tile(size_t(m) * m * W), rtile(size_t(m) * W), ld(W);
+  // dense-L contract (matches jnp.linalg.cholesky): zero upper triangle
+  // via one linear memset; the transposes then move only the lower half
+  std::memset(L, 0, size_t(B) * m * m * sizeof(T));
+  for (int64_t b0 = 0; b0 < B; b0 += W) {
+    const int64_t lanes = std::min<int64_t>(W, B - b0);
+    load_tile_lower<T, W>(S, tile.get(), b0, lanes, m, m * m);
+    load_tile<T, W>(rhs, rtile.get(), b0, lanes, m, m);
+    chol_tile<T, W>(tile.get(), ld.get(), m);
+    fwd_tile<T, W>(tile.get(), rtile.get(), m);
+    store_tile_lower<T, W>(tile.get(), L, b0, lanes, m, m * m);
+    store_tile<T, W>(rtile.get(), u, b0, lanes, m, m);
+    store_tile<T, W>(ld.get(), logdet, b0, lanes, 1, 1);
+  }
+}
+
+template <typename T>
+void solve_vec_batch(const T* L, const T* rhs, T* x, int64_t B, int64_t m,
+                     bool bwd) {
+  constexpr int W = Lanes<T>::W;
+  Scratch<T> tile(size_t(m) * m * W), rtile(size_t(m) * W);
+  for (int64_t b0 = 0; b0 < B; b0 += W) {
+    const int64_t lanes = std::min<int64_t>(W, B - b0);
+    load_tile_lower<T, W>(L, tile.get(), b0, lanes, m, m * m);
+    load_tile<T, W>(rhs, rtile.get(), b0, lanes, m, m);
+    if (bwd)
+      bwd_tile<T, W>(tile.get(), rtile.get(), m);
+    else
+      fwd_tile<T, W>(tile.get(), rtile.get(), m);
+    store_tile<T, W>(rtile.get(), x, b0, lanes, m, m);
+  }
+}
+
+template <typename T>
+void solve_mat_batch(const T* L, const T* R, T* X, int64_t B, int64_t m,
+                     int64_t k, bool bwd) {
+  constexpr int W = Lanes<T>::W;
+  Scratch<T> tile(size_t(m) * m * W), rtile(size_t(m) * k * W);
+  for (int64_t b0 = 0; b0 < B; b0 += W) {
+    const int64_t lanes = std::min<int64_t>(W, B - b0);
+    load_tile_lower<T, W>(L, tile.get(), b0, lanes, m, m * m);
+    load_tile<T, W>(R, rtile.get(), b0, lanes, m * k, m * k);
+    if (bwd)
+      bwd_mat_tile<T, W>(tile.get(), rtile.get(), m, k);
+    else
+      fwd_mat_tile<T, W>(tile.get(), rtile.get(), m, k);
+    store_tile<T, W>(rtile.get(), X, b0, lanes, m * k, m * k);
+  }
+}
+
+// Masked sum-of-squared-normals chi-square reduction: one fused pass
+// (the jnp formulation materializes the where-mask and the squared
+// array before reducing). rows = B*n, each kmax wide; out = 0.5 *
+// sum_{j < count} xs[j]^2. W explicit partial sums keep the reduction
+// vectorized without -ffast-math reassociation licences.
+template <typename T>
+void chisq_batch(const T* xs, const T* counts, T* out, int64_t rows,
+                 int64_t kmax) {
+  constexpr int W = Lanes<T>::W;
+  using V = typename VecOf<T, W>::type;
+  if (kmax < W) {  // short rows: plain scalar recurrence
+    for (int64_t r = 0; r < rows; ++r) {
+      const T* x = xs + r * kmax;
+      const T cnt = counts[r];
+      T tot = T(0);
+      for (int64_t j = 0; j < kmax; ++j) {
+        const T live = (T(j) < cnt) ? T(1) : T(0);
+        tot += live * x[j] * x[j];
+      }
+      out[r] = T(0.5) * tot;
+    }
+    return;
+  }
+  // index ramp hoisted out of the row loop: per-lane `T(j + l) < cnt`
+  // ternaries compile to W scalar int->float conversions per window,
+  // which dominated the kernel; vector compares + blends do not.
+  V ramp;
+  for (int l = 0; l < W; ++l) ramp[l] = T(l);
+  const V vzero = {};
+  const V stepW = splat<T, W>(T(W));
+  // tail-window constants are row-independent: the window sits at
+  // kmax - W and excludes indices below the last full window's end
+  const int64_t jfull = (kmax / W) * W;
+  const int64_t j2 = kmax - W;
+  const V idx_tail = ramp + splat<T, W>(T(j2));
+  const V lo_tail = splat<T, W>(T(jfull));
+  for (int64_t r = 0; r < rows; ++r) {
+    const T* x = xs + r * kmax;
+    const V vcnt = splat<T, W>(counts[r]);
+    V acc = {};
+    V idx = ramp;
+    int64_t j = 0;
+    for (; j + W <= kmax; j += W, idx += stepW) {
+      V xv;
+      for (int l = 0; l < W; ++l) xv[l] = x[j + l];
+      acc += ((idx < vcnt) ? xv : vzero) * xv;
+    }
+    if (j < kmax) {
+      // tail as one overlapped window ending at kmax (always in
+      // bounds: kmax >= W): the mask excludes indices already counted
+      // by the full windows, so the overlap contributes exactly once.
+      // A scalar epilogue here would be a serial FP dependency chain —
+      // GCC cannot vectorize FP reductions without reassociation
+      // licences, and the ~15-add chain dominated the whole kernel.
+      V xv;
+      for (int l = 0; l < W; ++l) xv[l] = x[j2 + l];
+      acc += (((idx_tail >= lo_tail) & (idx_tail < vcnt)) ? xv : vzero)
+             * xv;
+    }
+    // horizontal sum through a scratch array: pairwise halving SLP-
+    // vectorizes; per-lane subscripts on the vector value do not (each
+    // compiles to an extract/insert round trip).
+    alignas(64) T tmp[W];
+    for (int l = 0; l < W; ++l) tmp[l] = acc[l];
+    for (int s = W / 2; s > 0; s /= 2)
+      for (int l = 0; l < s; ++l) tmp[l] += tmp[l + s];
+    out[r] = T(0.5) * tmp[0];
+  }
+}
+
+}  // namespace gst

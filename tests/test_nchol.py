@@ -1,0 +1,351 @@
+"""Native lane-batched FFI kernels: parity pins, the GST_NCHOL
+dispatch, graceful degradation, and the committed-.so staleness guard
+(ISSUE 4).
+
+All CPU-fast. The backend arms reuse the vchol module's arm-sharing
+pattern (one compiled backend per gate arm, shared by every pin) to
+stay inside the 870 s / 1-core tier-1 budget.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.linalg import solve_triangular
+
+from gibbs_student_t_tpu.config import GibbsConfig
+from gibbs_student_t_tpu.ops import linalg
+
+from tests.conftest import make_demo_pta, make_demo_pulsar
+
+pytestmark = pytest.mark.nchol
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+nffi = pytest.importorskip("gibbs_student_t_tpu.native.ffi")
+
+
+def _require_kernels():
+    if not nffi.ready():
+        pytest.skip(f"native FFI kernels unavailable: {nffi.status()}")
+
+
+def _spd(C, m, seed=0, dtype=np.float64):
+    rng = np.random.default_rng(seed)
+    A = rng.standard_normal((C, m, max(m // 2, 4)))
+    S = A @ np.swapaxes(A, -1, -2) + 10.0 * np.eye(m)
+    return (jnp.asarray(S, dtype),
+            jnp.asarray(rng.standard_normal((C, m)), dtype),
+            jnp.asarray(rng.standard_normal((C, m, 5)), dtype))
+
+
+@pytest.fixture(scope="module")
+def small_ma():
+    psr, _ = make_demo_pulsar(seed=3, n=50, theta=0.1)
+    return make_demo_pta(psr, components=6).frozen()
+
+
+# ----------------------------------------------------------------------
+# f64 parity pins: every kernel vs the LAPACK/expander path
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("m", [16, 21, 60])  # lane-exact, odd, flagship-v
+def test_nchol_f64_parity(m):
+    """|dL|, |dlogdet|, |du| and every solve orientation <= 1e-9 against
+    the LAPACK/expander path on identical inputs (measured agreement is
+    ~1e-14 — different reduction order, same math)."""
+    _require_kernels()
+    jax.config.update("jax_enable_x64", True)
+    try:
+        C = 19  # odd batch: exercises the pad-lane tail tile
+        S, r, R = _spd(C, m)
+        L0 = jnp.linalg.cholesky(S)
+        ld0 = 2.0 * jnp.sum(
+            jnp.log(jnp.diagonal(L0, axis1=-2, axis2=-1)), -1)
+        u0 = solve_triangular(L0, r[..., None], lower=True)[..., 0]
+        L1, ld1, u1 = nffi.nchol_factor(S, r)
+        np.testing.assert_allclose(L1, L0, atol=1e-9)
+        np.testing.assert_allclose(ld1, ld0, atol=1e-9)
+        np.testing.assert_allclose(u1, u0, atol=1e-9)
+        np.testing.assert_allclose(
+            nffi.fwd_vec(L0, r), u0, atol=1e-9)
+        np.testing.assert_allclose(
+            nffi.bwd_vec(L0, r),
+            solve_triangular(L0, r, lower=True, trans="T"), atol=1e-9)
+        np.testing.assert_allclose(
+            nffi.fwd_mat(L0, R), solve_triangular(L0, R, lower=True),
+            atol=1e-9)
+        np.testing.assert_allclose(
+            nffi.bwd_mat(L0, R),
+            solve_triangular(L0, R, lower=True, trans="T"), atol=1e-9)
+    finally:
+        jax.config.update("jax_enable_x64", False)
+
+
+def test_nchol_stacked_jitter_batch_shape():
+    """The robust_precond_cholesky shape (jitter levels stacked on a new
+    leading axis): rank-4 batches flatten correctly."""
+    _require_kernels()
+    S, r, _ = _spd(9, 12, dtype=np.float32)
+    Ss = jnp.broadcast_to(S, (4,) + S.shape)
+    rs = jnp.broadcast_to(r, (4,) + r.shape)
+    Ls, lds, us = nffi.nchol_factor(Ss, rs)
+    assert Ls.shape == Ss.shape and lds.shape == (4, 9)
+    L1, ld1, u1 = nffi.nchol_factor(S, r)
+    for k in range(4):
+        np.testing.assert_array_equal(Ls[k], L1)
+        np.testing.assert_array_equal(lds[k], ld1)
+
+
+def test_nchol_chisq_parity_f64():
+    _require_kernels()
+    jax.config.update("jax_enable_x64", True)
+    try:
+        rng = np.random.default_rng(2)
+        kmax = 31
+        xs = jnp.asarray(rng.standard_normal((64, 13, kmax)))
+        cnt = jnp.asarray(rng.integers(0, kmax + 1, (64, 13)),
+                          jnp.float64)
+        ref = 0.5 * jnp.sum(
+            jnp.where(jnp.arange(kmax) < cnt[..., None], xs * xs, 0.0),
+            -1)
+        np.testing.assert_allclose(nffi.chisq(xs, cnt), ref, atol=1e-9)
+        # short rows take the scalar path (kmax < lane width)
+        xs4 = xs[..., :4]
+        cnt4 = jnp.minimum(cnt, 4.0)
+        ref4 = 0.5 * jnp.sum(
+            jnp.where(jnp.arange(4) < cnt4[..., None], xs4 * xs4, 0.0),
+            -1)
+        np.testing.assert_allclose(nffi.chisq(xs4, cnt4), ref4,
+                                   atol=1e-9)
+    finally:
+        jax.config.update("jax_enable_x64", False)
+
+
+def test_nchol_nonpd_nan_propagation():
+    """A non-PD batch member poisons ITS logdet/solve with non-finite
+    values (the branchless -inf -> MH-reject signal) and leaves the
+    other chains alone — including members in the same SIMD lane tile."""
+    _require_kernels()
+    m = 12
+    S = np.eye(m)[None].repeat(3, 0)
+    S[1, 0, 0] = -1.0  # non-PD in chain 1 only
+    L, ld, u = nffi.nchol_factor(jnp.asarray(S, jnp.float32),
+                                 jnp.ones((3, m), jnp.float32))
+    ld = np.asarray(ld)
+    assert np.isfinite(ld[0]) and np.isfinite(ld[2])
+    assert np.isnan(ld[1])
+    assert np.isnan(np.asarray(u[1])).all()
+    assert np.isfinite(np.asarray(u[0])).all()
+    assert np.isfinite(np.asarray(u[2])).all()
+    # zero pivot: logdet -inf (still non-finite, still rejects)
+    S0 = np.eye(m)[None].copy()
+    S0[0, -1, -1] = 0.0
+    _, ld0, _ = nffi.nchol_factor(jnp.asarray(S0, jnp.float32),
+                                  jnp.ones((1, m), jnp.float32))
+    assert not np.isfinite(np.asarray(ld0[0]))
+
+
+# ----------------------------------------------------------------------
+# gate validation + dispatch
+# ----------------------------------------------------------------------
+
+
+def test_nchol_env_validation(monkeypatch, small_ma):
+    """Strict auto|1|0 whenever set (the loud-typo contract), enforced
+    both by nchol_env() and at backend construction."""
+    from gibbs_student_t_tpu.backends import JaxGibbs
+
+    monkeypatch.delenv("GST_NCHOL", raising=False)
+    assert linalg.nchol_env() == "auto"
+    monkeypatch.setenv("GST_NCHOL", "interpret")  # pallas-ism: rejected
+    with pytest.raises(ValueError, match="GST_NCHOL"):
+        linalg.nchol_env()
+    monkeypatch.setenv("GST_NCHOL", "bogus")
+    with pytest.raises(ValueError, match="GST_NCHOL"):
+        JaxGibbs(small_ma, GibbsConfig(model="mixture"), nchains=2)
+    for ok in ("auto", "1", "0"):
+        monkeypatch.setenv("GST_NCHOL", ok)
+        JaxGibbs(small_ma, GibbsConfig(model="mixture"), nchains=2)
+
+
+def test_dispatch_prefers_nchol_on_cpu(monkeypatch):
+    """Through the custom_vmap fold at an in-sweep shape, auto resolves
+    to the native kernel on CPU (and the introspection log records it)."""
+    _require_kernels()
+    from gibbs_student_t_tpu.obs import introspect
+
+    monkeypatch.delenv("GST_NCHOL", raising=False)
+    introspect.clear_introspection()
+    S, r, _ = _spd(32, 20, dtype=np.float32)
+    q, ld = jax.jit(jax.vmap(
+        lambda s, rr: linalg.precond_quad_logdet(s, rr, 1e-6)))(S, r)
+    assert np.isfinite(np.asarray(q)).all()
+    impls = {(rec["op"], rec["impl"])
+             for rec in introspect.linalg_impls()}
+    assert ("factor", "nchol") in impls
+
+
+def test_dispatch_degrades_without_library(monkeypatch):
+    """The acceptance contract: with the library unavailable (absent
+    .so / unregistered handlers), every entry point silently falls back
+    — even under a forced GST_NCHOL=1 — and produces the same numbers
+    as the portable path."""
+    from gibbs_student_t_tpu import native as native_mod
+    from gibbs_student_t_tpu.native import ffi as nffi_mod
+
+    S, r, _ = _spd(32, 20, dtype=np.float32)
+    f = lambda s, rr: linalg.precond_quad_logdet(s, rr, 1e-6)  # noqa: E731
+
+    monkeypatch.setenv("GST_NCHOL", "0")
+    q_off, ld_off = jax.jit(jax.vmap(f))(S, r)
+
+    # simulate the deleted-.so / no-handlers host: the probe fails
+    monkeypatch.setattr(native_mod, "load", lambda build=False: None)
+    nffi_mod._reset_for_tests()
+    try:
+        assert not nffi_mod.ready()
+        monkeypatch.setenv("GST_NCHOL", "1")  # forced AND unavailable
+        q_forced, ld_forced = jax.jit(jax.vmap(f))(S, r)
+        np.testing.assert_array_equal(q_forced, q_off)
+        np.testing.assert_array_equal(ld_forced, ld_off)
+        # chisq dispatcher degrades identically
+        xs = jnp.asarray(np.random.default_rng(0).standard_normal(
+            (32, 7, 18)), jnp.float32)
+        cnt = jnp.full((32, 7), 5.0, jnp.float32)
+        g = linalg.masked_chisq(xs, cnt)
+        assert np.isfinite(np.asarray(g)).all()
+    finally:
+        monkeypatch.undo()
+        nffi_mod._reset_for_tests()
+        assert nffi_mod.ready() == nffi_mod.ready()  # re-probe is clean
+
+
+def test_masked_chisq_forced_native_matches_jnp(monkeypatch):
+    """GST_NCHOL=1 routes masked_chisq to the kernel; auto keeps the
+    jnp fusion (the measured A/B, cpu_microbench_r07). Both compute the
+    same reduction."""
+    _require_kernels()
+    rng = np.random.default_rng(5)
+    xs = jnp.asarray(rng.standard_normal((64, 9, 31)), jnp.float32)
+    cnt = jnp.asarray(rng.integers(0, 32, (64, 9)), jnp.float32)
+    monkeypatch.setenv("GST_NCHOL", "0")
+    g_jnp = linalg.masked_chisq(xs, cnt)
+    monkeypatch.setenv("GST_NCHOL", "1")
+    g_nat = linalg.masked_chisq(xs, cnt)
+    np.testing.assert_allclose(g_nat, g_jnp, rtol=2e-6, atol=2e-6)
+
+
+# ----------------------------------------------------------------------
+# backend arms: one compiled backend per gate arm (vchol pattern)
+# ----------------------------------------------------------------------
+
+_ARMS = {
+    "nchol_off": {"GST_NCHOL": "0"},
+    "nchol_on": {"GST_NCHOL": "1"},
+}
+
+
+@pytest.fixture(scope="module")
+def arm_runs(small_ma):
+    """{arm: (backend, ChainResult)} — 24 sweeps, 4 chains, seed 5.
+    GST_NCHOL=1 forces the kernels past the MIN_BATCH floor so the
+    4-chain tier-1 model exercises them in-sweep."""
+    from gibbs_student_t_tpu.backends import JaxGibbs
+
+    saved = os.environ.get("GST_NCHOL")
+    out = {}
+    try:
+        for arm, env in _ARMS.items():
+            os.environ.pop("GST_NCHOL", None)
+            os.environ.update(env)
+            gb = JaxGibbs(small_ma,
+                          GibbsConfig(model="mixture",
+                                      theta_prior="beta"),
+                          nchains=4, chunk_size=6)
+            out[arm] = (gb, gb.sample(niter=24, seed=5))
+    finally:
+        if saved is None:
+            os.environ.pop("GST_NCHOL", None)
+        else:
+            os.environ["GST_NCHOL"] = saved
+    return out
+
+
+def test_nchol_backend_chains_track_vchol(arm_runs):
+    """GST_NCHOL on vs off: same math with a different reduction order —
+    f32 trajectories track tightly over a short window (the same
+    tolerance contract as the vchol-vs-expander pin)."""
+    if not nffi.ready():
+        pytest.skip(f"native FFI kernels unavailable: {nffi.status()}")
+    _, r0 = arm_runs["nchol_off"]
+    _, r1 = arm_runs["nchol_on"]
+    np.testing.assert_allclose(r1.chain[:10], r0.chain[:10],
+                               rtol=1e-4, atol=1e-4)
+    # bchain rides the compact wire at bf16 (quantum ~0.008 at these
+    # magnitudes): one-ulp wire flips from the reassociated solve are
+    # expected, so the pin is at the quantization scale
+    np.testing.assert_allclose(r1.bchain[:10], r0.bchain[:10],
+                               rtol=5e-2, atol=1e-2)
+    assert np.isfinite(r1.chain).all() and (r1.alphachain > 0).all()
+
+
+def test_nchol_backend_deterministic(arm_runs, small_ma):
+    """Same seed, same gate -> bit-identical chains (the kernels are
+    deterministic single-threaded code; rerunning the compiled sweep
+    must reproduce every bit)."""
+    if not nffi.ready():
+        pytest.skip(f"native FFI kernels unavailable: {nffi.status()}")
+    gb, r1 = arm_runs["nchol_on"]
+    r2 = gb.sample(niter=24, seed=5)
+    np.testing.assert_array_equal(r1.chain, r2.chain)
+    np.testing.assert_array_equal(r1.bchain, r2.bchain)
+    np.testing.assert_array_equal(r1.alphachain, r2.alphachain)
+
+
+# ----------------------------------------------------------------------
+# committed-.so staleness guard
+# ----------------------------------------------------------------------
+
+
+def _exported_symbols(so_path):
+    out = subprocess.run(["nm", "-D", "--defined-only", so_path],
+                         capture_output=True, text=True, check=True)
+    return {ln.split()[-1] for ln in out.stdout.splitlines()
+            if ln.strip() and " T " in f" {ln} "}
+
+
+def test_committed_so_symbol_set_fresh(tmp_path):
+    """Rebuild native/src into a scratch .so and assert the committed
+    library exports the same symbol set — a stale committed .so would
+    silently drop the FFI kernels (every entry point then degrades to
+    vchol: correct but slow) or, worse, ship old kernel semantics."""
+    import shutil
+
+    if not (shutil.which("make") and shutil.which("g++")
+            and shutil.which("nm")):
+        pytest.skip("native toolchain unavailable (no make/g++/nm)")
+    committed = os.path.join(
+        REPO, "gibbs_student_t_tpu", "native", "libgst_native.so")
+    if not os.path.exists(committed):
+        pytest.skip("no committed libgst_native.so")
+    fresh = str(tmp_path / "fresh.so")
+    # -O0 keeps the rebuild fast; the exported symbol set is
+    # optimization-independent
+    subprocess.run(
+        ["make", "-C", os.path.join(REPO, "native"),
+         f"OUT={fresh}", f"OBJDIR={tmp_path / 'obj'}",
+         "CXXFLAGS=-O0 -std=c++17 -fPIC"],
+        check=True, capture_output=True, timeout=300)
+    want = _exported_symbols(fresh)
+    have = _exported_symbols(committed)
+    assert want == have, (
+        f"committed .so is stale: missing {sorted(want - have)}, "
+        f"extra {sorted(have - want)} — rebuild with make -C native "
+        "and commit the result")

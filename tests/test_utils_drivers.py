@@ -189,14 +189,50 @@ def test_run_sims_until_rhat(tmp_path):
 
 @pytest.mark.slow
 def test_bench_quick(tmp_path):
-    r = _run_script(["/root/repo/bench.py", "--quick"], str(tmp_path))
-    assert r.returncode == 0, r.stderr
+    """End-to-end bench smoke on the COMBINED stdout+stderr stream: the
+    metric JSON must be the absolute final combined line (the r05
+    ``parsed: null`` regression — stage comments and XLA AOT-cache
+    warnings used to land after it; bench now drains both streams and
+    parks fd 2 on /dev/null before the final write)."""
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = "/root/repo"
+    r = subprocess.run(
+        [sys.executable, "/root/repo/bench.py", "--quick"],
+        cwd=str(tmp_path), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, env=env, timeout=600)
+    assert r.returncode == 0, r.stdout[-2000:]
     line = json.loads(r.stdout.strip().splitlines()[-1])
     assert {"metric", "value", "unit", "vs_baseline"} <= set(line)
     assert line["value"] > 0
     # the r04 default flip: adapted proposals are the production default
     # and the JSON line is self-describing about it
     assert line["adapt_sweeps"] == 20 and line["adapt_cov"] is True
+
+
+def test_bench_final_line_emission(tmp_path):
+    """Tier-1 unit for the final-line contract without a bench run:
+    _emit_final_line must put the metric line after any pending
+    stdout/stderr chatter and silence fd 2 for everything later
+    (post-metric C++ atexit output is what broke r05's parse)."""
+    code = (
+        "import sys, bench\n"
+        "sys.stderr.write('early diagnostic\\n')\n"
+        "sys.stdout.write('# comment line\\n')\n"
+        "bench._emit_final_line({'metric': 'm', 'value': 1.0})\n"
+        "sys.stderr.write('late C++-style chatter\\n')\n"
+    )
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env["PYTHONPATH"] = "/root/repo"
+    r = subprocess.run([sys.executable, "-c", code], cwd=str(tmp_path),
+                       stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                       text=True, env=env, timeout=120)
+    assert r.returncode == 0
+    lines = r.stdout.strip().splitlines()
+    assert json.loads(lines[-1]) == {"metric": "m", "value": 1.0}
+    assert "late C++-style chatter" not in r.stdout
 
 
 def test_driver_adapt_default_resolution(tmp_path):

@@ -31,7 +31,8 @@ import numpy as np
 _HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(_HERE))  # repo root for the package
 
-_ARM_FLAGS = ("GST_VCHOL", "GST_BDRAW_REUSE", "GST_FAST_GAMMA")
+_ARM_FLAGS = ("GST_VCHOL", "GST_BDRAW_REUSE", "GST_FAST_GAMMA",
+              "GST_NCHOL")
 
 
 def bench(fn, *args, reps=5):
@@ -91,6 +92,20 @@ def main():
                     L, r, lower=True, trans="T")), (L, r)),
             f"bwd_vchol({C},{m})": (jax.jit(bwd_solve_vec), (L, r)),
         }
+        # the native lane-batched FFI kernels (ISSUE 4), when built
+        try:
+            from gibbs_student_t_tpu.native import ffi as nffi
+
+            have_nchol = nffi.ready()
+        except Exception:  # noqa: BLE001
+            have_nchol = False
+        if have_nchol:
+            cases[f"factor_nchol({C},{m})"] = (
+                jax.jit(nffi.nchol_factor), (S, r))
+            cases[f"bwd_nchol({C},{m})"] = (jax.jit(nffi.bwd_vec), (L, r))
+        else:
+            print("# nchol kernels unavailable "
+                  "(make -C native); arms skipped", file=sys.stderr)
         for name, (fn, a) in cases.items():
             ms = bench(fn, *a, reps=reps)
             results[name] = round(ms, 3)
@@ -108,8 +123,27 @@ def main():
         live = jnp.arange(kmax, dtype=jnp.float32) < kc[:, None]
         return 0.5 * jnp.sum(jnp.where(live, xs * xs, 0.0), -1)
     g_chi = jax.jit(jax.vmap(chisq))
-    for name, fn in ((f"gamma_rejection({C},{n})", g_rej),
-                     (f"gamma_chisq({C},{n})", g_chi)):
+    gamma_cases = [(f"gamma_rejection({C},{n})", g_rej),
+                   (f"gamma_chisq({C},{n})", g_chi)]
+    if have_nchol:
+        # the fused masked reduction alone (normals precomputed), native
+        # vs the jnp mask-square-sum it replaces
+        xs_fixed = random.normal(random.PRNGKey(1), (C, n, kmax),
+                                 dtype=jnp.float32)
+
+        def chisq_jnp(xs, kc):
+            live = jnp.arange(kmax, dtype=jnp.float32) < kc[..., None]
+            return 0.5 * jnp.sum(jnp.where(live, xs * xs, 0.0), -1)
+
+        chisq_jnp_j = jax.jit(chisq_jnp)  # jit ONCE: a fresh jax.jit per
+        chisq_nat_j = jax.jit(nffi.chisq)  # rep would retrace every call
+        gamma_cases += [
+            (f"chisq_jnp({C},{n})",
+             lambda _k, kc: chisq_jnp_j(xs_fixed, kc)),
+            (f"chisq_nchol({C},{n})",
+             lambda _k, kc: chisq_nat_j(xs_fixed, kc)),
+        ]
+    for name, fn in gamma_cases:
         ms = bench(fn, keys, kcount, reps=reps)
         results[name] = round(ms, 3)
         print(f"{name:28s} {ms:8.2f} ms")
@@ -125,11 +159,14 @@ def main():
                           theta_prior="beta")
         arms = [
             ("baseline_pr2", {"GST_VCHOL": "0", "GST_BDRAW_REUSE": "0",
-                              "GST_FAST_GAMMA": "0"}),
+                              "GST_FAST_GAMMA": "0", "GST_NCHOL": "0"}),
             ("vchol_only", {"GST_VCHOL": "1", "GST_BDRAW_REUSE": "0",
-                            "GST_FAST_GAMMA": "0"}),
+                            "GST_FAST_GAMMA": "0", "GST_NCHOL": "0"}),
             ("vchol_breuse", {"GST_VCHOL": "1", "GST_BDRAW_REUSE": "1",
-                              "GST_FAST_GAMMA": "0"}),
+                              "GST_FAST_GAMMA": "0", "GST_NCHOL": "0"}),
+            # the round-6 production path (nchol off, everything else
+            # auto) vs the round-7 default (nchol rides auto when built)
+            ("nchol_off", {"GST_NCHOL": "0"}),
             ("auto_defaults", {}),
         ]
         for arm, env in arms:
@@ -165,6 +202,10 @@ def main():
         if base and new:
             results["hyper_and_draws_speedup"] = round(base / new, 2)
             print(f"hyper_and_draws speedup: {base / new:.2f}x")
+        r6 = results.get("sweep_hyper_and_draws[nchol_off]")
+        if r6 and new:
+            results["nchol_speedup"] = round(r6 / new, 2)
+            print(f"nchol speedup over the r06 path: {r6 / new:.2f}x")
 
     if args.out:
         with open(args.out, "w") as fh:

@@ -238,6 +238,16 @@ def check_latest(ledger_recs, max_drop, max_compile_growth,
     if not shared:
         print("check: per-stage timings unavailable on one side — "
               "skipped")
+    # asymmetric stage sets are REPORTED, never silently dropped: a
+    # renamed stage would otherwise vanish from the gate entirely (the
+    # r07 contract — new stage names must stay visible the round they
+    # appear)
+    for name in sorted(set(st) - set(bst)):
+        print(f"check: stage[{name}] new this record "
+              f"({st[name] * 1e3:.1f}ms, no baseline to gate against)")
+    for name in sorted(set(bst) - set(st)):
+        print(f"check: stage[{name}] present in baseline but missing "
+              f"from latest — renamed or dropped?")
     for name in shared:
         growth = (st[name] - bst[name]) / bst[name] * 100.0
         print(f"check: stage[{name}] {bst[name] * 1e3:.1f}ms -> "
