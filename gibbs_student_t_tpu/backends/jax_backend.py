@@ -64,7 +64,8 @@ from gibbs_student_t_tpu.ops.linalg import (
     masked_chisq,
     nchol_env,
     precond_quad_logdet,
-    robust_precond_cholesky,
+    precond_quad_logdet_hoisted,
+    robust_precond_draw,
     schur_eliminate,
     vchol_env,
 )
@@ -112,6 +113,39 @@ def _fast_gamma_env() -> str:
     if env is not None and env not in ("auto", "1", "0"):
         raise ValueError(
             f"GST_FAST_GAMMA must be 'auto', '1' or '0', got {env!r}")
+    return env if env is not None else "auto"
+
+
+def _hyper_hoist_env() -> str:
+    """Validated ``GST_HYPER_HOIST`` (``auto`` when unset) — the hyper
+    MH loop's per-sweep hoisting of proposal-invariant work (the
+    matrix block's diagonal, the fused equilibrated-matrix build that
+    skips materializing ``S0 + diag(phiinv)`` per proposal). Strict
+    ``auto|1|0``; ``auto`` resolves ON for the CPU backend (where the
+    closure-path hyper loop is the production path) and OFF elsewhere.
+    The hoist is a pure reassociation-free restructuring: chains are
+    bit-identical on/off (pinned in tests/test_nchol.py)."""
+    env = os.environ.get("GST_HYPER_HOIST")
+    if env is not None and env not in ("auto", "1", "0"):
+        raise ValueError(
+            f"GST_HYPER_HOIST must be 'auto', '1' or '0', got {env!r}")
+    return env if env is not None else "auto"
+
+
+def _fast_beta_env() -> str:
+    """Validated ``GST_FAST_BETA`` (``auto`` when unset) — the theta
+    draw's exact chi-square construction (``Beta(a, b) = chi2_2a /
+    (chi2_2a + chi2_2b)`` from one disjointly-masked normal pool),
+    replacing ``random.beta``'s two per-element rejection loops when
+    the prior pseudo-counts are half-integral. Strict ``auto|1|0``;
+    ``auto`` resolves ON off-TPU (the GST_FAST_GAMMA pattern — the
+    rejection loop is a CPU cost). Draws a different (equally exact)
+    stream than ``random.beta``, so it is gated separately from
+    GST_HYPER_HOIST, whose on/off contract is bit-identical chains."""
+    env = os.environ.get("GST_FAST_BETA")
+    if env is not None and env not in ("auto", "1", "0"):
+        raise ValueError(
+            f"GST_FAST_BETA must be 'auto', '1' or '0', got {env!r}")
     return env if env is not None else "auto"
 
 
@@ -730,6 +764,35 @@ class JaxGibbs(SamplerBackend):
         genv = _fast_gamma_env()
         self._fast_gamma = ((jax.default_backend() not in ("tpu", "axon"))
                             if genv == "auto" else genv == "1")
+        # hyper-MH hoist: per-sweep precomputation of the proposal-
+        # invariant pieces of the marginalized likelihood (bit-identical
+        # on/off — see _hyper_hoist_env). auto -> on for CPU, where the
+        # closure-path loop is the production path.
+        henv = _hyper_hoist_env()
+        self._hyper_hoist = ((jax.default_backend() == "cpu")
+                             if henv == "auto" else henv == "1")
+        # theta draw: exact chi-square Beta construction. Engages only
+        # when BOTH doubled pseudo-counts are integers (the chi-square
+        # identity is exact only for half-integer shapes; e.g. the
+        # uniform prior's a = sz + 1 always is, a beta prior's
+        # n * outlier_mean may not be) and the normal pool stays small;
+        # everything else keeps random.beta.
+        benv = _fast_beta_env()
+        fast_beta = ((jax.default_backend() not in ("tpu", "axon"))
+                     if benv == "auto" else benv == "1")
+        self._beta_pool = None
+        if fast_beta and config.is_outlier_model:
+            n_stat = self._n_real
+            if config.theta_prior == "beta":
+                mk = n_stat * config.outlier_mean
+                k1mm = n_stat * (1.0 - config.outlier_mean)
+            else:
+                mk = k1mm = 1.0
+            pool = 2.0 * (n_stat + mk + k1mm)
+            if (abs(2.0 * mk - round(2.0 * mk)) < 1e-9
+                    and abs(2.0 * k1mm - round(2.0 * k1mm)) < 1e-9
+                    and pool <= 8192.0):
+                self._beta_pool = int(round(pool))
         # donated chunk buffers: chunk k's ChainState input buffers are
         # reused for chunk k+1's outputs instead of re-allocating
         # ~per-chunk state each dispatch. sample() defends the caller's
@@ -1178,24 +1241,55 @@ class JaxGibbs(SamplerBackend):
                 x, acc_h = self._hyper_block(x, Sh, dS0, rh, base, dxh,
                                              logus, hK, hsel, hspecs)
         elif len(ma.hyper_indices):
+            # GST_HYPER_HOIST: the matrix block of the marginalized
+            # likelihood is proposal-invariant — hoist its diagonal out
+            # of the 10-step loop and build each proposal's equilibrated
+            # matrix in one fused pass (precond_quad_logdet_hoisted)
+            # instead of materializing S0 + diag(phiinv) then
+            # re-equilibrating it. Same floats in the same association
+            # order: chains are bit-identical hoist on/off.
             if self._schur is not None:
-                def ll_hyper(xq):
-                    phiinv, logdet_phi = phiinv_logdet(ma, xq, jnp)
-                    Sv = S0 + jnp.diag(phiinv[v_i])
-                    quad_v, logdet_S = precond_quad_logdet(Sv, rt,
-                                                           cfg.jitter)
-                    ll = const_white + 0.5 * (quad_s + quad_v - logdetA
-                                              - logdet_S - logdet_phi)
-                    return jnp.where(jnp.isfinite(ll), ll, -jnp.inf)
+                if self._hyper_hoist:
+                    dS0 = jnp.diagonal(S0, axis1=-2, axis2=-1)
+
+                    def ll_hyper(xq):
+                        phiinv, logdet_phi = phiinv_logdet(ma, xq, jnp)
+                        quad_v, logdet_S = precond_quad_logdet_hoisted(
+                            S0, dS0, phiinv[v_i], rt, cfg.jitter)
+                        ll = const_white + 0.5 * (quad_s + quad_v
+                                                  - logdetA - logdet_S
+                                                  - logdet_phi)
+                        return jnp.where(jnp.isfinite(ll), ll, -jnp.inf)
+                else:
+                    def ll_hyper(xq):
+                        phiinv, logdet_phi = phiinv_logdet(ma, xq, jnp)
+                        Sv = S0 + jnp.diag(phiinv[v_i])
+                        quad_v, logdet_S = precond_quad_logdet(Sv, rt,
+                                                               cfg.jitter)
+                        ll = const_white + 0.5 * (quad_s + quad_v
+                                                  - logdetA - logdet_S
+                                                  - logdet_phi)
+                        return jnp.where(jnp.isfinite(ll), ll, -jnp.inf)
             else:
-                def ll_hyper(xq):
-                    phiinv, logdet_phi = phiinv_logdet(ma, xq, jnp)
-                    Sigma = TNT + jnp.diag(phiinv)
-                    quad, logdet_sigma = precond_quad_logdet(Sigma, d,
-                                                             cfg.jitter)
-                    ll = const_white + 0.5 * (quad - logdet_sigma
-                                              - logdet_phi)
-                    return jnp.where(jnp.isfinite(ll), ll, -jnp.inf)
+                if self._hyper_hoist:
+                    dTNT = jnp.diagonal(TNT, axis1=-2, axis2=-1)
+
+                    def ll_hyper(xq):
+                        phiinv, logdet_phi = phiinv_logdet(ma, xq, jnp)
+                        quad, logdet_sigma = precond_quad_logdet_hoisted(
+                            TNT, dTNT, phiinv, d, cfg.jitter)
+                        ll = const_white + 0.5 * (quad - logdet_sigma
+                                                  - logdet_phi)
+                        return jnp.where(jnp.isfinite(ll), ll, -jnp.inf)
+                else:
+                    def ll_hyper(xq):
+                        phiinv, logdet_phi = phiinv_logdet(ma, xq, jnp)
+                        Sigma = TNT + jnp.diag(phiinv)
+                        quad, logdet_sigma = precond_quad_logdet(
+                            Sigma, d, cfg.jitter)
+                        ll = const_white + 0.5 * (quad - logdet_sigma
+                                                  - logdet_phi)
+                        return jnp.where(jnp.isfinite(ll), ll, -jnp.inf)
 
             block = self._mtm_block if mtm_h else self._mh_block
             with block_span("gibbs/hyper_mh"):
@@ -1230,10 +1324,16 @@ class JaxGibbs(SamplerBackend):
                 # agree in law (and the factor reconstructs Sigma to
                 # f64 roundoff — tests/test_vchol.py pins both).
                 Sv = S0 + jnp.diag(phiinv[v_i])
-                Ls, isd_v, _, u_v = robust_precond_cholesky(
-                    Sv, jitters=(cfg.jitter, 1e-4, 1e-2, 1e-1), rhs=rt)
                 ns = len(s_i)
-                y_v = backward_solve(Ls, u_v + xi[ns:])
+                # factor + backward draw as ONE operation: on the
+                # native path (GST_NCHOL) a single fused custom call
+                # that escalates jitters only for chain tiles whose
+                # first level failed; otherwise exactly the old
+                # stacked-jitter robust_precond_cholesky +
+                # backward_solve composition (ops/linalg.py).
+                y_v, isd_v, _ = robust_precond_draw(
+                    Sv, rt, xi[ns:],
+                    jitters=(cfg.jitter, 1e-4, 1e-2, 1e-1))
                 hi = jax.lax.Precision.HIGHEST
                 wty = jnp.matmul(
                     U_B, (isd_v * y_v)[..., None], precision=hi)[..., 0]
@@ -1243,14 +1343,14 @@ class JaxGibbs(SamplerBackend):
                      .at[v_i].set(y_v * isd_v))
             else:
                 Sigma = TNT + jnp.diag(phiinv)
-                L, isd, _, u = robust_precond_cholesky(
-                    Sigma, jitters=(cfg.jitter, 1e-4, 1e-2, 1e-1), rhs=d)
                 # b = mean + fluct = D^-1/2 L^-T (u + xi): the forward
-                # solve rode along with the factorization, so one
-                # backward substitution yields the draw (reference
-                # gibbs.py:169-180's mn + Li*xi)
-                b = backward_solve(L, u + xi)
-                b = b * isd
+                # solve rides along with the factorization and the
+                # backward substitution is fused into the same
+                # operation (reference gibbs.py:169-180's mn + Li*xi)
+                y, isd, _ = robust_precond_draw(
+                    Sigma, d, xi,
+                    jitters=(cfg.jitter, 1e-4, 1e-2, 1e-1))
+                b = y * isd
 
         resid = ma.y - matvec_blocked(ma.T, b, bs)
         nvec0 = ndiag(ma, x, jnp)
@@ -1265,8 +1365,30 @@ class JaxGibbs(SamplerBackend):
             else:
                 mk = k1mm = 1.0
             sz = jnp.sum(z)
-            theta = random.beta(kt, sz + mk, n - sz + k1mm,
-                                dtype=self.dtype)
+            if self._beta_pool is not None and ma_in is None:
+                # GST_FAST_BETA: Beta(a, b) = X / (X + Y) with
+                # X ~ 0.5 chi2_2a, Y ~ 0.5 chi2_2b — exact for the
+                # half-integer shapes this model produces (z sums are
+                # integers, the doubled pseudo-counts were checked
+                # integral at construction). 2a + 2b = pool is
+                # z-independent, so ONE normal pool serves both: the
+                # first 2a squares (masked sum) are X, the last 2b
+                # (the flipped mask) are Y — disjoint, hence
+                # independent. Replaces random.beta's two per-element
+                # rejection While loops (the same CPU cost profile as
+                # the GST_FAST_GAMMA alpha draw) with fixed-shape
+                # masked reductions through the masked_chisq dispatch.
+                pool = self._beta_pool
+                xs = random.normal(kt, (pool,), dtype=self.dtype)
+                a2 = (2.0 * (sz + mk)).astype(self.dtype)
+                ga = masked_chisq(xs, a2)
+                gb = masked_chisq(jnp.flip(xs, -1),
+                                  jnp.asarray(float(pool),
+                                              self.dtype) - a2)
+                theta = ga / (ga + gb)
+            else:
+                theta = random.beta(kt, sz + mk, n - sz + k1mm,
+                                    dtype=self.dtype)
 
         # --- outlier indicators z ~ Bernoulli (reference gibbs.py:201-226)
         pout = state.pout

@@ -34,6 +34,10 @@ import numpy as np
 TARGETS = {
     "gst_nchol_factor_f32": "GstNcholFactorF32",
     "gst_nchol_factor_f64": "GstNcholFactorF64",
+    "gst_nchol_factor_quad_f32": "GstNcholFactorQuadF32",
+    "gst_nchol_factor_quad_f64": "GstNcholFactorQuadF64",
+    "gst_nchol_robust_draw_f32": "GstNcholRobustDrawF32",
+    "gst_nchol_robust_draw_f64": "GstNcholRobustDrawF64",
     "gst_nchol_fwd_vec_f32": "GstNcholFwdVecF32",
     "gst_nchol_fwd_vec_f64": "GstNcholFwdVecF64",
     "gst_nchol_bwd_vec_f32": "GstNcholBwdVecF32",
@@ -44,6 +48,8 @@ TARGETS = {
     "gst_nchol_bwd_mat_f64": "GstNcholBwdMatF64",
     "gst_chisq_f32": "GstChisqF32",
     "gst_chisq_f64": "GstChisqF64",
+    "gst_tnt_f32": "GstTntF32",
+    "gst_tnt_f64": "GstTntF64",
 }
 
 # None = not yet probed; True/False = latched verdict for the process.
@@ -169,6 +175,40 @@ def nchol_factor(S, rhs):
     L, logdet, u = _call("gst_nchol_factor",
                          (S.shape, S.shape[:-2], rhs.shape), S, rhs)
     return L, logdet, u
+
+
+def nchol_factor_quad(S, rhs):
+    """``(logdet, u)`` — :func:`nchol_factor` without the L output.
+    Bitwise the same recurrence; skips the dense-L memset and the L
+    store transpose, which dominated the kernel wall time for callers
+    (the hyper-MH likelihood) that never read the factor."""
+    logdet, u = _call("gst_nchol_factor_quad",
+                      (S.shape[:-2], rhs.shape), S, rhs)
+    return logdet, u
+
+
+def nchol_robust_draw(S, rhs, xi, jitters):
+    """``(y, logdet)`` with ``y = L^-T (L^-1 rhs + xi)`` for the first
+    escalating-jitter level whose factor of ``S + j*I`` is finite (else
+    the last level) — the b-draw's robust factorization and backward
+    draw fused into one custom call; escalation beyond level 0 runs
+    only for chain tiles that actually failed."""
+    y, logdet = _call("gst_nchol_robust_draw",
+                      (rhs.shape, S.shape[:-2]), S, rhs, xi, jitters)
+    return y, logdet
+
+
+def tnt(T, y, nvec):
+    """``(TNT, d, const_white)`` of the marginalized likelihood for a
+    chain batch sharing one basis: ``TNT = T^T diag(1/nvec) T`` (full
+    symmetric), ``d = T^T (y / nvec)``, ``const = -1/2 (sum log nvec +
+    y^T y / nvec)``; ``T (n, m)`` and ``y (n,)`` shared, ``nvec
+    (..., n)`` per chain."""
+    m = T.shape[-1]
+    batch = nvec.shape[:-1]
+    TNT, d, cw = _call("gst_tnt", (batch + (m, m), batch + (m,), batch),
+                       T, y, nvec)
+    return TNT, d, cw
 
 
 def _solve(base, L, r):

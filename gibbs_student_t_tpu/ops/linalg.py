@@ -55,6 +55,7 @@ requires a C toolchain.
 
 from __future__ import annotations
 
+import functools
 import os
 
 import jax
@@ -162,6 +163,17 @@ def _nchol_mode():
     if jax.default_backend() != "cpu" or not _nchol_ready():
         return False, False
     return True, env == "1"
+
+
+def nchol_active() -> bool:
+    """Trace-time: could the native kernel family be dispatched at all
+    on this platform? Callers that must keep their gates-off graph
+    byte-identical to earlier rounds (ops/tnt.py's dense reduction, the
+    b-draw's robust factorization) branch on this BEFORE entering the
+    dispatchers — with the gate off the old code path is emitted
+    verbatim, not a dispatcher whose fallback merely computes the same
+    values."""
+    return _nchol_mode()[0]
 
 
 def _nchol_ok(shape, dtype, forced: bool) -> bool:
@@ -285,6 +297,37 @@ def _factor_fused_vmap(axis_size, in_batched, S, rhs):
 
 
 @custom_vmap
+def _factor_quad_fused(S, rhs):
+    """``(logdet S, L^-1 rhs)`` — the factorization WITHOUT the dense-L
+    output. The hyper-MH likelihood consumes only logdet and the
+    forward-solved rhs; XLA cannot dead-code an FFI result buffer, so
+    routing those callers through the full factor kernel paid a
+    B*m*m memset plus the L store transpose per proposal (measured:
+    ~5/6 of the factor kernel's wall time at the flagship shape,
+    artifacts/cpu_microbench_r08.json). Values are bitwise identical to
+    :func:`_factor_fused`'s logdet/u — same recurrence, L simply never
+    stored. Falls back to :func:`_factor_fused` (whose jnp branches let
+    XLA DCE the unused L) whenever the native kernel is not chosen."""
+    n_on, n_forced = _nchol_mode()
+    if n_on and _nchol_ok(S.shape, S.dtype, n_forced):
+        from gibbs_student_t_tpu.native import ffi as nffi
+
+        _note_impl("factor_quad", "nchol", S.shape)
+        return nffi.nchol_factor_quad(S, rhs)
+    _, logdet, u = _factor_fused(S, rhs)
+    return logdet, u
+
+
+@_factor_quad_fused.def_vmap
+def _factor_quad_fused_vmap(axis_size, in_batched, S, rhs):
+    if not in_batched[0]:
+        S = jnp.broadcast_to(S, (axis_size,) + S.shape)
+    if not in_batched[1]:
+        rhs = jnp.broadcast_to(rhs, (axis_size,) + rhs.shape)
+    return _factor_quad_fused(S, rhs), (True, True)
+
+
+@custom_vmap
 def _backsolve_fused(L, rhs):
     """``L^T x = rhs`` — Pallas lane-batched backward substitution or the
     XLA triangular-solve, same dispatch as :func:`_factor_fused`."""
@@ -402,13 +445,56 @@ def precond_cholesky(Sigma, jitter: float = 0.0):
     return L, inv_sqrt_d, logdet_S + logd
 
 
+def _factor_quad(S, rhs):
+    """``(logdet S, L^-1 rhs)`` through the same gates as
+    :func:`_factor` for callers that never read L: the no-L native
+    kernel when the nchol dispatch would choose the native factor, the
+    ordinary dispatch (L dead-coded by XLA) otherwise. Bitwise
+    identical to dropping L from :func:`_factor`'s result."""
+    if _unrolled_wanted(S.shape[-1]):
+        _, logdet, u = chol_forward(S, rhs)
+        return logdet, u
+    if nchol_active():
+        return _factor_quad_fused(S, rhs)
+    _, logdet, u = _factor_fused(S, rhs)
+    return logdet, u
+
+
 def precond_quad_logdet(Sigma, rhs, jitter: float = 0.0):
     """``(rhs^T Sigma^-1 rhs, logdet Sigma)`` in one fused pass — the
     linear-algebra payload of a marginalized-likelihood evaluation
     (reference gibbs.py:309-327) without materializing solves the MH
     accept/reject never looks at."""
     S, inv_sqrt_d, logd = _equilibrate(Sigma, jitter)
-    _, logdet_S, u = _factor(S, rhs * inv_sqrt_d)
+    logdet_S, u = _factor_quad(S, rhs * inv_sqrt_d)
+    return jnp.sum(u * u, axis=-1), logdet_S + logd
+
+
+def precond_quad_logdet_hoisted(S0, dS0, pv, rhs, jitter: float = 0.0):
+    """``precond_quad_logdet(S0 + diag(pv), rhs, jitter)`` restructured
+    for a per-proposal loop whose matrix block ``S0`` (and its
+    precomputed diagonal ``dS0``) are sweep constants and only the
+    diagonal increment ``pv`` (the prior precision at the proposal)
+    varies: the ``S0 + diag(pv)`` intermediate is never materialized —
+    the equilibrated matrix is built in ONE fused elementwise pass from
+    ``S0`` and the updated diagonal. Every float operation matches
+    :func:`_equilibrate` on the materialized sum (same values, same
+    association order), so hoist on/off chains are bit-identical
+    (pinned in tests/test_nchol.py)."""
+    d = dS0 + pv
+    inv_sqrt_d = 1.0 / jnp.sqrt(d)
+    S = S0 * inv_sqrt_d[..., :, None] * inv_sqrt_d[..., None, :]
+    # the diagonal of the materialized form is (Sv_ii * isd_i) * isd_i;
+    # replicate that exact association on the precomputed diagonal
+    eye_b = jnp.eye(S.shape[-1], dtype=bool)
+    S = jnp.where(
+        eye_b,
+        d[..., :, None] * inv_sqrt_d[..., :, None] * inv_sqrt_d[..., :, None],
+        S)
+    if jitter:
+        S = S + jitter * jnp.eye(S.shape[-1], dtype=S.dtype)
+    logd = jnp.sum(jnp.log(d), axis=-1)
+    logdet_S, u = _factor_quad(S, rhs * inv_sqrt_d)
     return jnp.sum(u * u, axis=-1), logdet_S + logd
 
 
@@ -506,6 +592,128 @@ def schur_eliminate(Sigma_ss, Sigma_sv, Sigma_vv, rhs_s, rhs_v,
     if return_factor:
         out = out + ((La, isd_a, u[..., :, :-1], u[..., :, -1]),)
     return out
+
+
+@functools.lru_cache(maxsize=None)
+def _robust_draw_dispatcher(jitters: tuple):
+    """Per-jitter-schedule ``custom_vmap`` dispatcher behind
+    :func:`robust_precond_draw` (the schedule is trace-static, so one
+    dispatcher per distinct tuple, cached)."""
+
+    @custom_vmap
+    def rd(Sigma, rhs, xi):
+        n_on, n_forced = _nchol_mode()
+        if (n_on and Sigma.ndim >= 3
+                and _nchol_ok(Sigma.shape, Sigma.dtype, n_forced)):
+            from gibbs_student_t_tpu.native import ffi as nffi
+
+            _note_impl("robust_draw", "nchol", Sigma.shape)
+            S, inv_sqrt_d, logd = _equilibrate(Sigma, 0.0)
+            jits = jnp.asarray(np.asarray(jitters, dtype=np.float64),
+                               dtype=Sigma.dtype)
+            y, logdet_S = nffi.nchol_robust_draw(S, rhs * inv_sqrt_d, xi,
+                                                 jits)
+            return y, inv_sqrt_d, logdet_S + logd
+        _note_impl("robust_draw", "stacked", Sigma.shape)
+        L, inv_sqrt_d, logdet, u = robust_precond_cholesky(
+            Sigma, jitters=jitters, rhs=rhs)
+        return backward_solve(L, u + xi), inv_sqrt_d, logdet
+
+    @rd.def_vmap
+    def _rd_vmap(axis_size, in_batched, Sigma, rhs, xi):
+        if not in_batched[0]:
+            Sigma = jnp.broadcast_to(Sigma, (axis_size,) + Sigma.shape)
+        if not in_batched[1]:
+            rhs = jnp.broadcast_to(rhs, (axis_size,) + rhs.shape)
+        if not in_batched[2]:
+            xi = jnp.broadcast_to(xi, (axis_size,) + xi.shape)
+        return rd(Sigma, rhs, xi), (True, True, True)
+
+    return rd
+
+
+def robust_precond_draw(Sigma, rhs, xi,
+                        jitters=(1e-6, 1e-4, 1e-2, 1e-1)):
+    """``(y, inv_sqrt_d, logdet)`` with ``y = L^-T (u + xi)`` for the
+    escalating-jitter factorization of :func:`robust_precond_cholesky`
+    — the b-draw's factor-then-backward-substitute pair as one
+    operation, so the native path (``GST_NCHOL``) can run it as a
+    single fused custom call: the stacked-jitter XLA form materializes
+    every jitter level of ``S`` and factors all of them every sweep,
+    while the kernel escalates only the chain tiles whose first level
+    actually failed (the selection predicate — all-finite L and logdet
+    — and the escalate-else-last cascade are identical). With the
+    native path inactive this IS the old composition, emitted verbatim
+    (the gates-off graphs are byte-identical to rounds 6/7)."""
+    if not nchol_active():
+        L, inv_sqrt_d, logdet, u = robust_precond_cholesky(
+            Sigma, jitters=jitters, rhs=rhs)
+        return backward_solve(L, u + xi), inv_sqrt_d, logdet
+    jitters = tuple(float(j) for j in jitters)
+    return _robust_draw_dispatcher(jitters)(Sigma, rhs, xi)
+
+
+def _tnt_gram_jnp(T, y, nvec):
+    """One chain's dense TNT reduction — EXACTLY ops/tnt.py's dense
+    expressions, so the dispatcher's fallback lowers to the same HLO
+    the pre-dispatch path produced under ``vmap``."""
+    w = 1.0 / nvec
+    Tw = T * w[:, None]
+    hi = jax.lax.Precision.HIGHEST
+    TNT = jnp.matmul(T.T, Tw, precision=hi)
+    d = jnp.matmul(Tw.T, y, precision=hi)
+    const = -0.5 * (jnp.sum(jnp.log(nvec)) + jnp.sum(y * y * w))
+    return TNT, d, const
+
+
+@custom_vmap
+def tnt_gram(T, y, nvec):
+    """``(TNT, d, const_white)`` of ops/tnt.py's dense reduction with
+    the basis ``T (n, m)`` / residuals ``y (n,)`` SHARED across the
+    chain batch and only ``nvec (..., n)`` per-chain — the structure
+    the native lane-batched Gram kernel exploits (XLA's batched-matmul
+    lowering materializes the (B, n, m) weighted basis and loops B
+    small matmuls instead). Dispatched under ``GST_NCHOL`` like the
+    factor kernels; the fallback re-enters the plain per-chain
+    expressions under ``vmap`` so a small batch lowers exactly as the
+    pre-dispatch path did. Only reached when ``nchol_active()`` (see
+    ops/tnt.py) — gates-off sweeps never route here."""
+    if nvec.ndim == 1:
+        return _tnt_gram_jnp(T, y, nvec)
+    n_on, n_forced = _nchol_mode()
+    batch = int(np.prod(nvec.shape[:-1]))
+    if (n_on and T.ndim == 2 and y.ndim == 1
+            and nvec.dtype in (jnp.float32, jnp.float64)
+            and T.dtype == nvec.dtype and y.dtype == nvec.dtype
+            and (n_forced or batch >= _PALLAS_MIN_BATCH)):
+        from gibbs_student_t_tpu.native import ffi as nffi
+
+        _note_impl("tnt", "nchol", nvec.shape)
+        return nffi.tnt(T, y, nvec)
+    _note_impl("tnt", "vmap_jnp", nvec.shape)
+    f = _tnt_gram_jnp
+    for _ in range(nvec.ndim - 1):
+        f = jax.vmap(f, in_axes=(None, None, 0))
+    return f(T, y, nvec)
+
+
+@tnt_gram.def_vmap
+def _tnt_gram_vmap(axis_size, in_batched, T, y, nvec):
+    if in_batched[0] or in_batched[1]:
+        # batched basis (a traced per-pulsar model): not the shared-T
+        # structure — peel every axis with plain vmap over the jnp form
+        def g(Tb, yb, nvb):
+            f = _tnt_gram_jnp
+            for _ in range(nvb.ndim - 1):
+                f = jax.vmap(f, in_axes=(None, None, 0))
+            return f(Tb, yb, nvb)
+
+        out = jax.vmap(g, in_axes=tuple(0 if b else None
+                                        for b in in_batched))(T, y, nvec)
+        return out, (True, True, True)
+    if not in_batched[2]:
+        nvec = jnp.broadcast_to(nvec, (axis_size,) + nvec.shape)
+    return tnt_gram(T, y, nvec), (True, True, True)
 
 
 def precond_solve_quad(L, inv_sqrt_d, rhs):

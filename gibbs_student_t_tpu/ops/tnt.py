@@ -35,6 +35,7 @@ from __future__ import annotations
 from functools import partial
 from typing import Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
@@ -68,8 +69,23 @@ def tnt_products(T, y, nvec, block_size: Optional[int] = None):
     the TOA axis (which must be an exact multiple) is reduced by
     ``lax.scan``; results are bitwise-independent of ``block_size`` up to
     float reassociation.
+
+    On CPU with the native kernels available (``GST_NCHOL``,
+    ops/linalg.py), the dense form of a frozen model (concrete ``T``
+    and ``y`` — a traced per-pulsar ensemble basis keeps the plain
+    path) routes through the :func:`ops.linalg.tnt_gram` custom_vmap
+    dispatcher, so the in-sweep chain batch reaches the lane-batched
+    Gram kernel: the basis is shared across every chain and only
+    ``nvec`` varies, which XLA's batched matmul cannot exploit (it
+    materializes a (B, n, m) weighted basis per sweep). With the gate
+    off this function is byte-identical to earlier rounds.
     """
     if block_size is None:
+        from gibbs_student_t_tpu.ops.linalg import nchol_active, tnt_gram
+
+        if (nchol_active() and not isinstance(T, jax.core.Tracer)
+                and not isinstance(y, jax.core.Tracer)):
+            return tnt_gram(jnp.asarray(T), jnp.asarray(y), nvec)
         w = 1.0 / nvec
         Tw = T * w[:, None]
         TNT = jnp.matmul(T.T, Tw, precision=_HI)

@@ -68,6 +68,19 @@ extern "C" __attribute__((visibility("default")))
 void gst_bench_chisq(const float* xs, const float* cnt, float* out,
                      long long rows, long long kmax);
 
+// Plain-C A/B entries for the tile transposes: a full batch of
+// lower-triangle load+store round trips through the scalar chunked
+// form (mem) vs the in-register shuffle form (reg) — the
+// transpose_{mem,reg} arms of tools/cpu_microbench.py. On compilers
+// without the two-operand __builtin_shuffle both entries run the
+// scalar form.
+extern "C" __attribute__((visibility("default")))
+void gst_bench_transpose_mem(const float* src, float* dst,
+                             long long B, long long m);
+extern "C" __attribute__((visibility("default")))
+void gst_bench_transpose_reg(const float* src, float* dst,
+                             long long B, long long m);
+
 #ifndef GST_NO_FFI
 
 #include "xla/ffi/api/ffi.h"
@@ -80,9 +93,12 @@ namespace {
 
 using gst::Lanes;
 using gst::factor_batch;
+using gst::factor_quad_batch;
+using gst::robust_draw_batch;
 using gst::solve_vec_batch;
 using gst::solve_mat_batch;
 using gst::chisq_batch;
+using gst::tnt_batch;
 
 // ---------------------------------------------------------------------
 // FFI handlers
@@ -108,6 +124,68 @@ ffi::Error factor_impl(ffi::Buffer<DT> S, ffi::Buffer<DT> rhs,
   if (B && m)
     factor_batch(S.typed_data(), rhs.typed_data(), L->typed_data(),
                  ld->typed_data(), u->typed_data(), B, m);
+  return ffi::Error::Success();
+}
+
+template <ffi::DataType DT>
+ffi::Error factor_quad_impl(ffi::Buffer<DT> S, ffi::Buffer<DT> rhs,
+                            ffi::ResultBuffer<DT> ld,
+                            ffi::ResultBuffer<DT> u) {
+  auto dims = S.dimensions();
+  if (dims.size() < 2 || dims[dims.size() - 1] != dims[dims.size() - 2])
+    return ffi::Error::InvalidArgument("gst_nchol_factor_quad: S not square");
+  const int64_t m = dims[dims.size() - 1];
+  const int64_t B = batch_of(dims, 2);
+  if (rhs.element_count() != size_t(B) * m)
+    return ffi::Error::InvalidArgument("gst_nchol_factor_quad: rhs shape");
+  if (B && m)
+    factor_quad_batch(S.typed_data(), rhs.typed_data(), ld->typed_data(),
+                      u->typed_data(), B, m);
+  return ffi::Error::Success();
+}
+
+template <ffi::DataType DT>
+ffi::Error robust_draw_impl(ffi::Buffer<DT> S, ffi::Buffer<DT> rhs,
+                            ffi::Buffer<DT> xi, ffi::Buffer<DT> jits,
+                            ffi::ResultBuffer<DT> y,
+                            ffi::ResultBuffer<DT> ld) {
+  auto dims = S.dimensions();
+  if (dims.size() < 2 || dims[dims.size() - 1] != dims[dims.size() - 2])
+    return ffi::Error::InvalidArgument("gst_nchol_robust_draw: S not square");
+  const int64_t m = dims[dims.size() - 1];
+  const int64_t B = batch_of(dims, 2);
+  if (rhs.element_count() != size_t(B) * m
+      || xi.element_count() != size_t(B) * m)
+    return ffi::Error::InvalidArgument("gst_nchol_robust_draw: rhs/xi shape");
+  const int64_t nlev = jits.element_count();
+  if (nlev < 1)
+    return ffi::Error::InvalidArgument("gst_nchol_robust_draw: no jitters");
+  if (B && m)
+    robust_draw_batch(S.typed_data(), rhs.typed_data(), xi.typed_data(),
+                      jits.typed_data(), nlev, y->typed_data(),
+                      ld->typed_data(), B, m);
+  return ffi::Error::Success();
+}
+
+template <ffi::DataType DT>
+ffi::Error tnt_impl(ffi::Buffer<DT> T, ffi::Buffer<DT> y,
+                    ffi::Buffer<DT> nvec, ffi::ResultBuffer<DT> TNT,
+                    ffi::ResultBuffer<DT> d, ffi::ResultBuffer<DT> cw) {
+  auto tdims = T.dimensions();
+  if (tdims.size() != 2)
+    return ffi::Error::InvalidArgument("gst_tnt: T must be (n, m)");
+  const int64_t n = tdims[0];
+  const int64_t m = tdims[1];
+  if (y.element_count() != size_t(n))
+    return ffi::Error::InvalidArgument("gst_tnt: y shape");
+  auto ndims = nvec.dimensions();
+  if (ndims.size() < 1 || ndims[ndims.size() - 1] != n)
+    return ffi::Error::InvalidArgument("gst_tnt: nvec shape");
+  const int64_t B = batch_of(ndims, 1);
+  if (B && n && m)
+    tnt_batch(T.typed_data(), y.typed_data(), nvec.typed_data(),
+              TNT->typed_data(), d->typed_data(), cw->typed_data(), B, n,
+              m);
   return ffi::Error::Success();
 }
 
@@ -215,6 +293,48 @@ XLA_FFI_DEFINE_HANDLER_SYMBOL(GstChisqF32, (chisq_impl<ffi::F32>),
 XLA_FFI_DEFINE_HANDLER_SYMBOL(GstChisqF64, (chisq_impl<ffi::F64>),
                               GST_BIND_SOLVE(ffi::F64));
 
+#define GST_BIND_FACTOR_QUAD(DT)           \
+  ffi::Ffi::Bind()                         \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Ret<ffi::Buffer<DT>>()              \
+      .Ret<ffi::Buffer<DT>>()
+
+#define GST_BIND_ROBUST_DRAW(DT)           \
+  ffi::Ffi::Bind()                         \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Ret<ffi::Buffer<DT>>()              \
+      .Ret<ffi::Buffer<DT>>()
+
+#define GST_BIND_TNT(DT)                   \
+  ffi::Ffi::Bind()                         \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Ret<ffi::Buffer<DT>>()              \
+      .Ret<ffi::Buffer<DT>>()              \
+      .Ret<ffi::Buffer<DT>>()
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(GstNcholFactorQuadF32,
+                              (factor_quad_impl<ffi::F32>),
+                              GST_BIND_FACTOR_QUAD(ffi::F32));
+XLA_FFI_DEFINE_HANDLER_SYMBOL(GstNcholFactorQuadF64,
+                              (factor_quad_impl<ffi::F64>),
+                              GST_BIND_FACTOR_QUAD(ffi::F64));
+XLA_FFI_DEFINE_HANDLER_SYMBOL(GstNcholRobustDrawF32,
+                              (robust_draw_impl<ffi::F32>),
+                              GST_BIND_ROBUST_DRAW(ffi::F32));
+XLA_FFI_DEFINE_HANDLER_SYMBOL(GstNcholRobustDrawF64,
+                              (robust_draw_impl<ffi::F64>),
+                              GST_BIND_ROBUST_DRAW(ffi::F64));
+XLA_FFI_DEFINE_HANDLER_SYMBOL(GstTntF32, (tnt_impl<ffi::F32>),
+                              GST_BIND_TNT(ffi::F32));
+XLA_FFI_DEFINE_HANDLER_SYMBOL(GstTntF64, (tnt_impl<ffi::F64>),
+                              GST_BIND_TNT(ffi::F64));
+
 #endif  // GST_NO_FFI
 
 #ifndef GST_NO_FFI
@@ -222,5 +342,34 @@ extern "C" void gst_bench_chisq(const float* xs, const float* cnt,
                                 float* out, long long rows,
                                 long long kmax) {
   gst::chisq_batch<float>(xs, cnt, out, rows, kmax);
+}
+
+// One full lower-triangle load+store round trip per chain tile —
+// exactly the transpose traffic a factor kernel pays around its
+// in-tile compute. dst must hold B*m*m floats (the round trip writes
+// the lower triangles back out).
+extern "C" void gst_bench_transpose_mem(const float* src, float* dst,
+                                        long long B, long long m) {
+  constexpr int W = gst::Lanes<float>::W;
+  gst::Scratch<float> tile(size_t(m) * m * W);
+  for (long long b0 = 0; b0 < B; b0 += W) {
+    const long long lanes = std::min<long long>(W, B - b0);
+    gst::load_tile_lower_mem<float, W>(src, tile.get(), b0, lanes, m,
+                                       m * m);
+    gst::store_tile_lower_mem<float, W>(tile.get(), dst, b0, lanes, m,
+                                        m * m);
+  }
+}
+
+extern "C" void gst_bench_transpose_reg(const float* src, float* dst,
+                                        long long B, long long m) {
+  constexpr int W = gst::Lanes<float>::W;
+  gst::Scratch<float> tile(size_t(m) * m * W);
+  for (long long b0 = 0; b0 < B; b0 += W) {
+    const long long lanes = std::min<long long>(W, B - b0);
+    gst::load_tile_lower<float, W>(src, tile.get(), b0, lanes, m, m * m);
+    gst::store_tile_lower<float, W>(tile.get(), dst, b0, lanes, m,
+                                    m * m);
+  }
 }
 #endif

@@ -17,9 +17,19 @@
 #include <cstdint>
 #include <cstring>
 #include <new>
+#include <utility>
 
 #if !defined(__GNUC__) && !defined(__clang__)
 #error "gst_kernels.h needs GCC/Clang vector extensions (define GST_NO_FFI to skip the kernels)"
+#endif
+
+// In-register W x W block transposes need the two-operand
+// __builtin_shuffle (GCC); clang lacks it, so clang builds keep the
+// scalar chunked transposes (slower, same results).
+#if defined(__GNUC__) && !defined(__clang__)
+#define GST_REG_XPOSE 1
+#else
+#define GST_REG_XPOSE 0
 #endif
 
 namespace gst {
@@ -59,15 +69,22 @@ struct Scratch {
 // tile transposes: (B, m, m) row-major <-> (row, col, lane) scratch
 // ---------------------------------------------------------------------
 
-// Elements per transpose chunk: the strided side touches one cache
-// line per element, so a chunk (256 * 64 B = 16 KB) stays L1-resident
-// across all W lane passes instead of re-walking the whole tile.
+// Elements per transpose chunk (scalar fallback): the strided side
+// touches one cache line per element, so a chunk (256 * 64 B = 16 KB)
+// stays L1-resident across all W lane passes instead of re-walking the
+// whole tile.
 constexpr int64_t kTransposeChunk = 256;
 
+// Scalar chunked transposes. Kept (a) as the clang / A-B baseline and
+// (b) for the short tails the register path cannot cover. One scalar
+// load + store per element; this was the portable path's single
+// largest cost once the factorization went register-resident
+// (docs/PERFORMANCE.md "Round 7": in-tile ~50 GFLOP/s, end-to-end ~9).
+
 template <typename T, int W>
-inline void load_tile(const T* __restrict src, T* __restrict dst,
-                      int64_t b0, int64_t lanes, int64_t elems,
-                      int64_t stride) {
+inline void load_tile_mem(const T* __restrict src, T* __restrict dst,
+                          int64_t b0, int64_t lanes, int64_t elems,
+                          int64_t stride) {
   for (int64_t e0 = 0; e0 < elems; e0 += kTransposeChunk) {
     const int64_t e1 = std::min(elems, e0 + kTransposeChunk);
     for (int64_t l = 0; l < lanes; ++l) {
@@ -82,9 +99,9 @@ inline void load_tile(const T* __restrict src, T* __restrict dst,
 }
 
 template <typename T, int W>
-inline void store_tile(const T* __restrict src, T* __restrict dst,
-                       int64_t b0, int64_t lanes, int64_t elems,
-                       int64_t stride) {
+inline void store_tile_mem(const T* __restrict src, T* __restrict dst,
+                           int64_t b0, int64_t lanes, int64_t elems,
+                           int64_t stride) {
   for (int64_t e0 = 0; e0 < elems; e0 += kTransposeChunk) {
     const int64_t e1 = std::min(elems, e0 + kTransposeChunk);
     for (int64_t l = 0; l < lanes; ++l) {
@@ -94,17 +111,10 @@ inline void store_tile(const T* __restrict src, T* __restrict dst,
   }
 }
 
-// Triangle-aware variants: the factorization reads only the lower
-// triangle of a symmetric input and the solves read only the lower
-// triangle of L, so half the transpose traffic is skippable. Each row's
-// lower run is contiguous in the row-major source, and one row's
-// strided tile window ((r+1) cache lines) stays L1-resident across the
-// W lane passes without extra chunking.
-
 template <typename T, int W>
-inline void load_tile_lower(const T* __restrict src, T* __restrict dst,
-                            int64_t b0, int64_t lanes, int64_t m,
-                            int64_t stride) {
+inline void load_tile_lower_mem(const T* __restrict src,
+                                T* __restrict dst, int64_t b0,
+                                int64_t lanes, int64_t m, int64_t stride) {
   for (int64_t r = 0; r < m; ++r) {
     const int64_t o = r * m;
     for (int64_t l = 0; l < lanes; ++l) {
@@ -120,13 +130,10 @@ inline void load_tile_lower(const T* __restrict src, T* __restrict dst,
   }
 }
 
-// Stores the lower triangle only — callers that need a dense L zero the
-// destination buffer up front (memset is far cheaper than transposing
-// W lanes of zeros through the strided window).
 template <typename T, int W>
-inline void store_tile_lower(const T* __restrict src, T* __restrict dst,
-                             int64_t b0, int64_t lanes, int64_t m,
-                             int64_t stride) {
+inline void store_tile_lower_mem(const T* __restrict src,
+                                 T* __restrict dst, int64_t b0,
+                                 int64_t lanes, int64_t m, int64_t stride) {
   for (int64_t r = 0; r < m; ++r) {
     const int64_t o = r * m;
     for (int64_t l = 0; l < lanes; ++l) {
@@ -135,6 +142,216 @@ inline void store_tile_lower(const T* __restrict src, T* __restrict dst,
       for (int64_t e = 0; e <= r; ++e) d[e] = s[e * W];
     }
   }
+}
+
+#if GST_REG_XPOSE
+
+// In-register W x W block transpose: W unaligned vector loads, a
+// log2(W)-round interleave butterfly (each round = W two-source
+// shuffles with compile-time masks), W aligned vector stores — ~100
+// instructions per W*W elements where the scalar form paid ~2*W*W
+// load/store pairs through a strided window. The butterfly leaves the
+// output rows in bit-reversed order; the store indexes through
+// bitrev() (an involution), which costs nothing — the stores were
+// permutable anyway.
+
+template <typename T> struct MaskInt;
+template <> struct MaskInt<float> { using type = int32_t; };
+template <> struct MaskInt<double> { using type = int64_t; };
+
+// element-aligned (unaligned-capable) vector view of a T run
+template <typename T, int W>
+struct UVecOf {
+  typedef T type __attribute__((vector_size(W * sizeof(T)),
+                                aligned(alignof(T)), may_alias));
+};
+
+template <typename T, int W>
+struct RegXpose {
+  using V = typename VecOf<T, W>::type;
+  using MI = typename MaskInt<T>::type;
+  typedef MI M __attribute__((vector_size(W * sizeof(T))));
+
+  static constexpr int bitrev(int k) {
+    int r = 0;
+    for (int bit = 1; bit < W; bit <<= 1) {
+      r = (r << 1) | (k & 1);
+      k >>= 1;
+    }
+    return r;
+  }
+
+  // Round masks: interleave blocks of S elements from two sources
+  // (lo = first halves, hi = second halves). For output slot I with
+  // block index q = I / S: even blocks read source a, odd blocks
+  // source b (offset W in two-operand __builtin_shuffle indexing).
+  template <int S, int Off, int... I>
+  static constexpr M mask(std::integer_sequence<int, I...>) {
+    return M{MI((((I / S) & 1) ? W : 0) + ((I / S) / 2) * S + (I % S)
+                + Off)...};
+  }
+
+  template <int S>
+  static inline void round_(V* r) {
+    constexpr M lo = mask<S, 0>(std::make_integer_sequence<int, W>{});
+    constexpr M hi = mask<S, W / 2>(std::make_integer_sequence<int, W>{});
+    for (int base = 0; base < W; base += 2 * S)
+      for (int j = 0; j < S; ++j) {
+        const V a = r[base + j];
+        const V b = r[base + j + S];
+        r[base + j] = __builtin_shuffle(a, b, lo);
+        r[base + j + S] = __builtin_shuffle(a, b, hi);
+      }
+  }
+
+  static inline void run(V* r) {
+    round_<1>(r);
+    if constexpr (W > 2) round_<2>(r);
+    if constexpr (W > 4) round_<4>(r);
+    if constexpr (W > 8) round_<8>(r);
+    if constexpr (W > 16) round_<16>(r);
+  }
+};
+
+// One W x W block, load direction: W lanes' element runs [o, o + W)
+// transposed into the (element, lane) scratch at dst + o * W.
+template <typename T, int W>
+inline void xpose_load_block(const T* __restrict src, T* __restrict dst,
+                             int64_t b0, int64_t lanes, int64_t stride,
+                             int64_t o) {
+  using X = RegXpose<T, W>;
+  using V = typename VecOf<T, W>::type;
+  using UV = typename UVecOf<T, W>::type;
+  V r[W];
+  for (int l = 0; l < (int)lanes; ++l)
+    r[l] = (V)*(const UV*)(src + (b0 + l) * stride + o);
+  for (int l = (int)lanes; l < W; ++l) r[l] = r[0];  // pad lanes
+  X::run(r);
+  V* d = reinterpret_cast<V*>(dst + o * W);
+  for (int k = 0; k < W; ++k) d[X::bitrev(k)] = r[k];
+}
+
+// Store direction: scratch vectors [o, o + W) back to the lanes' runs.
+template <typename T, int W>
+inline void xpose_store_block(const T* __restrict scr, T* __restrict out,
+                              int64_t b0, int64_t lanes, int64_t stride,
+                              int64_t o) {
+  using X = RegXpose<T, W>;
+  using V = typename VecOf<T, W>::type;
+  using UV = typename UVecOf<T, W>::type;
+  V r[W];
+  const V* s = reinterpret_cast<const V*>(scr + o * W);
+  for (int k = 0; k < W; ++k) r[k] = s[k];
+  X::run(r);
+  for (int k = 0; k < W; ++k) {
+    const int l = X::bitrev(k);
+    if (l < lanes) *(UV*)(out + (b0 + l) * stride + o) = (UV)r[k];
+  }
+}
+
+// Contiguous-run transposes: full W-blocks, then ONE overlapped block
+// ending at the run's end (always in bounds when run >= W; overlapped
+// elements are written twice with identical values — the chisq tail-
+// window trick applied to transposes). Runs shorter than W fall back
+// to the scalar moves.
+
+template <typename T, int W>
+inline void xpose_load_run(const T* __restrict src, T* __restrict dst,
+                           int64_t b0, int64_t lanes, int64_t stride,
+                           int64_t o, int64_t run) {
+  int64_t e = 0;
+  for (; e + W <= run; e += W)
+    xpose_load_block<T, W>(src, dst, b0, lanes, stride, o + e);
+  if (e < run) {
+    if (run >= W) {
+      xpose_load_block<T, W>(src, dst, b0, lanes, stride, o + run - W);
+    } else {
+      for (int64_t l = 0; l < lanes; ++l) {
+        const T* s = src + (b0 + l) * stride + o;
+        for (int64_t ee = e; ee < run; ++ee) dst[(o + ee) * W + l] = s[ee];
+      }
+      for (int64_t l = lanes; l < W; ++l) {
+        const T* s = src + b0 * stride + o;
+        for (int64_t ee = e; ee < run; ++ee) dst[(o + ee) * W + l] = s[ee];
+      }
+    }
+  }
+}
+
+template <typename T, int W>
+inline void xpose_store_run(const T* __restrict scr, T* __restrict out,
+                            int64_t b0, int64_t lanes, int64_t stride,
+                            int64_t o, int64_t run) {
+  int64_t e = 0;
+  for (; e + W <= run; e += W)
+    xpose_store_block<T, W>(scr, out, b0, lanes, stride, o + e);
+  if (e < run) {
+    if (run >= W) {
+      xpose_store_block<T, W>(scr, out, b0, lanes, stride, o + run - W);
+    } else {
+      for (int64_t l = 0; l < lanes; ++l) {
+        T* d = out + (b0 + l) * stride + o;
+        for (int64_t ee = e; ee < run; ++ee) d[ee] = scr[(o + ee) * W + l];
+      }
+    }
+  }
+}
+
+#endif  // GST_REG_XPOSE
+
+template <typename T, int W>
+inline void load_tile(const T* __restrict src, T* __restrict dst,
+                      int64_t b0, int64_t lanes, int64_t elems,
+                      int64_t stride) {
+#if GST_REG_XPOSE
+  xpose_load_run<T, W>(src, dst, b0, lanes, stride, 0, elems);
+#else
+  load_tile_mem<T, W>(src, dst, b0, lanes, elems, stride);
+#endif
+}
+
+template <typename T, int W>
+inline void store_tile(const T* __restrict src, T* __restrict dst,
+                       int64_t b0, int64_t lanes, int64_t elems,
+                       int64_t stride) {
+#if GST_REG_XPOSE
+  xpose_store_run<T, W>(src, dst, b0, lanes, stride, 0, elems);
+#else
+  store_tile_mem<T, W>(src, dst, b0, lanes, elems, stride);
+#endif
+}
+
+// Triangle-aware variants: the factorization reads only the lower
+// triangle of a symmetric input and the solves read only the lower
+// triangle of L, so half the transpose traffic is skippable. Each
+// row's lower run is contiguous in the row-major source, so every row
+// is just a short contiguous-run transpose.
+
+template <typename T, int W>
+inline void load_tile_lower(const T* __restrict src, T* __restrict dst,
+                            int64_t b0, int64_t lanes, int64_t m,
+                            int64_t stride) {
+#if GST_REG_XPOSE
+  for (int64_t r = 0; r < m; ++r)
+    xpose_load_run<T, W>(src, dst, b0, lanes, stride, r * m, r + 1);
+#else
+  load_tile_lower_mem<T, W>(src, dst, b0, lanes, m, stride);
+#endif
+}
+
+// Stores the lower triangle only — callers that need a dense L zero the
+// destination buffer up front (memset is far cheaper than transposing
+// W lanes of zeros through the strided window).
+template <typename T, int W>
+inline void store_tile_lower(const T* __restrict src, T* __restrict dst,
+                             int64_t b0, int64_t lanes, int64_t m,
+                             int64_t stride) {
+#if GST_REG_XPOSE
+  for (int64_t r = 0; r < m; ++r)
+    xpose_store_run<T, W>(src, dst, b0, lanes, stride, r * m, r + 1);
+#else
+  store_tile_lower_mem<T, W>(src, dst, b0, lanes, m, stride);
+#endif
 }
 
 // ---------------------------------------------------------------------
@@ -354,6 +571,179 @@ void solve_mat_batch(const T* L, const T* R, T* X, int64_t B, int64_t m,
     else
       fwd_mat_tile<T, W>(tile.get(), rtile.get(), m, k);
     store_tile<T, W>(rtile.get(), X, b0, lanes, m * k, m * k);
+  }
+}
+
+// factor_batch without the L output: the hyper-MH closure consumes only
+// (logdet, u) — XLA cannot dead-code an FFI result buffer, so the full
+// kernel paid a B*m*m memset plus the L store transpose per proposal
+// for a factor the accept/reject never reads. Measured at the flagship
+// shape the non-compute tile traffic was ~5/6 of the kernel wall time.
+template <typename T>
+void factor_quad_batch(const T* S, const T* rhs, T* logdet, T* u,
+                       int64_t B, int64_t m) {
+  constexpr int W = Lanes<T>::W;
+  Scratch<T> tile(size_t(m) * m * W), rtile(size_t(m) * W), ld(W);
+  for (int64_t b0 = 0; b0 < B; b0 += W) {
+    const int64_t lanes = std::min<int64_t>(W, B - b0);
+    load_tile_lower<T, W>(S, tile.get(), b0, lanes, m, m * m);
+    load_tile<T, W>(rhs, rtile.get(), b0, lanes, m, m);
+    chol_tile<T, W>(tile.get(), ld.get(), m);
+    fwd_tile<T, W>(tile.get(), rtile.get(), m);
+    store_tile<T, W>(rtile.get(), u, b0, lanes, m, m);
+    store_tile<T, W>(ld.get(), logdet, b0, lanes, 1, 1);
+  }
+}
+
+// Escalating-jitter factorization fused with the coefficient draw:
+// y = L^-T (L^-1 rhs + xi) for the first jitter level whose factor is
+// finite (else the last level) — the b-draw's robust_precond_cholesky
+// + backward_solve pair in ONE pass over the tile. The stacked-jitter
+// XLA form materializes nlev copies of S, factors all of them every
+// sweep, and pays isfinite scans + where-cascades over the stored L
+// buffers; here escalation beyond level 0 only runs when some lane in
+// the tile actually failed (measured: never, at the flagship shape).
+// Selection predicate matches the stacked path exactly: all lower-L
+// entries finite AND logdet finite, per lane.
+template <typename T>
+void robust_draw_batch(const T* S, const T* rhs, const T* xi,
+                       const T* jits, int64_t nlev, T* y, T* logdet,
+                       int64_t B, int64_t m) {
+  constexpr int W = Lanes<T>::W;
+  using V = typename VecOf<T, W>::type;
+  using MI = typename MaskInt<T>::type;
+  typedef MI IV __attribute__((vector_size(W * sizeof(T))));
+  Scratch<T> prist(size_t(m) * m * W), work(size_t(m) * m * W),
+      r0(size_t(m) * W), xt(size_t(m) * W), yt(size_t(m) * W), ld(W),
+      ysel(size_t(m) * W), ldsel(W);
+  const V vzero = {};
+  for (int64_t b0 = 0; b0 < B; b0 += W) {
+    const int64_t lanes = std::min<int64_t>(W, B - b0);
+    load_tile_lower<T, W>(S, prist.get(), b0, lanes, m, m * m);
+    load_tile<T, W>(rhs, r0.get(), b0, lanes, m, m);
+    load_tile<T, W>(xi, xt.get(), b0, lanes, m, m);
+    IV accepted = {};
+    for (int64_t lev = 0; lev < nlev; ++lev) {
+      std::memcpy(work.get(), prist.get(), size_t(m) * m * W * sizeof(T));
+      V* w = reinterpret_cast<V*>(work.get());
+      const V jv = splat<T, W>(jits[lev]);
+      for (int64_t j = 0; j < m; ++j) w[j * m + j] += jv;
+      chol_tile<T, W>(work.get(), ld.get(), m);
+      V* yv = reinterpret_cast<V*>(yt.get());
+      const V* xv = reinterpret_cast<const V*>(xt.get());
+      std::memcpy(yt.get(), r0.get(), size_t(m) * W * sizeof(T));
+      fwd_tile<T, W>(work.get(), yt.get(), m);   // yt = u = L^-1 rhs
+      for (int64_t i = 0; i < m; ++i) yv[i] += xv[i];
+      bwd_tile<T, W>(work.get(), yt.get(), m);   // yt = L^-T (u + xi)
+      // per-lane finiteness of the factor: x - x == 0 rejects NaN/inf
+      IV fin = (vzero == vzero);                 // all lanes true
+      for (int64_t j = 0; j < m; ++j)
+        for (int64_t i = j; i < m; ++i) {
+          const V v = w[i * m + j];
+          fin &= ((v - v) == vzero);
+        }
+      const V ldv = *reinterpret_cast<const V*>(ld.get());
+      fin &= ((ldv - ldv) == vzero);
+      IV take = ~accepted & ((lev == nlev - 1) ? ~IV{} : fin);
+      V* ys = reinterpret_cast<V*>(ysel.get());
+      for (int64_t i = 0; i < m; ++i) ys[i] = take ? yv[i] : ys[i];
+      V* lds = reinterpret_cast<V*>(ldsel.get());
+      lds[0] = take ? ldv : lds[0];
+      accepted |= (fin | take);
+      bool all_done = true;
+      for (int l = 0; l < W; ++l) all_done &= (accepted[l] != 0);
+      if (all_done) break;
+    }
+    store_tile<T, W>(ysel.get(), y, b0, lanes, m, m);
+    store_tile<T, W>(ldsel.get(), logdet, b0, lanes, 1, 1);
+  }
+}
+
+// Lane-batched weighted Gram reduction of the marginalized likelihood
+// (ops/tnt.py dense form): TNT = T^T diag(1/nvec) T, d = T^T (y/nvec),
+// const = -1/2 (sum log nvec + y^T y/nvec), with the basis T and
+// residuals y SHARED across the chain batch and only nvec per-chain —
+// the structure XLA's batched-matmul lowering cannot exploit (it
+// materializes the (B, n, m) weighted basis and loops B small
+// matmuls). Here the basis is transposed once, augmented with y as row
+// m, and every (i, j <= i) output scalar is a W-lane dot over the TOA
+// axis: one splat-FMA per TOA with the weight row L1-resident. The
+// log-sum uses the chol_tile chunked-double-product discipline.
+template <typename T>
+void tnt_batch(const T* Tm, const T* yv, const T* nvec, T* TNT, T* d,
+               T* cw, int64_t B, int64_t n, int64_t m) {
+  constexpr int W = Lanes<T>::W;
+  using V = typename VecOf<T, W>::type;
+  using D = typename VecOf<double, W>::type;
+  Scratch<T> Tt(size_t(m + 1) * n);
+  for (int64_t i = 0; i < m; ++i)
+    for (int64_t k = 0; k < n; ++k) Tt.get()[i * n + k] = Tm[k * m + i];
+  std::memcpy(Tt.get() + size_t(m) * n, yv, size_t(n) * sizeof(T));
+  Scratch<T> wt(size_t(n) * W), vi(size_t(n) * W),
+      row(size_t(m + 1) * W);
+  for (int64_t b0 = 0; b0 < B; b0 += W) {
+    const int64_t lanes = std::min<int64_t>(W, B - b0);
+    load_tile<T, W>(nvec, wt.get(), b0, lanes, n, n);
+    V* wv = reinterpret_cast<V*>(wt.get());
+    D lg = {};
+    D prod = splat<double, W>(1.0);
+    int since = 0;
+    const V one = splat<T, W>(T(1));
+    for (int64_t k = 0; k < n; ++k) {
+      const V nv = wv[k];
+      for (int l = 0; l < W; ++l) prod[l] *= double(nv[l]);
+      if (++since == 8 || k == n - 1) {
+        for (int l = 0; l < W; ++l) lg[l] += std::log(prod[l]);
+        prod = splat<double, W>(1.0);
+        since = 0;
+      }
+      wv[k] = one / nv;
+    }
+    V* viv = reinterpret_cast<V*>(vi.get());
+    V* rowv = reinterpret_cast<V*>(row.get());
+    for (int64_t i = 0; i <= m; ++i) {
+      const T* ti = Tt.get() + i * n;
+      for (int64_t k = 0; k < n; ++k) viv[k] = wv[k] * ti[k];
+      int64_t j = 0;
+      for (; j + 4 <= i + 1; j += 4) {
+        const T* t0 = Tt.get() + (j + 0) * n;
+        const T* t1 = Tt.get() + (j + 1) * n;
+        const T* t2 = Tt.get() + (j + 2) * n;
+        const T* t3 = Tt.get() + (j + 3) * n;
+        V s0 = {}, s1 = {}, s2 = {}, s3 = {};
+        for (int64_t k = 0; k < n; ++k) {
+          const V v = viv[k];
+          s0 += v * t0[k];
+          s1 += v * t1[k];
+          s2 += v * t2[k];
+          s3 += v * t3[k];
+        }
+        rowv[j] = s0;
+        rowv[j + 1] = s1;
+        rowv[j + 2] = s2;
+        rowv[j + 3] = s3;
+      }
+      for (; j <= i; ++j) {
+        const T* tj = Tt.get() + j * n;
+        V s = {};
+        for (int64_t k = 0; k < n; ++k) s += viv[k] * tj[k];
+        rowv[j] = s;
+      }
+      if (i < m) {
+        // row i of the symmetric output: contiguous per-lane store of
+        // the lower run, scalar mirror into the strided upper column
+        store_tile<T, W>(row.get(), TNT + i * m, b0, lanes, i + 1,
+                         m * m);
+        for (int64_t jj = 0; jj < i; ++jj)
+          for (int64_t l = 0; l < lanes; ++l)
+            TNT[(b0 + l) * m * m + jj * m + i] = row.get()[jj * W + l];
+      } else {
+        store_tile<T, W>(row.get(), d, b0, lanes, m, m);
+        for (int64_t l = 0; l < lanes; ++l)
+          cw[b0 + l] =
+              T(-0.5 * (lg[l] + double(row.get()[m * W + l])));
+      }
+    }
   }
 }
 

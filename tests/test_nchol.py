@@ -152,6 +152,102 @@ def test_nchol_nonpd_nan_propagation():
     assert not np.isfinite(np.asarray(ld0[0]))
 
 
+def test_nchol_factor_quad_bitwise_matches_factor():
+    """The no-L kernel is the same recurrence with the L store skipped:
+    logdet/u must be BITWISE identical to the full factor kernel's."""
+    _require_kernels()
+    S, r, _ = _spd(37, 21, dtype=np.float32)  # odd batch: pad-lane tile
+    L, ld0, u0 = jax.jit(nffi.nchol_factor)(S, r)
+    ld1, u1 = jax.jit(nffi.nchol_factor_quad)(S, r)
+    np.testing.assert_array_equal(np.asarray(ld1), np.asarray(ld0))
+    np.testing.assert_array_equal(np.asarray(u1), np.asarray(u0))
+
+
+def test_nchol_robust_draw_f64_parity():
+    """The fused escalating-jitter factor+draw vs the stacked
+    robust_precond_cholesky + backward_solve composition on identical
+    inputs at f64 1e-9 — including members that escalate past level 0
+    and a member no level can rescue (NaN propagates, others alone)."""
+    _require_kernels()
+    jax.config.update("jax_enable_x64", True)
+    try:
+        rng = np.random.default_rng(7)
+        C, m = 37, 21
+        A = rng.standard_normal((C, m, 12))
+        S = jnp.asarray(A @ np.swapaxes(A, -1, -2) + 5.0 * np.eye(m))
+        # chains 3 and 17: 2*ones - I has unit diagonal (so the
+        # equilibration is finite) but eigenvalues {2m-1, -1}: non-PD
+        # until the final jitter level (j > 1) — the full escalation
+        # cascade. chain 30: negative diagonal, hopeless at every level.
+        hard = jnp.asarray(2.0 * np.ones((m, m)) - np.eye(m))
+        S = (S.at[3].set(hard).at[17].set(hard)
+             .at[30].add(-1e6 * jnp.eye(m)))
+        r = jnp.asarray(rng.standard_normal((C, m)))
+        xi = jnp.asarray(rng.standard_normal((C, m)))
+        jitters = (0.0, 1e-4, 1e-2, 40.0)
+        L0, isd0, ld0, u0 = linalg.robust_precond_cholesky(
+            S, jitters=jitters, rhs=r)
+        y0 = linalg.backward_solve(L0, u0 + xi)
+        # force the dispatcher's native branch (batch 37 > floor)
+        y1, isd1, ld1 = jax.jit(lambda s, rr, x: linalg.robust_precond_draw(
+            s, rr, x, jitters=jitters))(S, r, xi)
+        ok = np.isfinite(np.asarray(y0)).all(axis=-1)
+        assert ok[3] and ok[17] and not ok[30]
+        np.testing.assert_allclose(np.asarray(y1)[ok], np.asarray(y0)[ok],
+                                   atol=1e-9)
+        np.testing.assert_allclose(np.asarray(ld1)[ok],
+                                   np.asarray(ld0)[ok], atol=1e-9)
+        np.testing.assert_allclose(isd1, isd0, atol=1e-12)
+        assert not np.isfinite(np.asarray(y1)[30]).all()
+    finally:
+        jax.config.update("jax_enable_x64", False)
+
+
+def test_nchol_tnt_f64_parity():
+    """The lane-batched Gram reduction vs the dense jnp expressions at
+    f64 1e-9, on odd batch/width shapes that exercise the pad-lane
+    tile and the overlapped transpose tails."""
+    _require_kernels()
+    jax.config.update("jax_enable_x64", True)
+    try:
+        rng = np.random.default_rng(11)
+        C, n, m = 37, 53, 19
+        T = jnp.asarray(rng.standard_normal((n, m)))
+        y = jnp.asarray(rng.standard_normal((n,)))
+        nvec = jnp.asarray(rng.uniform(0.5, 3.0, (C, n)))
+        TNT0, d0, c0 = jax.vmap(
+            lambda nv: linalg._tnt_gram_jnp(T, y, nv))(nvec)
+        TNT1, d1, c1 = jax.jit(nffi.tnt)(T, y, nvec)
+        np.testing.assert_allclose(TNT1, TNT0, atol=1e-9)
+        np.testing.assert_allclose(d1, d0, atol=1e-9)
+        np.testing.assert_allclose(c1, c0, atol=1e-9)
+        # full symmetric output (both triangles written)
+        np.testing.assert_array_equal(
+            np.asarray(TNT1), np.swapaxes(np.asarray(TNT1), -1, -2))
+    finally:
+        jax.config.update("jax_enable_x64", False)
+
+
+def test_nchol_tnt_nonfinite_propagation():
+    """A non-positive nvec entry poisons ITS chain's const (log of a
+    negative) while the other chains' outputs stay finite — the same
+    per-chain containment contract as the factor kernels."""
+    _require_kernels()
+    rng = np.random.default_rng(13)
+    C, n, m = 5, 40, 9
+    T = jnp.asarray(rng.standard_normal((n, m)), jnp.float32)
+    y = jnp.asarray(rng.standard_normal((n,)), jnp.float32)
+    nvec = np.asarray(rng.uniform(0.5, 2.0, (C, n)), np.float32)
+    nvec[2, 7] = -1.0
+    TNT, d, c = jax.jit(nffi.tnt)(T, y, jnp.asarray(nvec))
+    c = np.asarray(c)
+    assert not np.isfinite(c[2])
+    keep = np.asarray([0, 1, 3, 4])
+    assert np.isfinite(c[keep]).all()
+    assert np.isfinite(np.asarray(TNT)[keep]).all()
+    assert np.isfinite(np.asarray(d)[keep]).all()
+
+
 # ----------------------------------------------------------------------
 # gate validation + dispatch
 # ----------------------------------------------------------------------
@@ -189,7 +285,8 @@ def test_dispatch_prefers_nchol_on_cpu(monkeypatch):
     assert np.isfinite(np.asarray(q)).all()
     impls = {(rec["op"], rec["impl"])
              for rec in introspect.linalg_impls()}
-    assert ("factor", "nchol") in impls
+    # r08: quad/logdet callers dispatch to the no-L kernel
+    assert ("factor_quad", "nchol") in impls
 
 
 def test_dispatch_degrades_without_library(monkeypatch):
@@ -240,6 +337,77 @@ def test_masked_chisq_forced_native_matches_jnp(monkeypatch):
     monkeypatch.setenv("GST_NCHOL", "1")
     g_nat = linalg.masked_chisq(xs, cnt)
     np.testing.assert_allclose(g_nat, g_jnp, rtol=2e-6, atol=2e-6)
+
+
+def test_hyper_hoist_and_fast_beta_env_validation(monkeypatch, small_ma):
+    """GST_HYPER_HOIST / GST_FAST_BETA follow the strict auto|1|0
+    loud-typo contract of every GST_* gate, enforced at backend
+    construction."""
+    from gibbs_student_t_tpu.backends import JaxGibbs
+    from gibbs_student_t_tpu.backends.jax_backend import (
+        _fast_beta_env,
+        _hyper_hoist_env,
+    )
+
+    for var, fn in (("GST_HYPER_HOIST", _hyper_hoist_env),
+                    ("GST_FAST_BETA", _fast_beta_env)):
+        monkeypatch.delenv(var, raising=False)
+        assert fn() == "auto"
+        monkeypatch.setenv(var, "yes")
+        with pytest.raises(ValueError, match=var):
+            fn()
+        with pytest.raises(ValueError, match=var):
+            JaxGibbs(small_ma, GibbsConfig(model="mixture"), nchains=2)
+        for ok in ("auto", "1", "0"):
+            monkeypatch.setenv(var, ok)
+            JaxGibbs(small_ma, GibbsConfig(model="mixture"), nchains=2)
+        monkeypatch.delenv(var, raising=False)
+
+
+def test_fast_beta_requires_half_integer_counts(small_ma, monkeypatch):
+    """The chi-square Beta construction is exact only for half-integer
+    shapes: a prior whose doubled pseudo-counts are fractional must
+    keep random.beta even when the gate is forced on."""
+    from gibbs_student_t_tpu.backends import JaxGibbs
+
+    monkeypatch.setenv("GST_FAST_BETA", "1")
+    n = small_ma.n
+    # uniform prior: a = sz + 1 — always half-integer-exact
+    gb = JaxGibbs(small_ma, GibbsConfig(model="mixture",
+                                        theta_prior="uniform"), nchains=2)
+    assert gb._beta_pool == 2 * (n + 2)
+    # beta prior with fractional n * outlier_mean: must fall back
+    gb2 = JaxGibbs(small_ma,
+                   GibbsConfig(model="mixture", theta_prior="beta",
+                               outlier_mean=0.013), nchains=2)
+    assert gb2._beta_pool is None
+
+
+def test_fast_beta_distribution():
+    """The disjointly-masked chi-square construction IS Beta(a, b):
+    moment pin over many draws against the analytic mean/variance."""
+    import jax.numpy as jnp
+    from jax import random
+
+    from gibbs_student_t_tpu.ops.linalg import masked_chisq
+
+    a, b = 4.0, 14.0        # half-integer-exact (2a, 2b integers)
+    pool = int(2 * (a + b))
+
+    def draw(key):
+        xs = random.normal(key, (pool,), dtype=jnp.float32)
+        ga = masked_chisq(xs, jnp.float32(2.0 * a))
+        gb = masked_chisq(jnp.flip(xs, -1), jnp.float32(2.0 * b))
+        return ga / (ga + gb)
+
+    th = np.asarray(jax.jit(jax.vmap(draw))(
+        random.split(random.PRNGKey(0), 4000)))
+    mean = a / (a + b)
+    var = a * b / ((a + b) ** 2 * (a + b + 1.0))
+    # 4000 draws: se(mean) ~ sqrt(var/4000) ~ 1.6e-3; pin at ~4 sigma
+    assert abs(th.mean() - mean) < 7e-3
+    assert abs(th.var() - var) < var * 0.15
+    assert ((th > 0) & (th < 1)).all()
 
 
 # ----------------------------------------------------------------------
@@ -307,6 +475,104 @@ def test_nchol_backend_deterministic(arm_runs, small_ma):
     np.testing.assert_array_equal(r1.chain, r2.chain)
     np.testing.assert_array_equal(r1.bchain, r2.bchain)
     np.testing.assert_array_equal(r1.alphachain, r2.alphachain)
+
+
+# ----------------------------------------------------------------------
+# GST_HYPER_HOIST arms: bit-identical on/off + per-arm determinism
+# ----------------------------------------------------------------------
+
+_HOIST_ARMS = {
+    "hoist_off": {"GST_HYPER_HOIST": "0"},
+    "hoist_on": {"GST_HYPER_HOIST": "1"},
+}
+
+
+@pytest.fixture(scope="module")
+def hoist_arm_runs(small_ma):
+    """{arm: (backend, ChainResult)} — 24 sweeps, 4 chains, seed 5,
+    everything else at defaults (the arm-shared-backend pattern that
+    keeps the marker inside tier-1's budget)."""
+    from gibbs_student_t_tpu.backends import JaxGibbs
+
+    saved = os.environ.get("GST_HYPER_HOIST")
+    out = {}
+    try:
+        for arm, env in _HOIST_ARMS.items():
+            os.environ.update(env)
+            gb = JaxGibbs(small_ma,
+                          GibbsConfig(model="mixture",
+                                      theta_prior="beta"),
+                          nchains=4, chunk_size=6)
+            out[arm] = (gb, gb.sample(niter=24, seed=5))
+    finally:
+        if saved is None:
+            os.environ.pop("GST_HYPER_HOIST", None)
+        else:
+            os.environ["GST_HYPER_HOIST"] = saved
+    return out
+
+
+def test_hyper_hoist_chains_bit_identical(hoist_arm_runs):
+    """The hoist is a pure restructuring — same floats, same
+    association order — so on/off chains must agree BITWISE, not just
+    track: any reassociation sneaking into the hoisted likelihood
+    (or its factor dispatch) fails this immediately."""
+    _, r0 = hoist_arm_runs["hoist_off"]
+    _, r1 = hoist_arm_runs["hoist_on"]
+    np.testing.assert_array_equal(r1.chain, r0.chain)
+    np.testing.assert_array_equal(r1.bchain, r0.bchain)
+    np.testing.assert_array_equal(r1.alphachain, r0.alphachain)
+    np.testing.assert_array_equal(r1.thetachain, r0.thetachain)
+
+
+def test_hyper_hoist_deterministic(hoist_arm_runs):
+    """Same seed, same gate -> bit-identical chains on rerun, for each
+    arm (the test_nchol_backend_deterministic contract extended to the
+    hoist gate)."""
+    for arm in _HOIST_ARMS:
+        gb, r1 = hoist_arm_runs[arm]
+        r2 = gb.sample(niter=24, seed=5)
+        np.testing.assert_array_equal(r1.chain, r2.chain)
+        np.testing.assert_array_equal(r1.thetachain, r2.thetachain)
+
+
+def test_robust_draw_and_tnt_degrade_without_library(monkeypatch):
+    """Graceful-degradation extended to the round-8 entry points: with
+    the library unreachable and GST_NCHOL forced on, the b-draw's
+    fused robust path and the TNT Gram dispatch must fall back to the
+    portable compositions and reproduce their numbers exactly."""
+    from gibbs_student_t_tpu import native as native_mod
+    from gibbs_student_t_tpu.native import ffi as nffi_mod
+    from gibbs_student_t_tpu.ops.tnt import tnt_products
+
+    rng = np.random.default_rng(3)
+    C, m, n = 24, 11, 31
+    A = rng.standard_normal((C, m, 6))
+    S = jnp.asarray(A @ np.swapaxes(A, -1, -2) + 4.0 * np.eye(m),
+                    jnp.float32)
+    r = jnp.asarray(rng.standard_normal((C, m)), jnp.float32)
+    xi = jnp.asarray(rng.standard_normal((C, m)), jnp.float32)
+    T = jnp.asarray(rng.standard_normal((n, m)), jnp.float32)
+    y = jnp.asarray(rng.standard_normal((n,)), jnp.float32)
+    nvec = jnp.asarray(rng.uniform(0.5, 2.0, (C, n)), jnp.float32)
+
+    monkeypatch.setenv("GST_NCHOL", "0")
+    y_off = jax.jit(lambda: linalg.robust_precond_draw(S, r, xi))()[0]
+    tnt_off = jax.jit(jax.vmap(lambda nv: tnt_products(T, y, nv)))(nvec)
+
+    monkeypatch.setattr(native_mod, "load", lambda build=False: None)
+    nffi_mod._reset_for_tests()
+    try:
+        monkeypatch.setenv("GST_NCHOL", "1")  # forced AND unavailable
+        assert not nffi_mod.ready()
+        y_f = jax.jit(lambda: linalg.robust_precond_draw(S, r, xi))()[0]
+        tnt_f = jax.jit(jax.vmap(lambda nv: tnt_products(T, y, nv)))(nvec)
+        np.testing.assert_array_equal(y_f, y_off)
+        for a, b in zip(tnt_f, tnt_off):
+            np.testing.assert_array_equal(a, b)
+    finally:
+        monkeypatch.undo()
+        nffi_mod._reset_for_tests()
 
 
 # ----------------------------------------------------------------------

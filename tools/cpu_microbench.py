@@ -3,13 +3,15 @@
 ``tools/tpu_microbench.py``.
 
 Measures (a) the isolated batched factorization/solve primitives the
-GST_VCHOL gate chooses between, (b) the ``random.gamma`` rejection
+GST_VCHOL gate chooses between (plus the round-8 no-L factor_quad and
+fused robust_draw kernels), (b) the ``random.gamma`` rejection
 sampler vs the exact chi-square construction behind GST_FAST_GAMMA,
-and (c) the in-sweep ``hyper_and_draws`` stage across the
-GST_VCHOL x GST_BDRAW_REUSE arms (fast-gamma rides the same
-construction-time snapshot) — the A/B evidence behind the ``auto``
+(c) the tile transposes in isolation (``transpose_{mem,reg}``) and
+the dense TNT reduction A/B (``tnt_{jnp,nchol}``), and (d) the
+in-sweep ``hyper_and_draws`` stage across the gate arms including
+``hyper_hoist_{on,off}`` — the A/B evidence behind the ``auto``
 resolutions in ops/linalg.py and backends/jax_backend.py. Writes a
-JSON artifact (``artifacts/cpu_microbench_r06.json`` for the round-6
+JSON artifact (``artifacts/cpu_microbench_r08.json`` for the round-8
 record) so the gate decision is reproducible.
 
 The GST_* flags are read at TRACE time, so each in-sweep arm
@@ -32,7 +34,7 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(_HERE))  # repo root for the package
 
 _ARM_FLAGS = ("GST_VCHOL", "GST_BDRAW_REUSE", "GST_FAST_GAMMA",
-              "GST_NCHOL")
+              "GST_NCHOL", "GST_HYPER_HOIST", "GST_FAST_BETA")
 
 
 def bench(fn, *args, reps=5):
@@ -102,6 +104,12 @@ def main():
         if have_nchol:
             cases[f"factor_nchol({C},{m})"] = (
                 jax.jit(nffi.nchol_factor), (S, r))
+            cases[f"factor_quad_nchol({C},{m})"] = (
+                jax.jit(nffi.nchol_factor_quad), (S, r))
+            jits = jnp.asarray([1e-6, 1e-4, 1e-2, 1e-1], jnp.float32)
+            xi = jnp.asarray(rng.standard_normal((C, m)), jnp.float32)
+            cases[f"robust_draw_nchol({C},{m})"] = (
+                jax.jit(nffi.nchol_robust_draw), (S, r, xi, jits))
             cases[f"bwd_nchol({C},{m})"] = (jax.jit(nffi.bwd_vec), (L, r))
         else:
             print("# nchol kernels unavailable "
@@ -110,6 +118,65 @@ def main():
             ms = bench(fn, *a, reps=reps)
             results[name] = round(ms, 3)
             print(f"{name:28s} {ms:8.2f} ms")
+
+    # the tile transposes in isolation: scalar chunked (mem) vs the
+    # in-register shuffle network (reg) — one full lower-triangle
+    # load+store round trip per chain tile, via the plain-C bench
+    # entries (no XLA call frame, so the delta is pure transpose)
+    try:
+        import ctypes
+
+        from gibbs_student_t_tpu import native as native_mod
+
+        lib = native_mod.load()
+        lib.gst_bench_transpose_mem  # AttributeError -> too old
+        B, mt = C, 60
+        src = np.ascontiguousarray(
+            rng.standard_normal((B, mt, mt)), dtype=np.float32)
+        dst = np.zeros_like(src)
+        pf = ctypes.POINTER(ctypes.c_float)
+
+        def c_bench(fn):
+            fn(src.ctypes.data_as(pf), dst.ctypes.data_as(pf),
+               ctypes.c_longlong(B), ctypes.c_longlong(mt))
+            t0 = time.perf_counter()
+            for _ in range(max(reps, 10)):
+                fn(src.ctypes.data_as(pf), dst.ctypes.data_as(pf),
+                   ctypes.c_longlong(B), ctypes.c_longlong(mt))
+            return (time.perf_counter() - t0) / max(reps, 10) * 1e3
+
+        for arm in ("mem", "reg"):
+            ms = c_bench(getattr(lib, f"gst_bench_transpose_{arm}"))
+            name = f"transpose_{arm}({B},{mt})"
+            results[name] = round(ms, 3)
+            print(f"{name:28s} {ms:8.2f} ms")
+    except (OSError, AttributeError) as e:
+        print(f"# transpose bench entries unavailable ({e}); "
+              "arms skipped", file=sys.stderr)
+
+    # the dense TNT reduction: XLA's batched-matmul lowering vs the
+    # native lane-batched Gram kernel (shared basis, per-chain nvec)
+    n_tnt, m_tnt = 130, 74
+    T_tnt = jnp.asarray(rng.standard_normal((n_tnt, m_tnt)), jnp.float32)
+    y_tnt = jnp.asarray(rng.standard_normal((n_tnt,)), jnp.float32)
+    nv_tnt = jnp.asarray(rng.uniform(0.5, 3.0, (C, n_tnt)), jnp.float32)
+
+    def tnt_dense(nv):
+        from gibbs_student_t_tpu.ops.linalg import _tnt_gram_jnp
+
+        return _tnt_gram_jnp(T_tnt, y_tnt, nv)
+
+    tnt_jnp_j = jax.jit(jax.vmap(tnt_dense))  # jit ONCE (chisq-arm rule)
+    tnt_cases = [(f"tnt_jnp({C},{n_tnt},{m_tnt})",
+                  lambda nv: tnt_jnp_j(nv))]
+    if have_nchol:
+        tnt_nat_j = jax.jit(lambda nv: nffi.tnt(T_tnt, y_tnt, nv))
+        tnt_cases.append((f"tnt_nchol({C},{n_tnt},{m_tnt})",
+                          lambda nv: tnt_nat_j(nv)))
+    for name, fn in tnt_cases:
+        ms = bench(fn, nv_tnt, reps=reps)
+        results[name] = round(ms, 3)
+        print(f"{name:28s} {ms:8.2f} ms")
 
     # the alpha update's gamma draw: rejection sampler vs exact
     # chi-square construction (Gamma(k/2) = 0.5 * chi^2_k)
@@ -167,6 +234,11 @@ def main():
             # the round-6 production path (nchol off, everything else
             # auto) vs the round-7 default (nchol rides auto when built)
             ("nchol_off", {"GST_NCHOL": "0"}),
+            # round 8: the hyper-MH hoist A/B on top of the full native
+            # path (bit-identical chains, different op graph), plus the
+            # all-auto default
+            ("hyper_hoist_off", {"GST_HYPER_HOIST": "0"}),
+            ("hyper_hoist_on", {"GST_HYPER_HOIST": "1"}),
             ("auto_defaults", {}),
         ]
         for arm, env in arms:
@@ -206,6 +278,11 @@ def main():
         if r6 and new:
             results["nchol_speedup"] = round(r6 / new, 2)
             print(f"nchol speedup over the r06 path: {r6 / new:.2f}x")
+        hoff = results.get("sweep_hyper_and_draws[hyper_hoist_off]")
+        hon = results.get("sweep_hyper_and_draws[hyper_hoist_on]")
+        if hoff and hon:
+            results["hyper_hoist_speedup"] = round(hoff / hon, 2)
+            print(f"hyper hoist speedup: {hoff / hon:.2f}x")
 
     if args.out:
         with open(args.out, "w") as fh:

@@ -142,8 +142,14 @@ def print_report(ledger_recs, include_rounds=True):
                   f"peak={'?' if peak is None else f'{peak / 1e6:.0f}MB':>7} "
                   f"cfg={rec.get('config_fingerprint')} "
                   f"sha={str(rec.get('git_sha'))[:8]}")
-            for name, sv in sorted(_stages_of(rec).items()):
-                print(f"    stage {name:20s} {sv * 1e3:10.1f} ms")
+            stages = _stages_of(rec)
+            total = sum(stages.values())
+            for name, sv in sorted(stages.items()):
+                # share of the timed stages: which stage dominates the
+                # sweep is readable at a glance, not by mental division
+                share = f"{sv / total * 100.0:5.1f}%" if total else "    ?"
+                print(f"    stage {name:20s} {sv * 1e3:10.1f} ms "
+                      f"({share} of timed stages)")
         else:
             brief = {k: v for k, v in m.items()
                      if isinstance(v, (int, float, bool, str))}
@@ -248,12 +254,17 @@ def check_latest(ledger_recs, max_drop, max_compile_growth,
     for name in sorted(set(bst) - set(st)):
         print(f"check: stage[{name}] present in baseline but missing "
               f"from latest — renamed or dropped?")
+    total_latest = sum(st.values())
     for name in shared:
         growth = (st[name] - bst[name]) / bst[name] * 100.0
+        share = (f", {st[name] / total_latest * 100.0:.1f}% of sweep"
+                 if total_latest else "")
         print(f"check: stage[{name}] {bst[name] * 1e3:.1f}ms -> "
-              f"{st[name] * 1e3:.1f}ms ({growth:+.1f}%, limit "
+              f"{st[name] * 1e3:.1f}ms ({growth:+.1f}%{share}, limit "
               f"{max_stage_growth}%)")
         if growth > max_stage_growth:
+            # the tripping stage is NAMED here and again in the FAIL
+            # summary line, so a red gate needs no log spelunking
             failures.append(f"stage {name} slowed {growth:.1f}% "
                             f"(> {max_stage_growth}%)")
 
