@@ -29,6 +29,12 @@ from typing import Optional
 
 import numpy as np
 
+#: Kernel-family ABI version this module was written against. The
+#: committed library exports ``gst_abi_version()``; a mismatch (or a
+#: pre-versioning library) degrades at probe time with a clear reason
+#: string instead of miscalling a handler whose signature moved.
+ABI_VERSION = 2
+
 #: FFI target name -> exported C symbol. Names are versioned with a
 #: ``gst_`` prefix so they cannot collide with XLA's own cpu targets.
 TARGETS = {
@@ -50,6 +56,18 @@ TARGETS = {
     "gst_chisq_f64": "GstChisqF64",
     "gst_tnt_f32": "GstTntF32",
     "gst_tnt_f64": "GstTntF64",
+    "gst_gamma_v2_f32": "GstGammaV2F32",
+    "gst_gamma_v2_f64": "GstGammaV2F64",
+    "gst_beta_frac_f32": "GstBetaFracF32",
+    "gst_beta_frac_f64": "GstBetaFracF64",
+    "gst_white_mh_f32": "GstWhiteMhF32",
+    "gst_white_mh_f64": "GstWhiteMhF64",
+    "gst_hyper_mh_f32": "GstHyperMhF32",
+    "gst_hyper_mh_f64": "GstHyperMhF64",
+    "gst_schur_f32": "GstSchurF32",
+    "gst_schur_f64": "GstSchurF64",
+    "gst_fused_hyper_f32": "GstFusedHyperF32",
+    "gst_fused_hyper_f64": "GstFusedHyperF64",
 }
 
 # None = not yet probed; True/False = latched verdict for the process.
@@ -107,6 +125,17 @@ def _probe() -> bool:
         _WHY = f"library built for {level}, host lacks it"
         return False
     try:
+        lib.gst_abi_version.restype = ctypes.c_int
+        abi = int(lib.gst_abi_version())
+    except AttributeError:
+        _WHY = (f"library predates gst_abi_version (ABI {ABI_VERSION} "
+                "expected; rebuild: make -C native)")
+        return False
+    if abi != ABI_VERSION:
+        _WHY = (f"library ABI v{abi} != expected v{ABI_VERSION} — "
+                "kernel signatures moved; rebuild: make -C native")
+        return False
+    try:
         jffi = _ffi_module()
     except ImportError:
         _WHY = "installed jax has no FFI API"
@@ -157,14 +186,18 @@ def supported_dtype(dtype) -> bool:
     return np.dtype(dtype) in _SFX
 
 
-def _call(base: str, out_shapes, *args):
+def _call(base: str, out_shapes, *args, dtype=None):
+    """``dtype`` overrides the output dtype / target suffix (needed by
+    the draw kernels, whose first operand is the uint32 key buffer)."""
     import jax
 
     jffi = _ffi_module()
-    sfx = _SFX[np.dtype(args[0].dtype)]
+    if dtype is None:
+        dtype = args[0].dtype
+    sfx = _SFX[np.dtype(dtype)]
     fn = jffi.ffi_call(
         f"{base}_{sfx}",
-        [jax.ShapeDtypeStruct(s, args[0].dtype) for s in out_shapes])
+        [jax.ShapeDtypeStruct(s, dtype) for s in out_shapes])
     out = fn(*args)
     return out
 
@@ -228,3 +261,98 @@ def chisq(xs, counts):
     (``xs (..., kmax)``, ``counts (...)`` same dtype)."""
     (out,) = _call("gst_chisq", (counts.shape,), xs, counts)
     return out
+
+
+def gamma_v2(keys, counts, jmax: int):
+    """``Gamma(k/2)`` draws for integer ``k = counts`` (float-encoded)
+    as ``-log prod U + odd * 0.5 * N^2`` with in-kernel philox
+    randomness: ``keys (B, 2)`` uint32 key words per chain, ``counts
+    (B, n)``, one draw per element. ``jmax`` is the static uniform-pool
+    half-width (``kmax // 2``); streams are pinned against the jnp twin
+    in ops/rng.py."""
+    import jax.numpy as jnp
+
+    meta = jnp.asarray([jmax], jnp.int32)
+    (out,) = _call("gst_gamma_v2", (counts.shape,), keys, counts, meta,
+                   dtype=counts.dtype)
+    return out
+
+
+def beta_frac(keys, a, b):
+    """``Beta(a, b)`` draws for per-chain fractional shapes via two
+    in-kernel Marsaglia-Tsang gammas (``keys (B, 2)`` uint32,
+    ``a/b (B,)``)."""
+    (out,) = _call("gst_beta_frac", (a.shape,), keys, a, b,
+                   dtype=a.dtype)
+    return out
+
+
+def white_mh(x, az, yred2, dx, logu, rows, specs, var):
+    """The whole white-noise MH block as one custom call — the native
+    arm of ops/pallas_white.make_white_block (XLA oracle
+    ``white_mh_loop_xla``). ``rows (R, n)`` / ``specs (3, p)`` shared
+    across the chain batch; ``var`` the static (kind, x_index,
+    row_slot) int32 table."""
+    import jax.numpy as jnp
+
+    var_arr = jnp.asarray(np.asarray(var, np.int32).reshape(-1, 3))
+    xo, acc = _call("gst_white_mh", (x.shape, x.shape[:-1]), x, az,
+                    yred2, dx, logu, rows, specs, var_arr)
+    return xo, acc
+
+
+def hyper_mh(x, S0, dS0, rt, base, dx, logu, K, sel, specs, hyp_idx,
+             jitter):
+    """The whole hyper MH block as one custom call — the native arm of
+    ops/pallas_hyper.make_hyper_block (XLA oracle
+    ``hyper_mh_loop_xla``): per-proposal affine-phi evaluation,
+    equilibrated no-L Cholesky with fused forward solve, prior and
+    masked accept, all in-kernel with S0 tile-resident."""
+    import jax.numpy as jnp
+
+    idx = jnp.asarray(np.asarray(hyp_idx, np.int32))
+    jit_arr = jnp.asarray([jitter], x.dtype)
+    xo, acc = _call("gst_hyper_mh", (x.shape, x.shape[:-1]), x, S0,
+                    dS0, rt, base, dx, logu, K, sel, specs, idx,
+                    jit_arr)
+    return xo, acc
+
+
+def schur(A, Bm, C, rhs_s, rhs_v, jitter):
+    """Fused Schur pre-elimination (ops/linalg.py ``schur_eliminate``
+    with ``return_factor=True``): equilibrated A-block factor, the
+    multi-rhs solves and the S0/rt assembly matmuls in one custom
+    call. Returns ``(S0, rt, quad_s, logdetA, La, isd_a, U_B, u_s)``."""
+    import jax.numpy as jnp
+
+    ns = A.shape[-1]
+    nv = C.shape[-1]
+    batch = A.shape[:-2]
+    jit_arr = jnp.asarray([jitter], A.dtype)
+    return tuple(_call("gst_schur",
+                       (batch + (nv, nv), batch + (nv,), batch, batch,
+                        batch + (ns, ns), batch + (ns,),
+                        batch + (ns, nv), batch + (ns,)),
+                       A, Bm, C, rhs_s, rhs_v, jit_arr))
+
+
+def fused_hyper(A, Bm, C, rhs_s, rhs_v, x, dx, logu, xi, base0, K, sel,
+                phist, specs, hyp_idx, jitter, jitters):
+    """GST_FUSE_STAGES megastage: Schur pre-elimination + the whole
+    hyper MH block + the b-draw's robust v-block factorization and
+    block-assembled backward solves as ONE custom call. Returns
+    ``(x, acc, y_v, isd_v, y_s, isd_a)`` — the caller scatters
+    ``b[s] = y_s * isd_a``, ``b[v] = y_v * isd_v``."""
+    import jax.numpy as jnp
+
+    ns = A.shape[-1]
+    nv = C.shape[-1]
+    batch = A.shape[:-2]
+    idx = jnp.asarray(np.asarray(hyp_idx, np.int32))
+    jit_arr = jnp.asarray([jitter], x.dtype)
+    jits = jnp.asarray(np.asarray(jitters, np.float64), x.dtype)
+    return tuple(_call("gst_fused_hyper",
+                       (x.shape, batch, batch + (nv,), batch + (nv,),
+                        batch + (ns,), batch + (ns,)),
+                       A, Bm, C, rhs_s, rhs_v, x, dx, logu, xi, base0,
+                       K, sel, phist, specs, idx, jit_arr, jits))

@@ -119,6 +119,23 @@ def memory_analysis_of(compiled) -> Optional[Dict[str, int]]:
     return out or None
 
 
+def custom_call_count_of(compiled) -> Optional[int]:
+    """Number of custom-call instructions in the compiled program's
+    optimized HLO — the per-execution dispatch count of everything
+    that leaves XLA's own fusion world (FFI kernels, LAPACK, Pallas).
+    This is the metric the stage-fusion work (GST_FUSE_STAGES) moves:
+    collapsing N per-stage custom calls into one multi-stage dispatch
+    shows up here even when wall time hides it. None when the
+    installed jax cannot render the program text."""
+    try:
+        txt = compiled.as_text()
+    except Exception:  # noqa: BLE001 - version drift means unavailable
+        return None
+    if not isinstance(txt, str):
+        return None
+    return txt.count("custom-call(")
+
+
 def analyze_compiled(compiled, label: str = "",
                      lower_s: float = 0.0,
                      compile_s: float = 0.0) -> Dict[str, Any]:
@@ -136,6 +153,7 @@ def analyze_compiled(compiled, label: str = "",
         "flops": None,
         "bytes_accessed": None,
         "peak_bytes": None,
+        "custom_calls": custom_call_count_of(compiled),
     }
     missing = []
     if cost is not None:
@@ -383,6 +401,9 @@ def compile_summary() -> Dict[str, Any]:
         "flops": agg("flops", sum),
         "bytes_accessed": agg("bytes_accessed", sum),
         "peak_bytes": agg("peak_bytes", max),
+        # dispatch count of the LARGEST program (the chunk sweep — the
+        # one whose per-sweep custom-call count the fusion work gates)
+        "custom_calls": agg("custom_calls", max),
         "programs": recs,
         "pallas_kernels": kernel_builds(),
         "linalg_impls": linalg_impls(),
@@ -398,10 +419,13 @@ def format_summary(prefix: str = "# ") -> List[str]:
                  else f"{r['flops']:.3g}")
         peak = ("?" if r.get("peak_bytes") is None
                 else f"{r['peak_bytes'] / 1e6:.1f}MB")
+        ncc = ("?" if r.get("custom_calls") is None
+               else str(r["custom_calls"]))
         lines.append(
             f"{prefix}compile[{r['label']}] platform={r.get('platform')} "
             f"lower={r['lower_s']:.2f}s compile={r['compile_s']:.2f}s "
-            f"flops={flops} peak={peak} ({r['analysis']})")
+            f"flops={flops} peak={peak} custom_calls={ncc} "
+            f"({r['analysis']})")
     kern = kernel_builds()
     if kern:
         names = ", ".join(sorted({k["kernel"] for k in kern}))

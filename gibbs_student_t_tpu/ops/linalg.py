@@ -165,6 +165,89 @@ def _nchol_mode():
     return True, env == "1"
 
 
+def nwhite_env() -> str:
+    """Validated ``GST_NWHITE`` (``auto`` when unset) — the native
+    white-MH block arm. Strict ``auto|1|0`` (the loud-typo contract);
+    a well-formed ``1`` on a host without the library degrades
+    silently to the XLA loop, which IS the CPU production path, so the
+    graph is unchanged."""
+    env = os.environ.get("GST_NWHITE")
+    if env is not None and env not in ("auto", "1", "0"):
+        raise ValueError(
+            f"GST_NWHITE must be 'auto', '1' or '0', got {env!r}")
+    return env if env is not None else "auto"
+
+
+def _nwhite_mode():
+    """``(enabled, forced)`` for the native white-MH arm — CPU custom
+    call, same trace-time snapshot semantics as ``GST_NCHOL``."""
+    env = nwhite_env()
+    if env == "0":
+        return False, False
+    if jax.default_backend() != "cpu" or not _nchol_ready():
+        return False, False
+    return True, env == "1"
+
+
+def nwhite_take(shape, dtype, p: int, nvar: int) -> bool:
+    """Trace-time: should the white-MH dispatch choose the native
+    kernel for this call? Caps mirror the handler's validation so a
+    shape it would reject is never dispatched."""
+    enabled, forced = _nwhite_mode()
+    if not enabled:
+        return False
+    batch = int(np.prod(shape[:-1])) if len(shape) > 1 else 1
+    return (dtype in (jnp.float32, jnp.float64) and p <= 64
+            and nvar <= 16 and (forced or batch >= _PALLAS_MIN_BATCH))
+
+
+def nhyper_env() -> str:
+    """Validated ``GST_NHYPER`` (``auto`` when unset) — the native
+    fused hyper-MH block arm (one custom call for the whole 10-step
+    block, S0 tile-resident across proposals). Strict ``auto|1|0``."""
+    env = os.environ.get("GST_NHYPER")
+    if env is not None and env not in ("auto", "1", "0"):
+        raise ValueError(
+            f"GST_NHYPER must be 'auto', '1' or '0', got {env!r}")
+    return env if env is not None else "auto"
+
+
+def _nhyper_mode():
+    """``(enabled, forced)`` for the native hyper-MH arm."""
+    env = nhyper_env()
+    if env == "0":
+        return False, False
+    if jax.default_backend() != "cpu" or not _nchol_ready():
+        return False, False
+    return True, env == "1"
+
+
+def nhyper_take(shape, dtype, p: int, v: int, nk: int) -> bool:
+    """Trace-time guard for the native hyper-MH dispatch."""
+    enabled, forced = _nhyper_mode()
+    if not enabled:
+        return False
+    batch = int(np.prod(shape[:-1])) if len(shape) > 1 else 1
+    return (dtype in (jnp.float32, jnp.float64) and p <= 64
+            and nk <= 16 and v <= MAX_VCHOL_DIM
+            and (forced or batch >= _PALLAS_MIN_BATCH))
+
+
+def fuse_stages_env() -> str:
+    """Validated ``GST_FUSE_STAGES`` (``auto`` when unset) — the
+    hyper+draws megastage: Schur pre-elimination, the whole hyper MH
+    block and the coefficient draw's robust factorization + assembled
+    solves as ONE multi-stage FFI dispatch. Strict ``auto|1|0``;
+    ``auto`` resolves at backend construction (CPU + library + Schur +
+    b-draw reuse + fusable model structure); anything missing keeps the
+    per-stage graph, byte-identically with every gate off."""
+    env = os.environ.get("GST_FUSE_STAGES")
+    if env is not None and env not in ("auto", "1", "0"):
+        raise ValueError(
+            f"GST_FUSE_STAGES must be 'auto', '1' or '0', got {env!r}")
+    return env if env is not None else "auto"
+
+
 def nchol_active() -> bool:
     """Trace-time: could the native kernel family be dispatched at all
     on this platform? Callers that must keep their gates-off graph
@@ -575,7 +658,29 @@ def schur_eliminate(Sigma_ss, Sigma_sv, Sigma_vv, rhs_s, rhs_v,
 
     with ``Dd = blockdiag(D_a, D_v)`` and ``W = D_v^-1/2 B^T D_a^-1/2
     La^-T = (U_B * D_v^-1/2)^T`` — no full m x m refactorization.
+
+    On the native path (``GST_NCHOL``, return_factor calls) the whole
+    elimination — equilibrated A factor, multi-rhs solves, and the
+    S0/rt assembly matmuls XLA lowers as B small per-chain matmuls —
+    is ONE fused custom call (``gst_schur``); with the gate off this
+    composition is emitted verbatim.
     """
+    if return_factor and nchol_active():
+        S0, rt, quad_s, logdetA, La, isd_a, U_B, u_s = _schur_dispatcher(
+            float(jitter))(Sigma_ss, Sigma_sv, Sigma_vv, rhs_s, rhs_v)
+        return S0, rt, quad_s, logdetA, (La, isd_a, U_B, u_s)
+    S0, rt, quad_s, logdetA, La, isd_a, U_B, u_s = _schur_jnp(
+        Sigma_ss, Sigma_sv, Sigma_vv, rhs_s, rhs_v, jitter)
+    out = (S0, rt, quad_s, logdetA)
+    if return_factor:
+        out = out + ((La, isd_a, U_B, u_s),)
+    return out
+
+
+def _schur_jnp(Sigma_ss, Sigma_sv, Sigma_vv, rhs_s, rhs_v, jitter):
+    """The pre-dispatch :func:`schur_eliminate` composition, flat
+    8-tuple — the gates-off graph (emitted verbatim) and the native
+    schur kernel's parity oracle / degradation target."""
     La, isd_a, logdetA = precond_cholesky(Sigma_ss, jitter)
     rhsM = jnp.concatenate([Sigma_sv, rhs_s[..., :, None]], axis=-1)
     u = _fwd_mat_fused(La, rhsM * isd_a[..., :, None])
@@ -588,10 +693,36 @@ def schur_eliminate(Sigma_ss, Sigma_sv, Sigma_vv, rhs_s, rhs_v,
     hi = jax.lax.Precision.HIGHEST
     S0 = Sigma_vv - jnp.matmul(mT, w[..., :, :-1], precision=hi)
     rt = rhs_v - jnp.matmul(mT, Ainv_rs[..., None], precision=hi)[..., 0]
-    out = (S0, rt, quad_s, logdetA)
-    if return_factor:
-        out = out + ((La, isd_a, u[..., :, :-1], u[..., :, -1]),)
-    return out
+    return (S0, rt, quad_s, logdetA, La, isd_a, u[..., :, :-1],
+            u[..., :, -1])
+
+
+@functools.lru_cache(maxsize=None)
+def _schur_dispatcher(jitter: float):
+    """Per-jitter ``custom_vmap`` dispatcher behind the native
+    :func:`schur_eliminate` arm (jitter is trace-static)."""
+
+    @custom_vmap
+    def sd(A, Bm, C, rs, rv):
+        n_on, n_forced = _nchol_mode()
+        if (n_on and A.ndim >= 3
+                and _nchol_ok(A.shape, A.dtype, n_forced)
+                and C.shape[-1] <= MAX_VCHOL_DIM):
+            from gibbs_student_t_tpu.native import ffi as nffi
+
+            _note_impl("schur", "nchol", A.shape)
+            return nffi.schur(A, Bm, C, rs, rv, jitter)
+        _note_impl("schur", "jnp", A.shape)
+        return _schur_jnp(A, Bm, C, rs, rv, jitter)
+
+    @sd.def_vmap
+    def _sd_vmap(axis_size, in_batched, *args):
+        args = tuple(
+            a if b else jnp.broadcast_to(a, (axis_size,) + a.shape)
+            for a, b in zip(args, in_batched))
+        return sd(*args), (True,) * 8
+
+    return sd
 
 
 @functools.lru_cache(maxsize=None)
@@ -765,6 +896,197 @@ def _masked_chisq_vmap(axis_size, in_batched, xs, counts):
     if not in_batched[1]:
         counts = jnp.broadcast_to(counts, (axis_size,) + counts.shape)
     return masked_chisq(xs, counts), True
+
+
+def _native_draws_ok() -> bool:
+    """Trace-time availability of the native draw kernels (CPU custom
+    calls): platform + library probe. The WHETHER of a draw arm
+    (gamma v2, fractional theta) is the backend's gate; this only
+    selects native-vs-jnp-twin for an already-chosen arm — both
+    compute the same distribution."""
+    return jax.default_backend() == "cpu" and _nchol_ready()
+
+
+@functools.lru_cache(maxsize=None)
+def _gamma_v2_dispatcher(jmax: int):
+    """Per-pool-width dispatcher behind :func:`masked_gamma_v2`."""
+
+    @custom_vmap
+    def gd(keys, counts):
+        batch = int(np.prod(counts.shape[:-1])) if counts.ndim > 1 else 1
+        if (_native_draws_ok() and counts.ndim >= 2 and batch >= 1
+                and counts.dtype in (jnp.float32, jnp.float64)):
+            from gibbs_student_t_tpu.native import ffi as nffi
+
+            _note_impl("gamma_v2", "nchol", counts.shape)
+            return nffi.gamma_v2(keys.reshape(-1, 2),
+                                 counts.reshape(batch, -1),
+                                 jmax).reshape(counts.shape)
+        from gibbs_student_t_tpu.ops import rng as _rng
+
+        _note_impl("gamma_v2", "jnp_philox", counts.shape)
+        f = lambda k2, c: _rng.gamma_halfint_v2(k2, c, jmax)  # noqa: E731
+        for _ in range(counts.ndim - 1):
+            f = jax.vmap(f)
+        return f(keys, counts)
+
+    @gd.def_vmap
+    def _gd_vmap(axis_size, in_batched, keys, counts):
+        if not in_batched[0]:
+            keys = jnp.broadcast_to(keys, (axis_size,) + keys.shape)
+        if not in_batched[1]:
+            counts = jnp.broadcast_to(counts,
+                                      (axis_size,) + counts.shape)
+        return gd(keys, counts), True
+
+    return gd
+
+
+def masked_gamma_v2(keys, counts, jmax: int):
+    """``Gamma(k/2)`` draws for integer ``k = counts`` — the
+    GST_FAST_GAMMA **v2** construction (``-log prod U`` plus one
+    odd-parity Box-Muller plane, counter-based philox randomness;
+    distribution-exact like the chi-square arm but ~3x fewer
+    transcendental bytes). ``keys (..., 2)`` uint32 PRNG key words per
+    chain, ``counts (..., n)``; the native kernel generates its
+    uniforms in-kernel, the jnp twin (ops/rng.py) draws the identical
+    philox streams — the two arms agree to transcendental ulp."""
+    return _gamma_v2_dispatcher(int(jmax))(keys, counts)
+
+
+@custom_vmap
+def beta_fractional(keys, a, b):
+    """``theta ~ Beta(a, b)`` for per-chain FRACTIONAL pseudo-counts —
+    the flagship beta prior that the half-integer ``GST_FAST_BETA``
+    construction measured out. Native arm: two in-kernel
+    Marsaglia-Tsang gammas per chain (one custom call for the whole
+    chain batch); fallback: ``random.beta`` on the same key (identical
+    law, different stream — the dispatcher contract of every draw
+    arm). ``keys (..., 2)`` uint32 key words, ``a``/``b`` (...)."""
+    from jax import random
+
+    if (_native_draws_ok() and a.ndim >= 1
+            and a.dtype in (jnp.float32, jnp.float64)):
+        from gibbs_student_t_tpu.native import ffi as nffi
+
+        _note_impl("beta_frac", "nchol", a.shape)
+        return nffi.beta_frac(keys.reshape(-1, 2), a.reshape(-1),
+                              b.reshape(-1)).reshape(a.shape)
+    _note_impl("beta_frac", "random_beta", a.shape)
+
+    def one(k2, av, bv):
+        return random.beta(random.wrap_key_data(k2), av, bv,
+                           dtype=a.dtype)
+
+    f = one
+    for _ in range(a.ndim):
+        f = jax.vmap(f)
+    return f(keys, a, b)
+
+
+@beta_fractional.def_vmap
+def _beta_fractional_vmap(axis_size, in_batched, keys, a, b):
+    if not in_batched[0]:
+        keys = jnp.broadcast_to(keys, (axis_size,) + keys.shape)
+    if not in_batched[1]:
+        a = jnp.broadcast_to(a, (axis_size,) + a.shape)
+    if not in_batched[2]:
+        b = jnp.broadcast_to(b, (axis_size,) + b.shape)
+    return beta_fractional(keys, a, b), True
+
+
+@functools.lru_cache(maxsize=None)
+def _fused_hyper_dispatcher(hyp_idx: tuple, jitter: float,
+                            jitters: tuple):
+    """Dispatcher behind :func:`fused_hyper_draws` (the static phi
+    structure, MH jitter and escalation schedule are trace-static)."""
+
+    def _stages_jnp(A, Bm, C, rs, rv, x, dx, logu, xi, base0, K, sel,
+                    phist, specs):
+        """The per-stage composition — the megastage's gates-off-
+        equivalent graph, parity oracle and degradation target. The
+        b-draw evaluates phi through the same affine K rows the hyper
+        block (and the kernel) uses, so fused on/off agree to rounding."""
+        from gibbs_student_t_tpu.ops.pallas_hyper import (
+            _phi_eval_xla,
+            hyper_mh_loop_xla,
+        )
+
+        ns = A.shape[-1]
+        (S0, rt, quad_s, logdetA, La, isd_a, U_B, u_s) = _schur_jnp(
+            A, Bm, C, rs, rv, jitter)
+        dS0 = jnp.diagonal(S0, axis1=-2, axis2=-1) + phist
+        base = base0 + 0.5 * (quad_s - logdetA)
+        xh, acc = hyper_mh_loop_xla(x, S0, dS0, rt, base, dx, logu, K,
+                                    sel, specs, hyp_idx, jitter)
+        phiv, _ = _phi_eval_xla(xh, K, sel, hyp_idx)
+        eye = jnp.eye(S0.shape[-1], dtype=S0.dtype)
+        Sv = S0 + eye * (phiv + phist)[..., None, :]
+        y_v, isd_v, _ = robust_precond_draw(Sv, rt, xi[..., ns:],
+                                            jitters=jitters)
+        hi = jax.lax.Precision.HIGHEST
+        wty = jnp.matmul(U_B, (isd_v * y_v)[..., None],
+                         precision=hi)[..., 0]
+        y_s = backward_solve(La, u_s + xi[..., :ns] - wty)
+        return xh, acc, y_v, isd_v, y_s, isd_a
+
+    @custom_vmap
+    def fh(A, Bm, C, rs, rv, x, dx, logu, xi, base0, K, sel, phist,
+           specs):
+        # the WHETHER of the megastage is the backend's construction-
+        # time GST_FUSE_STAGES resolution; here only availability and
+        # shape caps pick native vs the per-stage jnp composition
+        nk = len(hyp_idx)
+        if (_native_draws_ok() and A.ndim >= 3 and K.ndim == 2
+                and _nchol_ok(A.shape, A.dtype, False)
+                and C.shape[-1] <= MAX_VCHOL_DIM
+                and x.shape[-1] <= 64 and nk <= 16):
+            from gibbs_student_t_tpu.native import ffi as nffi
+
+            _note_impl("fused_hyper", "nchol", C.shape)
+            dt = x.dtype
+            return nffi.fused_hyper(
+                A, Bm, C, rs, rv, x, dx, logu, xi, base0,
+                jnp.asarray(K, dt), jnp.asarray(sel, dt),
+                jnp.asarray(phist, dt), jnp.asarray(specs, dt),
+                hyp_idx, jitter, jitters)
+        _note_impl("fused_hyper", "stages", C.shape)
+        return _stages_jnp(A, Bm, C, rs, rv, x, dx, logu, xi, base0,
+                           K, sel, phist, specs)
+
+    @fh.def_vmap
+    def _fh_vmap(axis_size, in_batched, *args):
+        # the trailing 4 operands (K, sel, phist, specs) are per-model
+        # constants: a chain-level vmap maps only the data operands and
+        # the constants stay shared (the consts_batch_vmap discipline)
+        data, consts = args[:10], args[10:]
+        data = tuple(
+            a if bt else jnp.broadcast_to(a, (axis_size,) + a.shape)
+            for a, bt in zip(data, in_batched[:10]))
+        if any(in_batched[10:]):
+            consts = tuple(
+                a if bt else jnp.broadcast_to(a, (axis_size,) + a.shape)
+                for a, bt in zip(consts, in_batched[10:]))
+        return fh(*data, *consts), (True,) * 6
+
+    return fh
+
+
+def fused_hyper_draws(A, Bm, C, rs, rv, x, dx, logu, xi, base0, K, sel,
+                      phist, specs, hyp_idx, jitter, jitters):
+    """``(x, acc_hyper, y_v, isd_v, y_s, isd_a)`` — the hyper+draws
+    megastage (``GST_FUSE_STAGES``): Schur pre-elimination, the whole
+    hyper MH block over precomputed draws, and the coefficient draw's
+    robust v-block factorization + block-assembled backward solves as
+    ONE multi-stage FFI dispatch. The caller scatters ``b[s] = y_s *
+    isd_a``, ``b[v] = y_v * isd_v`` (backends/jax_backend.py). The
+    fallback is the per-stage jnp composition with identical operands
+    and randomness — the parity oracle, and what a
+    forced-but-unavailable gate silently degrades to."""
+    hyp_idx = tuple(int(i) for i in hyp_idx)
+    jitters = tuple(float(j) for j in jitters)
+    return _fused_hyper_dispatcher(hyp_idx, float(jitter), jitters)(
+        A, Bm, C, rs, rv, x, dx, logu, xi, base0, K, sel, phist, specs)
 
 
 def gaussian_draw(L, inv_sqrt_d, mean, xi):

@@ -565,6 +565,32 @@ def make_hyper_block(hyp_idx: Tuple[int, ...], jitter: float):
                     logu.reshape(1, B, S), K[None], sel[None],
                     specs[None], hyp_idx, jitter, interpret=interp)
                 return xf.reshape(batch + (p,)), acc.reshape(batch)
+        if K.ndim == 2 and x.ndim >= 2:
+            # native CPU arm (GST_NHYPER): the whole block as one FFI
+            # custom call with S0 tile-resident across all proposals —
+            # the Pallas kernel's portable counterpart; the XLA loop
+            # below is its oracle
+            from gibbs_student_t_tpu.ops import linalg as _lin
+
+            if _lin.nhyper_take(x.shape, x.dtype, x.shape[-1],
+                                S0.shape[-1], len(hyp_idx)):
+                from gibbs_student_t_tpu.native import ffi as nffi
+
+                _lin._note_impl("hyper_mh", "nchol", S0.shape)
+                B = int(np.prod(x.shape[:-1]))
+                p = x.shape[-1]
+                v = S0.shape[-1]
+                S = dx.shape[-2]
+                dt = x.dtype
+                xf, acc = nffi.hyper_mh(
+                    x.reshape(B, p), S0.reshape(B, v, v),
+                    dS0.reshape(B, v), rt.reshape(B, v),
+                    base.reshape(B), dx.reshape(B, S, p),
+                    logu.reshape(B, S), jnp.asarray(K, dt),
+                    jnp.asarray(sel, dt), jnp.asarray(specs, dt),
+                    hyp_idx, jitter)
+                return (xf.reshape(x.shape),
+                        acc.reshape(x.shape[:-1]))
         return hyper_mh_loop_xla(x, S0, dS0, rt, base, dx, logu,
                                  K, sel, specs, hyp_idx, jitter)
 

@@ -716,6 +716,28 @@ def make_white_block(var: Tuple[Tuple[int, int, int], ...]):
                     logu.reshape(1, B, S), rows[None], specs[None],
                     var, interpret=interp)
                 return xf.reshape(batch + (p,)), acc.reshape(batch)
+        if rows.ndim == 2 and x.ndim >= 2:
+            # native CPU arm (GST_NWHITE): the whole block as one FFI
+            # custom call — the Pallas kernel's portable counterpart,
+            # same operands/randomness, XLA loop below as the oracle
+            from gibbs_student_t_tpu.ops import linalg as _lin
+
+            if _lin.nwhite_take(x.shape, x.dtype, x.shape[-1],
+                                len(var)):
+                from gibbs_student_t_tpu.native import ffi as nffi
+
+                _lin._note_impl("white_mh", "nchol", x.shape)
+                B = int(np.prod(x.shape[:-1]))
+                p = x.shape[-1]
+                n = az.shape[-1]
+                S = dx.shape[-2]
+                xf, acc = nffi.white_mh(
+                    x.reshape(B, p), az.reshape(B, n),
+                    yred2.reshape(B, n), dx.reshape(B, S, p),
+                    logu.reshape(B, S), jnp.asarray(rows, x.dtype),
+                    jnp.asarray(specs, x.dtype), var)
+                return (xf.reshape(x.shape),
+                        acc.reshape(x.shape[:-1]))
         return white_mh_loop_xla(x, az, yred2, dx, logu, rows, specs,
                                  var)
 

@@ -1,0 +1,139 @@
+"""Counter-based Philox-4x32-10 in pure jnp — the native RNG's twin.
+
+The round-9 draw kernels (``native/src/gst_kernels.h``: fast-gamma v2,
+fractional beta) generate their randomness IN-kernel from a
+Philox-4x32-10 stream, so no uniform pool ever crosses the FFI
+boundary. This module is the stream's jnp twin: the same key/counter
+layout, the same 10-round bump-per-round schedule, and the same exact
+bits->uniform map — uniforms agree BITWISE between the two arms
+(pinned in tests/test_nchol.py), and downstream values agree to the
+libm-vs-XLA transcendental ulp level.
+
+Everything is plain uint32 arithmetic (wrap-around semantics), so it
+runs without ``jax_enable_x64``: the 32x32 -> 64 multiply goes through
+16-bit limbs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+PHILOX_M0 = np.uint32(0xD2511F53)
+PHILOX_M1 = np.uint32(0xCD9E8D57)
+PHILOX_W0 = np.uint32(0x9E3779B9)
+PHILOX_W1 = np.uint32(0xBB67AE85)
+
+#: ctr2 domain tags (native kernels use the same constants so a reused
+#: key can never collide across kernels)
+TAG_GAMMA = np.uint32(0x67616D00)
+TAG_BETA_A = np.uint32(0x62657400)
+TAG_BETA_B = np.uint32(0x62657401)
+
+_LOW16 = np.uint32(0xFFFF)
+
+
+def _mulhilo(a, m):
+    """(hi, lo) words of the 32x32 product via 16-bit limbs — exact
+    with uint32 wrap-around arithmetic only (no x64 requirement)."""
+    a = jnp.asarray(a, jnp.uint32)
+    al = a & _LOW16
+    ah = a >> 16
+    ml = np.uint32(int(m) & 0xFFFF)
+    mh = np.uint32(int(m) >> 16)
+    ll = al * ml
+    lh = al * mh
+    hl = ah * ml
+    hh = ah * mh
+    mid = (ll >> 16) + (lh & _LOW16) + (hl & _LOW16)
+    lo = (ll & _LOW16) | (mid << 16)
+    hi = hh + (lh >> 16) + (hl >> 16) + (mid >> 16)
+    return hi, lo
+
+
+def philox_4x32(k0, k1, c0, c1, c2, c3):
+    """One Philox-4x32-10 block per counter element; key words are
+    scalars (or broadcastable arrays), counters arbitrary-shaped uint32
+    arrays. Returns the four output words."""
+    k0 = jnp.asarray(k0, jnp.uint32)
+    k1 = jnp.asarray(k1, jnp.uint32)
+    c0 = jnp.asarray(c0, jnp.uint32)
+    c1 = jnp.asarray(c1, jnp.uint32)
+    c2 = jnp.asarray(c2, jnp.uint32)
+    c3 = jnp.asarray(c3, jnp.uint32)
+    for _ in range(10):
+        hi0, lo0 = _mulhilo(c0, PHILOX_M0)
+        hi1, lo1 = _mulhilo(c2, PHILOX_M1)
+        c0, c1, c2, c3 = hi1 ^ c1 ^ k0, lo1, hi0 ^ c3 ^ k1, lo0
+        k0 = k0 + PHILOX_W0
+        k1 = k1 + PHILOX_W1
+    return c0, c1, c2, c3
+
+
+def uniform_of_bits(bits, dtype):
+    """Exact bits -> (0, 1) map shared with the kernels:
+    ``(bits >> 9) * 2^-23 + 2^-24`` — every step representable, so the
+    two arms' uniforms are bitwise equal (23 bits of entropy)."""
+    b = (jnp.asarray(bits, jnp.uint32) >> 9).astype(dtype)
+    return b * dtype(2.0 ** -23) + dtype(2.0 ** -24)
+
+
+def key_bits(key):
+    """The raw uint32 key words of a jax PRNG key (old-style uint32
+    arrays pass through; typed keys unwrap via ``random.key_data``)."""
+    import jax
+
+    arr = jnp.asarray(key)
+    if jnp.issubdtype(arr.dtype, jnp.integer):
+        return arr.astype(jnp.uint32)
+    return jax.random.key_data(key).astype(jnp.uint32)
+
+
+def philox_uniform_pool(key2, rows: int, width: int, tag, dtype):
+    """(rows, width) uniforms for ONE chain: uniform ``i`` of row ``r``
+    is word ``i % 4`` of block (ctr0 = r, ctr1 = i // 4, ctr2 = tag)
+    under the chain's key — the exact layout the native kernels
+    consume. ``key2`` is the (2,) uint32 key-word array."""
+    nblk = (width + 3) // 4
+    c0 = jnp.broadcast_to(
+        jnp.arange(rows, dtype=jnp.uint32)[:, None], (rows, nblk))
+    c1 = jnp.broadcast_to(
+        jnp.arange(nblk, dtype=jnp.uint32)[None, :], (rows, nblk))
+    w = philox_4x32(key2[0], key2[1], c0, c1,
+                    jnp.full((rows, nblk), tag, jnp.uint32),
+                    jnp.zeros((rows, nblk), jnp.uint32))
+    bits = jnp.stack(w, axis=-1).reshape(rows, nblk * 4)[:, :width]
+    return uniform_of_bits(bits, dtype)
+
+
+def gamma_halfint_v2(key2, counts, jmax: int):
+    """``Gamma(k/2)`` for integer ``k = counts`` (float-encoded), the
+    GST_FAST_GAMMA v2 construction — jnp twin of the native
+    ``gamma_v2_batch`` kernel (same philox streams, chunked-product
+    log instead of the kernel's full double product, Box-Muller
+    odd-parity plane). One chain: ``counts (n,)`` -> draws ``(n,)``."""
+    dtype = counts.dtype.type
+    n = counts.shape[-1]
+    u = philox_uniform_pool(key2, n, jmax + 2, TAG_GAMMA, dtype)
+    k = jnp.floor(counts + counts.dtype.type(0.5)).astype(jnp.int32)
+    k = jnp.maximum(k, 0)
+    j = jnp.minimum(k >> 1, jmax)
+    odd = (k & 1).astype(counts.dtype)
+    live = jnp.arange(jmax, dtype=jnp.int32)[None, :] < j[:, None]
+    up = jnp.where(live, u[:, :jmax], dtype(1.0))
+    # chunked product before each log: 4 uniforms (each >= 2^-24)
+    # cannot underflow f32; 8 cannot underflow f64 — the chol_tile
+    # chunked-product discipline (the kernel accumulates the whole
+    # product in a double and pays ONE log; values agree to ~1e-7)
+    chunk = 4 if counts.dtype == jnp.float32 else 8
+    pad = (-jmax) % chunk
+    if pad:
+        up = jnp.concatenate(
+            [up, jnp.ones(up.shape[:-1] + (pad,), counts.dtype)],
+            axis=-1)
+    pc = jnp.prod(up.reshape(up.shape[:-1] + (-1, chunk)), axis=-1)
+    g = -jnp.sum(jnp.log(pc), axis=-1)
+    nrm = jnp.sqrt(dtype(-2.0) * jnp.log(u[:, jmax])) * jnp.cos(
+        dtype(2.0 * np.pi) * u[:, jmax + 1])
+    return g + odd * counts.dtype.type(0.5) * nrm * nrm

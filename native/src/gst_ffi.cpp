@@ -45,6 +45,17 @@
 #define GST_EXPORT2 extern "C" __attribute__((visibility("default")))
 #endif
 
+// ABI version of the kernel/handler family — bumped whenever a kernel
+// SIGNATURE changes (operand count, order, dtype, or semantics), so a
+// committed .so from an older round degrades with a clear reason
+// string at probe time (gibbs_student_t_tpu/native/ffi.py checks this
+// against its own expected value) instead of miscalling a handler
+// whose argument list moved. v2: the round-9 draw/MH kernel family
+// (philox gamma-v2, fractional beta, white/hyper MH blocks, fused
+// Schur + hyper+draws megastage).
+#define GST_ABI_VERSION 2
+GST_EXPORT2 int gst_abi_version() { return GST_ABI_VERSION; }
+
 // Best SIMD level this object was compiled for — the Python loader
 // refuses to register handlers on a host whose cpuinfo lacks it, so a
 // committed .so built with -march=native can never SIGILL a weaker
@@ -242,6 +253,231 @@ ffi::Error chisq_impl(ffi::Buffer<DT> xs, ffi::Buffer<DT> counts,
   return ffi::Error::Success();
 }
 
+// ---- round-9 draw/MH kernel family ----------------------------------
+
+template <ffi::DataType DT>
+ffi::Error gamma_v2_impl(ffi::Buffer<ffi::U32> keys, ffi::Buffer<DT> counts,
+                         ffi::Buffer<ffi::S32> meta,
+                         ffi::ResultBuffer<DT> out) {
+  auto dims = counts.dimensions();
+  if (dims.size() < 1)
+    return ffi::Error::InvalidArgument("gst_gamma_v2: counts rank");
+  const int64_t n = dims[dims.size() - 1];
+  const int64_t B = batch_of(dims, 1);
+  if (keys.element_count() != size_t(B) * 2)
+    return ffi::Error::InvalidArgument("gst_gamma_v2: keys shape");
+  if (meta.element_count() != 1)
+    return ffi::Error::InvalidArgument("gst_gamma_v2: meta shape");
+  const int64_t jmax = meta.typed_data()[0];
+  if (jmax < 0 || jmax > 128)
+    return ffi::Error::InvalidArgument("gst_gamma_v2: jmax out of range");
+  if (B && n)
+    gst::gamma_v2_batch(keys.typed_data(), counts.typed_data(),
+                        out->typed_data(), B, n, jmax);
+  return ffi::Error::Success();
+}
+
+template <ffi::DataType DT>
+ffi::Error beta_frac_impl(ffi::Buffer<ffi::U32> keys, ffi::Buffer<DT> a,
+                          ffi::Buffer<DT> b, ffi::ResultBuffer<DT> out) {
+  const int64_t B = a.element_count();
+  if (b.element_count() != size_t(B))
+    return ffi::Error::InvalidArgument("gst_beta_frac: a/b shape");
+  if (keys.element_count() != size_t(B) * 2)
+    return ffi::Error::InvalidArgument("gst_beta_frac: keys shape");
+  if (B)
+    gst::beta_frac_batch(keys.typed_data(), a.typed_data(),
+                         b.typed_data(), out->typed_data(), B);
+  return ffi::Error::Success();
+}
+
+template <ffi::DataType DT>
+ffi::Error white_mh_impl(ffi::Buffer<DT> x, ffi::Buffer<DT> az,
+                         ffi::Buffer<DT> y2, ffi::Buffer<DT> dx,
+                         ffi::Buffer<DT> logu, ffi::Buffer<DT> rows,
+                         ffi::Buffer<DT> specs,
+                         ffi::Buffer<ffi::S32> var,
+                         ffi::ResultBuffer<DT> xo,
+                         ffi::ResultBuffer<DT> acc) {
+  auto xdims = x.dimensions();
+  auto rdims = rows.dimensions();
+  auto ddims = dx.dimensions();
+  if (xdims.size() < 1 || rdims.size() != 2 || ddims.size() < 2)
+    return ffi::Error::InvalidArgument("gst_white_mh: ranks");
+  const int64_t p = xdims[xdims.size() - 1];
+  const int64_t B = batch_of(xdims, 1);
+  const int64_t R = rdims[0];
+  const int64_t n = rdims[1];
+  const int64_t S = ddims[ddims.size() - 2];
+  const int64_t nvar = var.element_count() / 3;
+  if (az.element_count() != size_t(B) * n
+      || y2.element_count() != size_t(B) * n
+      || dx.element_count() != size_t(B) * S * p
+      || logu.element_count() != size_t(B) * S
+      || specs.element_count() != size_t(3) * p
+      || var.element_count() != size_t(nvar) * 3)
+    return ffi::Error::InvalidArgument("gst_white_mh: shapes");
+  if (p > 64 || nvar > 16 || R < 2 + nvar)
+    return ffi::Error::InvalidArgument("gst_white_mh: limits");
+  for (int64_t g = 0; g < nvar; ++g) {
+    const int32_t* vg = var.typed_data() + 3 * g;
+    if (vg[1] < 0 || vg[1] >= p || vg[2] < 0 || vg[2] >= R)
+      return ffi::Error::InvalidArgument("gst_white_mh: var table");
+  }
+  if (B && p && n && S)
+    gst::white_mh_batch(x.typed_data(), az.typed_data(), y2.typed_data(),
+                        dx.typed_data(), logu.typed_data(),
+                        rows.typed_data(), specs.typed_data(),
+                        var.typed_data(), nvar, xo->typed_data(),
+                        acc->typed_data(), B, p, n, S, R);
+  return ffi::Error::Success();
+}
+
+template <ffi::DataType DT>
+ffi::Error hyper_mh_impl(ffi::Buffer<DT> x, ffi::Buffer<DT> S0,
+                         ffi::Buffer<DT> dS0, ffi::Buffer<DT> rt,
+                         ffi::Buffer<DT> base, ffi::Buffer<DT> dx,
+                         ffi::Buffer<DT> logu, ffi::Buffer<DT> K,
+                         ffi::Buffer<DT> sel, ffi::Buffer<DT> specs,
+                         ffi::Buffer<ffi::S32> hypidx,
+                         ffi::Buffer<DT> jitter,
+                         ffi::ResultBuffer<DT> xo,
+                         ffi::ResultBuffer<DT> acc) {
+  auto xdims = x.dimensions();
+  auto sdims = S0.dimensions();
+  auto ddims = dx.dimensions();
+  auto kdims = K.dimensions();
+  if (xdims.size() < 1 || sdims.size() < 2 || ddims.size() < 2
+      || kdims.size() != 2)
+    return ffi::Error::InvalidArgument("gst_hyper_mh: ranks");
+  const int64_t p = xdims[xdims.size() - 1];
+  const int64_t B = batch_of(xdims, 1);
+  const int64_t v = sdims[sdims.size() - 1];
+  const int64_t S = ddims[ddims.size() - 2];
+  const int64_t nk = hypidx.element_count();
+  if (sdims[sdims.size() - 2] != v || batch_of(sdims, 2) != B
+      || dS0.element_count() != size_t(B) * v
+      || rt.element_count() != size_t(B) * v
+      || base.element_count() != size_t(B)
+      || dx.element_count() != size_t(B) * S * p
+      || logu.element_count() != size_t(B) * S
+      || K.element_count() != size_t(1 + nk) * v
+      || sel.element_count() != size_t(v)
+      || specs.element_count() != size_t(3) * p
+      || jitter.element_count() != 1)
+    return ffi::Error::InvalidArgument("gst_hyper_mh: shapes");
+  if (p > 64 || nk > 16)
+    return ffi::Error::InvalidArgument("gst_hyper_mh: limits");
+  for (int64_t k = 0; k < nk; ++k)
+    if (hypidx.typed_data()[k] < 0 || hypidx.typed_data()[k] >= p)
+      return ffi::Error::InvalidArgument("gst_hyper_mh: hypidx");
+  if (B && p && v && S)
+    gst::hyper_mh_batch(x.typed_data(), S0.typed_data(),
+                        dS0.typed_data(), rt.typed_data(),
+                        base.typed_data(), dx.typed_data(),
+                        logu.typed_data(), K.typed_data(),
+                        sel.typed_data(), specs.typed_data(),
+                        hypidx.typed_data(), nk, jitter.typed_data()[0],
+                        xo->typed_data(), acc->typed_data(), B, p, v, S);
+  return ffi::Error::Success();
+}
+
+template <ffi::DataType DT>
+ffi::Error schur_impl(ffi::Buffer<DT> A, ffi::Buffer<DT> Bm,
+                      ffi::Buffer<DT> C, ffi::Buffer<DT> rhs_s,
+                      ffi::Buffer<DT> rhs_v, ffi::Buffer<DT> jitter,
+                      ffi::ResultBuffer<DT> S0, ffi::ResultBuffer<DT> rt,
+                      ffi::ResultBuffer<DT> quad_s,
+                      ffi::ResultBuffer<DT> logdetA,
+                      ffi::ResultBuffer<DT> La,
+                      ffi::ResultBuffer<DT> isd_a,
+                      ffi::ResultBuffer<DT> U_B,
+                      ffi::ResultBuffer<DT> u_s) {
+  auto adims = A.dimensions();
+  auto cdims = C.dimensions();
+  if (adims.size() < 2 || cdims.size() < 2)
+    return ffi::Error::InvalidArgument("gst_schur: ranks");
+  const int64_t ns = adims[adims.size() - 1];
+  const int64_t nv = cdims[cdims.size() - 1];
+  const int64_t B = batch_of(adims, 2);
+  if (adims[adims.size() - 2] != ns || cdims[cdims.size() - 2] != nv
+      || batch_of(cdims, 2) != B
+      || Bm.element_count() != size_t(B) * ns * nv
+      || rhs_s.element_count() != size_t(B) * ns
+      || rhs_v.element_count() != size_t(B) * nv
+      || jitter.element_count() != 1)
+    return ffi::Error::InvalidArgument("gst_schur: shapes");
+  if (B && ns && nv)
+    gst::schur_batch(A.typed_data(), Bm.typed_data(), C.typed_data(),
+                     rhs_s.typed_data(), rhs_v.typed_data(),
+                     jitter.typed_data()[0], S0->typed_data(),
+                     rt->typed_data(), quad_s->typed_data(),
+                     logdetA->typed_data(), La->typed_data(),
+                     isd_a->typed_data(), U_B->typed_data(),
+                     u_s->typed_data(), B, ns, nv);
+  return ffi::Error::Success();
+}
+
+template <ffi::DataType DT>
+ffi::Error fused_hyper_impl(
+    ffi::Buffer<DT> A, ffi::Buffer<DT> Bm, ffi::Buffer<DT> C,
+    ffi::Buffer<DT> rhs_s, ffi::Buffer<DT> rhs_v, ffi::Buffer<DT> x,
+    ffi::Buffer<DT> dx, ffi::Buffer<DT> logu, ffi::Buffer<DT> xi,
+    ffi::Buffer<DT> base0, ffi::Buffer<DT> K, ffi::Buffer<DT> sel,
+    ffi::Buffer<DT> phist, ffi::Buffer<DT> specs,
+    ffi::Buffer<ffi::S32> hypidx, ffi::Buffer<DT> jitter,
+    ffi::Buffer<DT> jits, ffi::ResultBuffer<DT> xo,
+    ffi::ResultBuffer<DT> acc, ffi::ResultBuffer<DT> y_v,
+    ffi::ResultBuffer<DT> isd_v, ffi::ResultBuffer<DT> y_s,
+    ffi::ResultBuffer<DT> isd_a) {
+  auto adims = A.dimensions();
+  auto cdims = C.dimensions();
+  auto xdims = x.dimensions();
+  auto ddims = dx.dimensions();
+  if (adims.size() < 2 || cdims.size() < 2 || xdims.size() < 1
+      || ddims.size() < 2)
+    return ffi::Error::InvalidArgument("gst_fused_hyper: ranks");
+  const int64_t ns = adims[adims.size() - 1];
+  const int64_t nv = cdims[cdims.size() - 1];
+  const int64_t p = xdims[xdims.size() - 1];
+  const int64_t B = batch_of(adims, 2);
+  const int64_t S = ddims[ddims.size() - 2];
+  const int64_t nk = hypidx.element_count();
+  const int64_t nlev = jits.element_count();
+  if (adims[adims.size() - 2] != ns || cdims[cdims.size() - 2] != nv
+      || batch_of(cdims, 2) != B || batch_of(xdims, 1) != B
+      || Bm.element_count() != size_t(B) * ns * nv
+      || rhs_s.element_count() != size_t(B) * ns
+      || rhs_v.element_count() != size_t(B) * nv
+      || dx.element_count() != size_t(B) * S * p
+      || logu.element_count() != size_t(B) * S
+      || xi.element_count() != size_t(B) * (ns + nv)
+      || base0.element_count() != size_t(B)
+      || K.element_count() != size_t(1 + nk) * nv
+      || sel.element_count() != size_t(nv)
+      || phist.element_count() != size_t(nv)
+      || specs.element_count() != size_t(3) * p
+      || jitter.element_count() != 1 || nlev < 1)
+    return ffi::Error::InvalidArgument("gst_fused_hyper: shapes");
+  if (p > 64 || nk > 16)
+    return ffi::Error::InvalidArgument("gst_fused_hyper: limits");
+  for (int64_t k = 0; k < nk; ++k)
+    if (hypidx.typed_data()[k] < 0 || hypidx.typed_data()[k] >= p)
+      return ffi::Error::InvalidArgument("gst_fused_hyper: hypidx");
+  if (B && p && ns && nv && S)
+    gst::fused_hyper_batch(
+        A.typed_data(), Bm.typed_data(), C.typed_data(),
+        rhs_s.typed_data(), rhs_v.typed_data(), x.typed_data(),
+        dx.typed_data(), logu.typed_data(), xi.typed_data(),
+        base0.typed_data(), K.typed_data(), sel.typed_data(),
+        phist.typed_data(), specs.typed_data(), hypidx.typed_data(), nk,
+        jitter.typed_data()[0], jits.typed_data(), nlev,
+        xo->typed_data(), acc->typed_data(), y_v->typed_data(),
+        isd_v->typed_data(), y_s->typed_data(), isd_a->typed_data(), B,
+        p, ns, nv, S);
+  return ffi::Error::Success();
+}
+
 }  // namespace
 
 #define GST_BIND_FACTOR(DT)                \
@@ -334,6 +570,135 @@ XLA_FFI_DEFINE_HANDLER_SYMBOL(GstTntF32, (tnt_impl<ffi::F32>),
                               GST_BIND_TNT(ffi::F32));
 XLA_FFI_DEFINE_HANDLER_SYMBOL(GstTntF64, (tnt_impl<ffi::F64>),
                               GST_BIND_TNT(ffi::F64));
+
+#define GST_BIND_GAMMA_V2(DT)              \
+  ffi::Ffi::Bind()                         \
+      .Arg<ffi::Buffer<ffi::U32>>()        \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<ffi::S32>>()        \
+      .Ret<ffi::Buffer<DT>>()
+
+#define GST_BIND_BETA_FRAC(DT)             \
+  ffi::Ffi::Bind()                         \
+      .Arg<ffi::Buffer<ffi::U32>>()        \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Ret<ffi::Buffer<DT>>()
+
+#define GST_BIND_WHITE_MH(DT)              \
+  ffi::Ffi::Bind()                         \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<ffi::S32>>()        \
+      .Ret<ffi::Buffer<DT>>()              \
+      .Ret<ffi::Buffer<DT>>()
+
+#define GST_BIND_HYPER_MH(DT)              \
+  ffi::Ffi::Bind()                         \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<ffi::S32>>()        \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Ret<ffi::Buffer<DT>>()              \
+      .Ret<ffi::Buffer<DT>>()
+
+#define GST_BIND_SCHUR(DT)                 \
+  ffi::Ffi::Bind()                         \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Ret<ffi::Buffer<DT>>()              \
+      .Ret<ffi::Buffer<DT>>()              \
+      .Ret<ffi::Buffer<DT>>()              \
+      .Ret<ffi::Buffer<DT>>()              \
+      .Ret<ffi::Buffer<DT>>()              \
+      .Ret<ffi::Buffer<DT>>()              \
+      .Ret<ffi::Buffer<DT>>()              \
+      .Ret<ffi::Buffer<DT>>()
+
+#define GST_BIND_FUSED_HYPER(DT)           \
+  ffi::Ffi::Bind()                         \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<ffi::S32>>()        \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Ret<ffi::Buffer<DT>>()              \
+      .Ret<ffi::Buffer<DT>>()              \
+      .Ret<ffi::Buffer<DT>>()              \
+      .Ret<ffi::Buffer<DT>>()              \
+      .Ret<ffi::Buffer<DT>>()              \
+      .Ret<ffi::Buffer<DT>>()
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(GstGammaV2F32, (gamma_v2_impl<ffi::F32>),
+                              GST_BIND_GAMMA_V2(ffi::F32));
+XLA_FFI_DEFINE_HANDLER_SYMBOL(GstGammaV2F64, (gamma_v2_impl<ffi::F64>),
+                              GST_BIND_GAMMA_V2(ffi::F64));
+XLA_FFI_DEFINE_HANDLER_SYMBOL(GstBetaFracF32, (beta_frac_impl<ffi::F32>),
+                              GST_BIND_BETA_FRAC(ffi::F32));
+XLA_FFI_DEFINE_HANDLER_SYMBOL(GstBetaFracF64, (beta_frac_impl<ffi::F64>),
+                              GST_BIND_BETA_FRAC(ffi::F64));
+XLA_FFI_DEFINE_HANDLER_SYMBOL(GstWhiteMhF32, (white_mh_impl<ffi::F32>),
+                              GST_BIND_WHITE_MH(ffi::F32));
+XLA_FFI_DEFINE_HANDLER_SYMBOL(GstWhiteMhF64, (white_mh_impl<ffi::F64>),
+                              GST_BIND_WHITE_MH(ffi::F64));
+XLA_FFI_DEFINE_HANDLER_SYMBOL(GstHyperMhF32, (hyper_mh_impl<ffi::F32>),
+                              GST_BIND_HYPER_MH(ffi::F32));
+XLA_FFI_DEFINE_HANDLER_SYMBOL(GstHyperMhF64, (hyper_mh_impl<ffi::F64>),
+                              GST_BIND_HYPER_MH(ffi::F64));
+XLA_FFI_DEFINE_HANDLER_SYMBOL(GstSchurF32, (schur_impl<ffi::F32>),
+                              GST_BIND_SCHUR(ffi::F32));
+XLA_FFI_DEFINE_HANDLER_SYMBOL(GstSchurF64, (schur_impl<ffi::F64>),
+                              GST_BIND_SCHUR(ffi::F64));
+XLA_FFI_DEFINE_HANDLER_SYMBOL(GstFusedHyperF32,
+                              (fused_hyper_impl<ffi::F32>),
+                              GST_BIND_FUSED_HYPER(ffi::F32));
+XLA_FFI_DEFINE_HANDLER_SYMBOL(GstFusedHyperF64,
+                              (fused_hyper_impl<ffi::F64>),
+                              GST_BIND_FUSED_HYPER(ffi::F64));
+
+// Plain-C debug/parity entry for the in-kernel RNG: fills ``out`` with
+// ``count`` philox words for (key, ctr0 row, tag) — how the jnp twin's
+// stream pin (tests/test_nchol.py) reaches the exact generator the
+// kernels consume, without an XLA call frame.
+extern "C" __attribute__((visibility("default")))
+void gst_philox_fill(uint32_t k0, uint32_t k1, uint32_t c0, uint32_t c2,
+                     uint32_t* out, long long count) {
+  long long i = 0;
+  for (uint32_t blk = 0; i < count; ++blk) {
+    uint32_t w[4];
+    gst::philox_scalar(k0, k1, c0, blk, c2, 0u, w);
+    for (int q = 0; q < 4 && i < count; ++q) out[i++] = w[q];
+  }
+}
 
 #endif  // GST_NO_FFI
 

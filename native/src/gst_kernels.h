@@ -16,6 +16,7 @@
 #include <cmath>
 #include <cstdint>
 #include <cstring>
+#include <limits>
 #include <new>
 #include <utility>
 
@@ -144,6 +145,14 @@ inline void store_tile_lower_mem(const T* __restrict src,
   }
 }
 
+// Lane-width signed integer for vector compare masks (the ternary
+// blend operand type). Used by the recurrences whether or not the
+// register transposes build, so it lives outside the GST_REG_XPOSE
+// guard.
+template <typename T> struct MaskInt;
+template <> struct MaskInt<float> { using type = int32_t; };
+template <> struct MaskInt<double> { using type = int64_t; };
+
 #if GST_REG_XPOSE
 
 // In-register W x W block transpose: W unaligned vector loads, a
@@ -154,10 +163,6 @@ inline void store_tile_lower_mem(const T* __restrict src,
 // output rows in bit-reversed order; the store indexes through
 // bitrev() (an involution), which costs nothing — the stores were
 // permutable anyway.
-
-template <typename T> struct MaskInt;
-template <> struct MaskInt<float> { using type = int32_t; };
-template <> struct MaskInt<double> { using type = int64_t; };
 
 // element-aligned (unaligned-capable) vector view of a T run
 template <typename T, int W>
@@ -605,55 +610,72 @@ void factor_quad_batch(const T* S, const T* rhs, T* logdet, T* u,
 // the tile actually failed (measured: never, at the flagship shape).
 // Selection predicate matches the stacked path exactly: all lower-L
 // entries finite AND logdet finite, per lane.
+// Per-tile core of the escalating-jitter draw, shared by the
+// standalone robust_draw handler and the fused hyper+draws megastage:
+// operates on an already chains-contiguous pristine tile ``prist``
+// ((m, m, W), lower triangle valid), rhs/xi tiles ((m, W)), writing the
+// selected draw/logdet into ``ysel``/``ldsel``. ``work``/``yt``/``ld``
+// are caller-provided scratch of the same tile shapes.
+template <typename T, int W>
+inline void robust_tile(const T* __restrict prist, const T* __restrict r0,
+                        const T* __restrict xt, const T* jits,
+                        int64_t nlev, T* __restrict ysel,
+                        T* __restrict ldsel, T* __restrict work,
+                        T* __restrict yt, T* __restrict ld, int64_t m) {
+  using V = typename VecOf<T, W>::type;
+  using MI = typename MaskInt<T>::type;
+  typedef MI IV __attribute__((vector_size(W * sizeof(T))));
+  const V vzero = {};
+  IV accepted = {};
+  for (int64_t lev = 0; lev < nlev; ++lev) {
+    std::memcpy(work, prist, size_t(m) * m * W * sizeof(T));
+    V* w = reinterpret_cast<V*>(work);
+    const V jv = splat<T, W>(jits[lev]);
+    for (int64_t j = 0; j < m; ++j) w[j * m + j] += jv;
+    chol_tile<T, W>(work, ld, m);
+    V* yv = reinterpret_cast<V*>(yt);
+    const V* xv = reinterpret_cast<const V*>(xt);
+    std::memcpy(yt, r0, size_t(m) * W * sizeof(T));
+    fwd_tile<T, W>(work, yt, m);   // yt = u = L^-1 rhs
+    for (int64_t i = 0; i < m; ++i) yv[i] += xv[i];
+    bwd_tile<T, W>(work, yt, m);   // yt = L^-T (u + xi)
+    // per-lane finiteness of the factor: x - x == 0 rejects NaN/inf
+    IV fin = (vzero == vzero);                 // all lanes true
+    for (int64_t j = 0; j < m; ++j)
+      for (int64_t i = j; i < m; ++i) {
+        const V v = w[i * m + j];
+        fin &= ((v - v) == vzero);
+      }
+    const V ldv = *reinterpret_cast<const V*>(ld);
+    fin &= ((ldv - ldv) == vzero);
+    IV take = ~accepted & ((lev == nlev - 1) ? ~IV{} : fin);
+    V* ys = reinterpret_cast<V*>(ysel);
+    for (int64_t i = 0; i < m; ++i) ys[i] = take ? yv[i] : ys[i];
+    V* lds = reinterpret_cast<V*>(ldsel);
+    lds[0] = take ? ldv : lds[0];
+    accepted |= (fin | take);
+    bool all_done = true;
+    for (int l = 0; l < W; ++l) all_done &= (accepted[l] != 0);
+    if (all_done) break;
+  }
+}
+
 template <typename T>
 void robust_draw_batch(const T* S, const T* rhs, const T* xi,
                        const T* jits, int64_t nlev, T* y, T* logdet,
                        int64_t B, int64_t m) {
   constexpr int W = Lanes<T>::W;
-  using V = typename VecOf<T, W>::type;
-  using MI = typename MaskInt<T>::type;
-  typedef MI IV __attribute__((vector_size(W * sizeof(T))));
   Scratch<T> prist(size_t(m) * m * W), work(size_t(m) * m * W),
       r0(size_t(m) * W), xt(size_t(m) * W), yt(size_t(m) * W), ld(W),
       ysel(size_t(m) * W), ldsel(W);
-  const V vzero = {};
   for (int64_t b0 = 0; b0 < B; b0 += W) {
     const int64_t lanes = std::min<int64_t>(W, B - b0);
     load_tile_lower<T, W>(S, prist.get(), b0, lanes, m, m * m);
     load_tile<T, W>(rhs, r0.get(), b0, lanes, m, m);
     load_tile<T, W>(xi, xt.get(), b0, lanes, m, m);
-    IV accepted = {};
-    for (int64_t lev = 0; lev < nlev; ++lev) {
-      std::memcpy(work.get(), prist.get(), size_t(m) * m * W * sizeof(T));
-      V* w = reinterpret_cast<V*>(work.get());
-      const V jv = splat<T, W>(jits[lev]);
-      for (int64_t j = 0; j < m; ++j) w[j * m + j] += jv;
-      chol_tile<T, W>(work.get(), ld.get(), m);
-      V* yv = reinterpret_cast<V*>(yt.get());
-      const V* xv = reinterpret_cast<const V*>(xt.get());
-      std::memcpy(yt.get(), r0.get(), size_t(m) * W * sizeof(T));
-      fwd_tile<T, W>(work.get(), yt.get(), m);   // yt = u = L^-1 rhs
-      for (int64_t i = 0; i < m; ++i) yv[i] += xv[i];
-      bwd_tile<T, W>(work.get(), yt.get(), m);   // yt = L^-T (u + xi)
-      // per-lane finiteness of the factor: x - x == 0 rejects NaN/inf
-      IV fin = (vzero == vzero);                 // all lanes true
-      for (int64_t j = 0; j < m; ++j)
-        for (int64_t i = j; i < m; ++i) {
-          const V v = w[i * m + j];
-          fin &= ((v - v) == vzero);
-        }
-      const V ldv = *reinterpret_cast<const V*>(ld.get());
-      fin &= ((ldv - ldv) == vzero);
-      IV take = ~accepted & ((lev == nlev - 1) ? ~IV{} : fin);
-      V* ys = reinterpret_cast<V*>(ysel.get());
-      for (int64_t i = 0; i < m; ++i) ys[i] = take ? yv[i] : ys[i];
-      V* lds = reinterpret_cast<V*>(ldsel.get());
-      lds[0] = take ? ldv : lds[0];
-      accepted |= (fin | take);
-      bool all_done = true;
-      for (int l = 0; l < W; ++l) all_done &= (accepted[l] != 0);
-      if (all_done) break;
-    }
+    robust_tile<T, W>(prist.get(), r0.get(), xt.get(), jits, nlev,
+                      ysel.get(), ldsel.get(), work.get(), yt.get(),
+                      ld.get(), m);
     store_tile<T, W>(ysel.get(), y, b0, lanes, m, m);
     store_tile<T, W>(ldsel.get(), logdet, b0, lanes, 1, 1);
   }
@@ -814,6 +836,979 @@ void chisq_batch(const T* xs, const T* counts, T* out, int64_t rows,
     for (int s = W / 2; s > 0; s /= 2)
       for (int l = 0; l < s; ++l) tmp[l] += tmp[l + s];
     out[r] = T(0.5) * tmp[0];
+  }
+}
+
+// ---------------------------------------------------------------------
+// counter-based RNG (Philox-4x32-10) + vector transcendentals
+// ---------------------------------------------------------------------
+//
+// The draw kernels below generate their randomness IN-kernel from a
+// counter-based Philox-4x32-10 stream keyed by the caller's jax PRNG
+// key words, so a (B, n, pool) buffer of uniforms never crosses the
+// FFI boundary. The stream is pinned against the jnp twin
+// (gibbs_student_t_tpu/ops/rng.py): same key, same (ctr0, ctr1, ctr2)
+// counter layout, same 10-round schedule, and the SAME exact
+// bits->uniform map ((bits >> 9) * 2^-23 + 2^-24 — every step exact in
+// f32, so the two arms' uniforms agree BITWISE; only the downstream
+// libm-vs-XLA transcendentals differ, at the ulp level).
+
+constexpr uint32_t kPhiloxM0 = 0xD2511F53u;
+constexpr uint32_t kPhiloxM1 = 0xCD9E8D57u;
+constexpr uint32_t kPhiloxW0 = 0x9E3779B9u;
+constexpr uint32_t kPhiloxW1 = 0xBB67AE85u;
+
+// GCC cannot feed a template-dependent vector type through
+// __builtin_convertvector, so the lane-width conversions go through
+// these concrete-typed overloads (resolved at instantiation).
+namespace cvt {
+typedef uint32_t u32x8 __attribute__((vector_size(32)));
+typedef uint32_t u32x16 __attribute__((vector_size(64)));
+typedef uint64_t u64x8 __attribute__((vector_size(64)));
+typedef uint64_t u64x16 __attribute__((vector_size(128)));
+typedef int32_t i32x8 __attribute__((vector_size(32)));
+typedef int32_t i32x16 __attribute__((vector_size(64)));
+typedef float f32x8 __attribute__((vector_size(32)));
+typedef float f32x16 __attribute__((vector_size(64)));
+typedef double f64x8 __attribute__((vector_size(64)));
+typedef double f64x16 __attribute__((vector_size(128)));
+
+inline u64x8 widen(u32x8 a) { return __builtin_convertvector(a, u64x8); }
+inline u64x16 widen(u32x16 a) {
+  return __builtin_convertvector(a, u64x16);
+}
+inline u32x8 narrow(u64x8 a) { return __builtin_convertvector(a, u32x8); }
+inline u32x16 narrow(u64x16 a) {
+  return __builtin_convertvector(a, u32x16);
+}
+inline f32x8 tofloat(i32x8 a) { return __builtin_convertvector(a, f32x8); }
+inline f32x16 tofloat(i32x16 a) {
+  return __builtin_convertvector(a, f32x16);
+}
+inline i32x8 toint(f32x8 a) { return __builtin_convertvector(a, i32x8); }
+inline i32x16 toint(f32x16 a) {
+  return __builtin_convertvector(a, i32x16);
+}
+inline f64x8 todouble(f32x8 a) {
+  return __builtin_convertvector(a, f64x8);
+}
+inline f64x16 todouble(f32x16 a) {
+  return __builtin_convertvector(a, f64x16);
+}
+inline f64x8 todouble(f64x8 a) { return a; }
+inline f32x16 fromdouble(f64x16 a, f32x16) {
+  return __builtin_convertvector(a, f32x16);
+}
+inline f64x8 fromdouble(f64x8 a, f64x8) { return a; }
+}  // namespace cvt
+
+template <int W>
+struct PhiloxVec {
+  using U32V = typename VecOf<uint32_t, W>::type;
+
+  static inline void mulhilo(U32V a, uint32_t m, U32V* hi, U32V* lo) {
+    const auto p = cvt::widen(a) * (uint64_t)m;
+    *lo = cvt::narrow(p & 0xffffffffu);
+    *hi = cvt::narrow(p >> 32);
+  }
+
+  // One 4x32 block for W independent lanes; key is bumped per round
+  // (k + r*W) — the jnp twin replicates this schedule exactly.
+  static inline void block(uint32_t k0, uint32_t k1, U32V c0, U32V c1,
+                           U32V c2, U32V c3, U32V out[4]) {
+    for (int r = 0; r < 10; ++r) {
+      U32V hi0, lo0, hi1, lo1;
+      mulhilo(c0, kPhiloxM0, &hi0, &lo0);
+      mulhilo(c2, kPhiloxM1, &hi1, &lo1);
+      const U32V n0 = hi1 ^ c1 ^ k0;
+      const U32V n2 = hi0 ^ c3 ^ k1;
+      c0 = n0;
+      c1 = lo1;
+      c2 = n2;
+      c3 = lo0;
+      k0 += kPhiloxW0;
+      k1 += kPhiloxW1;
+    }
+    out[0] = c0;
+    out[1] = c1;
+    out[2] = c2;
+    out[3] = c3;
+  }
+};
+
+inline void philox_scalar(uint32_t k0, uint32_t k1, uint32_t c0,
+                          uint32_t c1, uint32_t c2, uint32_t c3,
+                          uint32_t out[4]) {
+  for (int r = 0; r < 10; ++r) {
+    const uint64_t p0 = (uint64_t)kPhiloxM0 * c0;
+    const uint64_t p1 = (uint64_t)kPhiloxM1 * c2;
+    const uint32_t n0 = (uint32_t)(p1 >> 32) ^ c1 ^ k0;
+    const uint32_t n2 = (uint32_t)(p0 >> 32) ^ c3 ^ k1;
+    c1 = (uint32_t)p1;
+    c3 = (uint32_t)p0;
+    c0 = n0;
+    c2 = n2;
+    k0 += kPhiloxW0;
+    k1 += kPhiloxW1;
+  }
+  out[0] = c0;
+  out[1] = c1;
+  out[2] = c2;
+  out[3] = c3;
+}
+
+// Exact bits -> (0, 1) uniform: (bits >> 9) * 2^-23 + 2^-24 =
+// (2k + 1) * 2^-24 — every step representable in f32 (and identical in
+// f64), so the jnp twin produces bitwise-equal uniforms.
+template <typename T>
+inline T u01_of(uint32_t bits) {
+  return T(bits >> 9) * T(1.1920928955078125e-07)   // 2^-23
+         + T(5.9604644775390625e-08);               // 2^-24
+}
+
+// Counter domain tags: one kernel's stream can never collide with
+// another's under a reused key (ctr2 carries the tag).
+constexpr uint32_t kTagGamma = 0x67616d00u;  // "gam"
+constexpr uint32_t kTagBetaA = 0x62657400u;  // "bet" + which
+constexpr uint32_t kTagBetaB = 0x62657401u;
+
+// f32 vector ln/exp/cos(2*pi*u) — cephes-style polynomials (~1-2 ulp),
+// special values handled by blend overlays so non-finite inputs
+// propagate exactly like the scalar libm forms (the branchless
+// MH-reject contract). f64 callers get per-lane libm through the
+// vlog_t/vexp_t/vcos2pi_t wrappers below (the f64 kernels are the
+// parity oracles, not the hot path).
+template <int W>
+struct VMathF32 {
+  using V = typename VecOf<float, W>::type;
+  using IV = typename VecOf<int32_t, W>::type;
+
+  static inline V vlog(V x) {
+    const V zero = {};
+    const IV tiny = (x > zero) & (x < splat<float, W>(1.17549435e-38f));
+    V xs = tiny ? x * splat<float, W>(33554432.0f) : x;  // 2^25
+    const IV ib = (IV)xs;
+    IV e = ((ib >> 23) & 0xff) - 126;
+    V m = (V)((ib & 0x007fffff) | 0x3f000000);           // [0.5, 1)
+    const IV adj = m < splat<float, W>(0.70710678118654752f);
+    m = adj ? (m + m) : m;
+    e = e + adj;                                          // adj is -1/0
+    const V ef = cvt::tofloat(e);
+    const V f = m - splat<float, W>(1.0f);
+    const V z = f * f;
+    V p = splat<float, W>(7.0376836292e-2f);
+    p = p * f + splat<float, W>(-1.1514610310e-1f);
+    p = p * f + splat<float, W>(1.1676998740e-1f);
+    p = p * f + splat<float, W>(-1.2420140846e-1f);
+    p = p * f + splat<float, W>(1.4249322787e-1f);
+    p = p * f + splat<float, W>(-1.6668057665e-1f);
+    p = p * f + splat<float, W>(2.0000714765e-1f);
+    p = p * f + splat<float, W>(-2.4999993993e-1f);
+    p = p * f + splat<float, W>(3.3333331174e-1f);
+    V y = f * z * p;
+    y += ef * splat<float, W>(-2.12194440e-4f);
+    y -= splat<float, W>(0.5f) * z;
+    V r = f + y + ef * splat<float, W>(0.693359375f);
+    r = tiny ? r - splat<float, W>(17.3286795139986f) : r;  // 25 ln 2
+    const V inf = splat<float, W>(__builtin_inff());
+    r = (x == zero) ? -inf : r;
+    r = (x < zero) ? splat<float, W>(__builtin_nanf("")) : r;
+    r = (x == inf) ? inf : r;
+    r = (x != x) ? x : r;
+    return r;
+  }
+
+  static inline V vexp(V x) {
+    const V zero = {};
+    const V x0 = x;
+    V z = x * splat<float, W>(1.44269504088896341f);
+    IV n = cvt::toint(z + ((z < zero) ? splat<float, W>(-0.5f)
+                                       : splat<float, W>(0.5f)));
+    n = (n > 127) ? (IV{} + 127) : n;
+    n = (n < -126) ? (IV{} - 126) : n;
+    const V nf = cvt::tofloat(n);
+    x = x - nf * splat<float, W>(0.693359375f);
+    x = x - nf * splat<float, W>(-2.12194440e-4f);
+    V p = splat<float, W>(1.9875691500e-4f);
+    p = p * x + splat<float, W>(1.3981999507e-3f);
+    p = p * x + splat<float, W>(8.3334519073e-3f);
+    p = p * x + splat<float, W>(4.1665795894e-2f);
+    p = p * x + splat<float, W>(1.6666665459e-1f);
+    p = p * x + splat<float, W>(5.0000001201e-1f);
+    V r = p * (x * x) + x + splat<float, W>(1.0f);
+    r = r * (V)((n + 127) << 23);
+    const V inf = splat<float, W>(__builtin_inff());
+    r = (x0 > splat<float, W>(88.72f)) ? inf : r;
+    r = (x0 < splat<float, W>(-87.33f)) ? zero : r;
+    r = (x0 != x0) ? x0 : r;
+    return r;
+  }
+
+  // cos(2*pi*u) for u in [0, 1): shift to t in [-0.5, 0.5), negate the
+  // half-period, Taylor in t^2 to t^20 (trunc error ~4e-9 at |t|=0.5).
+  static inline V vcos2pi(V u) {
+    const V t = u - splat<float, W>(0.5f);
+    const V y = t * t;
+    V p = splat<float, W>(-3.6382841e-2f);   // -(2pi)^18/18!
+    p = p * y + splat<float, W>(2.8200597e-1f);
+    p = p * y + splat<float, W>(-1.7143907f);
+    p = p * y + splat<float, W>(7.9035364f);
+    p = p * y + splat<float, W>(-2.6426257e1f);
+    p = p * y + splat<float, W>(6.0244641e1f);
+    p = p * y + splat<float, W>(-8.5456817e1f);
+    p = p * y + splat<float, W>(6.4939394e1f);
+    p = p * y + splat<float, W>(-1.9739209e1f);
+    p = p * y + splat<float, W>(1.0f);
+    return -p;  // cos(2 pi u) = -cos(2 pi t)
+  }
+};
+
+template <typename T, int W>
+inline typename VecOf<T, W>::type vlog_t(typename VecOf<T, W>::type x) {
+  if constexpr (sizeof(T) == 4) {
+    return VMathF32<W>::vlog(x);
+  } else {
+    typename VecOf<T, W>::type r;
+    for (int l = 0; l < W; ++l) r[l] = std::log(x[l]);
+    return r;
+  }
+}
+
+template <typename T, int W>
+inline typename VecOf<T, W>::type vexp_t(typename VecOf<T, W>::type x) {
+  if constexpr (sizeof(T) == 4) {
+    return VMathF32<W>::vexp(x);
+  } else {
+    typename VecOf<T, W>::type r;
+    for (int l = 0; l < W; ++l) r[l] = std::exp(x[l]);
+    return r;
+  }
+}
+
+template <typename T, int W>
+inline typename VecOf<T, W>::type vcos2pi_t(
+    typename VecOf<T, W>::type u) {
+  if constexpr (sizeof(T) == 4) {
+    return VMathF32<W>::vcos2pi(u);
+  } else {
+    typename VecOf<T, W>::type r;
+    for (int l = 0; l < W; ++l)
+      r[l] = std::cos(6.283185307179586476925286766559 * u[l]);
+    return r;
+  }
+}
+
+template <typename T, int W>
+inline typename VecOf<T, W>::type vsqrt_t(typename VecOf<T, W>::type x) {
+  typename VecOf<T, W>::type r;
+  for (int l = 0; l < W; ++l) r[l] = std::sqrt(x[l]);
+  return r;
+}
+
+// ---------------------------------------------------------------------
+// draw kernels: integer-k Gamma(k/2) v2, fractional Beta
+// ---------------------------------------------------------------------
+
+// GST_FAST_GAMMA v2: Gamma(k/2) for integer k as
+//   -log( prod_{i < k/2} U_i )  +  (k odd) * 0.5 * N^2
+// with N one Box-Muller normal — distribution-exact, and ~3x fewer
+// transcendental bytes than the erfinv normal pool of the chi-square
+// arm (one double log + one BM sqrt/log/cos per ROW instead of kmax
+// erfinv evaluations; the product of <= jmax uniforms cannot
+// under/overflow a double, the chol_tile chunked-product discipline
+// taken to its limit). Uniform i of row r comes from philox block
+// (ctr0 = r, ctr1 = i/4, ctr2 = kTagGamma) word i%4 under the chain's
+// key — the layout ops/rng.py's jnp twin reproduces bitwise.
+template <typename T>
+void gamma_v2_batch(const uint32_t* keys, const T* counts, T* out,
+                    int64_t B, int64_t n, int64_t jmax) {
+  constexpr int W = Lanes<T>::W;
+  using V = typename VecOf<T, W>::type;
+  using D = typename VecOf<double, W>::type;
+  using PX = PhiloxVec<W>;
+  using U32V = typename PX::U32V;
+  const int64_t pool = jmax + 2;           // + the 2 Box-Muller uniforms
+  const int64_t nblk = (pool + 3) / 4;
+  V u[132];                                // pool <= 130 (handler-checked)
+  U32V lane_iota = {};
+  for (int l = 0; l < W; ++l) lane_iota[l] = (uint32_t)l;
+  for (int64_t c = 0; c < B; ++c) {
+    const uint32_t k0 = keys[2 * c], k1 = keys[2 * c + 1];
+    const T* cnt_row = counts + c * n;
+    T* out_row = out + c * n;
+    for (int64_t r0 = 0; r0 < n; r0 += W) {
+      const int64_t lanes = std::min<int64_t>(W, n - r0);
+      const U32V c0 = lane_iota + (uint32_t)r0;
+      for (int64_t blk = 0; blk < nblk; ++blk) {
+        U32V w4[4];
+        PX::block(k0, k1, c0, U32V{} + (uint32_t)blk,
+                  U32V{} + kTagGamma, U32V{}, w4);
+        for (int q = 0; q < 4; ++q) {
+          const int64_t idx = blk * 4 + q;
+          if (idx >= pool) break;
+          V uv;
+          for (int l = 0; l < W; ++l) uv[l] = u01_of<T>(w4[q][l]);
+          u[idx] = uv;
+        }
+      }
+      alignas(64) T ctmp[W];
+      for (int l = 0; l < W; ++l)
+        ctmp[l] = (l < lanes) ? cnt_row[r0 + l] : T(1);
+      D jd, oddv;
+      for (int l = 0; l < W; ++l) {
+        long k = (long)(double(ctmp[l]) + 0.5);
+        if (k < 0) k = 0;
+        long j = k >> 1;
+        if (j > jmax) j = jmax;
+        jd[l] = double(j);
+        oddv[l] = double(k & 1);
+      }
+      D prod = splat<double, W>(1.0);
+      const D done = splat<double, W>(1.0);
+      for (int64_t i = 0; i < jmax; ++i) {
+        const D ui = cvt::todouble(u[i]);
+        const D iv = splat<double, W>(double(i));
+        prod *= (iv < jd) ? ui : done;
+      }
+      D g;
+      for (int l = 0; l < W; ++l) g[l] = -std::log(prod[l]);
+      // odd-parity plane: one Box-Muller normal per row
+      const V r2 = splat<T, W>(T(-2)) * vlog_t<T, W>(u[jmax]);
+      const V nrm = vsqrt_t<T, W>(r2) * vcos2pi_t<T, W>(u[jmax + 1]);
+      alignas(64) T gout[W];
+      for (int l = 0; l < W; ++l)
+        gout[l] = T(g[l] + oddv[l] * 0.5 * double(nrm[l])
+                                   * double(nrm[l]));
+      for (int l = 0; l < lanes; ++l) out_row[r0 + l] = gout[l];
+    }
+  }
+}
+
+// Fractional-shape Gamma via Marsaglia-Tsang (2000) squeeze, the
+// textbook exact rejection sampler, with the a < 1 boost
+// Gamma(a) = Gamma(a+1) * U^(1/a). Per-attempt randomness is one
+// philox block (BM normal from words 0-1, squeeze uniform word 2;
+// word 3 of attempt 0 is the boost uniform), counters
+// (chain, attempt, tag+which) — unbounded attempts just advance ctr1.
+inline double gamma_mt_scalar(uint32_t k0, uint32_t k1, uint32_t chain,
+                              uint32_t tag, double alpha) {
+  if (!(alpha > 0.0)) return std::nan("");
+  const bool boost = alpha < 1.0;
+  double ub = 1.0;
+  const double d = (boost ? alpha + 1.0 : alpha) - 1.0 / 3.0;
+  const double cc = 1.0 / (3.0 * std::sqrt(d));
+  double g = 0.0;
+  for (uint32_t attempt = 0;; ++attempt) {
+    uint32_t w[4];
+    philox_scalar(k0, k1, chain, attempt, tag, 0u, w);
+    if (attempt == 0 && boost) ub = u01_of<double>(w[3]);
+    const double u0 = u01_of<double>(w[0]);
+    const double u1 = u01_of<double>(w[1]);
+    const double x = std::sqrt(-2.0 * std::log(u0))
+                     * std::cos(6.283185307179586476925286766559 * u1);
+    double v = 1.0 + cc * x;
+    if (v <= 0.0) continue;
+    v = v * v * v;
+    const double usq = u01_of<double>(w[2]);
+    if (std::log(usq)
+        < 0.5 * x * x + d - d * v + d * std::log(v)) {
+      g = d * v;
+      break;
+    }
+  }
+  if (boost) g *= std::pow(ub, 1.0 / alpha);
+  return g;
+}
+
+// theta ~ Beta(a, b) for per-chain fractional (a, b): two MT gammas,
+// theta = Ga / (Ga + Gb). ~2 blocks expected per chain — three orders
+// of magnitude less work than random.beta's per-element XLA rejection
+// While loops at the flagship batch.
+template <typename T>
+void beta_frac_batch(const uint32_t* keys, const T* a, const T* b,
+                     T* out, int64_t B) {
+  for (int64_t c = 0; c < B; ++c) {
+    const uint32_t k0 = keys[2 * c], k1 = keys[2 * c + 1];
+    const double ga = gamma_mt_scalar(k0, k1, (uint32_t)c, kTagBetaA,
+                                      double(a[c]));
+    const double gb = gamma_mt_scalar(k0, k1, (uint32_t)c, kTagBetaB,
+                                      double(b[c]));
+    out[c] = T(ga / (ga + gb));
+  }
+}
+
+// ---------------------------------------------------------------------
+// fused MH blocks: white-noise and hyper conditionals
+// ---------------------------------------------------------------------
+
+// Per-parameter prior table (models/parameter.lnprior_specs kinds
+// 0 = uniform, 1 = normal, 2 = log-uniform-in-linear), with the
+// q-independent constants precomputed once per kernel call so the
+// per-step evaluation is pure FMA/blend work.
+template <typename T>
+struct PriorTab {
+  int kind[64];
+  T a[64], b[64], c[64];
+  int64_t p;
+
+  void build(const T* specs, int64_t p_) {
+    p = p_;
+    for (int64_t i = 0; i < p; ++i) {
+      kind[i] = (int)specs[0 * p + i];
+      a[i] = specs[1 * p + i];
+      b[i] = specs[2 * p + i];
+      const double av = double(a[i]), bv = double(b[i]);
+      double cv = 0.0;
+      if (kind[i] == 0) {
+        cv = -std::log(bv - av);
+      } else if (kind[i] == 1) {
+        cv = -std::log(bv) - 0.91893853320467274178;  // 0.5 log 2pi
+      } else if (kind[i] == 2) {
+        cv = std::log(2.302585092994045684
+                      / (std::pow(10.0, bv) - std::pow(10.0, av)));
+      }
+      c[i] = T(cv);
+    }
+  }
+
+  template <int W>
+  inline typename VecOf<T, W>::type lp_sum(
+      const typename VecOf<T, W>::type* q) const {
+    using V = typename VecOf<T, W>::type;
+    const V ninf = splat<T, W>(-std::numeric_limits<T>::infinity());
+    V lp = {};
+    for (int64_t i = 0; i < p; ++i) {
+      const V qi = q[i];
+      V el;
+      if (kind[i] == 1) {
+        const V z = (qi - splat<T, W>(a[i])) / splat<T, W>(b[i]);
+        el = splat<T, W>(c[i]) - splat<T, W>(T(0.5)) * z * z;
+      } else {
+        const auto inb = (qi >= splat<T, W>(a[i]))
+                         & (qi <= splat<T, W>(b[i]));
+        if (kind[i] == 0) {
+          el = inb ? splat<T, W>(c[i]) : ninf;
+        } else if (kind[i] == 2) {
+          el = inb ? (qi * splat<T, W>(T(2.302585092994045684))
+                      + splat<T, W>(c[i]))
+                   : ninf;
+        } else {
+          el = ninf;
+        }
+      }
+      lp += el;
+    }
+    return lp;
+  }
+};
+
+// The whole white-noise MH block for a chain tile in one call — the
+// native arm of ops/pallas_white.make_white_block's dispatch (CPU
+// counterpart of the Pallas kernel; XLA oracle white_mh_loop_xla).
+// rows (R, n) and specs (3, p) are SHARED across chains; var
+// (nvar, 3) carries the static (kind, x_index, row_slot) triples.
+template <typename T>
+void white_mh_batch(const T* x, const T* az, const T* y2, const T* dx,
+                    const T* logu, const T* rows, const T* specs,
+                    const int32_t* var, int64_t nvar, T* xo, T* acc,
+                    int64_t B, int64_t p, int64_t n, int64_t S,
+                    int64_t R) {
+  (void)R;
+  constexpr int W = Lanes<T>::W;
+  using V = typename VecOf<T, W>::type;
+  using MI = typename MaskInt<T>::type;
+  typedef MI IV __attribute__((vector_size(W * sizeof(T))));
+  PriorTab<T> pt;
+  pt.build(specs, p);
+  const T* nv0 = rows;            // row 0: folded baseline variance
+  const T* rmask = rows + n;      // row 1: real-TOA mask
+  Scratch<T> xt(size_t(p) * W), azt(size_t(n) * W), y2t(size_t(n) * W),
+      dxt(size_t(S) * p * W), lut(size_t(S) * W), qt(size_t(p) * W);
+  const V one = splat<T, W>(T(1));
+  for (int64_t b0 = 0; b0 < B; b0 += W) {
+    const int64_t lanes = std::min<int64_t>(W, B - b0);
+    load_tile<T, W>(x, xt.get(), b0, lanes, p, p);
+    load_tile<T, W>(az, azt.get(), b0, lanes, n, n);
+    load_tile<T, W>(y2, y2t.get(), b0, lanes, n, n);
+    load_tile<T, W>(dx, dxt.get(), b0, lanes, S * p, S * p);
+    load_tile<T, W>(logu, lut.get(), b0, lanes, S, S);
+    V* xv = reinterpret_cast<V*>(xt.get());
+    V* qv = reinterpret_cast<V*>(qt.get());
+    const V* azv = reinterpret_cast<const V*>(azt.get());
+    const V* y2v = reinterpret_cast<const V*>(y2t.get());
+    const V* dxv = reinterpret_cast<const V*>(dxt.get());
+    const V* luv = reinterpret_cast<const V*>(lut.get());
+
+    auto ll_of = [&](const V* q) -> V {
+      V coef[16];
+      for (int64_t g = 0; g < nvar; ++g) {
+        const V qi = q[var[3 * g + 1]];
+        coef[g] = (var[3 * g] == 0)
+                      ? qi * qi
+                      : vexp_t<T, W>(qi
+                                     * splat<T, W>(
+                                           T(4.605170185988091368)));
+      }
+      V sll = {}, sq = {};
+      for (int64_t k = 0; k < n; ++k) {
+        V nd = splat<T, W>(nv0[k]);
+        for (int64_t g = 0; g < nvar; ++g)
+          nd += coef[g] * splat<T, W>(rows[var[3 * g + 2] * n + k]);
+        const V rm = splat<T, W>(rmask[k]);
+        const V nv = rm * (azv[k] * nd) + (one - rm);
+        sll += vlog_t<T, W>(nv);
+        sq += y2v[k] / nv;
+      }
+      return splat<T, W>(T(-0.5)) * (sll + sq);
+    };
+
+    V ll0 = ll_of(xv);
+    V lp0 = pt.template lp_sum<W>(xv);
+    V accv = {};
+    for (int64_t s = 0; s < S; ++s) {
+      for (int64_t i = 0; i < p; ++i) qv[i] = xv[i] + dxv[s * p + i];
+      const V ll1 = ll_of(qv);
+      const V lp1 = pt.template lp_sum<W>(qv);
+      const V delta = (ll1 + lp1) - (ll0 + lp0);
+      const IV am = delta > luv[s];          // NaN compares false
+      for (int64_t i = 0; i < p; ++i) xv[i] = am ? qv[i] : xv[i];
+      ll0 = am ? ll1 : ll0;
+      lp0 = am ? lp1 : lp0;
+      accv += am ? one : V{};
+    }
+    store_tile<T, W>(xt.get(), xo, b0, lanes, p, p);
+    alignas(64) T atmp[W];
+    const V arate = accv / splat<T, W>(T(S));
+    for (int l = 0; l < W; ++l) atmp[l] = arate[l];
+    for (int l = 0; l < lanes; ++l) acc[b0 + l] = atmp[l];
+  }
+}
+
+// Per-tile hyper-MH machinery, shared by the standalone hyper block
+// handler and the fused schur+hyper+draws megastage. The affine phi
+// structure (K rows / sel / static addend) and prior table are
+// call-level constants; S0 stays tile-resident across all proposals.
+template <typename T, int W>
+struct HyperTile {
+  using V = typename VecOf<T, W>::type;
+  using MI = typename MaskInt<T>::type;
+  using IV = typename VecOf<MI, W>::type;
+  using D = typename VecOf<double, W>::type;
+
+  const T* K;              // (1 + nk, v) shared rows
+  const T* sel;            // (v,)
+  const int32_t* hypidx;   // (nk,)
+  int64_t nk, v, p;
+  T jitter;
+  const PriorTab<T>* pt;
+  const V* S0t;            // (v, v, W) lower-valid pristine tile
+  const V* dS0t;           // (v, W) diag + static phiinv
+  const V* rtt;            // (v, W)
+  T* work;                 // (v, v, W) scratch
+  T* ld;                   // (W,)
+  T* rp;                   // (v, W) scratch rhs
+
+  // (phiinv, sum_lph) per column plane for proposal q; phiinv lands in
+  // ``phi_out`` ((v, W) scratch).
+  inline V phi_eval(const V* q, V* phi_out) const {
+    V sum_lph = {};
+    for (int64_t c = 0; c < v; ++c) {
+      V lph = splat<T, W>(K[c]);
+      for (int64_t k = 0; k < nk; ++k)
+        lph += splat<T, W>(K[(1 + k) * v + c]) * q[hypidx[k]];
+      const V s = splat<T, W>(sel[c]);
+      phi_out[c] = s * vexp_t<T, W>(-lph);
+      sum_lph += s * lph;
+    }
+    return sum_lph;
+  }
+
+  // Marginalized log-likelihood + prior of proposal q: equilibrated
+  // Cholesky with fused forward solve (logdet/quad only — the
+  // hyper_mh_loop_xla math, lane-batched).
+  inline void ll_lp(const V* q, V* phi, V base, V* ll_out,
+                    V* lp_out) const {
+    const V sum_lph = phi_eval(q, phi);
+    V* w = reinterpret_cast<V*>(work);
+    V* rpv = reinterpret_cast<V*>(rp);
+    // d = dS0 + phiinv; isd = 1/sqrt(d); chunked-double log sum
+    V sum_logd = {};
+    {
+      D prod = splat<double, W>(1.0);
+      int since = 0;
+      for (int64_t c = 0; c < v; ++c) {
+        const V d = dS0t[c] + phi[c];
+        const V isd = splat<T, W>(T(1)) / vsqrt_t<T, W>(d);
+        phi[c] = isd;                        // reuse the plane for isd
+        rpv[c] = rtt[c] * isd;
+        const D dd = cvt::todouble(d);
+        prod *= dd;
+        if (++since == 4 || c == v - 1) {
+          for (int l = 0; l < W; ++l) prod[l] = std::log(prod[l]);
+          sum_logd += cvt::fromdouble(prod, V{});
+          prod = splat<double, W>(1.0);
+          since = 0;
+        }
+      }
+    }
+    // equilibrated matrix straight into the work tile: off-diagonal
+    // (S0_ij * isd_i) * isd_j, unit diagonal written as 1 + jitter
+    // (the hyper_mh_loop_xla construction)
+    const V dj = splat<T, W>(T(1) + jitter);
+    for (int64_t j = 0; j < v; ++j) {
+      const V isdj = phi[j];
+      for (int64_t i = j + 1; i < v; ++i)
+        w[i * v + j] = (S0t[i * v + j] * phi[i]) * isdj;
+      w[j * v + j] = dj;
+    }
+    chol_tile<T, W>(work, ld, v);
+    fwd_tile<T, W>(work, rp, v);
+    V quad = {};
+    for (int64_t c = 0; c < v; ++c) quad += rpv[c] * rpv[c];
+    const V ldv = *reinterpret_cast<const V*>(ld);
+    V ll = base + splat<T, W>(T(0.5))
+                      * (quad - (ldv + sum_logd) - sum_lph);
+    const V zero = {};
+    const IV fin = ((ll - ll) == zero);
+    ll = fin ? ll : splat<T, W>(-std::numeric_limits<T>::infinity());
+    *ll_out = ll;
+    *lp_out = pt->template lp_sum<W>(q);
+  }
+
+  // The full MH loop over precomputed draws; x/acc updated in place.
+  inline void run(V* xv, const V* dxv, const V* luv, V base, V* phi,
+                  int64_t S, V* acc_out, V* qv) const {
+    V ll0, lp0;
+    ll_lp(xv, phi, base, &ll0, &lp0);
+    V accv = {};
+    const V one = splat<T, W>(T(1));
+    for (int64_t s = 0; s < S; ++s) {
+      for (int64_t i = 0; i < p; ++i) qv[i] = xv[i] + dxv[s * p + i];
+      V ll1, lp1;
+      ll_lp(qv, phi, base, &ll1, &lp1);
+      const V delta = (ll1 + lp1) - (ll0 + lp0);
+      const IV am = delta > luv[s];
+      for (int64_t i = 0; i < p; ++i) xv[i] = am ? qv[i] : xv[i];
+      ll0 = am ? ll1 : ll0;
+      lp0 = am ? lp1 : lp0;
+      accv += am ? one : V{};
+    }
+    *acc_out = accv / splat<T, W>(T(S));
+  }
+};
+
+// Standalone native hyper-MH block (GST_NHYPER): the
+// hyper_mh_loop_xla contract, one custom call for the whole block.
+template <typename T>
+void hyper_mh_batch(const T* x, const T* S0, const T* dS0, const T* rt,
+                    const T* base, const T* dx, const T* logu,
+                    const T* K, const T* sel, const T* specs,
+                    const int32_t* hypidx, int64_t nk, T jitter, T* xo,
+                    T* acc, int64_t B, int64_t p, int64_t v, int64_t S) {
+  constexpr int W = Lanes<T>::W;
+  using V = typename VecOf<T, W>::type;
+  PriorTab<T> pt;
+  pt.build(specs, p);
+  Scratch<T> S0t(size_t(v) * v * W), dS0t(size_t(v) * W),
+      rtt(size_t(v) * W), xt(size_t(p) * W), qt(size_t(p) * W),
+      dxt(size_t(S) * p * W), lut(size_t(S) * W), bt(W),
+      work(size_t(v) * v * W), ld(W), rp(size_t(v) * W),
+      phi(size_t(v) * W);
+  for (int64_t b0 = 0; b0 < B; b0 += W) {
+    const int64_t lanes = std::min<int64_t>(W, B - b0);
+    load_tile_lower<T, W>(S0, S0t.get(), b0, lanes, v, v * v);
+    load_tile<T, W>(dS0, dS0t.get(), b0, lanes, v, v);
+    load_tile<T, W>(rt, rtt.get(), b0, lanes, v, v);
+    load_tile<T, W>(x, xt.get(), b0, lanes, p, p);
+    load_tile<T, W>(dx, dxt.get(), b0, lanes, S * p, S * p);
+    load_tile<T, W>(logu, lut.get(), b0, lanes, S, S);
+    load_tile<T, W>(base, bt.get(), b0, lanes, 1, 1);
+    HyperTile<T, W> ht{K, sel, hypidx, nk, v, p, jitter, &pt,
+                       reinterpret_cast<const V*>(S0t.get()),
+                       reinterpret_cast<const V*>(dS0t.get()),
+                       reinterpret_cast<const V*>(rtt.get()),
+                       work.get(), ld.get(), rp.get()};
+    V accv;
+    ht.run(reinterpret_cast<V*>(xt.get()),
+           reinterpret_cast<const V*>(dxt.get()),
+           reinterpret_cast<const V*>(lut.get()),
+           *reinterpret_cast<const V*>(bt.get()),
+           reinterpret_cast<V*>(phi.get()), S, &accv,
+           reinterpret_cast<V*>(qt.get()));
+    store_tile<T, W>(xt.get(), xo, b0, lanes, p, p);
+    alignas(64) T atmp[W];
+    for (int l = 0; l < W; ++l) atmp[l] = accv[l];
+    for (int l = 0; l < lanes; ++l) acc[b0 + l] = atmp[l];
+  }
+}
+
+// ---------------------------------------------------------------------
+// fused Schur pre-elimination (+ the hyper+draws megastage)
+// ---------------------------------------------------------------------
+
+// Per-tile Schur elimination (ops/linalg.py schur_eliminate with
+// return_factor=True): equilibrated A-block factor, the multi-rhs
+// forward/backward solves, and the S0/rt assembly matmuls in one pass.
+// At (equilibrated in place -> La), u ((ns, nv+1, W)) and w (same) are
+// caller scratch; outputs land in isd/ldA/quad/S0/rt tiles.
+template <typename T, int W>
+inline void schur_tile(T* At, const T* Bt, const T* Ct, const T* rst,
+                       const T* rvt, T jitter, T* isd_t, T* ldA_t,
+                       T* quad_t, T* u_t, T* w_t, T* S0_t, T* rt_t,
+                       T* lds, int64_t ns, int64_t nv) {
+  using V = typename VecOf<T, W>::type;
+  using D = typename VecOf<double, W>::type;
+  V* a = reinterpret_cast<V*>(At);
+  V* isd = reinterpret_cast<V*>(isd_t);
+  const V* bv = reinterpret_cast<const V*>(Bt);
+  const V* cv = reinterpret_cast<const V*>(Ct);
+  const V* rs = reinterpret_cast<const V*>(rst);
+  const V* rv = reinterpret_cast<const V*>(rvt);
+  V* u = reinterpret_cast<V*>(u_t);
+  V* w = reinterpret_cast<V*>(w_t);
+  V* S0v = reinterpret_cast<V*>(S0_t);
+  V* rtv = reinterpret_cast<V*>(rt_t);
+  const int64_t k = nv + 1;
+  // equilibrate A: d = diag, isd = 1/sqrt(d), logd via chunked-double
+  V logd = {};
+  {
+    D prod = splat<double, W>(1.0);
+    int since = 0;
+    for (int64_t i = 0; i < ns; ++i) {
+      const V d = a[i * ns + i];
+      isd[i] = splat<T, W>(T(1)) / vsqrt_t<T, W>(d);
+      prod *= cvt::todouble(d);
+      if (++since == 4 || i == ns - 1) {
+        for (int l = 0; l < W; ++l) prod[l] = std::log(prod[l]);
+        logd += cvt::fromdouble(prod, V{});
+        prod = splat<double, W>(1.0);
+        since = 0;
+      }
+    }
+  }
+  const V jv = splat<T, W>(jitter);
+  for (int64_t j = 0; j < ns; ++j) {
+    const V isdj = isd[j];
+    for (int64_t i = j; i < ns; ++i)
+      a[i * ns + j] = (a[i * ns + j] * isd[i]) * isdj;
+    a[j * ns + j] += jv;
+  }
+  chol_tile<T, W>(At, lds, ns);            // At now holds La
+  const V ldSv = *reinterpret_cast<const V*>(lds);
+  V* ldA = reinterpret_cast<V*>(ldA_t);
+  ldA[0] = ldSv + logd;
+  // u = La^-1 ( [B | rhs_s] * isd_a[:, None] )
+  for (int64_t i = 0; i < ns; ++i) {
+    const V isdi = isd[i];
+    for (int64_t j = 0; j < nv; ++j)
+      u[i * k + j] = bv[i * nv + j] * isdi;
+    u[i * k + nv] = rs[i] * isdi;
+  }
+  fwd_mat_tile<T, W>(At, u_t, ns, k);
+  std::memcpy(w_t, u_t, size_t(ns) * k * W * sizeof(T));
+  bwd_mat_tile<T, W>(At, w_t, ns, k);
+  for (int64_t i = 0; i < ns; ++i) {
+    const V isdi = isd[i];
+    for (int64_t j = 0; j < k; ++j) w[i * k + j] *= isdi;
+  }
+  V quad = {};
+  for (int64_t i = 0; i < ns; ++i) quad += rs[i] * w[i * k + nv];
+  reinterpret_cast<V*>(quad_t)[0] = quad;
+  // S0 = C - B^T w[:, :nv]  (full matrix, 4-column register blocking);
+  // rt = rhs_v - B^T w[:, nv]
+  for (int64_t i = 0; i < nv; ++i) {
+    int64_t j = 0;
+    for (; j + 4 <= nv; j += 4) {
+      V s0 = cv[i * nv + j], s1 = cv[i * nv + j + 1],
+        s2 = cv[i * nv + j + 2], s3 = cv[i * nv + j + 3];
+      for (int64_t kk = 0; kk < ns; ++kk) {
+        const V bki = bv[kk * nv + i];
+        const V* wk = w + kk * k + j;
+        s0 -= bki * wk[0];
+        s1 -= bki * wk[1];
+        s2 -= bki * wk[2];
+        s3 -= bki * wk[3];
+      }
+      S0v[i * nv + j] = s0;
+      S0v[i * nv + j + 1] = s1;
+      S0v[i * nv + j + 2] = s2;
+      S0v[i * nv + j + 3] = s3;
+    }
+    for (; j < nv; ++j) {
+      V s = cv[i * nv + j];
+      for (int64_t kk = 0; kk < ns; ++kk)
+        s -= bv[kk * nv + i] * w[kk * k + j];
+      S0v[i * nv + j] = s;
+    }
+    V r = rv[i];
+    for (int64_t kk = 0; kk < ns; ++kk)
+      r -= bv[kk * nv + i] * w[kk * k + nv];
+    rtv[i] = r;
+  }
+}
+
+template <typename T>
+void schur_batch(const T* A, const T* Bm, const T* C, const T* rhs_s,
+                 const T* rhs_v, T jitter, T* S0, T* rt, T* quad_s,
+                 T* logdetA, T* La, T* isd_a, T* U_B, T* u_s, int64_t B,
+                 int64_t ns, int64_t nv) {
+  constexpr int W = Lanes<T>::W;
+  using V = typename VecOf<T, W>::type;
+  const int64_t k = nv + 1;
+  Scratch<T> At(size_t(ns) * ns * W), Bt(size_t(ns) * nv * W),
+      Ct(size_t(nv) * nv * W), rst(size_t(ns) * W), rvt(size_t(nv) * W),
+      isd(size_t(ns) * W), ldA(W), quad(W), ut(size_t(ns) * k * W),
+      wt(size_t(ns) * k * W), S0t(size_t(nv) * nv * W),
+      rtt(size_t(nv) * W), lds(W), ubt(size_t(ns) * nv * W),
+      ust(size_t(ns) * W);
+  std::memset(La, 0, size_t(B) * ns * ns * sizeof(T));
+  for (int64_t b0 = 0; b0 < B; b0 += W) {
+    const int64_t lanes = std::min<int64_t>(W, B - b0);
+    load_tile_lower<T, W>(A, At.get(), b0, lanes, ns, ns * ns);
+    load_tile<T, W>(Bm, Bt.get(), b0, lanes, ns * nv, ns * nv);
+    load_tile<T, W>(C, Ct.get(), b0, lanes, nv * nv, nv * nv);
+    load_tile<T, W>(rhs_s, rst.get(), b0, lanes, ns, ns);
+    load_tile<T, W>(rhs_v, rvt.get(), b0, lanes, nv, nv);
+    schur_tile<T, W>(At.get(), Bt.get(), Ct.get(), rst.get(), rvt.get(),
+                     jitter, isd.get(), ldA.get(), quad.get(), ut.get(),
+                     wt.get(), S0t.get(), rtt.get(), lds.get(), ns, nv);
+    // U_B = u[:, :nv], u_s = u[:, nv] (contiguous repack for the store)
+    const V* u = reinterpret_cast<const V*>(ut.get());
+    V* ub = reinterpret_cast<V*>(ubt.get());
+    V* us = reinterpret_cast<V*>(ust.get());
+    for (int64_t i = 0; i < ns; ++i) {
+      for (int64_t j = 0; j < nv; ++j) ub[i * nv + j] = u[i * k + j];
+      us[i] = u[i * k + nv];
+    }
+    store_tile<T, W>(S0t.get(), S0, b0, lanes, nv * nv, nv * nv);
+    store_tile<T, W>(rtt.get(), rt, b0, lanes, nv, nv);
+    store_tile<T, W>(quad.get(), quad_s, b0, lanes, 1, 1);
+    store_tile<T, W>(ldA.get(), logdetA, b0, lanes, 1, 1);
+    store_tile_lower<T, W>(At.get(), La, b0, lanes, ns, ns * ns);
+    store_tile<T, W>(isd.get(), isd_a, b0, lanes, ns, ns);
+    store_tile<T, W>(ubt.get(), U_B, b0, lanes, ns * nv, ns * nv);
+    store_tile<T, W>(ust.get(), u_s, b0, lanes, ns, ns);
+  }
+}
+
+// GST_FUSE_STAGES: the hyper+draws megastage — Schur pre-elimination,
+// the whole hyper-MH block, and the coefficient draw's robust v-block
+// factorization + block-assembled backward solves, as ONE custom call.
+// Inputs mirror the per-stage composition exactly (same operands, same
+// randomness); outputs are the accepted x, the block acceptance rate,
+// and the draw pieces (y_v, isd_v, y_s, isd_a) the caller scatters
+// into b. Sub-kernels are the SAME tile functions the per-stage arms
+// run, so fuse on/off native paths agree bitwise.
+template <typename T>
+void fused_hyper_batch(const T* A, const T* Bm, const T* C,
+                       const T* rhs_s, const T* rhs_v, const T* x,
+                       const T* dx, const T* logu, const T* xi,
+                       const T* base0, const T* K, const T* sel,
+                       const T* phist, const T* specs,
+                       const int32_t* hypidx, int64_t nk, T jitter,
+                       const T* jits, int64_t nlev,
+                       T* xo, T* acc, T* y_v, T* isd_v_o, T* y_s,
+                       T* isd_a_o, int64_t B, int64_t p, int64_t ns,
+                       int64_t nv, int64_t S) {
+  constexpr int W = Lanes<T>::W;
+  using V = typename VecOf<T, W>::type;
+  PriorTab<T> pt;
+  pt.build(specs, p);
+  const int64_t k = nv + 1;
+  const int64_t m = ns + nv;
+  Scratch<T> At(size_t(ns) * ns * W), Bt(size_t(ns) * nv * W),
+      Ct(size_t(nv) * nv * W), rst(size_t(ns) * W), rvt(size_t(nv) * W),
+      isd(size_t(ns) * W), ldA(W), quad(W), ut(size_t(ns) * k * W),
+      wt(size_t(ns) * k * W), S0t(size_t(nv) * nv * W),
+      rtt(size_t(nv) * W), lds(W), xt(size_t(p) * W), qt(size_t(p) * W),
+      dxt(size_t(S) * p * W), lut(size_t(S) * W), bt(W),
+      dS0t(size_t(nv) * W), work(size_t(nv) * nv * W), ld(W),
+      rp(size_t(nv) * W), phi(size_t(nv) * W), xit(size_t(m) * W),
+      prist(size_t(nv) * nv * W), yv(size_t(nv) * W), ldsel(W),
+      yt(size_t(nv) * W), yst(size_t(ns) * W);
+  for (int64_t b0 = 0; b0 < B; b0 += W) {
+    const int64_t lanes = std::min<int64_t>(W, B - b0);
+    load_tile_lower<T, W>(A, At.get(), b0, lanes, ns, ns * ns);
+    load_tile<T, W>(Bm, Bt.get(), b0, lanes, ns * nv, ns * nv);
+    load_tile<T, W>(C, Ct.get(), b0, lanes, nv * nv, nv * nv);
+    load_tile<T, W>(rhs_s, rst.get(), b0, lanes, ns, ns);
+    load_tile<T, W>(rhs_v, rvt.get(), b0, lanes, nv, nv);
+    load_tile<T, W>(x, xt.get(), b0, lanes, p, p);
+    load_tile<T, W>(dx, dxt.get(), b0, lanes, S * p, S * p);
+    load_tile<T, W>(logu, lut.get(), b0, lanes, S, S);
+    load_tile<T, W>(xi, xit.get(), b0, lanes, m, m);
+    load_tile<T, W>(base0, bt.get(), b0, lanes, 1, 1);
+    // stage 1: Schur pre-elimination (At -> La, tiles stay resident)
+    schur_tile<T, W>(At.get(), Bt.get(), Ct.get(), rst.get(), rvt.get(),
+                     jitter, isd.get(), ldA.get(), quad.get(), ut.get(),
+                     wt.get(), S0t.get(), rtt.get(), lds.get(), ns, nv);
+    // stage 2: the hyper MH block on the eliminated system
+    V* S0v = reinterpret_cast<V*>(S0t.get());
+    V* dS0v = reinterpret_cast<V*>(dS0t.get());
+    for (int64_t c = 0; c < nv; ++c)
+      dS0v[c] = S0v[c * nv + c] + splat<T, W>(phist[c]);
+    const V base =
+        *reinterpret_cast<const V*>(bt.get())
+        + splat<T, W>(T(0.5))
+              * (reinterpret_cast<const V*>(quad.get())[0]
+                 - reinterpret_cast<const V*>(ldA.get())[0]);
+    HyperTile<T, W> ht{K, sel, hypidx, nk, nv, p, jitter, &pt,
+                       reinterpret_cast<const V*>(S0t.get()),
+                       reinterpret_cast<const V*>(dS0t.get()),
+                       reinterpret_cast<const V*>(rtt.get()),
+                       work.get(), ld.get(), rp.get()};
+    V accv;
+    V* xv = reinterpret_cast<V*>(xt.get());
+    ht.run(xv, reinterpret_cast<const V*>(dxt.get()),
+           reinterpret_cast<const V*>(lut.get()), base,
+           reinterpret_cast<V*>(phi.get()), S, &accv,
+           reinterpret_cast<V*>(qt.get()));
+    // stage 3: the b-draw — robust v-block factor + assembled solves.
+    // d_b = dS0 + phiinv(x_accepted); equilibrate the PRISTINE S0 (the
+    // robust_precond_draw construction: diagonal (d*isd)*isd, jitter
+    // only per escalation level)
+    V* phiv = reinterpret_cast<V*>(phi.get());
+    ht.phi_eval(xv, phiv);
+    V* pr = reinterpret_cast<V*>(prist.get());
+    V* rpv = reinterpret_cast<V*>(rp.get());
+    for (int64_t c = 0; c < nv; ++c) {
+      const V d = dS0v[c] + phiv[c];
+      const V isdc = splat<T, W>(T(1)) / vsqrt_t<T, W>(d);
+      phiv[c] = isdc;                       // now isd_v
+      rpv[c] = reinterpret_cast<const V*>(rtt.get())[c] * isdc;
+      pr[c * nv + c] = (d * isdc) * isdc;
+    }
+    for (int64_t j = 0; j < nv; ++j) {
+      const V isdj = phiv[j];
+      for (int64_t i = j + 1; i < nv; ++i)
+        pr[i * nv + j] = (S0v[i * nv + j] * phiv[i]) * isdj;
+    }
+    robust_tile<T, W>(prist.get(), rp.get(),
+                      xit.get() + size_t(ns) * W, jits, nlev, yv.get(),
+                      ldsel.get(), work.get(), yt.get(), ld.get(), nv);
+    // y_s = La^-T (u_s + xi_s - U_B (isd_v * y_v))
+    const V* u = reinterpret_cast<const V*>(ut.get());
+    const V* yvv = reinterpret_cast<const V*>(yv.get());
+    const V* xiv = reinterpret_cast<const V*>(xit.get());
+    V* ys = reinterpret_cast<V*>(yst.get());
+    for (int64_t c = 0; c < nv; ++c)
+      reinterpret_cast<V*>(yt.get())[c] = phiv[c] * yvv[c];
+    const V* sy = reinterpret_cast<const V*>(yt.get());
+    for (int64_t i = 0; i < ns; ++i) {
+      V wty = {};
+      for (int64_t j = 0; j < nv; ++j) wty += u[i * k + j] * sy[j];
+      ys[i] = u[i * k + nv] + xiv[i] - wty;
+    }
+    bwd_tile<T, W>(At.get(), yst.get(), ns);
+    // stores
+    store_tile<T, W>(xt.get(), xo, b0, lanes, p, p);
+    alignas(64) T atmp[W];
+    for (int l = 0; l < W; ++l) atmp[l] = accv[l];
+    for (int l = 0; l < lanes; ++l) acc[b0 + l] = atmp[l];
+    store_tile<T, W>(yv.get(), y_v, b0, lanes, nv, nv);
+    store_tile<T, W>(phi.get(), isd_v_o, b0, lanes, nv, nv);
+    store_tile<T, W>(yst.get(), y_s, b0, lanes, ns, ns);
+    store_tile<T, W>(isd.get(), isd_a_o, b0, lanes, ns, ns);
   }
 }
 

@@ -615,3 +615,550 @@ def test_committed_so_symbol_set_fresh(tmp_path):
         f"committed .so is stale: missing {sorted(want - have)}, "
         f"extra {sorted(have - want)} — rebuild with make -C native "
         "and commit the result")
+
+
+# ----------------------------------------------------------------------
+# round 9: philox streams, draw kernels, MH blocks, schur, megastage
+# ----------------------------------------------------------------------
+
+
+def test_philox_stream_pinned_against_jnp_twin():
+    """The in-kernel counter-based RNG and the jnp twin (ops/rng.py)
+    produce BITWISE-equal words and uniforms: same key/counter layout,
+    same round schedule, same exact bits->uniform map. This is the pin
+    that makes the native and jnp arms of every draw kernel the same
+    distribution by construction, not by statistics."""
+    import ctypes
+
+    from gibbs_student_t_tpu import native as native_mod
+    from gibbs_student_t_tpu.ops import rng as grng
+
+    _require_kernels()
+    lib = native_mod.load()
+    k0, k1, row, tag = 0xDEADBEEF, 0x12345678, 7, int(grng.TAG_GAMMA)
+    count = 37
+    out = np.zeros(count, np.uint32)
+    lib.gst_philox_fill(
+        ctypes.c_uint32(k0), ctypes.c_uint32(k1), ctypes.c_uint32(row),
+        ctypes.c_uint32(tag),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        ctypes.c_longlong(count))
+    nblk = (count + 3) // 4
+    w = grng.philox_4x32(np.uint32(k0), np.uint32(k1),
+                         np.full(nblk, row, np.uint32),
+                         np.arange(nblk, dtype=np.uint32),
+                         np.full(nblk, tag, np.uint32),
+                         np.zeros(nblk, np.uint32))
+    bits = np.stack([np.asarray(x) for x in w], -1).reshape(-1)[:count]
+    np.testing.assert_array_equal(out, bits)
+    # the uniform map is exact float arithmetic: bitwise too
+    u_j = np.asarray(grng.uniform_of_bits(bits, jnp.float32))
+    u_ref = ((bits >> 9).astype(np.float32) * np.float32(2.0 ** -23)
+             + np.float32(2.0 ** -24))
+    np.testing.assert_array_equal(u_j, u_ref)
+    assert (u_ref > 0.0).all() and (u_ref < 1.0).all()
+
+
+def test_gamma_v2_kernel_matches_jnp_twin():
+    """Native gamma-v2 vs the jnp philox twin on identical keys: same
+    streams, values agree to the transcendental-ulp level (the kernel
+    accumulates the uniform product in a double and pays one log; the
+    twin chunks in the working dtype)."""
+    from gibbs_student_t_tpu.ops import rng as grng
+
+    _require_kernels()
+    rng = np.random.default_rng(0)
+    B, n, jmax = 33, 21, 15
+    keys = jnp.asarray(rng.integers(0, 2 ** 32, (B, 2), dtype=np.uint32))
+    counts = jnp.asarray(rng.integers(1, 32, (B, n)), jnp.float32)
+    gk = np.asarray(nffi.gamma_v2(keys, counts, jmax))
+    gt = np.asarray(jax.vmap(
+        lambda k2, c: grng.gamma_halfint_v2(k2, c, jmax))(keys, counts))
+    np.testing.assert_allclose(gk, gt, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("k", [1, 2, 7, 31])
+def test_gamma_v2_distribution_pins(k):
+    """Moment + KS pins of the v2 construction against the chi-square
+    law it must reproduce exactly: Gamma(k/2) = 0.5 * chi^2_k, per
+    integer k (even: pure -log prod U; odd: + the Box-Muller plane)."""
+    from scipy import stats
+
+    _require_kernels()
+    rng = np.random.default_rng(100 + k)
+    N = 40000
+    keys = jnp.asarray(rng.integers(0, 2 ** 32, (N, 2), dtype=np.uint32))
+    counts = jnp.full((N, 1), float(k), jnp.float32)
+    g = np.asarray(nffi.gamma_v2(keys, counts, 15))[:, 0]
+    mean, var = k / 2.0, k / 2.0
+    assert abs(g.mean() - mean) < 5.0 * np.sqrt(var / N) + 0.01
+    assert abs(g.var() - var) < 0.08 * var + 0.02
+    ks = stats.kstest(2.0 * g, stats.chi2(df=k).cdf)
+    assert ks.pvalue > 1e-3, f"KS p={ks.pvalue} for k={k}"
+
+
+def test_beta_frac_distribution_pin():
+    """The native fractional-Beta kernel (Marsaglia-Tsang, exact
+    rejection) IS Beta(a, b): KS against the analytic CDF at the
+    flagship-like fractional shapes, plus a < 1 boost coverage."""
+    from scipy import stats
+
+    _require_kernels()
+    rng = np.random.default_rng(7)
+    for a, b in ((2.3, 14.7), (0.4, 3.1)):
+        N = 20000
+        keys = jnp.asarray(
+            rng.integers(0, 2 ** 32, (N, 2), dtype=np.uint32))
+        av = jnp.full((N,), a, jnp.float32)
+        bv = jnp.full((N,), b, jnp.float32)
+        th = np.asarray(nffi.beta_frac(keys, av, bv))
+        assert (th > 0).all() and (th < 1).all()
+        ks = stats.kstest(th, stats.beta(a, b).cdf)
+        assert ks.pvalue > 1e-3, f"KS p={ks.pvalue} for ({a},{b})"
+
+
+def _white_operands(dtype, B=19, S=7, seed=0):
+    from gibbs_student_t_tpu.ops.pallas_white import build_white_consts
+
+    psr, _ = make_demo_pulsar(seed=3, n=50, theta=0.1)
+    ma = make_demo_pta(psr, components=6).frozen()
+    wc = build_white_consts(ma)
+    rng = np.random.default_rng(seed)
+    p, n = ma.nparam, ma.n
+    x = jnp.asarray(np.stack([ma.x_init(rng) for _ in range(B)]), dtype)
+    az = jnp.asarray(rng.uniform(0.5, 2.0, (B, n)), dtype)
+    y2 = jnp.asarray(rng.uniform(0.0, 3.0, (B, n)), dtype)
+    dx = jnp.asarray(rng.normal(0, 0.05, (B, S, p)), dtype)
+    logu = jnp.asarray(np.log(rng.uniform(size=(B, S))), dtype)
+    return ma, wc, x, az, y2, dx, logu
+
+
+def test_white_mh_kernel_f64_parity_and_nan():
+    """The native white-MH block vs white_mh_loop_xla on identical
+    draws at f64: identical accepts, identical x (the accepted
+    coordinates are the same dx values). A non-finite chain's variance
+    poisons ITS likelihood (reject-all) without touching lane
+    neighbours — the branchless contract."""
+    from gibbs_student_t_tpu.ops.pallas_white import white_mh_loop_xla
+
+    _require_kernels()
+    jax.config.update("jax_enable_x64", True)
+    try:
+        ma, wc, x, az, y2, dx, logu = _white_operands(np.float64)
+        rows = jnp.asarray(wc.rows, jnp.float64)
+        specs = jnp.asarray(wc.specs, jnp.float64)
+        x0, a0 = white_mh_loop_xla(x, az, y2, dx, logu, rows, specs,
+                                   wc.var)
+        x1, a1 = nffi.white_mh(x, az, y2, dx, logu, rows, specs, wc.var)
+        np.testing.assert_allclose(x1, x0, atol=1e-9)
+        np.testing.assert_array_equal(np.asarray(a1), np.asarray(a0))
+        assert 0.0 < np.asarray(a1).mean() < 1.0
+        # NaN az in chain 0: rejects every step there, neighbours alone
+        az_bad = az.at[0, 3].set(jnp.nan)
+        xb, ab = nffi.white_mh(x, az_bad, y2, dx, logu, rows, specs,
+                               wc.var)
+        np.testing.assert_array_equal(np.asarray(xb)[0],
+                                      np.asarray(x)[0])
+        assert np.asarray(ab)[0] == 0.0
+        np.testing.assert_array_equal(np.asarray(xb)[1:],
+                                      np.asarray(x1)[1:])
+    finally:
+        jax.config.update("jax_enable_x64", False)
+
+
+def test_hyper_mh_kernel_f64_parity_and_nonpd():
+    """The native hyper-MH block vs hyper_mh_loop_xla at f64: identical
+    accepts/x. A non-PD S0 chain rejects every proposal (NaN factor ->
+    -inf likelihood) and leaves its lane neighbours untouched."""
+    from gibbs_student_t_tpu.ops.pallas_hyper import (
+        build_hyper_consts,
+        hyper_mh_loop_xla,
+    )
+
+    _require_kernels()
+    jax.config.update("jax_enable_x64", True)
+    try:
+        ma, wc, x, az, y2, dx, logu = _white_operands(np.float64)
+        hc = build_hyper_consts(ma, np.arange(ma.m))
+        B, v = x.shape[0], ma.m
+        rng = np.random.default_rng(1)
+        A = rng.standard_normal((B, v, 2 * v))
+        S0 = jnp.asarray(A @ np.swapaxes(A, -1, -2) + 10 * np.eye(v),
+                         jnp.float64)
+        dS0 = (jnp.diagonal(S0, axis1=-2, axis2=-1)
+               + jnp.asarray(hc.phiinv_static, jnp.float64))
+        rt = jnp.asarray(rng.standard_normal((B, v)), jnp.float64)
+        base = jnp.asarray(rng.standard_normal(B), jnp.float64)
+        K = jnp.asarray(hc.K, jnp.float64)
+        sel = jnp.asarray(hc.phi_sel, jnp.float64)
+        specs = jnp.asarray(wc.specs, jnp.float64)
+        x0, a0 = hyper_mh_loop_xla(x, S0, dS0, rt, base, dx, logu, K,
+                                   sel, specs, hc.hyp_idx, 1e-6)
+        x1, a1 = nffi.hyper_mh(x, S0, dS0, rt, base, dx, logu, K, sel,
+                               specs, hc.hyp_idx, 1e-6)
+        np.testing.assert_allclose(x1, x0, atol=1e-9)
+        np.testing.assert_array_equal(np.asarray(a1), np.asarray(a0))
+        # non-PD S0 in chain 0: every proposal (and the initial point)
+        # evaluates to -inf; -inf - -inf = NaN > logu is False
+        S0b = S0.at[0].set(-jnp.eye(v, dtype=jnp.float64))
+        dS0b = (jnp.diagonal(S0b, axis1=-2, axis2=-1)
+                + jnp.asarray(hc.phiinv_static, jnp.float64))
+        xb, ab = nffi.hyper_mh(x, S0b, dS0b, rt, base, dx, logu, K,
+                               sel, specs, hc.hyp_idx, 1e-6)
+        np.testing.assert_array_equal(np.asarray(xb)[0],
+                                      np.asarray(x)[0])
+        assert np.asarray(ab)[0] == 0.0
+        np.testing.assert_array_equal(np.asarray(xb)[1:],
+                                      np.asarray(x1)[1:])
+    finally:
+        jax.config.update("jax_enable_x64", False)
+
+
+def test_schur_kernel_f64_parity_and_nan():
+    """The fused native schur_eliminate vs the jnp composition at f64
+    1e-9 on every output (factor pieces bitwise-critical: the b-draw
+    consumes them), and non-PD A poisons only its own chain."""
+    _require_kernels()
+    jax.config.update("jax_enable_x64", True)
+    try:
+        rng = np.random.default_rng(0)
+        B, ns, nv = 19, 9, 14
+        m = ns + nv
+        A_ = rng.standard_normal((B, m, 40))
+        Sig = jnp.asarray(A_ @ np.swapaxes(A_, -1, -2) + 10 * np.eye(m),
+                          jnp.float64)
+        rs = jnp.asarray(rng.standard_normal((B, ns)), jnp.float64)
+        rv = jnp.asarray(rng.standard_normal((B, nv)), jnp.float64)
+        Ass, Asv = Sig[:, :ns, :ns], Sig[:, :ns, ns:]
+        Avv = Sig[:, ns:, ns:]
+        ref = jax.vmap(lambda a, b, c, x, y: linalg._schur_jnp(
+            a, b, c, x, y, 1e-8))(Ass, Asv, Avv, rs, rv)
+        out = nffi.schur(Ass, Asv, Avv, rs, rv, 1e-8)
+        for got, want in zip(out, ref):
+            np.testing.assert_allclose(np.asarray(got),
+                                       np.asarray(want), atol=1e-9)
+        # non-PD A in chain 1: its logdetA/quad/S0 go non-finite,
+        # chain 0 and 2 stay bitwise identical
+        Abad = Ass.at[1, 0, 0].set(-1.0)
+        outb = nffi.schur(Abad, Asv, Avv, rs, rv, 1e-8)
+        assert not np.isfinite(np.asarray(outb[3])[1])  # logdetA
+        for got, clean in zip(outb, out):
+            np.testing.assert_array_equal(np.asarray(got)[0],
+                                          np.asarray(clean)[0])
+            np.testing.assert_array_equal(np.asarray(got)[2],
+                                          np.asarray(clean)[2])
+    finally:
+        jax.config.update("jax_enable_x64", False)
+
+
+def test_fused_hyper_kernel_f64_parity():
+    """The hyper+draws megastage vs the per-stage jnp composition
+    (the _fused_hyper_dispatcher fallback) at f64: x/acc bitwise, draw
+    pieces <= 1e-9 — fuse on/off is the same math in the same order."""
+    from gibbs_student_t_tpu.ops.pallas_hyper import build_hyper_consts
+    from gibbs_student_t_tpu.models.pta import (
+        phiinv_logdet,
+        static_phi_columns,
+    )
+
+    _require_kernels()
+    jax.config.update("jax_enable_x64", True)
+    try:
+        ma, wc, x, az, y2, dx, logu = _white_operands(np.float64)
+        smask = static_phi_columns(ma)
+        s_i, v_i = np.flatnonzero(smask), np.flatnonzero(~smask)
+        hc = build_hyper_consts(ma, v_i)
+        B, mm = x.shape[0], ma.m
+        rng = np.random.default_rng(1)
+        T_ = rng.standard_normal((B, mm, 2 * mm))
+        TNT = jnp.asarray(T_ @ np.swapaxes(T_, -1, -2) + 10 * np.eye(mm),
+                          jnp.float64)
+        d = jnp.asarray(rng.standard_normal((B, mm)), jnp.float64)
+        xi = jnp.asarray(rng.standard_normal((B, mm)), jnp.float64)
+        base0 = jnp.asarray(rng.standard_normal(B), jnp.float64)
+        K = jnp.asarray(hc.K, jnp.float64)
+        sel = jnp.asarray(hc.phi_sel, jnp.float64)
+        phist = jnp.asarray(hc.phiinv_static, jnp.float64)
+        specs = jnp.asarray(wc.specs, jnp.float64)
+        phiinv_s = jax.vmap(
+            lambda q: phiinv_logdet(ma, q, jnp)[0])(x)[:, s_i]
+        A = TNT[:, s_i][:, :, s_i] + jax.vmap(jnp.diag)(phiinv_s)
+        Bm = TNT[:, s_i][:, :, v_i]
+        C = TNT[:, v_i][:, :, v_i]
+        args = (A, Bm, C, d[:, s_i], d[:, v_i], x, dx, logu, xi, base0,
+                K, sel, phist, specs)
+        jitters = (1e-8, 1e-4, 1e-2, 1e-1)
+        kern = nffi.fused_hyper(*args[:14], hc.hyp_idx, 1e-8, jitters)
+        # the per-stage composition the dispatcher degrades to (built
+        # explicitly here rather than poking the dispatcher's privates)
+        from gibbs_student_t_tpu.ops.pallas_hyper import (
+            _phi_eval_xla,
+            hyper_mh_loop_xla,
+        )
+
+        (S0r, rtr, qr, ldr, Lar, isdr, UBr, usr) = jax.vmap(
+            lambda a, b, c, xx, yy: linalg._schur_jnp(
+                a, b, c, xx, yy, 1e-8))(A, Bm, C, d[:, s_i], d[:, v_i])
+        dS0 = jnp.diagonal(S0r, axis1=-2, axis2=-1) + phist
+        base = base0 + 0.5 * (qr - ldr)
+        xh, acch = hyper_mh_loop_xla(x, S0r, dS0, rtr, base, dx, logu,
+                                     K, sel, specs, hc.hyp_idx, 1e-8)
+        phiv, _ = _phi_eval_xla(xh, K, sel, hc.hyp_idx)
+        eye = jnp.eye(S0r.shape[-1], dtype=S0r.dtype)
+        Sv = S0r + eye * (phiv + phist)[..., None, :]
+        yv, isdv, _ = jax.vmap(
+            lambda s, r, z: linalg.robust_precond_draw(
+                s, r, z, jitters=jitters))(Sv, rtr, xi[:, len(s_i):])
+        hi = jax.lax.Precision.HIGHEST
+        wty = jnp.matmul(UBr, (isdv * yv)[..., None],
+                         precision=hi)[..., 0]
+        ys = jax.vmap(linalg.backward_solve)(
+            Lar, usr + xi[:, :len(s_i)] - wty)
+        want = (xh, acch, yv, isdv, ys, isdr)
+        for got, exp in zip(kern[:2], want[:2]):
+            np.testing.assert_array_equal(np.asarray(got),
+                                          np.asarray(exp))
+        for got, exp in zip(kern[2:], want[2:]):
+            np.testing.assert_allclose(np.asarray(got),
+                                       np.asarray(exp), atol=1e-9)
+    finally:
+        jax.config.update("jax_enable_x64", False)
+
+
+def test_round9_env_validation(monkeypatch, small_ma):
+    """The five new gates follow the strict auto|1|0 loud-typo contract
+    — at the env helper and at backend construction."""
+    from gibbs_student_t_tpu.backends import JaxGibbs
+    from gibbs_student_t_tpu.backends.jax_backend import (
+        _fast_gamma_v2_env,
+        _fast_theta_env,
+    )
+
+    helpers = {
+        "GST_NWHITE": linalg.nwhite_env,
+        "GST_NHYPER": linalg.nhyper_env,
+        "GST_FUSE_STAGES": linalg.fuse_stages_env,
+        "GST_FAST_GAMMA_V2": _fast_gamma_v2_env,
+        "GST_FAST_THETA": _fast_theta_env,
+    }
+    for var, fn in helpers.items():
+        monkeypatch.delenv(var, raising=False)
+        assert fn() == "auto"
+        monkeypatch.setenv(var, "yes")
+        with pytest.raises(ValueError, match=var):
+            fn()
+        monkeypatch.delenv(var, raising=False)
+    # one construction-time raise per gate keeps this inside budget
+    monkeypatch.setenv("GST_FUSE_STAGES", "bogus")
+    with pytest.raises(ValueError, match="GST_FUSE_STAGES"):
+        JaxGibbs(small_ma, GibbsConfig(model="mixture"), nchains=2)
+    monkeypatch.delenv("GST_FUSE_STAGES", raising=False)
+
+
+def test_custom_call_count_introspection():
+    """custom_call_count_of parses the optimized HLO's dispatch count
+    (the fusion metric perf_report --check gates) and degrades to None
+    on API drift."""
+    from gibbs_student_t_tpu.obs.introspect import custom_call_count_of
+
+    class Fake:
+        def as_text(self):
+            return ("a = f32[2] custom-call(b), custom_call_target=\"x\"\n"
+                    "c = f32[2] add(a, a)\n"
+                    "d = f32[2] custom-call(c), custom_call_target=\"y\"\n")
+
+    class Broken:
+        def as_text(self):
+            raise RuntimeError("no text")
+
+    assert custom_call_count_of(Fake()) == 2
+    assert custom_call_count_of(Broken()) is None
+
+
+# ----------------------------------------------------------------------
+# round 9: backend arms, degradation, graph pins, ABI + symbol guards
+# ----------------------------------------------------------------------
+
+_R9_OFF = {"GST_FAST_GAMMA_V2": "0", "GST_FAST_THETA": "0",
+           "GST_NWHITE": "0", "GST_NHYPER": "0", "GST_FUSE_STAGES": "0"}
+
+
+def _small_backend_run(small_ma, env, monkeypatch, niter=12, seed=5):
+    from gibbs_student_t_tpu.backends import JaxGibbs
+
+    for k in _R9_OFF:
+        monkeypatch.delenv(k, raising=False)
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    gb = JaxGibbs(small_ma, GibbsConfig(model="mixture",
+                                        theta_prior="beta"),
+                  nchains=4, chunk_size=6)
+    return gb, gb.sample(niter=niter, seed=seed)
+
+
+def test_fuse_backend_runs_and_deterministic(small_ma, monkeypatch):
+    """GST_FUSE_STAGES=1: the megastage sweeps produce finite,
+    law-plausible chains and are bit-identical on rerun (the per-arm
+    determinism contract). The fuse-off arm with the same per-stage
+    native kernels tracks it over a short window."""
+    _require_kernels()
+    gb_on, r_on = _small_backend_run(small_ma, {"GST_FUSE_STAGES": "1"},
+                                     monkeypatch)
+    assert gb_on._fuse_stages
+    assert np.isfinite(r_on.chain).all()
+    assert (r_on.alphachain > 0).all()
+    assert (r_on.thetachain > 0).all() and (r_on.thetachain < 1).all()
+    r_on2 = gb_on.sample(niter=12, seed=5)
+    np.testing.assert_array_equal(r_on.chain, r_on2.chain)
+    np.testing.assert_array_equal(r_on.thetachain, r_on2.thetachain)
+    gb_off, r_off = _small_backend_run(small_ma,
+                                       {"GST_FUSE_STAGES": "0"},
+                                       monkeypatch)
+    assert not gb_off._fuse_stages
+    # same kernels, same order, different only by the b-draw's phi
+    # association (K rows vs the model walk): short-window tracking
+    np.testing.assert_allclose(r_off.chain[:6], r_on.chain[:6],
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_round9_forced_but_unavailable_degrades(small_ma, monkeypatch):
+    """The graph-preserving gates (FUSE_STAGES / NWHITE / NHYPER /
+    FAST_THETA) forced on with the library unreachable must reproduce
+    the gates-off chains BITWISE — forcing an arm never changes the
+    math when the arm cannot exist. GST_FAST_GAMMA_V2 degrades to the
+    jnp philox twin instead (same distribution, different stream), so
+    it is pinned to run finite, not to match."""
+    from gibbs_student_t_tpu import native as native_mod
+    from gibbs_student_t_tpu.native import ffi as nffi_mod
+
+    _, r_off = _small_backend_run(small_ma, _R9_OFF, monkeypatch)
+
+    monkeypatch.setattr(native_mod, "load", lambda build=False: None)
+    nffi_mod._reset_for_tests()
+    try:
+        assert not nffi_mod.ready()
+        forced = {"GST_FUSE_STAGES": "1", "GST_NWHITE": "1",
+                  "GST_NHYPER": "1", "GST_FAST_THETA": "1",
+                  "GST_FAST_GAMMA_V2": "0", "GST_NCHOL": "0"}
+        gb_f, r_f = _small_backend_run(small_ma, forced, monkeypatch)
+        assert not gb_f._fuse_stages and not gb_f._fast_theta
+        np.testing.assert_array_equal(r_f.chain, r_off.chain)
+        np.testing.assert_array_equal(r_f.thetachain, r_off.thetachain)
+        np.testing.assert_array_equal(r_f.alphachain, r_off.alphachain)
+        # the v2 gamma arm: jnp twin when forced without the library
+        gb_v2, r_v2 = _small_backend_run(
+            small_ma, dict(_R9_OFF, GST_FAST_GAMMA_V2="1"), monkeypatch)
+        assert gb_v2._fast_gamma_v2
+        assert np.isfinite(r_v2.chain).all()
+        assert (r_v2.alphachain > 0).all()
+    finally:
+        monkeypatch.undo()
+        nffi_mod._reset_for_tests()
+        monkeypatch.delenv("GST_NCHOL", raising=False)
+
+
+def test_round9_gates_off_graph_contains_no_new_targets(small_ma,
+                                                        monkeypatch):
+    """Graph-level pin of the gates-off byte-identity contract: with
+    every round-9 gate off, the lowered sweep contains NONE of the new
+    custom-call targets (the dispatchers cannot have rerouted the
+    off-graph); with the gates on, the megastage target is present."""
+    import jax
+
+    from gibbs_student_t_tpu.backends import JaxGibbs
+
+    _require_kernels()
+    for k, v in _R9_OFF.items():
+        monkeypatch.setenv(k, v)
+    gb = JaxGibbs(small_ma, GibbsConfig(model="mixture",
+                                        theta_prior="beta"),
+                  nchains=4, chunk_size=6)
+    state = gb.init_state(seed=0)
+    from jax import random
+    keys = random.split(random.PRNGKey(0), 4)
+    txt = jax.jit(gb._make_chunk_fn(), static_argnames=("length",)).lower(
+        state, keys, 0, length=6).as_text()
+    for target in ("gst_gamma_v2", "gst_beta_frac", "gst_white_mh",
+                   "gst_hyper_mh", "gst_schur", "gst_fused_hyper"):
+        assert target not in txt, f"{target} leaked into gates-off graph"
+    for k in _R9_OFF:
+        monkeypatch.delenv(k, raising=False)
+    # 16 chains: the dispatchers' shared MIN_BATCH floor — below it the
+    # megastage correctly keeps the per-stage graph. outlier_mean
+    # fractional so the theta draw takes the native beta arm (a
+    # half-integer prior would correctly keep the GST_FAST_BETA pool).
+    gb2 = JaxGibbs(small_ma, GibbsConfig(model="mixture",
+                                         theta_prior="beta",
+                                         outlier_mean=0.013),
+                   nchains=16, chunk_size=6)
+    assert gb2._fuse_stages and gb2._fast_theta
+    state16 = gb2.init_state(seed=0)
+    keys16 = random.split(random.PRNGKey(0), 16)
+    txt2 = jax.jit(gb2._make_chunk_fn(),
+                   static_argnames=("length",)).lower(
+        state16, keys16, 0, length=6).as_text()
+    assert "gst_fused_hyper" in txt2
+    assert "gst_gamma_v2" in txt2
+    assert "gst_beta_frac" in txt2
+    assert "gst_white_mh" in txt2
+
+
+def test_abi_version_guard(monkeypatch):
+    """A committed .so whose kernel-family ABI does not match this
+    module's expectation degrades at probe time with a reason naming
+    the versions — never miscalls a moved handler signature."""
+    from gibbs_student_t_tpu.native import ffi as nffi_mod
+
+    _require_kernels()
+    monkeypatch.setattr(nffi_mod, "ABI_VERSION", 999)
+    nffi_mod._reset_for_tests()
+    try:
+        assert not nffi_mod.ready()
+        assert "ABI" in nffi_mod.status()
+        assert "999" in nffi_mod.status()
+    finally:
+        monkeypatch.undo()
+        nffi_mod._reset_for_tests()
+        assert nffi_mod.ready()
+
+
+def test_registered_targets_match_exported_symbols():
+    """Registration/export drift guard: every handler in
+    native/ffi.py TARGETS resolves in the committed .so, and every
+    exported FFI handler symbol (Gst*) is registered — in BOTH
+    directions, so adding a kernel without registering it (or
+    registering one the .so lacks) fails fast instead of silently
+    degrading."""
+    import ctypes
+
+    from gibbs_student_t_tpu import native as native_mod
+    from gibbs_student_t_tpu.native import ffi as nffi_mod
+
+    _require_kernels()
+    lib = native_mod.load()
+    for target, symbol in nffi_mod.TARGETS.items():
+        assert getattr(lib, symbol, None) is not None, (
+            f"registered target {target} has no exported symbol "
+            f"{symbol} in the committed libgst_native.so — rebuild "
+            "with make -C native and commit the result")
+    # reverse direction via the dynamic symbol table
+    so = os.path.join(REPO, "gibbs_student_t_tpu", "native",
+                      "libgst_native.so")
+    import shutil
+
+    if shutil.which("nm") is None:
+        pytest.skip("nm unavailable for the reverse-direction scan")
+    out = subprocess.run(["nm", "-D", "--defined-only", so],
+                         capture_output=True, text=True, check=True)
+    exported = {ln.split()[-1] for ln in out.stdout.splitlines()
+                if ln.strip()}
+    handlers = {s for s in exported
+                if s.startswith("Gst") and s[3:4].isupper()}
+    registered = set(nffi_mod.TARGETS.values())
+    assert handlers == registered, (
+        f"exported-but-unregistered: {sorted(handlers - registered)}; "
+        f"registered-but-unexported: {sorted(registered - handlers)}")
+    # the plain-C gst_* surface the probe/benches rely on
+    for sym in ("gst_simd_level", "gst_abi_version", "gst_philox_fill",
+                "gst_bench_chisq", "gst_bench_transpose_reg"):
+        assert sym in exported, f"plain-C entry {sym} missing"

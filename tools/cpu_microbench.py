@@ -34,7 +34,9 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(_HERE))  # repo root for the package
 
 _ARM_FLAGS = ("GST_VCHOL", "GST_BDRAW_REUSE", "GST_FAST_GAMMA",
-              "GST_NCHOL", "GST_HYPER_HOIST", "GST_FAST_BETA")
+              "GST_NCHOL", "GST_HYPER_HOIST", "GST_FAST_BETA",
+              "GST_FAST_GAMMA_V2", "GST_FAST_THETA", "GST_NWHITE",
+              "GST_NHYPER", "GST_FUSE_STAGES")
 
 
 def bench(fn, *args, reps=5):
@@ -215,6 +217,109 @@ def main():
         results[name] = round(ms, 3)
         print(f"{name:28s} {ms:8.2f} ms")
 
+    # round 9: the full alpha-draw arms — erfinv normal pool + masked
+    # chi-square (the v1 fast-gamma construction, erfinv-bound) vs the
+    # v2 philox construction (-log prod U + odd-parity Box-Muller,
+    # in-kernel RNG on the native arm, jnp philox twin otherwise)
+    from gibbs_student_t_tpu.ops.linalg import (
+        masked_chisq,
+        masked_gamma_v2,
+    )
+    from gibbs_student_t_tpu.ops.rng import key_bits
+
+    jmax = kmax // 2
+    kb2 = jax.vmap(key_bits)(keys)
+
+    def g_erfinv(ks, kc):
+        xs = jax.vmap(lambda k: random.normal(k, (n, kmax),
+                                              dtype=jnp.float32))(ks)
+        return masked_chisq(xs, kc)
+
+    g_erfinv_j = jax.jit(g_erfinv)
+    g_v2_j = jax.jit(lambda kb, kc: masked_gamma_v2(kb, kc, jmax))
+    v2_cases = [(f"gamma_erfinv({C},{n})",
+                 lambda: g_erfinv_j(keys, kcount)),
+                (f"gamma_v2({C},{n})", lambda: g_v2_j(kb2, kcount))]
+    for name, fn in v2_cases:
+        ms = bench(fn, reps=reps)
+        results[name] = round(ms, 3)
+        print(f"{name:28s} {ms:8.2f} ms")
+
+    # the theta draw for FRACTIONAL pseudo-counts: random.beta's
+    # per-element rejection While loops vs the native Marsaglia-Tsang
+    # kernel (GST_FAST_THETA)
+    a_b = jnp.full((C,), 2.3, jnp.float32)
+    b_b = jnp.full((C,), 129.4, jnp.float32)
+    beta_jnp_j = jax.jit(jax.vmap(
+        lambda k, a, b: random.beta(k, a, b, dtype=jnp.float32)))
+    beta_cases = [(f"beta_jnp({C})",
+                   lambda: beta_jnp_j(keys, a_b, b_b))]
+    if have_nchol:
+        beta_nat_j = jax.jit(nffi.beta_frac)
+        beta_cases.append((f"beta_nchol({C})",
+                           lambda: beta_nat_j(kb2, a_b, b_b)))
+    for name, fn in beta_cases:
+        ms = bench(fn, reps=reps)
+        results[name] = round(ms, 3)
+        print(f"{name:28s} {ms:8.2f} ms")
+
+    # Schur pre-elimination: the jnp composition (equilibrated factor,
+    # multi-rhs solves, assembly matmuls) vs the fused native kernel
+    from gibbs_student_t_tpu.ops.linalg import _schur_jnp
+
+    ns_s, nv_s = 14, 60
+    m_s = ns_s + nv_s
+    A_s = jnp.asarray(rng.standard_normal((C, m_s, 40)), jnp.float32)
+    Sig = A_s @ jnp.swapaxes(A_s, -1, -2) + 10.0 * jnp.eye(
+        m_s, dtype=jnp.float32)
+    Ass, Asv = Sig[:, :ns_s, :ns_s], Sig[:, :ns_s, ns_s:]
+    Avv = Sig[:, ns_s:, ns_s:]
+    rs_s = jnp.asarray(rng.standard_normal((C, ns_s)), jnp.float32)
+    rv_s = jnp.asarray(rng.standard_normal((C, nv_s)), jnp.float32)
+    schur_jnp_j = jax.jit(
+        lambda: _schur_jnp(Ass, Asv, Avv, rs_s, rv_s, 1e-6))
+    schur_cases = [(f"schur_jnp({C},{ns_s},{nv_s})", schur_jnp_j)]
+    if have_nchol:
+        schur_nat_j = jax.jit(
+            lambda: nffi.schur(Ass, Asv, Avv, rs_s, rv_s, 1e-6))
+        schur_cases.append((f"schur_nchol({C},{ns_s},{nv_s})",
+                            schur_nat_j))
+    for name, fn in schur_cases:
+        ms = bench(fn, reps=reps)
+        results[name] = round(ms, 3)
+        print(f"{name:28s} {ms:8.2f} ms")
+
+    # the white-MH block: XLA loop over precomputed draws vs the
+    # native one-call block (GST_NWHITE), flagship model constants
+    from gibbs_student_t_tpu.ops.pallas_white import (
+        build_white_consts,
+        white_mh_loop_xla,
+    )
+    from gibbs_student_t_tpu.data.demo import make_demo_model_arrays
+
+    ma_w = make_demo_model_arrays(n=130, components=30, seed=42)
+    wc = build_white_consts(ma_w)
+    p_w, S_w = ma_w.nparam, 20
+    xw = jnp.asarray(np.stack([ma_w.x_init(rng) for _ in range(C)]),
+                     jnp.float32)
+    azw = jnp.asarray(rng.uniform(0.5, 2.0, (C, 130)), jnp.float32)
+    y2w = jnp.asarray(rng.uniform(0.0, 3.0, (C, 130)), jnp.float32)
+    dxw = jnp.asarray(rng.normal(0, 0.05, (C, S_w, p_w)), jnp.float32)
+    luw = jnp.asarray(np.log(rng.uniform(size=(C, S_w))), jnp.float32)
+    rows_w = jnp.asarray(wc.rows)
+    specs_w = jnp.asarray(wc.specs)
+    wm_jnp_j = jax.jit(lambda: white_mh_loop_xla(
+        xw, azw, y2w, dxw, luw, rows_w, specs_w, wc.var))
+    wm_cases = [(f"whitemh_jnp({C},130)", wm_jnp_j)]
+    if have_nchol:
+        wm_nat_j = jax.jit(lambda: nffi.white_mh(
+            xw, azw, y2w, dxw, luw, rows_w, specs_w, wc.var))
+        wm_cases.append((f"whitemh_nchol({C},130)", wm_nat_j))
+    for name, fn in wm_cases:
+        ms = bench(fn, reps=reps)
+        results[name] = round(ms, 3)
+        print(f"{name:28s} {ms:8.2f} ms")
+
     # in-sweep A/B: hyper_and_draws across the gate arms
     if not args.skip_sweep:
         from gibbs_student_t_tpu.config import GibbsConfig
@@ -224,21 +329,37 @@ def main():
         ma = make_demo_model_arrays(n=130, components=30, seed=42)
         cfg = GibbsConfig(model="mixture", vary_df=True,
                           theta_prior="beta")
+        # the round-9 draw/fusion gates ride an availability probe, not
+        # GST_NCHOL — the historical arms pin them OFF so each keeps
+        # measuring the path it is named after
+        r9_off = {"GST_FAST_GAMMA_V2": "0", "GST_FAST_THETA": "0",
+                  "GST_NWHITE": "0", "GST_NHYPER": "0",
+                  "GST_FUSE_STAGES": "0"}
         arms = [
-            ("baseline_pr2", {"GST_VCHOL": "0", "GST_BDRAW_REUSE": "0",
-                              "GST_FAST_GAMMA": "0", "GST_NCHOL": "0"}),
-            ("vchol_only", {"GST_VCHOL": "1", "GST_BDRAW_REUSE": "0",
-                            "GST_FAST_GAMMA": "0", "GST_NCHOL": "0"}),
-            ("vchol_breuse", {"GST_VCHOL": "1", "GST_BDRAW_REUSE": "1",
-                              "GST_FAST_GAMMA": "0", "GST_NCHOL": "0"}),
+            ("baseline_pr2", dict(r9_off, **{
+                "GST_VCHOL": "0", "GST_BDRAW_REUSE": "0",
+                "GST_FAST_GAMMA": "0", "GST_NCHOL": "0"})),
+            ("vchol_only", dict(r9_off, **{
+                "GST_VCHOL": "1", "GST_BDRAW_REUSE": "0",
+                "GST_FAST_GAMMA": "0", "GST_NCHOL": "0"})),
+            ("vchol_breuse", dict(r9_off, **{
+                "GST_VCHOL": "1", "GST_BDRAW_REUSE": "1",
+                "GST_FAST_GAMMA": "0", "GST_NCHOL": "0"})),
             # the round-6 production path (nchol off, everything else
             # auto) vs the round-7 default (nchol rides auto when built)
-            ("nchol_off", {"GST_NCHOL": "0"}),
-            # round 8: the hyper-MH hoist A/B on top of the full native
-            # path (bit-identical chains, different op graph), plus the
-            # all-auto default
-            ("hyper_hoist_off", {"GST_HYPER_HOIST": "0"}),
-            ("hyper_hoist_on", {"GST_HYPER_HOIST": "1"}),
+            ("nchol_off", dict(r9_off, GST_NCHOL="0")),
+            # round 8: the hyper-MH hoist A/B on the closure-path hyper
+            # loop (the megastage replaces that loop, so the hoist arms
+            # pin the round-9 gates off to keep measuring it)
+            ("hyper_hoist_off", dict(r9_off, GST_HYPER_HOIST="0")),
+            ("hyper_hoist_on", dict(r9_off, GST_HYPER_HOIST="1")),
+            # round 9: the draw/MH-block arms and the megastage. r08 =
+            # every round-9 gate off (the previous production path);
+            # fuse_off = all round-9 arms on but per-stage dispatches;
+            # fuse_on = the single hyper+draws FFI megastage.
+            ("r08_equiv", dict(r9_off)),
+            ("fuse_off", {"GST_FUSE_STAGES": "0"}),
+            ("fuse_on", {"GST_FUSE_STAGES": "1"}),
             ("auto_defaults", {}),
         ]
         for arm, env in arms:
@@ -283,6 +404,16 @@ def main():
         if hoff and hon:
             results["hyper_hoist_speedup"] = round(hoff / hon, 2)
             print(f"hyper hoist speedup: {hoff / hon:.2f}x")
+        r8 = results.get("sweep_hyper_and_draws[r08_equiv]")
+        foff = results.get("sweep_hyper_and_draws[fuse_off]")
+        fon = results.get("sweep_hyper_and_draws[fuse_on]")
+        if foff and fon:
+            results["fuse_speedup"] = round(foff / fon, 2)
+            print(f"fuse speedup (megastage vs per-stage): "
+                  f"{foff / fon:.2f}x")
+        if r8 and fon:
+            results["round9_speedup"] = round(r8 / fon, 2)
+            print(f"round-9 speedup over the r08 path: {r8 / fon:.2f}x")
 
     if args.out:
         with open(args.out, "w") as fh:

@@ -103,6 +103,15 @@ def _xla_of(rec):
     return comp, peak
 
 
+def _dispatch_of(rec):
+    """Per-sweep XLA dispatch count (max custom-call count over the
+    run's compiled programs — the chunk sweep); None when the record
+    predates the field or marks it unavailable."""
+    xla = rec.get("xla") or {}
+    ncc = xla.get("custom_calls")
+    return ncc if isinstance(ncc, (int, float)) else None
+
+
 def print_report(ledger_recs, include_rounds=True):
     if include_rounds:
         print("== graded round artifacts ==")
@@ -173,7 +182,8 @@ def _stages_of(rec):
 
 
 def check_latest(ledger_recs, max_drop, max_compile_growth,
-                 max_hbm_growth, baseline_mode, max_stage_growth=100.0):
+                 max_hbm_growth, baseline_mode, max_stage_growth=100.0,
+                 max_dispatch_growth=50.0):
     """The regression gate; returns the process exit code."""
     bench = [r for r in ledger_recs if r.get("tool") == "bench"]
     if not bench:
@@ -235,6 +245,21 @@ def check_latest(ledger_recs, max_drop, max_compile_growth,
                             f"(> {max_hbm_growth}%)")
     else:
         print("check: peak_bytes unavailable on one side — skipped")
+
+    # dispatch-count gate: the number of custom-call/program launches
+    # in the compiled chunk sweep (introspect.custom_call_count_of) —
+    # the metric the GST_FUSE_STAGES megastage moves; growth means a
+    # change un-fused the sweep (or added per-sweep dispatches)
+    ncc, bncc = _dispatch_of(latest), _dispatch_of(base)
+    if ncc is not None and bncc is not None and bncc > 0:
+        growth = (ncc - bncc) / bncc * 100.0
+        print(f"check: custom_calls {bncc:.0f} -> {ncc:.0f} "
+              f"({growth:+.1f}%, limit {max_dispatch_growth}%)")
+        if growth > max_dispatch_growth:
+            failures.append(f"per-sweep dispatch count grew "
+                            f"{growth:.1f}% (> {max_dispatch_growth}%)")
+    else:
+        print("check: custom_calls unavailable on one side — skipped")
 
     # per-stage regression gate: every stage both records timed is
     # compared, so a hyper-block (or any future stage) slowdown fails
@@ -300,6 +325,12 @@ def main(argv=None):
                          "(stages present in both latest and baseline "
                          "bench records; wall timings on shared hosts "
                          "are noisy, hence the loose default)")
+    ap.add_argument("--max-dispatch-growth", type=float, default=50.0,
+                    metavar="PCT",
+                    help="max tolerated growth of the compiled chunk "
+                         "sweep's custom-call/dispatch count (the "
+                         "GST_FUSE_STAGES fusion metric; a count, not "
+                         "a wall time — growth means real un-fusion)")
     ap.add_argument("--baseline", choices=("prev", "best"),
                     default="prev",
                     help="compare against the previous comparable "
@@ -317,7 +348,8 @@ def main(argv=None):
         return check_latest(recs, args.max_drop,
                             args.max_compile_growth,
                             args.max_hbm_growth, args.baseline,
-                            max_stage_growth=args.max_stage_growth)
+                            max_stage_growth=args.max_stage_growth,
+                            max_dispatch_growth=args.max_dispatch_growth)
     return 0
 
 
