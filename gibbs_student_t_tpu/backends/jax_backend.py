@@ -68,11 +68,16 @@ from gibbs_student_t_tpu.ops.linalg import (
     masked_gamma_v2,
     nchol_env,
     nhyper_env,
+    nresid_active,
+    nresid_env,
     nwhite_env,
     precond_quad_logdet,
     precond_quad_logdet_hoisted,
+    residual_matvec,
+    residual_matvec_lanes,
     robust_precond_draw,
     schur_eliminate,
+    tnt_gram_lanes,
     vchol_env,
 )
 from gibbs_student_t_tpu.ops.rng import key_bits
@@ -239,6 +244,11 @@ class FusedConsts(NamedTuple):
     hyper_phiinv_static: jnp.ndarray | None   # (v,) / (P, v)
     hyper_logdet_phi_static: jnp.ndarray | None  # () / (P,)
     hyper_specs: jnp.ndarray | None      # (3, p) / (P, 3, p)
+    # serve slot pool only (serve/pool.py): per-lane tenant group ids
+    # under the tile-uniform admission contract — the operand that lets
+    # the native lanes kernels (tnt_lanes, fused_hyper_lanes) pick each
+    # tile's constants. None for the single-model and ensemble paths.
+    gid: jnp.ndarray | None = None
 
 
 _RECORD_FIELDS = ("x", "b", "z", "theta", "alpha", "df", "pout",
@@ -524,7 +534,8 @@ class JaxGibbs(SamplerBackend):
                  pallas_interpret: bool = False,
                  hyper_schur: bool | str = "auto",
                  telemetry: bool = True,
-                 metrics=None):
+                 metrics=None,
+                 operand_mode: bool = False):
         """``tnt_block_size`` selects the TOA reduction: ``None`` dense,
         an int for a ``lax.scan`` over row blocks (the 1e5-TOA stress path,
         BASELINE.json config 4; TOA axis zero-padded to a block multiple),
@@ -580,6 +591,18 @@ class JaxGibbs(SamplerBackend):
         flags gate the fused whole-MH-block kernels (ops/pallas_white.py,
         ops/pallas_hyper.py), both ``auto``-on for TPU backends.
 
+        ``operand_mode`` (the serve slot pool, serve/pool.py) marks
+        this backend as a TEMPLATE whose sweeps receive per-lane traced
+        models: the per-model fast-draw gates (``GST_FAST_BETA`` /
+        ``GST_FAST_THETA`` / ``GST_FUSE_STAGES``) then treat a traced
+        ``ma`` with serve fused-consts (``FusedConsts.gid``) exactly
+        like the frozen model — constants become call-time operands of
+        ONE compiled chunk program instead of trace literals, so
+        admitting a tenant never recompiles. The template's OWN model
+        defines the static structure (shapes, Schur split, prior
+        kinds, hyp_idx); tenants must match it (validated at admission
+        by the serve scheduler).
+
         ``telemetry`` (default on) carries the in-kernel ``Telemetry``
         pytree through each chunk's scan — per-block MH accept sums,
         per-chain non-finite divergence counters, chunk-end
@@ -594,6 +617,7 @@ class JaxGibbs(SamplerBackend):
         self.nchains = nchains
         self.dtype = dtype
         self.chunk_size = chunk_size
+        self._operand_mode = bool(operand_mode)
         if record not in ("full", "compact", "compact8", "light"):
             raise ValueError("record must be 'full', 'compact', "
                              f"'compact8' or 'light', got {record!r}")
@@ -862,6 +886,7 @@ class JaxGibbs(SamplerBackend):
         # gate silently keeps the previous graph.
         nwhite_env()
         nhyper_env()
+        nresid_env()
         g2env = _fast_gamma_v2_env()
         tenv = _fast_theta_env()
         fenv = fuse_stages_env()
@@ -1031,23 +1056,27 @@ class JaxGibbs(SamplerBackend):
         return dx, logus
 
     def _mh_block(self, x, key, ind: np.ndarray, nsteps: int, loglike_fn,
-                  jump_scale=1.0, cov_chol=None):
+                  jump_scale=1.0, cov_chol=None, lnprior_fn=None):
         """Branchless random-walk Metropolis on a coordinate block
         (reference gibbs.py:80-143). ``jump_scale`` multiplies the jump
         sigma (the chain's adapted log-scale, exp'd; exactly 1 when
         adaptation is off — the per-step ``scale`` drawn in ``_mh_draws``
         is the discrete mixture draw, a different thing); ``cov_chol``
-        switches to population-covariance joint proposals."""
+        switches to population-covariance joint proposals.
+        ``lnprior_fn`` overrides the prior evaluation — the traced
+        per-lane/per-pulsar model's priors when the sweep runs on an
+        operand model instead of the backend's own frozen one."""
         dx, logus = self._mh_draws(key, ind, nsteps, jump_scale, cov_chol)
+        lnprior_fn = lnprior_fn or self._lnprior
 
         ll0 = loglike_fn(x)
-        lp0 = self._lnprior(x)
+        lp0 = lnprior_fn(x)
 
         def body(i, carry):
             x, ll0, lp0, acc = carry
             q = x + dx[i]
             ll1 = loglike_fn(q)
-            lp1 = self._lnprior(q)
+            lp1 = lnprior_fn(q)
             accept = (ll1 + lp1) - (ll0 + lp0) > logus[i]
             x = jnp.where(accept, q, x)
             ll0 = jnp.where(accept, ll1, ll0)
@@ -1081,7 +1110,8 @@ class JaxGibbs(SamplerBackend):
         return dx, dxr, gumb, logus
 
     def _mtm_block(self, x, key, ind: np.ndarray, nsteps: int,
-                   loglike_fn, jump_scale=1.0, cov_chol=None):
+                   loglike_fn, jump_scale=1.0, cov_chol=None,
+                   lnprior_fn=None):
         """Multiple-try Metropolis on a coordinate block
         (MHConfig.mtm_tries; MTM(II) of Liu, Liang & Wong 2000 with
         importance weights w = pi, valid because the jump kernel is
@@ -1097,9 +1127,10 @@ class JaxGibbs(SamplerBackend):
         likelihood evaluations per step."""
         dx, dxr, gumb, logus = self._mtm_draws(key, ind, nsteps,
                                                jump_scale, cov_chol)
+        lnprior_fn = lnprior_fn or self._lnprior
 
         def w(q):
-            return loglike_fn(q) + self._lnprior(q)
+            return loglike_fn(q) + lnprior_fn(q)
 
         w_batch = jax.vmap(w)
         wx0 = w(x)
@@ -1204,9 +1235,18 @@ class JaxGibbs(SamplerBackend):
             x, acc_w, nvec = self._sweep_white(state, keys[0], ma, fused)
         ma_r, _, bs, _ = self._resolve(ma)
         # per-sweep inner products (reference gibbs.py:302-304), via the
-        # fused dense/blocked reduction (ops/tnt.py)
+        # fused dense/blocked reduction (ops/tnt.py). The serve slot
+        # pool's per-lane traced basis routes through the lanes Gram
+        # dispatcher instead — native per-group kernel when available,
+        # the identical per-lane jnp expressions otherwise.
         with block_span("gibbs/tnt_reduction"):
-            TNT, d, const_white = tnt_products(ma_r.T, ma_r.y, nvec, bs)
+            if (self._operand_mode and ma is not None and bs is None
+                    and fused is not None and fused.gid is not None):
+                TNT, d, const_white = tnt_gram_lanes(ma_r.T, ma_r.y,
+                                                     nvec, fused.gid)
+            else:
+                TNT, d, const_white = tnt_products(ma_r.T, ma_r.y, nvec,
+                                                   bs)
         return self._sweep_rest(state, x, acc_w, TNT, d, const_white,
                                 keys[1:], ma, sweep, fused)
 
@@ -1271,10 +1311,15 @@ class JaxGibbs(SamplerBackend):
                                    + jnp.sum(yred * yred / nvec))
 
                 block = self._mtm_block if mtm_w else self._mh_block
+                # a traced per-lane/per-pulsar model evaluates ITS
+                # priors, not the template's (they ride prior_specs,
+                # a data field of the stacked operand model)
+                lnp = (None if ma_in is None
+                       else (lambda q: lnprior(ma, q, jnp)))
                 x, acc_w = block(x, kw, ma.white_indices,
                                  cfg.mh.n_white_steps, ll_white,
                                  jump_scale=jump_scale,
-                                 cov_chol=cov_w)
+                                 cov_chol=cov_w, lnprior_fn=lnp)
         else:
             acc_w = jnp.zeros((), dtype=self.dtype)
         return x, acc_w, self._masked_nvec(ma, mask, x, az)
@@ -1305,30 +1350,46 @@ class JaxGibbs(SamplerBackend):
         # (ops/linalg.fused_hyper_draws). Same operands and randomness
         # as the per-stage path; with the gate unresolved at
         # construction the per-stage graph below is emitted verbatim.
-        fuse = (self._fuse_stages and ma_in is None
-                and len(ma.hyper_indices) > 0)
+        # The serve slot pool (operand_mode) reaches the same megastage
+        # with a traced per-lane model: the fused constants arrive as
+        # call-time operands through ``fused`` and the group-id routes
+        # the lanes kernel (ops/linalg._fused_hyper_lanes_dispatcher).
+        serve_ops = (self._operand_mode and ma_in is not None
+                     and fused is not None and fused.gid is not None)
+        fuse = (self._fuse_stages and len(ma.hyper_indices) > 0
+                and (ma_in is None
+                     or (serve_ops and fused.hyper_K is not None)))
         if fuse:
             s_i, v_i = self._schur
             hc = self._fuse_consts
+            if ma_in is None:
+                Kc = jnp.asarray(hc.K, self.dtype)
+                selc = jnp.asarray(hc.phi_sel, self.dtype)
+                phistc = jnp.asarray(hc.phiinv_static, self.dtype)
+                specsc = jnp.asarray(hc.specs, self.dtype)
+                ld_static = jnp.asarray(hc.logdet_phi_static,
+                                        self.dtype)
+                gid = None
+            else:
+                Kc, selc = fused.hyper_K, fused.hyper_sel
+                phistc = fused.hyper_phiinv_static
+                specsc = fused.hyper_specs
+                ld_static = fused.hyper_logdet_phi_static
+                gid = fused.gid
             phiinv_s = phiinv_logdet(ma, x, jnp)[0][s_i]
             dxh, logus = self._mh_draws(
                 kh, ma.hyper_indices, cfg.mh.n_hyper_steps,
                 jump_scale_h, cov_h)
             xi = random.normal(kb, (m,), dtype=self.dtype)
-            base0 = (const_white
-                     - 0.5 * jnp.asarray(hc.logdet_phi_static,
-                                         self.dtype))
+            base0 = const_white - 0.5 * ld_static
             with block_span("gibbs/hyper_mh"):
                 x, acc_h, y_v, isd_v, y_s, isd_a = fused_hyper_draws(
                     TNT[np.ix_(s_i, s_i)] + jnp.diag(phiinv_s),
                     TNT[np.ix_(s_i, v_i)], TNT[np.ix_(v_i, v_i)],
                     d[s_i], d[v_i], x, dxh, logus, xi, base0,
-                    jnp.asarray(hc.K, self.dtype),
-                    jnp.asarray(hc.phi_sel, self.dtype),
-                    jnp.asarray(hc.phiinv_static, self.dtype),
-                    jnp.asarray(hc.specs, self.dtype),
+                    Kc, selc, phistc, specsc,
                     hc.hyp_idx, cfg.jitter,
-                    (cfg.jitter, 1e-4, 1e-2, 1e-1))
+                    (cfg.jitter, 1e-4, 1e-2, 1e-1), gid=gid)
             with block_span("gibbs/b_draw"):
                 b = (jnp.zeros(m, dtype=self.dtype)
                      .at[s_i].set(y_s * isd_a)
@@ -1443,11 +1504,13 @@ class JaxGibbs(SamplerBackend):
                         return jnp.where(jnp.isfinite(ll), ll, -jnp.inf)
 
             block = self._mtm_block if mtm_h else self._mh_block
+            lnp_h = (None if ma_in is None
+                     else (lambda q: lnprior(ma, q, jnp)))
             with block_span("gibbs/hyper_mh"):
                 x, acc_h = block(x, kh, ma.hyper_indices,
                                  cfg.mh.n_hyper_steps, ll_hyper,
                                  jump_scale=jump_scale_h,
-                                 cov_chol=cov_h)
+                                 cov_chol=cov_h, lnprior_fn=lnp_h)
         elif not fuse:
             acc_h = jnp.zeros((), dtype=self.dtype)
 
@@ -1504,7 +1567,19 @@ class JaxGibbs(SamplerBackend):
                         jitters=(cfg.jitter, 1e-4, 1e-2, 1e-1))
                     b = y * isd
 
-        resid = ma.y - matvec_blocked(ma.T, b, bs)
+        # the (n, m) residual matvec between the draws and the z/df
+        # conditionals (FUTURE.md #2's glue): dispatched through the
+        # GST_NCHOL-family resid arm (GST_NRESID) for a frozen dense
+        # basis; gates-off (and traced/blocked bases) keep the old
+        # matmul verbatim
+        if (bs is None and nresid_active()
+                and not isinstance(ma.T, jax.core.Tracer)):
+            resid = residual_matvec(jnp.asarray(ma.T),
+                                    jnp.asarray(ma.y), b)
+        elif bs is None and serve_ops and nresid_active():
+            resid = residual_matvec_lanes(ma.T, ma.y, b, fused.gid)
+        else:
+            resid = ma.y - matvec_blocked(ma.T, b, bs)
         nvec0 = ndiag(ma, x, jnp)
         if mask is not None:
             nvec0 = jnp.where(mask, nvec0, 1.0)
@@ -1517,7 +1592,8 @@ class JaxGibbs(SamplerBackend):
             else:
                 mk = k1mm = 1.0
             sz = jnp.sum(z)
-            if self._beta_pool is not None and ma_in is None:
+            if self._beta_pool is not None and (ma_in is None
+                                                or serve_ops):
                 # GST_FAST_BETA: Beta(a, b) = X / (X + Y) with
                 # X ~ 0.5 chi2_2a, Y ~ 0.5 chi2_2b — exact for the
                 # half-integer shapes this model produces (z sums are
@@ -1534,11 +1610,17 @@ class JaxGibbs(SamplerBackend):
                 xs = random.normal(kt, (pool,), dtype=self.dtype)
                 a2 = (2.0 * (sz + mk)).astype(self.dtype)
                 ga = masked_chisq(xs, a2)
-                gb = masked_chisq(jnp.flip(xs, -1),
-                                  jnp.asarray(float(pool),
-                                              self.dtype) - a2)
+                if ma_in is None:
+                    b2 = jnp.asarray(float(pool), self.dtype) - a2
+                else:
+                    # serve lane: the lane's own (possibly traced) TOA
+                    # count, not the template pool — identical bits for
+                    # a matching tenant (all quantities are exact small
+                    # integers in f32), correct law for a padded one
+                    b2 = (2.0 * (n - sz + k1mm)).astype(self.dtype)
+                gb = masked_chisq(jnp.flip(xs, -1), b2)
                 theta = ga / (ga + gb)
-            elif self._fast_theta and ma_in is None:
+            elif self._fast_theta and (ma_in is None or serve_ops):
                 # GST_FAST_THETA: native fractional Beta via two
                 # in-kernel Marsaglia-Tsang gammas per chain
                 # (ops/linalg.beta_fractional) — the flagship beta

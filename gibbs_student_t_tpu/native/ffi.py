@@ -33,7 +33,7 @@ import numpy as np
 #: committed library exports ``gst_abi_version()``; a mismatch (or a
 #: pre-versioning library) degrades at probe time with a clear reason
 #: string instead of miscalling a handler whose signature moved.
-ABI_VERSION = 2
+ABI_VERSION = 3
 
 #: FFI target name -> exported C symbol. Names are versioned with a
 #: ``gst_`` prefix so they cannot collide with XLA's own cpu targets.
@@ -68,6 +68,14 @@ TARGETS = {
     "gst_schur_f64": "GstSchurF64",
     "gst_fused_hyper_f32": "GstFusedHyperF32",
     "gst_fused_hyper_f64": "GstFusedHyperF64",
+    "gst_tnt_lanes_f32": "GstTntLanesF32",
+    "gst_tnt_lanes_f64": "GstTntLanesF64",
+    "gst_fused_hyper_lanes_f32": "GstFusedHyperLanesF32",
+    "gst_fused_hyper_lanes_f64": "GstFusedHyperLanesF64",
+    "gst_resid_f32": "GstResidF32",
+    "gst_resid_f64": "GstResidF64",
+    "gst_resid_lanes_f32": "GstResidLanesF32",
+    "gst_resid_lanes_f64": "GstResidLanesF64",
 }
 
 # None = not yet probed; True/False = latched verdict for the process.
@@ -244,6 +252,41 @@ def tnt(T, y, nvec):
     return TNT, d, cw
 
 
+def tnt_lanes(T, y, nvec, gid):
+    """Multi-tenant twin of :func:`tnt`: ``T (B, n, m)`` / ``y (B, n)``
+    PER LANE (the serve slot pool's call-time dataset operands), with
+    the tile-uniform group-id contract — ``gid (B,)`` int32 constant
+    within every aligned SIMD tile (the scheduler's admission
+    granularity; the handler rejects straddles). A pool whose lanes all
+    share one basis is bitwise identical to the shared-basis kernel."""
+    m = T.shape[-1]
+    batch = nvec.shape[:-1]
+    TNT, d, cw = _call("gst_tnt_lanes",
+                       (batch + (m, m), batch + (m,), batch),
+                       T, y, nvec, gid, dtype=T.dtype)
+    return TNT, d, cw
+
+
+def resid(T, y, b):
+    """``y - T @ b`` per chain with the basis shared across the batch —
+    the z/df glue's (n, m) residual matvec as one fused pass
+    (``T (n, m)``, ``y (n,)``, ``b (..., m)``)."""
+    n = T.shape[0]
+    (out,) = _call("gst_resid", (b.shape[:-1] + (n,),), T, y, b)
+    return out
+
+
+def resid_lanes(T, y, b, gid):
+    """Multi-tenant twin of :func:`resid`: per-lane basis/residuals
+    (``T (B, n, m)``, ``y (B, n)``) under the tile-uniform ``gid``
+    contract; bitwise :func:`resid` for a uniform pool (same inner
+    loop)."""
+    n = T.shape[-2]
+    (out,) = _call("gst_resid_lanes", (b.shape[:-1] + (n,),), T, y, b,
+                   gid, dtype=T.dtype)
+    return out
+
+
 def _solve(base, L, r):
     (x,) = _call(base, (r.shape,), L, r)
     return x
@@ -356,3 +399,27 @@ def fused_hyper(A, Bm, C, rhs_s, rhs_v, x, dx, logu, xi, base0, K, sel,
                         batch + (ns,), batch + (ns,)),
                        A, Bm, C, rhs_s, rhs_v, x, dx, logu, xi, base0,
                        K, sel, phist, specs, idx, jit_arr, jits))
+
+
+def fused_hyper_lanes(A, Bm, C, rhs_s, rhs_v, x, dx, logu, xi, base0,
+                      K, sel, phist, specs, gid, hyp_idx, jitter,
+                      jitters):
+    """Multi-tenant megastage: :func:`fused_hyper` with the model
+    constants PER LANE (``K (B, 1+nk, v)``, ``sel/phist (B, v)``,
+    ``specs (B, 3, p)``) under the tile-uniform ``gid`` contract of
+    :func:`tnt_lanes`. Same tile functions as the shared form — a
+    uniform pool is bitwise identical to it."""
+    import jax.numpy as jnp
+
+    ns = A.shape[-1]
+    nv = C.shape[-1]
+    batch = A.shape[:-2]
+    idx = jnp.asarray(np.asarray(hyp_idx, np.int32))
+    jit_arr = jnp.asarray([jitter], x.dtype)
+    jits = jnp.asarray(np.asarray(jitters, np.float64), x.dtype)
+    return tuple(_call("gst_fused_hyper_lanes",
+                       (x.shape, batch, batch + (nv,), batch + (nv,),
+                        batch + (ns,), batch + (ns,)),
+                       A, Bm, C, rhs_s, rhs_v, x, dx, logu, xi, base0,
+                       K, sel, phist, specs, idx, gid, jit_arr, jits,
+                       dtype=x.dtype))

@@ -248,6 +248,39 @@ def fuse_stages_env() -> str:
     return env if env is not None else "auto"
 
 
+def nresid_env() -> str:
+    """Validated ``GST_NRESID`` (``auto`` when unset) — the z/df glue's
+    native residual-matvec arm (:func:`residual_matvec`). Strict
+    ``auto|1|0``; ``auto`` follows the ``GST_NCHOL`` resolution (the
+    arm is part of the native kernel family), ``0`` keeps the plain
+    matmul even with the family active — the knob that lets a serve
+    bit-identity pin align arms with the traced-basis pool path, which
+    has no native resid form."""
+    env = os.environ.get("GST_NRESID")
+    if env is not None and env not in ("auto", "1", "0"):
+        raise ValueError(
+            f"GST_NRESID must be 'auto', '1' or '0', got {env!r}")
+    return env if env is not None else "auto"
+
+
+def _nresid_mode():
+    """``(enabled, forced)`` for the native residual-matvec arm."""
+    env = nresid_env()
+    if env == "0":
+        return False, False
+    n_on, n_forced = _nchol_mode()
+    if not n_on:
+        return False, False
+    return True, env == "1" or n_forced
+
+
+def nresid_active() -> bool:
+    """Trace-time: should the sweep route its residual matvec through
+    the dispatcher at all? Mirrors :func:`nchol_active`'s contract —
+    with the arm off the caller emits the old matmul verbatim."""
+    return _nresid_mode()[0]
+
+
 def nchol_active() -> bool:
     """Trace-time: could the native kernel family be dispatched at all
     on this platform? Callers that must keep their gates-off graph
@@ -847,6 +880,135 @@ def _tnt_gram_vmap(axis_size, in_batched, T, y, nvec):
     return tnt_gram(T, y, nvec), (True, True, True)
 
 
+@custom_vmap
+def tnt_gram_lanes(T, y, nvec, gid):
+    """Per-lane-basis twin of :func:`tnt_gram` — the serve slot pool's
+    TNT reduction, where every lane carries its OWN tenant's dataset as
+    a call-time operand (``T (..., n, m)``, ``y (..., n)``) plus the
+    tile-uniform group id. The native lanes kernel re-transposes the
+    basis only at group boundaries, so a tenant spanning many tiles
+    pays one transpose; the fallback is the identical per-lane jnp
+    expression the grouped ensemble path emits, so gates-off serving
+    keeps the traced-basis graph verbatim."""
+    if nvec.ndim == 1:
+        return _tnt_gram_jnp(T, y, nvec)
+    n_on, n_forced = _nchol_mode()
+    batch = int(np.prod(nvec.shape[:-1]))
+    if (n_on and T.ndim == 3 and y.ndim == 2 and nvec.ndim == 2
+            and gid.ndim == 1
+            and nvec.dtype in (jnp.float32, jnp.float64)
+            and T.dtype == nvec.dtype and y.dtype == nvec.dtype
+            and (n_forced or batch >= _PALLAS_MIN_BATCH)):
+        from gibbs_student_t_tpu.native import ffi as nffi
+
+        _note_impl("tnt_lanes", "nchol", nvec.shape)
+        return tuple(nffi.tnt_lanes(T, y, nvec, gid))
+    _note_impl("tnt_lanes", "vmap_jnp", nvec.shape)
+    f = _tnt_gram_jnp
+    for _ in range(nvec.ndim - 1):
+        f = jax.vmap(f)
+    return f(T, y, nvec)
+
+
+@tnt_gram_lanes.def_vmap
+def _tnt_gram_lanes_vmap(axis_size, in_batched, T, y, nvec, gid):
+    out = tuple(
+        a if bt else jnp.broadcast_to(a, (axis_size,) + a.shape)
+        for a, bt in zip((T, y, nvec, gid), in_batched))
+    return tnt_gram_lanes(*out), (True, True, True)
+
+
+@custom_vmap
+def residual_matvec(T, y, b):
+    """``y - T @ b`` per chain with the basis/residuals shared across
+    the batch — the z/df glue's (n, m) matvec between the coefficient
+    draw and the outlier/df conditionals (docs/FUTURE.md #2), behind
+    the ``GST_NCHOL`` dispatch like :func:`tnt_gram`. The fallback is
+    the exact pre-dispatch expression under ``vmap``, and callers only
+    route here when ``nchol_active()`` — gates-off sweeps keep the old
+    matmul verbatim."""
+    hi = jax.lax.Precision.HIGHEST
+    if b.ndim == 1:
+        return y - jnp.matmul(T, b, precision=hi)
+    n_on, n_forced = _nresid_mode()
+    batch = int(np.prod(b.shape[:-1]))
+    if (n_on and T.ndim == 2 and y.ndim == 1
+            and b.dtype in (jnp.float32, jnp.float64)
+            and T.dtype == b.dtype and y.dtype == b.dtype
+            and (n_forced or batch >= _PALLAS_MIN_BATCH)):
+        from gibbs_student_t_tpu.native import ffi as nffi
+
+        _note_impl("resid", "nchol", b.shape)
+        return nffi.resid(T, y, b)
+    _note_impl("resid", "vmap_jnp", b.shape)
+    f = lambda bb: y - jnp.matmul(T, bb, precision=hi)  # noqa: E731
+    for _ in range(b.ndim - 1):
+        f = jax.vmap(f)
+    return f(b)
+
+
+@custom_vmap
+def residual_matvec_lanes(T, y, b, gid):
+    """Per-lane-basis twin of :func:`residual_matvec` — the serve slot
+    pool's z/df-glue matvec, with the tenant basis/residuals as
+    call-time operands under the tile-uniform group-id contract. The
+    native arm shares :func:`residual_matvec`'s inner loop, so a
+    uniform pool is bitwise the solo kernel; the fallback is the
+    per-lane matmul the traced-basis path always computed."""
+    hi = jax.lax.Precision.HIGHEST
+    if b.ndim == 1:
+        return y - jnp.matmul(T, b, precision=hi)
+    n_on, n_forced = _nresid_mode()
+    batch = int(np.prod(b.shape[:-1]))
+    if (n_on and T.ndim == 3 and y.ndim == 2 and b.ndim == 2
+            and gid.ndim == 1
+            and b.dtype in (jnp.float32, jnp.float64)
+            and T.dtype == b.dtype and y.dtype == b.dtype
+            and (n_forced or batch >= _PALLAS_MIN_BATCH)):
+        from gibbs_student_t_tpu.native import ffi as nffi
+
+        _note_impl("resid_lanes", "nchol", b.shape)
+        return nffi.resid_lanes(T, y, b, gid)
+    _note_impl("resid_lanes", "vmap_jnp", b.shape)
+
+    def one(Tb, yb, bb):
+        return yb - jnp.matmul(Tb, bb, precision=hi)
+
+    f = one
+    for _ in range(b.ndim - 1):
+        f = jax.vmap(f)
+    return f(T, y, b)
+
+
+@residual_matvec_lanes.def_vmap
+def _residual_matvec_lanes_vmap(axis_size, in_batched, T, y, b, gid):
+    out = tuple(
+        a if bt else jnp.broadcast_to(a, (axis_size,) + a.shape)
+        for a, bt in zip((T, y, b, gid), in_batched))
+    return residual_matvec_lanes(*out), True
+
+
+@residual_matvec.def_vmap
+def _residual_matvec_vmap(axis_size, in_batched, T, y, b):
+    if in_batched[0] or in_batched[1]:
+        # traced per-lane basis (the serve operand path): the identical
+        # per-lane expression under plain vmap
+        hi = jax.lax.Precision.HIGHEST
+
+        def g(Tb, yb, bb):
+            f = lambda v: yb - jnp.matmul(Tb, v, precision=hi)  # noqa: E731
+            for _ in range(bb.ndim - 1):
+                f = jax.vmap(f)
+            return f(bb)
+
+        out = jax.vmap(g, in_axes=tuple(0 if bt else None
+                                        for bt in in_batched))(T, y, b)
+        return out, True
+    if not in_batched[2]:
+        b = jnp.broadcast_to(b, (axis_size,) + b.shape)
+    return residual_matvec(T, y, b), True
+
+
 def precond_solve_quad(L, inv_sqrt_d, rhs):
     """Given the factorization from :func:`precond_cholesky`, return
     ``(Sigma^-1 rhs, rhs^T Sigma^-1 rhs)``."""
@@ -995,40 +1157,53 @@ def _beta_fractional_vmap(axis_size, in_batched, keys, a, b):
     return beta_fractional(keys, a, b), True
 
 
+def _fused_stages_jnp(hyp_idx, jitter, jitters, A, Bm, C, rs, rv, x,
+                      dx, logu, xi, base0, K, sel, phist, specs):
+    """The per-stage composition — the megastage's gates-off-
+    equivalent graph, parity oracle and degradation target (shared by
+    the single-model and lanes dispatchers; the constant operands may
+    be rank-2 shared arrays or carry a leading lane axis — the
+    align_consts batch-generic contract of hyper_mh_loop_xla). The
+    b-draw evaluates phi through the same affine K rows the hyper
+    block (and the kernel) uses, so fused on/off agree to rounding."""
+    from gibbs_student_t_tpu.ops.pallas_hyper import (
+        _phi_eval_xla,
+        hyper_mh_loop_xla,
+    )
+    from gibbs_student_t_tpu.ops.pallas_white import align_consts
+
+    ns = A.shape[-1]
+    (S0, rt, quad_s, logdetA, La, isd_a, U_B, u_s) = _schur_jnp(
+        A, Bm, C, rs, rv, jitter)
+    phist_a = align_consts(jnp.asarray(phist, x.dtype), x.ndim - 1,
+                           core_dims=1)
+    dS0 = jnp.diagonal(S0, axis1=-2, axis2=-1) + phist_a
+    base = base0 + 0.5 * (quad_s - logdetA)
+    xh, acc = hyper_mh_loop_xla(x, S0, dS0, rt, base, dx, logu, K,
+                                sel, specs, hyp_idx, jitter)
+    Ka = align_consts(jnp.asarray(K, x.dtype), x.ndim - 1)
+    sela = align_consts(jnp.asarray(sel, x.dtype), x.ndim - 1,
+                        core_dims=1)
+    phiv, _ = _phi_eval_xla(xh, Ka, sela, hyp_idx)
+    eye = jnp.eye(S0.shape[-1], dtype=S0.dtype)
+    Sv = S0 + eye * (phiv + phist_a)[..., None, :]
+    y_v, isd_v, _ = robust_precond_draw(Sv, rt, xi[..., ns:],
+                                        jitters=jitters)
+    hi = jax.lax.Precision.HIGHEST
+    wty = jnp.matmul(U_B, (isd_v * y_v)[..., None],
+                     precision=hi)[..., 0]
+    y_s = backward_solve(La, u_s + xi[..., :ns] - wty)
+    return xh, acc, y_v, isd_v, y_s, isd_a
+
+
 @functools.lru_cache(maxsize=None)
 def _fused_hyper_dispatcher(hyp_idx: tuple, jitter: float,
                             jitters: tuple):
     """Dispatcher behind :func:`fused_hyper_draws` (the static phi
     structure, MH jitter and escalation schedule are trace-static)."""
 
-    def _stages_jnp(A, Bm, C, rs, rv, x, dx, logu, xi, base0, K, sel,
-                    phist, specs):
-        """The per-stage composition — the megastage's gates-off-
-        equivalent graph, parity oracle and degradation target. The
-        b-draw evaluates phi through the same affine K rows the hyper
-        block (and the kernel) uses, so fused on/off agree to rounding."""
-        from gibbs_student_t_tpu.ops.pallas_hyper import (
-            _phi_eval_xla,
-            hyper_mh_loop_xla,
-        )
-
-        ns = A.shape[-1]
-        (S0, rt, quad_s, logdetA, La, isd_a, U_B, u_s) = _schur_jnp(
-            A, Bm, C, rs, rv, jitter)
-        dS0 = jnp.diagonal(S0, axis1=-2, axis2=-1) + phist
-        base = base0 + 0.5 * (quad_s - logdetA)
-        xh, acc = hyper_mh_loop_xla(x, S0, dS0, rt, base, dx, logu, K,
-                                    sel, specs, hyp_idx, jitter)
-        phiv, _ = _phi_eval_xla(xh, K, sel, hyp_idx)
-        eye = jnp.eye(S0.shape[-1], dtype=S0.dtype)
-        Sv = S0 + eye * (phiv + phist)[..., None, :]
-        y_v, isd_v, _ = robust_precond_draw(Sv, rt, xi[..., ns:],
-                                            jitters=jitters)
-        hi = jax.lax.Precision.HIGHEST
-        wty = jnp.matmul(U_B, (isd_v * y_v)[..., None],
-                         precision=hi)[..., 0]
-        y_s = backward_solve(La, u_s + xi[..., :ns] - wty)
-        return xh, acc, y_v, isd_v, y_s, isd_a
+    _stages_jnp = functools.partial(_fused_stages_jnp, hyp_idx, jitter,
+                                    jitters)
 
     @custom_vmap
     def fh(A, Bm, C, rs, rv, x, dx, logu, xi, base0, K, sel, phist,
@@ -1072,8 +1247,56 @@ def _fused_hyper_dispatcher(hyp_idx: tuple, jitter: float,
     return fh
 
 
+@functools.lru_cache(maxsize=None)
+def _fused_hyper_lanes_dispatcher(hyp_idx: tuple, jitter: float,
+                                  jitters: tuple):
+    """Lanes twin of :func:`_fused_hyper_dispatcher` — the serve slot
+    pool's megastage, with the model constants PER LANE (call-time
+    operands instead of trace literals) plus the tile-uniform group-id
+    operand (native/ffi.py ``fused_hyper_lanes``). The fallback is the
+    same per-stage jnp composition with the constants batched, which is
+    exactly the graph the grouped traced-consts path emits."""
+
+    _stages_jnp = functools.partial(_fused_stages_jnp, hyp_idx, jitter,
+                                    jitters)
+
+    @custom_vmap
+    def fh(A, Bm, C, rs, rv, x, dx, logu, xi, base0, K, sel, phist,
+           specs, gid):
+        nk = len(hyp_idx)
+        if (_native_draws_ok() and A.ndim == 3 and K.ndim == 3
+                and gid.ndim == 1
+                and _nchol_ok(A.shape, A.dtype, False)
+                and C.shape[-1] <= MAX_VCHOL_DIM
+                and x.shape[-1] <= 64 and nk <= 16):
+            from gibbs_student_t_tpu.native import ffi as nffi
+
+            _note_impl("fused_hyper_lanes", "nchol", C.shape)
+            dt = x.dtype
+            return tuple(nffi.fused_hyper_lanes(
+                A, Bm, C, rs, rv, x, dx, logu, xi, base0,
+                jnp.asarray(K, dt), jnp.asarray(sel, dt),
+                jnp.asarray(phist, dt), jnp.asarray(specs, dt), gid,
+                hyp_idx, jitter, jitters))
+        _note_impl("fused_hyper_lanes", "stages", C.shape)
+        return _stages_jnp(A, Bm, C, rs, rv, x, dx, logu, xi, base0,
+                           K, sel, phist, specs)
+
+    @fh.def_vmap
+    def _fh_vmap(axis_size, in_batched, *args):
+        # the serve vmap maps EVERY operand (state, draws, per-lane
+        # consts and gid alike); broadcast any stragglers and re-enter
+        # so the primal sees the full lane batch
+        out = tuple(
+            a if bt else jnp.broadcast_to(a, (axis_size,) + a.shape)
+            for a, bt in zip(args, in_batched))
+        return fh(*out), (True,) * 6
+
+    return fh
+
+
 def fused_hyper_draws(A, Bm, C, rs, rv, x, dx, logu, xi, base0, K, sel,
-                      phist, specs, hyp_idx, jitter, jitters):
+                      phist, specs, hyp_idx, jitter, jitters, gid=None):
     """``(x, acc_hyper, y_v, isd_v, y_s, isd_a)`` — the hyper+draws
     megastage (``GST_FUSE_STAGES``): Schur pre-elimination, the whole
     hyper MH block over precomputed draws, and the coefficient draw's
@@ -1082,9 +1305,20 @@ def fused_hyper_draws(A, Bm, C, rs, rv, x, dx, logu, xi, base0, K, sel,
     isd_a``, ``b[v] = y_v * isd_v`` (backends/jax_backend.py). The
     fallback is the per-stage jnp composition with identical operands
     and randomness — the parity oracle, and what a
-    forced-but-unavailable gate silently degrades to."""
+    forced-but-unavailable gate silently degrades to.
+
+    With ``gid`` (the serve slot pool's per-lane group ids), the model
+    constants ``K/sel/phist/specs`` are PER-LANE call-time operands —
+    uniform within each aligned SIMD tile — and the call routes through
+    the lanes kernel; a pool whose lanes share one model is bitwise
+    identical to the single-model megastage (same tile functions)."""
     hyp_idx = tuple(int(i) for i in hyp_idx)
     jitters = tuple(float(j) for j in jitters)
+    if gid is not None:
+        return _fused_hyper_lanes_dispatcher(
+            hyp_idx, float(jitter), jitters)(
+            A, Bm, C, rs, rv, x, dx, logu, xi, base0, K, sel, phist,
+            specs, gid)
     return _fused_hyper_dispatcher(hyp_idx, float(jitter), jitters)(
         A, Bm, C, rs, rv, x, dx, logu, xi, base0, K, sel, phist, specs)
 

@@ -1,0 +1,41 @@
+"""Continuous-batching chain serving: the 1024-lane sweep as a
+multi-tenant slot pool.
+
+The flagship AOT-compiled chunk program historically served exactly one
+caller per process — all throughput beyond one tenant's needs was
+wasted, and every new job paid a full cold compile (ROADMAP item 1).
+This package turns the lane axis into a slot pool the way LLM inference
+servers batch decode steps: a request queue admits independent jobs
+(different datasets / priors / seeds / sweep counts) into free lane
+groups mid-flight, evicts finished tenants, and streams per-tenant
+posterior chunks + telemetry back incrementally.
+
+The enabling refactor lives in backends/jax_backend.py
+(``operand_mode``) and ops/linalg.py (the ``*_lanes`` dispatchers):
+per-lane configuration that the single-model path bakes as trace-time
+literals — dataset constants, prior hypers, fused-MH constant arrays,
+philox chain keys, per-tenant sweep offsets, the active-lane mask —
+becomes call-time operands of ONE compiled chunk program, so admitting
+a tenant is a host-side buffer write, never a recompile. The native
+FFI megastage and TNT Gram kernels accept the same operands through
+their lanes variants under the tile-uniform group-id contract
+(native/src/gst_kernels.h; admission is SIMD-tile-granular).
+
+See docs/SERVING.md for the architecture and the
+operand-vs-baked-constant table.
+"""
+
+from gibbs_student_t_tpu.serve.pool import GROUP_LANES, SlotPool
+from gibbs_student_t_tpu.serve.scheduler import (
+    TenantHandle,
+    TenantRequest,
+)
+from gibbs_student_t_tpu.serve.server import ChainServer
+
+__all__ = [
+    "GROUP_LANES",
+    "SlotPool",
+    "TenantRequest",
+    "TenantHandle",
+    "ChainServer",
+]

@@ -1,0 +1,396 @@
+"""The slot pool: one compiled chunk program, per-lane call-time operands.
+
+A :class:`SlotPool` owns ``nlanes`` lanes, each an independent chain
+whose model (dataset + priors), fused-MH constants, philox chain key,
+sweep offset and active flag are CALL-TIME OPERANDS of a single jitted
+chunk function — the same trick ``parallel/ensemble.py`` plays for
+grouped ensembles, extended to the per-model fast-draw paths via the
+backend's ``operand_mode`` and the native ``*_lanes`` kernels
+(ops/linalg.py ``tnt_gram_lanes`` / ``fused_hyper_draws(gid=...)``).
+Writing a tenant into its lanes is a host-side numpy slice assignment;
+the program never retraces, so admission latency is buffer writes plus
+one device upload (obs/introspect.py compile records pin exactly ONE
+compile for the pool's lifetime — tests/test_serve.py).
+
+Lane state is host-authoritative between quanta: the CPU backend's
+"device" transfers are memcpys, and keeping the canonical state in
+numpy makes admission/eviction writes trivial and exact. A TPU/GPU
+serving port would keep state device-resident and scatter admissions
+instead — noted in docs/SERVING.md.
+
+RNG and keying are bit-compatible with ``JaxGibbs.sample``: a tenant's
+lane ``k`` carries ``random.split(PRNGKey(seed), nchains)[k]`` and each
+sweep folds in the tenant-local sweep index, so a solo tenant's chains
+are bit-identical to the same seed run through the single-model
+backend (the gates-off guarantee extends to serving; pinned in
+tests/test_serve.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax, random
+
+from gibbs_student_t_tpu.backends.jax_backend import (
+    ChainState,
+    FusedConsts,
+    JaxGibbs,
+    record_tuple,
+)
+from gibbs_student_t_tpu.config import GibbsConfig
+from gibbs_student_t_tpu.models.pta import ModelArrays
+from gibbs_student_t_tpu.obs.telemetry import telemetry_init, telemetry_update
+from gibbs_student_t_tpu.parallel.ensemble import (
+    _localize_names,
+    pad_model_arrays,
+)
+
+#: Admission granularity in lanes: the f32 SIMD tile width of the
+#: native lanes kernels (native/src/gst_kernels.h ``Lanes<float>::W``),
+#: which is also a multiple of the f64 width — per-lane constants must
+#: be uniform within every aligned tile, so tenants are admitted in
+#: whole groups of this many lanes.
+GROUP_LANES = 16
+
+#: gid of lanes not owned by any tenant (whole free groups). Free
+#: groups keep whatever constants last occupied them; their lanes are
+#: inactive, their outputs discarded, and their state frozen by the
+#: active mask, so stale constants are harmless.
+FREE_GID = -1
+
+
+class TenantSlot:
+    """Book-keeping for one admitted tenant (host side only)."""
+
+    def __init__(self, tenant_id: int, lanes: np.ndarray, nchains: int,
+                 niter: int, start_sweep: int, n_real: int, seed: int):
+        self.tenant_id = tenant_id
+        self.lanes = lanes            # (ceil(nchains/G)*G,) lane indices
+        self.nchains = nchains        # real chains; lanes[nchains:] pad
+        self.niter = niter
+        self.start_sweep = start_sweep
+        self.done_sweeps = 0          # tenant-local sweeps served so far
+        self.n_real = n_real
+        self.seed = seed
+
+    @property
+    def chain_lanes(self) -> np.ndarray:
+        return self.lanes[:self.nchains]
+
+    @property
+    def remaining(self) -> int:
+        return self.niter - self.done_sweeps
+
+
+class SlotPool:
+    """``nlanes`` single-chain lanes behind ONE compiled chunk program.
+
+    ``quantum`` is the scheduling granularity in sweeps: every
+    :meth:`run_quantum` advances all active lanes by exactly that many
+    sweeps (tenants' ``niter`` must be multiples of it, so the static
+    chunk length never changes and the program never recompiles).
+    ``template_ma`` fixes the pool's model STRUCTURE — shapes (every
+    tenant's TOA axis is padded to the pool ``n`` with masked rows),
+    basis size, parameter structure, Schur split, prior kinds; tenants
+    must match it (the scheduler validates at admission).
+    """
+
+    def __init__(self, template_ma: ModelArrays, config: GibbsConfig,
+                 nlanes: int = 1024, quantum: int = 25,
+                 group: int = GROUP_LANES, dtype=jnp.float32,
+                 record: str = "compact8", record_thin: int = 1,
+                 heterogeneous: bool = False,
+                 telemetry: bool = True, metrics=None):
+        """``heterogeneous=True`` stacks row-masked models so tenants
+        with FEWER TOAs than the pool axis can ride the same operand
+        buffers (suffix padding, exactly the ensemble convention). The
+        default homogeneous pool requires every tenant to match the
+        pool ``n`` and keeps the statistical TOA count a trace-time
+        integer — the configuration under which a solo tenant's chains
+        are BIT-identical to ``JaxGibbs.sample`` (a traced mask's
+        float-typed count rounds ``n * outlier_mean`` differently;
+        heterogeneous pools agree in law, not bits)."""
+        if group % GROUP_LANES:
+            raise ValueError(
+                f"group ({group}) must be a multiple of {GROUP_LANES} "
+                "— the native lanes kernels require per-lane constants "
+                "uniform within every aligned SIMD tile "
+                "(native/src/gst_kernels.h)")
+        if nlanes % group:
+            raise ValueError(f"nlanes ({nlanes}) must be a multiple of "
+                             f"the admission group ({group})")
+        if config.mh.adapt_cov:
+            raise ValueError(
+                "the serve slot pool does not support population-"
+                "covariance adaptation (adapt_cov): proposal factors "
+                "couple chains across one tenant's population, which "
+                "has no lane-local form")
+        self.nlanes = nlanes
+        self.quantum = quantum
+        self.group = group
+        self.metrics = metrics
+        self.heterogeneous = bool(heterogeneous)
+        tmpl = _localize_names(template_ma)
+        if tmpl.row_mask is not None:
+            raise ValueError("template_ma must be an unpadded model "
+                             "(its n defines the pool TOA axis)")
+        if self.heterogeneous:
+            (tmpl_model,) = pad_model_arrays([tmpl], n_to=tmpl.n)
+        else:
+            tmpl_model = tmpl
+        self.template = JaxGibbs(
+            tmpl_model, config, nchains=nlanes, dtype=dtype,
+            chunk_size=quantum, record=record, record_thin=record_thin,
+            tnt_block_size=None, use_pallas=False, telemetry=telemetry,
+            metrics=metrics, operand_mode=True)
+        t = self.template
+        if quantum % t.record_thin:
+            raise ValueError(f"quantum ({quantum}) must be a multiple "
+                             f"of record_thin ({t.record_thin})")
+        self.n_pool = tmpl.n
+        self.dtype = dtype
+        # ---- host-authoritative lane buffers --------------------------
+        # stacked per-lane model: every lane starts as the template
+        stack = jax.tree.map(
+            lambda a: np.repeat(np.asarray(a)[None], nlanes, axis=0),
+            tmpl_model)
+        self._mas_np: ModelArrays = stack
+        self._keys_np = np.zeros((nlanes, 2), np.uint32)
+        self._offsets_np = np.zeros(nlanes, np.int32)
+        self._active_np = np.zeros(nlanes, bool)
+        self._gid_np = np.full(nlanes, FREE_GID, np.int32)
+        self._fc_np = self._template_consts_stack()
+        self._state_np = jax.tree.map(np.array, t.init_state(seed=0))
+        self._dirty = True
+        self._mas_dev = None
+        self._fc_dev = None
+        # the ONE compiled chunk program
+        from gibbs_student_t_tpu.obs.introspect import introspect_jit
+
+        self._chunk = introspect_jit(
+            jax.jit(self._make_chunk(), static_argnames=("length",)),
+            label=f"serve_pool_chunk_l{nlanes}",
+            registry=lambda: self.metrics,
+            static_argnames=("length",))
+
+    # ------------------------------------------------------------------
+    # construction helpers
+    # ------------------------------------------------------------------
+
+    def _template_consts_stack(self) -> FusedConsts:
+        """Per-lane fused-MH constant buffers, initialized to the
+        template's own constants (free lanes keep them)."""
+        t = self.template
+        L = self.nlanes
+
+        def rep(a):
+            return (None if a is None
+                    else np.repeat(np.asarray(a, np.float32)[None], L,
+                                   axis=0))
+
+        wc = t._white_consts
+        hc = t._fuse_consts if t._fuse_consts is not None else t._hyper_consts
+        return FusedConsts(
+            white_rows=rep(wc.rows) if wc is not None else None,
+            white_specs=rep(wc.specs) if wc is not None else None,
+            hyper_K=rep(hc.K) if hc is not None else None,
+            hyper_sel=rep(hc.phi_sel) if hc is not None else None,
+            hyper_phiinv_static=(rep(hc.phiinv_static)
+                                 if hc is not None else None),
+            hyper_logdet_phi_static=(
+                np.full(L, hc.logdet_phi_static, np.float32)
+                if hc is not None else None),
+            hyper_specs=rep(hc.specs) if hc is not None else None,
+            gid=self._gid_np,
+        )
+
+    def _make_chunk(self):
+        t = self.template
+        fields = t._record_fields
+        casts = t._record_casts
+        thin = t.record_thin
+        use_tele = t._telemetry
+
+        def lane_chunk(ma_l, fc_l, state, chain_key, offset, length):
+            # mirrors the single-model chunk fn (backends/jax_backend
+            # _make_chunk_fn one_chain) with the model and fused consts
+            # as traced per-lane operands and a per-lane sweep offset
+            def one(j, c):
+                s, tl = c
+                s = t._sweep(s, random.fold_in(chain_key, j), ma=ma_l,
+                             sweep=j, fused=fc_l)
+                return s, (telemetry_update(tl, s) if use_tele else tl)
+
+            def body(carry, i0):
+                st, tl = carry
+                rec = record_tuple(st, fields, casts)
+                if thin == 1:
+                    st, tl = one(i0, (st, tl))
+                else:
+                    st, tl = lax.fori_loop(
+                        0, thin, lambda j, c: one(i0 + j, c), (st, tl))
+                return (st, tl), rec
+
+            (st, tl), recs = lax.scan(
+                body, (state, telemetry_init(t.dtype)),
+                offset + jnp.arange(0, length, thin))
+            if use_tele:
+                tl = tl._replace(logpost=t._logpost_chain(st, ma=ma_l))
+            return st, recs, tl
+
+        def chunk(states, mas, fcs, keys, offsets, active, length):
+            sts, recs, tl = jax.vmap(
+                functools.partial(lane_chunk, length=length)
+            )(mas, fcs, states, keys, offsets)
+            # freeze empty slots: their draws are discarded and their
+            # parked state carries over bitwise, so a stale model in a
+            # free group can never poison a future admission
+            def keep(new, old):
+                m = active.reshape((-1,) + (1,) * (new.ndim - 1))
+                return jnp.where(m, new, old)
+
+            sts = jax.tree.map(keep, sts, states)
+            return sts, (recs, tl if use_tele else None)
+
+        return chunk
+
+    # ------------------------------------------------------------------
+    # lane writes (host-side buffer writes — never a recompile)
+    # ------------------------------------------------------------------
+
+    def write_tenant(self, slot: TenantSlot, ma_padded: ModelArrays,
+                     backend: JaxGibbs, state: ChainState) -> None:
+        """Admit a tenant into its lanes: slice-assign its model,
+        fused-MH constants, chain keys, offsets and state into the
+        host lane buffers. ``backend`` is the tenant's throwaway
+        construction backend (structure already validated)."""
+        lanes = slot.lanes
+        k = slot.nchains
+        # model arrays (the localized+padded tenant model)
+        self._mas_np = jax.tree.map(
+            lambda buf, val: _assign(buf, lanes, np.asarray(val)),
+            self._mas_np, ma_padded)
+        # fused-MH constants from the tenant's backend
+        wc = backend._white_consts
+        hc = (backend._fuse_consts if backend._fuse_consts is not None
+              else backend._hyper_consts)
+        fc = self._fc_np
+        if fc.white_rows is not None and wc is not None:
+            fc.white_rows[lanes] = np.asarray(wc.rows, np.float32)
+            fc.white_specs[lanes] = np.asarray(wc.specs, np.float32)
+        if fc.hyper_K is not None and hc is not None:
+            fc.hyper_K[lanes] = np.asarray(hc.K, np.float32)
+            fc.hyper_sel[lanes] = np.asarray(hc.phi_sel, np.float32)
+            fc.hyper_phiinv_static[lanes] = np.asarray(
+                hc.phiinv_static, np.float32)
+            fc.hyper_logdet_phi_static[lanes] = np.float32(
+                hc.logdet_phi_static)
+            fc.hyper_specs[lanes] = np.asarray(hc.specs, np.float32)
+        # keys: exactly the single-model backend's chain key schedule,
+        # so lane k of the tenant IS chain k of a solo run
+        keys = np.asarray(random.split(random.PRNGKey(slot.seed),
+                                       slot.nchains))
+        self._keys_np[lanes[:k]] = keys
+        self._keys_np[lanes[k:]] = 0  # pad lanes: parked
+        self._offsets_np[lanes] = slot.start_sweep
+        self._active_np[lanes[:k]] = True
+        self._active_np[lanes[k:]] = False
+        self._gid_np[lanes] = slot.tenant_id
+        # state: tenant chains into their lanes; pad lanes keep a copy
+        # of chain 0 (finite, discarded)
+        st = jax.tree.map(np.array, state)
+        self._state_np = jax.tree.map(
+            lambda buf, val: _assign(
+                buf, lanes, np.concatenate(
+                    [val, np.repeat(val[:1], len(lanes) - k, axis=0)])
+                if len(lanes) > k else val),
+            self._state_np, st)
+        self._dirty = True
+
+    def evict(self, slot: TenantSlot) -> None:
+        """Free a tenant's lanes: deactivate and mark the groups free.
+        Constants/state stay parked (frozen by the active mask) until
+        the next admission overwrites them."""
+        self._active_np[slot.lanes] = False
+        self._gid_np[slot.lanes] = FREE_GID
+        self._dirty = True
+
+    def tenant_state(self, slot: TenantSlot) -> ChainState:
+        """The tenant's current chain state (host arrays) — the
+        checkpoint payload for the per-tenant spool."""
+        return jax.tree.map(lambda a: a[slot.chain_lanes],
+                            self._state_np)
+
+    # ------------------------------------------------------------------
+    # the quantum
+    # ------------------------------------------------------------------
+
+    def run_quantum(self):
+        """Advance every lane by ``quantum`` sweeps through the ONE
+        compiled program. Returns ``(records, telemetry)`` with
+        ``records[i]`` shaped ``(nlanes, rows, ...)`` in wire dtypes —
+        callers slice per-tenant lanes and materialize."""
+        if self._dirty:
+            self._mas_dev = jax.tree.map(
+                lambda a: (jnp.asarray(a, dtype=self.dtype)
+                           if np.issubdtype(np.asarray(a).dtype,
+                                            np.floating)
+                           else jnp.asarray(a)),
+                self._mas_np)
+            fc = self._fc_np
+            self._fc_dev = FusedConsts(*[
+                None if a is None else jnp.asarray(a)
+                for a in fc[:-1]
+            ], gid=jnp.asarray(self._gid_np))
+            self._dirty = False
+        sts, (recs, tl) = self._chunk(
+            jax.tree.map(jnp.asarray, self._state_np),
+            self._mas_dev, self._fc_dev,
+            jnp.asarray(self._keys_np), jnp.asarray(self._offsets_np),
+            jnp.asarray(self._active_np), length=self.quantum)
+        self._state_np = jax.tree.map(np.array, sts)
+        self._offsets_np[self._active_np] += self.quantum
+        return recs, tl
+
+    # ------------------------------------------------------------------
+    # record plumbing
+    # ------------------------------------------------------------------
+
+    def materialize(self, recs) -> list:
+        """Undo the wire casts for a quantum's records: returns host
+        float arrays, one per record field, each ``(nlanes, rows, ...)``
+        (the single-model backend's ``_materialize`` with the pool's
+        padded TOA count)."""
+        host = jax.device_get(recs)
+        return self.template._materialize(host, n_last=self.n_pool)
+
+    def tenant_records(self, host: list, slot: TenantSlot) -> dict:
+        """One tenant's slice of a materialized quantum:
+        ``{field: (rows, nchains, ...)}`` with per-TOA fields trimmed
+        back to the tenant's real TOA count."""
+        out = {}
+        for f, arr in zip(self.template._record_fields, host):
+            a = np.swapaxes(arr[slot.chain_lanes], 0, 1)
+            if (slot.n_real != self.n_pool
+                    and f in ("z", "alpha", "pout")):
+                a = a[..., :slot.n_real]
+            out[f] = a
+        return out
+
+
+def _assign(buf: np.ndarray, lanes: np.ndarray, val: np.ndarray):
+    """Slice-assign ``val`` (broadcast over the lane axis when it has
+    no leading lane dimension) into ``buf[lanes]``; non-array pytree
+    leaves (static metadata) pass through untouched."""
+    buf = np.asarray(buf)
+    if buf.ndim == 0:
+        return buf
+    if val.shape == buf.shape[1:]:
+        buf[lanes] = val[None]
+    else:
+        buf[lanes] = val
+    return buf

@@ -1,0 +1,170 @@
+"""Tenant requests, handles, and the bounded admission queue.
+
+The scheduler side of the serve subsystem is deliberately host-only
+and thread-safe-but-simple: a bounded FIFO with first-fit admission
+(the server scans past a head job that does not currently fit so a
+small job can backfill free groups — classic continuous-batching
+behavior), and per-tenant handles that stream chunk callbacks and
+deliver the final :class:`ChainResult`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from gibbs_student_t_tpu.models.pta import ModelArrays
+
+
+class QueueFull(RuntimeError):
+    """Raised by ``submit`` under the ``reject`` backpressure policy
+    when the admission queue is at capacity."""
+
+
+@dataclass
+class TenantRequest:
+    """One job for the slot pool.
+
+    ``niter`` must be a multiple of the pool quantum (validated at
+    submit — the static chunk length is what makes admission
+    recompile-free). ``spool_dir`` streams the tenant's chunks to a
+    per-tenant spool directory with a rolling state checkpoint
+    (utils/spool.py) instead of accumulating in memory; ``state`` +
+    ``start_sweep`` resume a checkpointed tenant (utils/spool.py
+    ``load_spool_state``) — the per-sweep fold-in keying makes the
+    continuation identical to an unbroken run.
+    """
+
+    ma: ModelArrays
+    niter: int
+    nchains: int = 16
+    seed: int = 0
+    x0: Optional[np.ndarray] = None
+    state: object = None
+    start_sweep: int = 0
+    spool_dir: Optional[str] = None
+    on_chunk: Optional[Callable] = None   # (handle, sweep_end, records)
+    name: Optional[str] = None
+
+
+class TenantHandle:
+    """Caller-facing view of a submitted job."""
+
+    def __init__(self, tenant_id: int, request: TenantRequest):
+        self.tenant_id = tenant_id
+        self.request = request
+        self.status = "queued"
+        self.error: Optional[str] = None
+        self.submitted_t = time.monotonic()
+        self.admitted_t: Optional[float] = None
+        self.finished_t: Optional[float] = None
+        self.sweeps_done = 0
+        self.chunks_streamed = 0
+        self._cols: Dict[str, List[np.ndarray]] = {}
+        self._tele_stats: Dict[str, np.ndarray] = {}
+        self._result = None
+        self._done = threading.Event()
+
+    # -- lifecycle (server side) ---------------------------------------
+
+    def _stream(self, sweep_end: int, records: Dict[str, np.ndarray]):
+        self.sweeps_done = sweep_end - self.request.start_sweep
+        self.chunks_streamed += 1
+        if self.request.spool_dir is None:
+            for f, a in records.items():
+                self._cols.setdefault(f, []).append(a)
+        if self.request.on_chunk is not None:
+            self.request.on_chunk(self, sweep_end, records)
+
+    def _finish(self, result):
+        self._result = result
+        self.finished_t = time.monotonic()
+        self.status = "done"
+        self._done.set()
+
+    def _fail(self, why: str):
+        self.error = why
+        self.finished_t = time.monotonic()
+        self.status = "rejected"
+        self._done.set()
+
+    # -- caller side ----------------------------------------------------
+
+    @property
+    def admission_ms(self) -> Optional[float]:
+        if self.admitted_t is None:
+            return None
+        return (self.admitted_t - self.submitted_t) * 1e3
+
+    @property
+    def throughput_sweeps_per_s(self) -> Optional[float]:
+        """Chain-sweeps per second over the tenant's residency."""
+        if self.admitted_t is None or self.finished_t is None:
+            return None
+        dt = self.finished_t - self.admitted_t
+        return (self.request.nchains * self.sweeps_done / dt
+                if dt > 0 else None)
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        """Block until the job completes and return its
+        :class:`ChainResult`; raises on rejection."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"tenant {self.tenant_id} not done (status "
+                f"{self.status!r}); drive ChainServer.step()/run()")
+        if self.error is not None:
+            raise RuntimeError(
+                f"tenant {self.tenant_id} rejected: {self.error}")
+        return self._result
+
+
+class AdmissionQueue:
+    """Bounded FIFO with first-fit scanning and block/reject
+    backpressure."""
+
+    def __init__(self, maxsize: int = 64, policy: str = "block"):
+        if policy not in ("block", "reject"):
+            raise ValueError(
+                f"backpressure policy must be 'block' or 'reject', "
+                f"got {policy!r}")
+        self.maxsize = maxsize
+        self.policy = policy
+        self._q: List[TenantHandle] = []
+        self._lock = threading.Lock()
+        self._not_full = threading.Condition(self._lock)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    def put(self, handle: TenantHandle,
+            timeout: Optional[float] = None) -> None:
+        with self._not_full:
+            if len(self._q) >= self.maxsize:
+                if self.policy == "reject":
+                    raise QueueFull(
+                        f"admission queue at capacity ({self.maxsize})")
+                if not self._not_full.wait_for(
+                        lambda: len(self._q) < self.maxsize,
+                        timeout=timeout):
+                    raise QueueFull(
+                        f"admission queue still full after {timeout}s")
+            self._q.append(handle)
+
+    def pop_first_fit(self, fits) -> Optional[TenantHandle]:
+        """Remove and return the first queued job for which
+        ``fits(handle)`` is true (first-fit backfill), else None."""
+        with self._not_full:
+            for i, h in enumerate(self._q):
+                if fits(h):
+                    self._q.pop(i)
+                    self._not_full.notify()
+                    return h
+            return None

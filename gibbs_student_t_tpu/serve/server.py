@@ -1,0 +1,370 @@
+"""ChainServer: admission, eviction, streaming and serving metrics.
+
+Ties the :class:`~gibbs_student_t_tpu.serve.pool.SlotPool` (the ONE
+compiled chunk program) to the admission queue. The driver is a
+synchronous quantum loop — ``step()`` advances the pool by one quantum
+and handles admissions/evictions at the boundary; ``run()`` loops it
+(optionally from a background thread via ``start()``), so callers can
+``submit()`` from any thread and block on ``handle.result()``.
+
+Serving metrics land in the attached ``obs.metrics.MetricsRegistry``:
+``serve_occupancy`` (busy chain-lanes / pool lanes, per quantum),
+``serve_queue_depth``, ``serve_admission_ms`` histogram,
+``serve_sweeps_total`` counter (chain-sweeps), plus ``admit``/``evict``
+events — and the per-run summary that tools/serve_bench.py turns into
+a ledger record (docs/SERVING.md schema).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from gibbs_student_t_tpu.backends.jax_backend import JaxGibbs
+from gibbs_student_t_tpu.config import GibbsConfig
+from gibbs_student_t_tpu.models.pta import ModelArrays
+from gibbs_student_t_tpu.parallel.ensemble import (
+    _localize_names,
+    pad_model_arrays,
+)
+from gibbs_student_t_tpu.serve.pool import (
+    GROUP_LANES,
+    SlotPool,
+    TenantSlot,
+)
+from gibbs_student_t_tpu.serve.scheduler import (
+    AdmissionQueue,
+    TenantHandle,
+    TenantRequest,
+)
+
+
+class ChainServer:
+    """A persistent multi-tenant driver over one slot pool."""
+
+    def __init__(self, template_ma: ModelArrays, config: GibbsConfig,
+                 nlanes: int = 1024, quantum: int = 25,
+                 group: int = GROUP_LANES, dtype=None,
+                 record: str = "compact8", record_thin: int = 1,
+                 max_queue: int = 64, backpressure: str = "block",
+                 telemetry: bool = True, metrics=None):
+        import jax.numpy as jnp
+
+        self.pool = SlotPool(template_ma, config,
+                             nlanes=nlanes, quantum=quantum, group=group,
+                             dtype=dtype or jnp.float32, record=record,
+                             record_thin=record_thin,
+                             telemetry=telemetry, metrics=metrics)
+        self.config = config
+        self.metrics = metrics
+        self.queue = AdmissionQueue(maxsize=max_queue,
+                                    policy=backpressure)
+        self._lock = threading.Lock()
+        self._running: Dict[int, tuple] = {}   # id -> (slot, handle, spool)
+        self._free_groups: List[int] = list(
+            range(self.pool.nlanes // self.pool.group))
+        self._next_id = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # run-level aggregates for the serving summary
+        self.quanta = 0
+        self.busy_lane_sweeps = 0     # chain-sweeps actually served
+        self.total_lane_sweeps = 0    # nlanes * sweeps advanced
+        self._admission_ms: List[float] = []
+
+    # ------------------------------------------------------------------
+    # submission
+    # ------------------------------------------------------------------
+
+    def submit(self, request: TenantRequest,
+               timeout: Optional[float] = None) -> TenantHandle:
+        """Queue a job (backpressure per the queue policy) and return
+        its handle. Validation that needs the pool template happens at
+        admission time; a structurally incompatible tenant is rejected
+        through its handle."""
+        if request.niter < 1 or request.niter % self.pool.quantum:
+            raise ValueError(
+                f"niter ({request.niter}) must be a positive multiple "
+                f"of the pool quantum ({self.pool.quantum}) — the "
+                "static chunk length is what keeps admission "
+                "recompile-free")
+        if request.nchains < 1:
+            raise ValueError("nchains must be >= 1")
+        groups_needed = -(-request.nchains // self.pool.group)
+        if groups_needed > self.pool.nlanes // self.pool.group:
+            raise ValueError(
+                f"tenant needs {groups_needed} lane groups; the pool "
+                f"only has {self.pool.nlanes // self.pool.group}")
+        with self._lock:
+            handle = TenantHandle(self._next_id, request)
+            self._next_id += 1
+        self.queue.put(handle, timeout=timeout)
+        if self.metrics is not None:
+            self.metrics.gauge("serve_queue_depth").set(len(self.queue))
+        return handle
+
+    # ------------------------------------------------------------------
+    # admission
+    # ------------------------------------------------------------------
+
+    def _groups_needed(self, handle: TenantHandle) -> int:
+        return -(-handle.request.nchains // self.pool.group)
+
+    def _admit(self, handle: TenantHandle) -> bool:
+        """Validate + write one tenant into free lane groups. Returns
+        False (and fails the handle) on structural mismatch."""
+        req = handle.request
+        pool = self.pool
+        t = pool.template
+        try:
+            ma = _localize_names(req.ma)
+            if ma.row_mask is not None:
+                raise ValueError("tenant models must be unpadded; the "
+                                 "pool pads to its own TOA axis")
+            if pool.heterogeneous:
+                if ma.n > pool.n_pool:
+                    raise ValueError(
+                        f"tenant n={ma.n} exceeds the pool TOA axis "
+                        f"{pool.n_pool}")
+            elif ma.n != pool.n_pool:
+                raise ValueError(
+                    f"tenant n={ma.n} != pool n={pool.n_pool}; a "
+                    "homogeneous pool admits only matching TOA counts "
+                    "(construct the pool with heterogeneous=True to "
+                    "accept suffix-padded tenants)")
+            if ma.m != t._ma.m:
+                raise ValueError(
+                    f"tenant basis size {ma.m} != pool {t._ma.m}")
+            if ma.param_names != t._ma.param_names:
+                raise ValueError(
+                    "tenant parameter structure differs from the pool "
+                    "template")
+            if ma.time_scale != t._ma.time_scale:
+                raise ValueError("tenant time_scale differs from pool")
+            if pool.heterogeneous:
+                (ma_p,) = pad_model_arrays([ma], n_to=pool.n_pool)
+            else:
+                ma_p = ma
+            if (jax.tree.structure(ma_p)
+                    != jax.tree.structure(t._ma)):
+                raise ValueError(
+                    "tenant model structure (noise groups / phi "
+                    "blocks) differs from the pool template")
+            # throwaway construction backend: builds/validates the
+            # tenant's fused-MH constants and the exact solo initial
+            # state (bit-compatibility with JaxGibbs.sample)
+            tb = JaxGibbs(ma_p, self.config, nchains=req.nchains,
+                          dtype=pool.dtype, chunk_size=pool.quantum,
+                          tnt_block_size=None, use_pallas=False,
+                          telemetry=False)
+            hc_t = (t._fuse_consts if t._fuse_consts is not None
+                    else t._hyper_consts)
+            hc_b = (tb._fuse_consts if tb._fuse_consts is not None
+                    else tb._hyper_consts)
+            if hc_t is not None:
+                if hc_b is None or hc_b.hyp_idx != hc_t.hyp_idx:
+                    raise ValueError(
+                        "tenant hyper structure (affine-phi rows) "
+                        "differs from the pool template")
+            if t._white_consts is not None:
+                if (tb._white_consts is None
+                        or tb._white_consts.var != t._white_consts.var):
+                    raise ValueError(
+                        "tenant white-noise structure differs from the "
+                        "pool template")
+            if t._beta_pool is not None:
+                if tb._beta_pool is None or tb._beta_pool > t._beta_pool:
+                    raise ValueError(
+                        "tenant TOA count is incompatible with the "
+                        "pool's exact chi-square theta pool "
+                        "(GST_FAST_BETA needs half-integer "
+                        "pseudo-counts within the pool's draw width); "
+                        "set GST_FAST_BETA=0 on the pool or match "
+                        "the tenant's n")
+            state = (req.state if req.state is not None
+                     else tb.init_state(req.x0, seed=req.seed))
+        except Exception as e:  # noqa: BLE001 - reject, don't kill pool
+            handle._fail(f"{type(e).__name__}: {e}")
+            return False
+        groups_needed = self._groups_needed(handle)
+        taken = [self._free_groups.pop(0) for _ in range(groups_needed)]
+        lanes = np.concatenate([
+            np.arange(g * pool.group, (g + 1) * pool.group)
+            for g in sorted(taken)])
+        n_real = ma.n
+        slot = TenantSlot(handle.tenant_id, lanes, req.nchains,
+                          req.niter, req.start_sweep, n_real, req.seed)
+        pool.write_tenant(slot, ma_p, tb, state)
+        spool = None
+        if req.spool_dir is not None:
+            from gibbs_student_t_tpu.utils.spool import ChainSpool
+
+            spool = ChainSpool(
+                req.spool_dir, req.seed, resume=req.start_sweep > 0,
+                resume_at=req.start_sweep if req.start_sweep else None,
+                record_mode=t.record_mode, record_thin=t.record_thin,
+                extra_meta={"tenant": handle.tenant_id,
+                            "n_toa": [n_real]})
+        handle.admitted_t = time.monotonic()
+        handle.status = "running"
+        self._running[handle.tenant_id] = (slot, handle, spool)
+        self._admission_ms.append(handle.admission_ms)
+        if self.metrics is not None:
+            self.metrics.histogram("serve_admission_ms").observe(
+                handle.admission_ms)
+            self.metrics.counter("serve_admissions").inc()
+            self.metrics.emit("admit", tenant=handle.tenant_id,
+                              nchains=req.nchains, niter=req.niter,
+                              lanes=int(lanes[0]),
+                              admission_ms=handle.admission_ms)
+        return True
+
+    def _try_admissions(self) -> None:
+        while self._free_groups:
+            free = len(self._free_groups)
+            h = self.queue.pop_first_fit(
+                lambda hh: self._groups_needed(hh) <= free)
+            if h is None:
+                break
+            self._admit(h)   # a rejected tenant frees nothing
+
+    # ------------------------------------------------------------------
+    # the quantum loop
+    # ------------------------------------------------------------------
+
+    def step(self) -> bool:
+        """One scheduling quantum: admit, advance, stream, evict.
+        Returns True while there is (or may be) work."""
+        with self._lock:
+            self._try_admissions()
+            if not self._running:
+                return len(self.queue) > 0
+            recs, tl = self.pool.run_quantum()
+            host = self.pool.materialize(recs)
+            tele = (jax.device_get(tl) if tl is not None else None)
+            q = self.pool.quantum
+            finished = []
+            for tid, (slot, handle, spool) in self._running.items():
+                slot.done_sweeps += q
+                sweep_end = slot.start_sweep + slot.done_sweeps
+                records = self.pool.tenant_records(host, slot)
+                if spool is not None:
+                    spool.append(records, self.pool.tenant_state(slot),
+                                 sweep_end)
+                # _stream stores (rows, nchains, ...) host arrays for
+                # in-memory tenants and fires the streaming callback
+                handle._stream(
+                    sweep_end,
+                    records if spool is None or handle.request.on_chunk
+                    else {})
+                if tele is not None:
+                    self._accumulate_tele(handle, slot, tele)
+                if slot.remaining <= 0:
+                    finished.append(tid)
+            self.quanta += 1
+            busy = sum(s.nchains for s, _, _ in self._running.values())
+            self.busy_lane_sweeps += busy * q
+            self.total_lane_sweeps += self.pool.nlanes * q
+            if self.metrics is not None:
+                self.metrics.gauge("serve_occupancy").set(
+                    busy / self.pool.nlanes)
+                self.metrics.gauge("serve_queue_depth").set(
+                    len(self.queue))
+                self.metrics.counter("serve_sweeps_total").inc(busy * q)
+            for tid in finished:
+                self._evict(tid)
+            return bool(self._running) or len(self.queue) > 0
+
+    def _accumulate_tele(self, handle: TenantHandle, slot: TenantSlot,
+                         tele) -> None:
+        """Fold one quantum's telemetry pytree (lane axis) into the
+        tenant's running tele_* stats (mean accept rates, divergence
+        counts — the ChainResult.stats convention)."""
+        lanes = slot.chain_lanes
+        sub = jax.tree.map(lambda a: np.asarray(a)[lanes], tele)
+        d = handle._tele_stats
+        n = handle.chunks_streamed
+        for name, val in zip(type(sub)._fields, sub):
+            key = f"tele_{name}"
+            prev = d.get(key)
+            d[key] = (val if prev is None
+                      else (prev * n + val) / (n + 1))
+
+    def _evict(self, tenant_id: int) -> None:
+        slot, handle, spool = self._running.pop(tenant_id)
+        self.pool.evict(slot)
+        for g in sorted(set(slot.lanes // self.pool.group)):
+            self._free_groups.append(int(g))
+        self._free_groups.sort()
+        if spool is not None:
+            spool.close()
+            from gibbs_student_t_tpu.utils.spool import load_spool
+
+            res = load_spool(handle.request.spool_dir)
+        else:
+            cols = {f: np.concatenate(chunks)
+                    for f, chunks in handle._cols.items()}
+            res = self.pool.template._to_result(cols)
+        res.stats.update(handle._tele_stats)
+        res.stats["n_toa"] = np.asarray([slot.n_real])
+        if self.metrics is not None:
+            self.metrics.emit("evict", tenant=tenant_id,
+                              sweeps=slot.done_sweeps)
+        handle._finish(res)
+
+    # ------------------------------------------------------------------
+    # drivers
+    # ------------------------------------------------------------------
+
+    def run(self, idle_exit: bool = True, poll_s: float = 0.02) -> None:
+        """Drive quanta until stopped (or, with ``idle_exit``, until
+        both the pool and the queue drain)."""
+        while not self._stop.is_set():
+            had_work = self.step()
+            if not had_work:
+                if idle_exit:
+                    return
+                time.sleep(poll_s)
+
+    def start(self) -> None:
+        """Run the quantum loop in a background thread until
+        :meth:`close`."""
+        if self._thread is not None:
+            raise RuntimeError("server already started")
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self.run, kwargs={"idle_exit": False}, daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    # ------------------------------------------------------------------
+    # summary
+    # ------------------------------------------------------------------
+
+    def summary(self) -> dict:
+        """Run-level serving metrics (the serve_bench ledger payload).
+        ``occupancy`` is chain-lane-sweeps actually served over total
+        lane-sweeps advanced; ``admission_ms`` the mean admission
+        latency."""
+        occ = (self.busy_lane_sweeps / self.total_lane_sweeps
+               if self.total_lane_sweeps else 0.0)
+        return {
+            "nlanes": self.pool.nlanes,
+            "quantum": self.pool.quantum,
+            "quanta": self.quanta,
+            "occupancy": occ,
+            "busy_chain_sweeps": self.busy_lane_sweeps,
+            "admission_ms": (float(np.mean(self._admission_ms))
+                             if self._admission_ms else None),
+            "admission_ms_max": (float(np.max(self._admission_ms))
+                                 if self._admission_ms else None),
+        }

@@ -38,6 +38,7 @@
 #include <memory>
 #include <new>
 #include <string>
+#include <type_traits>
 
 #if defined(_WIN32)
 #define GST_EXPORT2 extern "C" __declspec(dllexport)
@@ -52,8 +53,10 @@
 // against its own expected value) instead of miscalling a handler
 // whose argument list moved. v2: the round-9 draw/MH kernel family
 // (philox gamma-v2, fractional beta, white/hyper MH blocks, fused
-// Schur + hyper+draws megastage).
-#define GST_ABI_VERSION 2
+// Schur + hyper+draws megastage). v3: the multi-tenant serving family
+// (per-lane-consts tnt/fused-hyper lanes variants with the
+// tile-uniform group-id contract, residual matvec).
+#define GST_ABI_VERSION 3
 GST_EXPORT2 int gst_abi_version() { return GST_ABI_VERSION; }
 
 // Best SIMD level this object was compiled for — the Python loader
@@ -110,6 +113,9 @@ using gst::solve_vec_batch;
 using gst::solve_mat_batch;
 using gst::chisq_batch;
 using gst::tnt_batch;
+using gst::tnt_lanes_batch;
+using gst::resid_batch;
+using gst::resid_lanes_batch;
 
 // ---------------------------------------------------------------------
 // FFI handlers
@@ -197,6 +203,101 @@ ffi::Error tnt_impl(ffi::Buffer<DT> T, ffi::Buffer<DT> y,
     tnt_batch(T.typed_data(), y.typed_data(), nvec.typed_data(),
               TNT->typed_data(), d->typed_data(), cw->typed_data(), B, n,
               m);
+  return ffi::Error::Success();
+}
+
+// Tile-uniform group-id contract of the *_lanes kernels: per-lane
+// constants may only change at aligned W-lane tile boundaries (the
+// serve scheduler admits tenants in whole tiles). Verified here so a
+// scheduler bug surfaces as a clear error instead of silently reading
+// the wrong tenant's constants for part of a tile.
+template <typename T>
+const char* check_tile_uniform(const int32_t* gid, int64_t B) {
+  constexpr int W = gst::Lanes<T>::W;
+  for (int64_t b0 = 0; b0 < B; b0 += W) {
+    const int64_t lanes = std::min<int64_t>(W, B - b0);
+    for (int64_t l = 1; l < lanes; ++l)
+      if (gid[b0 + l] != gid[b0]) {
+        static thread_local std::string why;
+        why = "group straddles a lane tile (W=" + std::to_string(W)
+              + " b0=" + std::to_string(b0) + " l=" + std::to_string(l)
+              + " gid=" + std::to_string(gid[b0]) + "/"
+              + std::to_string(gid[b0 + l]) + ")";
+        return why.c_str();
+      }
+  }
+  return nullptr;
+}
+
+template <ffi::DataType DT>
+ffi::Error tnt_lanes_impl(ffi::Buffer<DT> T, ffi::Buffer<DT> y,
+                          ffi::Buffer<DT> nvec, ffi::Buffer<ffi::S32> gid,
+                          ffi::ResultBuffer<DT> TNT,
+                          ffi::ResultBuffer<DT> d,
+                          ffi::ResultBuffer<DT> cw) {
+  auto tdims = T.dimensions();
+  if (tdims.size() != 3)
+    return ffi::Error::InvalidArgument("gst_tnt_lanes: T must be (B, n, m)");
+  const int64_t B = tdims[0];
+  const int64_t n = tdims[1];
+  const int64_t m = tdims[2];
+  if (y.element_count() != size_t(B) * n
+      || nvec.element_count() != size_t(B) * n
+      || gid.element_count() != size_t(B))
+    return ffi::Error::InvalidArgument("gst_tnt_lanes: shapes");
+  using NT = std::remove_pointer_t<decltype(T.typed_data())>;
+  if (const char* why = check_tile_uniform<NT>(gid.typed_data(), B))
+    return ffi::Error::InvalidArgument(
+        std::string("gst_tnt_lanes: ") + why);
+  if (B && n && m)
+    tnt_lanes_batch(T.typed_data(), y.typed_data(), nvec.typed_data(),
+                    gid.typed_data(), TNT->typed_data(),
+                    d->typed_data(), cw->typed_data(), B, n, m);
+  return ffi::Error::Success();
+}
+
+template <ffi::DataType DT>
+ffi::Error resid_impl(ffi::Buffer<DT> T, ffi::Buffer<DT> y,
+                      ffi::Buffer<DT> b, ffi::ResultBuffer<DT> out) {
+  auto tdims = T.dimensions();
+  auto bdims = b.dimensions();
+  if (tdims.size() != 2 || bdims.size() < 1)
+    return ffi::Error::InvalidArgument("gst_resid: ranks");
+  const int64_t n = tdims[0];
+  const int64_t m = tdims[1];
+  const int64_t B = batch_of(bdims, 1);
+  if (y.element_count() != size_t(n)
+      || bdims[bdims.size() - 1] != m)
+    return ffi::Error::InvalidArgument("gst_resid: shapes");
+  if (B && n && m)
+    resid_batch(T.typed_data(), y.typed_data(), b.typed_data(),
+                out->typed_data(), B, n, m);
+  return ffi::Error::Success();
+}
+
+template <ffi::DataType DT>
+ffi::Error resid_lanes_impl(ffi::Buffer<DT> T, ffi::Buffer<DT> y,
+                            ffi::Buffer<DT> b,
+                            ffi::Buffer<ffi::S32> gid,
+                            ffi::ResultBuffer<DT> out) {
+  auto tdims = T.dimensions();
+  auto bdims = b.dimensions();
+  if (tdims.size() != 3 || bdims.size() < 1)
+    return ffi::Error::InvalidArgument("gst_resid_lanes: ranks");
+  const int64_t B = tdims[0];
+  const int64_t n = tdims[1];
+  const int64_t m = tdims[2];
+  if (y.element_count() != size_t(B) * n
+      || bdims[bdims.size() - 1] != m || batch_of(bdims, 1) != B
+      || gid.element_count() != size_t(B))
+    return ffi::Error::InvalidArgument("gst_resid_lanes: shapes");
+  using NT = std::remove_pointer_t<decltype(T.typed_data())>;
+  if (const char* why = check_tile_uniform<NT>(gid.typed_data(), B))
+    return ffi::Error::InvalidArgument(
+        std::string("gst_resid_lanes: ") + why);
+  if (B && n && m)
+    resid_lanes_batch(T.typed_data(), y.typed_data(), b.typed_data(),
+                      gid.typed_data(), out->typed_data(), B, n, m);
   return ffi::Error::Success();
 }
 
@@ -478,6 +579,71 @@ ffi::Error fused_hyper_impl(
   return ffi::Error::Success();
 }
 
+template <ffi::DataType DT>
+ffi::Error fused_hyper_lanes_impl(
+    ffi::Buffer<DT> A, ffi::Buffer<DT> Bm, ffi::Buffer<DT> C,
+    ffi::Buffer<DT> rhs_s, ffi::Buffer<DT> rhs_v, ffi::Buffer<DT> x,
+    ffi::Buffer<DT> dx, ffi::Buffer<DT> logu, ffi::Buffer<DT> xi,
+    ffi::Buffer<DT> base0, ffi::Buffer<DT> K, ffi::Buffer<DT> sel,
+    ffi::Buffer<DT> phist, ffi::Buffer<DT> specs,
+    ffi::Buffer<ffi::S32> hypidx, ffi::Buffer<ffi::S32> gid,
+    ffi::Buffer<DT> jitter, ffi::Buffer<DT> jits,
+    ffi::ResultBuffer<DT> xo, ffi::ResultBuffer<DT> acc,
+    ffi::ResultBuffer<DT> y_v, ffi::ResultBuffer<DT> isd_v,
+    ffi::ResultBuffer<DT> y_s, ffi::ResultBuffer<DT> isd_a) {
+  auto adims = A.dimensions();
+  auto cdims = C.dimensions();
+  auto xdims = x.dimensions();
+  auto ddims = dx.dimensions();
+  if (adims.size() < 2 || cdims.size() < 2 || xdims.size() < 1
+      || ddims.size() < 2)
+    return ffi::Error::InvalidArgument("gst_fused_hyper_lanes: ranks");
+  const int64_t ns = adims[adims.size() - 1];
+  const int64_t nv = cdims[cdims.size() - 1];
+  const int64_t p = xdims[xdims.size() - 1];
+  const int64_t B = batch_of(adims, 2);
+  const int64_t S = ddims[ddims.size() - 2];
+  const int64_t nk = hypidx.element_count();
+  const int64_t nlev = jits.element_count();
+  if (adims[adims.size() - 2] != ns || cdims[cdims.size() - 2] != nv
+      || batch_of(cdims, 2) != B || batch_of(xdims, 1) != B
+      || Bm.element_count() != size_t(B) * ns * nv
+      || rhs_s.element_count() != size_t(B) * ns
+      || rhs_v.element_count() != size_t(B) * nv
+      || dx.element_count() != size_t(B) * S * p
+      || logu.element_count() != size_t(B) * S
+      || xi.element_count() != size_t(B) * (ns + nv)
+      || base0.element_count() != size_t(B)
+      || K.element_count() != size_t(B) * (1 + nk) * nv
+      || sel.element_count() != size_t(B) * nv
+      || phist.element_count() != size_t(B) * nv
+      || specs.element_count() != size_t(B) * 3 * p
+      || gid.element_count() != size_t(B)
+      || jitter.element_count() != 1 || nlev < 1)
+    return ffi::Error::InvalidArgument("gst_fused_hyper_lanes: shapes");
+  if (p > 64 || nk > 16)
+    return ffi::Error::InvalidArgument("gst_fused_hyper_lanes: limits");
+  for (int64_t k = 0; k < nk; ++k)
+    if (hypidx.typed_data()[k] < 0 || hypidx.typed_data()[k] >= p)
+      return ffi::Error::InvalidArgument("gst_fused_hyper_lanes: hypidx");
+  using NT = std::remove_pointer_t<decltype(x.typed_data())>;
+  if (const char* why = check_tile_uniform<NT>(gid.typed_data(), B))
+    return ffi::Error::InvalidArgument(
+        std::string("gst_fused_hyper_lanes: ") + why);
+  if (B && p && ns && nv && S)
+    gst::fused_hyper_lanes_batch(
+        A.typed_data(), Bm.typed_data(), C.typed_data(),
+        rhs_s.typed_data(), rhs_v.typed_data(), x.typed_data(),
+        dx.typed_data(), logu.typed_data(), xi.typed_data(),
+        base0.typed_data(), K.typed_data(), sel.typed_data(),
+        phist.typed_data(), specs.typed_data(), hypidx.typed_data(), nk,
+        jitter.typed_data()[0], jits.typed_data(), nlev,
+        xo->typed_data(), acc->typed_data(), y_v->typed_data(),
+        isd_v->typed_data(), y_s->typed_data(), isd_a->typed_data(), B,
+        p, ns, nv, S);
+  return ffi::Error::Success();
+}
+
 }  // namespace
 
 #define GST_BIND_FACTOR(DT)                \
@@ -570,6 +736,48 @@ XLA_FFI_DEFINE_HANDLER_SYMBOL(GstTntF32, (tnt_impl<ffi::F32>),
                               GST_BIND_TNT(ffi::F32));
 XLA_FFI_DEFINE_HANDLER_SYMBOL(GstTntF64, (tnt_impl<ffi::F64>),
                               GST_BIND_TNT(ffi::F64));
+
+#define GST_BIND_TNT_LANES(DT)             \
+  ffi::Ffi::Bind()                         \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<ffi::S32>>()        \
+      .Ret<ffi::Buffer<DT>>()              \
+      .Ret<ffi::Buffer<DT>>()              \
+      .Ret<ffi::Buffer<DT>>()
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(GstTntLanesF32, (tnt_lanes_impl<ffi::F32>),
+                              GST_BIND_TNT_LANES(ffi::F32));
+XLA_FFI_DEFINE_HANDLER_SYMBOL(GstTntLanesF64, (tnt_lanes_impl<ffi::F64>),
+                              GST_BIND_TNT_LANES(ffi::F64));
+
+#define GST_BIND_RESID(DT)                 \
+  ffi::Ffi::Bind()                         \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Ret<ffi::Buffer<DT>>()
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(GstResidF32, (resid_impl<ffi::F32>),
+                              GST_BIND_RESID(ffi::F32));
+XLA_FFI_DEFINE_HANDLER_SYMBOL(GstResidF64, (resid_impl<ffi::F64>),
+                              GST_BIND_RESID(ffi::F64));
+
+#define GST_BIND_RESID_LANES(DT)           \
+  ffi::Ffi::Bind()                         \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<ffi::S32>>()        \
+      .Ret<ffi::Buffer<DT>>()
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(GstResidLanesF32,
+                              (resid_lanes_impl<ffi::F32>),
+                              GST_BIND_RESID_LANES(ffi::F32));
+XLA_FFI_DEFINE_HANDLER_SYMBOL(GstResidLanesF64,
+                              (resid_lanes_impl<ffi::F64>),
+                              GST_BIND_RESID_LANES(ffi::F64));
 
 #define GST_BIND_GAMMA_V2(DT)              \
   ffi::Ffi::Bind()                         \
@@ -684,6 +892,40 @@ XLA_FFI_DEFINE_HANDLER_SYMBOL(GstFusedHyperF32,
 XLA_FFI_DEFINE_HANDLER_SYMBOL(GstFusedHyperF64,
                               (fused_hyper_impl<ffi::F64>),
                               GST_BIND_FUSED_HYPER(ffi::F64));
+
+#define GST_BIND_FUSED_HYPER_LANES(DT)     \
+  ffi::Ffi::Bind()                         \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<ffi::S32>>()        \
+      .Arg<ffi::Buffer<ffi::S32>>()        \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Ret<ffi::Buffer<DT>>()              \
+      .Ret<ffi::Buffer<DT>>()              \
+      .Ret<ffi::Buffer<DT>>()              \
+      .Ret<ffi::Buffer<DT>>()              \
+      .Ret<ffi::Buffer<DT>>()              \
+      .Ret<ffi::Buffer<DT>>()
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(GstFusedHyperLanesF32,
+                              (fused_hyper_lanes_impl<ffi::F32>),
+                              GST_BIND_FUSED_HYPER_LANES(ffi::F32));
+XLA_FFI_DEFINE_HANDLER_SYMBOL(GstFusedHyperLanesF64,
+                              (fused_hyper_lanes_impl<ffi::F64>),
+                              GST_BIND_FUSED_HYPER_LANES(ffi::F64));
 
 // Plain-C debug/parity entry for the in-kernel RNG: fills ``out`` with
 // ``count`` philox words for (key, ctr0 row, tag) — how the jnp twin's

@@ -769,6 +769,173 @@ void tnt_batch(const T* Tm, const T* yv, const T* nvec, T* TNT, T* d,
   }
 }
 
+// Multi-tenant twin of tnt_batch: basis and residuals PER LANE (the
+// serve slot pool's call-time dataset operands, docs/SERVING.md), under
+// the contract that they are uniform within each aligned W-lane tile —
+// ``gid`` marks the lane groups (admission is tile-granular;
+// gst_ffi.cpp rejects tiles that straddle groups). The transposed
+// augmented basis is rebuilt only when gid changes between consecutive
+// tiles, so a tenant spanning many tiles pays ONE transpose; the
+// per-tile compute is the exact tnt_batch loop, so a uniform pool is
+// bitwise identical to the shared-basis kernel.
+template <typename T>
+void tnt_lanes_batch(const T* Tm, const T* yv, const T* nvec,
+                     const int32_t* gid, T* TNT, T* d, T* cw, int64_t B,
+                     int64_t n, int64_t m) {
+  constexpr int W = Lanes<T>::W;
+  using V = typename VecOf<T, W>::type;
+  using D = typename VecOf<double, W>::type;
+  Scratch<T> Tt(size_t(m + 1) * n);
+  Scratch<T> wt(size_t(n) * W), vi(size_t(n) * W),
+      row(size_t(m + 1) * W);
+  int32_t last_gid = 0;
+  bool have = false;
+  for (int64_t b0 = 0; b0 < B; b0 += W) {
+    const int64_t lanes = std::min<int64_t>(W, B - b0);
+    if (!have || gid[b0] != last_gid) {
+      const T* Tb = Tm + size_t(b0) * n * m;
+      for (int64_t i = 0; i < m; ++i)
+        for (int64_t k = 0; k < n; ++k)
+          Tt.get()[i * n + k] = Tb[k * m + i];
+      std::memcpy(Tt.get() + size_t(m) * n, yv + size_t(b0) * n,
+                  size_t(n) * sizeof(T));
+      last_gid = gid[b0];
+      have = true;
+    }
+    load_tile<T, W>(nvec, wt.get(), b0, lanes, n, n);
+    V* wv = reinterpret_cast<V*>(wt.get());
+    D lg = {};
+    D prod = splat<double, W>(1.0);
+    int since = 0;
+    const V one = splat<T, W>(T(1));
+    for (int64_t k = 0; k < n; ++k) {
+      const V nv = wv[k];
+      for (int l = 0; l < W; ++l) prod[l] *= double(nv[l]);
+      if (++since == 8 || k == n - 1) {
+        for (int l = 0; l < W; ++l) lg[l] += std::log(prod[l]);
+        prod = splat<double, W>(1.0);
+        since = 0;
+      }
+      wv[k] = one / nv;
+    }
+    V* viv = reinterpret_cast<V*>(vi.get());
+    V* rowv = reinterpret_cast<V*>(row.get());
+    for (int64_t i = 0; i <= m; ++i) {
+      const T* ti = Tt.get() + i * n;
+      for (int64_t k = 0; k < n; ++k) viv[k] = wv[k] * ti[k];
+      int64_t j = 0;
+      for (; j + 4 <= i + 1; j += 4) {
+        const T* t0 = Tt.get() + (j + 0) * n;
+        const T* t1 = Tt.get() + (j + 1) * n;
+        const T* t2 = Tt.get() + (j + 2) * n;
+        const T* t3 = Tt.get() + (j + 3) * n;
+        V s0 = {}, s1 = {}, s2 = {}, s3 = {};
+        for (int64_t k = 0; k < n; ++k) {
+          const V v = viv[k];
+          s0 += v * t0[k];
+          s1 += v * t1[k];
+          s2 += v * t2[k];
+          s3 += v * t3[k];
+        }
+        rowv[j] = s0;
+        rowv[j + 1] = s1;
+        rowv[j + 2] = s2;
+        rowv[j + 3] = s3;
+      }
+      for (; j <= i; ++j) {
+        const T* tj = Tt.get() + j * n;
+        V s = {};
+        for (int64_t k = 0; k < n; ++k) s += viv[k] * tj[k];
+        rowv[j] = s;
+      }
+      if (i < m) {
+        store_tile<T, W>(row.get(), TNT + i * m, b0, lanes, i + 1,
+                         m * m);
+        for (int64_t jj = 0; jj < i; ++jj)
+          for (int64_t l = 0; l < lanes; ++l)
+            TNT[(b0 + l) * m * m + jj * m + i] = row.get()[jj * W + l];
+      } else {
+        store_tile<T, W>(row.get(), d, b0, lanes, m, m);
+        for (int64_t l = 0; l < lanes; ++l)
+          cw[b0 + l] =
+              T(-0.5 * (lg[l] + double(row.get()[m * W + l])));
+      }
+    }
+  }
+}
+
+// Conditional-likelihood residual resid = y - T b for a chain batch
+// sharing one basis — the z/df glue's (n, m) matvec
+// (backends/jax_backend.py _sweep_rest). b tiles transpose to
+// chains-contiguous scratch; each TOA row is then a splat-FMA over the
+// m basis columns with 4-way register blocking, the basis L2-resident
+// across tiles.
+template <typename T>
+void resid_batch(const T* Tm, const T* yv, const T* b, T* out,
+                 int64_t B, int64_t n, int64_t m) {
+  constexpr int W = Lanes<T>::W;
+  using V = typename VecOf<T, W>::type;
+  Scratch<T> bt(size_t(m) * W), ot(size_t(n) * W);
+  for (int64_t b0 = 0; b0 < B; b0 += W) {
+    const int64_t lanes = std::min<int64_t>(W, B - b0);
+    load_tile<T, W>(b, bt.get(), b0, lanes, m, m);
+    const V* bv = reinterpret_cast<const V*>(bt.get());
+    V* ov = reinterpret_cast<V*>(ot.get());
+    for (int64_t k = 0; k < n; ++k) {
+      const T* tk = Tm + k * m;
+      V s0 = {}, s1 = {}, s2 = {}, s3 = {};
+      int64_t i = 0;
+      for (; i + 4 <= m; i += 4) {
+        s0 += bv[i + 0] * tk[i + 0];
+        s1 += bv[i + 1] * tk[i + 1];
+        s2 += bv[i + 2] * tk[i + 2];
+        s3 += bv[i + 3] * tk[i + 3];
+      }
+      for (; i < m; ++i) s0 += bv[i] * tk[i];
+      ov[k] = splat<T, W>(yv[k]) - ((s0 + s1) + (s2 + s3));
+    }
+    store_tile<T, W>(ot.get(), out, b0, lanes, n, n);
+  }
+}
+
+// Multi-tenant twin of resid_batch: per-lane basis/residuals under the
+// tile-uniform gid contract. The inner loop is IDENTICAL to the shared
+// form (the per-lane y load replaces a splat of the same value), so a
+// lane's residual is bitwise what resid_batch computes for the same
+// basis — the serve bit-identity pin rests on this.
+template <typename T>
+void resid_lanes_batch(const T* Tm, const T* yv, const T* b,
+                       const int32_t* gid, T* out, int64_t B, int64_t n,
+                       int64_t m) {
+  (void)gid;  // uniformity verified by the FFI handler
+  constexpr int W = Lanes<T>::W;
+  using V = typename VecOf<T, W>::type;
+  Scratch<T> bt(size_t(m) * W), yt(size_t(n) * W), ot(size_t(n) * W);
+  for (int64_t b0 = 0; b0 < B; b0 += W) {
+    const int64_t lanes = std::min<int64_t>(W, B - b0);
+    const T* Tb = Tm + size_t(b0) * n * m;
+    load_tile<T, W>(b, bt.get(), b0, lanes, m, m);
+    load_tile<T, W>(yv, yt.get(), b0, lanes, n, n);
+    const V* bv = reinterpret_cast<const V*>(bt.get());
+    const V* yvv = reinterpret_cast<const V*>(yt.get());
+    V* ov = reinterpret_cast<V*>(ot.get());
+    for (int64_t k = 0; k < n; ++k) {
+      const T* tk = Tb + k * m;
+      V s0 = {}, s1 = {}, s2 = {}, s3 = {};
+      int64_t i = 0;
+      for (; i + 4 <= m; i += 4) {
+        s0 += bv[i + 0] * tk[i + 0];
+        s1 += bv[i + 1] * tk[i + 1];
+        s2 += bv[i + 2] * tk[i + 2];
+        s3 += bv[i + 3] * tk[i + 3];
+      }
+      for (; i < m; ++i) s0 += bv[i] * tk[i];
+      ov[k] = yvv[k] - ((s0 + s1) + (s2 + s3));
+    }
+    store_tile<T, W>(ot.get(), out, b0, lanes, n, n);
+  }
+}
+
 // Masked sum-of-squared-normals chi-square reduction: one fused pass
 // (the jnp formulation materializes the where-mask and the squared
 // array before reducing). rows = B*n, each kmax wide; out = 0.5 *
@@ -1229,9 +1396,14 @@ void beta_frac_batch(const uint32_t* keys, const T* a, const T* b,
                      T* out, int64_t B) {
   for (int64_t c = 0; c < B; ++c) {
     const uint32_t k0 = keys[2 * c], k1 = keys[2 * c + 1];
-    const double ga = gamma_mt_scalar(k0, k1, (uint32_t)c, kTagBetaA,
+    // ctr0 is NOT the batch index: the per-chain key words already
+    // separate chains, and folding the position in would make a
+    // chain's draw depend on where it sits in the batch — the serve
+    // slot pool places the same chain at arbitrary lanes and pins
+    // draws equal to the solo backend's (tests/test_serve.py).
+    const double ga = gamma_mt_scalar(k0, k1, 0u, kTagBetaA,
                                       double(a[c]));
-    const double gb = gamma_mt_scalar(k0, k1, (uint32_t)c, kTagBetaB,
+    const double gb = gamma_mt_scalar(k0, k1, 0u, kTagBetaB,
                                       double(b[c]));
     out[c] = T(ga / (ga + gb));
   }
@@ -1699,21 +1871,28 @@ void schur_batch(const T* A, const T* Bm, const T* C, const T* rhs_s,
 // and the draw pieces (y_v, isd_v, y_s, isd_a) the caller scatters
 // into b. Sub-kernels are the SAME tile functions the per-stage arms
 // run, so fuse on/off native paths agree bitwise.
+// ``cs_*`` are per-LANE strides of the model-constant operands: all
+// zero for the single-model call (constants shared by every chain —
+// the round-9 form, bitwise unchanged), or their per-lane sizes for
+// the serve slot pool's lanes variant (constants uniform within each
+// aligned W-tile; per-tile pointers select the tile's tenant).
 template <typename T>
-void fused_hyper_batch(const T* A, const T* Bm, const T* C,
-                       const T* rhs_s, const T* rhs_v, const T* x,
-                       const T* dx, const T* logu, const T* xi,
-                       const T* base0, const T* K, const T* sel,
-                       const T* phist, const T* specs,
-                       const int32_t* hypidx, int64_t nk, T jitter,
-                       const T* jits, int64_t nlev,
-                       T* xo, T* acc, T* y_v, T* isd_v_o, T* y_s,
-                       T* isd_a_o, int64_t B, int64_t p, int64_t ns,
-                       int64_t nv, int64_t S) {
+void fused_hyper_batch_strided(const T* A, const T* Bm, const T* C,
+                               const T* rhs_s, const T* rhs_v, const T* x,
+                               const T* dx, const T* logu, const T* xi,
+                               const T* base0, const T* K, const T* sel,
+                               const T* phist, const T* specs,
+                               const int32_t* hypidx, int64_t nk, T jitter,
+                               const T* jits, int64_t nlev,
+                               T* xo, T* acc, T* y_v, T* isd_v_o, T* y_s,
+                               T* isd_a_o, int64_t B, int64_t p, int64_t ns,
+                               int64_t nv, int64_t S, int64_t cs_K,
+                               int64_t cs_sel, int64_t cs_phist,
+                               int64_t cs_specs) {
   constexpr int W = Lanes<T>::W;
   using V = typename VecOf<T, W>::type;
   PriorTab<T> pt;
-  pt.build(specs, p);
+  if (!cs_specs) pt.build(specs, p);
   const int64_t k = nv + 1;
   const int64_t m = ns + nv;
   Scratch<T> At(size_t(ns) * ns * W), Bt(size_t(ns) * nv * W),
@@ -1728,6 +1907,10 @@ void fused_hyper_batch(const T* A, const T* Bm, const T* C,
       yt(size_t(nv) * W), yst(size_t(ns) * W);
   for (int64_t b0 = 0; b0 < B; b0 += W) {
     const int64_t lanes = std::min<int64_t>(W, B - b0);
+    const T* Kb = K + size_t(b0) * cs_K;
+    const T* selb = sel + size_t(b0) * cs_sel;
+    const T* phistb = phist + size_t(b0) * cs_phist;
+    if (cs_specs) pt.build(specs + size_t(b0) * cs_specs, p);
     load_tile_lower<T, W>(A, At.get(), b0, lanes, ns, ns * ns);
     load_tile<T, W>(Bm, Bt.get(), b0, lanes, ns * nv, ns * nv);
     load_tile<T, W>(C, Ct.get(), b0, lanes, nv * nv, nv * nv);
@@ -1746,13 +1929,13 @@ void fused_hyper_batch(const T* A, const T* Bm, const T* C,
     V* S0v = reinterpret_cast<V*>(S0t.get());
     V* dS0v = reinterpret_cast<V*>(dS0t.get());
     for (int64_t c = 0; c < nv; ++c)
-      dS0v[c] = S0v[c * nv + c] + splat<T, W>(phist[c]);
+      dS0v[c] = S0v[c * nv + c] + splat<T, W>(phistb[c]);
     const V base =
         *reinterpret_cast<const V*>(bt.get())
         + splat<T, W>(T(0.5))
               * (reinterpret_cast<const V*>(quad.get())[0]
                  - reinterpret_cast<const V*>(ldA.get())[0]);
-    HyperTile<T, W> ht{K, sel, hypidx, nk, nv, p, jitter, &pt,
+    HyperTile<T, W> ht{Kb, selb, hypidx, nk, nv, p, jitter, &pt,
                        reinterpret_cast<const V*>(S0t.get()),
                        reinterpret_cast<const V*>(dS0t.get()),
                        reinterpret_cast<const V*>(rtt.get()),
@@ -1810,6 +1993,47 @@ void fused_hyper_batch(const T* A, const T* Bm, const T* C,
     store_tile<T, W>(yst.get(), y_s, b0, lanes, ns, ns);
     store_tile<T, W>(isd.get(), isd_a_o, b0, lanes, ns, ns);
   }
+}
+
+// The round-9 single-model form: constants shared across the whole
+// chain batch (strides 0 — bitwise the pre-refactor kernel).
+template <typename T>
+void fused_hyper_batch(const T* A, const T* Bm, const T* C,
+                       const T* rhs_s, const T* rhs_v, const T* x,
+                       const T* dx, const T* logu, const T* xi,
+                       const T* base0, const T* K, const T* sel,
+                       const T* phist, const T* specs,
+                       const int32_t* hypidx, int64_t nk, T jitter,
+                       const T* jits, int64_t nlev,
+                       T* xo, T* acc, T* y_v, T* isd_v_o, T* y_s,
+                       T* isd_a_o, int64_t B, int64_t p, int64_t ns,
+                       int64_t nv, int64_t S) {
+  fused_hyper_batch_strided(A, Bm, C, rhs_s, rhs_v, x, dx, logu, xi,
+                            base0, K, sel, phist, specs, hypidx, nk,
+                            jitter, jits, nlev, xo, acc, y_v, isd_v_o,
+                            y_s, isd_a_o, B, p, ns, nv, S, 0, 0, 0, 0);
+}
+
+// Multi-tenant megastage: per-LANE constant operands (uniform within
+// each aligned W-tile, tile pointers select the tenant — the
+// tnt_lanes_batch contract). Same tile functions as the shared form,
+// so a uniform pool is bitwise identical to fused_hyper_batch.
+template <typename T>
+void fused_hyper_lanes_batch(const T* A, const T* Bm, const T* C,
+                             const T* rhs_s, const T* rhs_v, const T* x,
+                             const T* dx, const T* logu, const T* xi,
+                             const T* base0, const T* K, const T* sel,
+                             const T* phist, const T* specs,
+                             const int32_t* hypidx, int64_t nk, T jitter,
+                             const T* jits, int64_t nlev,
+                             T* xo, T* acc, T* y_v, T* isd_v_o, T* y_s,
+                             T* isd_a_o, int64_t B, int64_t p, int64_t ns,
+                             int64_t nv, int64_t S) {
+  fused_hyper_batch_strided(A, Bm, C, rhs_s, rhs_v, x, dx, logu, xi,
+                            base0, K, sel, phist, specs, hypidx, nk,
+                            jitter, jits, nlev, xo, acc, y_v, isd_v_o,
+                            y_s, isd_a_o, B, p, ns, nv, S,
+                            (1 + nk) * nv, nv, nv, 3 * p);
 }
 
 }  // namespace gst

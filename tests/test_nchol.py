@@ -1162,3 +1162,158 @@ def test_registered_targets_match_exported_symbols():
     for sym in ("gst_simd_level", "gst_abi_version", "gst_philox_fill",
                 "gst_bench_chisq", "gst_bench_transpose_reg"):
         assert sym in exported, f"plain-C entry {sym} missing"
+
+
+# ----------------------------------------------------------------------
+# multi-tenant lanes kernels (serve slot pool, ABI v3)
+# ----------------------------------------------------------------------
+
+
+def test_tnt_lanes_and_resid_kernels():
+    """The per-lane-consts twins: a uniform pool is BITWISE the shared
+    kernel (same tile functions), heterogeneous tiles match the f64
+    einsum oracle, and a group straddling a SIMD tile is rejected by
+    the handler (the admission-granularity contract)."""
+    _require_kernels()
+    jax.config.update("jax_enable_x64", True)
+    try:
+        rng = np.random.default_rng(0)
+        B, n, m = 48, 37, 9
+        for dt, W in ((np.float64, 8), (np.float32, 16)):
+            T1 = rng.standard_normal((n, m)).astype(dt)
+            y1 = rng.standard_normal(n).astype(dt)
+            nvec = (0.5 + rng.random((B, n))).astype(dt)
+            Tb = np.broadcast_to(T1, (B, n, m)).copy()
+            yb = np.broadcast_to(y1, (B, n)).copy()
+            gid = np.zeros(B, np.int32)
+            a = nffi.tnt(jnp.asarray(T1), jnp.asarray(y1),
+                         jnp.asarray(nvec))
+            b = nffi.tnt_lanes(jnp.asarray(Tb), jnp.asarray(yb),
+                               jnp.asarray(nvec), jnp.asarray(gid))
+            for got, exp in zip(b, a):
+                np.testing.assert_array_equal(np.asarray(got),
+                                              np.asarray(exp))
+            # heterogeneous groups at tile boundaries vs the oracle
+            T2 = rng.standard_normal((n, m)).astype(dt)
+            y2 = rng.standard_normal(n).astype(dt)
+            Tb2, yb2, gid2 = Tb.copy(), yb.copy(), gid.copy()
+            Tb2[W:2 * W] = T2
+            yb2[W:2 * W] = y2
+            gid2[W:2 * W] = 1
+            out = nffi.tnt_lanes(jnp.asarray(Tb2), jnp.asarray(yb2),
+                                 jnp.asarray(nvec), jnp.asarray(gid2))
+            w = 1.0 / nvec.astype(np.float64)
+            T64 = Tb2.astype(np.float64)
+            tol = 1e-9 if dt == np.float64 else 2e-3
+            np.testing.assert_allclose(
+                np.asarray(out[0]),
+                np.einsum("bni,bn,bnj->bij", T64, w, T64),
+                rtol=tol, atol=tol)
+            np.testing.assert_allclose(
+                np.asarray(out[1]),
+                np.einsum("bni,bn,bn->bi", T64, w,
+                          yb2.astype(np.float64)),
+                rtol=tol, atol=tol)
+            # resid + its lanes twin: bitwise vs each other, oracle tol
+            bvec = rng.standard_normal((B, m)).astype(dt)
+            r = nffi.resid(jnp.asarray(T1), jnp.asarray(y1),
+                           jnp.asarray(bvec))
+            rl = nffi.resid_lanes(jnp.asarray(Tb), jnp.asarray(yb),
+                                  jnp.asarray(bvec), jnp.asarray(gid))
+            np.testing.assert_array_equal(np.asarray(r),
+                                          np.asarray(rl))
+            np.testing.assert_allclose(
+                np.asarray(r),
+                y1[None].astype(np.float64)
+                - bvec.astype(np.float64) @ T1.T.astype(np.float64),
+                rtol=tol, atol=tol)
+        # tile-straddle rejection (f32 tile width 16)
+        bad = np.zeros(B, np.int32)
+        bad[3] = 1
+        with pytest.raises(Exception, match="straddles"):
+            jax.block_until_ready(nffi.tnt_lanes(
+                jnp.asarray(Tb.astype(np.float32)),
+                jnp.asarray(yb.astype(np.float32)),
+                jnp.asarray(nvec.astype(np.float32)),
+                jnp.asarray(bad)))
+    finally:
+        jax.config.update("jax_enable_x64", False)
+
+
+def test_fused_hyper_lanes_uniform_bitwise():
+    """fused_hyper_lanes with every lane carrying the same constants is
+    BITWISE the single-model megastage (they share the tile functions —
+    the serve bit-identity pin rests on this), and a tile whose
+    constants differ changes only its own lanes."""
+    _require_kernels()
+    rng = np.random.default_rng(1)
+    B, ns, nv, p, nk, S = 33, 4, 6, 8, 2, 3
+    dt = np.float32
+
+    def spd(k):
+        M = rng.standard_normal((B, k, k))
+        return (np.einsum("bij,bkj->bik", M, M)
+                + 5 * np.eye(k)).astype(dt)
+
+    A, C = spd(ns), spd(nv)
+    Bm = (0.1 * rng.standard_normal((B, ns, nv))).astype(dt)
+    rs = rng.standard_normal((B, ns)).astype(dt)
+    rv = rng.standard_normal((B, nv)).astype(dt)
+    x = rng.standard_normal((B, p)).astype(dt)
+    dx = (0.1 * rng.standard_normal((B, S, p))).astype(dt)
+    logu = np.log(rng.random((B, S))).astype(dt)
+    xi = rng.standard_normal((B, ns + nv)).astype(dt)
+    base0 = rng.standard_normal(B).astype(dt)
+    K = (0.3 * rng.standard_normal((1 + nk, nv))).astype(dt)
+    sel = (rng.random(nv) > 0.3).astype(dt)
+    phist = (rng.random(nv) * (1 - sel)).astype(dt)
+    specs = np.zeros((3, p), dt)
+    specs[1], specs[2] = -50, 50
+    hyp_idx, jitter = (1, 4), 1e-6
+    jitters = (1e-6, 1e-4, 1e-2, 1e-1)
+    args = [jnp.asarray(a)
+            for a in (A, Bm, C, rs, rv, x, dx, logu, xi, base0)]
+    shared = nffi.fused_hyper(
+        *args, jnp.asarray(K), jnp.asarray(sel), jnp.asarray(phist),
+        jnp.asarray(specs), hyp_idx, jitter, jitters)
+    Kb = np.broadcast_to(K, (B,) + K.shape).copy()
+    selb = np.broadcast_to(sel, (B, nv)).copy()
+    phb = np.broadcast_to(phist, (B, nv)).copy()
+    spb = np.broadcast_to(specs, (B, 3, p)).copy()
+    gid = np.zeros(B, np.int32)
+    lanes = nffi.fused_hyper_lanes(
+        *args, jnp.asarray(Kb), jnp.asarray(selb), jnp.asarray(phb),
+        jnp.asarray(spb), jnp.asarray(gid), hyp_idx, jitter, jitters)
+    for got, exp in zip(lanes, shared):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(exp))
+    # heterogeneous consts in tile 1 (lanes 16..32): other tiles bitwise
+    Kb[16:32] *= 0.5
+    gid[16:32] = 1
+    het = nffi.fused_hyper_lanes(
+        *args, jnp.asarray(Kb), jnp.asarray(selb), jnp.asarray(phb),
+        jnp.asarray(spb), jnp.asarray(gid), hyp_idx, jitter, jitters)
+    iv_s, iv_h = np.asarray(shared[3]), np.asarray(het[3])
+    np.testing.assert_array_equal(iv_h[:16], iv_s[:16])
+    np.testing.assert_array_equal(iv_h[32:], iv_s[32:])
+    assert not np.array_equal(iv_h[16:32], iv_s[16:32])
+
+
+def test_residual_matvec_dispatch_forced(monkeypatch):
+    """The GST_NRESID dispatcher arm: forced native matches the plain
+    matmul at f32 tolerance even below the MIN_BATCH floor, and
+    GST_NRESID=0 keeps the jnp expression with the family active."""
+    _require_kernels()
+    rng = np.random.default_rng(2)
+    n, m, B = 40, 12, 4
+    T = jnp.asarray(rng.standard_normal((n, m)), jnp.float32)
+    y = jnp.asarray(rng.standard_normal(n), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((B, m)), jnp.float32)
+    want = np.asarray(y)[None] - np.asarray(b) @ np.asarray(T).T
+    monkeypatch.setenv("GST_NCHOL", "1")
+    got = jax.jit(lambda: linalg.residual_matvec(T, y, b))()
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-5,
+                               atol=2e-5)
+    monkeypatch.setenv("GST_NRESID", "0")
+    got_off = jax.jit(lambda: linalg.residual_matvec(T, y, b))()
+    np.testing.assert_allclose(np.asarray(got_off), want, rtol=2e-5,
+                               atol=2e-5)

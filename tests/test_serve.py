@@ -1,0 +1,275 @@
+"""Serve slot-pool tests: operand-fed chunk program, admission/eviction
+scheduling, backpressure, per-tenant spool checkpoint/resume, and the
+solo-tenant parity pins (docs/SERVING.md).
+
+Parity contract pinned here (and documented in SERVING.md): a solo
+tenant's SAMPLED PARAMETER chains and discrete fields (x, z, theta, df,
+accept rates) are BIT-identical to ``JaxGibbs.sample`` at matched
+dispatch arms; the continuous per-TOA fields (b, alpha, pout) agree to
+f32 roundoff — the slot-pool program is a structurally different XLA
+program (operands vs baked constants), and XLA:CPU contracts
+multiply-add chains into FMAs differently across program shapes, a
+~1-ulp-per-op effect no operand plumbing can remove.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from tests.conftest import make_demo_pta
+from gibbs_student_t_tpu.config import GibbsConfig
+from gibbs_student_t_tpu.backends.jax_backend import JaxGibbs
+from gibbs_student_t_tpu.serve import ChainServer, TenantRequest
+from gibbs_student_t_tpu.serve.scheduler import QueueFull
+
+pytestmark = pytest.mark.serve
+
+GATES_OFF = {
+    "GST_NCHOL": "0", "GST_FUSE_STAGES": "0", "GST_NWHITE": "0",
+    "GST_NHYPER": "0", "GST_FAST_GAMMA_V2": "0", "GST_FAST_THETA": "0",
+}
+
+EXACT_FIELDS = ("chain", "zchain", "thetachain", "dfchain")
+ROUNDOFF_FIELDS = ("bchain", "alphachain", "poutchain")
+
+
+def _native_ready() -> bool:
+    from gibbs_student_t_tpu.native import ffi
+
+    return ffi.ready()
+
+
+@pytest.fixture(scope="module")
+def demo():
+    pta = make_demo_pta()
+    return pta.frozen(0), GibbsConfig(model="mixture")
+
+
+def _run_pair(ma, cfg, niter=10, nchains=16, seed=0):
+    """(solo ChainResult, serve ChainResult) for one matched tenant."""
+    srv = ChainServer(ma, cfg, nlanes=32, quantum=5, record="full")
+    h = srv.submit(TenantRequest(ma=ma, niter=niter, nchains=nchains,
+                                 seed=seed))
+    # a second unrelated tenant keeps the pool genuinely multi-tenant
+    # while the pinned one runs
+    h2 = srv.submit(TenantRequest(ma=ma, niter=5, nchains=16,
+                                  seed=seed + 13))
+    srv.run()
+    solo = JaxGibbs(ma, cfg, nchains=nchains, chunk_size=5,
+                    record="full")
+    rs = solo.sample(niter=niter, seed=seed)
+    h2.result()
+    return rs, h.result()
+
+
+def _assert_parity(rs, rv):
+    for f in EXACT_FIELDS:
+        assert np.array_equal(getattr(rs, f), getattr(rv, f)), f
+    assert np.array_equal(rs.stats["acc_white"], rv.stats["acc_white"])
+    assert np.array_equal(rs.stats["acc_hyper"], rv.stats["acc_hyper"])
+    for f in ROUNDOFF_FIELDS:
+        a = np.asarray(getattr(rs, f), np.float64)
+        b = np.asarray(getattr(rv, f), np.float64)
+        scale = max(1.0, float(np.abs(a).max()))
+        assert np.abs(a - b).max() <= 2e-2 * scale, f
+
+
+def test_solo_tenant_parity_gates_off(demo, monkeypatch):
+    """The gates-off guarantee extends to serving: with every native
+    gate off, the slot-pool program is the traced-operand form of the
+    same jnp graph — x/z/theta/df bit-identical, per-TOA continuous
+    fields at f32 roundoff."""
+    ma, cfg = demo
+    for k, v in GATES_OFF.items():
+        monkeypatch.setenv(k, v)
+    rs, rv = _run_pair(ma, cfg)
+    _assert_parity(rs, rv)
+
+
+@pytest.mark.skipif(not _native_ready(),
+                    reason="native kernels unavailable")
+def test_solo_tenant_parity_native_lanes(demo, monkeypatch):
+    """At the native arms, the lanes kernels (tnt_lanes,
+    fused_hyper_lanes, resid_lanes) share the solo kernels' tile
+    functions: the pin additionally asserts they actually engaged.
+    GST_NWHITE is pinned off — the white block has no lanes arm, so
+    aligning both sides on the XLA loop is what makes the accept
+    streams match."""
+    ma, cfg = demo
+    monkeypatch.setenv("GST_NWHITE", "0")
+    from gibbs_student_t_tpu.obs import introspect
+
+    n0 = len(introspect.compile_records())
+    rs, rv = _run_pair(ma, cfg, niter=20)
+    _assert_parity(rs, rv)
+    recs = [r for r in introspect.compile_records()[n0:]
+            if r["label"].startswith("serve_pool_chunk")]
+    assert len(recs) == 1
+    impls = {(d["op"], d["impl"])
+             for d in recs[0].get("linalg_impls", [])}
+    assert ("tnt_lanes", "nchol") in impls
+    assert ("fused_hyper_lanes", "nchol") in impls
+    assert ("resid_lanes", "nchol") in impls
+
+
+def test_multi_tenant_zero_recompiles(demo):
+    """>= 4 tenants share ONE compiled chunk program: admission is a
+    host-side buffer write, never a recompile (obs/introspect compile
+    records), and eviction frees groups for backfill."""
+    ma, cfg = demo
+    from gibbs_student_t_tpu.obs import introspect
+
+    n0 = len(introspect.compile_records())
+    srv = ChainServer(ma, cfg, nlanes=32, quantum=5)
+    handles = [srv.submit(TenantRequest(ma=ma, niter=n, nchains=16,
+                                        seed=i))
+               for i, n in enumerate((5, 10, 5, 10))]
+    srv.run()
+    for h in handles:
+        res = h.result()
+        assert res.chain.shape[1] == 16
+        assert h.admission_ms is not None
+        assert h.throughput_sweeps_per_s is not None
+    serve_recs = [r for r in introspect.compile_records()[n0:]
+                  if r["label"].startswith("serve_pool_chunk")]
+    assert len(serve_recs) == 1, (
+        "admitting tenants must never recompile the pool program")
+    # occupancy accounting: busy chain-sweeps is exactly the sum of
+    # every tenant's chains x sweeps
+    s = srv.summary()
+    assert s["busy_chain_sweeps"] == sum(
+        16 * n for n in (5, 10, 5, 10))
+    assert 0.0 < s["occupancy"] <= 1.0
+    # all groups returned to the free list after the run drains
+    assert sorted(srv._free_groups) == [0, 1]
+
+
+def test_backpressure_and_validation(demo):
+    ma, cfg = demo
+    srv = ChainServer(ma, cfg, nlanes=32, quantum=5, max_queue=2,
+                      backpressure="reject")
+    # niter must be a positive multiple of the quantum
+    with pytest.raises(ValueError, match="multiple of the pool quantum"):
+        srv.submit(TenantRequest(ma=ma, niter=7, nchains=16))
+    with pytest.raises(ValueError, match="lane groups"):
+        srv.submit(TenantRequest(ma=ma, niter=5, nchains=64))
+    srv.submit(TenantRequest(ma=ma, niter=5, nchains=16, seed=0))
+    srv.submit(TenantRequest(ma=ma, niter=5, nchains=16, seed=1))
+    with pytest.raises(QueueFull):
+        srv.submit(TenantRequest(ma=ma, niter=5, nchains=16, seed=2))
+    # block policy: a full queue times out with QueueFull too
+    srv2 = ChainServer(ma, cfg, nlanes=32, quantum=5, max_queue=1,
+                       backpressure="block")
+    srv2.submit(TenantRequest(ma=ma, niter=5, nchains=16, seed=0))
+    with pytest.raises(QueueFull):
+        srv2.submit(TenantRequest(ma=ma, niter=5, nchains=16, seed=1),
+                    timeout=0.05)
+    # structurally incompatible tenants are rejected through the
+    # handle, not raised into the serving loop (drain the full queue
+    # first — rejection validation happens at admission)
+    srv.run()
+    pta_small = make_demo_pta(psr=None, components=10)
+    bad = srv.submit(TenantRequest(ma=pta_small.frozen(0), niter=5,
+                                   nchains=16, seed=3))
+    srv.run()
+    assert bad.status == "rejected"
+    with pytest.raises(RuntimeError, match="rejected"):
+        bad.result(timeout=0)
+
+
+def test_heterogeneous_pool_requires_flag(demo):
+    """A homogeneous pool (the bit-exact default) refuses a tenant
+    whose TOA count differs from the pool axis, with a pointer at the
+    heterogeneous mode."""
+    ma, cfg = demo
+    psr_small, _ = __import__(
+        "tests.conftest", fromlist=["make_demo_pulsar"]
+    ).make_demo_pulsar(n=100)
+    ma_small = make_demo_pta(psr_small).frozen(0)
+    srv = ChainServer(ma, cfg, nlanes=32, quantum=5)
+    h = srv.submit(TenantRequest(ma=ma_small, niter=5, nchains=16))
+    srv.run()
+    assert h.status == "rejected" and "heterogeneous" in h.error
+
+
+def test_env_gate_validation(monkeypatch, demo):
+    from gibbs_student_t_tpu.ops.linalg import nresid_env
+
+    monkeypatch.setenv("GST_NRESID", "banana")
+    with pytest.raises(ValueError, match="GST_NRESID"):
+        nresid_env()
+    ma, cfg = demo
+    with pytest.raises(ValueError, match="GST_NRESID"):
+        JaxGibbs(ma, cfg, nchains=2)
+
+
+@pytest.mark.skipif(
+    not __import__("gibbs_student_t_tpu.native",
+                   fromlist=["available"]).available(),
+    reason="spooling needs the native library")
+def test_tenant_spool_checkpoint_resume(demo, tmp_path):
+    """Per-tenant checkpoint/resume over the existing SPOOL snapshot
+    path: a tenant interrupted at a quantum boundary resumes through a
+    fresh server bitwise-identically (the solo resume contract extends
+    to serving)."""
+    from gibbs_student_t_tpu.utils.spool import (
+        load_spool_state,
+    )
+
+    ma, cfg = demo
+    spool_dir = str(tmp_path / "tenantA")
+    srv = ChainServer(ma, cfg, nlanes=32, quantum=5, record="full")
+    # reference: an uninterrupted 15-sweep tenant
+    ref = srv.submit(TenantRequest(ma=ma, niter=15, nchains=16, seed=3))
+    # phase 1: 10 sweeps, spooled
+    h1 = srv.submit(TenantRequest(ma=ma, niter=10, nchains=16, seed=3,
+                                  spool_dir=spool_dir))
+    srv.run()
+    ref_res = ref.result()
+    h1.result()
+    state, next_sweep, seed = load_spool_state(spool_dir)
+    assert next_sweep == 10 and seed == 3
+    # phase 2: resume 5 more sweeps through a FRESH server
+    srv2 = ChainServer(ma, cfg, nlanes=32, quantum=5, record="full")
+    h2 = srv2.submit(TenantRequest(
+        ma=ma, niter=5, nchains=16, seed=3, state=state,
+        start_sweep=next_sweep, spool_dir=spool_dir))
+    srv2.run()
+    res = h2.result()
+    assert res.chain.shape[0] == 15
+    assert np.array_equal(res.chain, ref_res.chain)
+    assert np.array_equal(res.zchain, ref_res.zchain)
+
+
+@pytest.mark.slow
+def test_serve_bench_ledger_matches_final_line(tmp_path):
+    """End-to-end smoke: serve_bench's ledger record carries exactly
+    the metric values of its final stdout line (the bench.py
+    emission-hardening contract)."""
+    import json
+    import subprocess
+
+    ledger = str(tmp_path / "ledger.jsonl")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PYTHONPATH", None)
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "..", "tools",
+                      "serve_bench.py"),
+         "--quick", "--ledger", ledger],
+        capture_output=True, text=True, env=env, timeout=1200)
+    assert out.returncode == 0, out.stderr[-2000:]
+    last = out.stdout.strip().splitlines()[-1]
+    line = json.loads(last)
+    from gibbs_student_t_tpu.obs.ledger import read_ledger
+
+    recs = [r for r in read_ledger(ledger)
+            if r.get("tool") == "serve_bench"]
+    assert len(recs) == 1
+    assert recs[0]["metrics"] == line
+    assert line["occupancy"] > 0.5
+    assert line["value"] > 0

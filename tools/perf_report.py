@@ -159,6 +159,18 @@ def print_report(ledger_recs, include_rounds=True):
                 share = f"{sv / total * 100.0:5.1f}%" if total else "    ?"
                 print(f"    stage {name:20s} {sv * 1e3:10.1f} ms "
                       f"({share} of timed stages)")
+        elif rec.get("tool") == "serve_bench":
+            # serving record: the occupancy/ratio pair IS the story
+            occ = m.get("occupancy")
+            ratio = m.get("ratio_vs_solo")
+            print(f"  {rec.get('timestamp_utc', '?'):20s} "
+                  f"{rec.get('tool', '?'):14s} "
+                  f"{rec.get('platform') or '?':8s} "
+                  f"{m.get('metric', '?')}={m.get('value')} "
+                  f"occupancy={occ if occ is not None else '?'} "
+                  f"ratio_vs_solo={ratio if ratio is not None else '?'} "
+                  f"admission_ms={m.get('admission_ms')} "
+                  f"lanes={m.get('nlanes')} tenants={m.get('tenants')}")
         else:
             brief = {k: v for k, v in m.items()
                      if isinstance(v, (int, float, bool, str))}
@@ -301,6 +313,38 @@ def check_latest(ledger_recs, max_drop, max_compile_growth,
     return 0
 
 
+def check_serve(ledger_recs, min_occupancy):
+    """Serving gate: the latest ``serve_bench`` record (when one
+    exists) must report lane occupancy at or above ``min_occupancy``
+    and carry a usable aggregate value. Returns the exit code
+    contribution (0 when no serving record exists — a bench-only
+    ledger is not a serving regression)."""
+    serve = [r for r in ledger_recs if r.get("tool") == "serve_bench"]
+    if not serve:
+        print("check: no serve_bench record — serving gate skipped")
+        return 0
+    m = serve[-1].get("metrics") or {}
+    occ, value = m.get("occupancy"), m.get("value")
+    if not isinstance(value, (int, float)):
+        print("check: FAIL — latest serve_bench record has no usable "
+              f"value ({value!r})")
+        return 3
+    if not isinstance(occ, (int, float)):
+        print("check: FAIL — latest serve_bench record has no usable "
+              f"occupancy ({occ!r})")
+        return 3
+    ratio = m.get("ratio_vs_solo")
+    print(f"check: serve occupancy {occ:.3f} (min {min_occupancy}), "
+          f"aggregate {value} chain-sweeps/s"
+          + (f", ratio_vs_solo {ratio}" if ratio is not None else ""))
+    if occ < min_occupancy:
+        print(f"check: FAIL — serve occupancy {occ:.3f} < "
+              f"{min_occupancy} (idle lanes are the serving "
+              "regression: admissions are not backfilling the pool)")
+        return 2
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--ledger", default=None,
@@ -331,6 +375,13 @@ def main(argv=None):
                          "sweep's custom-call/dispatch count (the "
                          "GST_FUSE_STAGES fusion metric; a count, not "
                          "a wall time — growth means real un-fusion)")
+    ap.add_argument("--min-occupancy", type=float, default=0.9,
+                    metavar="FRAC",
+                    help="serving gate: minimum lane occupancy the "
+                         "latest serve_bench ledger record must report "
+                         "(chain-lane-sweeps served / lane-sweeps "
+                         "advanced; skipped when no serving record "
+                         "exists)")
     ap.add_argument("--baseline", choices=("prev", "best"),
                     default="prev",
                     help="compare against the previous comparable "
@@ -345,11 +396,13 @@ def main(argv=None):
     recs = _read_ledger(ledger)
     print_report(recs, include_rounds=not args.no_rounds)
     if args.check:
-        return check_latest(recs, args.max_drop,
-                            args.max_compile_growth,
-                            args.max_hbm_growth, args.baseline,
-                            max_stage_growth=args.max_stage_growth,
-                            max_dispatch_growth=args.max_dispatch_growth)
+        rc = check_latest(recs, args.max_drop,
+                          args.max_compile_growth,
+                          args.max_hbm_growth, args.baseline,
+                          max_stage_growth=args.max_stage_growth,
+                          max_dispatch_growth=args.max_dispatch_growth)
+        rc_serve = check_serve(recs, args.min_occupancy)
+        return rc or rc_serve
     return 0
 
 

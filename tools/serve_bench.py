@@ -1,0 +1,230 @@
+"""Mixed-workload serving benchmark for the multi-tenant slot pool.
+
+Drives a :class:`~gibbs_student_t_tpu.serve.server.ChainServer` with a
+staggered-arrival, heterogeneous-sweep-count tenant mix (each tenant a
+different simulated dataset + seed at the pool's model structure) and
+reports aggregate serving throughput against a same-host single-tenant
+baseline — the ratio is what the serving acceptance gate grades, so the
+number is host-independent.
+
+Emission contract (the bench.py discipline): one JSON line as the
+absolute final combined-stream line, a ``serve_bench`` ledger record
+written BEFORE any stderr epilogue with the identical metric values,
+and ``--check``-able fields: ``value`` (aggregate chain-sweeps/s),
+``occupancy``, ``aggregate_sweeps_per_s``, ``admission_ms``,
+``solo_sweeps_per_s``, ``ratio_vs_solo``.
+
+Usage::
+
+    python tools/serve_bench.py                 # flagship 1024 lanes
+    python tools/serve_bench.py --quick         # CI smoke shapes
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))  # repo root for the package
+
+
+def _emit_final_line(line: dict) -> None:
+    """The bench.py emission-hardening contract: drain both streams,
+    write the metric line straight to fd 1, then park fd 2 on /dev/null
+    so late C++ atexit chatter cannot land below it in a combined
+    stream (the BENCH_r05 ``parsed: null`` failure)."""
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os.write(1, (json.dumps(line) + "\n").encode())
+    try:
+        devnull = os.open(os.devnull, os.O_WRONLY)
+        os.dup2(devnull, 2)
+        os.close(devnull)
+    except OSError:
+        pass
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nlanes", type=int, default=1024)
+    ap.add_argument("--ntoa", type=int, default=130)
+    ap.add_argument("--components", type=int, default=30)
+    ap.add_argument("--quantum", type=int, default=25,
+                    help="scheduling quantum in sweeps")
+    ap.add_argument("--tenants", type=int, default=12,
+                    help="total jobs in the mixed workload")
+    ap.add_argument("--resident", type=int, default=4,
+                    help="target concurrently-resident tenants (each "
+                         "sized nlanes/resident chains)")
+    ap.add_argument("--quanta-min", type=int, default=4,
+                    help="smallest tenant sweep budget, in quanta")
+    ap.add_argument("--quanta-max", type=int, default=7,
+                    help="largest tenant sweep budget, in quanta")
+    ap.add_argument("--stagger", type=int, default=1,
+                    help="submit a new tenant every N quanta after the "
+                         "initial resident set (0 = all up front)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--model", default="mixture")
+    ap.add_argument("--quick", action="store_true",
+                    help="small smoke shapes (64 lanes, 2 resident)")
+    ap.add_argument("--no-solo", action="store_true",
+                    help="skip the same-host solo baseline arm")
+    ap.add_argument("--ledger", default=None,
+                    help="ledger path override ('' disables the write)")
+    args = ap.parse_args(argv)
+    if args.quick:
+        args.nlanes = 64
+        args.tenants = 6
+        args.resident = 2
+        args.quantum = 5
+
+    # jax init after arg parsing (the bench.py ordering); cap BLAS
+    # pools to the sched affinity like bench.py so the graded 1-core
+    # host measures the real serial path
+    try:
+        ncpu = len(os.sched_getaffinity(0))
+    except AttributeError:  # pragma: no cover - non-Linux
+        ncpu = os.cpu_count() or 1
+    os.environ.setdefault("XLA_FLAGS", "")
+    os.environ["XLA_FLAGS"] += (
+        f" --xla_cpu_multi_thread_eigen={'true' if ncpu > 1 else 'false'}"
+        f" intra_op_parallelism_threads={ncpu}")
+    os.environ.setdefault("OPENBLAS_NUM_THREADS", str(ncpu))
+
+    import jax  # noqa: E402
+
+    import numpy as np  # noqa: E402
+
+    from gibbs_student_t_tpu.backends.jax_backend import JaxGibbs
+    from gibbs_student_t_tpu.config import GibbsConfig
+    from gibbs_student_t_tpu.data.demo import (
+        make_contaminated_pulsar,
+        make_reference_pta,
+    )
+    from gibbs_student_t_tpu.serve import ChainServer, TenantRequest
+
+    platform = jax.default_backend()
+
+    def model_for(seed):
+        psr, _ = make_contaminated_pulsar(
+            n=args.ntoa, components=args.components, theta=0.02,
+            sigma_out=1e-5, seed=seed)
+        return make_reference_pta(psr, args.components).frozen(0)
+
+    cfg = GibbsConfig(model=args.model)
+    template = model_for(42)
+    tenant_mas = [model_for(100 + i) for i in range(args.tenants)]
+
+    # ---- solo baseline: ONE tenant owning every lane ------------------
+    solo_sps = None
+    if not args.no_solo:
+        gb = JaxGibbs(template, cfg, nchains=args.nlanes,
+                      chunk_size=args.quantum, tnt_block_size=None,
+                      use_pallas=False)
+        st = gb.init_state(seed=args.seed)
+        gb.sample(niter=args.quantum, seed=args.seed, state=st)  # compile
+        st2 = gb.last_state
+        t0 = time.perf_counter()
+        gb.sample(niter=2 * args.quantum, seed=args.seed, state=st2,
+                  start_sweep=args.quantum)
+        dt = time.perf_counter() - t0
+        solo_sps = args.nlanes * 2 * args.quantum / dt
+        print(f"# solo baseline: {solo_sps:.1f} chain-sweeps/s "
+              f"({args.nlanes} lanes)", file=sys.stderr)
+        del gb, st, st2
+
+    # ---- mixed-tenant serving phase ----------------------------------
+    srv = ChainServer(template, cfg, nlanes=args.nlanes,
+                      quantum=args.quantum)
+    rng = np.random.default_rng(args.seed)
+    chains_each = args.nlanes // args.resident
+    budgets = [int(rng.integers(args.quanta_min, args.quanta_max + 1))
+               * args.quantum for _ in range(args.tenants)]
+
+    def req(i):
+        return TenantRequest(ma=tenant_mas[i], niter=budgets[i],
+                             nchains=chains_each, seed=args.seed + i,
+                             name=f"tenant{i}")
+
+    # warmup: compile the pool program outside the timed window
+    w = srv.submit(TenantRequest(ma=template, niter=args.quantum,
+                                 nchains=srv.pool.group,
+                                 seed=args.seed))
+    srv.run()
+    w.result()
+    srv.quanta = 0
+    srv.busy_lane_sweeps = 0
+    srv.total_lane_sweeps = 0
+    srv._admission_ms.clear()
+
+    handles = []
+    next_i = 0
+    for _ in range(min(args.resident, args.tenants)):
+        handles.append(srv.submit(req(next_i)))
+        next_i += 1
+    t0 = time.perf_counter()
+    quanta_since = 0
+    while True:
+        had_work = srv.step()
+        quanta_since += 1
+        if (next_i < args.tenants
+                and (args.stagger == 0
+                     or quanta_since % max(args.stagger, 1) == 0)):
+            handles.append(srv.submit(req(next_i)))
+            next_i += 1
+            had_work = True
+        if not had_work and next_i >= args.tenants:
+            break
+    wall = time.perf_counter() - t0
+    for h in handles:
+        h.result(timeout=0)
+
+    summary = srv.summary()
+    agg = summary["busy_chain_sweeps"] / wall
+    line = {
+        "metric": "serve_aggregate_chain_sweeps_per_s",
+        "value": round(agg, 1),
+        "aggregate_sweeps_per_s": round(agg, 1),
+        "occupancy": round(summary["occupancy"], 4),
+        "admission_ms": (None if summary["admission_ms"] is None
+                         else round(summary["admission_ms"], 2)),
+        "solo_sweeps_per_s": (None if solo_sps is None
+                              else round(solo_sps, 1)),
+        "ratio_vs_solo": (None if solo_sps is None
+                          else round(agg / solo_sps, 4)),
+        "nlanes": args.nlanes,
+        "quantum": args.quantum,
+        "tenants": args.tenants,
+        "resident": args.resident,
+        "tenant_chains": chains_each,
+        "wall_s": round(wall, 3),
+        "platform": platform,
+        "quick": bool(args.quick),
+    }
+    if args.ledger != "":
+        try:
+            from gibbs_student_t_tpu.obs import ledger as _ledger
+
+            lpath = _ledger.append_record(_ledger.make_record(
+                "serve_bench", line, platform=platform,
+                config=vars(args),
+                argv=[sys.argv[0]] + list(argv if argv is not None
+                                          else sys.argv[1:]),
+                extra={"serve_summary": summary}),
+                args.ledger)
+            print(f"# ledger record -> {lpath}", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            print(f"# ledger write failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+    print(f"# serve: {agg:.1f} chain-sweeps/s aggregate at "
+          f"{summary['occupancy']:.1%} occupancy "
+          f"(admission {line['admission_ms']} ms)", file=sys.stderr)
+    _emit_final_line(line)
+
+
+if __name__ == "__main__":
+    main()
