@@ -738,6 +738,7 @@ class JaxGibbs(SamplerBackend):
         # trace-time snapshot semantics as GST_PALLAS_CHOL) gates the
         # actual kernel use inside the dispatcher.
         self._white_block = None
+        self._white_block_lanes = None
         self._white_mtm_block = None
         self._white_consts = None
         if dtype == jnp.float32 and len(self._ma.white_indices):
@@ -755,6 +756,16 @@ class JaxGibbs(SamplerBackend):
             # travel per call, so ensembles can substitute traced
             # per-pulsar constants (parallel/ensemble.py)
             self._white_block = make_white_block(wc.var)
+            if self._operand_mode:
+                from gibbs_student_t_tpu.ops.pallas_white import (
+                    make_white_block_lanes,
+                )
+
+                # serve slot pool: per-lane consts + the tile-uniform
+                # gid route the native white_mh_lanes kernel — the one
+                # lanes-path MH stage that previously had no native
+                # twin and fell back to the grouped XLA loop
+                self._white_block_lanes = make_white_block_lanes(wc.var)
             if (config.mh.mtm_tries >= 2
                     and "white" in config.mh.mtm_blocks):
                 from gibbs_student_t_tpu.ops.pallas_white import (
@@ -1301,8 +1312,20 @@ class JaxGibbs(SamplerBackend):
                     dx, logus = self._mh_draws(
                         kw, ma.white_indices, cfg.mh.n_white_steps,
                         jump_scale, cov_w)
-                    x, acc_w = self._white_block(x, az, yred * yred, dx,
-                                                 logus, wrows, wspecs)
+                    if (self._white_block_lanes is not None
+                            and ma_in is not None
+                            and fused is not None
+                            and fused.gid is not None):
+                        # serve slot pool: per-lane consts + gid route
+                        # the native lanes kernel (fallback: the same
+                        # grouped XLA loop this call always produced)
+                        x, acc_w = self._white_block_lanes(
+                            x, az, yred * yred, dx, logus, wrows,
+                            wspecs, fused.gid)
+                    else:
+                        x, acc_w = self._white_block(x, az, yred * yred,
+                                                     dx, logus, wrows,
+                                                     wspecs)
             else:
                 def ll_white(xq):
                     nvec = self._masked_nvec(ma, mask, xq, az)

@@ -33,7 +33,7 @@ import numpy as np
 #: committed library exports ``gst_abi_version()``; a mismatch (or a
 #: pre-versioning library) degrades at probe time with a clear reason
 #: string instead of miscalling a handler whose signature moved.
-ABI_VERSION = 3
+ABI_VERSION = 4
 
 #: FFI target name -> exported C symbol. Names are versioned with a
 #: ``gst_`` prefix so they cannot collide with XLA's own cpu targets.
@@ -62,6 +62,8 @@ TARGETS = {
     "gst_beta_frac_f64": "GstBetaFracF64",
     "gst_white_mh_f32": "GstWhiteMhF32",
     "gst_white_mh_f64": "GstWhiteMhF64",
+    "gst_white_lanes_f32": "GstWhiteLanesF32",
+    "gst_white_lanes_f64": "GstWhiteLanesF64",
     "gst_hyper_mh_f32": "GstHyperMhF32",
     "gst_hyper_mh_f64": "GstHyperMhF64",
     "gst_schur_f32": "GstSchurF32",
@@ -341,6 +343,23 @@ def white_mh(x, az, yred2, dx, logu, rows, specs, var):
     var_arr = jnp.asarray(np.asarray(var, np.int32).reshape(-1, 3))
     xo, acc = _call("gst_white_mh", (x.shape, x.shape[:-1]), x, az,
                     yred2, dx, logu, rows, specs, var_arr)
+    return xo, acc
+
+
+def white_mh_lanes(x, az, yred2, dx, logu, rows, specs, gid, var):
+    """Multi-tenant twin of :func:`white_mh`: the constant rows/specs
+    are PER LANE (``rows (B, R, n)``, ``specs (B, 3, p)`` — the serve
+    slot pool's call-time operands) under the tile-uniform ``gid``
+    contract of :func:`tnt_lanes`; ``var`` stays the static
+    (kind, x_index, row_slot) table, fixed by the pool template's
+    model STRUCTURE. A pool whose lanes share one model is bitwise
+    identical to the shared-consts kernel (same tile loop)."""
+    import jax.numpy as jnp
+
+    var_arr = jnp.asarray(np.asarray(var, np.int32).reshape(-1, 3))
+    xo, acc = _call("gst_white_lanes", (x.shape, x.shape[:-1]), x, az,
+                    yred2, dx, logu, rows, specs, gid, var_arr,
+                    dtype=x.dtype)
     return xo, acc
 
 

@@ -745,6 +745,53 @@ def make_white_block(var: Tuple[Tuple[int, int, int], ...]):
     return block
 
 
+def make_white_block_lanes(var: Tuple[Tuple[int, int, int], ...]):
+    """Per-lane-consts twin of :func:`make_white_block` — the serve
+    slot pool's white MH block, where every lane carries its OWN
+    tenant's constant rows / prior specs as call-time operands plus the
+    tile-uniform group id (serve/pool.py; the last lanes-path MH stage
+    that still ran on the grouped XLA loop under serving). The native
+    arm (``GST_NWHITE``, native/ffi.py ``white_mh_lanes``) shares the
+    solo kernel's tile loop, so a pool whose lanes share one model is
+    bitwise the solo kernel; the fallback is the grouped
+    :func:`white_mh_loop_xla` graph the traced-consts path always
+    emitted, so gates-off (or degraded) serving keeps that graph
+    verbatim. Returns ``block(x, az, yred2, dx, logu, rows, specs,
+    gid) -> (x_new, acc_rate)``."""
+    note_kernel_build("white_mh_lanes", n_varying=len(var))
+
+    @custom_vmap
+    def block(x, az, yred2, dx, logu, rows, specs, gid):
+        from gibbs_student_t_tpu.ops import linalg as _lin
+
+        if (rows.ndim == 3 and gid.ndim == 1 and x.ndim == 2
+                and rows.shape[0] == x.shape[0]
+                and _lin.nwhite_take(x.shape, x.dtype, x.shape[-1],
+                                     len(var))):
+            from gibbs_student_t_tpu.native import ffi as nffi
+
+            _lin._note_impl("white_lanes", "nchol", x.shape)
+            return nffi.white_mh_lanes(
+                x, az, yred2, dx, logu, jnp.asarray(rows, x.dtype),
+                jnp.asarray(specs, x.dtype), gid, var)
+        _lin._note_impl("white_lanes", "loop_xla", x.shape)
+        return white_mh_loop_xla(x, az, yred2, dx, logu, rows, specs,
+                                 var)
+
+    @block.def_vmap
+    def _block_vmap(axis_size, in_batched, *args):
+        # the serve vmap maps EVERY operand (state, draws, per-lane
+        # consts and gid alike); broadcast stragglers and re-enter so
+        # the primal sees the full lane batch (the
+        # _fused_hyper_lanes_dispatcher discipline)
+        out = tuple(
+            a if bt else jnp.broadcast_to(a, (axis_size,) + a.shape)
+            for a, bt in zip(args, in_batched))
+        return block(*out), (True, True)
+
+    return block
+
+
 def make_white_mtm_block(var: Tuple[Tuple[int, int, int], ...]):
     """Build the dispatched white-MTM block for one model STRUCTURE —
     ``block(x, az, yred2, dx, dxr, gumb, logu, rows, specs) ->

@@ -12,11 +12,18 @@ the program never retraces, so admission latency is buffer writes plus
 one device upload (obs/introspect.py compile records pin exactly ONE
 compile for the pool's lifetime — tests/test_serve.py).
 
-Lane state is host-authoritative between quanta: the CPU backend's
-"device" transfers are memcpys, and keeping the canonical state in
-numpy makes admission/eviction writes trivial and exact. A TPU/GPU
-serving port would keep state device-resident and scatter admissions
-instead — noted in docs/SERVING.md.
+Lane state is DEVICE-RESIDENT between quanta (round 11): the chunk
+program donates its ``ChainState`` argument (the ``GST_DONATE_CHUNK``
+discipline extended to serving), so a quantum with no admissions pays
+zero state roundtrips — the state buffers ping-pong inside XLA. The
+host numpy mirror (``_state_np``) is pulled lazily, only when an
+admission needs to slice-write tenant chains in or a spool checkpoint
+needs host arrays; :meth:`dispatch_quantum` re-uploads it (as a COPY,
+so donation can never alias the canonical host buffers) on the next
+boundary. Drains that outlive the next dispatch (the pipelined
+executor's deferred flush) read a ``snapshot`` device copy taken
+before the donated buffers are consumed — the ``snapshot_fn`` ordering
+contract of ``backends.jax_backend.chunked_sweep_loop``.
 
 RNG and keying are bit-compatible with ``JaxGibbs.sample``: a tenant's
 lane ``k`` carries ``random.split(PRNGKey(seed), nchains)[k]`` and each
@@ -76,6 +83,9 @@ class TenantSlot:
         self.done_sweeps = 0          # tenant-local sweeps served so far
         self.n_real = n_real
         self.seed = seed
+        # an eviction request (ChainServer.cancel) landing while a
+        # quantum is in flight: the lane freezes at the NEXT boundary
+        self.cancelled = False
 
     @property
     def chain_lanes(self) -> np.ndarray:
@@ -168,14 +178,26 @@ class SlotPool:
         self._dirty = True
         self._mas_dev = None
         self._fc_dev = None
+        # device-resident lane state (GST_DONATE_CHUNK extended to
+        # serving): between quanta the canonical state lives on device
+        # and the chunk donates it; the host mirror is pulled lazily
+        # for admission writes and checkpoint reads
+        from gibbs_student_t_tpu.backends.jax_backend import _donate_env
+
+        self._donate = _donate_env() != "0"
+        self._state_dev = None        # latest post-quantum device state
+        self._host_valid = True       # _state_np mirrors the canon
         # the ONE compiled chunk program
         from gibbs_student_t_tpu.obs.introspect import introspect_jit
 
+        donate = (0,) if self._donate else ()
         self._chunk = introspect_jit(
-            jax.jit(self._make_chunk(), static_argnames=("length",)),
+            jax.jit(self._make_chunk(), static_argnames=("length",),
+                    donate_argnums=donate),
             label=f"serve_pool_chunk_l{nlanes}",
             registry=lambda: self.metrics,
-            static_argnames=("length",))
+            static_argnames=("length",),
+            donate_argnums=donate)
 
     # ------------------------------------------------------------------
     # construction helpers
@@ -262,12 +284,21 @@ class SlotPool:
     # lane writes (host-side buffer writes — never a recompile)
     # ------------------------------------------------------------------
 
+    def _pull_state(self) -> None:
+        """Make the host state mirror current (device -> host when the
+        canonical copy is device-resident). Blocks until the last
+        dispatched quantum's state is computed."""
+        if not self._host_valid:
+            self._state_np = jax.tree.map(np.array, self._state_dev)
+            self._host_valid = True
+
     def write_tenant(self, slot: TenantSlot, ma_padded: ModelArrays,
                      backend: JaxGibbs, state: ChainState) -> None:
         """Admit a tenant into its lanes: slice-assign its model,
         fused-MH constants, chain keys, offsets and state into the
         host lane buffers. ``backend`` is the tenant's throwaway
         construction backend (structure already validated)."""
+        self._pull_state()
         lanes = slot.lanes
         k = slot.nchains
         # model arrays (the localized+padded tenant model)
@@ -322,38 +353,87 @@ class SlotPool:
     def tenant_state(self, slot: TenantSlot) -> ChainState:
         """The tenant's current chain state (host arrays) — the
         checkpoint payload for the per-tenant spool."""
+        self._pull_state()
         return jax.tree.map(lambda a: a[slot.chain_lanes],
                             self._state_np)
+
+    def tenant_state_from(self, snap, slot: TenantSlot) -> ChainState:
+        """One tenant's slice of a state ``snapshot`` returned by
+        :meth:`dispatch_quantum` — the deferred-drain checkpoint
+        payload (the snapshot was device-copied BEFORE the next
+        dispatch could donate the underlying buffers)."""
+        return jax.tree.map(lambda a: np.asarray(a)[slot.chain_lanes],
+                            snap)
 
     # ------------------------------------------------------------------
     # the quantum
     # ------------------------------------------------------------------
 
-    def run_quantum(self):
-        """Advance every lane by ``quantum`` sweeps through the ONE
-        compiled program. Returns ``(records, telemetry)`` with
-        ``records[i]`` shaped ``(nlanes, rows, ...)`` in wire dtypes —
-        callers slice per-tenant lanes and materialize."""
+    def dispatch_quantum(self, snapshot: bool = False):
+        """Dispatch one quantum WITHOUT materializing anything: uploads
+        dirty operand buffers, calls the ONE compiled program (state
+        donated under ``GST_DONATE_CHUNK``) and keeps the returned
+        state device-resident. Returns ``(records, telemetry, snap)``
+        device handles for a deferred drain; ``records[i]`` is
+        ``(nlanes, rows, ...)`` in wire dtypes. With ``snapshot=True``
+        the post-quantum state is additionally device-copied before any
+        LATER dispatch can donate its buffers — the flush-before-
+        checkpoint-reuse ordering the spool path requires (PR 3's
+        ``snapshot_fn`` discipline); ``snap`` is None otherwise."""
+        # every upload below hands jax a SYNCHRONOUS private numpy
+        # copy (np.array under our control, completed before the call
+        # returns). jax's own host-to-device copy can be deferred —
+        # and the canonical lane buffers keep mutating at boundaries
+        # while a quantum is in flight (admission slice-assigns, the
+        # offsets increment below, eviction's mask flip), so a lazy
+        # (or zero-copy) device view of a live buffer hands the
+        # in-flight program torn operands. Measured failure mode: a
+        # quantum consuming PARTIALLY-INCREMENTED offsets draws the
+        # NEXT quantum's philox streams for some lanes — caught by the
+        # pipelined-vs-serial bitwise pins. The serial loop never saw
+        # this only because its blocking state pull serialized every
+        # write behind the compute.
+        def up(a, dtype=None):
+            return jnp.asarray(np.array(a, dtype=dtype, copy=True))
+
         if self._dirty:
             self._mas_dev = jax.tree.map(
-                lambda a: (jnp.asarray(a, dtype=self.dtype)
+                lambda a: (up(a, np.dtype(self.dtype))
                            if np.issubdtype(np.asarray(a).dtype,
                                             np.floating)
-                           else jnp.asarray(a)),
+                           else up(a)),
                 self._mas_np)
             fc = self._fc_np
             self._fc_dev = FusedConsts(*[
-                None if a is None else jnp.asarray(a)
+                None if a is None else up(a)
                 for a in fc[:-1]
-            ], gid=jnp.asarray(self._gid_np))
+            ], gid=up(self._gid_np))
             self._dirty = False
+        if self._host_valid:
+            # the private copy additionally keeps donation honest: the
+            # program may reuse its state input buffers, never
+            # _state_np's
+            state_in = jax.tree.map(up, self._state_np)
+        else:
+            state_in = self._state_dev
         sts, (recs, tl) = self._chunk(
-            jax.tree.map(jnp.asarray, self._state_np),
-            self._mas_dev, self._fc_dev,
-            jnp.asarray(self._keys_np), jnp.asarray(self._offsets_np),
-            jnp.asarray(self._active_np), length=self.quantum)
-        self._state_np = jax.tree.map(np.array, sts)
+            state_in, self._mas_dev, self._fc_dev,
+            up(self._keys_np), up(self._offsets_np),
+            up(self._active_np), length=self.quantum)
+        self._state_dev = sts
+        self._host_valid = False
         self._offsets_np[self._active_np] += self.quantum
+        snap = jax.tree.map(jnp.copy, sts) if snapshot else None
+        return recs, tl, snap
+
+    def run_quantum(self):
+        """The serial form of :meth:`dispatch_quantum`: advance every
+        lane by ``quantum`` sweeps and pull the state back to host
+        before returning — the pre-pipelining contract (the bitwise
+        reference path of the pipelined executor's drain-ordering
+        pins). Returns ``(records, telemetry)``."""
+        recs, tl, _ = self.dispatch_quantum()
+        self._pull_state()
         return recs, tl
 
     # ------------------------------------------------------------------
@@ -380,6 +460,59 @@ class SlotPool:
                 a = a[..., :slot.n_real]
             out[f] = a
         return out
+
+    # -- deferred (wire-dtype) record plumbing --------------------------
+    # The per-quantum drain used to materialize ALL nlanes to float32
+    # and then fancy-index-copy each tenant's lanes — ~3x the record
+    # bytes in host memory traffic, every quantum, on the serving hot
+    # path. In-memory tenants now accumulate their lanes' NARROW wire
+    # slices per quantum and materialize ONCE at finalize; only
+    # spool/on_chunk consumers (whose contract is materialized
+    # records) pay the per-quantum cast, and only for THEIR lanes.
+
+    def wire_host(self, recs) -> list:
+        """A quantum's records pulled to host in WIRE dtypes (no
+        casts), one array per field, each ``(nlanes, rows, ...)``."""
+        return list(jax.device_get(recs))
+
+    def tenant_wire(self, wire: list, slot: TenantSlot) -> dict:
+        """One tenant's lanes sliced out of a wire-dtype quantum:
+        ``{field: (nchains, rows, ...)}`` COPIES (the backing quantum
+        buffers are released after the drain)."""
+        lanes = slot.chain_lanes
+        lo, hi = int(lanes[0]), int(lanes[-1]) + 1
+        contig = hi - lo == len(lanes)
+        out = {}
+        for f, arr in zip(self.template._record_fields, wire):
+            a = arr[lo:hi] if contig else arr[lanes]
+            out[f] = np.array(a)
+        return out
+
+    def materialize_tenant(self, cols: dict, n_real: int) -> dict:
+        """Materialize a tenant's accumulated wire chunks: undo the
+        transport casts, reorder to the record convention
+        ``{field: (rows, nchains, ...)}`` and trim per-TOA fields back
+        to the tenant's real TOA count. Applying the identical casts
+        to a lane SLICE (here) or the full lane axis (materialize) is
+        elementwise-identical, so the deferred path is bitwise the
+        eager one."""
+        fields = self.template._record_fields
+        host = self.template._materialize([cols[f] for f in fields],
+                                          n_last=self.n_pool)
+        out = {}
+        for f, arr in zip(fields, host):
+            a = np.swapaxes(arr, 0, 1)
+            if n_real != self.n_pool and f in ("z", "alpha", "pout"):
+                a = a[..., :n_real]
+            out[f] = a
+        return out
+
+    def tenant_quantum_records(self, wire: list,
+                               slot: TenantSlot) -> dict:
+        """One tenant's MATERIALIZED records for one quantum (the
+        spool / on_chunk payload): the wire slice cast on demand."""
+        return self.materialize_tenant(self.tenant_wire(wire, slot),
+                                       slot.n_real)
 
 
 def _assign(buf: np.ndarray, lanes: np.ndarray, val: np.ndarray):

@@ -67,21 +67,41 @@ class TenantHandle:
         self._cols: Dict[str, List[np.ndarray]] = {}
         self._tele_stats: Dict[str, np.ndarray] = {}
         self._result = None
+        self._builder = None
+        self._build_lock = threading.Lock()
         self._done = threading.Event()
 
     # -- lifecycle (server side) ---------------------------------------
 
     def _stream(self, sweep_end: int, records: Dict[str, np.ndarray]):
+        """Per-quantum bookkeeping + the streaming callback. Record
+        STORAGE no longer happens here: in-memory tenants accumulate
+        narrow wire-dtype lane slices (``_append_wire``, materialized
+        once at finalize) instead of per-quantum float copies — the
+        serving drain's biggest host cost."""
         self.sweeps_done = sweep_end - self.request.start_sweep
         self.chunks_streamed += 1
-        if self.request.spool_dir is None:
-            for f, a in records.items():
-                self._cols.setdefault(f, []).append(a)
         if self.request.on_chunk is not None:
             self.request.on_chunk(self, sweep_end, records)
 
+    def _append_wire(self, wire_cols: Dict[str, np.ndarray]):
+        for f, a in wire_cols.items():
+            self._cols.setdefault(f, []).append(a)
+
     def _finish(self, result):
         self._result = result
+        self.finished_t = time.monotonic()
+        self.status = "done"
+        self._done.set()
+
+    def _finish_lazy(self, builder):
+        """Complete the tenant with a DEFERRED result builder: the
+        sweeps are served and the wire-dtype records delivered, but
+        the float materialization + concatenation happen on the first
+        ``result()`` call, on the CALLER's thread — decode-on-consume,
+        so result assembly never steals serving cycles from the
+        drain worker."""
+        self._builder = builder
         self.finished_t = time.monotonic()
         self.status = "done"
         self._done.set()
@@ -122,6 +142,11 @@ class TenantHandle:
         if self.error is not None:
             raise RuntimeError(
                 f"tenant {self.tenant_id} rejected: {self.error}")
+        if self._result is None and self._builder is not None:
+            with self._build_lock:
+                if self._result is None:
+                    self._result = self._builder()
+                    self._builder = None
         return self._result
 
 
@@ -168,3 +193,27 @@ class AdmissionQueue:
                     self._not_full.notify()
                     return h
             return None
+
+    def pop_next(self) -> Optional[TenantHandle]:
+        """Non-blocking FIFO pop — the pipelined executor's staging
+        thread takes jobs in arrival order and prepares them ahead of
+        placement (first-fit happens later, over the PREPARED window,
+        so queue order is the preparation order, not the admission
+        order)."""
+        with self._not_full:
+            if not self._q:
+                return None
+            h = self._q.pop(0)
+            self._not_full.notify()
+            return h
+
+    def remove(self, handle: TenantHandle) -> bool:
+        """Drop a specific queued job (cancellation before admission).
+        Returns False when it is no longer queued."""
+        with self._not_full:
+            for i, h in enumerate(self._q):
+                if h is handle:
+                    self._q.pop(i)
+                    self._not_full.notify()
+                    return True
+            return False

@@ -1,24 +1,50 @@
 """ChainServer: admission, eviction, streaming and serving metrics.
 
 Ties the :class:`~gibbs_student_t_tpu.serve.pool.SlotPool` (the ONE
-compiled chunk program) to the admission queue. The driver is a
-synchronous quantum loop — ``step()`` advances the pool by one quantum
-and handles admissions/evictions at the boundary; ``run()`` loops it
-(optionally from a background thread via ``start()``), so callers can
-``submit()`` from any thread and block on ``handle.result()``.
+compiled chunk program) to the admission queue. Two drivers share every
+scheduling rule:
+
+- **serial** (``step()`` / ``pipeline=False``): one quantum per call —
+  admit, advance, drain, evict, all on the calling thread. This is the
+  bitwise reference path the pipelined executor is pinned against.
+- **pipelined** (the default ``run()``): a three-thread executor that
+  overlaps the per-quantum host work with device compute
+  (docs/SERVING.md "Pipelined executor"). The *dispatch* thread owns
+  the pool and the lane buffers: it applies staged admissions and
+  evictions at each quantum boundary, dispatches quantum k+1 (the
+  chunk call is async; the state stays device-resident and donated),
+  and hands quantum k's record/telemetry handles to the *drain*
+  worker, which materializes records, fires ``on_chunk`` callbacks,
+  folds telemetry, appends spool checkpoints (from a state snapshot
+  device-copied before the next dispatch could donate the buffers) and
+  finalizes finished tenants. A *staging* thread prepares queued
+  tenants (validation + the throwaway construction backend + exact
+  solo initial state — the 0.2-0.9 s of host work that used to stall
+  the pool) into a small prepared window; the boundary then only
+  slice-writes lane buffers.
+
+Because a tenant's draws depend only on its seed and tenant-local
+sweep index (never on lane placement or scheduling), per-tenant
+results are bitwise identical between the two drivers (pinned in
+tests/test_serve.py).
 
 Serving metrics land in the attached ``obs.metrics.MetricsRegistry``:
 ``serve_occupancy`` (busy chain-lanes / pool lanes, per quantum),
 ``serve_queue_depth``, ``serve_admission_ms`` histogram,
 ``serve_sweeps_total`` counter (chain-sweeps), plus ``admit``/``evict``
-events — and the per-run summary that tools/serve_bench.py turns into
-a ledger record (docs/SERVING.md schema).
+events — and the per-run summary (now with the per-quantum host-time
+breakdown ``host_ms``: admission / drain / dispatch-gap percentiles)
+that tools/serve_bench.py turns into a ledger record (docs/SERVING.md
+schema).
 """
 
 from __future__ import annotations
 
+import os
+import queue as _queue
 import threading
 import time
+from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 import jax
@@ -43,6 +69,48 @@ from gibbs_student_t_tpu.serve.scheduler import (
 )
 
 
+def serve_pipeline_env() -> str:
+    """Validated ``GST_SERVE_PIPELINE`` (``auto`` when unset) — the
+    pipelined serving executor. Strict ``auto|1|0`` (the loud-typo
+    contract of every GST_* gate); ``auto`` resolves to ON — the
+    executor is a pure host-scheduling change whose per-tenant results
+    are bitwise the serial loop's, on every platform. ``0`` keeps the
+    serial quantum loop (the A/B arm and the bitwise reference)."""
+    env = os.environ.get("GST_SERVE_PIPELINE")
+    if env is not None and env not in ("auto", "1", "0"):
+        raise ValueError(
+            f"GST_SERVE_PIPELINE must be 'auto', '1' or '0', got {env!r}")
+    return env if env is not None else "auto"
+
+
+@dataclass
+class _Prepared:
+    """A staged tenant: everything admission needs except lanes —
+    produced off the dispatch thread by the staging worker."""
+
+    handle: TenantHandle
+    ma_padded: ModelArrays
+    backend: JaxGibbs
+    state: object
+    groups_needed: int
+    n_real: int
+    prep_ms: float
+
+
+def _percentiles(vals: List[float]) -> Optional[dict]:
+    """{p50, p90, max, mean} of a host-time series, ms (None if
+    empty) — the serve_bench ledger breakdown shape."""
+    if not vals:
+        return None
+    a = np.asarray(vals, np.float64)
+    return {
+        "p50": round(float(np.percentile(a, 50)), 3),
+        "p90": round(float(np.percentile(a, 90)), 3),
+        "max": round(float(a.max()), 3),
+        "mean": round(float(a.mean()), 3),
+    }
+
+
 class ChainServer:
     """A persistent multi-tenant driver over one slot pool."""
 
@@ -51,7 +119,16 @@ class ChainServer:
                  group: int = GROUP_LANES, dtype=None,
                  record: str = "compact8", record_thin: int = 1,
                  max_queue: int = 64, backpressure: str = "block",
-                 telemetry: bool = True, metrics=None):
+                 telemetry: bool = True, metrics=None,
+                 pipeline="auto", prefetch: int = 2):
+        """``pipeline`` selects the driver ``run()`` uses: ``"auto"``
+        (default) follows ``GST_SERVE_PIPELINE`` (auto -> pipelined);
+        ``True``/``False`` force it, still overridden by an explicit
+        env setting (the bench A/B convention). ``prefetch`` bounds the
+        staged-tenant window: the staging thread prepares at most this
+        many queued tenants ahead of placement, so first-fit backfill
+        scans a ``prefetch``-deep prepared window instead of the whole
+        queue."""
         import jax.numpy as jnp
 
         self.pool = SlotPool(template_ma, config,
@@ -61,6 +138,17 @@ class ChainServer:
                              telemetry=telemetry, metrics=metrics)
         self.config = config
         self.metrics = metrics
+        env = serve_pipeline_env()
+        if pipeline not in ("auto", True, False):
+            raise ValueError(
+                f"pipeline must be 'auto', True or False, got {pipeline!r}")
+        if env != "auto":
+            self.pipeline = env == "1"
+        else:
+            self.pipeline = True if pipeline == "auto" else bool(pipeline)
+        if prefetch < 1:
+            raise ValueError(f"prefetch must be >= 1, got {prefetch}")
+        self._prefetch = int(prefetch)
         self.queue = AdmissionQueue(maxsize=max_queue,
                                     policy=backpressure)
         self._lock = threading.Lock()
@@ -70,11 +158,39 @@ class ChainServer:
         self._next_id = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # pipelined-executor machinery (threads started lazily)
+        self._prep_lock = threading.Lock()
+        self._prepared: List[_Prepared] = []
+        self._staging_n = 0            # tenants being prepared right now
+        self._workers_stop = threading.Event()
+        self._stage_thread: Optional[threading.Thread] = None
+        self._drain_thread: Optional[threading.Thread] = None
+        self._drainq: _queue.Queue = _queue.Queue()
+        self._worker_error: Optional[BaseException] = None
         # run-level aggregates for the serving summary
         self.quanta = 0
         self.busy_lane_sweeps = 0     # chain-sweeps actually served
         self.total_lane_sweeps = 0    # nlanes * sweeps advanced
         self._admission_ms: List[float] = []
+        # per-quantum host-time breakdown (ms; docs/SERVING.md schema):
+        # boundary admission-apply time, drain time per quantum, and
+        # the host gap between consecutive quantum dispatches
+        self._admit_apply_ms: List[float] = []
+        self._drain_ms: List[float] = []
+        self._gap_ms: List[float] = []
+        self._last_dispatch_t: Optional[float] = None
+
+    def reset_counters(self) -> None:
+        """Zero the run-level aggregates (the serve_bench warmup
+        boundary) without touching tenants or the pool."""
+        self.quanta = 0
+        self.busy_lane_sweeps = 0
+        self.total_lane_sweeps = 0
+        self._admission_ms.clear()
+        self._admit_apply_ms.clear()
+        self._drain_ms.clear()
+        self._gap_ms.clear()
+        self._last_dispatch_t = None
 
     # ------------------------------------------------------------------
     # submission
@@ -84,8 +200,8 @@ class ChainServer:
                timeout: Optional[float] = None) -> TenantHandle:
         """Queue a job (backpressure per the queue policy) and return
         its handle. Validation that needs the pool template happens at
-        admission time; a structurally incompatible tenant is rejected
-        through its handle."""
+        staging/admission time; a structurally incompatible tenant is
+        rejected through its handle."""
         if request.niter < 1 or request.niter % self.pool.quantum:
             raise ValueError(
                 f"niter ({request.niter}) must be a positive multiple "
@@ -107,6 +223,30 @@ class ChainServer:
             self.metrics.gauge("serve_queue_depth").set(len(self.queue))
         return handle
 
+    def cancel(self, handle: TenantHandle) -> bool:
+        """Request eviction of a tenant. A queued (or staged but not
+        yet placed) tenant is failed immediately; a RUNNING tenant's
+        lanes freeze at the NEXT quantum boundary — the in-flight
+        quantum completes and its records are kept — then the tenant
+        finalizes normally with the sweeps served so far (partial
+        rows, status ``done``). Returns False when the tenant is
+        unknown (already finished)."""
+        with self._lock:
+            ent = self._running.get(handle.tenant_id)
+            if ent is not None:
+                ent[0].cancelled = True
+                return True
+        if self.queue.remove(handle):
+            handle._fail("cancelled before admission")
+            return True
+        with self._prep_lock:
+            for i, p in enumerate(self._prepared):
+                if p.handle is handle:
+                    self._prepared.pop(i)
+                    handle._fail("cancelled before admission")
+                    return True
+        return False
+
     # ------------------------------------------------------------------
     # admission
     # ------------------------------------------------------------------
@@ -114,9 +254,15 @@ class ChainServer:
     def _groups_needed(self, handle: TenantHandle) -> int:
         return -(-handle.request.nchains // self.pool.group)
 
-    def _admit(self, handle: TenantHandle) -> bool:
-        """Validate + write one tenant into free lane groups. Returns
-        False (and fails the handle) on structural mismatch."""
+    def _prepare(self, handle: TenantHandle) -> Optional[_Prepared]:
+        """Validate one tenant against the pool template and build
+        everything admission needs except its lanes: the localized /
+        padded model, the throwaway construction backend (fused-MH
+        constants + the exact solo initial state) — the expensive host
+        work the pipelined executor runs on the staging thread while
+        the pool keeps serving. Returns None (and fails the handle) on
+        structural mismatch."""
+        t0 = time.monotonic()
         req = handle.request
         pool = self.pool
         t = pool.template
@@ -189,26 +335,37 @@ class ChainServer:
                      else tb.init_state(req.x0, seed=req.seed))
         except Exception as e:  # noqa: BLE001 - reject, don't kill pool
             handle._fail(f"{type(e).__name__}: {e}")
-            return False
-        groups_needed = self._groups_needed(handle)
-        taken = [self._free_groups.pop(0) for _ in range(groups_needed)]
+            return None
+        return _Prepared(handle, ma_p, tb, state,
+                         self._groups_needed(handle), ma.n,
+                         (time.monotonic() - t0) * 1e3)
+
+    def _apply_prepared(self, prep: _Prepared) -> None:
+        """Place a prepared tenant into free lane groups: the cheap
+        boundary half of admission (host slice writes + bookkeeping).
+        Caller holds ``_lock`` and has verified the groups fit."""
+        handle, req = prep.handle, prep.handle.request
+        pool = self.pool
+        taken = [self._free_groups.pop(0)
+                 for _ in range(prep.groups_needed)]
         lanes = np.concatenate([
             np.arange(g * pool.group, (g + 1) * pool.group)
             for g in sorted(taken)])
-        n_real = ma.n
         slot = TenantSlot(handle.tenant_id, lanes, req.nchains,
-                          req.niter, req.start_sweep, n_real, req.seed)
-        pool.write_tenant(slot, ma_p, tb, state)
+                          req.niter, req.start_sweep, prep.n_real,
+                          req.seed)
+        pool.write_tenant(slot, prep.ma_padded, prep.backend, prep.state)
         spool = None
         if req.spool_dir is not None:
             from gibbs_student_t_tpu.utils.spool import ChainSpool
 
+            t = pool.template
             spool = ChainSpool(
                 req.spool_dir, req.seed, resume=req.start_sweep > 0,
                 resume_at=req.start_sweep if req.start_sweep else None,
                 record_mode=t.record_mode, record_thin=t.record_thin,
                 extra_meta={"tenant": handle.tenant_id,
-                            "n_toa": [n_real]})
+                            "n_toa": [prep.n_real]})
         handle.admitted_t = time.monotonic()
         handle.status = "running"
         self._running[handle.tenant_id] = (slot, handle, spool)
@@ -221,6 +378,15 @@ class ChainServer:
                               nchains=req.nchains, niter=req.niter,
                               lanes=int(lanes[0]),
                               admission_ms=handle.admission_ms)
+
+    def _admit(self, handle: TenantHandle) -> bool:
+        """Serial-path admission: prepare + place in one call (the
+        pre-pipelining behavior — preparation stalls the quantum
+        loop). Returns False on structural rejection."""
+        prep = self._prepare(handle)
+        if prep is None:
+            return False
+        self._apply_prepared(prep)
         return True
 
     def _try_admissions(self) -> None:
@@ -232,38 +398,56 @@ class ChainServer:
                 break
             self._admit(h)   # a rejected tenant frees nothing
 
+    def _apply_admissions(self) -> None:
+        """Pipelined-path admission at a quantum boundary: first-fit
+        over the PREPARED window (staging already paid the expensive
+        part), placement is slice writes only. Caller holds
+        ``_lock``."""
+        while self._free_groups:
+            free = len(self._free_groups)
+            with self._prep_lock:
+                idx = next(
+                    (i for i, p in enumerate(self._prepared)
+                     if p.groups_needed <= free), None)
+                prep = (self._prepared.pop(idx)
+                        if idx is not None else None)
+            if prep is None:
+                break
+            self._apply_prepared(prep)
+
     # ------------------------------------------------------------------
-    # the quantum loop
+    # the serial quantum loop (the bitwise reference path)
     # ------------------------------------------------------------------
 
     def step(self) -> bool:
-        """One scheduling quantum: admit, advance, stream, evict.
-        Returns True while there is (or may be) work."""
+        """One scheduling quantum, fully on the calling thread: admit,
+        advance, stream, evict. Returns True while there is (or may
+        be) work. This is the serial driver — the pipelined executor's
+        drain-ordering and bitwise pins are checked against it."""
         with self._lock:
+            t0 = time.monotonic()
             self._try_admissions()
+            self._admit_apply_ms.append((time.monotonic() - t0) * 1e3)
             if not self._running:
                 return len(self.queue) > 0
+            if self._last_dispatch_t is not None:
+                self._gap_ms.append(
+                    (time.monotonic() - self._last_dispatch_t) * 1e3)
             recs, tl = self.pool.run_quantum()
-            host = self.pool.materialize(recs)
+            self._last_dispatch_t = time.monotonic()
+            t0 = time.monotonic()
+            wire = self.pool.wire_host(recs)
             tele = (jax.device_get(tl) if tl is not None else None)
             q = self.pool.quantum
             finished = []
             for tid, (slot, handle, spool) in self._running.items():
                 slot.done_sweeps += q
                 sweep_end = slot.start_sweep + slot.done_sweeps
-                records = self.pool.tenant_records(host, slot)
-                if spool is not None:
-                    spool.append(records, self.pool.tenant_state(slot),
-                                 sweep_end)
-                # _stream stores (rows, nchains, ...) host arrays for
-                # in-memory tenants and fires the streaming callback
-                handle._stream(
-                    sweep_end,
-                    records if spool is None or handle.request.on_chunk
-                    else {})
-                if tele is not None:
-                    self._accumulate_tele(handle, slot, tele)
-                if slot.remaining <= 0:
+                self._drain_tenant(slot, handle, spool, wire, tele,
+                                   sweep_end,
+                                   state_fn=lambda s=slot:
+                                   self.pool.tenant_state(s))
+                if slot.remaining <= 0 or slot.cancelled:
                     finished.append(tid)
             self.quanta += 1
             busy = sum(s.nchains for s, _, _ in self._running.values())
@@ -276,7 +460,10 @@ class ChainServer:
                     len(self.queue))
                 self.metrics.counter("serve_sweeps_total").inc(busy * q)
             for tid in finished:
-                self._evict(tid)
+                slot, handle, spool = self._running.pop(tid)
+                self._release(slot)
+                self._finalize(slot, handle, spool)
+            self._drain_ms.append((time.monotonic() - t0) * 1e3)
             return bool(self._running) or len(self.queue) > 0
 
     def _accumulate_tele(self, handle: TenantHandle, slot: TenantSlot,
@@ -294,41 +481,247 @@ class ChainServer:
             d[key] = (val if prev is None
                       else (prev * n + val) / (n + 1))
 
-    def _evict(self, tenant_id: int) -> None:
-        slot, handle, spool = self._running.pop(tenant_id)
+    def _drain_tenant(self, slot: TenantSlot, handle: TenantHandle,
+                      spool, wire: list, tele, sweep_end: int,
+                      state_fn) -> None:
+        """Flush one tenant's share of one quantum — SHARED by the
+        serial loop and the pipelined drain worker so the record
+        semantics cannot drift. In-memory tenants accumulate their
+        lanes' wire slices (cast once at finalize); spool / on_chunk
+        consumers get materialized records on demand (their
+        contract). ``state_fn()`` yields the checkpoint state for
+        spooled tenants (the serial path reads the pool, the deferred
+        drain reads the pre-donation snapshot)."""
+        need_mat = spool is not None or handle.request.on_chunk
+        records = (self.pool.tenant_quantum_records(wire, slot)
+                   if need_mat else None)
+        if spool is not None:
+            spool.append(records, state_fn(), sweep_end)
+        else:
+            handle._append_wire(self.pool.tenant_wire(wire, slot))
+        handle._stream(sweep_end,
+                       records if records is not None else {})
+        if tele is not None:
+            self._accumulate_tele(handle, slot, tele)
+
+    def _release(self, slot: TenantSlot) -> None:
+        """Free a finished tenant's lanes (pool-side bookkeeping; runs
+        on the dispatch thread, so the next quantum's operand upload
+        sees the deactivated mask)."""
         self.pool.evict(slot)
         for g in sorted(set(slot.lanes // self.pool.group)):
             self._free_groups.append(int(g))
         self._free_groups.sort()
+        if self.metrics is not None:
+            self.metrics.emit("evict", tenant=slot.tenant_id,
+                              sweeps=slot.done_sweeps)
+
+    def _finalize(self, slot: TenantSlot, handle: TenantHandle,
+                  spool) -> None:
+        """Deliver a finished tenant's result (runs on whichever
+        thread drained the tenant's FINAL quantum, after its records
+        were flushed). In-memory tenants finish LAZILY: the wire
+        chunks are complete, but the float materialization +
+        concatenation run on the first ``result()`` call, on the
+        caller's thread — result DECODE is client work and must not
+        steal serving cycles from the drain worker."""
         if spool is not None:
             spool.close()
             from gibbs_student_t_tpu.utils.spool import load_spool
 
             res = load_spool(handle.request.spool_dir)
-        else:
-            cols = {f: np.concatenate(chunks)
-                    for f, chunks in handle._cols.items()}
-            res = self.pool.template._to_result(cols)
-        res.stats.update(handle._tele_stats)
-        res.stats["n_toa"] = np.asarray([slot.n_real])
+            res.stats.update(handle._tele_stats)
+            res.stats["n_toa"] = np.asarray([slot.n_real])
+            handle._finish(res)
+            return
+        pool = self.pool
+
+        def build(slot=slot, handle=handle):
+            # one concatenate of the narrow wire chunks (rows axis),
+            # then ONE materialization pass for the whole tenant
+            cols = pool.materialize_tenant(
+                {f: np.concatenate(chunks, axis=1)
+                 for f, chunks in handle._cols.items()},
+                slot.n_real)
+            res = pool.template._to_result(cols)
+            res.stats.update(handle._tele_stats)
+            res.stats["n_toa"] = np.asarray([slot.n_real])
+            return res
+
+        handle._finish_lazy(build)
+
+    # ------------------------------------------------------------------
+    # the pipelined executor
+    # ------------------------------------------------------------------
+
+    def _take_for_staging(self) -> Optional[TenantHandle]:
+        """Hand the staging thread its next job, bounded by the
+        prepared window — one lock scope, so an idle check can never
+        observe a job that is neither queued nor counted as staging."""
+        with self._prep_lock:
+            if len(self._prepared) + self._staging_n >= self._prefetch:
+                return None
+            h = self.queue.pop_next()
+            if h is not None:
+                self._staging_n += 1
+            return h
+
+    def _stage_worker(self) -> None:
+        while not self._workers_stop.is_set():
+            try:
+                h = self._take_for_staging()
+                if h is None:
+                    time.sleep(0.005)
+                    continue
+                prep = self._prepare(h)
+                with self._prep_lock:
+                    self._staging_n -= 1
+                    if prep is not None:
+                        self._prepared.append(prep)
+            except BaseException as e:  # noqa: BLE001
+                self._worker_error = e
+                return
+
+    def _drain_worker(self) -> None:
+        while True:
+            item = self._drainq.get()
+            if item is None:
+                self._drainq.task_done()
+                return
+            try:
+                t0 = time.monotonic()
+                recs, tl, snap, entries = item
+                wire = self.pool.wire_host(recs)
+                tele = (jax.device_get(tl) if tl is not None else None)
+                for slot, handle, spool, sweep_end, final in entries:
+                    self._drain_tenant(
+                        slot, handle, spool, wire, tele, sweep_end,
+                        state_fn=lambda s=slot:
+                        self.pool.tenant_state_from(snap, s))
+                    if final:
+                        self._finalize(slot, handle, spool)
+                self._drain_ms.append((time.monotonic() - t0) * 1e3)
+            except BaseException as e:  # noqa: BLE001
+                self._worker_error = e
+            finally:
+                self._drainq.task_done()
+
+    def _ensure_workers(self) -> None:
+        if self._drain_thread is None or not self._drain_thread.is_alive():
+            self._workers_stop.clear()
+            self._drain_thread = threading.Thread(
+                target=self._drain_worker, name="serve-drain",
+                daemon=True)
+            self._drain_thread.start()
+        if self._stage_thread is None or not self._stage_thread.is_alive():
+            self._stage_thread = threading.Thread(
+                target=self._stage_worker, name="serve-stage",
+                daemon=True)
+            self._stage_thread.start()
+
+    def _raise_worker_error(self) -> None:
+        if self._worker_error is not None:
+            err, self._worker_error = self._worker_error, None
+            raise RuntimeError(
+                "serve worker thread failed") from err
+
+    def _dispatch_one(self) -> None:
+        """One pipelined quantum boundary (caller holds ``_lock``):
+        dispatch the next quantum, account for it, release finished
+        tenants' lanes, and hand the drain bundle to the worker. The
+        records of the quantum just dispatched are flushed by the
+        worker while the NEXT quantum computes."""
+        if self._last_dispatch_t is not None:
+            self._gap_ms.append(
+                (time.monotonic() - self._last_dispatch_t) * 1e3)
+        need_snap = any(sp is not None
+                        for _, _, sp in self._running.values())
+        recs, tl, snap = self.pool.dispatch_quantum(snapshot=need_snap)
+        self._last_dispatch_t = time.monotonic()
+        q = self.pool.quantum
+        entries = []
+        finished = []
+        busy = 0
+        for tid, (slot, handle, spool) in self._running.items():
+            slot.done_sweeps += q
+            busy += slot.nchains
+            final = slot.remaining <= 0 or slot.cancelled
+            entries.append((slot, handle, spool,
+                            slot.start_sweep + slot.done_sweeps, final))
+            if final:
+                finished.append(tid)
+        for tid in finished:
+            slot, _, _ = self._running.pop(tid)
+            self._release(slot)   # finalize happens at drain time
+        self.quanta += 1
+        self.busy_lane_sweeps += busy * q
+        self.total_lane_sweeps += self.pool.nlanes * q
         if self.metrics is not None:
-            self.metrics.emit("evict", tenant=tenant_id,
-                              sweeps=slot.done_sweeps)
-        handle._finish(res)
+            self.metrics.gauge("serve_occupancy").set(
+                busy / self.pool.nlanes)
+            self.metrics.gauge("serve_queue_depth").set(len(self.queue))
+            self.metrics.counter("serve_sweeps_total").inc(busy * q)
+        self._drainq.put((recs, tl, snap, entries))
+
+    def _pipeline_idle(self) -> bool:
+        """Nothing running, queued, staged or pending drain — the
+        prepared window and the staging counter are checked under one
+        lock with the queue pop, so no job can hide between states."""
+        if self._running:
+            return False
+        with self._prep_lock:
+            if self._staging_n or self._prepared:
+                return False
+            if len(self.queue):
+                return False
+        return self._drainq.unfinished_tasks == 0
+
+    def _run_pipelined(self, idle_exit: bool, poll_s: float,
+                       on_quantum) -> None:
+        self._ensure_workers()
+        while not self._stop.is_set():
+            self._raise_worker_error()
+            with self._lock:
+                t0 = time.monotonic()
+                self._apply_admissions()
+                self._admit_apply_ms.append(
+                    (time.monotonic() - t0) * 1e3)
+                have_work = bool(self._running)
+                if have_work:
+                    self._dispatch_one()
+            if on_quantum is not None:
+                on_quantum(self)
+            if not have_work:
+                if idle_exit and self._pipeline_idle():
+                    break
+                time.sleep(poll_s)
+        # flush every pending drain bundle before handing back — the
+        # caller may immediately read results or tear the server down
+        self._drainq.join()
+        self._raise_worker_error()
 
     # ------------------------------------------------------------------
     # drivers
     # ------------------------------------------------------------------
 
-    def run(self, idle_exit: bool = True, poll_s: float = 0.02) -> None:
+    def run(self, idle_exit: bool = True, poll_s: float = 0.02,
+            on_quantum=None) -> None:
         """Drive quanta until stopped (or, with ``idle_exit``, until
-        both the pool and the queue drain)."""
-        while not self._stop.is_set():
-            had_work = self.step()
-            if not had_work:
-                if idle_exit:
-                    return
-                time.sleep(poll_s)
+        the pool, the queue, the staging window and the drain queue
+        all drain). ``on_quantum(server)``, when given, fires after
+        every quantum boundary on the driving thread — the
+        serve_bench staggered-arrival hook."""
+        if not self.pipeline:
+            while not self._stop.is_set():
+                had_work = self.step()
+                if on_quantum is not None:
+                    on_quantum(self)
+                if not had_work:
+                    if idle_exit:
+                        return
+                    time.sleep(poll_s)
+            return
+        self._run_pipelined(idle_exit, poll_s, on_quantum)
 
     def start(self) -> None:
         """Run the quantum loop in a background thread until
@@ -345,6 +738,15 @@ class ChainServer:
         if self._thread is not None:
             self._thread.join()
             self._thread = None
+        # stop the executor workers (idempotent; threads are lazy)
+        self._workers_stop.set()
+        if self._drain_thread is not None and self._drain_thread.is_alive():
+            self._drainq.put(None)
+            self._drain_thread.join()
+        self._drain_thread = None
+        if self._stage_thread is not None and self._stage_thread.is_alive():
+            self._stage_thread.join()
+        self._stage_thread = None
 
     # ------------------------------------------------------------------
     # summary
@@ -354,7 +756,9 @@ class ChainServer:
         """Run-level serving metrics (the serve_bench ledger payload).
         ``occupancy`` is chain-lane-sweeps actually served over total
         lane-sweeps advanced; ``admission_ms`` the mean admission
-        latency."""
+        latency; ``host_ms`` the per-quantum host-time breakdown
+        (admission-apply / drain / dispatch-gap percentiles, ms) that
+        attributes the pipelining win."""
         occ = (self.busy_lane_sweeps / self.total_lane_sweeps
                if self.total_lane_sweeps else 0.0)
         return {
@@ -363,8 +767,14 @@ class ChainServer:
             "quanta": self.quanta,
             "occupancy": occ,
             "busy_chain_sweeps": self.busy_lane_sweeps,
+            "pipeline": bool(self.pipeline),
             "admission_ms": (float(np.mean(self._admission_ms))
                              if self._admission_ms else None),
             "admission_ms_max": (float(np.max(self._admission_ms))
                                  if self._admission_ms else None),
+            "host_ms": {
+                "admission": _percentiles(self._admit_apply_ms),
+                "drain": _percentiles(self._drain_ms),
+                "dispatch_gap": _percentiles(self._gap_ms),
+            },
         }

@@ -55,8 +55,10 @@
 // (philox gamma-v2, fractional beta, white/hyper MH blocks, fused
 // Schur + hyper+draws megastage). v3: the multi-tenant serving family
 // (per-lane-consts tnt/fused-hyper lanes variants with the
-// tile-uniform group-id contract, residual matvec).
-#define GST_ABI_VERSION 3
+// tile-uniform group-id contract, residual matvec). v4: gst_white_lanes
+// — the per-lane-consts white-MH twin (the last lanes-path MH stage
+// still on the grouped XLA loop under serving).
+#define GST_ABI_VERSION 4
 GST_EXPORT2 int gst_abi_version() { return GST_ABI_VERSION; }
 
 // Best SIMD level this object was compiled for — the Python loader
@@ -431,6 +433,55 @@ ffi::Error white_mh_impl(ffi::Buffer<DT> x, ffi::Buffer<DT> az,
                         rows.typed_data(), specs.typed_data(),
                         var.typed_data(), nvar, xo->typed_data(),
                         acc->typed_data(), B, p, n, S, R);
+  return ffi::Error::Success();
+}
+
+template <ffi::DataType DT>
+ffi::Error white_mh_lanes_impl(ffi::Buffer<DT> x, ffi::Buffer<DT> az,
+                               ffi::Buffer<DT> y2, ffi::Buffer<DT> dx,
+                               ffi::Buffer<DT> logu, ffi::Buffer<DT> rows,
+                               ffi::Buffer<DT> specs,
+                               ffi::Buffer<ffi::S32> gid,
+                               ffi::Buffer<ffi::S32> var,
+                               ffi::ResultBuffer<DT> xo,
+                               ffi::ResultBuffer<DT> acc) {
+  auto xdims = x.dimensions();
+  auto rdims = rows.dimensions();
+  auto ddims = dx.dimensions();
+  if (xdims.size() < 1 || rdims.size() != 3 || ddims.size() < 2)
+    return ffi::Error::InvalidArgument("gst_white_lanes: ranks");
+  const int64_t p = xdims[xdims.size() - 1];
+  const int64_t B = batch_of(xdims, 1);
+  const int64_t R = rdims[1];
+  const int64_t n = rdims[2];
+  const int64_t S = ddims[ddims.size() - 2];
+  const int64_t nvar = var.element_count() / 3;
+  if (rdims[0] != B
+      || az.element_count() != size_t(B) * n
+      || y2.element_count() != size_t(B) * n
+      || dx.element_count() != size_t(B) * S * p
+      || logu.element_count() != size_t(B) * S
+      || specs.element_count() != size_t(B) * 3 * p
+      || gid.element_count() != size_t(B)
+      || var.element_count() != size_t(nvar) * 3)
+    return ffi::Error::InvalidArgument("gst_white_lanes: shapes");
+  if (p > 64 || nvar > 16 || R < 2 + nvar)
+    return ffi::Error::InvalidArgument("gst_white_lanes: limits");
+  for (int64_t g = 0; g < nvar; ++g) {
+    const int32_t* vg = var.typed_data() + 3 * g;
+    if (vg[1] < 0 || vg[1] >= p || vg[2] < 0 || vg[2] >= R)
+      return ffi::Error::InvalidArgument("gst_white_lanes: var table");
+  }
+  using NT = std::remove_pointer_t<decltype(x.typed_data())>;
+  if (const char* why = check_tile_uniform<NT>(gid.typed_data(), B))
+    return ffi::Error::InvalidArgument(
+        std::string("gst_white_lanes: ") + why);
+  if (B && p && n && S)
+    gst::white_mh_lanes_batch(
+        x.typed_data(), az.typed_data(), y2.typed_data(),
+        dx.typed_data(), logu.typed_data(), rows.typed_data(),
+        specs.typed_data(), gid.typed_data(), var.typed_data(), nvar,
+        xo->typed_data(), acc->typed_data(), B, p, n, S, R);
   return ffi::Error::Success();
 }
 
@@ -878,6 +929,27 @@ XLA_FFI_DEFINE_HANDLER_SYMBOL(GstWhiteMhF32, (white_mh_impl<ffi::F32>),
                               GST_BIND_WHITE_MH(ffi::F32));
 XLA_FFI_DEFINE_HANDLER_SYMBOL(GstWhiteMhF64, (white_mh_impl<ffi::F64>),
                               GST_BIND_WHITE_MH(ffi::F64));
+
+#define GST_BIND_WHITE_LANES(DT)           \
+  ffi::Ffi::Bind()                         \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<DT>>()              \
+      .Arg<ffi::Buffer<ffi::S32>>()        \
+      .Arg<ffi::Buffer<ffi::S32>>()        \
+      .Ret<ffi::Buffer<DT>>()              \
+      .Ret<ffi::Buffer<DT>>()
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(GstWhiteLanesF32,
+                              (white_mh_lanes_impl<ffi::F32>),
+                              GST_BIND_WHITE_LANES(ffi::F32));
+XLA_FFI_DEFINE_HANDLER_SYMBOL(GstWhiteLanesF64,
+                              (white_mh_lanes_impl<ffi::F64>),
+                              GST_BIND_WHITE_LANES(ffi::F64));
 XLA_FFI_DEFINE_HANDLER_SYMBOL(GstHyperMhF32, (hyper_mh_impl<ffi::F32>),
                               GST_BIND_HYPER_MH(ffi::F32));
 XLA_FFI_DEFINE_HANDLER_SYMBOL(GstHyperMhF64, (hyper_mh_impl<ffi::F64>),

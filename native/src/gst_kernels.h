@@ -1556,6 +1556,104 @@ void white_mh_batch(const T* x, const T* az, const T* y2, const T* dx,
   }
 }
 
+// Multi-tenant twin of white_mh_batch: the constant rows and prior
+// specs are PER LANE (the serve slot pool's call-time operands,
+// docs/SERVING.md) under the tile-uniform group-id contract of
+// tnt_lanes_batch — rows (B, R, n), specs (B, 3, p), gid (B,) constant
+// within every aligned W-lane tile (gst_ffi.cpp rejects straddles).
+// The prior table and constant-row pointers rebind only when gid
+// changes between consecutive tiles, so a tenant spanning many tiles
+// pays ONE table build; the per-tile compute is the exact
+// white_mh_batch loop, so a uniform pool is bitwise identical to the
+// shared-consts kernel (and, like it, bitwise equal to
+// white_mh_loop_xla at f64 — pinned in tests/test_nchol.py).
+template <typename T>
+void white_mh_lanes_batch(const T* x, const T* az, const T* y2,
+                          const T* dx, const T* logu, const T* rows,
+                          const T* specs, const int32_t* gid,
+                          const int32_t* var, int64_t nvar, T* xo,
+                          T* acc, int64_t B, int64_t p, int64_t n,
+                          int64_t S, int64_t R) {
+  constexpr int W = Lanes<T>::W;
+  using V = typename VecOf<T, W>::type;
+  using MI = typename MaskInt<T>::type;
+  typedef MI IV __attribute__((vector_size(W * sizeof(T))));
+  PriorTab<T> pt;
+  const T* nv0 = rows;            // per-group row 0: baseline variance
+  const T* rmask = rows + n;      // per-group row 1: real-TOA mask
+  const T* rows_g = rows;
+  int32_t last_gid = 0;
+  bool have = false;
+  Scratch<T> xt(size_t(p) * W), azt(size_t(n) * W), y2t(size_t(n) * W),
+      dxt(size_t(S) * p * W), lut(size_t(S) * W), qt(size_t(p) * W);
+  const V one = splat<T, W>(T(1));
+  for (int64_t b0 = 0; b0 < B; b0 += W) {
+    const int64_t lanes = std::min<int64_t>(W, B - b0);
+    if (!have || gid[b0] != last_gid) {
+      rows_g = rows + size_t(b0) * R * n;
+      nv0 = rows_g;
+      rmask = rows_g + n;
+      pt.build(specs + size_t(b0) * 3 * p, p);
+      last_gid = gid[b0];
+      have = true;
+    }
+    load_tile<T, W>(x, xt.get(), b0, lanes, p, p);
+    load_tile<T, W>(az, azt.get(), b0, lanes, n, n);
+    load_tile<T, W>(y2, y2t.get(), b0, lanes, n, n);
+    load_tile<T, W>(dx, dxt.get(), b0, lanes, S * p, S * p);
+    load_tile<T, W>(logu, lut.get(), b0, lanes, S, S);
+    V* xv = reinterpret_cast<V*>(xt.get());
+    V* qv = reinterpret_cast<V*>(qt.get());
+    const V* azv = reinterpret_cast<const V*>(azt.get());
+    const V* y2v = reinterpret_cast<const V*>(y2t.get());
+    const V* dxv = reinterpret_cast<const V*>(dxt.get());
+    const V* luv = reinterpret_cast<const V*>(lut.get());
+
+    auto ll_of = [&](const V* q) -> V {
+      V coef[16];
+      for (int64_t g = 0; g < nvar; ++g) {
+        const V qi = q[var[3 * g + 1]];
+        coef[g] = (var[3 * g] == 0)
+                      ? qi * qi
+                      : vexp_t<T, W>(qi
+                                     * splat<T, W>(
+                                           T(4.605170185988091368)));
+      }
+      V sll = {}, sq = {};
+      for (int64_t k = 0; k < n; ++k) {
+        V nd = splat<T, W>(nv0[k]);
+        for (int64_t g = 0; g < nvar; ++g)
+          nd += coef[g] * splat<T, W>(rows_g[var[3 * g + 2] * n + k]);
+        const V rm = splat<T, W>(rmask[k]);
+        const V nv = rm * (azv[k] * nd) + (one - rm);
+        sll += vlog_t<T, W>(nv);
+        sq += y2v[k] / nv;
+      }
+      return splat<T, W>(T(-0.5)) * (sll + sq);
+    };
+
+    V ll0 = ll_of(xv);
+    V lp0 = pt.template lp_sum<W>(xv);
+    V accv = {};
+    for (int64_t s = 0; s < S; ++s) {
+      for (int64_t i = 0; i < p; ++i) qv[i] = xv[i] + dxv[s * p + i];
+      const V ll1 = ll_of(qv);
+      const V lp1 = pt.template lp_sum<W>(qv);
+      const V delta = (ll1 + lp1) - (ll0 + lp0);
+      const IV am = delta > luv[s];          // NaN compares false
+      for (int64_t i = 0; i < p; ++i) xv[i] = am ? qv[i] : xv[i];
+      ll0 = am ? ll1 : ll0;
+      lp0 = am ? lp1 : lp0;
+      accv += am ? one : V{};
+    }
+    store_tile<T, W>(xt.get(), xo, b0, lanes, p, p);
+    alignas(64) T atmp[W];
+    const V arate = accv / splat<T, W>(T(S));
+    for (int l = 0; l < W; ++l) atmp[l] = arate[l];
+    for (int l = 0; l < lanes; ++l) acc[b0 + l] = atmp[l];
+  }
+}
+
 // Per-tile hyper-MH machinery, shared by the standalone hyper block
 // handler and the fused schur+hyper+draws megastage. The affine phi
 // structure (K rows / sel / static addend) and prior table are

@@ -282,6 +282,9 @@ def test_pack_bits_roundtrip():
         np.testing.assert_array_equal(out, z)
 
 
+@pytest.mark.slow  # round-11 re-tier (~25 s): "compact" is the
+# non-default middle transport tier; the production default
+# ("compact8") keeps its tier-1 twin below
 def test_compact_record_matches_full(ma):
     """record="compact" (the default) narrows only the device->host
     transport: the sampled-parameter chains and z come back bit-identical
@@ -477,6 +480,9 @@ def test_mtm_per_block_selection(ma, monkeypatch):
     assert set(calls) == {cfg.mh.n_hyper_steps}
 
 
+@pytest.mark.slow  # round-11 re-tier (~30 s): GST_UNROLLED_CHOL is a
+# kept-for-A/B opt-in arm (measured loser in-sweep, ops/linalg.py) —
+# its full-sweep equality pin doesn't need to ride the tier-1 budget
 def test_unrolled_chol_sweep_matches_lapack_path(ma, monkeypatch):
     """The TPU-gated unrolled-Cholesky sweep path produces the same chains
     as the LAPACK/expander path on identical keys — full integration

@@ -766,6 +766,61 @@ def test_white_mh_kernel_f64_parity_and_nan():
         jax.config.update("jax_enable_x64", False)
 
 
+def test_white_lanes_kernel_f64_parity_uniform_and_straddle():
+    """The per-lane-consts white-MH twin (gst_white_lanes, round 11):
+    vs the grouped white_mh_loop_xla on identical draws at f64 with
+    two tile-aligned groups carrying DIFFERENT constants; a uniform
+    pool is bitwise the shared-consts kernel (same tile loop); a gid
+    straddling an aligned SIMD tile is rejected with a diagnostic (the
+    scheduler contract, not silent corruption)."""
+    from gibbs_student_t_tpu.ops.pallas_white import (
+        build_white_consts,
+        white_mh_loop_xla,
+    )
+
+    _require_kernels()
+    jax.config.update("jax_enable_x64", True)
+    try:
+        ma, wc, x, az, y2, dx, logu = _white_operands(np.float64, B=48)
+        B = 48
+        rows = np.repeat(wc.rows[None].astype(np.float64), B, 0)
+        specs = np.repeat(wc.specs[None].astype(np.float64), B, 0)
+        # group 1 (lanes 24+, W=8-aligned): perturbed baseline variance
+        # and a shifted uniform-prior window — really different consts
+        rows[24:, 0, :] *= 1.7
+        specs[24:, 1, :] -= 0.25
+        rows_j = jnp.asarray(rows)
+        specs_j = jnp.asarray(specs)
+        gid = jnp.asarray(np.repeat([0, 1], 24).astype(np.int32))
+        x0, a0 = white_mh_loop_xla(x, az, y2, dx, logu, rows_j,
+                                   specs_j, wc.var)
+        x1, a1 = nffi.white_mh_lanes(x, az, y2, dx, logu, rows_j,
+                                     specs_j, gid, wc.var)
+        np.testing.assert_allclose(x1, x0, atol=1e-9)
+        np.testing.assert_array_equal(np.asarray(a1), np.asarray(a0))
+        assert 0.0 < np.asarray(a1).mean() < 1.0
+        # uniform pool == the shared-consts kernel, bitwise
+        ru = jnp.asarray(np.repeat(wc.rows[None].astype(np.float64),
+                                   B, 0))
+        su = jnp.asarray(np.repeat(wc.specs[None].astype(np.float64),
+                                   B, 0))
+        xs, as_ = nffi.white_mh(x, az, y2, dx, logu,
+                                jnp.asarray(wc.rows, jnp.float64),
+                                jnp.asarray(wc.specs, jnp.float64),
+                                wc.var)
+        xl, al = nffi.white_mh_lanes(x, az, y2, dx, logu, ru, su,
+                                     jnp.zeros(B, jnp.int32), wc.var)
+        np.testing.assert_array_equal(np.asarray(xs), np.asarray(xl))
+        np.testing.assert_array_equal(np.asarray(as_), np.asarray(al))
+        # tile-straddling gid: loud rejection
+        with pytest.raises(Exception, match="straddles"):
+            nffi.white_mh_lanes(
+                x, az, y2, dx, logu, rows_j, specs_j,
+                jnp.asarray(np.arange(B, dtype=np.int32)), wc.var)
+    finally:
+        jax.config.update("jax_enable_x64", False)
+
+
 def test_hyper_mh_kernel_f64_parity_and_nonpd():
     """The native hyper-MH block vs hyper_mh_loop_xla at f64: identical
     accepts/x. A non-PD S0 chain rejects every proposal (NaN factor ->
@@ -1021,6 +1076,11 @@ def test_fuse_backend_runs_and_deterministic(small_ma, monkeypatch):
                                rtol=1e-3, atol=1e-3)
 
 
+@pytest.mark.slow  # round-11 re-tier: ~21 s of end-to-end sweeps; the
+# tier-1 budget keeps the bitwise parity pins and the cheap
+# dispatcher-level degradation checks (test_dispatch_degrades_without
+# _library, test_serve white-lanes) — this full-sweep sibling runs in
+# tier 2
 def test_round9_forced_but_unavailable_degrades(small_ma, monkeypatch):
     """The graph-preserving gates (FUSE_STAGES / NWHITE / NHYPER /
     FAST_THETA) forced on with the library unreachable must reproduce

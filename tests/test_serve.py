@@ -94,13 +94,12 @@ def test_solo_tenant_parity_gates_off(demo, monkeypatch):
                     reason="native kernels unavailable")
 def test_solo_tenant_parity_native_lanes(demo, monkeypatch):
     """At the native arms, the lanes kernels (tnt_lanes,
-    fused_hyper_lanes, resid_lanes) share the solo kernels' tile
-    functions: the pin additionally asserts they actually engaged.
-    GST_NWHITE is pinned off — the white block has no lanes arm, so
-    aligning both sides on the XLA loop is what makes the accept
-    streams match."""
+    fused_hyper_lanes, resid_lanes, and — round 11 — white_lanes)
+    share the solo kernels' tile functions: the pin additionally
+    asserts they actually engaged. With the white lanes twin, BOTH
+    sides now run fully native (GST_NWHITE no longer needs pinning
+    off — the round-10 caveat is closed)."""
     ma, cfg = demo
-    monkeypatch.setenv("GST_NWHITE", "0")
     from gibbs_student_t_tpu.obs import introspect
 
     n0 = len(introspect.compile_records())
@@ -114,6 +113,7 @@ def test_solo_tenant_parity_native_lanes(demo, monkeypatch):
     assert ("tnt_lanes", "nchol") in impls
     assert ("fused_hyper_lanes", "nchol") in impls
     assert ("resid_lanes", "nchol") in impls
+    assert ("white_lanes", "nchol") in impls
 
 
 def test_multi_tenant_zero_recompiles(demo):
@@ -243,6 +243,261 @@ def test_tenant_spool_checkpoint_resume(demo, tmp_path):
     assert res.chain.shape[0] == 15
     assert np.array_equal(res.chain, ref_res.chain)
     assert np.array_equal(res.zchain, ref_res.zchain)
+
+
+def _results_equal(ra, rb):
+    for f in EXACT_FIELDS + ROUNDOFF_FIELDS:
+        assert np.array_equal(np.asarray(getattr(ra, f)),
+                              np.asarray(getattr(rb, f))), f
+    for k in ("acc_white", "acc_hyper"):
+        assert np.array_equal(ra.stats[k], rb.stats[k]), k
+
+
+def test_pipelined_matches_serial_bitwise(demo):
+    """The drain-ordering contract: the pipelined executor runs the
+    SAME compiled program over the SAME per-quantum operands as the
+    serial loop, so every per-tenant field — including the continuous
+    per-TOA ones the solo pin only holds to roundoff — is bitwise
+    identical between the two drivers."""
+    ma, cfg = demo
+
+    def run(pipeline):
+        srv = ChainServer(ma, cfg, nlanes=32, quantum=5, record="full",
+                          pipeline=pipeline)
+        hs = [srv.submit(TenantRequest(ma=ma, niter=n, nchains=16,
+                                       seed=7 + i))
+              for i, n in enumerate((15, 10, 5))]
+        srv.run()
+        srv.close()
+        return [h.result() for h in hs]
+
+    serial = run(False)
+    piped = run(True)
+    for ra, rb in zip(serial, piped):
+        _results_equal(ra, rb)
+
+
+def test_pipelined_spool_drain_ordering(demo, tmp_path):
+    """Records are flushed (and the spool checkpoint written from the
+    pre-donation state snapshot) before the buffers are reused by the
+    next quantum: a spooled tenant on the PIPELINED server round-trips
+    bitwise against the serial driver's in-memory result, and its
+    rolling checkpoint resumes bitwise."""
+    pytest.importorskip("gibbs_student_t_tpu.native")
+    from gibbs_student_t_tpu import native as native_mod
+
+    if not native_mod.available():
+        pytest.skip("spooling needs the native library")
+    from gibbs_student_t_tpu.utils.spool import load_spool_state
+
+    ma, cfg = demo
+    spool_dir = str(tmp_path / "piped")
+    srv = ChainServer(ma, cfg, nlanes=32, quantum=5, record="full",
+                      pipeline=True)
+    h = srv.submit(TenantRequest(ma=ma, niter=20, nchains=16, seed=5,
+                                 spool_dir=spool_dir))
+    # a second tenant keeps the pool multi-tenant (and the drain queue
+    # busy) while the spooled one checkpoints every quantum
+    h2 = srv.submit(TenantRequest(ma=ma, niter=10, nchains=16, seed=6))
+    srv.run()
+    srv.close()
+    res = h.result()
+    h2.result()
+    ref_srv = ChainServer(ma, cfg, nlanes=32, quantum=5, record="full",
+                          pipeline=False)
+    ref = ref_srv.submit(TenantRequest(ma=ma, niter=20, nchains=16,
+                                       seed=5))
+    ref_srv.run()
+    _results_equal(ref.result(), res)
+    # the rolling checkpoint is the post-final-quantum state
+    state, next_sweep, seed = load_spool_state(spool_dir)
+    assert next_sweep == 20 and seed == 5
+
+
+def test_cancel_freezes_at_next_boundary(demo):
+    """An eviction (cancel) landing while a quantum is in flight
+    freezes the tenant at the NEXT quantum boundary: the in-flight
+    quantum's records are kept, and the partial rows are a bitwise
+    prefix of the uncancelled serial run."""
+    ma, cfg = demo
+    ref_srv = ChainServer(ma, cfg, nlanes=32, quantum=5, record="full",
+                          pipeline=False)
+    ref_h = ref_srv.submit(TenantRequest(ma=ma, niter=30, nchains=16,
+                                         seed=11))
+    ref_srv.run()
+    ref = ref_h.result()
+
+    srv = ChainServer(ma, cfg, nlanes=32, quantum=5, record="full",
+                      pipeline=True)
+    h = srv.submit(TenantRequest(ma=ma, niter=30, nchains=16, seed=11))
+    other = srv.submit(TenantRequest(ma=ma, niter=30, nchains=16,
+                                     seed=12))
+    cancelled = []
+
+    def cb(server):
+        if server.quanta >= 2 and not cancelled:
+            cancelled.append(server.cancel(h))
+
+    srv.run(on_quantum=cb)
+    srv.close()
+    assert cancelled == [True]
+    res = h.result()
+    rows = res.chain.shape[0]
+    assert 0 < rows < 30, "cancel must land mid-run for this pin"
+    for f in EXACT_FIELDS + ROUNDOFF_FIELDS:
+        assert np.array_equal(np.asarray(getattr(res, f)),
+                              np.asarray(getattr(ref, f))[:rows]), f
+    # the surviving tenant is untouched by its neighbour's eviction
+    ref2_srv = ChainServer(ma, cfg, nlanes=32, quantum=5,
+                           record="full", pipeline=False)
+    ref2_h = ref2_srv.submit(TenantRequest(ma=ma, niter=30, nchains=16,
+                                           seed=12))
+    ref2_srv.run()
+    _results_equal(ref2_h.result(), other.result())
+
+
+def test_serve_pipeline_gate_validation(monkeypatch, demo):
+    from gibbs_student_t_tpu.serve.server import serve_pipeline_env
+
+    monkeypatch.setenv("GST_SERVE_PIPELINE", "banana")
+    with pytest.raises(ValueError, match="GST_SERVE_PIPELINE"):
+        serve_pipeline_env()
+    ma, cfg = demo
+    with pytest.raises(ValueError, match="GST_SERVE_PIPELINE"):
+        ChainServer(ma, cfg, nlanes=32, quantum=5)
+    monkeypatch.setenv("GST_SERVE_PIPELINE", "0")
+    srv = ChainServer(ma, cfg, nlanes=32, quantum=5)
+    assert srv.pipeline is False
+    monkeypatch.setenv("GST_SERVE_PIPELINE", "1")
+    # an explicit env setting overrides the constructor arg (the
+    # bench A/B convention)
+    srv = ChainServer(ma, cfg, nlanes=32, quantum=5, pipeline=False)
+    assert srv.pipeline is True
+    with pytest.raises(ValueError, match="pipeline"):
+        ChainServer(ma, cfg, nlanes=32, quantum=5, pipeline="yes")
+
+
+def test_white_lanes_forced_but_unavailable_degrades(monkeypatch):
+    """GST_NWHITE=1 with the library unavailable keeps the grouped
+    XLA-loop graph: the lanes dispatcher under the serve vmap emits
+    white_mh_loop_xla verbatim, bitwise the GST_NWHITE=0 arm (the
+    forced-but-unavailable contract of every native arm, checked at
+    the dispatcher so tier-1 does not pay two full server compiles)."""
+    import jax
+    import jax.numpy as jnp
+
+    from gibbs_student_t_tpu import native as native_mod
+    from gibbs_student_t_tpu.native import ffi as nffi_mod
+    from gibbs_student_t_tpu.ops.pallas_white import (
+        build_white_consts,
+        make_white_block_lanes,
+    )
+
+    pta = make_demo_pta()
+    ma = pta.frozen(0)
+    wc = build_white_consts(ma)
+    rng = np.random.default_rng(0)
+    B, S, p, n = 32, 6, ma.nparam, ma.n
+    x = jnp.asarray(np.stack([ma.x_init(rng) for _ in range(B)]),
+                    jnp.float32)
+    az = jnp.asarray(rng.uniform(0.5, 2.0, (B, n)), jnp.float32)
+    y2 = jnp.asarray(rng.uniform(0.0, 3.0, (B, n)), jnp.float32)
+    dx = jnp.asarray(rng.normal(0, 0.05, (B, S, p)), jnp.float32)
+    logu = jnp.asarray(np.log(rng.uniform(size=(B, S))), jnp.float32)
+    rows = jnp.asarray(np.repeat(wc.rows[None], B, 0), jnp.float32)
+    specs = jnp.asarray(np.repeat(wc.specs[None], B, 0), jnp.float32)
+    gid = jnp.zeros(B, jnp.int32)
+
+    def run_block():
+        block = make_white_block_lanes(wc.var)
+        # the serve vmap shape: every operand mapped over the lane axis
+        return jax.vmap(block)(x, az, y2, dx, logu, rows, specs, gid)
+
+    monkeypatch.setenv("GST_NWHITE", "0")
+    x_off, a_off = run_block()
+    monkeypatch.setattr(native_mod, "load", lambda build=False: None)
+    nffi_mod._reset_for_tests()
+    try:
+        assert not nffi_mod.ready()
+        monkeypatch.setenv("GST_NWHITE", "1")  # forced AND unavailable
+        x_forced, a_forced = run_block()
+        np.testing.assert_array_equal(np.asarray(x_off),
+                                      np.asarray(x_forced))
+        np.testing.assert_array_equal(np.asarray(a_off),
+                                      np.asarray(a_forced))
+    finally:
+        monkeypatch.undo()
+        nffi_mod._reset_for_tests()
+
+
+@pytest.mark.slow
+def test_serve_concurrency_stress(demo):
+    """Safety net: submit/cancel/backfill hammered from threads
+    against a RUNNING pipelined server. No torn lane operands (the
+    native lanes handlers reject any tile-uniform gid violation loudly,
+    and the executor must surface worker errors instead of hanging),
+    and every completed tenant's result is bitwise the same schedule
+    replayed serially."""
+    import threading
+
+    ma, cfg = demo
+    srv = ChainServer(ma, cfg, nlanes=32, quantum=5, record="full",
+                      pipeline=True, max_queue=64)
+    srv.start()
+    jobs = [(i, 5 * (1 + i % 4), 16 if i % 3 else 32)
+            for i in range(12)]
+    handles = {}
+    hlock = threading.Lock()
+
+    def submitter(idx0):
+        for i, niter, nchains in jobs[idx0::3]:
+            h = srv.submit(TenantRequest(ma=ma, niter=niter,
+                                         nchains=nchains,
+                                         seed=100 + i))
+            with hlock:
+                handles[i] = h
+
+    threads = [threading.Thread(target=submitter, args=(k,))
+               for k in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # a couple of cancels racing the scheduler: either they land
+    # before admission (rejected handle) or freeze at a boundary
+    cancelled = {1, 7}
+    for i in sorted(cancelled):
+        srv.cancel(handles[i])
+    results = {}
+    for i, h in sorted(handles.items()):
+        if i in cancelled:
+            try:
+                results[i] = h.result(timeout=240)
+            except RuntimeError:
+                results[i] = None  # cancelled before admission
+        else:
+            results[i] = h.result(timeout=240)
+    srv.close()
+    assert srv._worker_error is None
+
+    # serial replay: same tenants, one at a time
+    for i, niter, nchains in jobs:
+        res = results.get(i)
+        if res is None:
+            continue
+        ref_srv = ChainServer(ma, cfg, nlanes=32, quantum=5,
+                              record="full", pipeline=False)
+        rh = ref_srv.submit(TenantRequest(ma=ma, niter=niter,
+                                          nchains=nchains,
+                                          seed=100 + i))
+        ref_srv.run()
+        ref = rh.result()
+        rows = res.chain.shape[0]
+        assert 0 < rows <= niter
+        for f in EXACT_FIELDS + ROUNDOFF_FIELDS:
+            assert np.array_equal(
+                np.asarray(getattr(res, f)),
+                np.asarray(getattr(ref, f))[:rows]), (i, f)
 
 
 @pytest.mark.slow

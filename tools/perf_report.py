@@ -170,7 +170,19 @@ def print_report(ledger_recs, include_rounds=True):
                   f"occupancy={occ if occ is not None else '?'} "
                   f"ratio_vs_solo={ratio if ratio is not None else '?'} "
                   f"admission_ms={m.get('admission_ms')} "
-                  f"lanes={m.get('nlanes')} tenants={m.get('tenants')}")
+                  f"lanes={m.get('nlanes')} tenants={m.get('tenants')}"
+                  + ("" if "pipeline" not in m
+                     else f" pipeline={m.get('pipeline')}"))
+            # per-quantum host-time breakdown (when the record carries
+            # one): where the serving host budget actually goes
+            host = m.get("host_ms") or {}
+            for name in ("admission", "drain", "dispatch_gap"):
+                v = host.get(name)
+                if isinstance(v, dict):
+                    print(f"    host {name:13s} "
+                          f"p50={v.get('p50'):>8}ms "
+                          f"p90={v.get('p90'):>8}ms "
+                          f"max={v.get('max'):>8}ms")
         else:
             brief = {k: v for k, v in m.items()
                      if isinstance(v, (int, float, bool, str))}
@@ -313,12 +325,14 @@ def check_latest(ledger_recs, max_drop, max_compile_growth,
     return 0
 
 
-def check_serve(ledger_recs, min_occupancy):
+def check_serve(ledger_recs, min_occupancy, min_serve_ratio):
     """Serving gate: the latest ``serve_bench`` record (when one
     exists) must report lane occupancy at or above ``min_occupancy``
-    and carry a usable aggregate value. Returns the exit code
-    contribution (0 when no serving record exists — a bench-only
-    ledger is not a serving regression)."""
+    and an aggregate/solo throughput ratio at or above
+    ``min_serve_ratio`` (when the record carries a same-host solo arm
+    — ``--no-solo`` records skip that leg with a note). Returns the
+    exit code contribution (0 when no serving record exists — a
+    bench-only ledger is not a serving regression)."""
     serve = [r for r in ledger_recs if r.get("tool") == "serve_bench"]
     if not serve:
         print("check: no serve_bench record — serving gate skipped")
@@ -336,11 +350,26 @@ def check_serve(ledger_recs, min_occupancy):
     ratio = m.get("ratio_vs_solo")
     print(f"check: serve occupancy {occ:.3f} (min {min_occupancy}), "
           f"aggregate {value} chain-sweeps/s"
-          + (f", ratio_vs_solo {ratio}" if ratio is not None else ""))
+          + (f", ratio_vs_solo {ratio} (min {min_serve_ratio})"
+             if ratio is not None else ""))
     if occ < min_occupancy:
         print(f"check: FAIL — serve occupancy {occ:.3f} < "
               f"{min_occupancy} (idle lanes are the serving "
               "regression: admissions are not backfilling the pool)")
+        return 2
+    if ratio is None:
+        print("check: serve ratio gate skipped — record has no "
+              "same-host solo arm (--no-solo run)")
+        return 0
+    if not isinstance(ratio, (int, float)):
+        print("check: FAIL — latest serve_bench record has an "
+              f"unusable ratio_vs_solo ({ratio!r})")
+        return 3
+    if ratio < min_serve_ratio:
+        print(f"check: FAIL — serve aggregate/solo ratio {ratio:.3f} "
+              f"< {min_serve_ratio} (multi-tenant host plumbing is "
+              "eating the kernels' throughput: see the host_ms "
+              "breakdown on the serving row)")
         return 2
     return 0
 
@@ -382,6 +411,13 @@ def main(argv=None):
                          "(chain-lane-sweeps served / lane-sweeps "
                          "advanced; skipped when no serving record "
                          "exists)")
+    ap.add_argument("--min-serve-ratio", type=float, default=0.85,
+                    metavar="FRAC",
+                    help="serving gate: minimum aggregate/solo "
+                         "throughput ratio (ratio_vs_solo — the "
+                         "host-independent serving-efficiency number) "
+                         "the latest serve_bench record must report; "
+                         "skipped when the record has no solo arm")
     ap.add_argument("--baseline", choices=("prev", "best"),
                     default="prev",
                     help="compare against the previous comparable "
@@ -401,7 +437,8 @@ def main(argv=None):
                           args.max_hbm_growth, args.baseline,
                           max_stage_growth=args.max_stage_growth,
                           max_dispatch_growth=args.max_dispatch_growth)
-        rc_serve = check_serve(recs, args.min_occupancy)
+        rc_serve = check_serve(recs, args.min_occupancy,
+                               args.min_serve_ratio)
         return rc or rc_serve
     return 0
 
