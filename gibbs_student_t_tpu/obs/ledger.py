@@ -115,20 +115,44 @@ def append_record(record: Dict[str, Any],
                   path: Optional[str] = None) -> str:
     """Append one record as a single atomic line write; returns the
     resolved path. Compact separators keep a record ~1-2 KB so the
-    single ``os.write`` stays atomic on any POSIX filesystem."""
+    single ``os.write`` stays atomic on any POSIX filesystem.
+
+    Appends are NON-FATAL under transient IO failures: one bounded
+    retry on an ``EINTR``/``ENOSPC``-class ``OSError`` (a fresh
+    descriptor — the first may be the poisoned one), then
+    warn-and-continue. A metrics/provenance write must never kill the
+    run it describes — a serving pool dying because its *ledger* disk
+    filled would be the observability tail wagging the dog."""
     from gibbs_student_t_tpu.obs.metrics import _jsonable
 
     path = ledger_path(path)
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    line = json.dumps(_jsonable(record), separators=(",", ":")) + "\n"
-    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
-    try:
-        os.write(fd, line.encode())
-        os.fsync(fd)
-    finally:
-        os.close(fd)
+    line = (json.dumps(_jsonable(record), separators=(",", ":"))
+            + "\n").encode()
+    for attempt in (0, 1):
+        fd = None
+        try:
+            fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                         0o644)
+            os.write(fd, line)
+            os.fsync(fd)
+            return path
+        except OSError as e:
+            if attempt:
+                import warnings
+
+                warnings.warn(
+                    f"ledger append to {path!r} failed twice "
+                    f"({type(e).__name__}: {e}); record dropped",
+                    RuntimeWarning, stacklevel=2)
+        finally:
+            if fd is not None:
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
     return path
 
 

@@ -27,6 +27,7 @@ operand-vs-baked-constant table.
 
 from gibbs_student_t_tpu.serve.pool import GROUP_LANES, SlotPool
 from gibbs_student_t_tpu.serve.scheduler import (
+    TenantError,
     TenantHandle,
     TenantRequest,
 )
@@ -37,5 +38,6 @@ __all__ = [
     "SlotPool",
     "TenantRequest",
     "TenantHandle",
+    "TenantError",
     "ChainServer",
 ]

@@ -1,0 +1,203 @@
+"""Durable server manifest: the crash-recovery log of a ChainServer.
+
+A long-running serving process dies — OOM killer, node preemption,
+plain ``kill -9`` — and the question is what survives. Per-tenant
+*records* already do (the spool files + rolling state checkpoint,
+utils/spool.py). What did NOT survive before this module is the
+*server's* knowledge of who was running: which tenants were admitted,
+with what budgets/seeds/policies, and how far their checkpoints got.
+The manifest closes that gap with the same append-only JSONL record
+discipline as the run ledger (obs/ledger.py): each record is one
+compact JSON line written by a single ``os.write`` on an ``O_APPEND``
+descriptor and fsync'd, so a crash can at worst leave one torn final
+line, which the reader skips.
+
+Record kinds (each carries ``t`` unix seconds; schema in
+docs/OBSERVABILITY.md):
+
+- ``server``  — one per ChainServer epoch: pool geometry (nlanes,
+  quantum, group, record mode/thin, heterogeneous) so ``recover``
+  rebuilds an identical pool. The template model + config are pickled
+  beside the log (``server.pkl``) — they are numpy pytrees, not JSON.
+- ``admit``   — tenant admission: id, name, seed, niter, nchains,
+  start_sweep, spool_dir, on_divergence, and (for spooled tenants)
+  the pickled model file recovery re-reads.
+- ``checkpoint`` — after every spool append: the tenant's resume point
+  (``next_sweep``) — the generation counter recovery resumes from.
+- ``done``    — tenant finalized (status ``done`` or ``failed``).
+- ``fault`` / ``quarantine`` / ``reinit`` — the containment events,
+  mirrored here so a post-mortem needs only the manifest.
+
+Multiple server epochs append to one log (a recovered server keeps
+writing where the dead one stopped); records are implicitly scoped to
+the latest preceding ``server`` record, and recovery resolves the
+outstanding set per *spool directory* — the stable identity of a
+logical job across epochs.
+
+Writes are non-fatal by the same argument as ledger appends: one
+bounded retry, then warn-and-continue — a bookkeeping write must never
+take down the serving loop it describes (the tenants' own records are
+on the spool path, which keeps its own fsync discipline).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import time
+import warnings
+from typing import Any, Dict, List, Optional, Tuple
+
+MANIFEST_NAME = "manifest.jsonl"
+SERVER_PICKLE = "server.pkl"
+
+
+def _append_line(path: str, record: Dict[str, Any]) -> None:
+    """The ledger append discipline (single fsync'd O_APPEND write),
+    made non-fatal: one retry on an OSError-class failure, then
+    warn-and-continue."""
+    from gibbs_student_t_tpu.obs.metrics import _jsonable
+
+    line = (json.dumps(_jsonable(record), separators=(",", ":"))
+            + "\n").encode()
+    for attempt in (0, 1):
+        try:
+            fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                         0o644)
+            try:
+                os.write(fd, line)
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+            return
+        except OSError as e:  # EINTR/ENOSPC-class transients
+            if attempt:
+                warnings.warn(
+                    f"server manifest append failed twice "
+                    f"({type(e).__name__}: {e}); record dropped — "
+                    f"recovery may lose this event", RuntimeWarning,
+                    stacklevel=2)
+
+
+def read_manifest(manifest_dir: str) -> List[Dict[str, Any]]:
+    """Every parseable manifest record in file order (torn final lines
+    skipped — the obs/ledger reader tolerance)."""
+    from gibbs_student_t_tpu.obs.ledger import read_ledger
+
+    return read_ledger(os.path.join(manifest_dir, MANIFEST_NAME))
+
+
+class ServerManifest:
+    """Writer handle for one ChainServer's manifest directory."""
+
+    def __init__(self, manifest_dir: str):
+        self.dir = manifest_dir
+        os.makedirs(manifest_dir, exist_ok=True)
+        self.path = os.path.join(manifest_dir, MANIFEST_NAME)
+        # epoch index = how many server records precede ours
+        self.epoch = sum(1 for r in read_manifest(manifest_dir)
+                         if r.get("kind") == "server")
+
+    def record(self, kind: str, **fields) -> None:
+        rec = {"kind": kind, "t": round(time.time(), 3)}
+        rec.update(fields)
+        _append_line(self.path, rec)
+
+    # -- server epoch ---------------------------------------------------
+
+    def record_server(self, template_ma, config,
+                      pool_kwargs: Dict[str, Any]) -> None:
+        """Start an epoch: pickle the template/config (pytrees, not
+        JSON-able) and log the pool geometry."""
+        tmp = os.path.join(self.dir, SERVER_PICKLE + ".tmp")
+        with open(tmp, "wb") as fh:
+            pickle.dump({"template_ma": template_ma, "config": config},
+                        fh)
+        os.replace(tmp, os.path.join(self.dir, SERVER_PICKLE))
+        self.record("server", epoch=self.epoch, **pool_kwargs)
+
+    # -- tenants --------------------------------------------------------
+
+    def record_admit(self, tenant_id: int, request,
+                     model=None) -> None:
+        model_file = None
+        if model is not None:
+            model_file = f"model_{self.epoch}_{tenant_id}.pkl"
+            tmp = os.path.join(self.dir, model_file + ".tmp")
+            with open(tmp, "wb") as fh:
+                pickle.dump(model, fh)
+            os.replace(tmp, os.path.join(self.dir, model_file))
+        self.record(
+            "admit", tenant=tenant_id, name=request.name,
+            seed=request.seed, niter=request.niter,
+            nchains=request.nchains, start_sweep=request.start_sweep,
+            spool_dir=request.spool_dir,
+            on_divergence=request.on_divergence, model_file=model_file)
+
+    def record_checkpoint(self, tenant_id: int, next_sweep: int) -> None:
+        self.record("checkpoint", tenant=tenant_id,
+                    next_sweep=next_sweep)
+
+    def record_done(self, tenant_id: int, status: str,
+                    sweeps: int) -> None:
+        self.record("done", tenant=tenant_id, status=status,
+                    sweeps=sweeps)
+
+
+def load_server_state(manifest_dir: str) -> Tuple[object, object,
+                                                  Dict[str, Any]]:
+    """(template_ma, config, pool_kwargs-from-latest-server-record)."""
+    with open(os.path.join(manifest_dir, SERVER_PICKLE), "rb") as fh:
+        blob = pickle.load(fh)
+    server_recs = [r for r in read_manifest(manifest_dir)
+                   if r.get("kind") == "server"]
+    if not server_recs:
+        raise ValueError(
+            f"manifest at {manifest_dir!r} has no server record")
+    kw = {k: v for k, v in server_recs[-1].items()
+          if k not in ("kind", "t", "epoch")}
+    return blob["template_ma"], blob["config"], kw
+
+
+def outstanding_tenants(manifest_dir: str) -> Tuple[List[Dict[str, Any]],
+                                                    List[Dict[str, Any]]]:
+    """Resolve the recovery set: tenants admitted but never finalized.
+
+    Returns ``(recoverable, lost)`` admit-record lists. A tenant is
+    *outstanding* when its latest admit (per spool_dir for spooled
+    tenants, per (epoch, tenant id) otherwise) has no matching ``done``
+    in the same epoch; it is *recoverable* when it was spooled with a
+    pickled model (in-memory tenants' drained records died with the
+    process — they are reported as lost, not silently dropped)."""
+    epoch = -1
+    # keyed by logical identity; values (admit_record, done_seen)
+    jobs: Dict[object, List] = {}
+    for r in read_manifest(manifest_dir):
+        kind = r.get("kind")
+        if kind == "server":
+            epoch += 1
+        elif kind == "admit":
+            key = r.get("spool_dir") or ("mem", epoch, r.get("tenant"))
+            jobs[key] = [dict(r, epoch=epoch), False]
+        elif kind == "done":
+            for key, v in jobs.items():
+                if (v[0].get("tenant") == r.get("tenant")
+                        and v[0]["epoch"] == epoch):
+                    v[1] = True
+    recoverable, lost = [], []
+    for v in jobs.values():
+        rec, done = v
+        if done:
+            continue
+        if rec.get("spool_dir") and rec.get("model_file"):
+            recoverable.append(rec)
+        else:
+            lost.append(rec)
+    return recoverable, lost
+
+
+def load_tenant_model(manifest_dir: str, admit_record: Dict[str, Any]):
+    with open(os.path.join(manifest_dir, admit_record["model_file"]),
+              "rb") as fh:
+        return pickle.load(fh)

@@ -86,6 +86,15 @@ class TenantSlot:
         # an eviction request (ChainServer.cancel) landing while a
         # quantum is in flight: the lane freezes at the NEXT boundary
         self.cancelled = False
+        # tenant-scoped fault containment (ChainServer, supervised):
+        # a failed tenant freezes and releases exactly like a cancel,
+        # but its handle resolves to a structured TenantError
+        self.failed = False
+        self.fail_where: str = ""
+        self.fail_cause = None
+        # lane-health bookkeeping (on_divergence policies)
+        self.quarantined: set = set()   # tenant-chain indices frozen
+        self.n_reinits = 0
 
     @property
     def chain_lanes(self) -> np.ndarray:
@@ -348,6 +357,44 @@ class SlotPool:
         the next admission overwrites them."""
         self._active_np[slot.lanes] = False
         self._gid_np[slot.lanes] = FREE_GID
+        self._dirty = True
+
+    def quarantine_lanes(self, lanes: np.ndarray) -> None:
+        """Mask diverged lanes inactive WITHOUT freeing their groups:
+        the lanes stop advancing (state frozen by the active mask,
+        draws discarded) but stay owned by their tenant, so its result
+        shape is unchanged and its surviving chains are untouched
+        bitwise. The group frees normally at eviction."""
+        self._active_np[np.asarray(lanes, int)] = False
+        self._dirty = True
+
+    def poison_lanes(self, lanes: np.ndarray) -> None:
+        """Force NaN into the given lanes' parameter state — the
+        deterministic ``lane_nan`` fault-injection arm (serve/faults).
+        The in-kernel telemetry's sticky diverged flag picks it up on
+        the next quantum exactly as a real numerical divergence."""
+        self._pull_state()
+        np.asarray(self._state_np.x)[np.asarray(lanes, int)] = np.nan
+
+    def reinit_lanes(self, lanes: np.ndarray, fresh: ChainState,
+                     fresh_idx: np.ndarray) -> None:
+        """Replace diverged lanes' state with ``fresh[fresh_idx]``
+        chains (a prior re-draw from the tenant's backend — the solo
+        ``reinit_diverged`` recovery path) and re-activate them.
+        Healthy lanes stay bitwise untouched, and the re-drawn lanes
+        KEEP their adapted MH jump scales / covariance factors —
+        exactly ``backends.jax_backend.merge_reinit``'s contract (a
+        zeroed scale would run un-adapted forever after)."""
+        lanes = np.asarray(lanes, int)
+        self._pull_state()
+        for f in type(self._state_np)._fields:
+            if f in ("mh_log_scale", "mh_cov_chol"):
+                continue  # adapted scales survive re-init (solo pin)
+            buf = np.asarray(getattr(self._state_np, f))
+            if buf.ndim == 0:
+                continue
+            buf[lanes] = np.asarray(getattr(fresh, f))[fresh_idx]
+        self._active_np[lanes] = True
         self._dirty = True
 
     def tenant_state(self, slot: TenantSlot) -> ChainState:

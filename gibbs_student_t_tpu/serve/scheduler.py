@@ -25,6 +25,40 @@ class QueueFull(RuntimeError):
     when the admission queue is at capacity."""
 
 
+class TenantError(RuntimeError):
+    """A tenant-scoped serving failure (docs/SERVING.md "Failure
+    semantics"): raised by ``TenantHandle.result()`` when a fault was
+    contained to this tenant — its lanes froze and released at a
+    quantum boundary while every co-resident tenant kept serving.
+
+    ``cause`` is the original exception (also chained as
+    ``__cause__``); ``partial`` the :class:`ChainResult` built from
+    the records drained before the fault — a bitwise prefix of the
+    fault-free run (the cancel contract), or None when nothing was
+    drained. ``where`` names the failing stage (``drain``,
+    ``callback``, ``spool``, ``divergence``, ``worker``, ``close``).
+    """
+
+    def __init__(self, tenant_id: int, reason: str,
+                 where: str = "drain", cause=None, partial=None):
+        super().__init__(f"tenant {tenant_id} failed [{where}]: "
+                         f"{reason}")
+        self.tenant_id = tenant_id
+        self.reason = reason
+        self.where = where
+        self.cause = cause
+        self.partial = partial
+        if cause is not None:
+            self.__cause__ = cause
+
+
+#: Valid ``TenantRequest.on_divergence`` policies. ``none`` keeps the
+#: historical behavior (diverged chains stream post-divergence noise,
+#: flagged only by telemetry/health); the active policies need pool
+#: telemetry and a supervised server (validated at submit).
+DIVERGENCE_POLICIES = ("none", "fail", "quarantine", "reinit")
+
+
 @dataclass
 class TenantRequest:
     """One job for the slot pool.
@@ -37,6 +71,15 @@ class TenantRequest:
     ``start_sweep`` resume a checkpointed tenant (utils/spool.py
     ``load_spool_state``) — the per-sweep fold-in keying makes the
     continuation identical to an unbroken run.
+
+    ``on_divergence`` selects the tenant's lane-health policy when the
+    in-kernel sticky diverged flags fold into per-lane health at a
+    quantum boundary (supervised servers with telemetry only):
+    ``none`` streams on (historical behavior), ``fail`` fails the
+    tenant with a structured :class:`TenantError`, ``quarantine``
+    freezes diverged lanes and continues on the survivors, ``reinit``
+    re-draws diverged lanes from the prior (the solo
+    ``reinit_diverged`` recovery path, serving-side).
     """
 
     ma: ModelArrays
@@ -49,6 +92,7 @@ class TenantRequest:
     spool_dir: Optional[str] = None
     on_chunk: Optional[Callable] = None   # (handle, sweep_end, records)
     name: Optional[str] = None
+    on_divergence: str = "none"
 
 
 class TenantHandle:
@@ -70,6 +114,11 @@ class TenantHandle:
         self._builder = None
         self._build_lock = threading.Lock()
         self._done = threading.Event()
+        # per-tenant health report (obs/health.py verdicts over the
+        # accumulated telemetry + serving lane-health counters),
+        # attached at finalize; None when the pool ran telemetry-off
+        self.health: Optional[Dict] = None
+        self._tenant_error: Optional[TenantError] = None
 
     # -- lifecycle (server side) ---------------------------------------
 
@@ -82,6 +131,12 @@ class TenantHandle:
         self.sweeps_done = sweep_end - self.request.start_sweep
         self.chunks_streamed += 1
         if self.request.on_chunk is not None:
+            from gibbs_student_t_tpu.serve import faults
+
+            faults.fire("callback",
+                        tenant=self.request.name
+                        if self.request.name is not None
+                        else self.tenant_id)
             self.request.on_chunk(self, sweep_end, records)
 
     def _append_wire(self, wire_cols: Dict[str, np.ndarray]):
@@ -112,6 +167,18 @@ class TenantHandle:
         self.status = "rejected"
         self._done.set()
 
+    def _fail_tenant(self, err: TenantError):
+        """Complete the handle with a CONTAINED tenant failure: the
+        tenant ran (unlike ``_fail``'s pre-admission rejection) and
+        ``result()`` raises the structured :class:`TenantError`
+        carrying the cause and the partial results drained before the
+        fault."""
+        self._tenant_error = err
+        self.error = str(err)
+        self.finished_t = time.monotonic()
+        self.status = "failed"
+        self._done.set()
+
     # -- caller side ----------------------------------------------------
 
     @property
@@ -139,6 +206,8 @@ class TenantHandle:
             raise TimeoutError(
                 f"tenant {self.tenant_id} not done (status "
                 f"{self.status!r}); drive ChainServer.step()/run()")
+        if self._tenant_error is not None:
+            raise self._tenant_error
         if self.error is not None:
             raise RuntimeError(
                 f"tenant {self.tenant_id} rejected: {self.error}")

@@ -1,4 +1,4 @@
-"""ChainServer: admission, eviction, streaming and serving metrics.
+"""ChainServer: admission, eviction, streaming, fault containment.
 
 Ties the :class:`~gibbs_student_t_tpu.serve.pool.SlotPool` (the ONE
 compiled chunk program) to the admission queue. Two drivers share every
@@ -28,13 +28,37 @@ sweep index (never on lane placement or scheduling), per-tenant
 results are bitwise identical between the two drivers (pinned in
 tests/test_serve.py).
 
+**Fault containment** (round 12; docs/SERVING.md "Failure semantics"):
+under ``GST_SERVE_SUPERVISE`` (auto → on), a tenant-attributable
+failure — an ``on_chunk`` callback raising, a spool IO error, a drain
+worker dying mid-bundle — fails ONLY that tenant: its lanes freeze and
+release at the next quantum boundary (the cancel machinery), its
+handle resolves to a structured
+:class:`~gibbs_student_t_tpu.serve.scheduler.TenantError` carrying the
+cause plus the partial results already drained (a bitwise prefix, the
+cancel contract), and a supervisor restarts dead workers with capped
+exponential backoff. Lane divergence folds into per-lane health at
+each boundary (the in-kernel sticky ``diverged`` telemetry flag) and
+the tenant's ``on_divergence`` policy decides: fail, quarantine the
+lanes, or re-draw them from the prior (the solo ``reinit_diverged``
+path). Only pool-level faults — dispatch itself raising, worker
+crash-looping past the restart budget — still fail the pool.
+``GST_SERVE_SUPERVISE=0`` keeps the historical fail-fast behavior
+bitwise (a worker exception latches a pool-wide error). A
+``manifest_dir`` additionally journals admissions / checkpoint
+generations / completions to an append-only fsync'd manifest
+(serve/manifest.py) so :meth:`ChainServer.recover` can rebuild the
+pool after a process kill and resume every spooled tenant from its
+last checkpoint, bitwise with an uninterrupted run.
+
 Serving metrics land in the attached ``obs.metrics.MetricsRegistry``:
 ``serve_occupancy`` (busy chain-lanes / pool lanes, per quantum),
 ``serve_queue_depth``, ``serve_admission_ms`` histogram,
 ``serve_sweeps_total`` counter (chain-sweeps), plus ``admit``/``evict``
-events — and the per-run summary (now with the per-quantum host-time
-breakdown ``host_ms``: admission / drain / dispatch-gap percentiles)
-that tools/serve_bench.py turns into a ledger record (docs/SERVING.md
+and the fault-containment events ``tenant_fault`` / ``quarantine`` /
+``reinit`` — and the per-run summary (per-quantum host-time breakdown
+``host_ms`` plus the ``faults`` counters block) that
+tools/serve_bench.py turns into a ledger record (docs/SERVING.md
 schema).
 """
 
@@ -57,13 +81,16 @@ from gibbs_student_t_tpu.parallel.ensemble import (
     _localize_names,
     pad_model_arrays,
 )
+from gibbs_student_t_tpu.serve import faults as _faults
 from gibbs_student_t_tpu.serve.pool import (
     GROUP_LANES,
     SlotPool,
     TenantSlot,
 )
 from gibbs_student_t_tpu.serve.scheduler import (
+    DIVERGENCE_POLICIES,
     AdmissionQueue,
+    TenantError,
     TenantHandle,
     TenantRequest,
 )
@@ -83,6 +110,21 @@ def serve_pipeline_env() -> str:
     return env if env is not None else "auto"
 
 
+def serve_supervise_env() -> str:
+    """Validated ``GST_SERVE_SUPERVISE`` (``auto`` when unset) — the
+    fault-containment supervisor. Strict ``auto|1|0``; ``auto``
+    resolves to ON (containment is a pure failure-path change: a
+    fault-free run is bitwise identical either way). ``0`` keeps the
+    historical fail-fast semantics — any worker exception latches a
+    pool-wide error — as the reference arm."""
+    env = os.environ.get("GST_SERVE_SUPERVISE")
+    if env is not None and env not in ("auto", "1", "0"):
+        raise ValueError(
+            f"GST_SERVE_SUPERVISE must be 'auto', '1' or '0', got "
+            f"{env!r}")
+    return env if env is not None else "auto"
+
+
 @dataclass
 class _Prepared:
     """A staged tenant: everything admission needs except lanes —
@@ -95,6 +137,34 @@ class _Prepared:
     groups_needed: int
     n_real: int
     prep_ms: float
+
+
+@dataclass
+class _Tenant:
+    """One RUNNING tenant's server-side entry. ``backend`` is retained
+    only for ``on_divergence="reinit"`` tenants (the prior re-draw
+    needs the tenant's own init-state path)."""
+
+    slot: TenantSlot
+    handle: TenantHandle
+    spool: object = None
+    backend: Optional[JaxGibbs] = None
+
+
+@dataclass
+class _Bundle:
+    """One quantum's deferred drain work. ``entries`` rows are
+    ``(slot, handle, spool, sweep_end, final, drained)`` — ``drained``
+    False marks a finalize-only entry (a tenant failed at a boundary:
+    no records this quantum, but its failure must be delivered in
+    drain order, after its last real drain). ``idx`` tracks progress
+    so a dying worker can abort exactly the undrained tail."""
+
+    recs: object
+    tl: object
+    snap: object
+    entries: list
+    idx: int = 0
 
 
 def _percentiles(vals: List[float]) -> Optional[dict]:
@@ -114,13 +184,18 @@ def _percentiles(vals: List[float]) -> Optional[dict]:
 class ChainServer:
     """A persistent multi-tenant driver over one slot pool."""
 
+    #: consecutive worker restarts (per worker kind) before the pool is
+    #: declared crash-looping and failed — the supervisor's budget
+    MAX_WORKER_RESTARTS = 5
+
     def __init__(self, template_ma: ModelArrays, config: GibbsConfig,
                  nlanes: int = 1024, quantum: int = 25,
                  group: int = GROUP_LANES, dtype=None,
                  record: str = "compact8", record_thin: int = 1,
                  max_queue: int = 64, backpressure: str = "block",
                  telemetry: bool = True, metrics=None,
-                 pipeline="auto", prefetch: int = 2):
+                 pipeline="auto", prefetch: int = 2,
+                 supervise="auto", manifest_dir: Optional[str] = None):
         """``pipeline`` selects the driver ``run()`` uses: ``"auto"``
         (default) follows ``GST_SERVE_PIPELINE`` (auto -> pipelined);
         ``True``/``False`` force it, still overridden by an explicit
@@ -128,7 +203,12 @@ class ChainServer:
         staged-tenant window: the staging thread prepares at most this
         many queued tenants ahead of placement, so first-fit backfill
         scans a ``prefetch``-deep prepared window instead of the whole
-        queue."""
+        queue. ``supervise`` follows the same convention over
+        ``GST_SERVE_SUPERVISE`` (auto -> on): tenant-scoped fault
+        containment + worker supervision vs the historical fail-fast.
+        ``manifest_dir``, when given, journals the server's state to an
+        append-only crash-recovery manifest (serve/manifest.py;
+        :meth:`recover` rebuilds from it)."""
         import jax.numpy as jnp
 
         self.pool = SlotPool(template_ma, config,
@@ -146,13 +226,22 @@ class ChainServer:
             self.pipeline = env == "1"
         else:
             self.pipeline = True if pipeline == "auto" else bool(pipeline)
+        sup_env = serve_supervise_env()
+        if supervise not in ("auto", True, False):
+            raise ValueError(
+                f"supervise must be 'auto', True or False, got "
+                f"{supervise!r}")
+        if sup_env != "auto":
+            self.supervise = sup_env == "1"
+        else:
+            self.supervise = True if supervise == "auto" else bool(supervise)
         if prefetch < 1:
             raise ValueError(f"prefetch must be >= 1, got {prefetch}")
         self._prefetch = int(prefetch)
         self.queue = AdmissionQueue(maxsize=max_queue,
                                     policy=backpressure)
         self._lock = threading.Lock()
-        self._running: Dict[int, tuple] = {}   # id -> (slot, handle, spool)
+        self._running: Dict[int, _Tenant] = {}
         self._free_groups: List[int] = list(
             range(self.pool.nlanes // self.pool.group))
         self._next_id = 0
@@ -167,6 +256,34 @@ class ChainServer:
         self._drain_thread: Optional[threading.Thread] = None
         self._drainq: _queue.Queue = _queue.Queue()
         self._worker_error: Optional[BaseException] = None
+        self._worker_error_label: str = ""
+        # supervisor state: per-worker-kind restart counters + the
+        # capped-exponential-backoff earliest-restart times
+        self._restarts = {"drain": {"n": 0, "next_t": 0.0},
+                          "stage": {"n": 0, "next_t": 0.0}}
+        # lane-health fold state: the previous quantum's telemetry
+        # handle (consumed at the next boundary when any running tenant
+        # carries an on_divergence policy) plus the tenant ids that
+        # quantum actually advanced — a tenant admitted AFTER the
+        # dispatch must never inherit its lanes' previous occupant's
+        # diverged flags
+        self._last_tl = None
+        self._last_tl_tids: set = set()
+        # tenants failed at a boundary, awaiting a drain-ordered
+        # finalize entry in the next bundle (pipelined driver)
+        self._boundary_failed: List[_Tenant] = []
+        # crash-recovery manifest (optional)
+        self._manifest = None
+        if manifest_dir is not None:
+            from gibbs_student_t_tpu.serve.manifest import ServerManifest
+
+            self._manifest = ServerManifest(manifest_dir)
+            self._manifest.record_server(template_ma, config, {
+                "nlanes": nlanes, "quantum": quantum, "group": group,
+                "record": record, "record_thin": record_thin,
+                "max_queue": max_queue, "backpressure": backpressure,
+                "telemetry": telemetry,
+            })
         # run-level aggregates for the serving summary
         self.quanta = 0
         self.busy_lane_sweeps = 0     # chain-sweeps actually served
@@ -179,6 +296,10 @@ class ChainServer:
         self._drain_ms: List[float] = []
         self._gap_ms: List[float] = []
         self._last_dispatch_t: Optional[float] = None
+        # fault-containment counters (the summary()/ledger block)
+        self._fault_counts = {"tenant_failures": 0,
+                              "quarantined_lanes": 0, "reinits": 0,
+                              "worker_restarts": 0, "pool_failures": 0}
 
     def reset_counters(self) -> None:
         """Zero the run-level aggregates (the serve_bench warmup
@@ -191,6 +312,8 @@ class ChainServer:
         self._drain_ms.clear()
         self._gap_ms.clear()
         self._last_dispatch_t = None
+        for k in self._fault_counts:
+            self._fault_counts[k] = 0
 
     # ------------------------------------------------------------------
     # submission
@@ -210,6 +333,21 @@ class ChainServer:
                 "recompile-free")
         if request.nchains < 1:
             raise ValueError("nchains must be >= 1")
+        if request.on_divergence not in DIVERGENCE_POLICIES:
+            raise ValueError(
+                f"on_divergence must be one of {DIVERGENCE_POLICIES}, "
+                f"got {request.on_divergence!r}")
+        if request.on_divergence != "none":
+            if not self.supervise:
+                raise ValueError(
+                    "on_divergence policies need a supervised server "
+                    "(GST_SERVE_SUPERVISE=0 keeps the fail-fast "
+                    "reference semantics)")
+            if not self.pool.template._telemetry:
+                raise ValueError(
+                    "on_divergence policies need pool telemetry — the "
+                    "in-kernel sticky diverged flags are what lane "
+                    "health folds at quantum boundaries")
         groups_needed = -(-request.nchains // self.pool.group)
         if groups_needed > self.pool.nlanes // self.pool.group:
             raise ValueError(
@@ -234,7 +372,7 @@ class ChainServer:
         with self._lock:
             ent = self._running.get(handle.tenant_id)
             if ent is not None:
-                ent[0].cancelled = True
+                ent.slot.cancelled = True
                 return True
         if self.queue.remove(handle):
             handle._fail("cancelled before admission")
@@ -254,6 +392,13 @@ class ChainServer:
     def _groups_needed(self, handle: TenantHandle) -> int:
         return -(-handle.request.nchains // self.pool.group)
 
+    @staticmethod
+    def _tenant_key(handle: TenantHandle):
+        """The fault-injection / logging identity: the request name
+        when one was given, else the tenant id."""
+        return (handle.request.name if handle.request.name is not None
+                else handle.tenant_id)
+
     def _prepare(self, handle: TenantHandle) -> Optional[_Prepared]:
         """Validate one tenant against the pool template and build
         everything admission needs except its lanes: the localized /
@@ -267,6 +412,7 @@ class ChainServer:
         pool = self.pool
         t = pool.template
         try:
+            _faults.fire("staging", tenant=self._tenant_key(handle))
             ma = _localize_names(req.ma)
             if ma.row_mask is not None:
                 raise ValueError("tenant models must be unpadded; the "
@@ -365,11 +511,19 @@ class ChainServer:
                 resume_at=req.start_sweep if req.start_sweep else None,
                 record_mode=t.record_mode, record_thin=t.record_thin,
                 extra_meta={"tenant": handle.tenant_id,
-                            "n_toa": [prep.n_real]})
+                            "n_toa": [prep.n_real]},
+                fault_key=self._tenant_key(handle))
         handle.admitted_t = time.monotonic()
         handle.status = "running"
-        self._running[handle.tenant_id] = (slot, handle, spool)
+        self._running[handle.tenant_id] = _Tenant(
+            slot, handle, spool,
+            backend=(prep.backend
+                     if req.on_divergence == "reinit" else None))
         self._admission_ms.append(handle.admission_ms)
+        if self._manifest is not None:
+            self._manifest.record_admit(
+                handle.tenant_id, req,
+                model=req.ma if req.spool_dir is not None else None)
         if self.metrics is not None:
             self.metrics.histogram("serve_admission_ms").observe(
                 handle.admission_ms)
@@ -416,6 +570,222 @@ class ChainServer:
             self._apply_prepared(prep)
 
     # ------------------------------------------------------------------
+    # fault containment
+    # ------------------------------------------------------------------
+
+    def _note_fault(self, t: _Tenant, where: str, cause) -> None:
+        """Mark a tenant failed (freeze-at-next-boundary, the cancel
+        machinery) and account/journal the fault. Idempotent per
+        tenant — only the first cause is kept."""
+        slot = t.slot
+        if slot.failed:
+            return
+        slot.failed = True
+        slot.fail_where = where
+        slot.fail_cause = cause
+        self._fault_counts["tenant_failures"] += 1
+        if self.metrics is not None:
+            self.metrics.counter("serve_tenant_faults").inc()
+            self.metrics.emit("tenant_fault", tenant=slot.tenant_id,
+                              where=where,
+                              error=f"{type(cause).__name__}: {cause}")
+        if self._manifest is not None:
+            self._manifest.record(
+                "fault", tenant=slot.tenant_id, where=where,
+                error=f"{type(cause).__name__}: {cause}")
+
+    def _tenant_health(self, t: _Tenant) -> Optional[dict]:
+        """The per-tenant health block (obs/health.py verdicts over the
+        accumulated telemetry + the serving lane-health counters), or
+        None when the pool ran telemetry-off."""
+        handle, slot = t.handle, t.slot
+        if not handle._tele_stats:
+            return None
+        from gibbs_student_t_tpu.obs.health import chain_health
+
+        report = chain_health(handle._tele_stats)
+        report["n_quarantined"] = len(slot.quarantined)
+        report["quarantined_chains"] = sorted(slot.quarantined)
+        report["n_reinits"] = slot.n_reinits
+        return report
+
+    def _finalize_failed(self, t: _Tenant) -> None:
+        """Deliver a contained tenant failure: build the partial result
+        from whatever was drained before the fault (the bitwise-prefix
+        contract of cancel), attach health, and resolve the handle to
+        a structured TenantError. Runs after the tenant's last drain
+        flushed (drain order)."""
+        slot, handle, spool = t.slot, t.handle, t.spool
+        partial = None
+        try:
+            if spool is not None:
+                spool.close()
+                from gibbs_student_t_tpu.utils.spool import load_spool
+
+                partial = load_spool(handle.request.spool_dir)
+                partial.stats.update(handle._tele_stats)
+            elif handle._cols:
+                pool = self.pool
+                cols = pool.materialize_tenant(
+                    {f: np.concatenate(chunks, axis=1)
+                     for f, chunks in handle._cols.items()},
+                    slot.n_real)
+                partial = pool.template._to_result(cols)
+                partial.stats.update(handle._tele_stats)
+        except Exception:  # noqa: BLE001 - the prefix itself is broken
+            partial = None
+        handle.health = self._tenant_health(t)
+        if partial is not None and handle.health is not None:
+            partial.stats["health"] = handle.health
+        cause = slot.fail_cause
+        err = TenantError(
+            slot.tenant_id,
+            reason=(f"{type(cause).__name__}: {cause}"
+                    if cause is not None else "unknown"),
+            where=slot.fail_where or "drain", cause=cause,
+            partial=partial)
+        handle._fail_tenant(err)
+        if self._manifest is not None:
+            self._manifest.record_done(slot.tenant_id, "failed",
+                                       slot.done_sweeps)
+        if self.metrics is not None:
+            self.metrics.emit("tenant_done", tenant=slot.tenant_id,
+                              status="failed", sweeps=slot.done_sweeps)
+
+    def _fold_lane_health(self) -> List[_Tenant]:
+        """At a quantum boundary (caller holds ``_lock``), fold the
+        PREVIOUS quantum's sticky in-kernel diverged flags into
+        per-lane health and apply each tenant's ``on_divergence``
+        policy. Consuming the telemetry handle blocks until that
+        quantum's compute finished — a sync only paid when a policy is
+        actually armed (policy-free pools keep the fully-async
+        boundary). Returns policy-failed tenants (already popped and
+        released) for the driver to finalize in drain order."""
+        tl = self._last_tl
+        if tl is None:
+            return []
+        if not any(t.handle.request.on_divergence != "none"
+                   for t in self._running.values()):
+            return []
+        self._last_tl = None
+        div = np.asarray(jax.device_get(tl.diverged), bool)
+        failed: List[_Tenant] = []
+        for tid, t in list(self._running.items()):
+            slot, handle = t.slot, t.handle
+            pol = handle.request.on_divergence
+            if pol == "none" or slot.failed:
+                continue
+            if tid not in self._last_tl_tids:
+                continue  # admitted after the folded quantum dispatched
+            mask = div[slot.chain_lanes].copy()
+            if slot.quarantined:
+                mask[sorted(slot.quarantined)] = False
+            chains = np.flatnonzero(mask)
+            if chains.size == 0:
+                continue
+            sweep_now = slot.start_sweep + slot.done_sweeps
+            fail_now = pol == "fail"
+            if pol == "quarantine":
+                self.pool.quarantine_lanes(slot.chain_lanes[chains])
+                slot.quarantined.update(int(c) for c in chains)
+                self._fault_counts["quarantined_lanes"] += int(
+                    chains.size)
+                if self.metrics is not None:
+                    self.metrics.counter(
+                        "serve_quarantined_lanes").inc(int(chains.size))
+                    self.metrics.emit(
+                        "quarantine", tenant=tid, sweep=sweep_now,
+                        chains=[int(c) for c in chains])
+                if self._manifest is not None:
+                    self._manifest.record(
+                        "quarantine", tenant=tid, sweep=sweep_now,
+                        chains=[int(c) for c in chains])
+                # a tenant with no surviving chains cannot make
+                # progress — that is a tenant failure, not a freeze
+                fail_now = len(slot.quarantined) >= slot.nchains
+            elif pol == "reinit":
+                fresh = t.backend.init_state(
+                    seed=handle.request.seed + 7919 * sweep_now)
+                self.pool.reinit_lanes(slot.chain_lanes[chains],
+                                       fresh, chains)
+                slot.n_reinits += int(chains.size)
+                self._fault_counts["reinits"] += int(chains.size)
+                if self.metrics is not None:
+                    self.metrics.counter("serve_reinits").inc(
+                        int(chains.size))
+                    self.metrics.emit(
+                        "reinit", tenant=tid, sweep=sweep_now,
+                        chains=[int(c) for c in chains])
+                if self._manifest is not None:
+                    self._manifest.record(
+                        "reinit", tenant=tid, sweep=sweep_now,
+                        chains=[int(c) for c in chains])
+            if fail_now:
+                why = (f"{chains.size} chain(s) diverged"
+                       if pol == "fail" else
+                       f"all {slot.nchains} chains diverged/quarantined")
+                self._note_fault(t, "divergence", RuntimeError(why))
+                self._running.pop(tid)
+                self._release(slot)
+                failed.append(t)
+        return failed
+
+    def _boundary_faults(self) -> None:
+        """The ``lane_nan`` injection point: at a quantum boundary, an
+        armed spec firing for a running tenant poisons that tenant's
+        first chain lane to NaN — a deterministic stand-in for a real
+        numerical divergence, picked up by the next quantum's sticky
+        telemetry flag exactly like the real thing."""
+        for t in self._running.values():
+            if t.slot.failed:
+                continue
+            try:
+                _faults.fire("lane_nan",
+                             tenant=self._tenant_key(t.handle))
+            except Exception:  # noqa: BLE001 - the fire IS the signal
+                self.pool.poison_lanes(t.slot.chain_lanes[:1])
+
+    def _fail_all_outstanding(self, reason: str,
+                              where: str = "close") -> None:
+        """Deterministically resolve every handle the server still
+        owns: queued and staged tenants are rejected; running tenants
+        fail with a TenantError carrying the drained prefix. No handle
+        is ever left hanging after close() or a pool failure."""
+        while True:
+            h = self.queue.pop_next()
+            if h is None:
+                break
+            h._fail(f"cancelled before admission: {reason}")
+        with self._prep_lock:
+            prepared, self._prepared = self._prepared, []
+        for p in prepared:
+            p.handle._fail(f"cancelled before admission: {reason}")
+        with self._lock:
+            running = list(self._running.values())
+            self._running.clear()
+            for t in running:
+                self._release(t.slot)
+        for t in running:
+            self._note_fault(t, where, RuntimeError(reason))
+            self._finalize_failed(t)
+
+    def _pool_failure(self, err: BaseException, label: str = ""):
+        """A pool-level fault (dispatch raising, worker crash-looping):
+        resolve every outstanding handle, then raise — the whole pool
+        is down, and callers blocked in result() must learn it."""
+        self._fault_counts["pool_failures"] += 1
+        if self.metrics is not None:
+            self.metrics.emit("pool_failure", error=str(err),
+                              label=label)
+        if self.supervise:
+            self._fail_all_outstanding(
+                f"pool failure: {type(err).__name__}: {err}",
+                where="pool")
+        raise RuntimeError(
+            "serve worker thread failed"
+            + (f" ({label})" if label else "")) from err
+
+    # ------------------------------------------------------------------
     # the serial quantum loop (the bitwise reference path)
     # ------------------------------------------------------------------
 
@@ -425,6 +795,8 @@ class ChainServer:
         be) work. This is the serial driver — the pipelined executor's
         drain-ordering and bitwise pins are checked against it."""
         with self._lock:
+            for t in self._fold_lane_health():
+                self._finalize_failed(t)   # serial: drains are flushed
             t0 = time.monotonic()
             self._try_admissions()
             self._admit_apply_ms.append((time.monotonic() - t0) * 1e3)
@@ -433,24 +805,34 @@ class ChainServer:
             if self._last_dispatch_t is not None:
                 self._gap_ms.append(
                     (time.monotonic() - self._last_dispatch_t) * 1e3)
+            self._boundary_faults()
             recs, tl = self.pool.run_quantum()
+            self._last_tl = tl
+            self._last_tl_tids = set(self._running)
             self._last_dispatch_t = time.monotonic()
             t0 = time.monotonic()
             wire = self.pool.wire_host(recs)
             tele = (jax.device_get(tl) if tl is not None else None)
             q = self.pool.quantum
             finished = []
-            for tid, (slot, handle, spool) in self._running.items():
+            for tid, t in self._running.items():
+                slot, handle, spool = t.slot, t.handle, t.spool
                 slot.done_sweeps += q
                 sweep_end = slot.start_sweep + slot.done_sweeps
-                self._drain_tenant(slot, handle, spool, wire, tele,
-                                   sweep_end,
-                                   state_fn=lambda s=slot:
-                                   self.pool.tenant_state(s))
-                if slot.remaining <= 0 or slot.cancelled:
+                if not slot.failed:
+                    try:
+                        self._drain_tenant(
+                            slot, handle, spool, wire, tele, sweep_end,
+                            state_fn=lambda s=slot:
+                            self.pool.tenant_state(s))
+                    except Exception as e:  # noqa: BLE001
+                        if not self.supervise:
+                            raise
+                        self._note_fault(t, "drain", e)
+                if slot.remaining <= 0 or slot.cancelled or slot.failed:
                     finished.append(tid)
             self.quanta += 1
-            busy = sum(s.nchains for s, _, _ in self._running.values())
+            busy = sum(t.slot.nchains for t in self._running.values())
             self.busy_lane_sweeps += busy * q
             self.total_lane_sweeps += self.pool.nlanes * q
             if self.metrics is not None:
@@ -460,26 +842,49 @@ class ChainServer:
                     len(self.queue))
                 self.metrics.counter("serve_sweeps_total").inc(busy * q)
             for tid in finished:
-                slot, handle, spool = self._running.pop(tid)
-                self._release(slot)
-                self._finalize(slot, handle, spool)
+                t = self._running.pop(tid)
+                self._release(t.slot)
+                if t.slot.failed:
+                    self._finalize_failed(t)
+                else:
+                    try:
+                        self._finalize(t)
+                    except Exception as e:  # noqa: BLE001
+                        if not self.supervise:
+                            raise
+                        self._note_fault(t, "finalize", e)
+                        self._finalize_failed(t)
             self._drain_ms.append((time.monotonic() - t0) * 1e3)
             return bool(self._running) or len(self.queue) > 0
 
     def _accumulate_tele(self, handle: TenantHandle, slot: TenantSlot,
                          tele) -> None:
         """Fold one quantum's telemetry pytree (lane axis) into the
-        tenant's running tele_* stats (mean accept rates, divergence
-        counts — the ChainResult.stats convention)."""
+        tenant's running tele_* stats with the SOLO aggregation
+        semantics (obs/telemetry.TelemetryAccumulator): sweep counts
+        and non-finite counters sum, acceptance rates are per-sweep
+        means, the sticky diverged flag ORs, the log-posterior keeps
+        the latest chunk's value — so obs/health.chain_health reads
+        serving stats exactly like solo stats."""
         lanes = slot.chain_lanes
         sub = jax.tree.map(lambda a: np.asarray(a)[lanes], tele)
         d = handle._tele_stats
-        n = handle.chunks_streamed
-        for name, val in zip(type(sub)._fields, sub):
-            key = f"tele_{name}"
-            prev = d.get(key)
-            d[key] = (val if prev is None
-                      else (prev * n + val) / (n + 1))
+        q = int(np.asarray(sub.sweeps).flat[0])
+        prev = int(d.get("tele_sweeps", 0))
+        total = max(prev + q, 1)
+        for blk, val in (("white", sub.accept_white),
+                         ("hyper", sub.accept_hyper)):
+            key = f"tele_accept_{blk}"
+            prev_rate = np.asarray(d.get(key, np.zeros(len(lanes))),
+                                   np.float64)
+            d[key] = ((prev_rate * prev + np.asarray(val, np.float64))
+                      / total).astype(np.float32)
+        d["tele_sweeps"] = np.asarray(prev + q)
+        d["tele_nonfinite"] = (np.asarray(sub.nonfinite, np.int64)
+                               + d.get("tele_nonfinite", 0))
+        d["tele_diverged"] = (np.asarray(sub.diverged, bool)
+                              | d.get("tele_diverged", False))
+        d["tele_logpost"] = np.asarray(sub.logpost, np.float32)
 
     def _drain_tenant(self, slot: TenantSlot, handle: TenantHandle,
                       spool, wire: list, tele, sweep_end: int,
@@ -497,6 +902,9 @@ class ChainServer:
                    if need_mat else None)
         if spool is not None:
             spool.append(records, state_fn(), sweep_end)
+            if self._manifest is not None:
+                self._manifest.record_checkpoint(slot.tenant_id,
+                                                 sweep_end)
         else:
             handle._append_wire(self.pool.tenant_wire(wire, slot))
         handle._stream(sweep_end,
@@ -516,8 +924,7 @@ class ChainServer:
             self.metrics.emit("evict", tenant=slot.tenant_id,
                               sweeps=slot.done_sweeps)
 
-    def _finalize(self, slot: TenantSlot, handle: TenantHandle,
-                  spool) -> None:
+    def _finalize(self, t: _Tenant) -> None:
         """Deliver a finished tenant's result (runs on whichever
         thread drained the tenant's FINAL quantum, after its records
         were flushed). In-memory tenants finish LAZILY: the wire
@@ -525,6 +932,19 @@ class ChainServer:
         concatenation run on the first ``result()`` call, on the
         caller's thread — result DECODE is client work and must not
         steal serving cycles from the drain worker."""
+        slot, handle, spool = t.slot, t.handle, t.spool
+        handle.health = self._tenant_health(t)
+        health = handle.health
+        if self._manifest is not None:
+            self._manifest.record_done(slot.tenant_id, "done",
+                                       slot.done_sweeps)
+        if self.metrics is not None and health is not None:
+            self.metrics.emit(
+                "tenant_health", tenant=slot.tenant_id,
+                n_ok=health["n_ok"], n_diverged=health["n_diverged"],
+                n_stuck=health["n_stuck"], n_dead=health["n_dead"],
+                n_quarantined=health["n_quarantined"],
+                n_reinits=health["n_reinits"])
         if spool is not None:
             spool.close()
             from gibbs_student_t_tpu.utils.spool import load_spool
@@ -532,11 +952,13 @@ class ChainServer:
             res = load_spool(handle.request.spool_dir)
             res.stats.update(handle._tele_stats)
             res.stats["n_toa"] = np.asarray([slot.n_real])
+            if health is not None:
+                res.stats["health"] = health
             handle._finish(res)
             return
         pool = self.pool
 
-        def build(slot=slot, handle=handle):
+        def build(slot=slot, handle=handle, health=health):
             # one concatenate of the narrow wire chunks (rows axis),
             # then ONE materialization pass for the whole tenant
             cols = pool.materialize_tenant(
@@ -546,6 +968,8 @@ class ChainServer:
             res = pool.template._to_result(cols)
             res.stats.update(handle._tele_stats)
             res.stats["n_toa"] = np.asarray([slot.n_real])
+            if health is not None:
+                res.stats["health"] = health
             return res
 
         handle._finish_lazy(build)
@@ -568,19 +992,80 @@ class ChainServer:
 
     def _stage_worker(self) -> None:
         while not self._workers_stop.is_set():
+            h = self._take_for_staging()
+            if h is None:
+                time.sleep(0.005)
+                continue
             try:
-                h = self._take_for_staging()
-                if h is None:
-                    time.sleep(0.005)
-                    continue
-                prep = self._prepare(h)
+                prep = self._prepare(h)   # rejects per-tenant Exceptions
+            except BaseException as e:
+                # an interpreter exit or an injected worker death:
+                # balance the staging counter and resolve the handle
+                # before the thread dies (the supervisor may restart
+                # us; the handle must never hang either way)
                 with self._prep_lock:
                     self._staging_n -= 1
-                    if prep is not None:
-                        self._prepared.append(prep)
-            except BaseException as e:  # noqa: BLE001
-                self._worker_error = e
-                return
+                if not h.done():
+                    h._fail(f"staging worker died: "
+                            f"{type(e).__name__}: {e}")
+                if isinstance(e, Exception):
+                    self._worker_error = e
+                    self._worker_error_label = (
+                        f"staging tenant {self._tenant_key(h)!r}")
+                if isinstance(e, _faults.WorkerDeath):
+                    return  # injected death: die quietly, no traceback
+                raise  # genuine interpreter exit (KeyboardInterrupt &c)
+            with self._prep_lock:
+                self._staging_n -= 1
+                if prep is not None:
+                    self._prepared.append(prep)
+
+    def _drain_bundle(self, b: _Bundle) -> None:
+        """Flush one quantum's drain bundle, per-tenant. A tenant-
+        attributable Exception (callback raise, spool IO error) is
+        contained to that tenant under supervision; re-raised under
+        the fail-fast arm. Non-Exception escapes (worker death) leave
+        ``b.idx`` at the undrained tail for ``_abort_undrained``."""
+        wire = (self.pool.wire_host(b.recs)
+                if b.recs is not None else None)
+        tele = (jax.device_get(b.tl) if b.tl is not None else None)
+        while b.idx < len(b.entries):
+            slot, handle, spool, sweep_end, final, drained = \
+                b.entries[b.idx]
+            try:
+                _faults.fire("drain_death",
+                             tenant=self._tenant_key(handle))
+                if drained and not slot.failed:
+                    self._drain_tenant(
+                        slot, handle, spool, wire, tele, sweep_end,
+                        state_fn=lambda s=slot:
+                        self.pool.tenant_state_from(b.snap, s))
+                if final:
+                    if slot.failed:
+                        self._finalize_failed(
+                            _Tenant(slot, handle, spool))
+                    else:
+                        self._finalize(_Tenant(slot, handle, spool))
+            except Exception as e:  # noqa: BLE001
+                if not self.supervise:
+                    raise
+                t = _Tenant(slot, handle, spool)
+                self._note_fault(t, "drain", e)
+                if final:
+                    self._finalize_failed(t)
+            b.idx += 1
+
+    def _abort_undrained(self, b: _Bundle, exc: BaseException) -> None:
+        """A worker died mid-bundle: every entry from the in-flight one
+        on has lost its quantum's records — fail those tenants (their
+        prefix up to the previous quantum stands) so no handle hangs.
+        Tenants drained earlier in the bundle are untouched."""
+        for slot, handle, spool, sweep_end, final, drained in \
+                b.entries[b.idx:]:
+            t = _Tenant(slot, handle, spool)
+            self._note_fault(t, "worker", exc)
+            if final:
+                self._finalize_failed(t)
 
     def _drain_worker(self) -> None:
         while True:
@@ -590,21 +1075,28 @@ class ChainServer:
                 return
             try:
                 t0 = time.monotonic()
-                recs, tl, snap, entries = item
-                wire = self.pool.wire_host(recs)
-                tele = (jax.device_get(tl) if tl is not None else None)
-                for slot, handle, spool, sweep_end, final in entries:
-                    self._drain_tenant(
-                        slot, handle, spool, wire, tele, sweep_end,
-                        state_fn=lambda s=slot:
-                        self.pool.tenant_state_from(snap, s))
-                    if final:
-                        self._finalize(slot, handle, spool)
+                self._drain_bundle(item)
                 self._drain_ms.append((time.monotonic() - t0) * 1e3)
-            except BaseException as e:  # noqa: BLE001
+            except Exception as e:  # noqa: BLE001
+                # fail-fast arm (or a bundle-scope failure): latch as
+                # a pool error, naming the tenant whose drain raised
+                label = ""
+                if item.idx < len(item.entries):
+                    label = (f"draining tenant "
+                             f"{self._tenant_key(item.entries[item.idx][1])!r}")
                 self._worker_error = e
-            finally:
+                self._worker_error_label = label
+            except BaseException as e:
+                # a genuine interpreter exit (KeyboardInterrupt /
+                # SystemExit) or an injected worker death: resolve the
+                # undrained tail, then let the thread die — the
+                # supervisor decides whether a replacement spawns
+                self._abort_undrained(item, e)
                 self._drainq.task_done()
+                if isinstance(e, _faults.WorkerDeath):
+                    return  # injected death: die quietly, no traceback
+                raise
+            self._drainq.task_done()
 
     def _ensure_workers(self) -> None:
         if self._drain_thread is None or not self._drain_thread.is_alive():
@@ -619,11 +1111,44 @@ class ChainServer:
                 daemon=True)
             self._stage_thread.start()
 
+    def _supervise_workers(self) -> None:
+        """Restart dead workers with capped exponential backoff; a
+        worker past its restart budget is a pool failure (the crash-
+        looping escape hatch — endless restarts would silently fail
+        every tenant one bundle at a time)."""
+        now = time.monotonic()
+        for kind, th in (("drain", self._drain_thread),
+                         ("stage", self._stage_thread)):
+            if th is not None and th.is_alive():
+                continue
+            st = self._restarts[kind]
+            if st["n"] >= self.MAX_WORKER_RESTARTS:
+                self._pool_failure(
+                    RuntimeError(
+                        f"{kind} worker crash-looping "
+                        f"({st['n']} restarts)"),
+                    label=f"{kind} worker crash-looping")
+            if now < st["next_t"]:
+                continue
+            st["n"] += 1
+            st["next_t"] = now + min(0.05 * 2 ** st["n"], 1.0)
+            self._fault_counts["worker_restarts"] += 1
+            if self.metrics is not None:
+                self.metrics.counter("serve_worker_restarts").inc()
+                self.metrics.emit("worker_restart", worker=kind,
+                                  n=st["n"])
+            if kind == "drain":
+                self._drain_thread = None
+            else:
+                self._stage_thread = None
+            self._ensure_workers()
+
     def _raise_worker_error(self) -> None:
         if self._worker_error is not None:
             err, self._worker_error = self._worker_error, None
-            raise RuntimeError(
-                "serve worker thread failed") from err
+            label, self._worker_error_label = \
+                self._worker_error_label, ""
+            self._pool_failure(err, label=label)
 
     def _dispatch_one(self) -> None:
         """One pipelined quantum boundary (caller holds ``_lock``):
@@ -634,25 +1159,39 @@ class ChainServer:
         if self._last_dispatch_t is not None:
             self._gap_ms.append(
                 (time.monotonic() - self._last_dispatch_t) * 1e3)
-        need_snap = any(sp is not None
-                        for _, _, sp in self._running.values())
+        self._boundary_faults()
+        need_snap = any(t.spool is not None
+                        for t in self._running.values())
         recs, tl, snap = self.pool.dispatch_quantum(snapshot=need_snap)
+        self._last_tl = tl
+        self._last_tl_tids = set(self._running)
         self._last_dispatch_t = time.monotonic()
         q = self.pool.quantum
         entries = []
+        # boundary-failed tenants (divergence policy, drain faults)
+        # get finalize-only entries FIRST: their last real drain rode
+        # an earlier bundle, so drain order delivers their failure
+        # after their records
+        for t in self._boundary_failed:
+            entries.append((t.slot, t.handle, t.spool,
+                            t.slot.start_sweep + t.slot.done_sweeps,
+                            True, False))
+        self._boundary_failed.clear()
         finished = []
         busy = 0
-        for tid, (slot, handle, spool) in self._running.items():
+        for tid, t in self._running.items():
+            slot = t.slot
             slot.done_sweeps += q
             busy += slot.nchains
-            final = slot.remaining <= 0 or slot.cancelled
-            entries.append((slot, handle, spool,
-                            slot.start_sweep + slot.done_sweeps, final))
+            final = slot.remaining <= 0 or slot.cancelled or slot.failed
+            entries.append((slot, t.handle, t.spool,
+                            slot.start_sweep + slot.done_sweeps, final,
+                            True))
             if final:
                 finished.append(tid)
         for tid in finished:
-            slot, _, _ = self._running.pop(tid)
-            self._release(slot)   # finalize happens at drain time
+            t = self._running.pop(tid)
+            self._release(t.slot)   # finalize happens at drain time
         self.quanta += 1
         self.busy_lane_sweeps += busy * q
         self.total_lane_sweeps += self.pool.nlanes * q
@@ -661,7 +1200,7 @@ class ChainServer:
                 busy / self.pool.nlanes)
             self.metrics.gauge("serve_queue_depth").set(len(self.queue))
             self.metrics.counter("serve_sweeps_total").inc(busy * q)
-        self._drainq.put((recs, tl, snap, entries))
+        self._drainq.put(_Bundle(recs, tl, snap, entries))
 
     def _pipeline_idle(self) -> bool:
         """Nothing running, queued, staged or pending drain — the
@@ -681,7 +1220,11 @@ class ChainServer:
         self._ensure_workers()
         while not self._stop.is_set():
             self._raise_worker_error()
+            if self.supervise:
+                self._supervise_workers()
             with self._lock:
+                boundary_failed = self._fold_lane_health()
+                self._boundary_failed.extend(boundary_failed)
                 t0 = time.monotonic()
                 self._apply_admissions()
                 self._admit_apply_ms.append(
@@ -689,6 +1232,16 @@ class ChainServer:
                 have_work = bool(self._running)
                 if have_work:
                     self._dispatch_one()
+                elif self._boundary_failed:
+                    # nothing left to dispatch, but boundary failures
+                    # still owe their drain-ordered finalize
+                    entries = [
+                        (t.slot, t.handle, t.spool,
+                         t.slot.start_sweep + t.slot.done_sweeps,
+                         True, False)
+                        for t in self._boundary_failed]
+                    self._boundary_failed.clear()
+                    self._drainq.put(_Bundle(None, None, None, entries))
             if on_quantum is not None:
                 on_quantum(self)
             if not have_work:
@@ -697,8 +1250,35 @@ class ChainServer:
                 time.sleep(poll_s)
         # flush every pending drain bundle before handing back — the
         # caller may immediately read results or tear the server down
-        self._drainq.join()
+        self._flush_drains()
         self._raise_worker_error()
+
+    def _flush_drains(self) -> None:
+        """Drain-queue flush that cannot hang: while a live worker
+        owns the queue this is a join; if the worker died (and the
+        supervisor is not running any more), the remaining bundles are
+        processed inline on the calling thread — deterministic
+        delivery beats thread ownership."""
+        while self._drainq.unfinished_tasks:
+            th = self._drain_thread
+            if th is not None and th.is_alive():
+                time.sleep(0.002)
+                continue
+            try:
+                item = self._drainq.get_nowait()
+            except _queue.Empty:
+                break
+            if item is None:
+                self._drainq.task_done()
+                continue
+            try:
+                self._drain_bundle(item)
+            except Exception as e:  # noqa: BLE001
+                self._worker_error = e
+                self._worker_error_label = "inline drain flush"
+            except BaseException as e:
+                self._abort_undrained(item, e)
+            self._drainq.task_done()
 
     # ------------------------------------------------------------------
     # drivers
@@ -734,10 +1314,18 @@ class ChainServer:
         self._thread.start()
 
     def close(self) -> None:
+        """Stop the server deterministically: the in-flight quantum's
+        drains flush (no lost spool checkpoints), the workers join,
+        and every handle the server still owns resolves — queued /
+        staged tenants as rejected, running tenants as a TenantError
+        carrying the drained prefix. No hung threads, no handle left
+        blocking a caller forever."""
         self._stop.set()
         if self._thread is not None:
             self._thread.join()
             self._thread = None
+        # flush pending drain bundles while the worker is still up
+        self._flush_drains()
         # stop the executor workers (idempotent; threads are lazy)
         self._workers_stop.set()
         if self._drain_thread is not None and self._drain_thread.is_alive():
@@ -747,6 +1335,69 @@ class ChainServer:
         if self._stage_thread is not None and self._stage_thread.is_alive():
             self._stage_thread.join()
         self._stage_thread = None
+        self._fail_all_outstanding("server closed")
+
+    # ------------------------------------------------------------------
+    # crash recovery
+    # ------------------------------------------------------------------
+
+    @classmethod
+    def recover(cls, manifest_dir: str, **overrides):
+        """Rebuild a server from its crash-recovery manifest and
+        resubmit every outstanding spooled tenant from its last spool
+        checkpoint. Returns ``(server, handles)`` where ``handles``
+        maps each recovered tenant's request name (or spool_dir) to
+        its new handle; drive ``server.run()`` to completion as usual.
+        Resumed chains are bitwise identical to an uninterrupted run
+        from the same checkpoint (the spool resume contract). Tenants
+        that were admitted without a spool died with the process —
+        they are listed on ``server.lost_tenants``, never silently
+        dropped. ``overrides`` adjust constructor kwargs (the pool
+        geometry defaults to the manifest's record)."""
+        from gibbs_student_t_tpu.serve.manifest import (
+            load_server_state,
+            load_tenant_model,
+            outstanding_tenants,
+        )
+        from gibbs_student_t_tpu.utils.spool import (
+            load_spool,
+            load_spool_state,
+        )
+
+        template_ma, config, kw = load_server_state(manifest_dir)
+        kw.update(overrides)
+        recoverable, lost = outstanding_tenants(manifest_dir)
+        srv = cls(template_ma, config, manifest_dir=manifest_dir, **kw)
+        srv.lost_tenants = lost
+        handles: Dict[object, TenantHandle] = {}
+        for rec in recoverable:
+            key = rec.get("name") or rec["spool_dir"]
+            ma = load_tenant_model(manifest_dir, rec)
+            try:
+                state, next_sweep, seed = load_spool_state(
+                    rec["spool_dir"])
+            except (OSError, KeyError):
+                # died before the first checkpoint: restart from scratch
+                state, next_sweep, seed = None, rec["start_sweep"], \
+                    rec["seed"]
+            done = next_sweep - rec["start_sweep"]
+            remaining = rec["niter"] - done
+            if remaining <= 0:
+                # fully served and checkpointed; only the finalize was
+                # lost — deliver the spooled result directly
+                h = TenantHandle(-1, TenantRequest(
+                    ma=ma, niter=rec["niter"], nchains=rec["nchains"],
+                    seed=rec["seed"], spool_dir=rec["spool_dir"],
+                    name=rec.get("name")))
+                h._finish(load_spool(rec["spool_dir"]))
+                handles[key] = h
+                continue
+            handles[key] = srv.submit(TenantRequest(
+                ma=ma, niter=remaining, nchains=rec["nchains"],
+                seed=rec["seed"], state=state, start_sweep=next_sweep,
+                spool_dir=rec["spool_dir"], name=rec.get("name"),
+                on_divergence=rec.get("on_divergence") or "none"))
+        return srv, handles
 
     # ------------------------------------------------------------------
     # summary
@@ -758,7 +1409,8 @@ class ChainServer:
         lane-sweeps advanced; ``admission_ms`` the mean admission
         latency; ``host_ms`` the per-quantum host-time breakdown
         (admission-apply / drain / dispatch-gap percentiles, ms) that
-        attributes the pipelining win."""
+        attributes the pipelining win; ``faults`` the containment
+        counters (docs/SERVING.md "Failure semantics")."""
         occ = (self.busy_lane_sweeps / self.total_lane_sweeps
                if self.total_lane_sweeps else 0.0)
         return {
@@ -768,6 +1420,7 @@ class ChainServer:
             "occupancy": occ,
             "busy_chain_sweeps": self.busy_lane_sweeps,
             "pipeline": bool(self.pipeline),
+            "supervise": bool(self.supervise),
             "admission_ms": (float(np.mean(self._admission_ms))
                              if self._admission_ms else None),
             "admission_ms_max": (float(np.max(self._admission_ms))
@@ -777,4 +1430,5 @@ class ChainServer:
                 "drain": _percentiles(self._drain_ms),
                 "dispatch_gap": _percentiles(self._gap_ms),
             },
+            "faults": dict(self._fault_counts),
         }

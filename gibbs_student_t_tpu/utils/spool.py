@@ -33,7 +33,8 @@ class ChainSpool:
                  resume_at: Optional[int] = None,
                  record_mode: Optional[str] = None,
                  record_thin: int = 1,
-                 extra_meta: Optional[Dict] = None):
+                 extra_meta: Optional[Dict] = None,
+                 fault_key=None):
         """``resume=True`` appends to an existing spool directory (after a
         kill: ``load_spool_state`` -> ``sample(state=..., start_sweep=...,
         spool_dir=...)``) instead of truncating it. ``resume_at`` is the
@@ -41,7 +42,12 @@ class ChainSpool:
         from a crash mid-append) are truncated away before appending.
         ``record_mode`` is persisted in ``meta.json`` so a spooled run's
         transport quantization (record="compact") stays discoverable; a
-        resume with a different mode is rejected."""
+        resume with a different mode is rejected. ``fault_key`` is the
+        serve fault-injection identity (serve/faults.py): when set, the
+        ``spool_io`` / ``kill_before_checkpoint`` /
+        ``kill_after_checkpoint`` injection points arm inside
+        :meth:`append` — deterministic stand-ins for a disk-full error
+        and a process kill straddling the checkpoint write."""
         from gibbs_student_t_tpu import native
 
         if not native.available():
@@ -60,6 +66,7 @@ class ChainSpool:
         # JSON-able run-level metadata (e.g. the ensemble's per-pulsar
         # real TOA counts) replayed into ChainResult.stats by load_spool
         self.extra_meta = extra_meta
+        self.fault_key = fault_key
         self._writers: Optional[Dict[str, object]] = None
         os.makedirs(path, exist_ok=True)
 
@@ -70,6 +77,10 @@ class ChainSpool:
         ``run_stats`` (e.g. the running re-init count) is persisted
         alongside the checkpoint so resumed runs keep cumulative
         counters."""
+        if self.fault_key is not None:
+            from gibbs_student_t_tpu.serve import faults as _faults
+
+            _faults.fire("spool_io", tenant=self.fault_key)
         if self._writers is None:
             meta_path = os.path.join(self.path, "meta.json")
             chunk_len = len(next(iter(records.values())))
@@ -123,8 +134,21 @@ class ChainSpool:
         for f, a in records.items():
             self._writers[f].append(a)
             self._writers[f].flush()
+        if self.fault_key is not None:
+            from gibbs_student_t_tpu.serve import faults as _faults
+
+            # records are flushed but the checkpoint is NOT yet: a kill
+            # here leaves orphan rows past the last checkpoint, which
+            # resume truncates (the crash-recovery "before" arm)
+            _faults.fire("kill_before_checkpoint", tenant=self.fault_key)
         save_checkpoint(os.path.join(self.path, "state.npz"), state,
                         sweep, self.seed)
+        if self.fault_key is not None:
+            from gibbs_student_t_tpu.serve import faults as _faults
+
+            # checkpoint written: a kill here resumes from THIS quantum
+            # boundary (the "after" arm)
+            _faults.fire("kill_after_checkpoint", tenant=self.fault_key)
         if run_stats is not None:
             tmp = os.path.join(self.path, "run_stats.json.tmp")
             with open(tmp, "w") as fh:

@@ -32,10 +32,12 @@ def test_adapt_cov_requires_adapt_until():
         _cfg(mh=dataclasses.replace(_cfg().mh, adapt_cov=True))
 
 
+@pytest.mark.slow
 def test_ensemble_adapt_cov_per_pulsar():
     """Ensembles adapt each pulsar's proposal covariance independently
     (the single-model update vmapped over the pulsar axis), and the
-    factors freeze with the scales."""
+    factors freeze with the scales. (slow: a ~20 s ensemble adaptation
+    run — round-12 tier-1 budget reclaim.)"""
     from gibbs_student_t_tpu.parallel import EnsembleGibbs
 
     mas = [make_demo_model_arrays(n=24, components=4, seed=10 + i)

@@ -190,13 +190,16 @@ def test_sample_until_min_ess_gates_stopping(ma):
     assert res2.chain.shape[0] < 600
 
 
+@pytest.mark.slow
 def test_adaptive_mh_moves_acceptance_toward_target(ma):
     """Opt-in Robbins-Monro jump-scale adaptation: the reference's fixed
     table sits near 0.95 white acceptance (too timid for mixing); with
     adapt_until set, post-adaptation acceptance must land near
     target_accept and closer to it than the fixed-scale run, while the
     posterior stays the same (adaptation freezes -> valid MH after).
-    Default configs (adapt_until=0) keep the reference's behavior."""
+    Default configs (adapt_until=0) keep the reference's behavior.
+    (slow: a ~33 s statistical sweep — round-12 tier-1 budget reclaim;
+    the bitwise adaptation pins stay tier-1.)"""
     import dataclasses
 
     from jax import random
@@ -388,10 +391,13 @@ def test_posterior_gate_mtm(ma):
     _posterior_gate(ma, cfg)
 
 
+@pytest.mark.slow
 def test_mtm_accepts_more_and_matches_default_off(ma, monkeypatch):
     """MTM raises per-step acceptance (K tries per step), composes with
     vmap/chunking, and mtm_tries=0 never routes through the MTM block
     (the dispatch must keep the reference's single-try path).
+    (slow: ~17 s of statistical acceptance sweeps — round-12 tier-1
+    budget reclaim.)
 
     Deflaked (ISSUE 3): at the reference jump scale the white block
     accepts ~0.92 — saturated, so the K-try gain drowned in seed noise

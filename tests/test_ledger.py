@@ -54,6 +54,44 @@ def test_append_and_read_round_trip(tmp_path):
     assert ledger_mod.last_record("nope", path) is None
 
 
+def test_append_nonfatal_under_transient_io_errors(tmp_path,
+                                                   monkeypatch):
+    """A metrics write must never kill the run it describes: one
+    bounded retry on an EINTR/ENOSPC-class failure (a fresh fd), then
+    warn-and-continue. Pinned with an injected failing ``os.write``."""
+    import errno
+    import warnings
+
+    path = str(tmp_path / "ledger.jsonl")
+    real_write = os.write
+    fails = {"n": 0}
+
+    def flaky_write(fd, data, _fail_times=1):
+        if fails["n"] < fails["budget"]:
+            fails["n"] += 1
+            raise OSError(errno.ENOSPC, "No space left on device")
+        return real_write(fd, data)
+
+    # one transient failure: the retry lands the record
+    fails.update(n=0, budget=1)
+    monkeypatch.setattr(os, "write", flaky_write)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # the retry must NOT warn
+        ledger_mod.append_record(
+            ledger_mod.make_record("bench", {"value": 1}), path)
+    monkeypatch.setattr(os, "write", real_write)
+    recs = ledger_mod.read_ledger(path)
+    assert len(recs) == 1 and recs[0]["metrics"]["value"] == 1
+    # a persistent failure: warn-and-continue, record dropped, NO raise
+    fails.update(n=0, budget=99)
+    monkeypatch.setattr(os, "write", flaky_write)
+    with pytest.warns(RuntimeWarning, match="failed twice"):
+        ledger_mod.append_record(
+            ledger_mod.make_record("bench", {"value": 2}), path)
+    monkeypatch.setattr(os, "write", real_write)
+    assert len(ledger_mod.read_ledger(path)) == 1   # still just one
+
+
 def test_read_tolerates_torn_and_garbage_lines(tmp_path):
     path = str(tmp_path / "ledger.jsonl")
     ledger_mod.append_record(

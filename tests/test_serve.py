@@ -356,6 +356,63 @@ def test_cancel_freezes_at_next_boundary(demo):
     _results_equal(ref2_h.result(), other.result())
 
 
+def test_close_with_inflight_work(demo, tmp_path):
+    """close() mid-workload is deterministic: the in-flight quantum's
+    drains flush (a spooled tenant's checkpoint lands on a quantum
+    boundary — nothing lost), queued tenants reject, running tenants
+    fail with their drained prefix, and no serve thread or handle is
+    left hanging."""
+    import time as _time
+
+    from gibbs_student_t_tpu import native as native_mod
+    from gibbs_student_t_tpu.serve.scheduler import TenantError
+
+    ma, cfg = demo
+    spooled = native_mod.available()
+    srv = ChainServer(ma, cfg, nlanes=32, quantum=5, record="full",
+                      max_queue=64)
+    kwargs = ({"spool_dir": str(tmp_path / "s0")} if spooled else {})
+    hs = [srv.submit(TenantRequest(ma=ma, niter=500, nchains=16,
+                                   seed=20 + i, name=f"t{i}",
+                                   **(kwargs if i == 0 else {})))
+          for i in range(6)]
+    srv.start()
+    # wait until real progress exists (condition-poll, not a timed
+    # sleep: close() must be deterministic whenever it lands)
+    deadline = _time.monotonic() + 120
+    while hs[0].sweeps_done < 10 and _time.monotonic() < deadline:
+        _time.sleep(0.01)
+    assert hs[0].sweeps_done >= 10
+    srv.close()
+    for h in hs:
+        assert h.done(), "handle left hanging after close()"
+        with pytest.raises((TenantError, RuntimeError)):
+            h.result(timeout=0)
+    # the two resident tenants failed with a drained prefix; the
+    # queued rest were rejected before admission
+    ran = [h for h in hs if h.status == "failed"]
+    assert len(ran) == 2
+    for h in ran:
+        err = None
+        try:
+            h.result(timeout=0)
+        except TenantError as e:
+            err = e
+        assert err is not None and err.where == "close"
+        assert err.partial is not None
+        assert err.partial.chain.shape[0] == h.sweeps_done
+    if spooled:
+        from gibbs_student_t_tpu.utils.spool import load_spool_state
+
+        state, next_sweep, seed = load_spool_state(str(tmp_path / "s0"))
+        assert next_sweep % 5 == 0 and next_sweep >= 10
+        assert next_sweep == hs[0].sweeps_done
+    # THIS server's threads are joined and gone (other tests' servers
+    # may leave daemon workers alive — only ours are in scope here)
+    assert srv._thread is None
+    assert srv._drain_thread is None and srv._stage_thread is None
+
+
 def test_serve_pipeline_gate_validation(monkeypatch, demo):
     from gibbs_student_t_tpu.serve.server import serve_pipeline_env
 

@@ -1,0 +1,464 @@
+"""Deterministic fault-injection tests for the serving stack
+(docs/SERVING.md "Failure semantics").
+
+The containment contract pinned here: under every injected
+tenant-attributable fault — callback raise, spool IO error, drain
+worker death, forced lane NaN — the victim tenant fails (or
+quarantines/reinits, per policy) with a structured cause, while every
+surviving co-resident tenant's results are BITWISE equal to the same
+workload with no injection. ``GST_SERVE_SUPERVISE=0`` preserves the
+historical fail-fast behavior. Crash recovery resumes spooled tenants
+from their last checkpoint bitwise (the process-kill arms are in the
+slow tier; the in-process manifest-recovery pin runs in tier-1).
+
+Everything is seeded and sync-free: injection points fire on exact
+traversal counts of deterministic serving orders (serve/faults.py),
+never on timers.
+
+Budget note (tier-1, ROADMAP): one 32-lane server run ≈ 2-4 s; the
+shared reference results come from ONE module-scoped server run.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tests.conftest import make_demo_pta
+from gibbs_student_t_tpu.config import GibbsConfig
+from gibbs_student_t_tpu.serve import (
+    ChainServer,
+    TenantError,
+    TenantRequest,
+    faults,
+)
+
+pytestmark = [pytest.mark.serve, pytest.mark.chaos]
+
+EXACT_FIELDS = ("chain", "zchain", "thetachain", "dfchain")
+ROUNDOFF_FIELDS = ("bchain", "alphachain", "poutchain")
+ALL_FIELDS = EXACT_FIELDS + ROUNDOFF_FIELDS
+
+
+def _native_available() -> bool:
+    from gibbs_student_t_tpu import native
+
+    return native.available()
+
+
+def _bitwise(res, ref, fields=ALL_FIELDS):
+    for f in fields:
+        assert np.array_equal(np.asarray(getattr(res, f)),
+                              np.asarray(getattr(ref, f))), f
+
+
+@pytest.fixture(scope="module")
+def demo():
+    pta = make_demo_pta()
+    return pta.frozen(0), GibbsConfig(model="mixture")
+
+
+@pytest.fixture(scope="module")
+def refs(demo, tmp_path_factory):
+    """Fault-free reference results for the standard victim/survivor
+    tenants (seeds 1/2, niter 20) — ONE server run shared by every
+    containment pin in this module."""
+    ma, cfg = demo
+    srv = ChainServer(ma, cfg, nlanes=32, quantum=5, record="full")
+    hA = srv.submit(TenantRequest(ma=ma, niter=20, nchains=16, seed=1,
+                                  name="A"))
+    hB = srv.submit(TenantRequest(ma=ma, niter=20, nchains=16, seed=2,
+                                  name="B"))
+    hS = None
+    spool_ref = str(tmp_path_factory.mktemp("refs") / "spool_ref")
+    if _native_available():
+        hS = srv.submit(TenantRequest(ma=ma, niter=20, nchains=16,
+                                      seed=3, name="S",
+                                      spool_dir=spool_ref))
+    srv.run()
+    srv.close()
+    return {
+        "A": hA.result(), "B": hB.result(),
+        "S": hS.result() if hS is not None else None,
+        "health_A": hA.health,
+    }
+
+
+def _two_tenant_run(ma, cfg, a_kwargs=None, b_kwargs=None, **srv_kwargs):
+    """One victim+survivor workload on a fresh server; returns
+    (handle_A, handle_B, server-summary)."""
+    srv = ChainServer(ma, cfg, nlanes=32, quantum=5, record="full",
+                      **srv_kwargs)
+    hA = srv.submit(TenantRequest(ma=ma, niter=20, nchains=16, seed=1,
+                                  name="A", **(a_kwargs or {})))
+    hB = srv.submit(TenantRequest(ma=ma, niter=20, nchains=16, seed=2,
+                                  name="B", **(b_kwargs or {})))
+    srv.run()
+    s = srv.summary()
+    srv.close()
+    return hA, hB, s
+
+
+# ---------------------------------------------------------------------------
+# the harness itself
+# ---------------------------------------------------------------------------
+
+def test_fault_spec_validation_and_counting():
+    with pytest.raises(ValueError, match="unknown fault point"):
+        faults.FaultSpec("banana")
+    with pytest.raises(ValueError, match="action"):
+        faults.FaultSpec("callback", action="explode")
+    with pytest.raises(ValueError, match="exc"):
+        faults.FaultSpec("callback", exc="KeyboardInterrupt")
+    with pytest.raises(ValueError, match="after"):
+        faults.FaultSpec("callback", after=-1)
+    # deterministic counting: after=1, times=1 fires exactly on the
+    # second matching traversal, tenant-scoped
+    with faults.inject(faults.FaultSpec("callback", tenant="t",
+                                        after=1)):
+        faults.fire("callback", tenant="other")
+        faults.fire("callback", tenant="t")           # after-skip
+        with pytest.raises(RuntimeError, match="injected fault"):
+            faults.fire("callback", tenant="t")       # fires
+        faults.fire("callback", tenant="t")           # disarmed
+        assert faults.fired_counts() == {("callback", "t"): 1}
+    # disarmed after the context
+    faults.fire("callback", tenant="t")
+
+
+def test_seeded_plan_is_deterministic():
+    tenants = [f"tenant{i}" for i in range(8)]
+    p1 = faults.seeded_plan(7, tenants)
+    p2 = faults.seeded_plan(7, tenants)
+    assert [(s.point, s.tenant, s.after) for s in p1] \
+        == [(s.point, s.tenant, s.after) for s in p2]
+    p3 = faults.seeded_plan(8, tenants)
+    assert [(s.point, s.tenant, s.after) for s in p1] \
+        != [(s.point, s.tenant, s.after) for s in p3]
+
+
+# ---------------------------------------------------------------------------
+# tenant-scoped containment pins
+# ---------------------------------------------------------------------------
+
+def test_callback_fault_isolates_tenant(demo, refs):
+    """A tenant's on_chunk callback raising fails ONLY that tenant:
+    the handle raises a structured TenantError whose partial results
+    are a bitwise prefix, and the co-resident tenant is bitwise equal
+    to the fault-free run."""
+    ma, cfg = demo
+    calls = {"n": 0}
+
+    def bad_cb(h, sweep_end, records):
+        calls["n"] += 1
+        if calls["n"] >= 2:
+            raise ValueError("tenant callback exploded")
+
+    hA, hB, s = _two_tenant_run(ma, cfg, a_kwargs={"on_chunk": bad_cb})
+    assert hA.status == "failed"
+    with pytest.raises(TenantError) as ei:
+        hA.result(timeout=0)
+    err = ei.value
+    assert err.tenant_id == hA.tenant_id and err.where == "drain"
+    assert isinstance(err.cause, ValueError)
+    rows = err.partial.chain.shape[0]
+    assert 0 < rows < 20
+    for f in ALL_FIELDS:
+        assert np.array_equal(np.asarray(getattr(err.partial, f)),
+                              np.asarray(getattr(refs["A"], f))[:rows]), f
+    _bitwise(hB.result(), refs["B"])
+    assert s["faults"]["tenant_failures"] == 1
+    assert s["faults"]["pool_failures"] == 0
+
+
+@pytest.mark.skipif(not _native_available(),
+                    reason="spooling needs the native library")
+def test_spool_io_fault_isolates_tenant(demo, refs, tmp_path):
+    """A spool write error (injected OSError at the 2nd append) fails
+    only the spooled tenant; its partial result is the spool's
+    readable prefix, bitwise; the survivor is untouched."""
+    ma, cfg = demo
+    with faults.inject(faults.FaultSpec("spool_io", tenant="A",
+                                        after=1, exc="OSError",
+                                        message="disk full")):
+        hA, hB, s = _two_tenant_run(
+            ma, cfg,
+            a_kwargs={"spool_dir": str(tmp_path / "sA")})
+    with pytest.raises(TenantError) as ei:
+        hA.result(timeout=0)
+    err = ei.value
+    assert isinstance(err.cause, OSError)
+    rows = err.partial.chain.shape[0]
+    assert rows == 5  # exactly the one quantum appended before the fault
+    for f in EXACT_FIELDS:
+        assert np.array_equal(np.asarray(getattr(err.partial, f)),
+                              np.asarray(getattr(refs["A"], f))[:rows]), f
+    _bitwise(hB.result(), refs["B"])
+    assert s["faults"]["tenant_failures"] == 1
+
+
+def test_drain_worker_death_contained_and_restarted(demo, refs):
+    """An injected drain-worker death (a BaseException the worker does
+    NOT latch) fails the tenants whose entries were undrained in that
+    bundle, the supervisor restarts the worker, and every other tenant
+    completes bitwise."""
+    ma, cfg = demo
+    with faults.inject(faults.FaultSpec("drain_death", tenant="B",
+                                        after=1, action="die")):
+        hA, hB, s = _two_tenant_run(ma, cfg)
+    _bitwise(hA.result(), refs["A"])       # drained before B in-bundle
+    with pytest.raises(TenantError) as ei:
+        hB.result(timeout=0)
+    err = ei.value
+    assert err.where == "worker"
+    rows = err.partial.chain.shape[0]
+    assert rows == 5   # quantum 1 drained; quantum 2's bundle died
+    for f in EXACT_FIELDS:
+        assert np.array_equal(np.asarray(getattr(err.partial, f)),
+                              np.asarray(getattr(refs["B"], f))[:rows]), f
+    assert s["faults"]["worker_restarts"] >= 1
+    assert s["faults"]["pool_failures"] == 0
+
+
+def test_staging_fault_rejects_only_victim(demo, refs):
+    """A staging failure rejects the victim through its handle without
+    touching the pool or its co-residents."""
+    ma, cfg = demo
+    with faults.inject(faults.FaultSpec("staging", tenant="A")):
+        hA, hB, s = _two_tenant_run(ma, cfg)
+    assert hA.status == "rejected"
+    with pytest.raises(RuntimeError, match="injected fault"):
+        hA.result(timeout=0)
+    _bitwise(hB.result(), refs["B"])
+    assert s["faults"]["pool_failures"] == 0
+
+
+# ---------------------------------------------------------------------------
+# divergence policies
+# ---------------------------------------------------------------------------
+
+def test_divergence_fail_policy(demo, refs):
+    ma, cfg = demo
+    with faults.inject(faults.FaultSpec("lane_nan", tenant="A",
+                                        after=1)):
+        hA, hB, s = _two_tenant_run(
+            ma, cfg, a_kwargs={"on_divergence": "fail"})
+    with pytest.raises(TenantError) as ei:
+        hA.result(timeout=0)
+    err = ei.value
+    assert err.where == "divergence"
+    rows = err.partial.chain.shape[0]
+    assert rows > 0
+    # the prefix includes the diverging quantum's rows — drained
+    # records are never retroactively rewritten; healthy chains of the
+    # prefix are bitwise the reference
+    ok = [c for c in range(16) if c != 0]
+    assert np.array_equal(np.asarray(err.partial.chain)[:, ok],
+                          np.asarray(refs["A"].chain)[:rows, ok])
+    _bitwise(hB.result(), refs["B"])
+    assert s["faults"]["tenant_failures"] == 1
+
+
+def test_divergence_quarantine_policy(demo, refs):
+    """Quarantined lanes freeze; the tenant completes on survivors
+    whose chains are bitwise the fault-free run; health reports the
+    quarantined chain indices."""
+    ma, cfg = demo
+    with faults.inject(faults.FaultSpec("lane_nan", tenant="A",
+                                        after=1)):
+        hA, hB, s = _two_tenant_run(
+            ma, cfg, a_kwargs={"on_divergence": "quarantine"})
+    res = hA.result()
+    assert res.chain.shape[0] == 20
+    assert hA.health["n_quarantined"] == 1
+    assert hA.health["quarantined_chains"] == [0]
+    assert hA.health["status"][0] == "diverged"
+    ok = [c for c in range(16) if c != 0]
+    assert np.array_equal(np.asarray(res.chain)[:, ok],
+                          np.asarray(refs["A"].chain)[:, ok])
+    assert res.stats["health"]["n_quarantined"] == 1
+    _bitwise(hB.result(), refs["B"])
+    assert s["faults"]["quarantined_lanes"] == 1
+    assert s["faults"]["tenant_failures"] == 0
+
+
+def test_divergence_reinit_policy(demo, refs):
+    """The reinit policy re-draws the diverged lane from the prior
+    (the solo test_recovery path, serving-side): the tenant completes
+    with a finite final state, the reinit is counted, and both the
+    survivor tenant and the victim's healthy chains stay bitwise."""
+    ma, cfg = demo
+    with faults.inject(faults.FaultSpec("lane_nan", tenant="A",
+                                        after=1)):
+        hA, hB, s = _two_tenant_run(
+            ma, cfg, a_kwargs={"on_divergence": "reinit"})
+    res = hA.result()
+    assert res.chain.shape[0] == 20
+    assert hA.health["n_reinits"] >= 1
+    assert np.isfinite(np.asarray(res.chain)[-1]).all()
+    ok = [c for c in range(16) if c != 0]
+    assert np.array_equal(np.asarray(res.chain)[:, ok],
+                          np.asarray(refs["A"].chain)[:, ok])
+    _bitwise(hB.result(), refs["B"])
+    assert s["faults"]["reinits"] >= 1
+    assert s["faults"]["tenant_failures"] == 0
+
+
+# ---------------------------------------------------------------------------
+# the fail-fast reference arm + gate validation
+# ---------------------------------------------------------------------------
+
+def test_supervise_off_keeps_fail_fast(demo, monkeypatch):
+    """GST_SERVE_SUPERVISE=0: a worker exception still latches a
+    pool-wide error (the historical semantics, the gate's reference
+    arm)."""
+    ma, cfg = demo
+    monkeypatch.setenv("GST_SERVE_SUPERVISE", "0")
+
+    def bad_cb(h, sweep_end, records):
+        raise ValueError("boom")
+
+    srv = ChainServer(ma, cfg, nlanes=32, quantum=5, record="full")
+    assert srv.supervise is False
+    srv.submit(TenantRequest(ma=ma, niter=20, nchains=16, seed=1,
+                             name="A", on_chunk=bad_cb))
+    with pytest.raises(RuntimeError, match="serve worker thread failed"):
+        srv.run()
+    srv.close()
+
+
+def test_supervise_gate_validation(demo, monkeypatch):
+    from gibbs_student_t_tpu.serve.server import serve_supervise_env
+
+    ma, cfg = demo
+    monkeypatch.setenv("GST_SERVE_SUPERVISE", "banana")
+    with pytest.raises(ValueError, match="GST_SERVE_SUPERVISE"):
+        serve_supervise_env()
+    with pytest.raises(ValueError, match="GST_SERVE_SUPERVISE"):
+        ChainServer(ma, cfg, nlanes=32, quantum=5)
+    monkeypatch.delenv("GST_SERVE_SUPERVISE")
+    srv = ChainServer(ma, cfg, nlanes=32, quantum=5, supervise=False)
+    assert srv.supervise is False
+    # env overrides the constructor arg (the A/B convention)
+    monkeypatch.setenv("GST_SERVE_SUPERVISE", "1")
+    srv = ChainServer(ma, cfg, nlanes=32, quantum=5, supervise=False)
+    assert srv.supervise is True
+    with pytest.raises(ValueError, match="supervise"):
+        ChainServer(ma, cfg, nlanes=32, quantum=5, supervise="yes")
+    # policy validation: unknown policy, and policies need supervision
+    with pytest.raises(ValueError, match="on_divergence"):
+        srv.submit(TenantRequest(ma=ma, niter=5, nchains=16,
+                                 on_divergence="explode"))
+    monkeypatch.setenv("GST_SERVE_SUPERVISE", "0")
+    srv0 = ChainServer(ma, cfg, nlanes=32, quantum=5)
+    with pytest.raises(ValueError, match="supervised"):
+        srv0.submit(TenantRequest(ma=ma, niter=5, nchains=16,
+                                  on_divergence="quarantine"))
+
+
+# ---------------------------------------------------------------------------
+# crash recovery (in-process tier-1 arm; true process kills are slow)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.skipif(not _native_available(),
+                    reason="spooling needs the native library")
+def test_manifest_recovery_resumes_bitwise(demo, refs, tmp_path):
+    """An abandoned mid-run server (the in-process stand-in for a
+    kill: no close, no finalize) leaves a manifest + spool checkpoints
+    from which ChainServer.recover() rebuilds the pool and resumes
+    every tenant bitwise vs the uninterrupted reference."""
+    ma, cfg = demo
+    man = str(tmp_path / "manifest")
+    spool_a = str(tmp_path / "sA")
+    srv = ChainServer(ma, cfg, nlanes=32, quantum=5, record="full",
+                      pipeline=False, manifest_dir=man)
+    srv.submit(TenantRequest(ma=ma, niter=20, nchains=16, seed=3,
+                             name="S", spool_dir=spool_a))
+    srv.submit(TenantRequest(ma=ma, niter=20, nchains=16, seed=2,
+                             name="B"))   # in-memory: unrecoverable
+    for _ in range(2):
+        srv.step()   # 2 quanta, then the "process dies"
+    del srv
+
+    srv2, handles = ChainServer.recover(man)
+    assert sorted(handles) == ["S"]
+    # the in-memory tenant is reported lost, never silently dropped
+    assert [r["name"] for r in srv2.lost_tenants] == ["B"]
+    srv2.run()
+    srv2.close()
+    res = handles["S"].result()
+    assert res.chain.shape[0] == 20
+    for f in EXACT_FIELDS:
+        assert np.array_equal(np.asarray(getattr(res, f)),
+                              np.asarray(getattr(refs["S"], f))), f
+    # manifest carries the full story: admits, checkpoints, dones
+    from gibbs_student_t_tpu.serve.manifest import read_manifest
+
+    kinds = [r["kind"] for r in read_manifest(man)]
+    assert kinds.count("server") == 2
+    assert "checkpoint" in kinds and "done" in kinds
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not _native_available(),
+                    reason="spooling needs the native library")
+@pytest.mark.parametrize("arm", ["kill_before_checkpoint",
+                                 "kill_after_checkpoint"])
+def test_process_kill_recovery_bitwise(demo, tmp_path, arm):
+    """THE crash pin: a real ``os._exit`` kill mid-workload — on both
+    sides of a spool checkpoint boundary — then ``recover()`` resumes
+    and the chains are bitwise an uninterrupted run. The before-arm
+    leaves orphan spool rows past the checkpoint (truncated on
+    resume); the after-arm resumes from the freshly-written one."""
+    ma, cfg = demo
+    man = str(tmp_path / "man")
+    spool = str(tmp_path / "sK")
+    script = tmp_path / "victim.py"
+    script.write_text(f"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+from tests.conftest import make_demo_pta
+from gibbs_student_t_tpu.config import GibbsConfig
+from gibbs_student_t_tpu.serve import ChainServer, TenantRequest, faults
+
+ma = make_demo_pta().frozen(0)
+cfg = GibbsConfig(model="mixture")
+faults.install(faults.FaultSpec({arm!r}, tenant="K", after=1,
+                                action="kill"))
+srv = ChainServer(ma, cfg, nlanes=32, quantum=5, record="full",
+                  manifest_dir={man!r})
+srv.submit(TenantRequest(ma=ma, niter=20, nchains=16, seed=7,
+                         name="K", spool_dir={spool!r}))
+srv.run()
+os._exit(3)   # unreachable: the injected kill fires first
+""")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PYTHONPATH", None)
+    out = subprocess.run([sys.executable, str(script)],
+                         capture_output=True, text=True, env=env,
+                         timeout=900)
+    assert out.returncode == 9, (out.returncode, out.stderr[-2000:])
+    from gibbs_student_t_tpu.utils.spool import load_spool_state
+
+    state, next_sweep, seed = load_spool_state(spool)
+    # after=1 → the kill fires during the SECOND append (sweep 10):
+    # the before-arm still holds checkpoint 5 with sweep-10 rows
+    # flushed (orphans); the after-arm holds checkpoint 10
+    assert next_sweep == (5 if arm == "kill_before_checkpoint" else 10)
+    srv2, handles = ChainServer.recover(man)
+    srv2.run()
+    srv2.close()
+    res = handles["K"].result()
+    assert res.chain.shape[0] == 20
+    ref_srv = ChainServer(ma, cfg, nlanes=32, quantum=5, record="full")
+    ref_h = ref_srv.submit(TenantRequest(ma=ma, niter=20, nchains=16,
+                                         seed=7, name="K"))
+    ref_srv.run()
+    ref_srv.close()
+    ref = ref_h.result()
+    for f in EXACT_FIELDS:
+        assert np.array_equal(np.asarray(getattr(res, f)),
+                              np.asarray(getattr(ref, f))), f

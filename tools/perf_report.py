@@ -183,6 +183,16 @@ def print_report(ledger_recs, include_rounds=True):
                           f"p50={v.get('p50'):>8}ms "
                           f"p90={v.get('p90'):>8}ms "
                           f"max={v.get('max'):>8}ms")
+            # chaos-arm sub-line (serve_bench --faults records)
+            f = m.get("faults")
+            if isinstance(f, dict):
+                print(f"    faults ratio_vs_nofault="
+                      f"{f.get('ratio_vs_nofault')} "
+                      f"failed={f.get('failed_tenants')} "
+                      f"rejected={f.get('rejected_tenants')} "
+                      f"quarantined={f.get('quarantined_lanes')} "
+                      f"restarts={f.get('worker_restarts')} "
+                      f"pool_failures={f.get('pool_failures')}")
         else:
             brief = {k: v for k, v in m.items()
                      if isinstance(v, (int, float, bool, str))}
@@ -325,6 +335,55 @@ def check_latest(ledger_recs, max_drop, max_compile_growth,
     return 0
 
 
+def check_faults(ledger_recs, max_fault_rate, min_fault_ratio):
+    """Fault-containment gate over the latest ``serve_bench`` record
+    that carries a ``faults`` block (a ``--faults`` arm run). Fails
+    when the pool itself failed, when the tenant fault rate exceeds
+    ``--max-fault-rate`` (containment should fail only the victimized
+    tenants — a higher rate means faults are spreading), or when the
+    surviving tenants' throughput dropped below ``--min-fault-ratio``
+    of the same run's no-fault arm. Skipped (0) when no faults-arm
+    record exists — the gate arms itself the first time the chaos arm
+    lands a record."""
+    serve = [r for r in ledger_recs if r.get("tool") == "serve_bench"
+             and isinstance((r.get("metrics") or {}).get("faults"),
+                            dict)]
+    if not serve:
+        print("check: no serve_bench --faults record — fault gate "
+              "skipped")
+        return 0
+    f = serve[-1]["metrics"]["faults"]
+    rate, ratio = f.get("fault_rate"), f.get("ratio_vs_nofault")
+    pool_failures = f.get("pool_failures")
+    print(f"check: faults arm fault_rate {rate} (max {max_fault_rate}),"
+          f" ratio_vs_nofault {ratio} (min {min_fault_ratio}), "
+          f"pool_failures {pool_failures}, "
+          f"quarantined {f.get('quarantined_lanes')}, "
+          f"restarts {f.get('worker_restarts')}")
+    if not isinstance(rate, (int, float)) \
+            or not isinstance(ratio, (int, float)):
+        print("check: FAIL — faults block has no usable "
+              f"fault_rate/ratio_vs_nofault ({rate!r}/{ratio!r})")
+        return 3
+    if isinstance(pool_failures, (int, float)) and pool_failures > 0:
+        print("check: FAIL — the faults arm killed the POOL "
+              f"({pool_failures} pool failure(s)); containment is "
+              "supposed to fail tenants, never the pool")
+        return 2
+    if rate > max_fault_rate:
+        print(f"check: FAIL — tenant fault rate {rate:.3f} > "
+              f"{max_fault_rate} (injected faults are spreading past "
+              "their victims)")
+        return 2
+    if ratio < min_fault_ratio:
+        print(f"check: FAIL — surviving-tenant throughput under "
+              f"faults is {ratio:.3f} of the no-fault arm "
+              f"(< {min_fault_ratio}): containment is stalling the "
+              "pool")
+        return 2
+    return 0
+
+
 def check_serve(ledger_recs, min_occupancy, min_serve_ratio):
     """Serving gate: the latest ``serve_bench`` record (when one
     exists) must report lane occupancy at or above ``min_occupancy``
@@ -418,6 +477,19 @@ def main(argv=None):
                          "host-independent serving-efficiency number) "
                          "the latest serve_bench record must report; "
                          "skipped when the record has no solo arm")
+    ap.add_argument("--max-fault-rate", type=float, default=0.25,
+                    metavar="FRAC",
+                    help="fault gate: max tolerated tenant fault rate "
+                         "(failed+rejected / submitted) in the latest "
+                         "serve_bench --faults ledger record — the "
+                         "injected victims only; a higher rate means "
+                         "containment is leaking across tenants "
+                         "(skipped when no faults-arm record exists)")
+    ap.add_argument("--min-fault-ratio", type=float, default=0.8,
+                    metavar="FRAC",
+                    help="fault gate: minimum surviving-tenant "
+                         "throughput under faults as a fraction of the "
+                         "same run's no-fault arm (ratio_vs_nofault)")
     ap.add_argument("--baseline", choices=("prev", "best"),
                     default="prev",
                     help="compare against the previous comparable "
@@ -439,7 +511,9 @@ def main(argv=None):
                           max_dispatch_growth=args.max_dispatch_growth)
         rc_serve = check_serve(recs, args.min_occupancy,
                                args.min_serve_ratio)
-        return rc or rc_serve
+        rc_faults = check_faults(recs, args.max_fault_rate,
+                                 args.min_fault_ratio)
+        return rc or rc_serve or rc_faults
     return 0
 
 

@@ -14,10 +14,18 @@ and ``--check``-able fields: ``value`` (aggregate chain-sweeps/s),
 ``occupancy``, ``aggregate_sweeps_per_s``, ``admission_ms``,
 ``solo_sweeps_per_s``, ``ratio_vs_solo``.
 
+``--faults`` repeats the workload under a seeded deterministic fault
+plan (serve/faults.py: callback raise, forced lane NaN + quarantine,
+staging failure) and lands a ``faults`` block in the record —
+surviving-tenant throughput vs the no-fault arm, fault/quarantine
+counts — which ``perf_report --check`` gates (``--max-fault-rate``,
+``--min-fault-ratio``).
+
 Usage::
 
     python tools/serve_bench.py                 # flagship 1024 lanes
     python tools/serve_bench.py --quick         # CI smoke shapes
+    python tools/serve_bench.py --faults        # + chaos arm
 """
 
 from __future__ import annotations
@@ -84,6 +92,17 @@ def main(argv=None):
                          "overrides both)")
     ap.add_argument("--ledger", default=None,
                     help="ledger path override ('' disables the write)")
+    ap.add_argument("--faults", action="store_true",
+                    help="after the no-fault workload, repeat it with "
+                         "a seeded deterministic fault plan (callback "
+                         "raise, forced lane NaN + quarantine, staging "
+                         "failure — serve/faults.py) and report "
+                         "throughput-under-faults on the surviving "
+                         "tenants; the ledger record gains a 'faults' "
+                         "block perf_report --check gates")
+    ap.add_argument("--fault-seed", type=int, default=0,
+                    help="seed of the deterministic fault plan (which "
+                         "tenants are victimized, and when)")
     args = ap.parse_args(argv)
     if args.quick:
         args.nlanes = 64
@@ -150,58 +169,132 @@ def main(argv=None):
         del gb, st, st2
 
     # ---- mixed-tenant serving phase ----------------------------------
-    srv = ChainServer(template, cfg, nlanes=args.nlanes,
-                      quantum=args.quantum,
-                      pipeline=False if args.no_pipeline else "auto")
     rng = np.random.default_rng(args.seed)
     chains_each = args.nlanes // args.resident
     budgets = [int(rng.integers(args.quanta_min, args.quanta_max + 1))
                * args.quantum for _ in range(args.tenants)]
 
-    def req(i):
-        return TenantRequest(ma=tenant_mas[i], niter=budgets[i],
-                             nchains=chains_each, seed=args.seed + i,
-                             name=f"tenant{i}")
+    def run_workload(mods=None):
+        """One staggered mixed-tenant phase on a fresh server; ``mods``
+        maps tenant index -> TenantRequest kwargs overrides (the fault
+        arm's victim instrumentation). Returns (handles, wall_s,
+        summary)."""
+        srv = ChainServer(template, cfg, nlanes=args.nlanes,
+                          quantum=args.quantum,
+                          pipeline=False if args.no_pipeline else "auto")
 
-    # warmup: compile the pool program outside the timed window
-    w = srv.submit(TenantRequest(ma=template, niter=args.quantum,
-                                 nchains=srv.pool.group,
-                                 seed=args.seed))
-    srv.run()
-    w.result()
-    srv.reset_counters()
+        def req(i):
+            kw = dict(ma=tenant_mas[i], niter=budgets[i],
+                      nchains=chains_each, seed=args.seed + i,
+                      name=f"tenant{i}")
+            kw.update((mods or {}).get(i, {}))
+            return TenantRequest(**kw)
 
-    handles = []
-    progress = {"next_i": 0, "iters": 0}
-    for _ in range(min(args.resident, args.tenants)):
-        handles.append(srv.submit(req(progress["next_i"])))
-        progress["next_i"] += 1
+        # warmup: compile the pool program outside the timed window
+        w = srv.submit(TenantRequest(ma=template, niter=args.quantum,
+                                     nchains=srv.pool.group,
+                                     seed=args.seed))
+        srv.run()
+        w.result()
+        srv.reset_counters()
 
-    def stagger_submit(server):
-        # fires once per driver iteration (the old manual-step loop's
-        # cadence) on whichever thread drives the quanta
-        progress["iters"] += 1
-        if (progress["next_i"] < args.tenants
-                and (args.stagger == 0
-                     or progress["iters"] % max(args.stagger, 1) == 0)):
+        handles = []
+        progress = {"next_i": 0, "iters": 0}
+        for _ in range(min(args.resident, args.tenants)):
             handles.append(srv.submit(req(progress["next_i"])))
             progress["next_i"] += 1
 
-    t0 = time.perf_counter()
-    srv.run(on_quantum=stagger_submit)
-    while progress["next_i"] < args.tenants:
-        # an idle-exit before the tail of a sparse stagger schedule
-        # was submitted: push the rest and drain again
-        handles.append(srv.submit(req(progress["next_i"])))
-        progress["next_i"] += 1
-        srv.run(on_quantum=stagger_submit)
-    wall = time.perf_counter() - t0
-    srv.close()
-    for h in handles:
-        h.result(timeout=0)
+        def stagger_submit(server):
+            # fires once per driver iteration (the old manual-step
+            # loop's cadence) on whichever thread drives the quanta
+            progress["iters"] += 1
+            if (progress["next_i"] < args.tenants
+                    and (args.stagger == 0
+                         or progress["iters"] % max(args.stagger, 1)
+                         == 0)):
+                handles.append(srv.submit(req(progress["next_i"])))
+                progress["next_i"] += 1
 
-    summary = srv.summary()
+        t0 = time.perf_counter()
+        srv.run(on_quantum=stagger_submit)
+        while progress["next_i"] < args.tenants:
+            # an idle-exit before the tail of a sparse stagger schedule
+            # was submitted: push the rest and drain again
+            handles.append(srv.submit(req(progress["next_i"])))
+            progress["next_i"] += 1
+            srv.run(on_quantum=stagger_submit)
+        wall = time.perf_counter() - t0
+        srv.close()
+        for h in handles:
+            if h.status == "done":
+                h.result(timeout=0)
+        return handles, wall, srv.summary()
+
+    handles, wall, summary = run_workload()
+    bad = [h for h in handles if h.status != "done"]
+    if bad:
+        raise RuntimeError(
+            f"{len(bad)} tenant(s) failed in the NO-fault arm: "
+            + "; ".join(str(h.error) for h in bad[:3]))
     agg = summary["busy_chain_sweeps"] / wall
+
+    # ---- fault-injection arm -----------------------------------------
+    faults_block = None
+    if args.faults:
+        from gibbs_student_t_tpu.serve import faults as faults_mod
+
+        frng = np.random.default_rng(args.fault_seed)
+        cb_v, nan_v, stage_v = (int(v) for v in frng.choice(
+            args.tenants, size=3, replace=False))
+        print(f"# fault plan (seed {args.fault_seed}): callback raise "
+              f"on tenant{cb_v}, lane NaN + quarantine on "
+              f"tenant{nan_v}, staging failure on tenant{stage_v}",
+              file=sys.stderr)
+        mods = {
+            cb_v: {"on_chunk": lambda *a: None},   # fire() preempts it
+            nan_v: {"on_divergence": "quarantine"},
+        }
+        with faults_mod.inject(
+                faults_mod.FaultSpec("callback", tenant=f"tenant{cb_v}",
+                                     after=1),
+                faults_mod.FaultSpec("lane_nan", tenant=f"tenant{nan_v}",
+                                     after=1),
+                faults_mod.FaultSpec("staging",
+                                     tenant=f"tenant{stage_v}")):
+            fhandles, fwall, fsummary = run_workload(mods)
+            injected = {f"{p}@{t}": n for (p, t), n
+                        in faults_mod.fired_counts().items()}
+        surviving = [h for h in fhandles if h.status == "done"]
+        surv_sweeps = sum(h.request.nchains * h.sweeps_done
+                          for h in surviving)
+        surv_rate = surv_sweeps / fwall if fwall > 0 else 0.0
+        faults_block = {
+            "fault_seed": args.fault_seed,
+            "injected": injected,
+            "tenants": args.tenants,
+            "surviving_tenants": len(surviving),
+            "failed_tenants": sum(1 for h in fhandles
+                                  if h.status == "failed"),
+            "rejected_tenants": sum(1 for h in fhandles
+                                    if h.status == "rejected"),
+            "fault_rate": round(
+                sum(1 for h in fhandles if h.status != "done")
+                / max(len(fhandles), 1), 4),
+            "quarantined_lanes":
+                fsummary["faults"]["quarantined_lanes"],
+            "reinits": fsummary["faults"]["reinits"],
+            "worker_restarts": fsummary["faults"]["worker_restarts"],
+            "pool_failures": fsummary["faults"]["pool_failures"],
+            "surviving_sweeps_per_s": round(surv_rate, 1),
+            "ratio_vs_nofault": round(surv_rate / agg, 4) if agg else None,
+            "wall_s": round(fwall, 3),
+        }
+        print(f"# faults arm: {surv_rate:.1f} surviving chain-sweeps/s "
+              f"= {faults_block['ratio_vs_nofault']} of the no-fault "
+              f"aggregate; {faults_block['failed_tenants']} failed / "
+              f"{faults_block['rejected_tenants']} rejected / "
+              f"{faults_block['quarantined_lanes']} lanes quarantined",
+              file=sys.stderr)
     line = {
         "metric": "serve_aggregate_chain_sweeps_per_s",
         "value": round(agg, 1),
@@ -228,6 +321,8 @@ def main(argv=None):
         # pipelining win (docs/SERVING.md)
         "host_ms": summary["host_ms"],
     }
+    if faults_block is not None:
+        line["faults"] = faults_block
     if args.ledger != "":
         try:
             from gibbs_student_t_tpu.obs import ledger as _ledger
