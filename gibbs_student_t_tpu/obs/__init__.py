@@ -27,6 +27,14 @@ machine-readable run records. This package supplies them:
 - :mod:`~gibbs_student_t_tpu.obs.ledger` — the durable append-only
   run ledger (``artifacts/ledger.jsonl``): one schema-versioned record
   per graded driver/tool invocation, immune to lost stdout.
+- :mod:`~gibbs_student_t_tpu.obs.spans` — per-tenant executor span
+  tracing for the chain server (bounded ring + JSONL sink, Chrome
+  trace-event export → Perfetto swimlanes).
+- :mod:`~gibbs_student_t_tpu.obs.export` — Prometheus text exposition
+  of a registry snapshot (the serving ``obs_dir`` pull surface).
+- :mod:`~gibbs_student_t_tpu.obs.schema` — machine-readable record
+  schemas (``docs/observability.schema.json``) + the small validator
+  behind the CI schema-drift guard.
 
 Import discipline: this package is imported by ``backends/jax_backend.py``
 at module load, so nothing here may import ``backends``/``parallel`` at
@@ -43,11 +51,16 @@ from gibbs_student_t_tpu.obs.ledger import (
     make_record,
     read_ledger,
 )
+from gibbs_student_t_tpu.obs.export import (
+    prometheus_text,
+    write_prometheus,
+)
 from gibbs_student_t_tpu.obs.metrics import (
     MetricsRegistry,
     read_events,
     write_manifest,
 )
+from gibbs_student_t_tpu.obs.spans import SpanRecorder
 from gibbs_student_t_tpu.obs.telemetry import (
     TELE_PREFIX,
     Telemetry,
@@ -62,6 +75,9 @@ __all__ = [
     "compile_summary",
     "introspect_jit",
     "register_kernel",
+    "prometheus_text",
+    "write_prometheus",
+    "SpanRecorder",
     "append_record",
     "make_record",
     "read_ledger",

@@ -1,0 +1,110 @@
+"""Prometheus-style text exposition of a :class:`MetricsRegistry`.
+
+The serving stack keeps its live metrics in an in-process registry
+(gauges/counters/histograms — docs/OBSERVABILITY.md). This module
+renders a registry snapshot in the Prometheus text exposition format
+(version 0.0.4: ``# TYPE`` headers, cumulative ``_bucket{le=...}``
+rows, ``_sum``/``_count``) so a scrape-shaped consumer — or a plain
+``watch cat`` — can read a live server without any RPC surface:
+``ChainServer(obs_dir=...)`` refreshes ``metrics.prom`` (and
+``status.json``) at quantum boundaries, and ``tools/serve_top.py``
+renders the same files as a terminal dashboard.
+
+Write discipline: atomic replace (a scraper never sees a torn file),
+and :func:`write_prometheus` is non-fatal — an IO error warns once per
+path and returns None, never failing the serving run (the PR 1 rule).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import re
+import time
+import warnings
+from typing import Optional
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+#: paths that already warned about a failed write (warn once, then
+#: stay quiet — the refresh runs every quantum)
+_WARNED = set()
+
+
+def _metric_name(name: str, prefix: str = "gst_") -> str:
+    """A valid Prometheus metric name: prefixed, invalid chars -> _."""
+    name = _NAME_RE.sub("_", name)
+    if not name or not (name[0].isalpha() or name[0] in "_:"):
+        name = "_" + name
+    return prefix + name if not name.startswith(prefix) else name
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "NaN"
+    v = float(v)
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    return repr(v)
+
+
+def prometheus_text(snapshot: dict, prefix: str = "gst_",
+                    ts_ms: Optional[int] = None) -> str:
+    """Render a ``MetricsRegistry.snapshot()`` dict as Prometheus text.
+
+    Counters keep their value, gauges their last value, histograms
+    become the standard cumulative ``_bucket``/``_sum``/``_count``
+    family. ``ts_ms`` (unix milliseconds) stamps every sample when
+    given — useful for file-scraped expositions where collection lag
+    matters.
+    """
+    out = []
+    suffix = f" {ts_ms}" if ts_ms is not None else ""
+
+    for name, value in sorted((snapshot.get("counters") or {}).items()):
+        n = _metric_name(name, prefix)
+        out.append(f"# TYPE {n} counter")
+        out.append(f"{n} {_fmt(value)}{suffix}")
+    for name, value in sorted((snapshot.get("gauges") or {}).items()):
+        n = _metric_name(name, prefix)
+        out.append(f"# TYPE {n} gauge")
+        out.append(f"{n} {_fmt(value)}{suffix}")
+    for name, h in sorted((snapshot.get("histograms") or {}).items()):
+        n = _metric_name(name, prefix)
+        out.append(f"# TYPE {n} histogram")
+        cum = 0
+        buckets = h.get("buckets") or {}
+        # registry buckets are per-bin counts keyed by upper bound
+        # (with a trailing "+inf"); prometheus wants cumulative le=
+        for le, c in buckets.items():
+            cum += int(c)
+            le_lbl = "+Inf" if le in ("+inf", "+Inf") else le
+            out.append(f'{n}_bucket{{le="{le_lbl}"}} {cum}{suffix}')
+        out.append(f"{n}_sum {_fmt(h.get('sum', 0.0))}{suffix}")
+        out.append(f"{n}_count {int(h.get('count', 0))}{suffix}")
+    return "\n".join(out) + "\n"
+
+
+def write_prometheus(registry, path: str, prefix: str = "gst_") -> \
+        Optional[str]:
+    """Atomically write ``registry``'s snapshot to ``path`` in the
+    exposition format. Returns the path, or None (with one warning per
+    path) when the write fails — a refresh must never crash a run."""
+    try:
+        text = prometheus_text(registry.snapshot(), prefix=prefix,
+                               ts_ms=int(time.time() * 1e3))
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            fh.write(text)
+        os.replace(tmp, path)
+        return path
+    except Exception as e:  # noqa: BLE001 - observability must not raise
+        if path not in _WARNED:
+            _WARNED.add(path)
+            warnings.warn(f"prometheus exposition write {path!r} failed "
+                          f"({type(e).__name__}: {e}); refresh disabled "
+                          "for this path's warning, writes keep being "
+                          "attempted", RuntimeWarning, stacklevel=2)
+        return None

@@ -74,15 +74,14 @@ def chain_health(stats: Dict[str, np.ndarray],
         dead = dead_flat.reshape(div.shape)
         from gibbs_student_t_tpu.parallel.diagnostics import (
             ess_per_param,
-            split_rhat,
+            split_rhat_per_param,
         )
 
         ok_chains = ~(diverged | dead).ravel()
         if ok_chains.sum() >= 2 and window.shape[0] >= 4:
             healthy = window[:, ok_chains]
             ess_min = float(ess_per_param(healthy).min())
-            rhat_max = float(max(split_rhat(healthy[..., pi])
-                                 for pi in range(healthy.shape[-1])))
+            rhat_max = float(split_rhat_per_param(healthy).max())
 
     status = np.full(div.shape, STATUS_OK, dtype=object)
     status[stuck] = STATUS_STUCK

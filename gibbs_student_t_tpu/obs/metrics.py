@@ -28,6 +28,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 from typing import Dict, List, Optional, Sequence
 
@@ -40,17 +41,23 @@ import numpy as np
 
 
 class Counter:
-    """Monotonic float counter (e.g. sweeps, accepted MH steps)."""
+    """Monotonic float counter (e.g. sweeps, accepted MH steps).
+
+    Thread-safe: the serving drain worker and caller threads increment
+    the same counters concurrently (``+=`` on a float attribute is a
+    read-modify-write that can lose updates across threads)."""
 
     def __init__(self, name: str):
         self.name = name
         self.value = 0.0
+        self._lock = threading.Lock()
 
     def inc(self, amount: float = 1.0) -> None:
         if amount < 0:
             raise ValueError(f"counter {self.name!r} cannot decrease "
                              f"(inc by {amount})")
-        self.value += amount
+        with self._lock:
+            self.value += amount
 
 
 class Gauge:
@@ -83,14 +90,16 @@ class Histogram:
         self.sum = 0.0
         self.min: Optional[float] = None
         self.max: Optional[float] = None
+        self._lock = threading.Lock()
 
     def observe(self, value: float) -> None:
         value = float(value)
-        self.counts[int(np.searchsorted(self.buckets, value))] += 1
-        self.count += 1
-        self.sum += value
-        self.min = value if self.min is None else min(self.min, value)
-        self.max = value if self.max is None else max(self.max, value)
+        with self._lock:
+            self.counts[int(np.searchsorted(self.buckets, value))] += 1
+            self.count += 1
+            self.sum += value
+            self.min = value if self.min is None else min(self.min, value)
+            self.max = value if self.max is None else max(self.max, value)
 
     def summary(self) -> Dict[str, object]:
         return {
@@ -109,6 +118,13 @@ class MetricsRegistry:
     scripts); ``snapshot()`` still works. ``emit()`` without a run
     directory is a no-op, so instrumented code never branches on whether
     a sink exists.
+
+    Thread-safe: the serving stack appends events and metrics from the
+    drain worker, the dispatch thread, and caller threads concurrently
+    (serve/server.py), so registration, event writes and ``close()``
+    are guarded by one registry lock (and each metric guards its own
+    update). ``close()`` is idempotent — any thread may close, every
+    later ``emit``/``close`` is a no-op.
     """
 
     def __init__(self, run_dir: Optional[str] = None):
@@ -118,6 +134,7 @@ class MetricsRegistry:
         self._metrics: Dict[str, object] = {}
         self.timer = BlockTimer()  # the registry's wall-clock source
         self._t0 = time.time()
+        self._lock = threading.RLock()
         self._events_fh = None
         if run_dir is not None:
             os.makedirs(run_dir, exist_ok=True)
@@ -127,13 +144,14 @@ class MetricsRegistry:
     # -- metric accessors (get-or-create, kind-checked) -----------------
 
     def _get(self, name: str, cls, **kwargs):
-        m = self._metrics.get(name)
-        if m is None:
-            m = self._metrics[name] = cls(name, **kwargs)
-        elif not isinstance(m, cls):
-            raise TypeError(f"metric {name!r} already registered as "
-                            f"{type(m).__name__}, not {cls.__name__}")
-        return m
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, **kwargs)
+            elif not isinstance(m, cls):
+                raise TypeError(f"metric {name!r} already registered as "
+                                f"{type(m).__name__}, not {cls.__name__}")
+            return m
 
     def counter(self, name: str) -> Counter:
         return self._get(name, Counter)
@@ -160,7 +178,9 @@ class MetricsRegistry:
         out: Dict[str, object] = {"counters": {}, "gauges": {},
                                   "histograms": {},
                                   "timers": self.timer.summary()}
-        for name, m in sorted(self._metrics.items()):
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+        for name, m in metrics:
             if isinstance(m, Counter):
                 out["counters"][name] = m.value
             elif isinstance(m, Gauge):
@@ -171,14 +191,19 @@ class MetricsRegistry:
 
     def emit(self, event: str, **fields) -> None:
         """Append one event line to ``events.jsonl`` (no-op without a
-        run_dir). Values go through the JSON sanitizer, so numpy scalars
-        and small arrays are fine."""
-        if self._events_fh is None:
+        run_dir, or after ``close()``). Values go through the JSON
+        sanitizer, so numpy scalars and small arrays are fine. The line
+        is serialized outside the lock; only the file write is guarded,
+        so concurrent emitters can never interleave partial lines."""
+        if self._events_fh is None:  # cheap unlocked fast path
             return
         rec = {"event": event, "t": round(time.time(), 3),
                "elapsed_s": round(time.time() - self._t0, 3)}
         rec.update(fields)
-        self._events_fh.write(json.dumps(_jsonable(rec)) + "\n")
+        line = json.dumps(_jsonable(rec)) + "\n"
+        with self._lock:
+            if self._events_fh is not None:  # may have closed meanwhile
+                self._events_fh.write(line)
 
     def write_manifest(self, **fields) -> Optional[str]:
         """Write ``manifest.json`` into the run directory (see
@@ -190,12 +215,16 @@ class MetricsRegistry:
 
     def close(self) -> None:
         """Emit a final ``snapshot`` event, fold the process's XLA
-        compile introspection into the manifest, and close the sink."""
-        if self._events_fh is not None:
+        compile introspection into the manifest, and close the sink.
+        Idempotent and thread-safe: exactly one caller wins the close
+        (the RLock lets that caller's final ``emit`` re-enter)."""
+        with self._lock:
+            if self._events_fh is None:
+                return
             self.emit("snapshot", metrics=self.snapshot())
             self._events_fh.close()
             self._events_fh = None
-            self._augment_manifest_xla()
+        self._augment_manifest_xla()
 
     def _augment_manifest_xla(self) -> None:
         """Add/refresh the manifest's ``xla`` block at close time —

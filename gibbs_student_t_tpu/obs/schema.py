@@ -1,0 +1,109 @@
+"""Machine-readable schema validation for the observability surfaces.
+
+Docs and code drift silently: OBSERVABILITY.md describes the ledger /
+events.jsonl / manifest / serving-status record shapes in prose, and
+nothing failed when an emitter changed a field. The schemas now live
+as data — ``docs/observability.schema.json``, checked in next to the
+prose — and a tier-1 test (tests/test_schema_guard.py) smoke-runs the
+serve and bench record paths and validates every emitted record
+against them, so a drifting field fails CI instead of a future reader.
+
+The validator is a deliberately small JSON-Schema subset (``type``
+incl. lists, ``properties``, ``required``, ``items``, ``enum``,
+``additionalProperties: false``, ``anyOf``) — enough to pin record
+shapes without adding a dependency; unknown keywords are ignored, so
+the checked-in schemas stay forward-compatible with real JSON Schema
+tooling.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List
+
+_TYPES = {
+    "object": dict,
+    "array": list,
+    "string": str,
+    "boolean": bool,
+    "null": type(None),
+}
+
+SCHEMA_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))),
+    "docs", "observability.schema.json")
+
+
+def load_schemas(path: str = None) -> dict:
+    """The named-schema table from ``docs/observability.schema.json``
+    (``{"ledger_record": {...}, "event": {...}, ...}``)."""
+    with open(path or SCHEMA_PATH) as fh:
+        return json.load(fh)
+
+
+def _type_ok(value, t: str) -> bool:
+    if t == "number":
+        return (isinstance(value, (int, float))
+                and not isinstance(value, bool))
+    if t == "integer":
+        return isinstance(value, int) and not isinstance(value, bool)
+    return isinstance(value, _TYPES[t])
+
+
+def validate(value, schema: dict, path: str = "$",
+             defs: dict = None) -> List[str]:
+    """Collect (not raise) every violation of ``schema`` by ``value``
+    as human-readable ``path: problem`` strings; empty list == valid.
+    ``defs`` is the named-schema table for ``{"$named": "..."}``
+    cross-references (e.g. the shared percentiles shape)."""
+    if "$named" in schema:
+        if not defs or schema["$named"] not in defs:
+            return [f"{path}: unresolvable $named "
+                    f"{schema['$named']!r}"]
+        schema = defs[schema["$named"]]
+    errs: List[str] = []
+    t = schema.get("type")
+    if t is not None:
+        types = t if isinstance(t, list) else [t]
+        if not any(_type_ok(value, tt) for tt in types):
+            return [f"{path}: expected {t}, got "
+                    f"{type(value).__name__} ({value!r:.80})"]
+    if "enum" in schema and value not in schema["enum"]:
+        errs.append(f"{path}: {value!r} not in {schema['enum']}")
+    if "anyOf" in schema:
+        branches = [validate(value, s, path, defs)
+                    for s in schema["anyOf"]]
+        if not any(not b for b in branches):
+            errs.append(f"{path}: matched no anyOf branch "
+                        f"({branches[0][0] if branches[0] else ''})")
+    if isinstance(value, dict):
+        props = schema.get("properties", {})
+        for key in schema.get("required", []):
+            if key not in value:
+                errs.append(f"{path}: missing required key {key!r}")
+        for key, sub in props.items():
+            if key in value:
+                errs.extend(validate(value[key], sub, f"{path}.{key}",
+                                     defs))
+        if schema.get("additionalProperties") is False:
+            for key in value:
+                if key not in props:
+                    errs.append(f"{path}: unexpected key {key!r}")
+    if isinstance(value, list) and "items" in schema:
+        for i, item in enumerate(value):
+            errs.extend(validate(item, schema["items"],
+                                 f"{path}[{i}]", defs))
+    return errs
+
+
+def assert_valid(value, schema: dict, label: str = "record",
+                 defs: dict = None) -> None:
+    """Raise ``AssertionError`` listing every violation (the test-side
+    entry point — one failure names every drifted field at once)."""
+    errs = validate(value, schema, defs=defs)
+    if errs:
+        raise AssertionError(
+            f"{label} violates its schema "
+            f"({len(errs)} problem(s)):\n  " + "\n  ".join(errs[:20]))

@@ -39,10 +39,32 @@ def block_span(name: str):
     return jax.named_scope(name)
 
 
+# host_span's TraceAnnotation availability, probed ONCE: the serving
+# drain loop calls host_span per tenant per quantum, and the old
+# per-call try/except re-attempted the constructor (and re-raised
+# through the handler) on every call when the installed jax lacks it.
+# False = probed-and-absent, None = not probed yet.
+_TRACE_ANNOTATION = None
+
+
+def _trace_annotation_cls():
+    global _TRACE_ANNOTATION
+    if _TRACE_ANNOTATION is None:
+        try:
+            cls = jax.profiler.TraceAnnotation
+            cls("gst_probe")  # constructing is the failure mode seen
+            _TRACE_ANNOTATION = cls
+        except Exception:  # noqa: BLE001 - degrade once, remember it
+            _TRACE_ANNOTATION = False
+    return _TRACE_ANNOTATION
+
+
 def host_span(name: str):
     """Host-side profiler span for Python-level work between dispatches
-    (no-op outside an active ``trace_to`` capture)."""
-    try:
-        return jax.profiler.TraceAnnotation(name)
-    except Exception:  # noqa: BLE001 - observability must never crash a run
+    (no-op outside an active ``trace_to`` capture). The
+    ``jax.profiler.TraceAnnotation`` probe is memoized — a jax without
+    it costs one failed attempt per process, not one per call."""
+    cls = _trace_annotation_cls()
+    if not cls:
         return contextlib.nullcontext()
+    return cls(name)

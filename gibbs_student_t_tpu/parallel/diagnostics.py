@@ -82,24 +82,42 @@ def effective_sample_size(chains: np.ndarray) -> float:
     return float((chains.shape[0] / taus).sum())
 
 
+def gelman_rubin_per_param(chains: np.ndarray) -> np.ndarray:
+    """(p,) potential scale reduction R-hat over ``(niter, nchains, p)``
+    samples — one vectorized pass over the parameter axis. The scalar
+    :func:`gelman_rubin` is this with ``p == 1`` (pinned equal in
+    tests/test_obs.py), so the per-parameter loop ``obs/health.py`` and
+    the serving convergence monitor used to pay is a single reduction."""
+    chains = np.asarray(chains, dtype=np.float64)
+    n = chains.shape[0]
+    means = chains.mean(axis=0)                       # (m, p)
+    W = chains.var(axis=0, ddof=1).mean(axis=0)       # (p,)
+    B = n * means.var(axis=0, ddof=1)                 # (p,)
+    var_plus = (n - 1) / n * W + B / n
+    return np.sqrt(var_plus / W)
+
+
 def gelman_rubin(chains: np.ndarray) -> float:
     """Potential scale reduction R-hat over ``(niter, nchains)`` samples."""
     chains = np.asarray(chains, dtype=np.float64)
-    n, m = chains.shape
-    means = chains.mean(axis=0)
-    W = chains.var(axis=0, ddof=1).mean()
-    B = n * means.var(ddof=1)
-    var_plus = (n - 1) / n * W + B / n
-    return float(np.sqrt(var_plus / W))
+    return float(gelman_rubin_per_param(chains[:, :, None])[0])
+
+
+def split_rhat_per_param(window: np.ndarray) -> np.ndarray:
+    """(p,) split-R-hat over a ``(rows, nchains, p)`` window: every
+    chain halved (within-chain drift shows up as cross-half spread),
+    all parameters in one batched :func:`gelman_rubin_per_param`."""
+    window = np.asarray(window, dtype=np.float64)
+    n = window.shape[0] // 2
+    split = np.concatenate([window[:n], window[n:2 * n]], axis=1)
+    return gelman_rubin_per_param(split)
 
 
 def split_rhat(chains: np.ndarray) -> float:
     """Rank-normalization-free split-R-hat: halves each chain to detect
     within-chain drift."""
     chains = np.asarray(chains, dtype=np.float64)
-    n = chains.shape[0] // 2
-    split = np.concatenate([chains[:n], chains[n:2 * n]], axis=1)
-    return gelman_rubin(split)
+    return float(split_rhat_per_param(chains[:, :, None])[0])
 
 
 def rhat_collective(x, axis_name: str):

@@ -25,6 +25,7 @@ See docs/SERVING.md for the architecture and the
 operand-vs-baked-constant table.
 """
 
+from gibbs_student_t_tpu.serve.monitor import MonitorSpec, TenantMonitor
 from gibbs_student_t_tpu.serve.pool import GROUP_LANES, SlotPool
 from gibbs_student_t_tpu.serve.scheduler import (
     TenantError,
@@ -40,4 +41,6 @@ __all__ = [
     "TenantHandle",
     "TenantError",
     "ChainServer",
+    "MonitorSpec",
+    "TenantMonitor",
 ]

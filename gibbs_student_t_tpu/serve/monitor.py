@@ -1,0 +1,268 @@
+"""Streaming per-tenant convergence monitoring for the chain server.
+
+In a sampling-as-a-service world the user-facing currency is effective
+samples per second and time-to-converged-answer (Recycling Gibbs,
+arXiv:1611.07056, frames ESS as the budget; arXiv:2405.08857 frames
+burn-in as per-request latency) — yet until round 13 a tenant could
+observe nothing about its own convergence until ``result()``. A
+:class:`TenantMonitor` closes that: the drain worker feeds it each
+quantum's already-accumulated wire slice of the parameter chain
+(``x`` rides the wire UNCAST — no transport decode exists for it, so
+"decode" is a param-axis slice), it keeps per-chain Welford running
+moments incrementally (O(new rows) per update), and evaluates online
+ESS and split-R-hat over the monitored parameter subset with the SAME
+batched ``parallel/diagnostics.py`` forms the post-hoc health report
+uses — so ``TenantHandle.progress()`` matches
+``ess_per_param``/``split_rhat_per_param`` on the same rows to 1e-6
+(pinned in tests/test_serve_obs.py).
+
+Cost model: the per-update work is the append + Welford fold over the
+new rows only. The windowed autocorrelation evaluation (one batched
+FFT over ``rows × nchains × |params|`` columns) reruns over the
+accumulated buffer, throttled by ``MonitorSpec.every`` — with the
+default few-parameter subset it is microseconds-to-milliseconds
+against a multi-hundred-millisecond quantum, and it runs on the drain
+worker, never the dispatch thread.
+
+Failure contract (the PR 1 rule): the server wraps every monitor call
+— a monitor exception disables THAT tenant's monitor with a warning
+event and the tenant keeps serving (tests/test_serve_obs.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+
+@dataclass
+class MonitorSpec:
+    """Per-tenant convergence-monitoring request
+    (``TenantRequest.monitor``).
+
+    ``params`` selects the monitored subset of the sampled parameter
+    vector — indices, or names resolved against the pool template's
+    ``param_names`` at admission; ``None`` monitors every parameter
+    (fine for small models; pick a subset for wide ones — the
+    monitored columns are what the online diagnostics pay for).
+    ``ess_target`` / ``rhat_target`` arm the convergence verdict: the
+    tenant counts as converged at the first evaluation where every
+    armed target holds (min ESS >= ``ess_target``, max split-R-hat <=
+    ``rhat_target``), recorded as ``converged_at`` (the sweep index)
+    and folded into the SLO surface. ``every`` evaluates the windowed
+    diagnostics every N quanta (the Welford fold still runs every
+    quantum); ``min_rows`` suppresses evaluation below a floor where
+    split-R-hat is undefined noise.
+    """
+
+    params: Optional[Sequence] = None
+    ess_target: Optional[float] = None
+    rhat_target: Optional[float] = None
+    every: int = 1
+    min_rows: int = 8
+
+    def __post_init__(self):
+        if self.every < 1:
+            raise ValueError(f"monitor every must be >= 1, got "
+                             f"{self.every}")
+        if self.min_rows < 4:
+            raise ValueError(f"monitor min_rows must be >= 4, got "
+                             f"{self.min_rows}")
+
+
+def resolve_params(spec: MonitorSpec, param_names) -> np.ndarray:
+    """Monitored param indices from a spec's names/indices against the
+    template's ``param_names`` (admission-time validation: a bad name
+    or index rejects the tenant, it never fails the pool)."""
+    names = list(param_names)
+    if spec.params is None:
+        return np.arange(len(names))
+    idx = []
+    for p in spec.params:
+        if isinstance(p, str):
+            if p not in names:
+                raise ValueError(f"monitored parameter {p!r} not in "
+                                 f"the pool template ({names[:8]}...)")
+            idx.append(names.index(p))
+        else:
+            i = int(p)
+            if not 0 <= i < len(names):
+                raise ValueError(f"monitored parameter index {i} out "
+                                 f"of range [0, {len(names)})")
+            idx.append(i)
+    if not idx:
+        raise ValueError("monitor params must not be empty")
+    return np.asarray(idx, int)
+
+
+class TenantMonitor:
+    """Online ESS / split-R-hat over one tenant's monitored columns.
+
+    ``update()`` runs on the drain worker (one call per drained
+    quantum); ``snapshot()`` / the handle's ``progress()`` may be
+    called from any thread at any time — state is guarded by a lock
+    and snapshots are plain dicts.
+    """
+
+    def __init__(self, spec: MonitorSpec, nchains: int,
+                 param_idx: np.ndarray, param_names=None,
+                 record_thin: int = 1):
+        self.spec = spec
+        self.nchains = int(nchains)
+        self.param_idx = np.asarray(param_idx, int)
+        self.param_names = (None if param_names is None else
+                            [str(param_names[i]) for i in self.param_idx])
+        self.record_thin = int(record_thin)
+        self._lock = threading.Lock()
+        # the accumulated monitored window, (rows, nchains, |params|)
+        # float32 — grown geometrically so each quantum's append is an
+        # O(new rows) copy, not an O(total rows) reallocation
+        self._buf = np.empty((0, self.nchains, len(self.param_idx)),
+                             np.float32)
+        self._rows = 0
+        # Welford running moments per (chain, param): the O(new rows)
+        # incremental statistics (count/mean/M2) that track per-chain
+        # location and spread between (and independently of) the
+        # throttled windowed evaluations
+        self._w_n = 0
+        self._w_mean = np.zeros((self.nchains, len(self.param_idx)),
+                                np.float64)
+        self._w_m2 = np.zeros_like(self._w_mean)
+        self._updates = 0
+        self._t_first: Optional[float] = None
+        self._snap: Dict[str, object] = {
+            "rows": 0, "sweeps": 0, "params": self.param_names,
+            "ess": None, "ess_min": None, "rhat": None, "rhat_max": None,
+            "ess_per_s": None, "est_sweeps_to_target": None,
+            "converged_at": None,
+        }
+
+    # -- drain-worker side ---------------------------------------------
+
+    def _append(self, rows: np.ndarray) -> None:
+        need = self._rows + rows.shape[0]
+        if need > self._buf.shape[0]:
+            grown = np.empty((max(need, 2 * self._buf.shape[0]),)
+                             + self._buf.shape[1:], np.float32)
+            grown[:self._rows] = self._buf[:self._rows]
+            self._buf = grown
+        self._buf[self._rows:need] = rows
+        self._rows = need
+
+    def _welford(self, rows: np.ndarray) -> None:
+        """Chan's batched Welford merge: fold the new rows' count /
+        mean / M2 into the running moments in one vectorized step —
+        O(new rows) work with no per-row Python loop."""
+        rows = np.asarray(rows, np.float64)            # (nb, nchains, p)
+        nb = rows.shape[0]
+        if nb == 0:
+            return
+        bm = rows.mean(axis=0)
+        bm2 = ((rows - bm) ** 2).sum(axis=0)
+        tot = self._w_n + nb
+        delta = bm - self._w_mean
+        self._w_m2 += bm2 + delta ** 2 * (self._w_n * nb / tot)
+        self._w_mean += delta * (nb / tot)
+        self._w_n = tot
+
+    def update(self, x_rows: np.ndarray, sweep_end: int) -> None:
+        """Fold one drained quantum: ``x_rows`` is the tenant's new
+        ``(rows, nchains, p_model)`` (or pre-sliced ``(rows, nchains,
+        |params|)``) chain rows in wire values. Called on the drain
+        worker; O(new rows) plus the throttled windowed evaluation."""
+        x_rows = np.asarray(x_rows)
+        if x_rows.ndim != 3 or x_rows.shape[1] != self.nchains:
+            raise ValueError(
+                f"monitor update wants (rows, nchains={self.nchains}, "
+                f"p), got {x_rows.shape}")
+        if x_rows.shape[2] != len(self.param_idx):
+            x_rows = x_rows[:, :, self.param_idx]
+        now = time.monotonic()
+        with self._lock:
+            if self._t_first is None:
+                self._t_first = now
+            self._append(np.asarray(x_rows, np.float32))
+            self._welford(x_rows)
+            self._updates += 1
+            self._snap["rows"] = self._rows
+            self._snap["sweeps"] = int(sweep_end)
+            if (self._updates % self.spec.every == 0
+                    and self._rows >= self.spec.min_rows):
+                self._evaluate(now, int(sweep_end))
+
+    def _evaluate(self, now: float, sweep_end: int) -> None:
+        """The windowed diagnostics over the accumulated buffer —
+        exactly the post-hoc ``parallel/diagnostics`` forms, so
+        ``progress()`` agrees with a ``result()``-time health report
+        on the same rows (the 1e-6 pin). Caller holds the lock."""
+        from gibbs_student_t_tpu.parallel.diagnostics import (
+            ess_per_param,
+            split_rhat_per_param,
+        )
+
+        window = self._buf[:self._rows]
+        ess = ess_per_param(window)
+        rhat = split_rhat_per_param(window)
+        s = self._snap
+        s["ess"] = [float(v) for v in ess]
+        s["ess_min"] = float(ess.min())
+        s["rhat"] = [float(v) for v in rhat]
+        rhat_fin = rhat[np.isfinite(rhat)]
+        s["rhat_max"] = (float(rhat_fin.max()) if rhat_fin.size
+                         else None)
+        dt = now - (self._t_first or now)
+        s["ess_per_s"] = (float(ess.min()) / dt if dt > 0 else None)
+        spec = self.spec
+        if spec.ess_target is not None and ess.min() > 0:
+            # sweeps scale ~linearly with ESS once mixing: extrapolate
+            # from the observed sweeps-per-effective-sample rate
+            need = spec.ess_target / float(ess.min())
+            s["est_sweeps_to_target"] = int(max(
+                0.0, np.ceil(sweep_end * (need - 1.0))))
+        ok = spec.ess_target is not None or spec.rhat_target is not None
+        if spec.ess_target is not None:
+            ok = ok and float(ess.min()) >= spec.ess_target
+        if spec.rhat_target is not None:
+            ok = ok and (s["rhat_max"] is not None
+                         and s["rhat_max"] <= spec.rhat_target)
+        if ok and s["converged_at"] is None:
+            s["converged_at"] = int(sweep_end)
+            s["converged_t"] = now
+            if spec.ess_target is not None:
+                s["est_sweeps_to_target"] = 0
+
+    # -- any-thread side ------------------------------------------------
+
+    def snapshot(self) -> Dict[str, object]:
+        """The latest progress view (plain JSON-ready dict copy):
+        ``rows``, ``sweeps``, per-param ``ess``/``rhat`` with their
+        ``ess_min``/``rhat_max`` aggregates, ``ess_per_s``,
+        ``est_sweeps_to_target`` and ``converged_at`` (None until the
+        armed targets hold)."""
+        with self._lock:
+            out = dict(self._snap)
+            if self._w_n >= 2:
+                # Welford within-chain spread: live, even between
+                # windowed evaluations
+                out["within_chain_std_mean"] = float(
+                    np.sqrt(self._w_m2 / (self._w_n - 1)).mean())
+        out.pop("converged_t", None)
+        return out
+
+    @property
+    def converged_at(self) -> Optional[int]:
+        with self._lock:
+            v = self._snap.get("converged_at")
+            return None if v is None else int(v)
+
+    @property
+    def converged_t(self) -> Optional[float]:
+        """Monotonic wall time of the convergence verdict (the SLO
+        submit->converged leg), None while unconverged."""
+        with self._lock:
+            v = self._snap.get("converged_t")
+            return None if v is None else float(v)

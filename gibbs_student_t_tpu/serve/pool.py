@@ -36,6 +36,7 @@ tests/test_serve.py).
 from __future__ import annotations
 
 import functools
+import time as _time
 
 import jax
 import jax.numpy as jnp
@@ -123,7 +124,7 @@ class SlotPool:
                  group: int = GROUP_LANES, dtype=jnp.float32,
                  record: str = "compact8", record_thin: int = 1,
                  heterogeneous: bool = False,
-                 telemetry: bool = True, metrics=None):
+                 telemetry: bool = True, metrics=None, spans=None):
         """``heterogeneous=True`` stacks row-masked models so tenants
         with FEWER TOAs than the pool axis can ride the same operand
         buffers (suffix padding, exactly the ensemble convention). The
@@ -152,6 +153,11 @@ class SlotPool:
         self.quantum = quantum
         self.group = group
         self.metrics = metrics
+        # pool-level executor spans (obs/spans.SpanRecorder, optional):
+        # the operand upload and the chunk-call handoff are the two
+        # host steps a dispatch pays — tracing them attributes a slow
+        # boundary to uploads vs the program call in the swimlane view
+        self._spans = spans
         self.heterogeneous = bool(heterogeneous)
         tmpl = _localize_names(template_ma)
         if tmpl.row_mask is not None:
@@ -444,6 +450,7 @@ class SlotPool:
             return jnp.asarray(np.array(a, dtype=dtype, copy=True))
 
         if self._dirty:
+            t_up0 = _time.monotonic()
             self._mas_dev = jax.tree.map(
                 lambda a: (up(a, np.dtype(self.dtype))
                            if np.issubdtype(np.asarray(a).dtype,
@@ -456,6 +463,9 @@ class SlotPool:
                 for a in fc[:-1]
             ], gid=up(self._gid_np))
             self._dirty = False
+            if self._spans is not None:
+                self._spans.record("operand_upload", "dispatch", t_up0,
+                                   _time.monotonic() - t_up0)
         if self._host_valid:
             # the private copy additionally keeps donation honest: the
             # program may reuse its state input buffers, never
@@ -463,10 +473,14 @@ class SlotPool:
             state_in = jax.tree.map(up, self._state_np)
         else:
             state_in = self._state_dev
+        t_call0 = _time.monotonic()
         sts, (recs, tl) = self._chunk(
             state_in, self._mas_dev, self._fc_dev,
             up(self._keys_np), up(self._offsets_np),
             up(self._active_np), length=self.quantum)
+        if self._spans is not None:
+            self._spans.record("chunk_call", "dispatch", t_call0,
+                               _time.monotonic() - t_call0)
         self._state_dev = sts
         self._host_valid = False
         self._offsets_np[self._active_np] += self.quantum

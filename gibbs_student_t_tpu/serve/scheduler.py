@@ -80,6 +80,14 @@ class TenantRequest:
     freezes diverged lanes and continues on the survivors, ``reinit``
     re-draws diverged lanes from the prior (the solo
     ``reinit_diverged`` recovery path, serving-side).
+
+    ``monitor`` (a :class:`~gibbs_student_t_tpu.serve.monitor.
+    MonitorSpec`) arms streaming convergence monitoring: the drain
+    worker folds each quantum's chain rows into an online ESS /
+    split-R-hat view surfaced through :meth:`TenantHandle.progress`,
+    with ``converged_at`` landing in the tenant's result stats and the
+    server's SLO surface (docs/OBSERVABILITY.md "Live serving
+    observability").
     """
 
     ma: ModelArrays
@@ -93,6 +101,7 @@ class TenantRequest:
     on_chunk: Optional[Callable] = None   # (handle, sweep_end, records)
     name: Optional[str] = None
     on_divergence: str = "none"
+    monitor: object = None                # serve/monitor.MonitorSpec
 
 
 class TenantHandle:
@@ -105,9 +114,14 @@ class TenantHandle:
         self.error: Optional[str] = None
         self.submitted_t = time.monotonic()
         self.admitted_t: Optional[float] = None
+        self.first_result_t: Optional[float] = None
         self.finished_t: Optional[float] = None
         self.sweeps_done = 0
         self.chunks_streamed = 0
+        # streaming convergence monitor (serve/monitor.TenantMonitor),
+        # attached at admission when the request armed one; the server
+        # detaches it (with a warning event) if it ever raises
+        self._monitor = None
         self._cols: Dict[str, List[np.ndarray]] = {}
         self._tele_stats: Dict[str, np.ndarray] = {}
         self._result = None
@@ -130,6 +144,8 @@ class TenantHandle:
         serving drain's biggest host cost."""
         self.sweeps_done = sweep_end - self.request.start_sweep
         self.chunks_streamed += 1
+        if self.first_result_t is None:   # the SLO admit->first-result leg
+            self.first_result_t = time.monotonic()
         if self.request.on_chunk is not None:
             from gibbs_student_t_tpu.serve import faults
 
@@ -186,6 +202,39 @@ class TenantHandle:
         if self.admitted_t is None:
             return None
         return (self.admitted_t - self.submitted_t) * 1e3
+
+    @property
+    def first_result_ms(self) -> Optional[float]:
+        """Admit -> first drained records latency (the SLO leg)."""
+        if self.admitted_t is None or self.first_result_t is None:
+            return None
+        return (self.first_result_t - self.admitted_t) * 1e3
+
+    @property
+    def converged_at(self) -> Optional[int]:
+        """Sweep index at which the armed convergence targets first
+        held (streaming monitor), None while unconverged/unmonitored."""
+        return (None if self._monitor is None
+                else self._monitor.converged_at)
+
+    def progress(self) -> Dict[str, object]:
+        """Live per-tenant progress: scheduling state plus — when the
+        request armed a :class:`~gibbs_student_t_tpu.serve.monitor.
+        MonitorSpec` — the streaming convergence view (``rows``,
+        per-param ``ess``/``rhat`` and their aggregates, ``ess_per_s``,
+        ``est_sweeps_to_target``, ``converged_at``). Callable from any
+        thread, before, during and after the run."""
+        p: Dict[str, object] = {
+            "tenant_id": self.tenant_id,
+            "name": self.request.name,
+            "status": self.status,
+            "nchains": self.request.nchains,
+            "sweeps_done": self.sweeps_done,
+            "niter": self.request.niter,
+        }
+        if self._monitor is not None:
+            p.update(self._monitor.snapshot())
+        return p
 
     @property
     def throughput_sweeps_per_s(self) -> Optional[float]:

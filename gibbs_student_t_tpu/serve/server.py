@@ -64,10 +64,13 @@ schema).
 
 from __future__ import annotations
 
+import contextlib
+import json
 import os
 import queue as _queue
 import threading
 import time
+import warnings
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
@@ -81,7 +84,18 @@ from gibbs_student_t_tpu.parallel.ensemble import (
     _localize_names,
     pad_model_arrays,
 )
+from gibbs_student_t_tpu.obs.spans import (
+    ROLE_DISPATCH,
+    ROLE_DRAIN,
+    ROLE_STAGING,
+    SpanRecorder,
+)
 from gibbs_student_t_tpu.serve import faults as _faults
+from gibbs_student_t_tpu.serve.monitor import (
+    MonitorSpec,
+    TenantMonitor,
+    resolve_params,
+)
 from gibbs_student_t_tpu.serve.pool import (
     GROUP_LANES,
     SlotPool,
@@ -137,6 +151,7 @@ class _Prepared:
     groups_needed: int
     n_real: int
     prep_ms: float
+    monitor: Optional[TenantMonitor] = None
 
 
 @dataclass
@@ -158,24 +173,27 @@ class _Bundle:
     False marks a finalize-only entry (a tenant failed at a boundary:
     no records this quantum, but its failure must be delivered in
     drain order, after its last real drain). ``idx`` tracks progress
-    so a dying worker can abort exactly the undrained tail."""
+    so a dying worker can abort exactly the undrained tail. ``qidx``
+    is the quantum index the bundle drains (span attribution)."""
 
     recs: object
     tl: object
     snap: object
     entries: list
     idx: int = 0
+    qidx: int = -1
 
 
 def _percentiles(vals: List[float]) -> Optional[dict]:
-    """{p50, p90, max, mean} of a host-time series, ms (None if
-    empty) — the serve_bench ledger breakdown shape."""
+    """{p50, p90, p99, max, mean} of a host-time series, ms (None if
+    empty) — the serve_bench ledger breakdown / SLO block shape."""
     if not vals:
         return None
     a = np.asarray(vals, np.float64)
     return {
         "p50": round(float(np.percentile(a, 50)), 3),
         "p90": round(float(np.percentile(a, 90)), 3),
+        "p99": round(float(np.percentile(a, 99)), 3),
         "max": round(float(a.max()), 3),
         "mean": round(float(a.mean()), 3),
     }
@@ -195,7 +213,10 @@ class ChainServer:
                  max_queue: int = 64, backpressure: str = "block",
                  telemetry: bool = True, metrics=None,
                  pipeline="auto", prefetch: int = 2,
-                 supervise="auto", manifest_dir: Optional[str] = None):
+                 supervise="auto", manifest_dir: Optional[str] = None,
+                 spans: bool = True, span_capacity: int = 65536,
+                 trace_jsonl: Optional[str] = None,
+                 obs_dir: Optional[str] = None):
         """``pipeline`` selects the driver ``run()`` uses: ``"auto"``
         (default) follows ``GST_SERVE_PIPELINE`` (auto -> pipelined);
         ``True``/``False`` force it, still overridden by an explicit
@@ -208,14 +229,45 @@ class ChainServer:
         containment + worker supervision vs the historical fail-fast.
         ``manifest_dir``, when given, journals the server's state to an
         append-only crash-recovery manifest (serve/manifest.py;
-        :meth:`recover` rebuilds from it)."""
+        :meth:`recover` rebuilds from it).
+
+        The observability plane (round 13; docs/OBSERVABILITY.md "Live
+        serving observability"): ``spans`` (default on — pure host
+        bookkeeping, chains bitwise identical either way) records
+        per-tenant executor spans into a ``span_capacity``-bounded
+        ring (+ an optional ``trace_jsonl`` sink), exported by
+        :meth:`export_trace` as Chrome trace-event JSON. ``obs_dir``
+        refreshes a pull-based surface at every quantum boundary:
+        ``status.json`` (the :meth:`status` snapshot) and
+        ``metrics.prom`` (Prometheus text exposition of the attached
+        registry — one is created in-memory if ``metrics`` is None),
+        which ``tools/serve_top.py`` renders as a terminal dashboard.
+        """
         import jax.numpy as jnp
 
+        if obs_dir is not None and metrics is None:
+            from gibbs_student_t_tpu.obs.metrics import MetricsRegistry
+
+            metrics = MetricsRegistry()   # exposition needs a registry
+        self.spans = (SpanRecorder(capacity=span_capacity,
+                                   jsonl_path=trace_jsonl)
+                      if spans else None)
+        self.obs_dir = obs_dir
+        if obs_dir is not None:
+            os.makedirs(obs_dir, exist_ok=True)
+        self._obs_warned = False
+        self._t_started = time.monotonic()
+        self._tenant_names: Dict[int, object] = {}
+        # SLO series (ms; drain-worker/caller appends are GIL-atomic,
+        # the _drain_ms precedent): submit->admit rides _admission_ms
+        self._first_result_ms: List[float] = []
+        self._converged_ms: List[float] = []
         self.pool = SlotPool(template_ma, config,
                              nlanes=nlanes, quantum=quantum, group=group,
                              dtype=dtype or jnp.float32, record=record,
                              record_thin=record_thin,
-                             telemetry=telemetry, metrics=metrics)
+                             telemetry=telemetry, metrics=metrics,
+                             spans=self.spans)
         self.config = config
         self.metrics = metrics
         env = serve_pipeline_env()
@@ -311,9 +363,20 @@ class ChainServer:
         self._admit_apply_ms.clear()
         self._drain_ms.clear()
         self._gap_ms.clear()
+        self._first_result_ms.clear()
+        self._converged_ms.clear()
         self._last_dispatch_t = None
         for k in self._fault_counts:
             self._fault_counts[k] = 0
+
+    def _span(self, name: str, role: str, tenant=None,
+              quantum: Optional[int] = None):
+        """A recorder span context, or a null context with tracing
+        off — call sites never branch."""
+        if self.spans is None:
+            return contextlib.nullcontext()
+        return self.spans.span(name, role, tenant=tenant,
+                               quantum=quantum)
 
     # ------------------------------------------------------------------
     # submission
@@ -337,6 +400,11 @@ class ChainServer:
             raise ValueError(
                 f"on_divergence must be one of {DIVERGENCE_POLICIES}, "
                 f"got {request.on_divergence!r}")
+        if (request.monitor is not None
+                and not isinstance(request.monitor, MonitorSpec)):
+            raise ValueError(
+                f"monitor must be a serve.monitor.MonitorSpec or None, "
+                f"got {type(request.monitor).__name__}")
         if request.on_divergence != "none":
             if not self.supervise:
                 raise ValueError(
@@ -411,8 +479,15 @@ class ChainServer:
         req = handle.request
         pool = self.pool
         t = pool.template
+        monitor = None
         try:
             _faults.fire("staging", tenant=self._tenant_key(handle))
+            if req.monitor is not None:
+                monitor = TenantMonitor(
+                    req.monitor, req.nchains,
+                    resolve_params(req.monitor, t._ma.param_names),
+                    param_names=t._ma.param_names,
+                    record_thin=t.record_thin)
             ma = _localize_names(req.ma)
             if ma.row_mask is not None:
                 raise ValueError("tenant models must be unpadded; the "
@@ -482,9 +557,13 @@ class ChainServer:
         except Exception as e:  # noqa: BLE001 - reject, don't kill pool
             handle._fail(f"{type(e).__name__}: {e}")
             return None
+        prep_ms = (time.monotonic() - t0) * 1e3
+        if self.spans is not None:
+            self.spans.record("stage", ROLE_STAGING, t0, prep_ms / 1e3,
+                              tenant=handle.tenant_id)
         return _Prepared(handle, ma_p, tb, state,
                          self._groups_needed(handle), ma.n,
-                         (time.monotonic() - t0) * 1e3)
+                         prep_ms, monitor=monitor)
 
     def _apply_prepared(self, prep: _Prepared) -> None:
         """Place a prepared tenant into free lane groups: the cheap
@@ -492,6 +571,7 @@ class ChainServer:
         Caller holds ``_lock`` and has verified the groups fit."""
         handle, req = prep.handle, prep.handle.request
         pool = self.pool
+        t_admit0 = time.monotonic()
         taken = [self._free_groups.pop(0)
                  for _ in range(prep.groups_needed)]
         lanes = np.concatenate([
@@ -515,11 +595,18 @@ class ChainServer:
                 fault_key=self._tenant_key(handle))
         handle.admitted_t = time.monotonic()
         handle.status = "running"
+        handle._monitor = prep.monitor
+        self._tenant_names[handle.tenant_id] = req.name
         self._running[handle.tenant_id] = _Tenant(
             slot, handle, spool,
             backend=(prep.backend
                      if req.on_divergence == "reinit" else None))
         self._admission_ms.append(handle.admission_ms)
+        if self.spans is not None:
+            self.spans.record("admit", ROLE_DISPATCH, t_admit0,
+                              time.monotonic() - t_admit0,
+                              tenant=handle.tenant_id,
+                              quantum=self.quanta)
         if self._manifest is not None:
             self._manifest.record_admit(
                 handle.tenant_id, req,
@@ -806,10 +893,17 @@ class ChainServer:
                 self._gap_ms.append(
                     (time.monotonic() - self._last_dispatch_t) * 1e3)
             self._boundary_faults()
+            qidx = self.quanta
+            t_d0 = time.monotonic()
             recs, tl = self.pool.run_quantum()
             self._last_tl = tl
             self._last_tl_tids = set(self._running)
             self._last_dispatch_t = time.monotonic()
+            if self.spans is not None:
+                dur = self._last_dispatch_t - t_d0
+                for tid in self._running:
+                    self.spans.record("quantum", ROLE_DISPATCH, t_d0,
+                                      dur, tenant=tid, quantum=qidx)
             t0 = time.monotonic()
             wire = self.pool.wire_host(recs)
             tele = (jax.device_get(tl) if tl is not None else None)
@@ -821,10 +915,13 @@ class ChainServer:
                 sweep_end = slot.start_sweep + slot.done_sweeps
                 if not slot.failed:
                     try:
-                        self._drain_tenant(
-                            slot, handle, spool, wire, tele, sweep_end,
-                            state_fn=lambda s=slot:
-                            self.pool.tenant_state(s))
+                        with self._span("drain", ROLE_DRAIN,
+                                        tenant=tid, quantum=qidx):
+                            self._drain_tenant(
+                                slot, handle, spool, wire, tele,
+                                sweep_end,
+                                state_fn=lambda s=slot:
+                                self.pool.tenant_state(s))
                     except Exception as e:  # noqa: BLE001
                         if not self.supervise:
                             raise
@@ -844,17 +941,20 @@ class ChainServer:
             for tid in finished:
                 t = self._running.pop(tid)
                 self._release(t.slot)
-                if t.slot.failed:
-                    self._finalize_failed(t)
-                else:
-                    try:
-                        self._finalize(t)
-                    except Exception as e:  # noqa: BLE001
-                        if not self.supervise:
-                            raise
-                        self._note_fault(t, "finalize", e)
+                with self._span("finalize", ROLE_DRAIN, tenant=tid,
+                                quantum=qidx):
+                    if t.slot.failed:
                         self._finalize_failed(t)
+                    else:
+                        try:
+                            self._finalize(t)
+                        except Exception as e:  # noqa: BLE001
+                            if not self.supervise:
+                                raise
+                            self._note_fault(t, "finalize", e)
+                            self._finalize_failed(t)
             self._drain_ms.append((time.monotonic() - t0) * 1e3)
+            self._refresh_obs(locked=True)
             return bool(self._running) or len(self.queue) > 0
 
     def _accumulate_tele(self, handle: TenantHandle, slot: TenantSlot,
@@ -900,17 +1000,77 @@ class ChainServer:
         need_mat = spool is not None or handle.request.on_chunk
         records = (self.pool.tenant_quantum_records(wire, slot)
                    if need_mat else None)
+        wire_cols = None
         if spool is not None:
             spool.append(records, state_fn(), sweep_end)
             if self._manifest is not None:
                 self._manifest.record_checkpoint(slot.tenant_id,
                                                  sweep_end)
         else:
-            handle._append_wire(self.pool.tenant_wire(wire, slot))
+            wire_cols = self.pool.tenant_wire(wire, slot)
+            handle._append_wire(wire_cols)
+        was_first = handle.first_result_t is None
         handle._stream(sweep_end,
                        records if records is not None else {})
+        if was_first and handle.first_result_t is not None:
+            ms = handle.first_result_ms
+            if ms is not None:
+                self._first_result_ms.append(ms)
+                if self.metrics is not None:
+                    self.metrics.histogram(
+                        "serve_first_result_ms").observe(ms)
         if tele is not None:
             self._accumulate_tele(handle, slot, tele)
+        self._feed_monitor(handle, slot, records, wire_cols, sweep_end)
+
+    def _feed_monitor(self, handle: TenantHandle, slot: TenantSlot,
+                      records, wire_cols, sweep_end: int) -> None:
+        """Fold one drained quantum into the tenant's streaming
+        convergence monitor. The ``x`` chain rides the wire UNCAST
+        (ops record casts touch z/pout/b/alpha only), so the monitored
+        rows come straight off the already-pulled host buffers — a
+        param-axis slice, no extra decode. A monitor exception
+        detaches THAT tenant's monitor with a warning event and the
+        tenant keeps serving (the PR 1 observability contract — never
+        a tenant fault)."""
+        mon = handle._monitor
+        if mon is None:
+            return
+        try:
+            if records is not None:
+                x_rows = records["x"]                 # (rows, C, p)
+            else:
+                # wire slice is (nchains, rows, p): rows-major for the
+                # diagnostics window
+                x_rows = np.swapaxes(wire_cols["x"], 0, 1)
+            mon.update(x_rows, sweep_end)
+            if (mon.converged_at is not None
+                    and handle.request.monitor is not None
+                    and not getattr(handle, "_conv_recorded", False)):
+                handle._conv_recorded = True
+                conv_t = mon.converged_t
+                ms = ((conv_t - handle.submitted_t) * 1e3
+                      if conv_t is not None else None)
+                if ms is not None:
+                    self._converged_ms.append(ms)
+                if self.metrics is not None:
+                    if ms is not None:
+                        self.metrics.histogram(
+                            "serve_converged_ms").observe(ms)
+                    self.metrics.emit(
+                        "tenant_converged", tenant=slot.tenant_id,
+                        sweep=mon.converged_at, ms=ms)
+        except Exception as e:  # noqa: BLE001 - observability contract
+            handle._monitor = None
+            warnings.warn(
+                f"tenant {slot.tenant_id} convergence monitor failed "
+                f"({type(e).__name__}: {e}); monitoring disabled for "
+                "this tenant, serving continues", RuntimeWarning)
+            if self.metrics is not None:
+                self.metrics.counter("serve_monitor_errors").inc()
+                self.metrics.emit("monitor_error",
+                                  tenant=slot.tenant_id,
+                                  error=f"{type(e).__name__}: {e}")
 
     def _release(self, slot: TenantSlot) -> None:
         """Free a finished tenant's lanes (pool-side bookkeeping; runs
@@ -945,6 +1105,13 @@ class ChainServer:
                 n_stuck=health["n_stuck"], n_dead=health["n_dead"],
                 n_quarantined=health["n_quarantined"],
                 n_reinits=health["n_reinits"])
+        # the streaming monitor's final view rides the result stats:
+        # the snapshot dict under "monitor", plus the "converged_at"
+        # sweep (None while the armed targets never held / unmonitored)
+        mon_stats = {}
+        if handle._monitor is not None:
+            mon_stats["monitor"] = handle._monitor.snapshot()
+            mon_stats["converged_at"] = handle._monitor.converged_at
         if spool is not None:
             spool.close()
             from gibbs_student_t_tpu.utils.spool import load_spool
@@ -952,13 +1119,15 @@ class ChainServer:
             res = load_spool(handle.request.spool_dir)
             res.stats.update(handle._tele_stats)
             res.stats["n_toa"] = np.asarray([slot.n_real])
+            res.stats.update(mon_stats)
             if health is not None:
                 res.stats["health"] = health
             handle._finish(res)
             return
         pool = self.pool
 
-        def build(slot=slot, handle=handle, health=health):
+        def build(slot=slot, handle=handle, health=health,
+                  mon_stats=mon_stats):
             # one concatenate of the narrow wire chunks (rows axis),
             # then ONE materialization pass for the whole tenant
             cols = pool.materialize_tenant(
@@ -968,6 +1137,7 @@ class ChainServer:
             res = pool.template._to_result(cols)
             res.stats.update(handle._tele_stats)
             res.stats["n_toa"] = np.asarray([slot.n_real])
+            res.stats.update(mon_stats)
             if health is not None:
                 res.stats["health"] = health
             return res
@@ -1036,16 +1206,22 @@ class ChainServer:
                 _faults.fire("drain_death",
                              tenant=self._tenant_key(handle))
                 if drained and not slot.failed:
-                    self._drain_tenant(
-                        slot, handle, spool, wire, tele, sweep_end,
-                        state_fn=lambda s=slot:
-                        self.pool.tenant_state_from(b.snap, s))
+                    with self._span("drain", ROLE_DRAIN,
+                                    tenant=slot.tenant_id,
+                                    quantum=b.qidx):
+                        self._drain_tenant(
+                            slot, handle, spool, wire, tele, sweep_end,
+                            state_fn=lambda s=slot:
+                            self.pool.tenant_state_from(b.snap, s))
                 if final:
-                    if slot.failed:
-                        self._finalize_failed(
-                            _Tenant(slot, handle, spool))
-                    else:
-                        self._finalize(_Tenant(slot, handle, spool))
+                    with self._span("finalize", ROLE_DRAIN,
+                                    tenant=slot.tenant_id,
+                                    quantum=b.qidx):
+                        if slot.failed:
+                            self._finalize_failed(
+                                _Tenant(slot, handle, spool))
+                        else:
+                            self._finalize(_Tenant(slot, handle, spool))
             except Exception as e:  # noqa: BLE001
                 if not self.supervise:
                     raise
@@ -1162,10 +1338,17 @@ class ChainServer:
         self._boundary_faults()
         need_snap = any(t.spool is not None
                         for t in self._running.values())
+        qidx = self.quanta
+        t_d0 = time.monotonic()
         recs, tl, snap = self.pool.dispatch_quantum(snapshot=need_snap)
         self._last_tl = tl
         self._last_tl_tids = set(self._running)
         self._last_dispatch_t = time.monotonic()
+        if self.spans is not None:
+            dur = self._last_dispatch_t - t_d0
+            for tid in self._running:
+                self.spans.record("quantum", ROLE_DISPATCH, t_d0, dur,
+                                  tenant=tid, quantum=qidx)
         q = self.pool.quantum
         entries = []
         # boundary-failed tenants (divergence policy, drain faults)
@@ -1200,7 +1383,7 @@ class ChainServer:
                 busy / self.pool.nlanes)
             self.metrics.gauge("serve_queue_depth").set(len(self.queue))
             self.metrics.counter("serve_sweeps_total").inc(busy * q)
-        self._drainq.put(_Bundle(recs, tl, snap, entries))
+        self._drainq.put(_Bundle(recs, tl, snap, entries, qidx=qidx))
 
     def _pipeline_idle(self) -> bool:
         """Nothing running, queued, staged or pending drain — the
@@ -1242,6 +1425,8 @@ class ChainServer:
                         for t in self._boundary_failed]
                     self._boundary_failed.clear()
                     self._drainq.put(_Bundle(None, None, None, entries))
+            if have_work:
+                self._refresh_obs()
             if on_quantum is not None:
                 on_quantum(self)
             if not have_work:
@@ -1336,6 +1521,120 @@ class ChainServer:
             self._stage_thread.join()
         self._stage_thread = None
         self._fail_all_outstanding("server closed")
+        self._refresh_obs()          # final pull-surface state
+        if self.spans is not None:
+            self.spans.close()       # flush/close the JSONL sink only
+
+    # ------------------------------------------------------------------
+    # the live observability surface
+    # ------------------------------------------------------------------
+
+    def _slo_block(self) -> dict:
+        """Per-tenant latency percentiles, ms: submit->admit
+        (queue-wait included), admit->first drained result, and
+        submit->converged (tenants whose armed monitor targets held;
+        ``n_converged`` counts them)."""
+        return {
+            "admission_ms": _percentiles(self._admission_ms),
+            "first_result_ms": _percentiles(self._first_result_ms),
+            "converged_ms": _percentiles(self._converged_ms),
+            "n_converged": len(self._converged_ms),
+        }
+
+    def _status_locked(self) -> dict:
+        """The :meth:`status` snapshot body; caller holds ``_lock``."""
+        running = list(self._running.items())
+        free_groups = len(self._free_groups)
+        with self._prep_lock:
+            staged = len(self._prepared) + self._staging_n
+        busy = sum(t.slot.nchains for _, t in running)
+        tenants = []
+        for tid, t in running:
+            p = t.handle.progress()
+            p.update({
+                "lane0": int(t.slot.lanes[0]),
+                "lane_groups": len(t.slot.lanes) // self.pool.group,
+                "quarantined": len(t.slot.quarantined),
+                "reinits": t.slot.n_reinits,
+                "cancelled": bool(t.slot.cancelled),
+                "failed": bool(t.slot.failed),
+            })
+            tenants.append(p)
+        occ = (self.busy_lane_sweeps / self.total_lane_sweeps
+               if self.total_lane_sweeps else 0.0)
+        return {
+            "schema": 1,
+            "t": round(time.time(), 3),
+            "uptime_s": round(time.monotonic() - self._t_started, 3),
+            "quanta": self.quanta,
+            "nlanes": self.pool.nlanes,
+            "group": self.pool.group,
+            "quantum": self.pool.quantum,
+            "busy_lanes": busy,
+            "free_groups": free_groups,
+            "occupancy_now": busy / self.pool.nlanes,
+            "occupancy": occ,
+            "queue_depth": len(self.queue),
+            "staged": staged,
+            "pipeline": bool(self.pipeline),
+            "supervise": bool(self.supervise),
+            "faults": dict(self._fault_counts),
+            "slo": self._slo_block(),
+            "tenants": tenants,
+        }
+
+    def status(self) -> dict:
+        """A pull-based live snapshot of the server: pool geometry and
+        occupancy, queue/staging depth, fault counters, the SLO
+        percentiles, and one entry per RUNNING tenant (scheduling
+        state + the streaming convergence view when monitored). This
+        is what ``obs_dir/status.json`` refreshes at every quantum
+        boundary and ``tools/serve_top.py`` renders."""
+        with self._lock:
+            return self._status_locked()
+
+    def _refresh_obs(self, locked: bool = False) -> None:
+        """Refresh the ``obs_dir`` pull surface (status.json +
+        metrics.prom) at a quantum boundary. Atomic writes; any
+        failure warns once and serving continues — the plane never
+        crashes a run."""
+        if self.obs_dir is None:
+            return
+        try:
+            from gibbs_student_t_tpu.obs.metrics import _jsonable
+
+            st = self._status_locked() if locked else self.status()
+            path = os.path.join(self.obs_dir, "status.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(_jsonable(st), fh)
+            os.replace(tmp, path)
+            if self.metrics is not None:
+                from gibbs_student_t_tpu.obs.export import (
+                    write_prometheus,
+                )
+
+                write_prometheus(
+                    self.metrics,
+                    os.path.join(self.obs_dir, "metrics.prom"))
+        except Exception as e:  # noqa: BLE001 - observability contract
+            if not self._obs_warned:
+                self._obs_warned = True
+                warnings.warn(
+                    f"obs_dir refresh failed ({type(e).__name__}: "
+                    f"{e}); serving continues without the pull "
+                    "surface", RuntimeWarning)
+
+    def export_trace(self, path: str) -> str:
+        """Write the recorded executor spans as Chrome trace-event
+        JSON (``chrome://tracing`` / Perfetto): one swimlane per
+        tenant, one track per thread role (staging / dispatch /
+        drain). Returns ``path``."""
+        if self.spans is None:
+            raise ValueError(
+                "span tracing is disabled (ChainServer(spans=False))")
+        return self.spans.export_chrome_trace(
+            path, tenant_names=self._tenant_names)
 
     # ------------------------------------------------------------------
     # crash recovery
@@ -1431,4 +1730,5 @@ class ChainServer:
                 "dispatch_gap": _percentiles(self._gap_ms),
             },
             "faults": dict(self._fault_counts),
+            "slo": self._slo_block(),
         }

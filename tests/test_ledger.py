@@ -430,6 +430,63 @@ def test_perf_report_baselines_and_unusable_records(tmp_path):
                     "--baseline", "best"]) == 2
 
 
+def _serve_rec(obs_overhead=0.01, admission_p99=500.0, value=5000.0):
+    slo = {"admission_ms": {"p50": 100.0, "p90": 300.0,
+                            "p99": admission_p99,
+                            "max": admission_p99, "mean": 150.0},
+           "first_result_ms": None, "converged_ms": None,
+           "n_converged": 0}
+    return {"schema": 1, "tool": "serve_bench", "platform": "cpu",
+            "timestamp_utc": "t", "git_sha": "abc",
+            "config_fingerprint": "f",
+            "metrics": {"metric": "serve_aggregate_chain_sweeps_per_s",
+                        "value": value, "occupancy": 0.95,
+                        "ratio_vs_solo": 0.9, "slo": slo,
+                        "monitor": {"tenant0": {"converged_at": None}},
+                        "obs_overhead": obs_overhead},
+            "xla": {}}
+
+
+def test_perf_report_gates_obs_overhead_and_admission_p99(tmp_path,
+                                                          capsys):
+    """The round-13 observability gate: obs_overhead over the warm
+    A/B arm fails past --max-obs-overhead, the slo admission p99
+    fails past --max-admission-p99, and records predating the fields
+    skip both legs with a note."""
+    pr = _perf_report()
+    base = [_bench_rec(100.0), _bench_rec(100.0)]
+    # within limits -> pass
+    path = _write_ledger(tmp_path, base + [_serve_rec()])
+    assert pr.main(["--ledger", path, "--check", "--no-rounds"]) == 0
+    # plane too expensive -> exit 2, named failure
+    path = _write_ledger(tmp_path, base + [_serve_rec(
+        obs_overhead=0.05)])
+    assert pr.main(["--ledger", path, "--check", "--no-rounds"]) == 2
+    assert "observability plane costs" in capsys.readouterr().out
+    # a negative overhead (noise) never fails
+    path = _write_ledger(tmp_path, base + [_serve_rec(
+        obs_overhead=-0.03)])
+    assert pr.main(["--ledger", path, "--check", "--no-rounds"]) == 0
+    # admission starvation -> exit 2
+    path = _write_ledger(tmp_path, base + [_serve_rec(
+        admission_p99=120000.0)])
+    assert pr.main(["--ledger", path, "--check", "--no-rounds"]) == 2
+    assert "admission is starving" in capsys.readouterr().out
+    # a tightened threshold flips the same record
+    path = _write_ledger(tmp_path, base + [_serve_rec()])
+    assert pr.main(["--ledger", path, "--check", "--no-rounds",
+                    "--max-admission-p99", "400"]) == 2
+    # pre-round-13 record: both legs skip with a note, gate passes
+    old = _serve_rec()
+    del old["metrics"]["slo"], old["metrics"]["obs_overhead"]
+    del old["metrics"]["monitor"]
+    path = _write_ledger(tmp_path, base + [old])
+    assert pr.main(["--ledger", path, "--check", "--no-rounds"]) == 0
+    out = capsys.readouterr().out
+    assert "overhead gate skipped" in out
+    assert "admission gate skipped" in out
+
+
 # ----------------------------------------------------------------------
 # bench end-to-end smoke (slow: fresh-process sweep-kernel compile)
 # ----------------------------------------------------------------------

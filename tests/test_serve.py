@@ -585,3 +585,18 @@ def test_serve_bench_ledger_matches_final_line(tmp_path):
     assert recs[0]["metrics"] == line
     assert line["occupancy"] > 0.5
     assert line["value"] > 0
+    # round 13: the record carries the SLO + monitor blocks and the
+    # warm-arm observability A/B, and matches the checked-in schema
+    # (the serve_bench leg of the schema-drift guard)
+    from gibbs_student_t_tpu.obs import schema as obs_schema
+
+    schemas = obs_schema.load_schemas()
+    obs_schema.assert_valid(line, schemas["serve_bench_metrics"],
+                            "serve_bench final line", defs=schemas)
+    assert line["slo"]["admission_ms"]["p99"] >= \
+        line["slo"]["admission_ms"]["p50"]
+    assert line["slo"]["first_result_ms"] is not None
+    assert len(line["monitor"]) == line["tenants"]
+    for v in line["monitor"].values():
+        assert v["rows"] > 0 and v["ess_min"] > 0
+    assert isinstance(line["obs_overhead"], float)

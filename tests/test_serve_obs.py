@@ -1,0 +1,409 @@
+"""Live serving observability plane tests (round 13): per-tenant span
+tracing, the streaming convergence monitor, the SLO/status surface,
+and the schema-drift guard.
+
+The acceptance pins (ISSUE 10 / docs/OBSERVABILITY.md "Live serving
+observability"):
+
+- ``TenantHandle.progress()`` ESS / split-R-hat match the post-hoc
+  ``parallel/diagnostics.py`` values on the same rows to 1e-6;
+- ``ChainServer.export_trace()`` validates as Chrome trace-event JSON
+  and shows >= one span per (tenant, quantum, thread-role) for a
+  4-tenant run;
+- chains are bitwise identical with the plane on vs off;
+- every observability failure path (span sink IO error, monitor
+  exception, obs_dir refresh failure) degrades warn-and-continue —
+  the tenant and the pool never fail.
+
+Budget note: the module runs THREE pool compiles total — one shared
+4-tenant plane run (module fixture, reused by five tests), one
+plane-off server (the bitwise A/B), one failure-path server.
+"""
+
+import glob
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from tests.conftest import make_demo_pta
+from gibbs_student_t_tpu.config import GibbsConfig
+from gibbs_student_t_tpu.obs import schema as obs_schema
+from gibbs_student_t_tpu.serve import (
+    ChainServer,
+    MonitorSpec,
+    TenantRequest,
+)
+
+pytestmark = pytest.mark.obsplane
+
+MON_PARAMS = [0, 1, 2]
+NITERS = (15, 10, 15, 10)
+
+
+@pytest.fixture(scope="module")
+def demo():
+    pta = make_demo_pta()
+    return pta.frozen(0), GibbsConfig(model="mixture")
+
+
+@pytest.fixture(scope="module")
+def schemas():
+    return obs_schema.load_schemas()
+
+
+@pytest.fixture(scope="module")
+def plane_run(demo, tmp_path_factory):
+    """ONE 4-tenant run with the full plane armed (spans + JSONL sink,
+    monitor, obs_dir, metrics run_dir, crash manifest) — shared by the
+    span/progress/status/schema tests so tier-1 pays a single pool
+    compile for all of them."""
+    from gibbs_student_t_tpu.obs import MetricsRegistry
+
+    ma, cfg = demo
+    root = tmp_path_factory.mktemp("plane")
+    obs_dir = str(root / "obs")
+    run_dir = str(root / "run")
+    man_dir = str(root / "manifest")
+    reg = MetricsRegistry(run_dir=run_dir)
+    reg.write_manifest(config=cfg, seeds=list(range(len(NITERS))))
+    srv = ChainServer(ma, cfg, nlanes=32, quantum=5, record="full",
+                      metrics=reg, obs_dir=obs_dir,
+                      manifest_dir=man_dir,
+                      trace_jsonl=os.path.join(obs_dir, "spans.jsonl"))
+    spec = MonitorSpec(params=MON_PARAMS, ess_target=4.0,
+                       rhat_target=50.0)
+    hs = [srv.submit(TenantRequest(ma=ma, niter=n, nchains=16, seed=i,
+                                   name=f"t{i}", monitor=spec))
+          for i, n in enumerate(NITERS)]
+    srv.run()
+    trace_path = srv.export_trace(os.path.join(obs_dir, "trace.json"))
+    status = srv.status()
+    summary = srv.summary()
+    srv.close()
+    reg.close()
+    results = [h.result() for h in hs]
+    return {"server": srv, "handles": hs, "results": results,
+            "obs_dir": obs_dir, "run_dir": run_dir, "man_dir": man_dir,
+            "trace_path": trace_path, "status": status,
+            "summary": summary}
+
+
+# ----------------------------------------------------------------------
+# streaming convergence monitor
+# ----------------------------------------------------------------------
+
+
+def test_progress_matches_posthoc_diagnostics(plane_run):
+    """The acceptance pin: the streaming monitor's final ESS and
+    split-R-hat equal the post-hoc ``parallel/diagnostics`` values on
+    the same rows to 1e-6 — the monitor feeds on wire slices, the
+    post-hoc path on the materialized ChainResult, and the two must be
+    the same numbers."""
+    from gibbs_student_t_tpu.parallel.diagnostics import (
+        ess_per_param,
+        split_rhat_per_param,
+    )
+
+    for h, res, niter in zip(plane_run["handles"], plane_run["results"],
+                             NITERS):
+        p = h.progress()
+        assert p["status"] == "done" and p["rows"] == niter
+        window = np.asarray(res.chain)[:, :, MON_PARAMS]
+        ess_ref = ess_per_param(window)
+        rhat_ref = split_rhat_per_param(window)
+        assert abs(p["ess_min"] - ess_ref.min()) <= 1e-6 * ess_ref.min()
+        np.testing.assert_allclose(np.asarray(p["ess"], float), ess_ref,
+                                   rtol=1e-6)
+        fin = rhat_ref[np.isfinite(rhat_ref)]
+        assert abs(p["rhat_max"] - fin.max()) <= 1e-6 * fin.max()
+        assert p["ess_per_s"] is not None and p["ess_per_s"] > 0
+        # loose targets: every tenant converged in-flight, and the
+        # verdict rides the result stats too
+        assert p["converged_at"] is not None
+        assert res.stats["converged_at"] == p["converged_at"]
+        assert res.stats["monitor"]["ess_min"] == p["ess_min"]
+        assert h.converged_at == p["converged_at"]
+
+
+def test_monitor_spec_validation(demo):
+    ma, cfg = demo
+    with pytest.raises(ValueError, match="every"):
+        MonitorSpec(every=0)
+    from gibbs_student_t_tpu.serve.monitor import resolve_params
+
+    with pytest.raises(ValueError, match="not in"):
+        resolve_params(MonitorSpec(params=["nope"]), ["a", "b"])
+    with pytest.raises(ValueError, match="out of range"):
+        resolve_params(MonitorSpec(params=[7]), ["a", "b"])
+    assert list(resolve_params(MonitorSpec(params=["b", 0]),
+                               ["a", "b"])) == [1, 0]
+    assert list(resolve_params(MonitorSpec(), ["a", "b"])) == [0, 1]
+
+
+# ----------------------------------------------------------------------
+# span tracing
+# ----------------------------------------------------------------------
+
+
+def test_export_trace_is_valid_and_complete(plane_run, schemas):
+    """Chrome trace-event validity (schema-pinned) plus the coverage
+    pin: >= one span per (tenant, quantum, thread-role) for the
+    4-tenant run, for both per-quantum roles (dispatch + drain), and
+    at least one staging span per tenant."""
+    with open(plane_run["trace_path"]) as fh:
+        doc = json.load(fh)
+    obs_schema.assert_valid(doc, schemas["chrome_trace"],
+                            "chrome trace", defs=schemas)
+    ev = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert ev, "no complete events in the trace"
+    # pid 0 is the pool; tenants are pid = tenant_id + 1
+    per_tenant_q = {}
+    staged = set()
+    for e in ev:
+        if e["pid"] == 0:
+            continue
+        tid = e["pid"] - 1
+        if e["cat"] == "staging":
+            staged.add(tid)
+        q = e["args"].get("quantum")
+        if q is not None:
+            per_tenant_q.setdefault((tid, q), set()).add(e["cat"])
+    assert staged == {0, 1, 2, 3}
+    # every tenant advanced niter/quantum quanta; each (tenant,
+    # quantum) shows BOTH the dispatch-role and drain-role span
+    expected = {t for t in range(4)}
+    seen_tenants = {t for (t, _) in per_tenant_q}
+    assert seen_tenants == expected
+    for (t, q), roles in per_tenant_q.items():
+        if "dispatch" in roles:
+            assert "drain" in roles, (t, q, roles)
+    n_quanta = {t: sum(1 for (tt, _) in per_tenant_q if tt == t)
+                for t in range(4)}
+    for t, niter in enumerate(NITERS):
+        assert n_quanta[t] >= niter // 5, (t, n_quanta)
+    # process_name metadata names the tenants for the swimlane view
+    names = {e["pid"]: e["args"]["name"]
+             for e in doc["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert names[0] == "pool" and names[1] == "tenant t0"
+
+
+def test_span_recorder_ring_and_sink(tmp_path, schemas):
+    """Unit: the ring is bounded (drop-oldest + dropped counter), the
+    JSONL sink lines validate against the span schema, and a sink that
+    starts failing disables itself with a warning while recording
+    continues in memory."""
+    from gibbs_student_t_tpu.obs.spans import SpanRecorder
+
+    path = str(tmp_path / "spans.jsonl")
+    rec = SpanRecorder(capacity=8, jsonl_path=path)
+    for i in range(12):
+        with rec.span("step", "drain", tenant=i % 2, quantum=i):
+            pass
+    assert len(rec.spans()) == 8
+    assert rec.dropped == 4
+    lines = [json.loads(x) for x in open(path)]
+    assert len(lines) == 12
+    for ln in lines:
+        obs_schema.assert_valid(ln, schemas["span"], "span line",
+                                defs=schemas)
+    # break the sink: one RuntimeWarning, then memory-only recording
+    rec._sink.close()
+    with pytest.warns(RuntimeWarning, match="sink"):
+        rec.record("after", "drain", 0.0, 0.1)
+    rec.record("after2", "drain", 0.0, 0.1)  # quiet, still ringed
+    assert [s["name"] for s in rec.spans()][-2:] == ["after", "after2"]
+    rec.close()
+
+
+# ----------------------------------------------------------------------
+# SLO / status / exposition surface
+# ----------------------------------------------------------------------
+
+
+def test_status_slo_and_exposition(plane_run, schemas):
+    st = plane_run["status"]
+    obs_schema.assert_valid(st, schemas["serve_status"],
+                            "ChainServer.status()", defs=schemas)
+    # the obs_dir pull surface carries the same (schema-valid) shape
+    with open(os.path.join(plane_run["obs_dir"], "status.json")) as fh:
+        disk = json.load(fh)
+    obs_schema.assert_valid(disk, schemas["serve_status"],
+                            "status.json", defs=schemas)
+    slo = plane_run["summary"]["slo"]
+    for leg in ("admission_ms", "first_result_ms", "converged_ms"):
+        obs_schema.assert_valid(slo[leg], schemas["percentiles"],
+                                f"slo.{leg}", defs=schemas)
+        assert slo[leg]["p50"] <= slo[leg]["p99"] <= slo[leg]["max"]
+    assert slo["n_converged"] == 4
+    # prometheus text exposition: counters + the latency histograms
+    prom = open(os.path.join(plane_run["obs_dir"],
+                             "metrics.prom")).read()
+    assert "# TYPE gst_serve_admissions counter" in prom
+    assert 'gst_serve_admission_ms_bucket{le="+Inf"}' in prom
+    assert "gst_serve_first_result_ms_count" in prom
+    assert "gst_serve_converged_ms_count" in prom
+    # serve_top renders both surfaces without touching jax
+    import io
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import serve_top
+
+    out = io.StringIO()
+    assert serve_top.render(plane_run["obs_dir"], out=out)
+    text = out.getvalue()
+    assert "slo admission_ms" in text and "serve_top" in text
+    out = io.StringIO()
+    assert serve_top.render(plane_run["man_dir"], out=out)
+    assert "manifest" in out.getvalue()
+    out = io.StringIO()
+    assert not serve_top.render(str(plane_run["obs_dir"]) + "_nope",
+                                out=out)
+
+
+# ----------------------------------------------------------------------
+# schema-drift guard (the CI tripwire for docs/OBSERVABILITY.md)
+# ----------------------------------------------------------------------
+
+
+def test_emitted_records_validate_against_schemas(plane_run, schemas,
+                                                 tmp_path):
+    """Every record the smoke run emitted — events.jsonl lines, the
+    run manifest, the serve crash-manifest journal, span JSONL — plus
+    a freshly built ledger record and every record in the COMMITTED
+    artifacts/ledger.jsonl validate against the checked-in schemas.
+    A field rename in any emitter fails here, next to the docs it
+    drifted from."""
+    from gibbs_student_t_tpu.obs import ledger as ledger_mod
+    from gibbs_student_t_tpu.obs.metrics import read_events
+
+    for e in read_events(plane_run["run_dir"]):
+        obs_schema.assert_valid(e, schemas["event"], "event line",
+                                defs=schemas)
+    with open(os.path.join(plane_run["run_dir"],
+                           "manifest.json")) as fh:
+        obs_schema.assert_valid(json.load(fh), schemas["manifest"],
+                                "manifest.json", defs=schemas)
+    from gibbs_student_t_tpu.serve.manifest import read_manifest
+
+    recs = read_manifest(plane_run["man_dir"])
+    assert recs, "serve manifest journaled nothing"
+    for r in recs:
+        obs_schema.assert_valid(r, schemas["serve_manifest_record"],
+                                "serve manifest record", defs=schemas)
+    for line in open(os.path.join(plane_run["obs_dir"],
+                                  "spans.jsonl")):
+        obs_schema.assert_valid(json.loads(line), schemas["span"],
+                                "span line", defs=schemas)
+    # the bench record path: a fresh record through make_record +
+    # append_record + read_ledger round-trips schema-valid
+    lpath = str(tmp_path / "ledger.jsonl")
+    rec = ledger_mod.make_record(
+        "bench", {"metric": "chain_sweeps_per_s", "value": 1.0},
+        platform="cpu", config={"x": 1}, argv=["bench.py"])
+    ledger_mod.append_record(rec, lpath)
+    (back,) = ledger_mod.read_ledger(lpath)
+    obs_schema.assert_valid(back, schemas["ledger_record"],
+                            "fresh ledger record", defs=schemas)
+    # the committed evidence trail stays valid too — the guard that
+    # catches a schema change breaking historical readers
+    committed = os.path.join(os.path.dirname(__file__), "..",
+                             "artifacts", "ledger.jsonl")
+    n = 0
+    for r in ledger_mod.read_ledger(committed):
+        obs_schema.assert_valid(r, schemas["ledger_record"],
+                                f"committed ledger record "
+                                f"({r.get('tool')})", defs=schemas)
+        n += 1
+    assert n >= 10
+
+
+# ----------------------------------------------------------------------
+# bitwise + failure-path contracts
+# ----------------------------------------------------------------------
+
+
+def test_plane_on_off_chains_bitwise(demo, plane_run):
+    """The plane is pure host bookkeeping: the SAME 4-tenant schedule
+    with spans/monitor/obs_dir all disabled produces bitwise-identical
+    per-tenant results (every field, incl. per-TOA)."""
+    ma, cfg = demo
+    srv = ChainServer(ma, cfg, nlanes=32, quantum=5, record="full",
+                      spans=False)
+    hs = [srv.submit(TenantRequest(ma=ma, niter=n, nchains=16, seed=i,
+                                   name=f"t{i}"))
+          for i, n in enumerate(NITERS)]
+    srv.run()
+    srv.close()
+    for h, ref in zip(hs, plane_run["results"]):
+        res = h.result()
+        for f in ("chain", "zchain", "thetachain", "dfchain", "bchain",
+                  "alphachain", "poutchain"):
+            assert np.array_equal(np.asarray(getattr(res, f)),
+                                  np.asarray(getattr(ref, f))), f
+        for k in ("acc_white", "acc_hyper"):
+            assert np.array_equal(res.stats[k], ref.stats[k]), k
+
+
+def test_observability_failures_warn_and_continue(demo, tmp_path,
+                                                  monkeypatch):
+    """Sink IO error + monitor exception + obs refresh failure, all in
+    one run: every tenant still completes 'done' with intact results,
+    faults counters stay zero — observability never fails a tenant or
+    the pool (the PR 1 contract, serving edition)."""
+    from gibbs_student_t_tpu.serve.monitor import TenantMonitor
+
+    ma, cfg = demo
+    obs_dir = str(tmp_path / "obs")
+
+    def boom(self, *a, **k):
+        raise RuntimeError("injected monitor failure")
+
+    monkeypatch.setattr(TenantMonitor, "update", boom)
+    srv = ChainServer(ma, cfg, nlanes=32, quantum=5, record="full",
+                      obs_dir=obs_dir,
+                      trace_jsonl=str(tmp_path / "spans.jsonl"))
+    # break the span sink AND the obs_dir refresh mid-flight
+    srv.spans._sink.close()
+    import shutil
+
+    shutil.rmtree(obs_dir)
+    # a file where the directory should be makes the atomic replace
+    # fail on every refresh, not just the first
+    with open(obs_dir, "w") as fh:
+        fh.write("not a directory")
+    hs = [srv.submit(TenantRequest(
+        ma=ma, niter=10, nchains=16, seed=i, name=f"f{i}",
+        monitor=MonitorSpec(params=[0])))
+        for i in range(2)]
+    with pytest.warns(RuntimeWarning):
+        srv.run()
+        srv.close()
+    for h in hs:
+        assert h.status == "done"
+        res = h.result()
+        assert res.chain.shape[0] == 10
+        # the monitor was detached, not the tenant
+        assert h._monitor is None
+        assert res.stats.get("converged_at") is None
+    s = srv.summary()
+    assert s["faults"]["tenant_failures"] == 0
+    assert s["faults"]["pool_failures"] == 0
+
+
+def test_metrics_auto_created_for_obs_dir(demo, tmp_path):
+    """obs_dir without an explicit registry still gets an exposition
+    (an in-memory MetricsRegistry is created) — cheap: no server run,
+    construction + one refresh only."""
+    ma, cfg = demo
+    srv = ChainServer(ma, cfg, nlanes=32, quantum=5,
+                      obs_dir=str(tmp_path / "o"))
+    assert srv.metrics is not None
+    srv._refresh_obs()
+    assert os.path.exists(str(tmp_path / "o" / "status.json"))
+    srv.close()

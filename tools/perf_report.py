@@ -183,6 +183,27 @@ def print_report(ledger_recs, include_rounds=True):
                           f"p50={v.get('p50'):>8}ms "
                           f"p90={v.get('p90'):>8}ms "
                           f"max={v.get('max'):>8}ms")
+            # SLO sub-lines (round-13 records): the per-tenant latency
+            # percentiles + the observability plane's measured price
+            slo = m.get("slo") or {}
+            for name in ("admission_ms", "first_result_ms",
+                         "converged_ms"):
+                v = slo.get(name)
+                if isinstance(v, dict):
+                    print(f"    slo {name:16s} "
+                          f"p50={v.get('p50'):>8}ms "
+                          f"p90={v.get('p90'):>8}ms "
+                          f"p99={v.get('p99'):>8}ms")
+            mon = m.get("monitor")
+            if isinstance(mon, dict) and mon:
+                conv = sum(1 for v in mon.values()
+                           if isinstance(v, dict)
+                           and v.get("converged_at") is not None)
+                print(f"    monitor {conv}/{len(mon)} tenants "
+                      f"converged in-flight"
+                      + ("" if m.get("obs_overhead") is None else
+                         f"; obs_overhead="
+                         f"{m['obs_overhead'] * 100:+.2f}%"))
             # chaos-arm sub-line (serve_bench --faults records)
             f = m.get("faults")
             if isinstance(f, dict):
@@ -384,6 +405,52 @@ def check_faults(ledger_recs, max_fault_rate, min_fault_ratio):
     return 0
 
 
+def check_obs(ledger_recs, max_obs_overhead, max_admission_p99):
+    """Observability gate over the latest ``serve_bench`` record.
+
+    Two legs, each skipped with a note when the record predates its
+    field: ``obs_overhead`` (the plane-on vs plane-off A/B arm) must
+    not exceed ``--max-obs-overhead`` percent — the plane's contract
+    is that watching a server never costs meaningful throughput — and
+    the ``slo`` block's submit->admit p99 must stay under
+    ``--max-admission-p99`` ms (admission starving behind the
+    boundary/staging work is the liveness regression the SLO surface
+    exists to catch; queue-wait under deliberate backpressure is
+    included, hence the loose default)."""
+    serve = [r for r in ledger_recs if r.get("tool") == "serve_bench"]
+    if not serve:
+        print("check: no serve_bench record — obs gate skipped")
+        return 0
+    m = serve[-1].get("metrics") or {}
+    rc = 0
+    ovh = m.get("obs_overhead")
+    if isinstance(ovh, (int, float)):
+        print(f"check: obs_overhead {ovh * 100:+.2f}% "
+              f"(max {max_obs_overhead}%)")
+        if ovh * 100.0 > max_obs_overhead:
+            print(f"check: FAIL — observability plane costs "
+                  f"{ovh * 100:.2f}% of serving throughput "
+                  f"(> {max_obs_overhead}%): spans/monitor/refresh "
+                  "work is leaking into the serving hot path")
+            rc = 2
+    else:
+        print("check: obs_overhead absent (pre-round-13 record or "
+              "--no-obs-arm) — overhead gate skipped")
+    p99 = ((m.get("slo") or {}).get("admission_ms") or {}).get("p99")
+    if isinstance(p99, (int, float)):
+        print(f"check: admission p99 {p99:.1f}ms "
+              f"(max {max_admission_p99}ms)")
+        if p99 > max_admission_p99:
+            print(f"check: FAIL — submit->admit p99 {p99:.0f}ms > "
+                  f"{max_admission_p99:.0f}ms (admission is starving; "
+                  "see the slo/host_ms sub-lines on the serving row)")
+            rc = 2
+    else:
+        print("check: slo admission p99 absent — admission gate "
+              "skipped")
+    return rc
+
+
 def check_serve(ledger_recs, min_occupancy, min_serve_ratio):
     """Serving gate: the latest ``serve_bench`` record (when one
     exists) must report lane occupancy at or above ``min_occupancy``
@@ -490,6 +557,22 @@ def main(argv=None):
                     help="fault gate: minimum surviving-tenant "
                          "throughput under faults as a fraction of the "
                          "same run's no-fault arm (ratio_vs_nofault)")
+    ap.add_argument("--max-obs-overhead", type=float, default=2.0,
+                    metavar="PCT",
+                    help="observability gate: max tolerated serving "
+                         "throughput cost of the plane (the "
+                         "serve_bench obs-on vs obs-off A/B arm's "
+                         "obs_overhead; skipped when the record has "
+                         "no A/B arm)")
+    ap.add_argument("--max-admission-p99", type=float, default=60000.0,
+                    metavar="MS",
+                    help="observability gate: max tolerated "
+                         "submit->admit p99 latency (the slo block; "
+                         "includes deliberate backpressure queue-wait "
+                         "— the flagship staggered workload sits at "
+                         "~37s by design — hence the loose default: "
+                         "this is a starvation guard, not a tuning "
+                         "target)")
     ap.add_argument("--baseline", choices=("prev", "best"),
                     default="prev",
                     help="compare against the previous comparable "
@@ -511,9 +594,11 @@ def main(argv=None):
                           max_dispatch_growth=args.max_dispatch_growth)
         rc_serve = check_serve(recs, args.min_occupancy,
                                args.min_serve_ratio)
+        rc_obs = check_obs(recs, args.max_obs_overhead,
+                           args.max_admission_p99)
         rc_faults = check_faults(recs, args.max_fault_rate,
                                  args.min_fault_ratio)
-        return rc or rc_serve or rc_faults
+        return rc or rc_serve or rc_obs or rc_faults
     return 0
 
 

@@ -21,6 +21,17 @@ surviving-tenant throughput vs the no-fault arm, fault/quarantine
 counts — which ``perf_report --check`` gates (``--max-fault-rate``,
 ``--min-fault-ratio``).
 
+Round 13: the main workload runs with the full observability plane ON
+(per-tenant span tracing, the streaming convergence monitor on a
+``min(4, p)``-parameter subset with an ESS budget target, the obs_dir
+pull surface), and the record gains an ``slo`` block (submit->admit /
+admit->first-result / submit->converged percentiles incl. p99), a
+``monitor`` block (per-tenant final ESS / R-hat / converged_at), and
+— unless ``--no-obs-arm`` — an A/B arm with the plane OFF whose
+``obs_overhead`` fraction ``perf_report --check`` gates
+(``--max-obs-overhead``, default 2%) along with
+``--max-admission-p99``.
+
 Usage::
 
     python tools/serve_bench.py                 # flagship 1024 lanes
@@ -103,6 +114,19 @@ def main(argv=None):
     ap.add_argument("--fault-seed", type=int, default=0,
                     help="seed of the deterministic fault plan (which "
                          "tenants are victimized, and when)")
+    ap.add_argument("--no-obs-arm", action="store_true",
+                    help="skip the observability-off A/B arm (the "
+                         "main workload always runs the plane ON; the "
+                         "off arm is what prices it — obs_overhead in "
+                         "the record, gated by perf_report "
+                         "--max-obs-overhead)")
+    ap.add_argument("--ess-target", type=float, default=500.0,
+                    help="streaming-monitor ESS budget per monitored "
+                         "parameter (arXiv:1611.07056 frames ESS as "
+                         "the request budget): tenants count as "
+                         "converged when pooled min-ESS over the "
+                         "monitored subset reaches this — the "
+                         "submit->converged SLO leg")
     args = ap.parse_args(argv)
     if args.quick:
         args.nlanes = 64
@@ -133,7 +157,11 @@ def main(argv=None):
         make_contaminated_pulsar,
         make_reference_pta,
     )
-    from gibbs_student_t_tpu.serve import ChainServer, TenantRequest
+    from gibbs_student_t_tpu.serve import (
+        ChainServer,
+        MonitorSpec,
+        TenantRequest,
+    )
 
     platform = jax.default_backend()
 
@@ -174,19 +202,30 @@ def main(argv=None):
     budgets = [int(rng.integers(args.quanta_min, args.quanta_max + 1))
                * args.quantum for _ in range(args.tenants)]
 
-    def run_workload(mods=None):
+    def run_workload(mods=None, obs=True):
         """One staggered mixed-tenant phase on a fresh server; ``mods``
         maps tenant index -> TenantRequest kwargs overrides (the fault
-        arm's victim instrumentation). Returns (handles, wall_s,
-        summary)."""
+        arm's victim instrumentation). ``obs`` arms the full
+        observability plane — per-tenant spans, the streaming
+        convergence monitor (4-parameter subset, the --ess-target
+        budget), the obs_dir pull surface — vs. a plane-off arm (the
+        A/B that prices it). Returns (handles, wall_s, summary)."""
+        import tempfile
+
+        obs_dir = (tempfile.mkdtemp(prefix="gst_serve_obs_")
+                   if obs else None)
         srv = ChainServer(template, cfg, nlanes=args.nlanes,
                           quantum=args.quantum,
-                          pipeline=False if args.no_pipeline else "auto")
+                          pipeline=False if args.no_pipeline else "auto",
+                          spans=obs, obs_dir=obs_dir)
+        mon = (MonitorSpec(params=list(range(min(
+            4, len(template.param_names)))),
+            ess_target=args.ess_target) if obs else None)
 
         def req(i):
             kw = dict(ma=tenant_mas[i], niter=budgets[i],
                       nchains=chains_each, seed=args.seed + i,
-                      name=f"tenant{i}")
+                      name=f"tenant{i}", monitor=mon)
             kw.update((mods or {}).get(i, {}))
             return TenantRequest(**kw)
 
@@ -237,6 +276,43 @@ def main(argv=None):
             f"{len(bad)} tenant(s) failed in the NO-fault arm: "
             + "; ".join(str(h.error) for h in bad[:3]))
     agg = summary["busy_chain_sweeps"] / wall
+
+    # per-tenant final convergence view (the streaming monitor's last
+    # snapshot — matches the post-hoc diagnostics on the same rows)
+    monitor_block = {}
+    for h in handles:
+        p = h.progress()
+        monitor_block[h.request.name] = {
+            k: p.get(k) for k in ("rows", "ess_min", "rhat_max",
+                                  "ess_per_s", "converged_at")}
+    n_conv = sum(1 for v in monitor_block.values()
+                 if v["converged_at"] is not None)
+    print(f"# monitor: {n_conv}/{len(monitor_block)} tenants hit the "
+          f"ESS budget ({args.ess_target:g}) in-flight", file=sys.stderr)
+
+    # ---- observability A/B arm: price the plane -----------------------
+    # The FIRST workload of a process runs measurably slower than every
+    # later one on the 1-core host (allocator/page-cache/branch warmth
+    # — measured ~±1.5% between later arms vs ~20-30% first-vs-later),
+    # so the headline arm above cannot be the overhead numerator. The
+    # A/B runs two ADJACENT warm arms: plane off, then plane on again;
+    # their ratio is the plane's real cost.
+    obs_overhead = obs_off_sps = obs_on_sps = None
+    if not args.no_obs_arm:
+        ohandles, owall, osummary = run_workload(obs=False)
+        obad = [h for h in ohandles if h.status != "done"]
+        if obad:
+            raise RuntimeError(
+                f"{len(obad)} tenant(s) failed in the obs-off arm: "
+                + "; ".join(str(h.error) for h in obad[:3]))
+        obs_off_sps = osummary["busy_chain_sweeps"] / owall
+        h2, wall2, summary2 = run_workload()
+        obs_on_sps = summary2["busy_chain_sweeps"] / wall2
+        obs_overhead = (1.0 - obs_on_sps / obs_off_sps
+                        if obs_off_sps else None)
+        print(f"# obs A/B (warm arms): plane on {obs_on_sps:.1f} vs "
+              f"off {obs_off_sps:.1f} chain-sweeps/s -> overhead "
+              f"{obs_overhead * 100:+.2f}%", file=sys.stderr)
 
     # ---- fault-injection arm -----------------------------------------
     faults_block = None
@@ -320,6 +396,18 @@ def main(argv=None):
         # consecutive quantum dispatches — what attributes the
         # pipelining win (docs/SERVING.md)
         "host_ms": summary["host_ms"],
+        # SLO surface (round 13): per-tenant latency percentiles
+        # (submit->admit, admit->first-result, submit->converged; ms
+        # incl. p99) + per-tenant final streaming-monitor view + the
+        # plane's measured A/B cost
+        "slo": summary["slo"],
+        "monitor": monitor_block,
+        "obs_overhead": (None if obs_overhead is None
+                         else round(obs_overhead, 4)),
+        "obs_off_sweeps_per_s": (None if obs_off_sps is None
+                                 else round(obs_off_sps, 1)),
+        "obs_on_sweeps_per_s": (None if obs_on_sps is None
+                                else round(obs_on_sps, 1)),
     }
     if faults_block is not None:
         line["faults"] = faults_block

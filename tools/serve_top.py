@@ -1,0 +1,197 @@
+#!/usr/bin/env python
+"""Text dashboard over a live (or dead) chain server's pull surface.
+
+``ChainServer(obs_dir=...)`` refreshes ``status.json`` (the
+``status()`` snapshot) and ``metrics.prom`` at every quantum boundary;
+``ChainServer(manifest_dir=...)`` journals admissions / checkpoints /
+completions / faults to ``manifest.jsonl``. This tool renders either —
+no RPC, no jax import, just files:
+
+    python tools/serve_top.py RUN_DIR             # one-shot snapshot
+    python tools/serve_top.py RUN_DIR --watch     # refresh every 2 s
+    python tools/serve_top.py RUN_DIR --watch 0.5
+
+``RUN_DIR`` may hold a ``status.json`` (preferred: live occupancy,
+queue, per-tenant streaming ESS/R-hat, SLO percentiles) and/or a
+``manifest.jsonl`` (fallback: tenant lifecycle reconstructed from the
+journal — works on a crashed server too). Pure host-side parsing; safe
+to point at a directory a server is actively writing (status writes
+are atomic).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _read_status(run_dir):
+    path = os.path.join(run_dir, "status.json")
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None  # torn mid-replace is impossible; racing rm isn't
+
+
+def _read_manifest(run_dir):
+    """Tenant lifecycle from manifest.jsonl: {tenant_id: row} in
+    admission order, plus server geometry (latest epoch)."""
+    path = os.path.join(run_dir, "manifest.jsonl")
+    if not os.path.exists(path):
+        return None, None
+    server = None
+    tenants = {}
+    try:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail
+                kind = rec.get("kind")
+                if kind == "server":
+                    server = rec
+                    tenants = {}  # a new epoch resets the tenant set
+                elif kind == "admit":
+                    tenants[rec.get("tenant")] = {
+                        "tenant_id": rec.get("tenant"),
+                        "name": rec.get("name"),
+                        "nchains": rec.get("nchains"),
+                        "niter": rec.get("niter"),
+                        "status": "running",
+                        "sweeps_done": 0,
+                    }
+                elif kind == "checkpoint":
+                    t = tenants.get(rec.get("tenant"))
+                    if t is not None:
+                        t["sweeps_done"] = rec.get("next_sweep", 0)
+                elif kind == "done":
+                    t = tenants.get(rec.get("tenant"))
+                    if t is not None:
+                        t["status"] = rec.get("status", "done")
+                        t["sweeps_done"] = rec.get(
+                            "sweeps", t["sweeps_done"])
+                elif kind in ("fault", "quarantine", "reinit"):
+                    t = tenants.get(rec.get("tenant"))
+                    if t is not None:
+                        t.setdefault("events", []).append(kind)
+    except OSError:
+        return None, None
+    return server, tenants
+
+
+def _fmt(v, nd=1, width=8):
+    if v is None:
+        return " " * (width - 1) + "-"
+    if isinstance(v, float):
+        return f"{v:{width}.{nd}f}"
+    return f"{v:>{width}}"
+
+
+def _render_status(st, out):
+    occ = st.get("occupancy_now")
+    print(f"serve_top  quanta={st.get('quanta')} "
+          f"uptime={st.get('uptime_s', 0):.0f}s "
+          f"lanes={st.get('busy_lanes')}/{st.get('nlanes')} "
+          f"({(occ or 0) * 100:.0f}% now, "
+          f"{st.get('occupancy', 0) * 100:.1f}% run) "
+          f"queue={st.get('queue_depth')} staged={st.get('staged')} "
+          f"pipeline={'on' if st.get('pipeline') else 'off'}",
+          file=out)
+    f = st.get("faults") or {}
+    if any(f.values()):
+        print("faults: " + " ".join(f"{k}={v}" for k, v in f.items()
+                                    if v), file=out)
+    slo = st.get("slo") or {}
+    for leg in ("admission_ms", "first_result_ms", "converged_ms"):
+        p = slo.get(leg)
+        if isinstance(p, dict):
+            print(f"slo {leg:16s} p50={_fmt(p.get('p50'))} "
+                  f"p90={_fmt(p.get('p90'))} p99={_fmt(p.get('p99'))} "
+                  f"max={_fmt(p.get('max'))}", file=out)
+    tenants = st.get("tenants") or []
+    print(f"{'ID':>4} {'NAME':>10} {'STATUS':>8} {'CHAINS':>6} "
+          f"{'SWEEPS':>11} {'ROWS':>6} {'ESS':>8} {'RHAT':>7} "
+          f"{'ESS/s':>8} {'CONV@':>6} {'Q':>3}", file=out)
+    for t in tenants:
+        sw = f"{t.get('sweeps_done', 0)}/{t.get('niter', '?')}"
+        print(f"{_fmt(t.get('tenant_id'), width=4)} "
+              f"{str(t.get('name') or '-'):>10.10s} "
+              f"{t.get('status', '?'):>8} "
+              f"{_fmt(t.get('nchains'), width=6)} {sw:>11} "
+              f"{_fmt(t.get('rows'), width=6)} "
+              f"{_fmt(t.get('ess_min'), width=8)} "
+              f"{_fmt(t.get('rhat_max'), nd=3, width=7)} "
+              f"{_fmt(t.get('ess_per_s'), width=8)} "
+              f"{_fmt(t.get('converged_at'), width=6)} "
+              f"{_fmt(t.get('quarantined'), width=3)}", file=out)
+    if not tenants:
+        print("  (no running tenants)", file=out)
+
+
+def _render_manifest(server, tenants, out):
+    if server is not None:
+        print(f"serve_top (manifest) epoch={server.get('epoch')} "
+              f"nlanes={server.get('nlanes')} "
+              f"quantum={server.get('quantum')}", file=out)
+    print(f"{'ID':>4} {'NAME':>10} {'STATUS':>8} {'CHAINS':>6} "
+          f"{'SWEEPS':>11} {'EVENTS'}", file=out)
+    for t in (tenants or {}).values():
+        sw = f"{t.get('sweeps_done', 0)}/{t.get('niter', '?')}"
+        print(f"{_fmt(t.get('tenant_id'), width=4)} "
+              f"{str(t.get('name') or '-'):>10.10s} "
+              f"{t.get('status', '?'):>8} "
+              f"{_fmt(t.get('nchains'), width=6)} {sw:>11} "
+              f"{','.join(t.get('events', [])) or '-'}", file=out)
+    if not tenants:
+        print("  (no tenants journaled)", file=out)
+
+
+def render(run_dir, out=sys.stdout) -> bool:
+    """One dashboard frame; returns False when the directory has
+    neither surface."""
+    st = _read_status(run_dir)
+    if st is not None:
+        _render_status(st, out)
+        return True
+    server, tenants = _read_manifest(run_dir)
+    if tenants is not None:
+        _render_manifest(server, tenants, out)
+        return True
+    print(f"serve_top: no status.json or manifest.jsonl under "
+          f"{run_dir!r} (start the server with obs_dir= or "
+          f"manifest_dir=)", file=out)
+    return False
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("run_dir", help="the server's obs_dir (status.json"
+                                    " + metrics.prom) or manifest_dir")
+    ap.add_argument("--watch", nargs="?", const=2.0, type=float,
+                    default=None, metavar="SECONDS",
+                    help="refresh every SECONDS (default 2) until ^C")
+    args = ap.parse_args(argv)
+    if args.watch is None:
+        return 0 if render(args.run_dir) else 1
+    try:
+        while True:
+            sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+            render(args.run_dir)
+            sys.stdout.flush()
+            time.sleep(args.watch)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
