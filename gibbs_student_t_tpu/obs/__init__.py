@@ -35,6 +35,14 @@ machine-readable run records. This package supplies them:
 - :mod:`~gibbs_student_t_tpu.obs.schema` — machine-readable record
   schemas (``docs/observability.schema.json``) + the small validator
   behind the CI schema-drift guard.
+- :mod:`~gibbs_student_t_tpu.obs.http` — the observability wire:
+  read-only stdlib HTTP endpoints (``/healthz``, ``/status``,
+  ``/metrics``, ``/trace``, ``/tenants/<id>/progress``) mounted via
+  ``ChainServer(http_port=...)``.
+- :mod:`~gibbs_student_t_tpu.obs.aggregate` — multi-pool fleet
+  aggregation over those endpoints (or status.json paths): the merged
+  occupancy/SLO snapshot ROADMAP item 1's router places by
+  (``tools/fleet_status.py`` renders it).
 
 Import discipline: this package is imported by ``backends/jax_backend.py``
 at module load, so nothing here may import ``backends``/``parallel`` at
@@ -51,10 +59,12 @@ from gibbs_student_t_tpu.obs.ledger import (
     make_record,
     read_ledger,
 )
+from gibbs_student_t_tpu.obs.aggregate import fleet_status, read_status
 from gibbs_student_t_tpu.obs.export import (
     prometheus_text,
     write_prometheus,
 )
+from gibbs_student_t_tpu.obs.http import ObsHttpServer
 from gibbs_student_t_tpu.obs.metrics import (
     MetricsRegistry,
     read_events,
@@ -75,8 +85,11 @@ __all__ = [
     "compile_summary",
     "introspect_jit",
     "register_kernel",
+    "fleet_status",
+    "read_status",
     "prometheus_text",
     "write_prometheus",
+    "ObsHttpServer",
     "SpanRecorder",
     "append_record",
     "make_record",

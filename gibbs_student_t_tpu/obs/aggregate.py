@@ -1,0 +1,191 @@
+"""Multi-pool fleet aggregation over the observability wire.
+
+ROADMAP item 1's router shards tenants across N ``ChainServer`` pools
+and must "place by ``status()`` occupancy/SLO" and "expose fleet-level
+aggregated status". This module is that read path, built before any
+mutating RPC exists: poll each pool's observability endpoint
+(obs/http.py ``GET /status``) **or** its ``obs_dir`` ``status.json``
+(the file surface keeps working for same-host pools and dead servers),
+and merge the snapshots into one schema-validated fleet view
+(``fleet_status`` in ``docs/observability.schema.json``).
+
+Merge semantics:
+
+- **occupancy / queue** aggregate by summation over reachable pools
+  (``totals`` — busy lanes over pool lanes is the fleet occupancy the
+  router places by);
+- **SLO percentiles merge from the raw series**, not from per-pool
+  percentiles (percentiles do not average): every pool's status
+  carries ``slo_raw`` — the per-tenant submit→admit /
+  admit→first-result / submit→converged ms series — and the fleet
+  percentiles are computed over their concatenation. Pools predating
+  ``slo_raw`` simply contribute nothing to the merged legs.
+- **unreachable pools are reported, never fatal**: a refused
+  connection, timeout, or unparseable body lands as
+  ``{"reachable": false, "error": ...}`` in ``pools`` and the merge
+  continues — a dead pool is exactly what a fleet view must show.
+
+Import discipline: stdlib + numpy only — ``tools/fleet_status.py``
+and ``tools/serve_top.py`` load this module by file path so a fleet
+dashboard never imports jax (the serve_top contract).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.request
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+FLEET_SCHEMA = 1
+
+#: slo legs merged across pools (the ``slo_raw`` series names)
+SLO_LEGS = ("admission_ms", "first_result_ms", "converged_ms")
+
+
+def _percentiles(vals: List[float]) -> Optional[dict]:
+    """{p50, p90, p99, max, mean} over a ms series (None if empty) —
+    the same block shape as ``serve/server.py`` emits per pool."""
+    if not vals:
+        return None
+    a = np.asarray(vals, np.float64)
+    return {
+        "p50": round(float(np.percentile(a, 50)), 3),
+        "p90": round(float(np.percentile(a, 90)), 3),
+        "p99": round(float(np.percentile(a, 99)), 3),
+        "max": round(float(a.max()), 3),
+        "mean": round(float(a.mean()), 3),
+    }
+
+
+def read_status(source: str, timeout: float = 2.0) -> dict:
+    """One pool's status snapshot. ``source`` is an endpoint URL (the
+    ``/status`` suffix is appended unless already present), an
+    ``obs_dir`` directory, or a ``status.json`` path. Raises on any
+    failure — :func:`fleet_status` is the caller that degrades."""
+    src = str(source)
+    if src.startswith(("http://", "https://")):
+        url = src.rstrip("/")
+        if not url.endswith("/status"):
+            url += "/status"
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            st = json.loads(resp.read().decode())
+    else:
+        path = src
+        if os.path.isdir(path):
+            path = os.path.join(path, "status.json")
+        with open(path) as fh:
+            st = json.load(fh)
+    if not isinstance(st, dict):
+        raise ValueError(f"status from {source!r} is not an object")
+    return st
+
+
+def _pool_entry(source: str, st: dict) -> dict:
+    """The per-pool row of the fleet snapshot: the placement-relevant
+    subset of one reachable pool's status."""
+    faults = st.get("faults") or {}
+    tenants = st.get("tenants") or []
+    return {
+        "source": str(source),
+        "reachable": True,
+        "error": None,
+        "nlanes": st.get("nlanes"),
+        "busy_lanes": st.get("busy_lanes"),
+        "free_groups": st.get("free_groups"),
+        "occupancy_now": st.get("occupancy_now"),
+        "occupancy": st.get("occupancy"),
+        "queue_depth": st.get("queue_depth"),
+        "staged": st.get("staged"),
+        "running_tenants": len(tenants),
+        "quanta": st.get("quanta"),
+        "uptime_s": st.get("uptime_s"),
+        # healthy = the pool itself never failed; tenant-scoped faults
+        # are contained by design and do not disqualify a pool
+        "healthy": not faults.get("pool_failures"),
+        "faults": faults,
+    }
+
+
+def fleet_status(sources: Sequence[str], timeout: float = 2.0) -> dict:
+    """Poll every source and merge into one fleet snapshot (the
+    ``fleet_status`` schema). Unreachable pools are reported in
+    ``pools`` with ``reachable: false`` — never fatal."""
+    pools = []
+    raw = {leg: [] for leg in SLO_LEGS}
+    totals = {"nlanes": 0, "busy_lanes": 0, "queue_depth": 0,
+              "staged": 0, "running_tenants": 0}
+    n_converged = 0
+    for src in sources:
+        try:
+            st = read_status(src, timeout=timeout)
+        except Exception as e:  # noqa: BLE001 - a dead pool is data
+            pools.append({"source": str(src), "reachable": False,
+                          "error": f"{type(e).__name__}: {e}"})
+            continue
+        entry = _pool_entry(src, st)
+        pools.append(entry)
+        for k in ("nlanes", "busy_lanes", "queue_depth", "staged"):
+            v = st.get(k)
+            if isinstance(v, (int, float)):
+                totals[k] += int(v)
+        totals["running_tenants"] += entry["running_tenants"]
+        slo_raw = st.get("slo_raw") or {}
+        for leg in SLO_LEGS:
+            raw[leg].extend(v for v in (slo_raw.get(leg) or [])
+                            if isinstance(v, (int, float)))
+        nc = (st.get("slo") or {}).get("n_converged")
+        if isinstance(nc, (int, float)):
+            n_converged += int(nc)
+    totals["occupancy_now"] = (totals["busy_lanes"] / totals["nlanes"]
+                               if totals["nlanes"] else 0.0)
+    slo = {leg: _percentiles(raw[leg]) for leg in SLO_LEGS}
+    slo["n_converged"] = n_converged
+    return {
+        "schema": FLEET_SCHEMA,
+        "t": round(time.time(), 3),
+        "n_pools": len(pools),
+        "n_reachable": sum(1 for p in pools if p["reachable"]),
+        "pools": pools,
+        "totals": totals,
+        "slo": slo,
+    }
+
+
+def render_fleet(snap: dict, out) -> None:
+    """One fleet dashboard frame (the ``tools/fleet_status.py``
+    renderer; serve_top-style fixed columns, no jax import)."""
+    tot = snap.get("totals") or {}
+    print(f"fleet_status  pools={snap.get('n_reachable')}/"
+          f"{snap.get('n_pools')} reachable "
+          f"lanes={tot.get('busy_lanes')}/{tot.get('nlanes')} "
+          f"({(tot.get('occupancy_now') or 0) * 100:.0f}% now) "
+          f"queue={tot.get('queue_depth')} staged={tot.get('staged')} "
+          f"tenants={tot.get('running_tenants')}", file=out)
+    slo = snap.get("slo") or {}
+    for leg in SLO_LEGS:
+        p = slo.get(leg)
+        if isinstance(p, dict):
+            print(f"slo {leg:16s} p50={p.get('p50'):>8} "
+                  f"p90={p.get('p90'):>8} p99={p.get('p99'):>8} "
+                  f"(merged from raw series)", file=out)
+    print(f"{'POOL':40s} {'OK':>4} {'LANES':>9} {'OCC%':>6} "
+          f"{'QUEUE':>5} {'TEN':>4} {'FAULTS'}", file=out)
+    for p in snap.get("pools") or []:
+        src = str(p.get("source"))[:40]
+        if not p.get("reachable"):
+            print(f"{src:40s} {'DOWN':>4}  {p.get('error')}", file=out)
+            continue
+        lanes = f"{p.get('busy_lanes')}/{p.get('nlanes')}"
+        occ = (p.get("occupancy_now") or 0) * 100
+        f = p.get("faults") or {}
+        fstr = " ".join(f"{k}={v}" for k, v in f.items() if v) or "-"
+        # str() the sparse fields: a pool serving a partial status is
+        # still a renderable row, not a dashboard crash
+        print(f"{src:40s} {'ok' if p.get('healthy') else 'SICK':>4} "
+              f"{lanes:>9} {occ:6.1f} "
+              f"{str(p.get('queue_depth')):>5} "
+              f"{str(p.get('running_tenants')):>4} {fstr}", file=out)

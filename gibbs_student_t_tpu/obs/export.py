@@ -25,10 +25,30 @@ import warnings
 from typing import Optional
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+_LABEL_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
 
 #: paths that already warned about a failed write (warn once, then
 #: stay quiet — the refresh runs every quantum)
 _WARNED = set()
+
+#: ``# HELP`` texts for the known metric families; unknown families
+#: get a generic kind-derived line (exposition format wants HELP/TYPE
+#: exactly once per family, before its samples)
+_HELP = {
+    "gst_serve_occupancy": "Busy chain-lanes / pool lanes, per quantum",
+    "gst_serve_queue_depth": "Admission queue depth",
+    "gst_serve_admissions": "Tenants admitted",
+    "gst_serve_admission_ms": "Submit->admit latency (queue wait incl.)",
+    "gst_serve_first_result_ms": "Admit->first drained result latency",
+    "gst_serve_converged_ms": "Submit->converged latency (monitored)",
+    "gst_serve_sweeps_total": "Chain-sweeps served",
+    "gst_serve_tenant_faults": "Tenant-scoped contained failures",
+    "gst_serve_quarantined_lanes": "Lanes frozen by quarantine policy",
+    "gst_serve_reinits": "Lanes re-drawn from the prior",
+    "gst_serve_worker_restarts": "Supervised executor worker restarts",
+    "gst_serve_monitor_errors": "Detached per-tenant monitors",
+    "gst_serve_spans_dropped": "Spans dropped from the bounded ring",
+}
 
 
 def _metric_name(name: str, prefix: str = "gst_") -> str:
@@ -37,6 +57,39 @@ def _metric_name(name: str, prefix: str = "gst_") -> str:
     if not name or not (name[0].isalpha() or name[0] in "_:"):
         name = "_" + name
     return prefix + name if not name.startswith(prefix) else name
+
+
+def _escape_label_value(value) -> str:
+    """Label-value escaping per the exposition format: backslash,
+    double-quote and newline must be escaped inside the quotes."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(text: str) -> str:
+    """HELP-text escaping: backslash and newline only (quotes are
+    legal in HELP lines)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _label_str(labels) -> str:
+    """``{k="v",...}`` with sanitized names and escaped values; empty
+    string when no labels."""
+    if not labels:
+        return ""
+    parts = []
+    for k in sorted(labels):
+        name = _LABEL_NAME_RE.sub("_", str(k)) or "_"
+        parts.append(f'{name}="{_escape_label_value(labels[k])}"')
+    return "{" + ",".join(parts) + "}"
+
+
+def _merge_labels(label_str: str, extra: str) -> str:
+    """Combine a rendered instance-label block with one extra
+    ``k="v"`` pair (the histogram ``le`` label)."""
+    if not label_str:
+        return "{" + extra + "}"
+    return label_str[:-1] + "," + extra + "}"
 
 
 def _fmt(v) -> str:
@@ -51,50 +104,65 @@ def _fmt(v) -> str:
 
 
 def prometheus_text(snapshot: dict, prefix: str = "gst_",
-                    ts_ms: Optional[int] = None) -> str:
+                    ts_ms: Optional[int] = None,
+                    labels: Optional[dict] = None) -> str:
     """Render a ``MetricsRegistry.snapshot()`` dict as Prometheus text.
 
     Counters keep their value, gauges their last value, histograms
     become the standard cumulative ``_bucket``/``_sum``/``_count``
     family. ``ts_ms`` (unix milliseconds) stamps every sample when
     given — useful for file-scraped expositions where collection lag
-    matters.
+    matters. ``labels`` attaches one instance-level label set to every
+    sample (the fleet aggregator's per-pool tagging); values are
+    escaped per the exposition format (``\\``, ``"``, newline), so
+    hostile strings cannot tear the exposition
+    (tests/test_obs_wire.py). ``# HELP``/``# TYPE`` are emitted
+    exactly once per family, before its samples.
     """
     out = []
     suffix = f" {ts_ms}" if ts_ms is not None else ""
+    lbl = _label_str(labels)
+
+    def _head(n: str, kind: str) -> None:
+        out.append(f"# HELP {n} "
+                   f"{_escape_help(_HELP.get(n, f'{kind} {n}'))}")
+        out.append(f"# TYPE {n} {kind}")
 
     for name, value in sorted((snapshot.get("counters") or {}).items()):
         n = _metric_name(name, prefix)
-        out.append(f"# TYPE {n} counter")
-        out.append(f"{n} {_fmt(value)}{suffix}")
+        _head(n, "counter")
+        out.append(f"{n}{lbl} {_fmt(value)}{suffix}")
     for name, value in sorted((snapshot.get("gauges") or {}).items()):
         n = _metric_name(name, prefix)
-        out.append(f"# TYPE {n} gauge")
-        out.append(f"{n} {_fmt(value)}{suffix}")
+        _head(n, "gauge")
+        out.append(f"{n}{lbl} {_fmt(value)}{suffix}")
     for name, h in sorted((snapshot.get("histograms") or {}).items()):
         n = _metric_name(name, prefix)
-        out.append(f"# TYPE {n} histogram")
+        _head(n, "histogram")
         cum = 0
         buckets = h.get("buckets") or {}
-        # registry buckets are per-bin counts keyed by upper bound
-        # (with a trailing "+inf"); prometheus wants cumulative le=
+        # registry buckets are per-bin counts keyed by ascending upper
+        # bound (with a trailing "+inf"); prometheus wants cumulative
+        # le= rows, monotone non-decreasing by construction
         for le, c in buckets.items():
             cum += int(c)
             le_lbl = "+Inf" if le in ("+inf", "+Inf") else le
-            out.append(f'{n}_bucket{{le="{le_lbl}"}} {cum}{suffix}')
-        out.append(f"{n}_sum {_fmt(h.get('sum', 0.0))}{suffix}")
-        out.append(f"{n}_count {int(h.get('count', 0))}{suffix}")
+            row_lbl = _merge_labels(lbl, f'le="{le_lbl}"')
+            out.append(f"{n}_bucket{row_lbl} {cum}{suffix}")
+        out.append(f"{n}_sum{lbl} {_fmt(h.get('sum', 0.0))}{suffix}")
+        out.append(f"{n}_count{lbl} {int(h.get('count', 0))}{suffix}")
     return "\n".join(out) + "\n"
 
 
-def write_prometheus(registry, path: str, prefix: str = "gst_") -> \
-        Optional[str]:
+def write_prometheus(registry, path: str, prefix: str = "gst_",
+                     labels: Optional[dict] = None) -> Optional[str]:
     """Atomically write ``registry``'s snapshot to ``path`` in the
     exposition format. Returns the path, or None (with one warning per
     path) when the write fails — a refresh must never crash a run."""
     try:
         text = prometheus_text(registry.snapshot(), prefix=prefix,
-                               ts_ms=int(time.time() * 1e3))
+                               ts_ms=int(time.time() * 1e3),
+                               labels=labels)
         tmp = path + ".tmp"
         with open(tmp, "w") as fh:
             fh.write(text)

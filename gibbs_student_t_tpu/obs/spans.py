@@ -69,7 +69,12 @@ class SpanRecorder:
     """Bounded ring of host spans + optional JSONL sink.
 
     ``capacity`` bounds the in-memory ring (a deque — old spans fall
-    off, a long-lived server cannot grow without bound);
+    off, a long-lived server cannot grow without bound). Drops are
+    ACCOUNTED, never silent (round 14): the :attr:`dropped` counter
+    counts overflow evictions, the first drop warns once, every drop
+    increments a ``serve_spans_dropped`` counter on the attached
+    ``metrics`` registry (when one was passed), and the export carries
+    the total in its ``otherData.dropped_spans`` metadata.
     ``jsonl_path``, when given, additionally appends one JSON line per
     span as it closes (crash-tolerant: every line is flushed). A sink
     IO error disables the sink with a single ``RuntimeWarning`` and
@@ -77,7 +82,7 @@ class SpanRecorder:
     """
 
     def __init__(self, capacity: int = 65536,
-                 jsonl_path: Optional[str] = None):
+                 jsonl_path: Optional[str] = None, metrics=None):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.capacity = int(capacity)
@@ -85,6 +90,8 @@ class SpanRecorder:
         self._ring = collections.deque(maxlen=self.capacity)
         self._lock = threading.Lock()
         self._dropped = 0
+        self._drop_warned = False
+        self._metrics = metrics
         self._sink = None
         self._sink_path = jsonl_path
         if jsonl_path:
@@ -119,10 +126,26 @@ class SpanRecorder:
             if args:
                 rec["args"] = args
             with self._lock:
-                if len(self._ring) == self.capacity:
+                dropped_now = len(self._ring) == self.capacity
+                if dropped_now:
                     self._dropped += 1
                 self._ring.append(rec)
                 sink = self._sink
+            if dropped_now:
+                if self._metrics is not None:
+                    try:
+                        self._metrics.counter(
+                            "serve_spans_dropped").inc()
+                    except Exception:  # noqa: BLE001 - accounting only
+                        pass
+                if not self._drop_warned:
+                    self._drop_warned = True
+                    warnings.warn(
+                        f"span ring overflowed (capacity "
+                        f"{self.capacity}); oldest spans are being "
+                        "dropped — raise span_capacity or attach a "
+                        "JSONL sink for complete traces",
+                        RuntimeWarning)
             if sink is not None:
                 line = json.dumps(rec) + "\n"
                 try:
@@ -169,15 +192,17 @@ class SpanRecorder:
             except Exception:  # noqa: BLE001
                 pass
 
-    def export_chrome_trace(self, path: str,
-                            tenant_names: Optional[Dict] = None) -> str:
-        """Write the ring as Chrome trace-event JSON (the Perfetto /
-        ``chrome://tracing`` format): one complete ("ph": "X") event
-        per span, ``pid`` = tenant id (so each tenant is a swimlane;
-        pool-level spans land on pid 0 "pool"), ``tid`` = thread role,
-        ``ts``/``dur`` in microseconds since the recorder epoch.
-        ``tenant_names`` maps tenant id -> display name for the
-        process_name metadata rows. Returns ``path``."""
+    def chrome_trace_doc(self,
+                         tenant_names: Optional[Dict] = None) -> Dict:
+        """The ring as a Chrome trace-event document (the Perfetto /
+        ``chrome://tracing`` format), rendered in memory: one complete
+        ("ph": "X") event per span, ``pid`` = tenant id (so each
+        tenant is a swimlane; pool-level spans land on pid 0 "pool"),
+        ``tid`` = thread role, ``ts``/``dur`` in microseconds since
+        the recorder epoch. ``tenant_names`` maps tenant id -> display
+        name for the process_name metadata rows. This is what
+        :meth:`export_chrome_trace` writes and the ``/trace`` HTTP
+        endpoint serves."""
         spans = self.spans()
         roles = {}   # role -> stable small tid
         events = []
@@ -208,8 +233,14 @@ class SpanRecorder:
                 meta.append({"name": "thread_name", "ph": "M",
                              "pid": pid, "tid": tid,
                              "args": {"name": role}})
-        doc = {"traceEvents": meta + events, "displayTimeUnit": "ms",
-               "otherData": {"dropped_spans": self.dropped}}
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms",
+                "otherData": {"dropped_spans": self.dropped}}
+
+    def export_chrome_trace(self, path: str,
+                            tenant_names: Optional[Dict] = None) -> str:
+        """Write :meth:`chrome_trace_doc` to ``path`` (atomic).
+        Returns ``path``."""
+        doc = self.chrome_trace_doc(tenant_names=tenant_names)
         tmp = path + ".tmp"
         with open(tmp, "w") as fh:
             json.dump(doc, fh)

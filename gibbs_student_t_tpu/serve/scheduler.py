@@ -133,6 +133,12 @@ class TenantHandle:
         # attached at finalize; None when the pool ran telemetry-off
         self.health: Optional[Dict] = None
         self._tenant_error: Optional[TenantError] = None
+        # per-tenant cost accounting (round 14): each quantum's
+        # dispatch wall time attributed across co-resident tenants by
+        # active-lane share. Written by exactly one thread (the drain
+        # worker / the serial driver); readers see GIL-atomic floats.
+        self.cost_device_ms = 0.0
+        self.cost_lane_quanta = 0
 
     # -- lifecycle (server side) ---------------------------------------
 
@@ -195,7 +201,36 @@ class TenantHandle:
         self.status = "failed"
         self._done.set()
 
+    def _add_cost(self, device_ms: float, lane_quanta: int) -> None:
+        """Fold one quantum's attributed share (single-writer: the
+        drain worker, or the serial driver's one thread)."""
+        self.cost_device_ms += device_ms
+        self.cost_lane_quanta += int(lane_quanta)
+
     # -- caller side ----------------------------------------------------
+
+    def cost(self) -> Dict[str, object]:
+        """The tenant's cost block (docs/OBSERVABILITY.md "The
+        observability wire"): ``device_ms`` — this tenant's
+        active-lane share of every quantum's dispatch wall time (the
+        shares across co-resident tenants sum to the measured dispatch
+        wall); ``lane_quanta`` — active chain-lanes × quanta consumed;
+        ``ess_per_core_s`` — monitored min-ESS per attributed core
+        second (None unmonitored / before the first evaluation): the
+        throughput-per-compute economics ROADMAP item 4's eviction
+        policy and item 1's router place by."""
+        ess_min = None
+        if self._monitor is not None:
+            ess_min = self._monitor.snapshot().get("ess_min")
+        core_s = self.cost_device_ms / 1e3
+        return {
+            "device_ms": round(self.cost_device_ms, 3),
+            "lane_quanta": int(self.cost_lane_quanta),
+            "ess_per_core_s": (
+                round(float(ess_min) / core_s, 3)
+                if isinstance(ess_min, (int, float)) and core_s > 0
+                else None),
+        }
 
     @property
     def admission_ms(self) -> Optional[float]:
@@ -234,6 +269,7 @@ class TenantHandle:
         }
         if self._monitor is not None:
             p.update(self._monitor.snapshot())
+        p["cost"] = self.cost()
         return p
 
     @property

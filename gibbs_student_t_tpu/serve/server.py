@@ -174,7 +174,11 @@ class _Bundle:
     no records this quantum, but its failure must be delivered in
     drain order, after its last real drain). ``idx`` tracks progress
     so a dying worker can abort exactly the undrained tail. ``qidx``
-    is the quantum index the bundle drains (span attribution)."""
+    is the quantum index the bundle drains (span attribution).
+    ``cost`` is the quantum's cost-attribution payload —
+    ``(dispatch_ms, [(handle, active_lanes), ...])`` — folded into
+    the per-tenant accumulators by the drain worker (bookkeeping off
+    the dispatch thread), or None for finalize-only bundles."""
 
     recs: object
     tl: object
@@ -182,6 +186,7 @@ class _Bundle:
     entries: list
     idx: int = 0
     qidx: int = -1
+    cost: object = None
 
 
 def _percentiles(vals: List[float]) -> Optional[dict]:
@@ -216,7 +221,9 @@ class ChainServer:
                  supervise="auto", manifest_dir: Optional[str] = None,
                  spans: bool = True, span_capacity: int = 65536,
                  trace_jsonl: Optional[str] = None,
-                 obs_dir: Optional[str] = None):
+                 obs_dir: Optional[str] = None,
+                 http_port: Optional[int] = None,
+                 http_host: str = "127.0.0.1"):
         """``pipeline`` selects the driver ``run()`` uses: ``"auto"``
         (default) follows ``GST_SERVE_PIPELINE`` (auto -> pipelined);
         ``True``/``False`` force it, still overridden by an explicit
@@ -242,15 +249,26 @@ class ChainServer:
         ``metrics.prom`` (Prometheus text exposition of the attached
         registry — one is created in-memory if ``metrics`` is None),
         which ``tools/serve_top.py`` renders as a terminal dashboard.
+
+        The observability wire (round 14; docs/OBSERVABILITY.md "The
+        observability wire"): ``http_port`` mounts a read-only stdlib
+        HTTP endpoint server (obs/http.py) on its own daemon thread —
+        ``/healthz``, ``/status``, ``/metrics``, ``/trace``,
+        ``/tenants/<id>/progress`` — port 0 binds an ephemeral port
+        (read it back from ``server.http.port``). Mount failure warns
+        and serving continues without the wire; chains are bitwise
+        identical with the HTTP server on or off (pure host reads).
         """
         import jax.numpy as jnp
 
-        if obs_dir is not None and metrics is None:
+        if (obs_dir is not None or http_port is not None) \
+                and metrics is None:
             from gibbs_student_t_tpu.obs.metrics import MetricsRegistry
 
             metrics = MetricsRegistry()   # exposition needs a registry
         self.spans = (SpanRecorder(capacity=span_capacity,
-                                   jsonl_path=trace_jsonl)
+                                   jsonl_path=trace_jsonl,
+                                   metrics=metrics)
                       if spans else None)
         self.obs_dir = obs_dir
         if obs_dir is not None:
@@ -258,6 +276,11 @@ class ChainServer:
         self._obs_warned = False
         self._t_started = time.monotonic()
         self._tenant_names: Dict[int, object] = {}
+        # every handle ever submitted, by tenant id — the ``/tenants/
+        # <id>/progress`` endpoint's lookup table (progress() stays
+        # callable after completion; same keep-everything lifetime as
+        # _tenant_names)
+        self._handles: Dict[int, TenantHandle] = {}
         # SLO series (ms; drain-worker/caller appends are GIL-atomic,
         # the _drain_ms precedent): submit->admit rides _admission_ms
         self._first_result_ms: List[float] = []
@@ -352,6 +375,28 @@ class ChainServer:
         self._fault_counts = {"tenant_failures": 0,
                               "quarantined_lanes": 0, "reinits": 0,
                               "worker_restarts": 0, "pool_failures": 0}
+        # cost accounting (round 14): total measured dispatch wall —
+        # the quantity the per-tenant device_ms shares sum back to
+        self._dispatch_wall_ms = 0.0
+        # the observability wire: read-only HTTP endpoints on a daemon
+        # thread; a mount failure downgrades to no wire, never a crash
+        self.http = None
+        if http_port is not None:
+            try:
+                from gibbs_student_t_tpu.obs.http import ObsHttpServer
+
+                self.http = ObsHttpServer(
+                    host=http_host, port=http_port,
+                    status_fn=self.status, healthz_fn=self.healthz,
+                    metrics_fn=self._metrics_text,
+                    trace_fn=self._trace_doc,
+                    progress_fn=self._tenant_progress)
+            except Exception as e:  # noqa: BLE001 - obs contract
+                warnings.warn(
+                    f"observability HTTP server failed to start on "
+                    f"{http_host}:{http_port} ({type(e).__name__}: "
+                    f"{e}); serving continues without the wire",
+                    RuntimeWarning)
 
     def reset_counters(self) -> None:
         """Zero the run-level aggregates (the serve_bench warmup
@@ -366,6 +411,7 @@ class ChainServer:
         self._first_result_ms.clear()
         self._converged_ms.clear()
         self._last_dispatch_t = None
+        self._dispatch_wall_ms = 0.0
         for k in self._fault_counts:
             self._fault_counts[k] = 0
 
@@ -424,6 +470,7 @@ class ChainServer:
         with self._lock:
             handle = TenantHandle(self._next_id, request)
             self._next_id += 1
+            self._handles[handle.tenant_id] = handle
         self.queue.put(handle, timeout=timeout)
         if self.metrics is not None:
             self.metrics.gauge("serve_queue_depth").set(len(self.queue))
@@ -657,6 +704,34 @@ class ChainServer:
             self._apply_prepared(prep)
 
     # ------------------------------------------------------------------
+    # cost accounting (round 14)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _cost_shares(running) -> List:
+        """``[(handle, active_lanes), ...]`` for one quantum's
+        co-resident tenants (quarantined lanes are frozen — they do
+        no work and buy no share)."""
+        return [(t.handle,
+                 max(t.slot.nchains - len(t.slot.quarantined), 0))
+                for t in running]
+
+    @staticmethod
+    def _attribute_cost(dispatch_ms: float, shares: List) -> None:
+        """Split one quantum's dispatch wall time across its tenants
+        by active-lane share. The shares sum to exactly
+        ``dispatch_ms``, so per-tenant ``cost.device_ms`` totals
+        reconcile with ``summary()['cost']['dispatch_wall_ms']``
+        (the serve_bench acceptance pin). Runs on the drain worker
+        (pipelined) or the single serial thread."""
+        total = sum(a for _, a in shares)
+        if total <= 0:
+            return
+        for handle, act in shares:
+            if act:
+                handle._add_cost(dispatch_ms * act / total, act)
+
+    # ------------------------------------------------------------------
     # fault containment
     # ------------------------------------------------------------------
 
@@ -722,8 +797,10 @@ class ChainServer:
         except Exception:  # noqa: BLE001 - the prefix itself is broken
             partial = None
         handle.health = self._tenant_health(t)
-        if partial is not None and handle.health is not None:
-            partial.stats["health"] = handle.health
+        if partial is not None:
+            partial.stats["cost"] = handle.cost()
+            if handle.health is not None:
+                partial.stats["health"] = handle.health
         cause = slot.fail_cause
         err = TenantError(
             slot.tenant_id,
@@ -899,6 +976,11 @@ class ChainServer:
             self._last_tl = tl
             self._last_tl_tids = set(self._running)
             self._last_dispatch_t = time.monotonic()
+            disp_ms = (self._last_dispatch_t - t_d0) * 1e3
+            self._dispatch_wall_ms += disp_ms
+            self._attribute_cost(disp_ms,
+                                 self._cost_shares(
+                                     self._running.values()))
             if self.spans is not None:
                 dur = self._last_dispatch_t - t_d0
                 for tid in self._running:
@@ -1112,6 +1194,9 @@ class ChainServer:
         if handle._monitor is not None:
             mon_stats["monitor"] = handle._monitor.snapshot()
             mon_stats["converged_at"] = handle._monitor.converged_at
+        # the cost block is complete here: the tenant's final quantum
+        # was attributed earlier in this same drain pass
+        mon_stats["cost"] = handle.cost()
         if spool is not None:
             spool.close()
             from gibbs_student_t_tpu.utils.spool import load_spool
@@ -1196,6 +1281,12 @@ class ChainServer:
         contained to that tenant under supervision; re-raised under
         the fail-fast arm. Non-Exception escapes (worker death) leave
         ``b.idx`` at the undrained tail for ``_abort_undrained``."""
+        if b.cost is not None:
+            # consume-once so a resumed bundle (worker death mid-flush,
+            # inline re-drain) can never double-bill a tenant
+            disp_ms, shares = b.cost
+            b.cost = None
+            self._attribute_cost(disp_ms, shares)
         wire = (self.pool.wire_host(b.recs)
                 if b.recs is not None else None)
         tele = (jax.device_get(b.tl) if b.tl is not None else None)
@@ -1344,6 +1435,12 @@ class ChainServer:
         self._last_tl = tl
         self._last_tl_tids = set(self._running)
         self._last_dispatch_t = time.monotonic()
+        disp_ms = (self._last_dispatch_t - t_d0) * 1e3
+        self._dispatch_wall_ms += disp_ms
+        # per-tenant attribution folds on the DRAIN worker (the cost
+        # payload rides the bundle) — the boundary only snapshots the
+        # co-resident share list
+        cost = (disp_ms, self._cost_shares(self._running.values()))
         if self.spans is not None:
             dur = self._last_dispatch_t - t_d0
             for tid in self._running:
@@ -1383,7 +1480,8 @@ class ChainServer:
                 busy / self.pool.nlanes)
             self.metrics.gauge("serve_queue_depth").set(len(self.queue))
             self.metrics.counter("serve_sweeps_total").inc(busy * q)
-        self._drainq.put(_Bundle(recs, tl, snap, entries, qidx=qidx))
+        self._drainq.put(_Bundle(recs, tl, snap, entries, qidx=qidx,
+                                 cost=cost))
 
     def _pipeline_idle(self) -> bool:
         """Nothing running, queued, staged or pending drain — the
@@ -1522,6 +1620,9 @@ class ChainServer:
         self._stage_thread = None
         self._fail_all_outstanding("server closed")
         self._refresh_obs()          # final pull-surface state
+        if self.http is not None:
+            self.http.close()        # stop the wire last: readable
+            self.http = None         # through the whole drain-down
         if self.spans is not None:
             self.spans.close()       # flush/close the JSONL sink only
 
@@ -1580,18 +1681,102 @@ class ChainServer:
             "supervise": bool(self.supervise),
             "faults": dict(self._fault_counts),
             "slo": self._slo_block(),
+            # the raw per-tenant latency series behind the percentile
+            # blocks — what the fleet aggregator merges across pools
+            # (percentiles don't average; raw series concatenate).
+            # One value per admission/tenant, so the lists stay small.
+            "slo_raw": {
+                "admission_ms": [round(v, 3)
+                                 for v in self._admission_ms],
+                "first_result_ms": [round(v, 3)
+                                    for v in self._first_result_ms],
+                "converged_ms": [round(v, 3)
+                                 for v in self._converged_ms],
+            },
             "tenants": tenants,
         }
 
     def status(self) -> dict:
         """A pull-based live snapshot of the server: pool geometry and
         occupancy, queue/staging depth, fault counters, the SLO
-        percentiles, and one entry per RUNNING tenant (scheduling
-        state + the streaming convergence view when monitored). This
-        is what ``obs_dir/status.json`` refreshes at every quantum
-        boundary and ``tools/serve_top.py`` renders."""
+        percentiles (plus their raw series for fleet merging), and one
+        entry per RUNNING tenant (scheduling state + the streaming
+        convergence view when monitored). This is what
+        ``obs_dir/status.json`` refreshes at every quantum boundary,
+        the ``GET /status`` endpoint serves, and ``tools/serve_top.py``
+        renders."""
         with self._lock:
             return self._status_locked()
+
+    def healthz(self) -> dict:
+        """The liveness verdict behind ``GET /healthz``: ``ok`` is
+        False exactly when the POOL is unhealthy (a pool failure was
+        counted, or a worker error is latched and about to become
+        one) — contained tenant faults do not flip it. The worker
+        block reports each executor thread's liveness (all False on a
+        serial/idle server is normal: the workers are lazy)."""
+        with self._lock:
+            running = len(self._running)
+        err = self._worker_error
+        ok = (self._fault_counts["pool_failures"] == 0
+              and err is None)
+        return {
+            "ok": bool(ok),
+            "t": round(time.time(), 3),
+            "uptime_s": round(time.monotonic() - self._t_started, 3),
+            "quanta": self.quanta,
+            "running_tenants": running,
+            "pipeline": bool(self.pipeline),
+            "supervise": bool(self.supervise),
+            "workers": {
+                "driver": bool(self._thread is not None
+                               and self._thread.is_alive()),
+                "stage": bool(self._stage_thread is not None
+                              and self._stage_thread.is_alive()),
+                "drain": bool(self._drain_thread is not None
+                              and self._drain_thread.is_alive()),
+            },
+            "worker_restarts": self._fault_counts["worker_restarts"],
+            "pool_failures": self._fault_counts["pool_failures"],
+            "error": (f"{type(err).__name__}: {err}"
+                      if err is not None else None),
+        }
+
+    # -- the HTTP endpoint callbacks (obs/http.py) ---------------------
+
+    def _metrics_text(self) -> Optional[str]:
+        """``GET /metrics``: the exposition text (None -> 404 when the
+        server runs without a registry)."""
+        if self.metrics is None:
+            return None
+        from gibbs_student_t_tpu.obs.export import prometheus_text
+
+        return prometheus_text(self.metrics.snapshot(),
+                               ts_ms=int(time.time() * 1e3))
+
+    def _trace_doc(self) -> Optional[dict]:
+        """``GET /trace``: the Chrome trace-event document (None ->
+        404 with tracing disabled)."""
+        if self.spans is None:
+            return None
+        return self.spans.chrome_trace_doc(
+            tenant_names=self._tenant_names)
+
+    def _tenant_progress(self, key: str) -> Optional[dict]:
+        """``GET /tenants/<key>/progress``: the handle's progress
+        snapshot, looked up by tenant id or request name (latest
+        submission wins a name collision). None -> 404."""
+        with self._lock:
+            h = None
+            try:
+                h = self._handles.get(int(key))
+            except (TypeError, ValueError):
+                pass
+            if h is None:
+                for hh in self._handles.values():
+                    if hh.request.name == key:
+                        h = hh   # keep scanning: latest wins
+        return None if h is None else h.progress()
 
     def _refresh_obs(self, locked: bool = False) -> None:
         """Refresh the ``obs_dir`` pull surface (status.json +
@@ -1731,4 +1916,9 @@ class ChainServer:
             },
             "faults": dict(self._fault_counts),
             "slo": self._slo_block(),
+            # total measured dispatch wall (ms): the per-tenant
+            # cost.device_ms attributions sum back to this — the
+            # reconciliation serve_bench's cost block asserts
+            "cost": {"dispatch_wall_ms": round(self._dispatch_wall_ms,
+                                               3)},
         }

@@ -407,6 +407,60 @@ def test_perf_report_gates_per_stage_regressions(tmp_path, capsys):
                     "--max-stage-growth", "10"]) == 2
 
 
+def test_perf_report_trend_gate_sustained_vs_noisy(tmp_path, capsys):
+    """The round-14 trend gate: a SUSTAINED drop below the rolling-
+    median baseline fails, a single noisy point does not, and the
+    point-compare gates alone would have missed the slow drift (each
+    record is within --max-drop of its neighbor)."""
+    pr = _perf_report()
+    # slow drift: each step drops ~12% (under the 30% point gate) but
+    # the last two records sit ~>25% under their rolling medians
+    drift = [100.0, 100.0, 100.0, 100.0, 100.0, 88.0, 77.0, 68.0, 60.0]
+    path = _write_ledger(tmp_path, [_bench_rec(v) for v in drift])
+    rc = pr.main(["--ledger", path, "--check", "--no-rounds"])
+    out = capsys.readouterr().out
+    assert rc == 2
+    assert "sustained regression" in out
+    assert "m@cpu" in out
+    # one noisy dip in a flat series: trend gate quiet (the dip is not
+    # sustained); the point gate also passes (within --max-drop)
+    noisy = [100.0, 101.0, 99.0, 100.0, 102.0, 100.0, 75.0, 100.0,
+             99.0]
+    path = _write_ledger(tmp_path, [_bench_rec(v) for v in noisy])
+    assert pr.main(["--ledger", path, "--check", "--no-rounds"]) == 0
+    # short series: skipped with a note, never fails
+    path = _write_ledger(tmp_path, [_bench_rec(100.0),
+                                    _bench_rec(50.0)])
+    rc = pr.main(["--ledger", path, "--check", "--no-rounds",
+                  "--max-drop", "60"])
+    out = capsys.readouterr().out
+    assert "skipped until history accrues" in out
+    assert rc == 0
+    # tighter limit / more points are tunable
+    path = _write_ledger(tmp_path, [_bench_rec(v) for v in drift])
+    assert pr.main(["--ledger", path, "--check", "--no-rounds",
+                    "--max-trend-drop", "90"]) == 0
+
+
+def test_perf_report_trend_table_renders_sparklines(tmp_path, capsys):
+    """The trajectory table: one row per (metric, platform) series
+    with a sparkline — bench and serve_bench series are separate, and
+    platform splits series."""
+    pr = _perf_report()
+    recs = ([_bench_rec(v) for v in (100.0, 120.0, 140.0)]
+            + [_bench_rec(500.0, platform="tpu")]
+            + [_serve_rec(value=5000.0), _serve_rec(value=5100.0)])
+    path = _write_ledger(tmp_path, recs)
+    assert pr.main(["--ledger", path, "--no-rounds"]) == 0
+    out = capsys.readouterr().out
+    assert "== ledger trends" in out
+    assert "m@cpu: n=3" in out
+    assert "m@tpu: n=1" in out
+    assert "serve_aggregate_chain_sweeps_per_s@cpu: n=2" in out
+    # sparkline glyphs actually render
+    assert any(ch in out for ch in "▁▂▃▄▅▆▇█")
+
+
 def test_perf_report_baselines_and_unusable_records(tmp_path):
     pr = _perf_report()
     # empty ledger / no bench record -> exit 3 (ungradeable)

@@ -1,0 +1,367 @@
+"""Observability-wire unit tests (round 14): the HTTP endpoint server
+as a standalone unit, Prometheus exposition conformance against
+hostile label values, fleet aggregation over canned pools, the
+serve_top golden snapshot (file mode and ``--url`` against a stub
+endpoint), and the ``GST_*`` env-gate doc-drift guard.
+
+Everything here is jax-light — no pool compiles, no ChainServer; the
+live-server integration rides the shared plane run in
+tests/test_serve_obs.py (the ONE-compile budget rule).
+"""
+
+import io
+import json
+import os
+import re
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from gibbs_student_t_tpu.obs import MetricsRegistry
+from gibbs_student_t_tpu.obs import schema as obs_schema
+from gibbs_student_t_tpu.obs.aggregate import fleet_status, read_status
+from gibbs_student_t_tpu.obs.export import prometheus_text
+from gibbs_student_t_tpu.obs.http import ObsHttpServer
+
+pytestmark = pytest.mark.obswire
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+@pytest.fixture(scope="module")
+def schemas():
+    return obs_schema.load_schemas()
+
+
+def _get(url, timeout=5.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+# ----------------------------------------------------------------------
+# ObsHttpServer as a unit (no ChainServer behind it)
+# ----------------------------------------------------------------------
+
+
+def test_http_server_routes_and_failure_modes():
+    """Routing, ephemeral-port bind, 503 healthz, 404 for missing
+    callbacks/unknown routes, 500 + warn-once for a raising callback,
+    idempotent close."""
+    calls = {"n": 0}
+
+    def boom():
+        calls["n"] += 1
+        raise RuntimeError("injected handler failure")
+
+    srv = ObsHttpServer(
+        port=0,
+        status_fn=lambda: {"schema": 1, "fake": True},
+        healthz_fn=lambda: {"ok": False, "reason": "draining"},
+        trace_fn=boom)
+    try:
+        assert srv.port > 0
+        code, body = _get(srv.url + "/")
+        assert code == 200 and "/healthz" in body
+        code, body = _get(srv.url + "/status")
+        assert code == 200 and json.loads(body)["fake"] is True
+        code, _ = _get(srv.url + "/healthz")
+        assert code == 503            # ok: False -> not ready
+        code, _ = _get(srv.url + "/metrics")
+        assert code == 404            # no metrics_fn mounted
+        code, _ = _get(srv.url + "/tenants/0/progress")
+        assert code == 404            # no progress_fn mounted
+        code, _ = _get(srv.url + "/bogus/route")
+        assert code == 404
+        # a raising callback: 500 body, one warning, server survives
+        with pytest.warns(RuntimeWarning, match="endpoint"):
+            code, body = _get(srv.url + "/trace")
+        assert code == 500 and "injected" in body
+        code, body = _get(srv.url + "/trace")   # warned once only
+        assert code == 500 and calls["n"] == 2
+        code, _ = _get(srv.url + "/status")     # still serving
+        assert code == 200
+    finally:
+        srv.close()
+    srv.close()   # idempotent
+    with pytest.raises((urllib.error.URLError, OSError)):
+        urllib.request.urlopen(srv.url + "/status", timeout=1.0)
+
+
+# ----------------------------------------------------------------------
+# Prometheus exposition conformance
+# ----------------------------------------------------------------------
+
+
+def test_prometheus_exposition_conformance():
+    """The round-14 conformance satellite: hostile label values are
+    escaped per the exposition format, HELP/TYPE appear exactly once
+    per family before its samples, hostile metric names sanitize, and
+    histogram buckets are cumulative-monotone with a +Inf terminal."""
+    reg = MetricsRegistry()
+    reg.counter("serve_admissions").inc(3)
+    reg.gauge('weird name {"x"}').set(1.5)
+    h = reg.histogram("lat_ms", buckets=(1.0, 10.0, 100.0))
+    for v in (0.5, 5.0, 5.0, 50.0, 500.0):
+        h.observe(v)
+    hostile = {"pool": 'a\\b"c\nd', "bad label!": "v"}
+    text = prometheus_text(reg.snapshot(), labels=hostile)
+    lines = text.splitlines()
+    # label escaping: backslash, quote, newline — one physical line
+    row = next(ln for ln in lines
+               if ln.startswith("gst_serve_admissions{"))
+    assert 'pool="a\\\\b\\"c\\nd"' in row
+    assert 'bad_label_="v"' in row
+    assert row.split()[-1] == "3.0" or "3.0" in row
+    # hostile metric name sanitized into the family name
+    assert any(ln.startswith("# TYPE gst_weird_name___x__ gauge")
+               for ln in lines)
+    # HELP + TYPE exactly once per family, HELP before samples
+    for family, kind in (("gst_serve_admissions", "counter"),
+                         ("gst_lat_ms", "histogram")):
+        assert text.count(f"# TYPE {family} {kind}") == 1
+        helps = [i for i, ln in enumerate(lines)
+                 if ln.startswith(f"# HELP {family} ")]
+        assert len(helps) == 1
+        first_sample = min(i for i, ln in enumerate(lines)
+                           if ln.startswith(family)
+                           and not ln.startswith("#"))
+        assert helps[0] < first_sample
+    # histogram: cumulative monotone buckets, +Inf terminal == count
+    bucket_re = re.compile(r'gst_lat_ms_bucket\{.*le="([^"]+)".*\} '
+                           r"(\d+)")
+    rows = [bucket_re.match(ln) for ln in lines
+            if ln.startswith("gst_lat_ms_bucket")]
+    assert all(rows)
+    counts = [int(m.group(2)) for m in rows]
+    assert counts == sorted(counts)
+    assert rows[-1].group(1) == "+Inf"
+    assert counts[-1] == 5
+    count_row = next(ln for ln in lines
+                     if ln.startswith("gst_lat_ms_count"))
+    assert count_row.split()[-1] == "5"
+
+
+# ----------------------------------------------------------------------
+# fleet aggregation over canned pools (no server)
+# ----------------------------------------------------------------------
+
+
+def _canned_status(nlanes=32, busy=16, admission=(10.0, 30.0),
+                   pool_failures=0):
+    return {
+        "schema": 1, "t": 1.0, "uptime_s": 5.0, "quanta": 10,
+        "nlanes": nlanes, "group": 16, "quantum": 5,
+        "busy_lanes": busy, "free_groups": 1,
+        "occupancy_now": busy / nlanes, "occupancy": 0.8,
+        "queue_depth": 1, "staged": 0, "pipeline": True,
+        "supervise": True,
+        "faults": {"tenant_failures": 0, "quarantined_lanes": 0,
+                   "reinits": 0, "worker_restarts": 0,
+                   "pool_failures": pool_failures},
+        "slo": {"admission_ms": None, "first_result_ms": None,
+                "converged_ms": None, "n_converged": 1},
+        "slo_raw": {"admission_ms": list(admission),
+                    "first_result_ms": [], "converged_ms": []},
+        "tenants": [],
+    }
+
+
+def test_fleet_status_merges_raw_series_and_flags_sick_pools(
+        tmp_path, schemas):
+    """Percentiles merge from the CONCATENATED raw series (not from
+    per-pool percentiles), totals sum, a pool with pool_failures is
+    reachable-but-sick, and a missing file is unreachable-not-fatal."""
+    a = tmp_path / "a.json"
+    b = tmp_path / "b"
+    os.makedirs(b)
+    a.write_text(json.dumps(_canned_status(admission=(10.0, 30.0))))
+    (b / "status.json").write_text(json.dumps(_canned_status(
+        nlanes=64, busy=48, admission=(50.0, 70.0),
+        pool_failures=1)))
+    snap = fleet_status([str(a), str(b), str(tmp_path / "gone.json")],
+                        timeout=0.2)
+    obs_schema.assert_valid(snap, schemas["fleet_status"],
+                            "fleet snapshot", defs=schemas)
+    assert snap["n_pools"] == 3 and snap["n_reachable"] == 2
+    assert snap["totals"]["nlanes"] == 96
+    assert snap["totals"]["busy_lanes"] == 64
+    assert snap["totals"]["occupancy_now"] == pytest.approx(64 / 96)
+    # merged over [10, 30, 50, 70] — NOT the mean of per-pool p50s
+    merged = snap["slo"]["admission_ms"]
+    ref = np.asarray([10.0, 30.0, 50.0, 70.0])
+    assert merged["p50"] == pytest.approx(np.percentile(ref, 50))
+    assert merged["p99"] == pytest.approx(np.percentile(ref, 99))
+    assert snap["slo"]["n_converged"] == 2
+    by_src = {p["source"]: p for p in snap["pools"]}
+    assert by_src[str(a)]["healthy"] is True
+    assert by_src[str(b)]["healthy"] is False   # pool_failures > 0
+    assert by_src[str(tmp_path / "gone.json")]["reachable"] is False
+    # read_status raises on the bad source; fleet_status degraded it
+    with pytest.raises(Exception):
+        read_status(str(tmp_path / "gone.json"))
+
+
+# ----------------------------------------------------------------------
+# serve_top golden snapshot: file mode and --url against a stub
+# ----------------------------------------------------------------------
+
+
+CANNED_TOP = {
+    "schema": 1, "t": 1700000000.0, "uptime_s": 12.5, "quanta": 40,
+    "nlanes": 64, "group": 16, "quantum": 5, "busy_lanes": 48,
+    "free_groups": 1, "occupancy_now": 0.75, "occupancy": 0.8123,
+    "queue_depth": 2, "staged": 1, "pipeline": True, "supervise": True,
+    "faults": {"tenant_failures": 1, "quarantined_lanes": 0,
+               "reinits": 0, "worker_restarts": 0, "pool_failures": 0},
+    "slo": {"admission_ms": {"p50": 10.0, "p90": 20.0, "p99": 30.0,
+                             "max": 31.5, "mean": 12.0},
+            "first_result_ms": None, "converged_ms": None,
+            "n_converged": 0},
+    "slo_raw": {"admission_ms": [10.0, 20.0], "first_result_ms": [],
+                "converged_ms": []},
+    "tenants": [
+        {"tenant_id": 0, "name": "t0", "status": "running",
+         "nchains": 16, "sweeps_done": 100, "niter": 200, "rows": 100,
+         "ess_min": 12.34, "rhat_max": 1.01, "ess_per_s": 5.6,
+         "converged_at": None, "quarantined": 0, "reinits": 0,
+         "cost": {"device_ms": 1234.5, "lane_quanta": 320,
+                  "ess_per_core_s": 10.0}},
+        {"tenant_id": 1, "name": "t1", "status": "running",
+         "nchains": 32, "sweeps_done": 50, "niter": 150,
+         "cost": {"device_ms": 2469.0, "lane_quanta": 640,
+                  "ess_per_core_s": None}},
+    ],
+}
+
+GOLDEN_TOP = (
+    "serve_top  quanta=40 uptime=12s lanes=48/64 (75% now, 81.2% run)"
+    " queue=2 staged=1 pipeline=on\n"
+    "faults: tenant_failures=1\n"
+    "slo admission_ms     p50=    10.0 p90=    20.0 p99=    30.0 "
+    "max=    31.5\n"
+    "  ID       NAME   STATUS CHAINS      SWEEPS   ROWS      ESS"
+    "    RHAT    ESS/s  CONV@   Q\n"
+    "   0         t0  running     16     100/200    100     12.3"
+    "   1.010      5.6      -   0\n"
+    "   1         t1  running     32      50/150      -        -"
+    "       -        -      -   -\n"
+)
+
+
+def _serve_top():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "serve_top", os.path.join(REPO, "tools", "serve_top.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_serve_top_golden_file_and_url_modes(tmp_path):
+    """Golden snapshot of the one-shot render: file mode from a canned
+    status.json, and the new --url mode against a stub HTTP endpoint
+    serving the same snapshot — byte-identical output, plus the
+    unreachable-URL note path."""
+    st_mod = _serve_top()
+    (tmp_path / "status.json").write_text(json.dumps(CANNED_TOP))
+    out = io.StringIO()
+    assert st_mod.render(str(tmp_path), out=out)
+    assert out.getvalue() == GOLDEN_TOP
+    # --url mode against a stub wire: same golden, byte for byte
+    stub = ObsHttpServer(port=0, status_fn=lambda: CANNED_TOP)
+    try:
+        out = io.StringIO()
+        assert st_mod.render_url(stub.url, out=out)
+        assert out.getvalue() == GOLDEN_TOP
+        assert st_mod.main(["--url", stub.url]) == 0
+    finally:
+        stub.close()
+    out = io.StringIO()
+    assert not st_mod.render_url("http://127.0.0.1:9", out=out,
+                                 timeout=0.5)
+    assert "unreachable" in out.getvalue()
+
+
+def test_fleet_status_tool_renders_without_jax(tmp_path):
+    """tools/fleet_status.py end-to-end over file sources: loads the
+    aggregator by path (no package import), renders the table and the
+    --json snapshot, exits 0 with >=1 reachable pool and 1 with
+    none."""
+    import importlib.util
+    import contextlib
+
+    spec = importlib.util.spec_from_file_location(
+        "fleet_status_tool",
+        os.path.join(REPO, "tools", "fleet_status.py"))
+    tool = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tool)
+    (tmp_path / "status.json").write_text(
+        json.dumps(_canned_status()))
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = tool.main([str(tmp_path)])
+    assert rc == 0
+    text = buf.getvalue()
+    assert "fleet_status" in text and "pools=1/1" in text
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = tool.main([str(tmp_path), "--json"])
+    assert rc == 0
+    snap = json.loads(buf.getvalue())
+    assert snap["n_reachable"] == 1
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = tool.main([str(tmp_path / "nope"), "--json"])
+    assert rc == 1
+
+
+# ----------------------------------------------------------------------
+# env-gate doc drift guard (ROADMAP item 5's sprawl, at least indexed)
+# ----------------------------------------------------------------------
+
+
+def _package_env_gates():
+    """Every GST_* name the package reads from the environment:
+    direct ``os.environ`` reads plus quoted gate-name literals (the
+    indirection through helpers like pallas_util.mode_from_env passes
+    the name as a string literal)."""
+    pkg = os.path.join(REPO, "gibbs_student_t_tpu")
+    env_line = re.compile(r"GST_[A-Z0-9_]+")
+    literal = re.compile(r"""["'](GST_[A-Z0-9_]+)["']""")
+    gates = set()
+    for root, _, files in os.walk(pkg):
+        if "__pycache__" in root:
+            continue
+        for f in files:
+            if not f.endswith(".py"):
+                continue
+            src = open(os.path.join(root, f)).read()
+            for line in src.splitlines():
+                if "environ" in line:
+                    gates.update(env_line.findall(line))
+            gates.update(literal.findall(src))
+    return gates
+
+
+def test_every_env_gate_is_documented():
+    """docs/OBSERVABILITY.md's env-gate index must name every GST_*
+    gate the package reads — a new gate without a doc row fails here,
+    next to the sprawl ROADMAP item 5 wants folded."""
+    gates = _package_env_gates()
+    # sanity: the extractor sees the well-known gates, so an empty
+    # set can never vacuously pass
+    for known in ("GST_NCHOL", "GST_SERVE_PIPELINE", "GST_FUSE_STAGES",
+                  "GST_LEDGER_PATH"):
+        assert known in gates, f"extractor lost {known}"
+    docs = open(os.path.join(REPO, "docs", "OBSERVABILITY.md")).read()
+    missing = sorted(g for g in gates if g not in docs)
+    assert not missing, (
+        f"env gates read by the package but absent from "
+        f"docs/OBSERVABILITY.md: {missing} — add them to the "
+        "'Env-gate index' table")

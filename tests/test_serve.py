@@ -600,3 +600,12 @@ def test_serve_bench_ledger_matches_final_line(tmp_path):
     for v in line["monitor"].values():
         assert v["rows"] > 0 and v["ess_min"] > 0
     assert isinstance(line["obs_overhead"], float)
+    # round 14: the per-tenant cost attributions reconcile with the
+    # measured dispatch wall (the acceptance pin, on the real tool)
+    cost = line["cost"]
+    assert len(cost["tenants"]) == line["tenants"]
+    wall = cost["dispatch_wall_ms"]
+    assert wall > 0
+    assert abs(cost["device_ms_sum"] - wall) <= 0.05 * wall
+    for v in cost["tenants"].values():
+        assert v["device_ms"] > 0 and v["lane_quanta"] > 0

@@ -15,9 +15,16 @@ observability"):
   exception, obs_dir refresh failure) degrades warn-and-continue —
   the tenant and the pool never fail.
 
+Round 14 (the observability wire) rides the SAME shared run: the
+plane fixture also mounts ``http_port=0`` and fetches every endpoint
+mid-run and post-drain, so the HTTP/cost/fleet tests add zero extra
+pool compiles — and the existing plane-off bitwise arm now doubles as
+the HTTP-server-on-vs-off bitwise pin.
+
 Budget note: the module runs THREE pool compiles total — one shared
-4-tenant plane run (module fixture, reused by five tests), one
-plane-off server (the bitwise A/B), one failure-path server.
+4-tenant plane run (module fixture, reused by the span/progress/
+status/schema/http/cost/fleet tests), one plane-off server (the
+bitwise A/B), one failure-path server.
 """
 
 import glob
@@ -54,12 +61,28 @@ def schemas():
     return obs_schema.load_schemas()
 
 
+def _http_get(url, timeout=10.0):
+    """(status_code, body_text) — 4xx/5xx are data here, not raises."""
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
 @pytest.fixture(scope="module")
 def plane_run(demo, tmp_path_factory):
     """ONE 4-tenant run with the full plane armed (spans + JSONL sink,
-    monitor, obs_dir, metrics run_dir, crash manifest) — shared by the
-    span/progress/status/schema tests so tier-1 pays a single pool
-    compile for all of them."""
+    monitor, obs_dir, metrics run_dir, crash manifest, round-14 HTTP
+    wire on an ephemeral port) — shared by the span/progress/status/
+    schema/http/cost tests so tier-1 pays a single pool compile for
+    all of them. Endpoints are fetched MID-RUN (first boundary with
+    busy lanes, on the driving thread's on_quantum hook) and again
+    after the drain-down, so both a live and an idle server are
+    covered."""
     from gibbs_student_t_tpu.obs import MetricsRegistry
 
     ma, cfg = demo
@@ -72,13 +95,27 @@ def plane_run(demo, tmp_path_factory):
     srv = ChainServer(ma, cfg, nlanes=32, quantum=5, record="full",
                       metrics=reg, obs_dir=obs_dir,
                       manifest_dir=man_dir,
-                      trace_jsonl=os.path.join(obs_dir, "spans.jsonl"))
+                      trace_jsonl=os.path.join(obs_dir, "spans.jsonl"),
+                      http_port=0)
+    url = srv.http.url
     spec = MonitorSpec(params=MON_PARAMS, ess_target=4.0,
                        rhat_target=50.0)
     hs = [srv.submit(TenantRequest(ma=ma, niter=n, nchains=16, seed=i,
                                    name=f"t{i}", monitor=spec))
           for i, n in enumerate(NITERS)]
-    srv.run()
+    live = {}
+
+    def fetch_live(server):
+        if live or not server.quanta:
+            return
+        for route in ("/healthz", "/status", "/metrics", "/trace",
+                      "/tenants/0/progress", "/tenants/t1/progress",
+                      "/tenants/nope/progress", "/nope"):
+            live[route] = _http_get(url + route)
+
+    srv.run(on_quantum=fetch_live)
+    idle = {route: _http_get(url + route)
+            for route in ("/healthz", "/status", "/metrics", "/trace")}
     trace_path = srv.export_trace(os.path.join(obs_dir, "trace.json"))
     status = srv.status()
     summary = srv.summary()
@@ -88,7 +125,7 @@ def plane_run(demo, tmp_path_factory):
     return {"server": srv, "handles": hs, "results": results,
             "obs_dir": obs_dir, "run_dir": run_dir, "man_dir": man_dir,
             "trace_path": trace_path, "status": status,
-            "summary": summary}
+            "summary": summary, "url": url, "live": live, "idle": idle}
 
 
 # ----------------------------------------------------------------------
@@ -192,19 +229,33 @@ def test_export_trace_is_valid_and_complete(plane_run, schemas):
 
 
 def test_span_recorder_ring_and_sink(tmp_path, schemas):
-    """Unit: the ring is bounded (drop-oldest + dropped counter), the
-    JSONL sink lines validate against the span schema, and a sink that
-    starts failing disables itself with a warning while recording
-    continues in memory."""
+    """Unit (undersized ring): drops are drop-oldest and ACCOUNTED —
+    the dropped counter counts them, a serve_spans_dropped metrics
+    counter mirrors them, the first drop warns exactly once, and the
+    Chrome export carries the total in otherData. The JSONL sink lines
+    validate against the span schema, and a sink that starts failing
+    disables itself with a warning while recording continues in
+    memory."""
+    import warnings as _warnings
+
+    from gibbs_student_t_tpu.obs import MetricsRegistry
     from gibbs_student_t_tpu.obs.spans import SpanRecorder
 
     path = str(tmp_path / "spans.jsonl")
-    rec = SpanRecorder(capacity=8, jsonl_path=path)
-    for i in range(12):
-        with rec.span("step", "drain", tenant=i % 2, quantum=i):
-            pass
+    reg = MetricsRegistry()
+    rec = SpanRecorder(capacity=8, jsonl_path=path, metrics=reg)
+    with _warnings.catch_warnings(record=True) as caught:
+        _warnings.simplefilter("always")
+        for i in range(12):
+            with rec.span("step", "drain", tenant=i % 2, quantum=i):
+                pass
+    overflow = [w for w in caught if "overflowed" in str(w.message)]
+    assert len(overflow) == 1   # warn once, not once per drop
     assert len(rec.spans()) == 8
     assert rec.dropped == 4
+    assert reg.counter("serve_spans_dropped").value == 4
+    doc = rec.chrome_trace_doc()
+    assert doc["otherData"]["dropped_spans"] == 4
     lines = [json.loads(x) for x in open(path)]
     assert len(lines) == 12
     for ln in lines:
@@ -330,8 +381,10 @@ def test_emitted_records_validate_against_schemas(plane_run, schemas,
 
 def test_plane_on_off_chains_bitwise(demo, plane_run):
     """The plane is pure host bookkeeping: the SAME 4-tenant schedule
-    with spans/monitor/obs_dir all disabled produces bitwise-identical
-    per-tenant results (every field, incl. per-TOA)."""
+    with spans/monitor/obs_dir all disabled — and no HTTP server,
+    where the plane run serves one, so this is also the round-14
+    wire-on-vs-off pin — produces bitwise-identical per-tenant
+    results (every field, incl. per-TOA)."""
     ma, cfg = demo
     srv = ChainServer(ma, cfg, nlanes=32, quantum=5, record="full",
                       spans=False)
@@ -394,6 +447,128 @@ def test_observability_failures_warn_and_continue(demo, tmp_path,
     s = srv.summary()
     assert s["faults"]["tenant_failures"] == 0
     assert s["faults"]["pool_failures"] == 0
+
+
+# ----------------------------------------------------------------------
+# the observability wire (round 14): HTTP endpoints, cost, fleet merge
+# ----------------------------------------------------------------------
+
+
+def test_http_endpoints_serve_schema_valid(plane_run, schemas):
+    """The acceptance pin: /healthz, /status, /metrics, /trace and
+    /tenants/<id>/progress all serve schema-valid bodies from the live
+    4-tenant run (and again once idle), with id-or-name tenant lookup
+    and 404s for unknown tenants/routes."""
+    live, idle = plane_run["live"], plane_run["idle"]
+    assert live, "mid-run fetch never fired"
+    for phase in (live, idle):
+        code, body = phase["/healthz"]
+        h = json.loads(body)
+        assert code == 200 and h["ok"] is True
+        obs_schema.assert_valid(h, schemas["healthz"], "healthz",
+                                defs=schemas)
+        code, body = phase["/status"]
+        assert code == 200
+        st = json.loads(body)
+        obs_schema.assert_valid(st, schemas["serve_status"],
+                                "GET /status", defs=schemas)
+        code, body = phase["/metrics"]
+        assert code == 200
+        assert "# TYPE gst_serve_queue_depth gauge" in body
+        assert "# HELP gst_serve_queue_depth" in body
+        code, body = phase["/trace"]
+        assert code == 200
+        obs_schema.assert_valid(json.loads(body),
+                                schemas["chrome_trace"], "GET /trace",
+                                defs=schemas)
+    # the live snapshot really was live: lanes busy, tenants listed
+    st = json.loads(live["/status"][1])
+    assert st["busy_lanes"] > 0 and st["tenants"]
+    assert st["slo_raw"]["admission_ms"]  # raw series for fleet merge
+    # tenant progress: by id and by name, same tenant shapes
+    code, body = live["/tenants/0/progress"]
+    assert code == 200
+    p0 = json.loads(body)
+    assert p0["tenant_id"] == 0 and p0["name"] == "t0"
+    obs_schema.assert_valid(p0["cost"], schemas["cost"],
+                            "progress cost", defs=schemas)
+    code, body = live["/tenants/t1/progress"]
+    assert code == 200 and json.loads(body)["tenant_id"] == 1
+    assert live["/tenants/nope/progress"][0] == 404
+    assert live["/nope"][0] == 404
+
+
+def test_http_server_down_after_close(plane_run):
+    """close() tears the wire down deterministically — the port stops
+    accepting (the fixture already closed the server)."""
+    import urllib.error
+    import urllib.request
+
+    with pytest.raises((urllib.error.URLError, OSError)):
+        urllib.request.urlopen(plane_run["url"] + "/healthz",
+                               timeout=2.0)
+
+
+def test_cost_accounting_reconciles(plane_run, schemas):
+    """Per-tenant cost: the active-lane-share attributions sum back to
+    the measured dispatch wall (within 5% — exact by construction),
+    lane_quanta counts chains x quanta, the block rides progress() AND
+    result().stats, and monitored tenants price their ESS per
+    core-second."""
+    handles = plane_run["handles"]
+    wall = plane_run["summary"]["cost"]["dispatch_wall_ms"]
+    assert wall > 0
+    total = sum(h.cost()["device_ms"] for h in handles)
+    assert abs(total - wall) <= 0.05 * wall, (total, wall)
+    for h, res, niter in zip(handles, plane_run["results"], NITERS):
+        c = h.cost()
+        obs_schema.assert_valid(c, schemas["cost"], "handle cost",
+                                defs=schemas)
+        assert c["device_ms"] > 0
+        # 16 active chains x (niter/quantum) quanta, no quarantines
+        assert c["lane_quanta"] == 16 * (niter // 5)
+        assert c["ess_per_core_s"] is not None \
+            and c["ess_per_core_s"] > 0
+        assert res.stats["cost"] == c   # the finalize-time snapshot
+        assert h.progress()["cost"] == c
+
+
+def test_fleet_status_merges_pools_and_reports_unreachable(plane_run,
+                                                           schemas):
+    """The 2-pool fleet merge acceptance pin: two pools (the shared
+    run's obs_dir, once as a directory and once as a status.json
+    path) merge into a schema-valid fleet snapshot with summed totals
+    and SLO percentiles recomputed from the concatenated raw series;
+    a third, deliberately unreachable pool is REPORTED, never
+    fatal."""
+    from gibbs_student_t_tpu.obs.aggregate import fleet_status
+
+    obs_dir = plane_run["obs_dir"]
+    dead = "http://127.0.0.1:9"   # discard port: connection refused
+    snap = fleet_status(
+        [obs_dir, os.path.join(obs_dir, "status.json"), dead],
+        timeout=0.5)
+    obs_schema.assert_valid(snap, schemas["fleet_status"],
+                            "fleet snapshot", defs=schemas)
+    assert snap["n_pools"] == 3 and snap["n_reachable"] == 2
+    down = [p for p in snap["pools"] if not p["reachable"]]
+    assert len(down) == 1 and down[0]["source"] == dead
+    assert down[0]["error"]
+    for p in snap["pools"]:
+        if p["reachable"]:
+            assert p["healthy"] is True
+    # totals sum over the two reachable copies of the same pool
+    assert snap["totals"]["nlanes"] == 64
+    # merged percentiles come from the concatenated raw series: the
+    # doubled series has the same p50 as one pool's
+    with open(os.path.join(obs_dir, "status.json")) as fh:
+        st = json.load(fh)
+    series = st["slo_raw"]["admission_ms"]
+    assert series, "pool status carries no raw admission series"
+    assert snap["slo"]["admission_ms"]["p50"] == pytest.approx(
+        float(np.percentile(np.asarray(series + series, float), 50)),
+        abs=1e-3)   # the aggregator rounds percentiles to 3 decimals
+    assert snap["slo"]["n_converged"] == 2 * st["slo"]["n_converged"]
 
 
 def test_metrics_auto_created_for_obs_dir(demo, tmp_path):

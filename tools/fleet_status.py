@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Fleet dashboard over N chain-server observability endpoints.
+
+The multi-pool half of the observability wire (round 14;
+docs/OBSERVABILITY.md "The observability wire"): poll every source —
+a ``ChainServer(http_port=...)`` endpoint URL, an ``obs_dir``, or a
+``status.json`` path — merge them with ``obs/aggregate.py`` into one
+schema-validated fleet snapshot (summed occupancy/queue, SLO
+percentiles merged from the pools' raw series, per-pool health), and
+render it serve_top-style. Unreachable pools are reported rows, never
+fatal: a fleet view that dies when a pool dies is useless.
+
+    python tools/fleet_status.py http://h1:8811 http://h2:8811
+    python tools/fleet_status.py /runs/a/obs /runs/b/obs --json
+    python tools/fleet_status.py URL... --watch 2
+
+This merged snapshot is the placement input ROADMAP item 1's router
+consumes (place by occupancy/SLO, fail over on unreachable). No jax
+import — ``obs/aggregate.py`` is loaded by file path, so the dashboard
+starts instantly on any host.
+
+Exit codes: 0 when at least one pool was reachable, 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _load_aggregate():
+    """obs/aggregate.py without importing the package (keeps jax — a
+    transitive import of the backend modules — out of the dashboard,
+    the serve_top discipline)."""
+    path = os.path.join(os.path.dirname(_HERE), "gibbs_student_t_tpu",
+                        "obs", "aggregate.py")
+    spec = importlib.util.spec_from_file_location("gst_obs_aggregate",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("sources", nargs="+",
+                    help="pool endpoint URLs, obs_dirs, or "
+                         "status.json paths")
+    ap.add_argument("--timeout", type=float, default=2.0,
+                    metavar="SECONDS",
+                    help="per-pool fetch timeout (an unreachable pool "
+                         "is reported, not fatal)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the merged fleet snapshot as JSON "
+                         "(the fleet_status schema) instead of the "
+                         "table")
+    ap.add_argument("--watch", nargs="?", const=2.0, type=float,
+                    default=None, metavar="SECONDS",
+                    help="refresh every SECONDS (default 2) until ^C")
+    args = ap.parse_args(argv)
+    agg = _load_aggregate()
+
+    def frame() -> int:
+        snap = agg.fleet_status(args.sources, timeout=args.timeout)
+        if args.json:
+            print(json.dumps(snap))
+        else:
+            agg.render_fleet(snap, sys.stdout)
+        return 0 if snap["n_reachable"] else 1
+
+    if args.watch is None:
+        return frame()
+    try:
+        while True:
+            sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+            frame()
+            sys.stdout.flush()
+            time.sleep(args.watch)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

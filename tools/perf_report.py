@@ -204,6 +204,14 @@ def print_report(ledger_recs, include_rounds=True):
                       + ("" if m.get("obs_overhead") is None else
                          f"; obs_overhead="
                          f"{m['obs_overhead'] * 100:+.2f}%"))
+            # cost sub-line (round-14 records): the per-tenant
+            # attribution must reconcile with the measured wall
+            c = m.get("cost")
+            if isinstance(c, dict):
+                print(f"    cost device_ms_sum={c.get('device_ms_sum')}"
+                      f" dispatch_wall_ms={c.get('dispatch_wall_ms')} "
+                      f"share={c.get('share_of_dispatch')} "
+                      f"tenants={len(c.get('tenants') or {})}")
             # chaos-arm sub-line (serve_bench --faults records)
             f = m.get("faults")
             if isinstance(f, dict):
@@ -220,6 +228,104 @@ def print_report(ledger_recs, include_rounds=True):
             print(f"  {rec.get('timestamp_utc', '?'):20s} "
                   f"{rec.get('tool', '?'):14s} "
                   f"{rec.get('platform') or '?':8s} {brief}")
+
+
+def _metric_series(ledger_recs):
+    """``{(metric, platform): [values...]}`` in ledger order, over the
+    bench + serve_bench records with a usable numeric headline value —
+    the per-series history the trend gate and sparkline table fold."""
+    out = {}
+    for rec in ledger_recs:
+        if rec.get("tool") not in ("bench", "serve_bench"):
+            continue
+        m = rec.get("metrics") or {}
+        name, value = m.get("metric"), m.get("value")
+        if not name or not isinstance(value, (int, float)) \
+                or isinstance(value, bool):
+            continue
+        out.setdefault((str(name), rec.get("platform")),
+                       []).append(float(value))
+    return out
+
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(vals, width=24):
+    """Unicode min-max sparkline of the last ``width`` values."""
+    vals = vals[-width:]
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return _SPARK[3] * len(vals)
+    return "".join(_SPARK[min(int((v - lo) / (hi - lo) * 8), 7)]
+                   for v in vals)
+
+
+def _rolling_median(vals, j, window):
+    """Median of the up-to-``window`` values preceding index ``j``
+    (None when nothing precedes it)."""
+    import statistics
+
+    prior = vals[max(0, j - window):j]
+    return statistics.median(prior) if prior else None
+
+
+def print_trends(ledger_recs, window=5):
+    """The sparkline trajectory table: one row per (metric, platform)
+    series with its history shape, rolling-median baseline and latest
+    value — the at-a-glance answer to "is this metric drifting down
+    across PRs" that the point-compare gate can't give."""
+    print("== ledger trends (rolling-median baselines) ==")
+    series = _metric_series(ledger_recs)
+    if not series:
+        print("  (no bench/serve_bench metric series)")
+        return
+    for (metric, platform), vals in sorted(series.items()):
+        med = _rolling_median(vals, len(vals) - 1, window)
+        vs = ("" if med is None else
+              f" vs med({min(window, len(vals) - 1)})="
+              f"{med:,.1f} ({(vals[-1] - med) / med * 100.0:+.1f}%)"
+              if med else "")
+        print(f"  {metric}@{platform or '?'}: n={len(vals)} "
+              f"best={max(vals):,.1f} latest={vals[-1]:,.1f}{vs}  "
+              f"{_sparkline(vals)}")
+
+
+def check_trend(ledger_recs, max_trend_drop, window=5, points=2):
+    """The sustained-regression gate: for every (metric, platform)
+    series, each of the last ``points`` records is compared against
+    the rolling MEDIAN of the ``window`` records preceding it; only
+    when ALL of them dropped more than ``max_trend_drop`` percent does
+    the gate fail — a single noisy record never trips it, a drift that
+    survived ``points`` consecutive runs does. Series shorter than
+    ``window + points`` are skipped with a note (the gate arms itself
+    as history accrues). Returns the exit-code contribution."""
+    series = _metric_series(ledger_recs)
+    if not series:
+        print("check: no metric series — trend gate skipped")
+        return 0
+    rc = 0
+    for (metric, platform), vals in sorted(series.items()):
+        key = f"{metric}@{platform or '?'}"
+        if len(vals) < window + points:
+            print(f"check: trend[{key}] {len(vals)} record(s) < "
+                  f"{window + points} — skipped until history accrues")
+            continue
+        drops = []
+        for j in range(len(vals) - points, len(vals)):
+            med = _rolling_median(vals, j, window)
+            drops.append((med - vals[j]) / med * 100.0 if med else 0.0)
+        print(f"check: trend[{key}] last {points} vs rolling "
+              f"median({window}): "
+              + ", ".join(f"{d:+.1f}%" for d in drops)
+              + f" (limit {max_trend_drop}%)")
+        if all(d > max_trend_drop for d in drops):
+            print(f"check: FAIL — {key} has been below its rolling-"
+                  f"median baseline by more than {max_trend_drop}% "
+                  f"for {points} consecutive records (sustained "
+                  "regression, not a noisy point)")
+            rc = 2
+    return rc
 
 
 def _stages_of(rec):
@@ -573,6 +679,24 @@ def main(argv=None):
                          "~37s by design — hence the loose default: "
                          "this is a starvation guard, not a tuning "
                          "target)")
+    ap.add_argument("--max-trend-drop", type=float, default=25.0,
+                    metavar="PCT",
+                    help="trend gate: max tolerated drop of a "
+                         "(metric, platform) series below its "
+                         "rolling-median baseline, sustained over "
+                         "--trend-points consecutive records — the "
+                         "slow-drift regression the prev/best point "
+                         "compares can't see (each point looks fine "
+                         "against an already-degraded neighbor)")
+    ap.add_argument("--trend-window", type=int, default=5,
+                    metavar="N",
+                    help="trend gate: rolling-median baseline window "
+                         "(records preceding the graded one)")
+    ap.add_argument("--trend-points", type=int, default=2,
+                    metavar="N",
+                    help="trend gate: consecutive below-baseline "
+                         "records required before the drop counts as "
+                         "sustained")
     ap.add_argument("--baseline", choices=("prev", "best"),
                     default="prev",
                     help="compare against the previous comparable "
@@ -586,6 +710,7 @@ def main(argv=None):
         ledger = os.path.join(REPO_ROOT, "artifacts", "ledger.jsonl")
     recs = _read_ledger(ledger)
     print_report(recs, include_rounds=not args.no_rounds)
+    print_trends(recs, window=args.trend_window)
     if args.check:
         rc = check_latest(recs, args.max_drop,
                           args.max_compile_growth,
@@ -598,7 +723,10 @@ def main(argv=None):
                            args.max_admission_p99)
         rc_faults = check_faults(recs, args.max_fault_rate,
                                  args.min_fault_ratio)
-        return rc or rc_serve or rc_obs or rc_faults
+        rc_trend = check_trend(recs, args.max_trend_drop,
+                               window=args.trend_window,
+                               points=args.trend_points)
+        return rc or rc_serve or rc_obs or rc_faults or rc_trend
     return 0
 
 

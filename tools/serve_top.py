@@ -10,13 +10,18 @@ no RPC, no jax import, just files:
     python tools/serve_top.py RUN_DIR             # one-shot snapshot
     python tools/serve_top.py RUN_DIR --watch     # refresh every 2 s
     python tools/serve_top.py RUN_DIR --watch 0.5
+    python tools/serve_top.py --url http://HOST:PORT   # over the wire
 
 ``RUN_DIR`` may hold a ``status.json`` (preferred: live occupancy,
 queue, per-tenant streaming ESS/R-hat, SLO percentiles) and/or a
 ``manifest.jsonl`` (fallback: tenant lifecycle reconstructed from the
-journal — works on a crashed server too). Pure host-side parsing; safe
-to point at a directory a server is actively writing (status writes
-are atomic).
+journal — works on a crashed server too). ``--url`` fetches the same
+snapshot from a ``ChainServer(http_port=...)`` observability endpoint
+(round 14, docs/OBSERVABILITY.md "The observability wire") — same
+renderer, network transport. For a multi-pool fleet view use
+``tools/fleet_status.py``. Pure host-side parsing, no jax import;
+safe to point at a directory a server is actively writing (status
+writes are atomic).
 """
 
 from __future__ import annotations
@@ -156,6 +161,27 @@ def _render_manifest(server, tenants, out):
         print("  (no tenants journaled)", file=out)
 
 
+def render_url(url, out=sys.stdout, timeout=5.0) -> bool:
+    """One dashboard frame over the observability wire (``GET
+    <url>/status``); returns False (with a note) when the endpoint is
+    unreachable or returns garbage — a dead pool is a rendering
+    outcome, not a crash."""
+    import urllib.request
+
+    u = url.rstrip("/")
+    if not u.endswith("/status"):
+        u += "/status"
+    try:
+        with urllib.request.urlopen(u, timeout=timeout) as resp:
+            st = json.load(resp)
+    except Exception as e:  # noqa: BLE001 - report, don't die
+        print(f"serve_top: {url!r} unreachable "
+              f"({type(e).__name__}: {e})", file=out)
+        return False
+    _render_status(st, out)
+    return True
+
+
 def render(run_dir, out=sys.stdout) -> bool:
     """One dashboard frame; returns False when the directory has
     neither surface."""
@@ -175,18 +201,30 @@ def render(run_dir, out=sys.stdout) -> bool:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("run_dir", help="the server's obs_dir (status.json"
-                                    " + metrics.prom) or manifest_dir")
+    ap.add_argument("run_dir", nargs="?", default=None,
+                    help="the server's obs_dir (status.json"
+                         " + metrics.prom) or manifest_dir")
+    ap.add_argument("--url", default=None, metavar="URL",
+                    help="render a live ChainServer(http_port=...) "
+                         "endpoint instead of a directory")
     ap.add_argument("--watch", nargs="?", const=2.0, type=float,
                     default=None, metavar="SECONDS",
                     help="refresh every SECONDS (default 2) until ^C")
     args = ap.parse_args(argv)
+    if (args.run_dir is None) == (args.url is None):
+        ap.error("give exactly one of RUN_DIR or --url")
+
+    def frame():
+        if args.url is not None:
+            return render_url(args.url)
+        return render(args.run_dir)
+
     if args.watch is None:
-        return 0 if render(args.run_dir) else 1
+        return 0 if frame() else 1
     try:
         while True:
             sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
-            render(args.run_dir)
+            frame()
             sys.stdout.flush()
             time.sleep(args.watch)
     except KeyboardInterrupt:
