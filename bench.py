@@ -405,6 +405,20 @@ def block_timings(gb, seed: int = 0, iters: int = 5):
                      const.astype(gb.dtype))
     jax.block_until_ready(rest(state, x, acc_w, TNT, d, const, ks[:, 1:]))
 
+    # in-kernel stage timers (round 15): cumulative per-stage cycle
+    # deltas across the timed loop, calibrated to ns — the per-stage
+    # view INSIDE the fused megastage dispatch that the PR 6 fusion
+    # removed from this table (block walls can't see through one FFI
+    # call; the timers can). A runtime flag in the same compiled
+    # kernels, so enabling it here cannot perturb the walls.
+    from gibbs_student_t_tpu.native import ffi as nffi
+
+    timers = nffi.timers_resolved_on()
+    prev = None
+    if timers:
+        nffi.timers_enable(True)
+        prev = nffi.timers_snapshot()
+
     bt = BlockTimer()
     for _ in range(iters):
         _, _, nvec = bt.time("white_mh_block", white, state, ks[:, 0])
@@ -416,7 +430,25 @@ def block_timings(gb, seed: int = 0, iters: int = 5):
     stages = {name: {"mean_s": round(s["mean_s"], 6),
                      "calls": s["calls"]}
               for name, s in bt.summary().items()}
-    return bt.report(), stages
+    report = bt.report()
+    if timers:
+        delta = nffi.timers_delta_ms(prev, nffi.timers_snapshot())
+        if delta:
+            # dev_* rows ride the same stages block (and the same
+            # perf_report --max-stage-growth gate) as the wall rows;
+            # the dev_ prefix keeps the two stage families apart in
+            # asymmetric-set reporting
+            lines = ["device stages (in-kernel timers, per sweep):"]
+            for name, dv in sorted(delta.items(),
+                                   key=lambda kv: -kv[1]["ms"]):
+                per_sweep_s = dv["ms"] / 1e3 / iters
+                stages[f"dev_{name}"] = {
+                    "mean_s": round(per_sweep_s, 6),
+                    "calls": dv["calls"]}
+                lines.append(f"  dev_{name:<16s} "
+                             f"{per_sweep_s * 1e3:8.1f} ms")
+            report = report + "\n" + "\n".join(lines)
+    return report, stages
 
 
 def main(argv=None):

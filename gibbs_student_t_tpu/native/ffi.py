@@ -33,7 +33,7 @@ import numpy as np
 #: committed library exports ``gst_abi_version()``; a mismatch (or a
 #: pre-versioning library) degrades at probe time with a clear reason
 #: string instead of miscalling a handler whose signature moved.
-ABI_VERSION = 4
+ABI_VERSION = 5
 
 #: FFI target name -> exported C symbol. Names are versioned with a
 #: ``gst_`` prefix so they cannot collide with XLA's own cpu targets.
@@ -184,9 +184,151 @@ def status() -> str:
 def _reset_for_tests() -> None:
     """Drop the latched verdict (tests only — e.g. after deleting the
     .so to prove graceful degradation)."""
-    global _READY, _WHY
+    global _READY, _WHY, _TIMERS_OK, _NS_PER_TICK
     _READY = None
     _WHY = "not probed"
+    _TIMERS_OK = None
+    _NS_PER_TICK = None
+
+
+# ---------------------------------------------------------------------
+# in-kernel stage timers (round 15, the deep profiling plane)
+# ---------------------------------------------------------------------
+# The kernels carry a runtime-flag timing side channel (gst_kernels.h):
+# per-stage rdtsc cycle accumulators the .so exports as plain-C
+# control entries. Because the flag gates brackets inside the SAME
+# compiled code, chains and the lowered graph are bitwise identical
+# timers on or off — the probe below only checks the control surface
+# exists and the stage table matches, never changes any dispatch.
+
+#: Stage names in the C enum order (gst_kernels.h StageId). The probe
+#: cross-checks this against gst_timer_stage_name so the Python list
+#: can never silently drift from the accumulators it labels.
+STAGE_NAMES = ("schur", "hyper_mh", "bdraw_factor", "solves",
+               "white_mh", "tnt", "resid", "draws")
+
+_TIMERS_OK: Optional[bool] = None
+_NS_PER_TICK: Optional[float] = None
+
+
+def kernel_timers_env() -> str:
+    """Validated ``GST_KERNEL_TIMERS`` (``auto`` when unset) — the
+    in-kernel stage-timer side channel. Strict ``auto|1|0`` (the
+    loud-typo contract of every GST_* gate); ``auto`` resolves to ON
+    wherever the native library provides the timer surface (the
+    channel is bitwise-free: same compiled code, a runtime flag).
+    ``1`` forces the request but still degrades silently when the
+    library lacks the exports (the forced-but-unavailable contract);
+    ``0`` keeps the flag down and every consumer timer-free."""
+    env = os.environ.get("GST_KERNEL_TIMERS")
+    if env is not None and env not in ("auto", "1", "0"):
+        raise ValueError(
+            f"GST_KERNEL_TIMERS must be 'auto', '1' or '0', got "
+            f"{env!r}")
+    return env if env is not None else "auto"
+
+
+def _lib():
+    from gibbs_student_t_tpu import native
+
+    return native.load()
+
+
+def timers_available() -> bool:
+    """The timer control surface exists on this host's library AND the
+    kernels themselves are registered (cycle counts from a library
+    whose kernels never run would always read zero). Latched like
+    :func:`ready`."""
+    global _TIMERS_OK
+    if _TIMERS_OK is None:
+        _TIMERS_OK = False
+        try:
+            if ready():
+                lib = _lib()
+                lib.gst_timer_stage_count.restype = ctypes.c_int
+                lib.gst_timer_stage_name.restype = ctypes.c_char_p
+                n = int(lib.gst_timer_stage_count())
+                names = tuple(
+                    lib.gst_timer_stage_name(i).decode()
+                    for i in range(n))
+                _TIMERS_OK = names == STAGE_NAMES
+        except Exception:  # noqa: BLE001 - absent surface == degraded
+            _TIMERS_OK = False
+    return _TIMERS_OK
+
+
+def timers_resolved_on() -> bool:
+    """The gate verdict consumers act on: ``GST_KERNEL_TIMERS`` (auto
+    -> on) AND the surface actually available — forced-but-unavailable
+    degrades to off, silently, like every other native gate."""
+    if kernel_timers_env() == "0":
+        return False
+    return timers_available()
+
+
+def timers_enable(on: bool) -> None:
+    """Raise/lower the process-global collection flag (a no-op without
+    the surface). Enabling is idempotent and thread-safe; kernels
+    sample the flag once per call."""
+    if timers_available():
+        _lib().gst_timers_enable(1 if on else 0)
+
+
+def timers_reset() -> None:
+    """Zero the cumulative accumulators. Only safe with no kernel in
+    flight — consumers on live servers difference cumulative
+    :func:`timers_snapshot` values instead."""
+    if timers_available():
+        _lib().gst_timers_reset()
+
+
+def timers_snapshot() -> dict:
+    """Cumulative ``{stage: {"cycles": int, "calls": int}}`` since the
+    last reset ({} without the surface)."""
+    if not timers_available():
+        return {}
+    lib = _lib()
+    n = len(STAGE_NAMES)
+    cyc = (ctypes.c_uint64 * n)()
+    calls = (ctypes.c_uint64 * n)()
+    lib.gst_timers_snapshot(cyc, calls)
+    return {name: {"cycles": int(cyc[i]), "calls": int(calls[i])}
+            for i, name in enumerate(STAGE_NAMES)}
+
+
+def timers_ns_per_tick() -> float:
+    """ns per timer tick, calibrated ONCE per process against
+    CLOCK_MONOTONIC (~2 ms spin in the library; rdtsc is
+    constant-rate, so one calibration serves the process)."""
+    global _NS_PER_TICK
+    if _NS_PER_TICK is None:
+        if not timers_available():
+            _NS_PER_TICK = 1.0
+        else:
+            lib = _lib()
+            lib.gst_timer_ns_per_tick.restype = ctypes.c_double
+            _NS_PER_TICK = float(lib.gst_timer_ns_per_tick())
+    return _NS_PER_TICK
+
+
+def timers_delta_ms(prev: dict, cur: dict) -> dict:
+    """``{stage: {"ms": float, "calls": int}}`` for the stages that
+    advanced between two cumulative snapshots — the per-quantum /
+    per-bench-window attribution helper. Stages with no new calls are
+    omitted so consumers render only what actually ran."""
+    scale = timers_ns_per_tick() / 1e6
+    out = {}
+    for name in STAGE_NAMES:
+        c0 = (prev.get(name) or {"cycles": 0, "calls": 0})
+        c1 = cur.get(name)
+        if c1 is None:
+            continue
+        dcalls = c1["calls"] - c0["calls"]
+        dcyc = c1["cycles"] - c0["cycles"]
+        if dcalls <= 0 and dcyc <= 0:
+            continue
+        out[name] = {"ms": dcyc * scale, "calls": int(dcalls)}
+    return out
 
 
 _SFX = {np.dtype(np.float32): "f32", np.dtype(np.float64): "f64"}

@@ -43,6 +43,15 @@ machine-readable run records. This package supplies them:
   aggregation over those endpoints (or status.json paths): the merged
   occupancy/SLO snapshot ROADMAP item 1's router places by
   (``tools/fleet_status.py`` renders it).
+- :mod:`~gibbs_student_t_tpu.obs.flight` — the crash flight recorder:
+  an always-on bounded ring of the last N quanta (spans, stage
+  timings, events, heartbeats), dumped atomically as a postmortem
+  bundle on pool failure / tenant fault / watchdog trip / SIGTERM
+  (``tools/postmortem.py`` renders it, no jax import).
+- :mod:`~gibbs_student_t_tpu.obs.watchdog` — the serving stall
+  watchdog: executor heartbeats + per-quantum deadlines + sustained
+  trend detectors, ``GST_SERVE_WATCHDOG`` policies, 503 ``healthz``
+  on trip.
 
 Import discipline: this package is imported by ``backends/jax_backend.py``
 at module load, so nothing here may import ``backends``/``parallel`` at
@@ -64,7 +73,13 @@ from gibbs_student_t_tpu.obs.export import (
     prometheus_text,
     write_prometheus,
 )
+from gibbs_student_t_tpu.obs.flight import FlightRecorder, read_bundle
 from gibbs_student_t_tpu.obs.http import ObsHttpServer
+from gibbs_student_t_tpu.obs.watchdog import (
+    Watchdog,
+    WatchdogSpec,
+    serve_watchdog_env,
+)
 from gibbs_student_t_tpu.obs.metrics import (
     MetricsRegistry,
     read_events,
@@ -90,6 +105,11 @@ __all__ = [
     "prometheus_text",
     "write_prometheus",
     "ObsHttpServer",
+    "FlightRecorder",
+    "read_bundle",
+    "Watchdog",
+    "WatchdogSpec",
+    "serve_watchdog_env",
     "SpanRecorder",
     "append_record",
     "make_record",

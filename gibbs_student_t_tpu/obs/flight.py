@@ -1,0 +1,232 @@
+"""The crash flight recorder: a bounded black box for the chain server.
+
+PR 9's crash recovery replays *state* (the manifest + spool
+checkpoints) but preserves no *evidence*: when a pool dies, a tenant
+fails, or the watchdog sees a stall, nothing records what the last N
+quanta looked like — spans, metric deltas, stage timings, admission
+and fault events, heartbeats. :class:`FlightRecorder` is that black
+box: an always-on bounded ring (one entry per quantum, plus a bounded
+event log and the latest per-role heartbeats) that costs a deque
+append on the serving path and is dumped ATOMICALLY as a
+schema-validated postmortem bundle (``docs/observability.schema.json``
+``postmortem``) when something goes wrong — pool failure, a contained
+``TenantError``, a watchdog trip, SIGTERM/atexit — or on demand via
+``ChainServer.dump_postmortem()`` / the ``GET /postmortem`` endpoint.
+
+Crash durability: ``os._exit`` (the PR 9 kill arms) skips every
+``atexit``/``finally``, so on-demand dumps alone would leave nothing
+behind. With ``sync_path`` set, the recorder additionally re-writes a
+spanless bundle (``flight.json``) every ``sync_every`` quanta — small
+and atomic, so a hard kill always leaves a parseable last-known-state
+bundle at most ``sync_every`` quanta stale (pinned by the chaos kill
+arm in tests/test_serve_faults.py).
+
+The PR 1 observability contract applies: recording and dumping never
+raise into the serving path — IO failures warn once and serving
+continues — and the ring is pure host bookkeeping, so chains are
+bitwise identical with the recorder on or off.
+
+``tools/postmortem.py`` renders a bundle (timeline, last-good-quantum
+diff, suspect tenant) with no jax import.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+import warnings
+from typing import Callable, Dict, List, Optional
+
+#: Bundle schema version (docs/observability.schema.json "postmortem").
+BUNDLE_SCHEMA = 1
+
+
+class FlightRecorder:
+    """Bounded ring of per-quantum entries + events + heartbeats.
+
+    ``capacity`` bounds the quantum ring, ``events_capacity`` the
+    event log (drop-oldest deques — a long-lived server cannot grow
+    without bound). ``context_fn``, when set, is called at bundle
+    time and its dict is merged into the bundle (the server hangs its
+    lock-free health/watchdog/stage-total views there); ``spans_fn``
+    supplies the span-ring tail for on-demand dumps (periodic syncs
+    stay spanless — spans are the bulky part, and the sync rides the
+    quantum boundary). Both callbacks are guarded: a raising provider
+    degrades to an ``error`` marker inside the bundle, never an
+    exception out of the recorder."""
+
+    def __init__(self, capacity: int = 64, events_capacity: int = 256,
+                 sync_path: Optional[str] = None, sync_every: int = 4,
+                 span_tail: int = 500,
+                 context_fn: Optional[Callable[[], dict]] = None,
+                 spans_fn: Optional[Callable[[], List[dict]]] = None):
+        if capacity < 1 or events_capacity < 1 or sync_every < 1:
+            raise ValueError(
+                "capacity, events_capacity and sync_every must be >= 1")
+        self.capacity = int(capacity)
+        self._quanta = collections.deque(maxlen=self.capacity)
+        self._events = collections.deque(maxlen=int(events_capacity))
+        self._beats: Dict[str, float] = {}
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self._n_quanta = 0
+        self._n_events = 0
+        self._dumps = 0
+        self._sync_path = sync_path
+        self._sync_every = int(sync_every)
+        self._span_tail = int(span_tail)
+        self._context_fn = context_fn
+        self._spans_fn = spans_fn
+        self._warned = False
+
+    # -- feeding --------------------------------------------------------
+
+    def note_quantum(self, entry: dict) -> None:
+        """Append one quantum's telemetry row (the server builds it at
+        the boundary: dispatch wall, occupancy, queue depth, stage
+        timings, fault counters). Triggers the periodic durable sync.
+        Never raises."""
+        try:
+            with self._lock:
+                self._quanta.append(entry)
+                self._n_quanta += 1
+                due = (self._sync_path is not None
+                       and self._n_quanta % self._sync_every == 0)
+            if due:
+                # best-effort durability: atomic replace, no fsync —
+                # a periodic sync that fsync'd would put disk latency
+                # on the serving path every few quanta; a torn sync
+                # just means the previous (complete) bundle survives
+                self.dump(self._sync_path, reason="sync",
+                          include_spans=False, fsync=False)
+        except Exception:  # noqa: BLE001 - never into the serving path
+            pass
+
+    def note_event(self, kind: str, **fields) -> None:
+        """Append one lifecycle event (admit / evict / fault /
+        quarantine / alert / ...). Never raises."""
+        try:
+            rec = {"kind": kind,
+                   "t": round(time.monotonic() - self._t0, 6)}
+            rec.update(fields)
+            with self._lock:
+                self._events.append(rec)
+                self._n_events += 1
+        except Exception:  # noqa: BLE001
+            pass
+
+    def beat(self, role: str) -> None:
+        """Record a heartbeat for an executor role (monotonic). The
+        bundle reports ages, so a stalled thread is visible as a stale
+        beat even when the watchdog is off."""
+        try:
+            self._beats[role] = time.monotonic()
+        except Exception:  # noqa: BLE001
+            pass
+
+    # -- bundling -------------------------------------------------------
+
+    def bundle(self, reason: str, include_spans: bool = True,
+               extra: Optional[dict] = None) -> dict:
+        """The postmortem document: ring + events + heartbeat ages +
+        the server context, schema-validated by the tier-1 drift
+        guard. Always succeeds — broken providers land as ``error``
+        markers in their block."""
+        now = time.monotonic()
+        with self._lock:
+            quanta = list(self._quanta)
+            events = list(self._events)
+            beats = dict(self._beats)
+            n_q, n_e = self._n_quanta, self._n_events
+        doc = {
+            "schema": BUNDLE_SCHEMA,
+            "t": round(time.time(), 3),
+            "reason": reason,
+            "ring_capacity": self.capacity,
+            "quanta_recorded": n_q,
+            "quanta_dropped": max(n_q - len(quanta), 0),
+            "events_recorded": n_e,
+            "events_dropped": max(n_e - len(events), 0),
+            "heartbeat_age_s": {
+                role: round(now - t, 3) for role, t in beats.items()},
+            "quanta": quanta,
+            "events": events,
+        }
+        if self._context_fn is not None:
+            try:
+                ctx = self._context_fn()
+                if isinstance(ctx, dict):
+                    doc.update(ctx)
+            except Exception as e:  # noqa: BLE001
+                doc["context_error"] = f"{type(e).__name__}: {e}"
+        if include_spans and self._spans_fn is not None:
+            try:
+                spans = self._spans_fn() or []
+                doc["spans"] = spans[-self._span_tail:]
+            except Exception as e:  # noqa: BLE001
+                doc["spans_error"] = f"{type(e).__name__}: {e}"
+        if extra:
+            doc.update(extra)
+        return doc
+
+    def dump(self, path: str, reason: str, include_spans: bool = True,
+             extra: Optional[dict] = None,
+             fsync: bool = True) -> Optional[str]:
+        """Write the bundle atomically (tmp + replace — a reader or a
+        crash mid-write can never observe a torn bundle). Returns the
+        path, or None on IO failure (warned once per recorder)."""
+        try:
+            doc = self.bundle(reason, include_spans=include_spans,
+                              extra=extra)
+            d = os.path.dirname(os.path.abspath(path))
+            os.makedirs(d, exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(_jsonable(doc), fh)
+                if fsync:
+                    fh.flush()
+                    os.fsync(fh.fileno())
+            os.replace(tmp, path)
+            with self._lock:
+                self._dumps += 1
+            return path
+        except Exception as e:  # noqa: BLE001 - the box must not crash
+            if not self._warned:
+                self._warned = True
+                warnings.warn(
+                    f"flight-recorder dump to {path!r} failed "
+                    f"({type(e).__name__}: {e}); serving continues "
+                    "without the bundle", RuntimeWarning)
+            return None
+
+
+def _jsonable(v):
+    """JSON-safe copy (numpy scalars/arrays -> python) — the
+    obs/metrics discipline, local so the recorder imports nothing
+    heavy."""
+    import numpy as np
+
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    return v
+
+
+def read_bundle(path: str) -> dict:
+    """Load + minimally check a bundle (the tools/postmortem.py entry
+    point; no jax import)."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    if doc.get("schema") != BUNDLE_SCHEMA:
+        raise ValueError(
+            f"{path}: not a postmortem bundle (schema "
+            f"{doc.get('schema')!r} != {BUNDLE_SCHEMA})")
+    return doc

@@ -16,7 +16,11 @@ stdlib-only (``http.server``, no new deps) endpoint server mounted via
 - ``GET /trace``     — Chrome trace-event JSON of the span ring (what
   ``export_trace`` writes, rendered in memory);
 - ``GET /tenants/<id-or-name>/progress`` — one tenant's streaming
-  monitor snapshot (``TenantHandle.progress()``, cost block included).
+  monitor snapshot (``TenantHandle.progress()``, cost block included);
+- ``GET /postmortem`` — the flight-recorder bundle rendered in memory
+  (round 15, the deep profiling plane): the same document
+  ``ChainServer.dump_postmortem()`` writes, so an operator can pull
+  the last N quanta's evidence off a degraded pool over the wire.
 
 Design rules (the PR 1 observability contract, wire edition):
 
@@ -59,12 +63,14 @@ class ObsHttpServer:
                  healthz_fn: Optional[Callable] = None,
                  metrics_fn: Optional[Callable] = None,
                  trace_fn: Optional[Callable] = None,
-                 progress_fn: Optional[Callable] = None):
+                 progress_fn: Optional[Callable] = None,
+                 postmortem_fn: Optional[Callable] = None):
         self._status_fn = status_fn
         self._healthz_fn = healthz_fn
         self._metrics_fn = metrics_fn
         self._trace_fn = trace_fn
         self._progress_fn = progress_fn
+        self._postmortem_fn = postmortem_fn
         self._warned = False
         outer = self
 
@@ -115,7 +121,7 @@ class ObsHttpServer:
             if not parts:
                 self._reply(req, 200, {"endpoints": [
                     "/healthz", "/status", "/metrics", "/trace",
-                    "/tenants/<id>/progress"]})
+                    "/postmortem", "/tenants/<id>/progress"]})
                 return
             if parts == ["healthz"] and self._healthz_fn is not None:
                 h = self._healthz_fn()
@@ -135,6 +141,11 @@ class ObsHttpServer:
                     return
             if parts == ["trace"] and self._trace_fn is not None:
                 doc = self._trace_fn()
+                if doc is not None:
+                    self._reply(req, 200, doc)
+                    return
+            if parts == ["postmortem"] and self._postmortem_fn is not None:
+                doc = self._postmortem_fn()
                 if doc is not None:
                     self._reply(req, 200, doc)
                     return

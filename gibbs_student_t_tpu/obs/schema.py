@@ -10,10 +10,12 @@ against them, so a drifting field fails CI instead of a future reader.
 
 The validator is a deliberately small JSON-Schema subset (``type``
 incl. lists, ``properties``, ``required``, ``items``, ``enum``,
-``additionalProperties: false``, ``anyOf``) — enough to pin record
-shapes without adding a dependency; unknown keywords are ignored, so
-the checked-in schemas stay forward-compatible with real JSON Schema
-tooling.
+``additionalProperties`` as ``false`` OR as a schema applied to every
+non-``properties`` key — how the dynamic stage-keyed maps of the
+round-15 profiling plane are pinned, ``anyOf``) — enough to pin
+record shapes without adding a dependency; unknown keywords are
+ignored, so the checked-in schemas stay forward-compatible with real
+JSON Schema tooling.
 """
 
 from __future__ import annotations
@@ -87,10 +89,16 @@ def validate(value, schema: dict, path: str = "$",
             if key in value:
                 errs.extend(validate(value[key], sub, f"{path}.{key}",
                                      defs))
-        if schema.get("additionalProperties") is False:
+        ap = schema.get("additionalProperties")
+        if ap is False:
             for key in value:
                 if key not in props:
                     errs.append(f"{path}: unexpected key {key!r}")
+        elif isinstance(ap, dict):
+            for key in value:
+                if key not in props:
+                    errs.extend(validate(value[key], ap,
+                                         f"{path}.{key}", defs))
     if isinstance(value, list) and "items" in schema:
         for i, item in enumerate(value):
             errs.extend(validate(item, schema["items"],

@@ -30,6 +30,10 @@ point               site (fires just before the real work)
                     the worker thread, not just the tenant)
 ``lane_nan``        quantum boundary, dispatch thread — poisons the
                     tenant's first chain lane state to NaN
+``dispatch_stall``  quantum boundary, dispatch thread, just before the
+                    chunk dispatch (``action="sleep"`` stalls the
+                    dispatch thread WITH the server lock held — the
+                    watchdog chaos arm's deterministic hang)
 ``kill_before_checkpoint``  ``ChainSpool.append`` before the state
                     checkpoint write (``action="kill"`` → ``os._exit``)
 ``kill_after_checkpoint``   same, after the checkpoint write
@@ -38,7 +42,10 @@ point               site (fires just before the real work)
 Actions: ``raise`` (the named exception type — the default),
 ``die`` (:class:`WorkerDeath`, a BaseException the worker loops do NOT
 latch, so the thread genuinely dies), ``kill`` (``os._exit(9)``, a
-process kill no ``finally`` can soften — the crash-recovery test arm).
+process kill no ``finally`` can soften — the crash-recovery test arm),
+``sleep`` (block the firing thread for ``seconds`` — a stall, not a
+failure: results are bitwise those of the uninjected run, only wall
+time and the watchdog's verdict change).
 
 Everything is process-local and OFF by default; ``install``/``clear``
 (or the ``inject`` context manager) arm and disarm. Counters of fired
@@ -76,11 +83,12 @@ POINTS = (
     "spool_io",
     "drain_death",
     "lane_nan",
+    "dispatch_stall",
     "kill_before_checkpoint",
     "kill_after_checkpoint",
 )
 
-_ACTIONS = ("raise", "die", "kill")
+_ACTIONS = ("raise", "die", "kill", "sleep")
 
 _EXCS = {
     "RuntimeError": RuntimeError,
@@ -109,10 +117,11 @@ class FaultSpec:
     ``after``   — skip this many matching traversals first (0 = fire on
                   the first one). Counted per (point, tenant-scope).
     ``times``   — how many firings before the spec disarms itself.
-    ``action``  — ``raise`` | ``die`` | ``kill``.
+    ``action``  — ``raise`` | ``die`` | ``kill`` | ``sleep``.
     ``exc``     — exception type name for ``action="raise"``.
     ``message`` — the raised exception's message (a recognizable token
                   chaos tests can assert on end to end).
+    ``seconds`` — stall duration for ``action="sleep"``.
     """
 
     point: str
@@ -122,6 +131,7 @@ class FaultSpec:
     action: str = "raise"
     exc: str = "RuntimeError"
     message: str = "injected fault"
+    seconds: float = 1.0
     _seen: int = field(default=0, repr=False)
     _fired: int = field(default=0, repr=False)
 
@@ -140,6 +150,8 @@ class FaultSpec:
                 f"{self.exc!r}")
         if self.after < 0 or self.times < 1:
             raise ValueError("after must be >= 0 and times >= 1")
+        if self.action == "sleep" and self.seconds <= 0:
+            raise ValueError("sleep seconds must be positive")
 
 
 _lock = threading.Lock()
@@ -212,10 +224,16 @@ def fire(point: str, tenant=None) -> None:
         if hit is None:
             return
         action, exc, msg = hit.action, hit.exc, hit.message
+        secs = hit.seconds
     # act outside the lock: a raise must not hold it, and _exit never
     # returns
     if action == "kill":
         os._exit(9)
+    if action == "sleep":
+        import time
+
+        time.sleep(secs)
+        return
     if action == "die":
         raise WorkerDeath(f"{msg} [{point}]")
     raise _EXCS[exc](f"{msg} [{point}]")

@@ -139,6 +139,10 @@ class TenantHandle:
         # worker / the serial driver); readers see GIL-atomic floats.
         self.cost_device_ms = 0.0
         self.cost_lane_quanta = 0
+        # per-stage device ms (round 15: the in-kernel stage timers'
+        # per-quantum deltas, attributed by the same active-lane
+        # share). Empty when the pool runs timers-off.
+        self.cost_stage_ms: Dict[str, float] = {}
 
     # -- lifecycle (server side) ---------------------------------------
 
@@ -207,6 +211,13 @@ class TenantHandle:
         self.cost_device_ms += device_ms
         self.cost_lane_quanta += int(lane_quanta)
 
+    def _add_stage_cost(self, stage_ms: Dict[str, float]) -> None:
+        """Fold one quantum's per-stage device-time share (same
+        single-writer discipline as :meth:`_add_cost`)."""
+        for name, ms in stage_ms.items():
+            self.cost_stage_ms[name] = \
+                self.cost_stage_ms.get(name, 0.0) + ms
+
     # -- caller side ----------------------------------------------------
 
     def cost(self) -> Dict[str, object]:
@@ -223,7 +234,7 @@ class TenantHandle:
         if self._monitor is not None:
             ess_min = self._monitor.snapshot().get("ess_min")
         core_s = self.cost_device_ms / 1e3
-        return {
+        c = {
             "device_ms": round(self.cost_device_ms, 3),
             "lane_quanta": int(self.cost_lane_quanta),
             "ess_per_core_s": (
@@ -231,6 +242,14 @@ class TenantHandle:
                 if isinstance(ess_min, (int, float)) and core_s > 0
                 else None),
         }
+        if self.cost_stage_ms:
+            # the deep-profiling split of device_ms (round 15): this
+            # tenant's active-lane share of each in-kernel stage's
+            # per-quantum device time
+            c["stage_device_ms"] = {
+                k: round(v, 3)
+                for k, v in sorted(self.cost_stage_ms.items())}
+        return c
 
     @property
     def admission_ms(self) -> Optional[float]:

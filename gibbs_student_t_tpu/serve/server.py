@@ -64,10 +64,13 @@ schema).
 
 from __future__ import annotations
 
+import atexit
 import contextlib
 import json
 import os
 import queue as _queue
+import signal
+import tempfile
 import threading
 import time
 import warnings
@@ -84,11 +87,18 @@ from gibbs_student_t_tpu.parallel.ensemble import (
     _localize_names,
     pad_model_arrays,
 )
+from gibbs_student_t_tpu.native import ffi as _nffi
+from gibbs_student_t_tpu.obs.flight import FlightRecorder
 from gibbs_student_t_tpu.obs.spans import (
     ROLE_DISPATCH,
     ROLE_DRAIN,
     ROLE_STAGING,
     SpanRecorder,
+)
+from gibbs_student_t_tpu.obs.watchdog import (
+    Watchdog,
+    WatchdogSpec,
+    serve_watchdog_env,
 )
 from gibbs_student_t_tpu.serve import faults as _faults
 from gibbs_student_t_tpu.serve.monitor import (
@@ -223,7 +233,12 @@ class ChainServer:
                  trace_jsonl: Optional[str] = None,
                  obs_dir: Optional[str] = None,
                  http_port: Optional[int] = None,
-                 http_host: str = "127.0.0.1"):
+                 http_host: str = "127.0.0.1",
+                 watchdog="auto",
+                 watchdog_spec: Optional[WatchdogSpec] = None,
+                 flight: bool = True, flight_dir: Optional[str] = None,
+                 flight_capacity: int = 64, flight_sync_every: int = 4,
+                 kernel_timers="auto"):
         """``pipeline`` selects the driver ``run()`` uses: ``"auto"``
         (default) follows ``GST_SERVE_PIPELINE`` (auto -> pipelined);
         ``True``/``False`` force it, still overridden by an explicit
@@ -258,6 +273,31 @@ class ChainServer:
         (read it back from ``server.http.port``). Mount failure warns
         and serving continues without the wire; chains are bitwise
         identical with the HTTP server on or off (pure host reads).
+
+        The deep profiling plane (round 15; docs/OBSERVABILITY.md
+        "Deep profiling plane"): ``kernel_timers`` (``"auto"`` follows
+        ``GST_KERNEL_TIMERS``, auto -> on where the native library has
+        the timer surface) raises the in-kernel stage-timer flag and
+        the server folds per-quantum cumulative deltas into
+        ``summary()['stages']`` / per-tenant ``cost()`` shares — a
+        runtime flag inside the SAME compiled kernels, so chains and
+        the lowered graph are bitwise identical either way.
+        ``flight`` (default on) arms the crash flight recorder: a
+        ``flight_capacity``-quanta ring of boundary telemetry +
+        events + heartbeats, synced spanless to
+        ``<flight_dir>/flight.json`` every ``flight_sync_every``
+        quanta (``flight_dir`` defaults to ``obs_dir`` or
+        ``manifest_dir``; with neither, on-demand dumps land in the
+        system temp dir) and dumped in full (span tail included) as
+        ``postmortem.json`` on pool failure / tenant fault / watchdog
+        trip / SIGTERM / atexit, via :meth:`dump_postmortem`, and over
+        ``GET /postmortem``. ``watchdog`` (``"auto"`` follows
+        ``GST_SERVE_WATCHDOG``; auto -> ``dump``, ``0``/False
+        disables; ``warn|dump|fail`` select the trip policy) runs the
+        independent stall watchdog — executor heartbeats,
+        per-quantum deadlines, drain-backlog and throughput-collapse
+        detectors; a trip degrades :meth:`healthz` to 503 with the
+        cause.
         """
         import jax.numpy as jnp
 
@@ -359,6 +399,90 @@ class ChainServer:
                 "max_queue": max_queue, "backpressure": backpressure,
                 "telemetry": telemetry,
             })
+        # ---- the deep profiling plane (round 15) ----------------------
+        # in-kernel stage timers: resolve GST_KERNEL_TIMERS against the
+        # native library's timer surface and raise/lower the
+        # process-global collection flag to match. The flag gates
+        # rdtsc brackets inside the SAME compiled kernels — chains and
+        # the lowered graph are bitwise identical on/off (pinned in
+        # tests/test_nchol.py), so the resolution can never change
+        # results, only whether stage evidence accumulates.
+        if kernel_timers not in ("auto", True, False):
+            raise ValueError(
+                f"kernel_timers must be 'auto', True or False, got "
+                f"{kernel_timers!r}")
+        kt_env = _nffi.kernel_timers_env()
+        if kt_env == "0":
+            self.kernel_timers = False
+        elif kt_env == "1":
+            self.kernel_timers = _nffi.timers_available()
+        else:
+            want = True if kernel_timers == "auto" else bool(kernel_timers)
+            self.kernel_timers = want and _nffi.timers_available()
+        _nffi.timers_enable(self.kernel_timers)
+        # per-stage device-time accounting: cumulative snapshots are
+        # differenced at drain time (the device_get there has already
+        # synced the drained quantum's compute), single-writer like
+        # the cost accumulators
+        self._stage_prev = (_nffi.timers_snapshot()
+                            if self.kernel_timers else {})
+        self._stage_ms_total: Dict[str, float] = {}
+        self._stage_quanta = 0
+        self._last_stage_ms: Dict[str, float] = {}
+        # the crash flight recorder: always-on bounded ring; dumps
+        # land next to the pull surface / the crash manifest
+        self._flight_dir = flight_dir or obs_dir or manifest_dir
+        self.flight = None
+        self._atexit_registered = False
+        self._sigterm_prev = None
+        if flight:
+            sync_path = (os.path.join(self._flight_dir, "flight.json")
+                         if self._flight_dir is not None else None)
+            self.flight = FlightRecorder(
+                capacity=flight_capacity,
+                sync_path=sync_path, sync_every=flight_sync_every,
+                context_fn=self._flight_context,
+                spans_fn=(self.spans.spans if self.spans is not None
+                          else None))
+            # evidence on the way down: atexit covers normal
+            # interpreter exits, SIGTERM the polite kills (os._exit is
+            # covered by the periodic flight.json sync — it skips
+            # both hooks by design)
+            atexit.register(self._atexit_dump)
+            self._atexit_registered = True
+            try:
+                if (threading.current_thread()
+                        is threading.main_thread()
+                        and signal.getsignal(signal.SIGTERM)
+                        == signal.SIG_DFL):
+                    self._sigterm_prev = signal.signal(
+                        signal.SIGTERM, self._on_sigterm)
+            except (ValueError, OSError):
+                pass  # not installable here; atexit + sync still cover
+        # the stall watchdog: an independent daemon ticker (started
+        # with the drivers, stopped at close)
+        wd_env = serve_watchdog_env()
+        if watchdog not in ("auto", False) \
+                and watchdog not in ("warn", "dump", "fail"):
+            raise ValueError(
+                f"watchdog must be 'auto', False, 'warn', 'dump' or "
+                f"'fail', got {watchdog!r}")
+        if wd_env != "auto":
+            policy = None if wd_env == "0" else wd_env
+        else:
+            policy = ("dump" if watchdog == "auto"
+                      else (watchdog if watchdog else None))
+        self._watchdog = None
+        # the stall detector only owes heartbeats while a driver is
+        # actually inside run() (set there): an abandoned or idle
+        # server with parked tenants is not a stall
+        self._driving = False
+        if policy is not None:
+            self._watchdog = Watchdog(
+                policy=policy, spec=watchdog_spec,
+                active_fn=lambda: (self._driving
+                                   and bool(self._running)),
+                on_trip=self._watchdog_trip)
         # run-level aggregates for the serving summary
         self.quanta = 0
         self.busy_lane_sweeps = 0     # chain-sweeps actually served
@@ -390,7 +514,8 @@ class ChainServer:
                     status_fn=self.status, healthz_fn=self.healthz,
                     metrics_fn=self._metrics_text,
                     trace_fn=self._trace_doc,
-                    progress_fn=self._tenant_progress)
+                    progress_fn=self._tenant_progress,
+                    postmortem_fn=self._postmortem_doc)
             except Exception as e:  # noqa: BLE001 - obs contract
                 warnings.warn(
                     f"observability HTTP server failed to start on "
@@ -414,6 +539,13 @@ class ChainServer:
         self._dispatch_wall_ms = 0.0
         for k in self._fault_counts:
             self._fault_counts[k] = 0
+        # stage-timer accounting restarts from the current cumulative
+        # snapshot so warmup kernels never leak into the timed window
+        self._stage_prev = (_nffi.timers_snapshot()
+                            if self.kernel_timers else {})
+        self._stage_ms_total = {}
+        self._stage_quanta = 0
+        self._last_stage_ms = {}
 
     def _span(self, name: str, role: str, tenant=None,
               quantum: Optional[int] = None):
@@ -666,6 +798,11 @@ class ChainServer:
                               nchains=req.nchains, niter=req.niter,
                               lanes=int(lanes[0]),
                               admission_ms=handle.admission_ms)
+        if self.flight is not None:
+            self.flight.note_event(
+                "admit", tenant=handle.tenant_id,
+                nchains=req.nchains, niter=req.niter,
+                lane0=int(lanes[0]))
 
     def _admit(self, handle: TenantHandle) -> bool:
         """Serial-path admission: prepare + place in one call (the
@@ -717,19 +854,206 @@ class ChainServer:
                 for t in running]
 
     @staticmethod
-    def _attribute_cost(dispatch_ms: float, shares: List) -> None:
+    def _attribute_cost(dispatch_ms: float, shares: List,
+                        stage_ms: Optional[Dict] = None) -> None:
         """Split one quantum's dispatch wall time across its tenants
         by active-lane share. The shares sum to exactly
         ``dispatch_ms``, so per-tenant ``cost.device_ms`` totals
         reconcile with ``summary()['cost']['dispatch_wall_ms']``
-        (the serve_bench acceptance pin). Runs on the drain worker
-        (pipelined) or the single serial thread."""
+        (the serve_bench acceptance pin). ``stage_ms`` — the quantum's
+        in-kernel stage-timer delta — splits by the same share into
+        each tenant's ``cost.stage_device_ms``. Runs on the drain
+        worker (pipelined) or the single serial thread."""
         total = sum(a for _, a in shares)
         if total <= 0:
             return
         for handle, act in shares:
             if act:
                 handle._add_cost(dispatch_ms * act / total, act)
+                if stage_ms:
+                    frac = act / total
+                    handle._add_stage_cost(
+                        {k: v * frac for k, v in stage_ms.items()})
+
+    # ------------------------------------------------------------------
+    # the deep profiling plane (round 15)
+    # ------------------------------------------------------------------
+
+    def _stage_delta(self) -> Dict[str, float]:
+        """Difference the cumulative in-kernel stage-timer snapshot
+        against the last boundary's and fold it into the run totals.
+        Called where the drained quantum's compute has provably
+        finished (the drain's device_get) — single-writer, like the
+        cost accumulators. Under the pipelined executor the NEXT
+        quantum may already have started when the snapshot is read, so
+        a per-quantum delta can lend a sliver to its neighbour; the
+        run totals are exact (cumulative counters, no resets in
+        flight). Returns ``{stage: ms}`` ({} timers-off)."""
+        if not self.kernel_timers:
+            return {}
+        cur = _nffi.timers_snapshot()
+        delta = _nffi.timers_delta_ms(self._stage_prev, cur)
+        self._stage_prev = cur
+        ms = {k: v["ms"] for k, v in delta.items()}
+        if ms:
+            for k, v in ms.items():
+                self._stage_ms_total[k] = \
+                    self._stage_ms_total.get(k, 0.0) + v
+            self._stage_quanta += 1
+            self._last_stage_ms = ms
+        return ms
+
+    def _stages_block(self) -> Optional[dict]:
+        """The ``summary()``/``status()`` per-stage device-time view:
+        total ms, per-counted-quantum mean, and share of the measured
+        dispatch wall. None while no stage evidence accumulated
+        (timers off / native unavailable / nothing drained yet)."""
+        if not self._stage_ms_total:
+            return None
+        wall = self._dispatch_wall_ms
+        nq = max(self._stage_quanta, 1)
+        return {
+            k: {
+                "device_ms": round(v, 3),
+                "ms_per_quantum": round(v / nq, 4),
+                "share_of_dispatch": (round(v / wall, 4)
+                                      if wall else None),
+            }
+            for k, v in sorted(self._stage_ms_total.items())
+        }
+
+    def _watchdog_block(self) -> dict:
+        """The ``healthz()``/``status()`` watchdog view (lock-free —
+        it must answer DURING the stall it reports)."""
+        if self._watchdog is None:
+            return {"enabled": False, "policy": None, "state": "off",
+                    "trip": None}
+        return self._watchdog.snapshot()
+
+    def _watchdog_trip(self, trip: dict) -> None:
+        """The watchdog's one-shot trip handler (runs on the ticker
+        thread): alert event + warning, the flight dump under the
+        ``dump``/``fail`` policies, and under ``fail`` a latched pool
+        error the driver raises at its next boundary (an in-flight
+        native call cannot be safely killed — ``fail`` surfaces when
+        control returns; ``healthz`` degrades immediately either
+        way)."""
+        policy = self._watchdog.policy
+        warnings.warn(
+            f"serving watchdog tripped [{trip['cause']}]: "
+            f"{trip['detail']} (policy {policy}); healthz now "
+            "degraded", RuntimeWarning)
+        if self.metrics is not None:
+            try:
+                self.metrics.counter("serve_watchdog_trips").inc()
+                self.metrics.emit("watchdog_trip", cause=trip["cause"],
+                                  detail=trip["detail"])
+            except Exception:  # noqa: BLE001 - alerting only
+                pass
+        if self._manifest is not None:
+            self._manifest.record("fault", tenant=None,
+                                  where="watchdog",
+                                  error=f"{trip['cause']}: "
+                                        f"{trip['detail']}")
+        if self.flight is not None:
+            self.flight.note_event("watchdog_trip", **trip)
+            if policy in ("dump", "fail"):
+                self.dump_postmortem(
+                    reason=f"watchdog:{trip['cause']}")
+        if policy == "fail" and self._worker_error is None:
+            self._worker_error = RuntimeError(
+                f"watchdog trip: {trip['cause']} ({trip['detail']})")
+            self._worker_error_label = "watchdog"
+
+    def _flight_context(self) -> dict:
+        """Server context merged into every flight bundle. Lock-FREE
+        by design: it must compose while the dispatch thread holds
+        the server lock mid-stall."""
+        return {
+            "quantum_idx": self.quanta,
+            "nlanes": self.pool.nlanes,
+            "quantum_sweeps": self.pool.quantum,
+            "running_tenants": len(self._running),
+            "queue_depth": len(self.queue),
+            "pipeline": bool(self.pipeline),
+            "faults": dict(self._fault_counts),
+            "watchdog": self._watchdog_block(),
+            "stage_totals_ms": {
+                k: round(v, 3)
+                for k, v in sorted(self._stage_ms_total.items())}
+            or None,
+            "kernel_timers": bool(self.kernel_timers),
+        }
+
+    def _flight_quantum(self, qidx: int, dispatch_ms: float,
+                        busy: int, drain_ms: Optional[float],
+                        stage_ms: Dict[str, float]) -> None:
+        """One quantum's flight-ring entry (recorded at drain time,
+        when the stage delta is known)."""
+        if self.flight is None:
+            return
+        self.flight.note_quantum({
+            "q": qidx,
+            "t": round(time.time(), 3),
+            "dispatch_ms": round(dispatch_ms, 3),
+            "drain_ms": (round(drain_ms, 3)
+                         if drain_ms is not None else None),
+            "busy_lanes": busy,
+            "occupancy_now": round(busy / self.pool.nlanes, 4),
+            "queue_depth": len(self.queue),
+            "faults": dict(self._fault_counts),
+            "stage_device_ms": ({k: round(v, 3)
+                                 for k, v in sorted(stage_ms.items())}
+                                or None),
+        })
+
+    def dump_postmortem(self, path: Optional[str] = None,
+                        reason: str = "manual") -> Optional[str]:
+        """Write the flight-recorder postmortem bundle (span tail
+        included) atomically and return its path — the operator's
+        black-box pull after anything went wrong. ``path`` defaults to
+        ``<flight_dir>/postmortem.json`` (system temp dir when the
+        server has no obs/manifest directory). Raises only when the
+        recorder is disabled; IO failures warn and return None (the
+        observability contract)."""
+        if self.flight is None:
+            raise ValueError(
+                "flight recorder is disabled (ChainServer("
+                "flight=False))")
+        if path is None:
+            d = self._flight_dir or tempfile.gettempdir()
+            path = os.path.join(d, "postmortem.json")
+        return self.flight.dump(path, reason=reason,
+                                include_spans=True)
+
+    def _postmortem_doc(self) -> Optional[dict]:
+        """``GET /postmortem``: the bundle rendered in memory (None ->
+        404 with the recorder disabled)."""
+        if self.flight is None:
+            return None
+        return self.flight.bundle("endpoint", include_spans=True)
+
+    def _atexit_dump(self) -> None:
+        """Interpreter-exit hook: leave a bundle behind when the
+        server is still live at exit (close() unregisters this — a
+        cleanly closed server leaves no surprise postmortem)."""
+        try:
+            if self.flight is not None and self._flight_dir is not None:
+                self.flight.dump(
+                    os.path.join(self._flight_dir, "postmortem.json"),
+                    reason="atexit", include_spans=True)
+        except Exception:  # noqa: BLE001 - exit path
+            pass
+
+    def _on_sigterm(self, signum, frame) -> None:
+        """SIGTERM: dump the bundle, then re-deliver the default
+        action so the process still dies with the right signal."""
+        self._atexit_dump()
+        try:
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            os.kill(os.getpid(), signal.SIGTERM)
+        except Exception:  # noqa: BLE001
+            raise SystemExit(143)
 
     # ------------------------------------------------------------------
     # fault containment
@@ -755,6 +1079,18 @@ class ChainServer:
             self._manifest.record(
                 "fault", tenant=slot.tenant_id, where=where,
                 error=f"{type(cause).__name__}: {cause}")
+        if self.flight is not None:
+            # a contained tenant failure is a dump trigger: the bundle
+            # preserves the quanta/spans AROUND the fault while they
+            # are still in the ring
+            self.flight.note_event(
+                "tenant_fault", tenant=slot.tenant_id, where=where,
+                error=f"{type(cause).__name__}: {cause}")
+            if self._flight_dir is not None:
+                self.flight.dump(
+                    os.path.join(self._flight_dir, "postmortem.json"),
+                    reason=f"tenant_fault:{slot.tenant_id}",
+                    include_spans=True)
 
     def _tenant_health(self, t: _Tenant) -> Optional[dict]:
         """The per-tenant health block (obs/health.py verdicts over the
@@ -941,6 +1277,13 @@ class ChainServer:
         if self.metrics is not None:
             self.metrics.emit("pool_failure", error=str(err),
                               label=label)
+        if self.flight is not None:
+            self.flight.note_event("pool_failure", error=str(err),
+                                   label=label)
+            if self._flight_dir is not None:
+                self.flight.dump(
+                    os.path.join(self._flight_dir, "postmortem.json"),
+                    reason="pool_failure", include_spans=True)
         if self.supervise:
             self._fail_all_outstanding(
                 f"pool failure: {type(err).__name__}: {err}",
@@ -970,6 +1313,11 @@ class ChainServer:
                 self._gap_ms.append(
                     (time.monotonic() - self._last_dispatch_t) * 1e3)
             self._boundary_faults()
+            if self._watchdog is not None:
+                self._watchdog.beat("dispatch")
+            if self.flight is not None:
+                self.flight.beat("dispatch")
+            _faults.fire("dispatch_stall")
             qidx = self.quanta
             t_d0 = time.monotonic()
             recs, tl = self.pool.run_quantum()
@@ -978,9 +1326,14 @@ class ChainServer:
             self._last_dispatch_t = time.monotonic()
             disp_ms = (self._last_dispatch_t - t_d0) * 1e3
             self._dispatch_wall_ms += disp_ms
+            # serial drain: run_quantum pulled the state, so this
+            # quantum's kernels have finished — the stage delta is
+            # exactly this quantum's device time
+            stage_ms = self._stage_delta()
             self._attribute_cost(disp_ms,
                                  self._cost_shares(
-                                     self._running.values()))
+                                     self._running.values()),
+                                 stage_ms=stage_ms)
             if self.spans is not None:
                 dur = self._last_dispatch_t - t_d0
                 for tid in self._running:
@@ -1035,7 +1388,17 @@ class ChainServer:
                                 raise
                             self._note_fault(t, "finalize", e)
                             self._finalize_failed(t)
-            self._drain_ms.append((time.monotonic() - t0) * 1e3)
+            drain_ms = (time.monotonic() - t0) * 1e3
+            self._drain_ms.append(drain_ms)
+            if self._watchdog is not None:
+                self._watchdog.beat("drain")
+                self._watchdog.note_quantum(
+                    disp_ms,
+                    sweeps_per_s=(busy * q / (disp_ms / 1e3)
+                                  if disp_ms > 0 else None),
+                    backlog=0)
+            self._flight_quantum(qidx, disp_ms, busy, drain_ms,
+                                 stage_ms)
             self._refresh_obs(locked=True)
             return bool(self._running) or len(self.queue) > 0
 
@@ -1165,6 +1528,9 @@ class ChainServer:
         if self.metrics is not None:
             self.metrics.emit("evict", tenant=slot.tenant_id,
                               sweeps=slot.done_sweeps)
+        if self.flight is not None:
+            self.flight.note_event("evict", tenant=slot.tenant_id,
+                                   sweeps=slot.done_sweeps)
 
     def _finalize(self, t: _Tenant) -> None:
         """Deliver a finished tenant's result (runs on whichever
@@ -1247,6 +1613,8 @@ class ChainServer:
 
     def _stage_worker(self) -> None:
         while not self._workers_stop.is_set():
+            if self._watchdog is not None:
+                self._watchdog.beat("staging")
             h = self._take_for_staging()
             if h is None:
                 time.sleep(0.005)
@@ -1281,15 +1649,21 @@ class ChainServer:
         contained to that tenant under supervision; re-raised under
         the fail-fast arm. Non-Exception escapes (worker death) leave
         ``b.idx`` at the undrained tail for ``_abort_undrained``."""
-        if b.cost is not None:
-            # consume-once so a resumed bundle (worker death mid-flush,
-            # inline re-drain) can never double-bill a tenant
-            disp_ms, shares = b.cost
-            b.cost = None
-            self._attribute_cost(disp_ms, shares)
+        # consume-once so a resumed bundle (worker death mid-flush,
+        # inline re-drain) can never double-bill a tenant
+        cost, b.cost = b.cost, None
+        t_b0 = time.monotonic()
         wire = (self.pool.wire_host(b.recs)
                 if b.recs is not None else None)
         tele = (jax.device_get(b.tl) if b.tl is not None else None)
+        if cost is not None:
+            # the wire/tele pulls above synced the drained quantum's
+            # compute, so the cumulative stage-timer delta belongs to
+            # it (under pipelining the next quantum may already have
+            # started — totals stay exact, see _stage_delta)
+            disp_ms, shares = cost
+            stage_ms = self._stage_delta()
+            self._attribute_cost(disp_ms, shares, stage_ms=stage_ms)
         while b.idx < len(b.entries):
             slot, handle, spool, sweep_end, final, drained = \
                 b.entries[b.idx]
@@ -1321,6 +1695,19 @@ class ChainServer:
                 if final:
                     self._finalize_failed(t)
             b.idx += 1
+        if cost is not None:
+            disp_ms, shares = cost
+            self._flight_quantum(
+                b.qidx, disp_ms, sum(a for _, a in shares),
+                (time.monotonic() - t_b0) * 1e3, stage_ms)
+            if self._watchdog is not None:
+                busy = sum(a for _, a in shares)
+                q = self.pool.quantum
+                self._watchdog.note_quantum(
+                    disp_ms,
+                    sweeps_per_s=(busy * q / (disp_ms / 1e3)
+                                  if disp_ms > 0 else None),
+                    backlog=self._drainq.unfinished_tasks)
 
     def _abort_undrained(self, b: _Bundle, exc: BaseException) -> None:
         """A worker died mid-bundle: every entry from the in-flight one
@@ -1337,6 +1724,10 @@ class ChainServer:
     def _drain_worker(self) -> None:
         while True:
             item = self._drainq.get()
+            if self._watchdog is not None:
+                self._watchdog.beat("drain")
+            if self.flight is not None:
+                self.flight.beat("drain")
             if item is None:
                 self._drainq.task_done()
                 return
@@ -1427,6 +1818,11 @@ class ChainServer:
             self._gap_ms.append(
                 (time.monotonic() - self._last_dispatch_t) * 1e3)
         self._boundary_faults()
+        if self._watchdog is not None:
+            self._watchdog.beat("dispatch")
+        if self.flight is not None:
+            self.flight.beat("dispatch")
+        _faults.fire("dispatch_stall")
         need_snap = any(t.spool is not None
                         for t in self._running.values())
         qidx = self.quanta
@@ -1574,17 +1970,23 @@ class ChainServer:
         all drain). ``on_quantum(server)``, when given, fires after
         every quantum boundary on the driving thread — the
         serve_bench staggered-arrival hook."""
-        if not self.pipeline:
-            while not self._stop.is_set():
-                had_work = self.step()
-                if on_quantum is not None:
-                    on_quantum(self)
-                if not had_work:
-                    if idle_exit:
-                        return
-                    time.sleep(poll_s)
-            return
-        self._run_pipelined(idle_exit, poll_s, on_quantum)
+        if self._watchdog is not None:
+            self._watchdog.start()
+        self._driving = True
+        try:
+            if not self.pipeline:
+                while not self._stop.is_set():
+                    had_work = self.step()
+                    if on_quantum is not None:
+                        on_quantum(self)
+                    if not had_work:
+                        if idle_exit:
+                            return
+                        time.sleep(poll_s)
+                return
+            self._run_pipelined(idle_exit, poll_s, on_quantum)
+        finally:
+            self._driving = False
 
     def start(self) -> None:
         """Run the quantum loop in a background thread until
@@ -1619,6 +2021,18 @@ class ChainServer:
             self._stage_thread.join()
         self._stage_thread = None
         self._fail_all_outstanding("server closed")
+        if self._watchdog is not None:
+            self._watchdog.stop()
+        if self._atexit_registered:
+            # a cleanly closed server leaves no surprise postmortem
+            with contextlib.suppress(Exception):
+                atexit.unregister(self._atexit_dump)
+            self._atexit_registered = False
+        if self._sigterm_prev is not None:
+            with contextlib.suppress(Exception):
+                if signal.getsignal(signal.SIGTERM) == self._on_sigterm:
+                    signal.signal(signal.SIGTERM, self._sigterm_prev)
+            self._sigterm_prev = None
         self._refresh_obs()          # final pull-surface state
         if self.http is not None:
             self.http.close()        # stop the wire last: readable
@@ -1680,6 +2094,12 @@ class ChainServer:
             "pipeline": bool(self.pipeline),
             "supervise": bool(self.supervise),
             "faults": dict(self._fault_counts),
+            # the deep profiling plane (round 15): per-stage device
+            # time (None until the timers accumulate evidence) + the
+            # watchdog detector state — what serve_top's new panes
+            # render
+            "stages": self._stages_block(),
+            "watchdog": self._watchdog_block(),
             "slo": self._slo_block(),
             # the raw per-tenant latency series behind the percentile
             # blocks — what the fleet aggregator merges across pools
@@ -1710,16 +2130,24 @@ class ChainServer:
 
     def healthz(self) -> dict:
         """The liveness verdict behind ``GET /healthz``: ``ok`` is
-        False exactly when the POOL is unhealthy (a pool failure was
-        counted, or a worker error is latched and about to become
-        one) — contained tenant faults do not flip it. The worker
-        block reports each executor thread's liveness (all False on a
-        serial/idle server is normal: the workers are lazy)."""
-        with self._lock:
-            running = len(self._running)
+        False exactly when the POOL is unhealthy — a pool failure was
+        counted, a worker error is latched and about to become one,
+        or the watchdog tripped (round 15: a silently stalled dispatch
+        thread used to answer 200 forever). Contained tenant faults do
+        not flip it. Deliberately LOCK-FREE (GIL-atomic reads only):
+        the dispatch thread holds the server lock for the whole
+        quantum — and for the whole STALL when it hangs — so a locked
+        healthz could never report the one condition it exists for.
+        The worker block reports each executor thread's liveness (all
+        False on a serial/idle server is normal: the workers are
+        lazy); the ``watchdog`` block carries the detector state,
+        heartbeat ages and the latched trip cause."""
+        running = len(self._running)   # dict len: GIL-atomic
         err = self._worker_error
+        wd = self._watchdog_block()
+        tripped = wd.get("state") == "tripped"
         ok = (self._fault_counts["pool_failures"] == 0
-              and err is None)
+              and err is None and not tripped)
         return {
             "ok": bool(ok),
             "t": round(time.time(), 3),
@@ -1738,8 +2166,11 @@ class ChainServer:
             },
             "worker_restarts": self._fault_counts["worker_restarts"],
             "pool_failures": self._fault_counts["pool_failures"],
+            "watchdog": wd,
             "error": (f"{type(err).__name__}: {err}"
-                      if err is not None else None),
+                      if err is not None
+                      else (f"watchdog trip: {wd['trip']['cause']}"
+                            if tripped and wd.get("trip") else None)),
         }
 
     # -- the HTTP endpoint callbacks (obs/http.py) ---------------------
@@ -1916,6 +2347,11 @@ class ChainServer:
             },
             "faults": dict(self._fault_counts),
             "slo": self._slo_block(),
+            # per-stage DEVICE time from the in-kernel timers (round
+            # 15): total/mean-per-quantum/share-of-dispatch per stage,
+            # None while no evidence accumulated (timers off)
+            "stages": self._stages_block(),
+            "watchdog": self._watchdog_block(),
             # total measured dispatch wall (ms): the per-tenant
             # cost.device_ms attributions sum back to this — the
             # reconciliation serve_bench's cost block asserts

@@ -57,8 +57,11 @@
 // (per-lane-consts tnt/fused-hyper lanes variants with the
 // tile-uniform group-id contract, residual matvec). v4: gst_white_lanes
 // — the per-lane-consts white-MH twin (the last lanes-path MH stage
-// still on the grouped XLA loop under serving).
-#define GST_ABI_VERSION 4
+// still on the grouped XLA loop under serving). v5: the in-kernel
+// stage-timer side channel (gst_timers_* / gst_timer_* exports the
+// Python probe binds; the FFI call signatures themselves are
+// unchanged — timers are a runtime flag, never an operand).
+#define GST_ABI_VERSION 5
 GST_EXPORT2 int gst_abi_version() { return GST_ABI_VERSION; }
 
 // Best SIMD level this object was compiled for — the Python loader
@@ -998,6 +1001,65 @@ XLA_FFI_DEFINE_HANDLER_SYMBOL(GstFusedHyperLanesF32,
 XLA_FFI_DEFINE_HANDLER_SYMBOL(GstFusedHyperLanesF64,
                               (fused_hyper_lanes_impl<ffi::F64>),
                               GST_BIND_FUSED_HYPER_LANES(ffi::F64));
+
+// ---------------------------------------------------------------------
+// in-kernel stage timers (round 15): the plain-C control surface
+// ---------------------------------------------------------------------
+// The kernels accumulate per-stage rdtsc cycle counts into process
+// globals when gst_timers_enable(1) raised the flag (gst_kernels.h —
+// the same compiled code runs either way, so chains and the lowered
+// graph are bitwise identical timers on/off). These entries are how
+// gibbs_student_t_tpu/native/ffi.py drives the side channel: enable /
+// reset / cumulative snapshot, stage-name introspection (so the
+// Python stage list can never drift from the C enum), and a one-shot
+// ns-per-tick calibration against CLOCK_MONOTONIC.
+
+GST_EXPORT2 int gst_timer_stage_count() { return gst::TS_NSTAGES; }
+
+GST_EXPORT2 const char* gst_timer_stage_name(int i) {
+  return gst::stage_name(i);
+}
+
+GST_EXPORT2 void gst_timers_enable(int on) { gst::g_timers_on = on; }
+
+GST_EXPORT2 int gst_timers_enabled() { return gst::g_timers_on; }
+
+GST_EXPORT2 void gst_timers_reset() {
+  for (int i = 0; i < gst::TS_NSTAGES; ++i) {
+    __atomic_store_n(&gst::g_timer_cycles[i], 0ull, __ATOMIC_RELAXED);
+    __atomic_store_n(&gst::g_timer_calls[i], 0ull, __ATOMIC_RELAXED);
+  }
+}
+
+// Cumulative (cycles, calls) per stage, in enum order. Consumers
+// difference snapshots; a reset is only safe when no kernel is in
+// flight (the Python side resets at probe/bench boundaries only).
+GST_EXPORT2 void gst_timers_snapshot(uint64_t* cycles,
+                                     uint64_t* calls) {
+  for (int i = 0; i < gst::TS_NSTAGES; ++i) {
+    cycles[i] = __atomic_load_n(&gst::g_timer_cycles[i],
+                                __ATOMIC_RELAXED);
+    calls[i] = __atomic_load_n(&gst::g_timer_calls[i],
+                               __ATOMIC_RELAXED);
+  }
+}
+
+// Calibrate the tick unit once: spin ~2 ms and return ns per tick.
+// rdtsc is constant-rate on every supported host; on the non-x86
+// clock_gettime fallback this measures ~1.0 by construction.
+GST_EXPORT2 double gst_timer_ns_per_tick() {
+  struct timespec t0, t1;
+  clock_gettime(CLOCK_MONOTONIC, &t0);
+  const uint64_t c0 = gst::rdtick();
+  double ns = 0.0;
+  uint64_t c1 = c0;
+  do {
+    c1 = gst::rdtick();
+    clock_gettime(CLOCK_MONOTONIC, &t1);
+    ns = (t1.tv_sec - t0.tv_sec) * 1e9 + (t1.tv_nsec - t0.tv_nsec);
+  } while (ns < 2e6);
+  return c1 > c0 ? ns / double(c1 - c0) : 1.0;
+}
 
 // Plain-C debug/parity entry for the in-kernel RNG: fills ``out`` with
 // ``count`` philox words for (key, ctr0 row, tag) — how the jnp twin's

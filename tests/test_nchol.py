@@ -1220,7 +1220,11 @@ def test_registered_targets_match_exported_symbols():
         f"registered-but-unexported: {sorted(registered - handlers)}")
     # the plain-C gst_* surface the probe/benches rely on
     for sym in ("gst_simd_level", "gst_abi_version", "gst_philox_fill",
-                "gst_bench_chisq", "gst_bench_transpose_reg"):
+                "gst_bench_chisq", "gst_bench_transpose_reg",
+                "gst_timer_stage_count", "gst_timer_stage_name",
+                "gst_timers_enable", "gst_timers_enabled",
+                "gst_timers_reset", "gst_timers_snapshot",
+                "gst_timer_ns_per_tick"):
         assert sym in exported, f"plain-C entry {sym} missing"
 
 
@@ -1356,6 +1360,122 @@ def test_fused_hyper_lanes_uniform_bitwise():
     np.testing.assert_array_equal(iv_h[:16], iv_s[:16])
     np.testing.assert_array_equal(iv_h[32:], iv_s[32:])
     assert not np.array_equal(iv_h[16:32], iv_s[16:32])
+
+
+# ----------------------------------------------------------------------
+# in-kernel stage timers (round 15, the deep profiling plane)
+# ----------------------------------------------------------------------
+
+
+def _fused_timer_operands(B=1024, ns=44, nv=16, p=24, S=10, seed=5):
+    """Synthetic fused-megastage operands at flagship-like shapes (the
+    test_fused_hyper_lanes construction, bigger), plus a jitted
+    single-dispatch callable."""
+    rng = np.random.default_rng(seed)
+    nk, dt = 2, np.float32
+
+    def spd(k):
+        M = rng.standard_normal((B, k, max(k // 2, 4)))
+        return (np.einsum("bij,bkj->bik", M, M)
+                + 5 * np.eye(k)).astype(dt)
+
+    ops = [jnp.asarray(a) for a in (
+        spd(ns), (0.1 * rng.standard_normal((B, ns, nv))).astype(dt),
+        spd(nv), rng.standard_normal((B, ns)).astype(dt),
+        rng.standard_normal((B, nv)).astype(dt),
+        rng.standard_normal((B, p)).astype(dt),
+        (0.1 * rng.standard_normal((B, S, p))).astype(dt),
+        np.log(rng.random((B, S))).astype(dt),
+        rng.standard_normal((B, ns + nv)).astype(dt),
+        rng.standard_normal(B).astype(dt))]
+    K = (0.3 * rng.standard_normal((1 + nk, nv))).astype(dt)
+    sel = (rng.random(nv) > 0.3).astype(dt)
+    phist = (rng.random(nv) * (1 - sel)).astype(dt)
+    specs = np.zeros((3, p), dt)
+    specs[1], specs[2] = -50, 50
+    fn = jax.jit(lambda *a: nffi.fused_hyper(
+        *a, jnp.asarray(K), jnp.asarray(sel), jnp.asarray(phist),
+        jnp.asarray(specs), (1, 4), 1e-6, (1e-6, 1e-4, 1e-2, 1e-1)))
+    return fn, ops
+
+
+def test_kernel_timers_env_and_probe(monkeypatch):
+    """GST_KERNEL_TIMERS follows the strict auto|1|0 loud-typo
+    contract; the probe cross-checks the Python stage list against the
+    C enum; '0' keeps the resolution off even with the surface
+    present."""
+    monkeypatch.delenv("GST_KERNEL_TIMERS", raising=False)
+    assert nffi.kernel_timers_env() == "auto"
+    monkeypatch.setenv("GST_KERNEL_TIMERS", "yes")
+    with pytest.raises(ValueError, match="GST_KERNEL_TIMERS"):
+        nffi.kernel_timers_env()
+    monkeypatch.setenv("GST_KERNEL_TIMERS", "0")
+    assert nffi.timers_resolved_on() is False
+    monkeypatch.delenv("GST_KERNEL_TIMERS", raising=False)
+    _require_kernels()
+    assert nffi.timers_available()
+    assert nffi.timers_resolved_on()
+    # calibration is cached and sane: rdtsc ticks are sub-10ns on any
+    # host this runs on (the clock_gettime fallback reads exactly 1.0)
+    npt = nffi.timers_ns_per_tick()
+    assert npt == nffi.timers_ns_per_tick()
+    assert 0.01 <= npt <= 10.0
+
+
+def test_kernel_timers_bitwise_and_lowered_graph():
+    """The side-channel contract: timers on/off runs the SAME compiled
+    kernel code behind the SAME lowered graph — outputs bitwise equal,
+    lowering text identical (no operand, no attribute, nothing for the
+    flag to change), and off-mode accumulates nothing."""
+    _require_kernels()
+    fn, ops = _fused_timer_operands(B=64, ns=8, nv=8, p=10, S=4)
+    txt_off = jax.jit(fn).lower(*ops).as_text()
+    nffi.timers_enable(False)
+    nffi.timers_reset()
+    out_off = [np.asarray(a) for a in fn(*ops)]
+    assert not nffi.timers_delta_ms({}, nffi.timers_snapshot())
+    nffi.timers_enable(True)
+    out_on = [np.asarray(a) for a in fn(*ops)]
+    d = nffi.timers_delta_ms({}, nffi.timers_snapshot())
+    assert set(d) <= {"schur", "hyper_mh", "bdraw_factor", "solves"}
+    assert d, "timers on accumulated nothing"
+    nffi.timers_enable(False)
+    txt_on = jax.jit(fn).lower(*ops).as_text()
+    assert txt_on == txt_off
+    for a, b in zip(out_on, out_off):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_kernel_timers_reconcile_fused_dispatch_wall():
+    """THE reconciliation pin (ISSUE 12 acceptance): the four fused
+    stage segments (schur / hyper-MH / b-draw factor / solves, with
+    scratch setup folded into the first) sum to within 15% of the
+    fused dispatch wall at flagship-like shapes — the timers measure
+    the dispatch they claim to decompose."""
+    _require_kernels()
+    fn, ops = _fused_timer_operands()
+    import time
+
+    jax.block_until_ready(fn(*ops))   # compile + warm outside timing
+    nffi.timers_enable(True)
+    try:
+        prev = nffi.timers_snapshot()
+        t0 = time.perf_counter()
+        for _ in range(6):
+            jax.block_until_ready(fn(*ops))
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        delta = nffi.timers_delta_ms(prev, nffi.timers_snapshot())
+    finally:
+        nffi.timers_enable(False)
+    fused = {k: v["ms"] for k, v in delta.items()
+             if k in ("schur", "hyper_mh", "bdraw_factor", "solves")}
+    assert set(fused) == {"schur", "hyper_mh", "bdraw_factor",
+                          "solves"}
+    total = sum(fused.values())
+    ratio = total / wall_ms
+    assert abs(1.0 - ratio) <= 0.15, (
+        f"stage sum {total:.2f}ms vs dispatch wall {wall_ms:.2f}ms "
+        f"(ratio {ratio:.3f}) — the timers no longer reconcile")
 
 
 def test_residual_matvec_dispatch_forced(monkeypatch):

@@ -62,11 +62,15 @@ def test_http_server_routes_and_failure_modes():
         port=0,
         status_fn=lambda: {"schema": 1, "fake": True},
         healthz_fn=lambda: {"ok": False, "reason": "draining"},
+        postmortem_fn=lambda: {"schema": 1, "reason": "stub"},
         trace_fn=boom)
     try:
         assert srv.port > 0
         code, body = _get(srv.url + "/")
         assert code == 200 and "/healthz" in body
+        assert "/postmortem" in body
+        code, body = _get(srv.url + "/postmortem")
+        assert code == 200 and json.loads(body)["reason"] == "stub"
         code, body = _get(srv.url + "/status")
         assert code == 200 and json.loads(body)["fake"] is True
         code, _ = _get(srv.url + "/healthz")
@@ -218,6 +222,14 @@ CANNED_TOP = {
     "queue_depth": 2, "staged": 1, "pipeline": True, "supervise": True,
     "faults": {"tenant_failures": 1, "quarantined_lanes": 0,
                "reinits": 0, "worker_restarts": 0, "pool_failures": 0},
+    "watchdog": {"enabled": True, "policy": "dump", "state": "ok",
+                 "trip": None,
+                 "heartbeat_age_s": {"dispatch": 0.1, "drain": 0.2},
+                 "deadline_s": 1.0, "quanta_seen": 40},
+    "stages": {"hyper_mh": {"device_ms": 300.0, "ms_per_quantum": 7.5,
+                            "share_of_dispatch": 0.31},
+               "tnt": {"device_ms": 120.0, "ms_per_quantum": 3.0,
+                       "share_of_dispatch": 0.12}},
     "slo": {"admission_ms": {"p50": 10.0, "p90": 20.0, "p99": 30.0,
                              "max": 31.5, "mean": 12.0},
             "first_result_ms": None, "converged_ms": None,
@@ -242,6 +254,8 @@ GOLDEN_TOP = (
     "serve_top  quanta=40 uptime=12s lanes=48/64 (75% now, 81.2% run)"
     " queue=2 staged=1 pipeline=on\n"
     "faults: tenant_failures=1\n"
+    "watchdog: ok [policy dump] beats dispatch=0.1s drain=0.2s\n"
+    "stages: hyper_mh 7.5ms/q(31%) tnt 3.0ms/q(12%)\n"
     "slo admission_ms     p50=    10.0 p90=    20.0 p99=    30.0 "
     "max=    31.5\n"
     "  ID       NAME   STATUS CHAINS      SWEEPS   ROWS      ESS"
@@ -319,6 +333,119 @@ def test_fleet_status_tool_renders_without_jax(tmp_path):
     with contextlib.redirect_stdout(buf):
         rc = tool.main([str(tmp_path / "nope"), "--json"])
     assert rc == 1
+
+
+# ----------------------------------------------------------------------
+# watchdog + flight recorder units (round 15; jax-light, no server)
+# ----------------------------------------------------------------------
+
+
+def test_watchdog_detectors_and_validation():
+    """The three detectors as units — monotone backlog growth,
+    sustained throughput collapse (adjacent rolling medians: a noisy
+    point cannot trip it), and the strict validation surfaces — plus
+    the one-shot latch."""
+    from gibbs_student_t_tpu.obs.watchdog import (
+        Watchdog,
+        WatchdogSpec,
+        serve_watchdog_env,
+    )
+
+    with pytest.raises(ValueError, match="collapse_drop"):
+        WatchdogSpec(collapse_drop=1.5)
+    with pytest.raises(ValueError, match="policy"):
+        Watchdog(policy="explode")
+    with pytest.raises(ValueError, match="GST_SERVE_WATCHDOG"):
+        os.environ["GST_SERVE_WATCHDOG"] = "bogus"
+        try:
+            serve_watchdog_env()
+        finally:
+            del os.environ["GST_SERVE_WATCHDOG"]
+    trips = []
+    w = Watchdog(policy="warn",
+                 spec=WatchdogSpec(backlog_quanta=3, backlog_min=2,
+                                   min_deadline_s=99, tick_s=9),
+                 on_trip=trips.append)
+    for b in (1, 2, 2):               # non-strict growth below min: no
+        w.note_quantum(10.0, backlog=b)
+    assert w.check() is None
+    for b in (3, 4):
+        w.note_quantum(10.0, backlog=b)
+    t = w.check()
+    assert t["cause"] == "drain_backlog" and trips == [t]
+    assert w.check() is t             # latched, on_trip fired once
+    w2 = Watchdog(policy="warn",
+                  spec=WatchdogSpec(collapse_window=2,
+                                    collapse_drop=0.5,
+                                    min_deadline_s=99, tick_s=9))
+    for v in (100, 100, 90, 10):      # one bad point: medians hold
+        w2.note_quantum(10.0, sweeps_per_s=v)
+    # recent median sits exactly AT the threshold (50 = 0.5*100):
+    # strict comparison — a borderline noisy point does not trip
+    assert w2.check() is None
+    w3 = Watchdog(policy="warn",
+                  spec=WatchdogSpec(collapse_window=2,
+                                    collapse_drop=0.5,
+                                    min_deadline_s=99, tick_s=9))
+    for v in (100, 100, 10, 10):
+        w3.note_quantum(10.0, sweeps_per_s=v)
+    t3 = w3.check()
+    assert t3 is not None and t3["cause"] == "throughput_collapse"
+    snap = w3.snapshot()
+    assert snap["state"] == "tripped"
+    assert snap["trip"]["cause"] == "throughput_collapse"
+
+
+def test_flight_recorder_ring_bounds_and_dump(tmp_path, schemas):
+    """Unit: the ring drops oldest past capacity (and accounts the
+    drops), events bound independently, context/span providers that
+    raise degrade to error markers, dumps are atomic + schema-valid,
+    and the periodic sync fires spanless every sync_every quanta."""
+    from gibbs_student_t_tpu.obs.flight import (
+        FlightRecorder,
+        read_bundle,
+    )
+
+    sync = str(tmp_path / "flight.json")
+    rec = FlightRecorder(
+        capacity=4, events_capacity=3, sync_path=sync, sync_every=2,
+        context_fn=lambda: {"quantum_idx": 9, "kernel_timers": False},
+        spans_fn=lambda: [{"name": "s", "role": "drain", "t0": 0.0,
+                           "dur": 0.1, "tenant": None, "quantum": 0,
+                           "thread": "t"}])
+    for q in range(7):
+        rec.note_quantum({"q": q, "t": 1.0, "dispatch_ms": 10.0,
+                          "drain_ms": 1.0, "busy_lanes": 8,
+                          "occupancy_now": 0.5, "queue_depth": 0,
+                          "faults": {}, "stage_device_ms": None})
+        rec.note_event("admit", tenant=q)
+    doc = rec.bundle("unit")
+    assert [e["q"] for e in doc["quanta"]] == [3, 4, 5, 6]
+    assert doc["quanta_recorded"] == 7 and doc["quanta_dropped"] == 3
+    assert len(doc["events"]) == 3 and doc["events_dropped"] == 4
+    assert doc["quantum_idx"] == 9          # context merged
+    assert doc["spans"]                     # provider included
+    obs_schema.assert_valid(doc, schemas["postmortem"], "unit bundle",
+                            defs=schemas)
+    # the periodic sync fired (spanless) and parses via read_bundle
+    fj = read_bundle(sync)
+    assert fj["reason"] == "sync" and "spans" not in fj
+    obs_schema.assert_valid(fj, schemas["postmortem"], "sync bundle",
+                            defs=schemas)
+    # broken providers degrade inside the bundle, never raise out
+    rec2 = FlightRecorder(
+        context_fn=lambda: 1 / 0, spans_fn=lambda: 1 / 0)
+    rec2.note_quantum({"q": 0, "dispatch_ms": 1.0, "busy_lanes": 1,
+                       "queue_depth": 0})
+    d2 = rec2.bundle("broken")
+    assert "context_error" in d2 and "spans_error" in d2
+    p = rec2.dump(str(tmp_path / "pm.json"), reason="broken")
+    assert p and read_bundle(p)["reason"] == "broken"
+    # unreadable target: warn-once, None return, recorder survives
+    bad = str(tmp_path / "pm.json" / "nope" / "x.json")
+    with pytest.warns(RuntimeWarning, match="flight-recorder"):
+        assert rec2.dump(bad, reason="x") is None
+    assert rec2.dump(bad, reason="x") is None   # quiet second time
 
 
 # ----------------------------------------------------------------------

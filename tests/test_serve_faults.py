@@ -359,6 +359,143 @@ def test_supervise_gate_validation(demo, monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# the stall watchdog (round 15): injected dispatch stall -> trip ->
+# 503 healthz + postmortem bundle, survivors bitwise
+# ---------------------------------------------------------------------------
+
+
+def test_dispatch_stall_watchdog_trips_and_dumps(demo, refs, tmp_path):
+    """THE round-15 chaos pin: an injected dispatch stall (the
+    ``dispatch_stall`` sleep point fires WITH the server lock held, a
+    deterministic hang) trips the watchdog within the stalled quantum,
+    ``/healthz`` answers 503 with the cause DURING the stall (the
+    lock-free liveness contract), a schema-valid postmortem bundle
+    lands on disk, and both tenants' results are BITWISE the
+    uninjected reference — a stall loses time, never state."""
+    import json
+    import threading
+    import time
+    import urllib.error
+    import urllib.request
+
+    from gibbs_student_t_tpu.obs import schema as obs_schema
+    from gibbs_student_t_tpu.obs.watchdog import WatchdogSpec
+
+    with pytest.raises(ValueError, match="seconds"):
+        faults.FaultSpec("dispatch_stall", action="sleep", seconds=0)
+
+    ma, cfg = demo
+    obs_dir = str(tmp_path / "obs")
+    srv = ChainServer(ma, cfg, nlanes=32, quantum=5, record="full",
+                      obs_dir=obs_dir, http_port=0,
+                      watchdog_spec=WatchdogSpec(
+                          min_deadline_s=0.5, deadline_factor=4.0,
+                          tick_s=0.05))
+    # warm the pool: the first quantum's compile wall must not sit in
+    # the deadline median the detector sizes against
+    w = srv.submit(TenantRequest(ma=ma, niter=15, nchains=16, seed=99))
+    srv.run()
+    w.result()
+    hA = srv.submit(TenantRequest(ma=ma, niter=20, nchains=16, seed=1,
+                                  name="A"))
+    hB = srv.submit(TenantRequest(ma=ma, niter=20, nchains=16, seed=2,
+                                  name="B"))
+    url = srv.http.url
+    codes = []
+
+    def poll():
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 6.0:
+            try:
+                codes.append(urllib.request.urlopen(
+                    url + "/healthz", timeout=1.0).status)
+            except urllib.error.HTTPError as e:
+                codes.append(e.code)
+            except Exception:  # noqa: BLE001 - server tearing down
+                pass
+            time.sleep(0.1)
+            if 503 in codes and len(codes) > 3:
+                return
+
+    th = threading.Thread(target=poll, daemon=True)
+    th.start()
+    with faults.inject(faults.FaultSpec("dispatch_stall", after=1,
+                                        action="sleep", seconds=2.0)):
+        srv.run()
+        assert faults.fired_counts() == {("dispatch_stall", None): 1}
+    th.join(timeout=8.0)
+    trip = srv._watchdog.trip
+    assert trip is not None and trip["cause"] == "dispatch_stall", trip
+    assert 200 in codes and 503 in codes, codes
+    h = srv.healthz()
+    assert h["ok"] is False
+    assert h["watchdog"]["state"] == "tripped"
+    assert "dispatch_stall" in h["error"]
+    srv.close()
+    schemas = obs_schema.load_schemas()
+    pm = json.load(open(os.path.join(obs_dir, "postmortem.json")))
+    obs_schema.assert_valid(pm, schemas["postmortem"], "stall bundle",
+                            defs=schemas)
+    assert pm["reason"] == "watchdog:dispatch_stall"
+    assert pm["watchdog"]["state"] == "tripped"
+    assert any(e["kind"] == "watchdog_trip" for e in pm["events"])
+    # the stall changed nothing but wall time
+    _bitwise(hA.result(), refs["A"])
+    _bitwise(hB.result(), refs["B"])
+
+
+@pytest.mark.slow
+def test_process_kill_leaves_parseable_flight_bundle(demo, tmp_path):
+    """os._exit skips atexit and every finally — the periodic
+    flight.json sync is what survives it. A real killed process leaves
+    a parseable, schema-valid spanless bundle with ring quanta in it
+    (the crash-evidence twin of the PR 9 state-recovery kill pins)."""
+    from gibbs_student_t_tpu.obs import schema as obs_schema
+
+    if not _native_available():
+        pytest.skip("spooling needs the native library")
+    import json
+
+    ma, cfg = demo
+    man = str(tmp_path / "man")
+    spool = str(tmp_path / "sF")
+    script = tmp_path / "victim_flight.py"
+    script.write_text(f"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+from tests.conftest import make_demo_pta
+from gibbs_student_t_tpu.config import GibbsConfig
+from gibbs_student_t_tpu.serve import ChainServer, TenantRequest, faults
+
+ma = make_demo_pta().frozen(0)
+cfg = GibbsConfig(model="mixture")
+faults.install(faults.FaultSpec("kill_after_checkpoint", tenant="K",
+                                after=1, action="kill"))
+srv = ChainServer(ma, cfg, nlanes=32, quantum=5, record="full",
+                  manifest_dir={man!r}, flight_sync_every=1)
+srv.submit(TenantRequest(ma=ma, niter=20, nchains=16, seed=7,
+                         name="K", spool_dir={spool!r}))
+srv.run()
+os._exit(3)   # unreachable: the injected kill fires first
+""")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PYTHONPATH", None)
+    out = subprocess.run([sys.executable, str(script)],
+                         capture_output=True, text=True, env=env,
+                         timeout=900)
+    assert out.returncode == 9, (out.returncode, out.stderr[-2000:])
+    fj = json.load(open(os.path.join(man, "flight.json")))
+    schemas = obs_schema.load_schemas()
+    obs_schema.assert_valid(fj, schemas["postmortem"],
+                            "killed-process flight bundle",
+                            defs=schemas)
+    assert fj["reason"] == "sync" and "spans" not in fj
+    assert fj["quanta"], "ring empty at kill time"
+    assert any(e["kind"] == "admit" for e in fj["events"])
+
+
+# ---------------------------------------------------------------------------
 # crash recovery (in-process tier-1 arm; true process kills are slow)
 # ---------------------------------------------------------------------------
 

@@ -109,23 +109,27 @@ def plane_run(demo, tmp_path_factory):
         if live or not server.quanta:
             return
         for route in ("/healthz", "/status", "/metrics", "/trace",
+                      "/postmortem",
                       "/tenants/0/progress", "/tenants/t1/progress",
                       "/tenants/nope/progress", "/nope"):
             live[route] = _http_get(url + route)
 
     srv.run(on_quantum=fetch_live)
     idle = {route: _http_get(url + route)
-            for route in ("/healthz", "/status", "/metrics", "/trace")}
+            for route in ("/healthz", "/status", "/metrics", "/trace",
+                          "/postmortem")}
     trace_path = srv.export_trace(os.path.join(obs_dir, "trace.json"))
     status = srv.status()
     summary = srv.summary()
+    pm_path = srv.dump_postmortem(reason="fixture")
     srv.close()
     reg.close()
     results = [h.result() for h in hs]
     return {"server": srv, "handles": hs, "results": results,
             "obs_dir": obs_dir, "run_dir": run_dir, "man_dir": man_dir,
             "trace_path": trace_path, "status": status,
-            "summary": summary, "url": url, "live": live, "idle": idle}
+            "summary": summary, "url": url, "live": live, "idle": idle,
+            "pm_path": pm_path}
 
 
 # ----------------------------------------------------------------------
@@ -386,8 +390,11 @@ def test_plane_on_off_chains_bitwise(demo, plane_run):
     wire-on-vs-off pin — produces bitwise-identical per-tenant
     results (every field, incl. per-TOA)."""
     ma, cfg = demo
+    # the ENTIRE plane off: no spans, no flight recorder, no watchdog,
+    # kernel timers down — vs the fixture's everything-on run
     srv = ChainServer(ma, cfg, nlanes=32, quantum=5, record="full",
-                      spans=False)
+                      spans=False, flight=False, watchdog=False,
+                      kernel_timers=False)
     hs = [srv.submit(TenantRequest(ma=ma, niter=n, nchains=16, seed=i,
                                    name=f"t{i}"))
           for i, n in enumerate(NITERS)]
@@ -569,6 +576,100 @@ def test_fleet_status_merges_pools_and_reports_unreachable(plane_run,
         float(np.percentile(np.asarray(series + series, float), 50)),
         abs=1e-3)   # the aggregator rounds percentiles to 3 decimals
     assert snap["slo"]["n_converged"] == 2 * st["slo"]["n_converged"]
+
+
+# ----------------------------------------------------------------------
+# the deep profiling plane (round 15): stage timings, flight recorder
+# ----------------------------------------------------------------------
+
+
+def _timers_on(plane_run):
+    return plane_run["summary"].get("stages") is not None
+
+
+def test_stage_timings_and_watchdog_blocks(plane_run, schemas):
+    """status()/summary() carry the round-15 blocks: the per-stage
+    device-time view (schema ``stage_timings``; shares of dispatch sum
+    below 1 — device time can never exceed the wall that contains it)
+    and the watchdog block (untripped on a clean run); per-tenant cost
+    stage shares sum back to the server's stage totals (the same
+    reconciliation discipline as device_ms)."""
+    st = plane_run["status"]
+    obs_schema.assert_valid(st["watchdog"], schemas["watchdog"],
+                            "status watchdog", defs=schemas)
+    assert st["watchdog"]["enabled"] and st["watchdog"]["state"] == "ok"
+    hb = st["watchdog"]["heartbeat_age_s"]
+    assert "dispatch" in hb and "drain" in hb
+    if not _timers_on(plane_run):
+        pytest.skip("native kernel timers unavailable on this host")
+    stages = plane_run["summary"]["stages"]
+    obs_schema.assert_valid(stages, schemas["stage_timings"],
+                            "summary stages", defs=schemas)
+    assert stages, "timers on but no stage accumulated"
+    share = sum(v["share_of_dispatch"] or 0.0 for v in stages.values())
+    assert 0.0 < share <= 1.0, share
+    # per-tenant attribution reconciles with the totals stage by stage
+    per_tenant = {}
+    for h in plane_run["handles"]:
+        for k, v in (h.cost().get("stage_device_ms") or {}).items():
+            per_tenant[k] = per_tenant.get(k, 0.0) + v
+    assert set(per_tenant) == set(stages)
+    for k, v in stages.items():
+        assert abs(per_tenant[k] - v["device_ms"]) \
+            <= 0.02 * v["device_ms"] + 0.01, (k, per_tenant[k], v)
+
+
+def test_postmortem_bundle_endpoint_and_flight_sync(plane_run,
+                                                    schemas):
+    """GET /postmortem serves a schema-valid bundle live AND idle;
+    dump_postmortem() leaves the same (schema-valid) document on disk
+    with the span tail; the periodic spanless flight.json sync exists
+    after a multi-quantum run and validates too (the os._exit
+    durability arm's artifact)."""
+    for phase in (plane_run["live"], plane_run["idle"]):
+        code, body = phase["/postmortem"]
+        assert code == 200
+        doc = json.loads(body)
+        obs_schema.assert_valid(doc, schemas["postmortem"],
+                                "GET /postmortem", defs=schemas)
+    pm = json.load(open(plane_run["pm_path"]))
+    obs_schema.assert_valid(pm, schemas["postmortem"], "postmortem",
+                            defs=schemas)
+    assert pm["reason"] == "fixture"
+    assert pm["quanta"], "no quantum entries in the ring"
+    assert "spans" in pm and pm["spans"]
+    # ring entries tell the quantum story: dispatch wall + occupancy
+    # + (with timers) the stage split
+    q0 = pm["quanta"][0]
+    assert q0["dispatch_ms"] > 0 and q0["busy_lanes"] > 0
+    if _timers_on(plane_run):
+        assert q0["stage_device_ms"]
+    kinds = {e["kind"] for e in pm["events"]}
+    assert "admit" in kinds and "evict" in kinds
+    fj_path = os.path.join(plane_run["obs_dir"], "flight.json")
+    assert os.path.exists(fj_path), "periodic flight sync never fired"
+    fj = json.load(open(fj_path))
+    obs_schema.assert_valid(fj, schemas["postmortem"], "flight.json",
+                            defs=schemas)
+    assert fj["reason"] == "sync" and "spans" not in fj
+    # the renderer tool reads both, no jax import
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "postmortem_tool",
+        os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "postmortem.py"))
+    tool = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tool)
+    import io
+
+    out = io.StringIO()
+    doc, path = tool.load_bundle(plane_run["obs_dir"])
+    tool.render(doc, path, out=out)
+    text = out.getvalue()
+    assert "postmortem" in text and "timeline:" in text
+    assert tool.main([plane_run["obs_dir"]]) == 0
+    assert tool.main([plane_run["obs_dir"] + "_nope"]) == 1
 
 
 def test_metrics_auto_created_for_obs_dir(demo, tmp_path):

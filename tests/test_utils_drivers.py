@@ -99,9 +99,12 @@ def test_block_timings_composes_with_adapt(ma):
     gb = JaxGibbs(ma, cfg, nchains=2, chunk_size=4)
     out, stages = bench.block_timings(gb, iters=1)
     assert "white_mh_block" in out
-    # the machine-readable stages block the ledger records (ISSUE 3)
-    assert set(stages) == {"white_mh_block", "tnt_reduction",
-                           "hyper_and_draws"}
+    # the machine-readable stages block the ledger records (ISSUE 3):
+    # the three wall rows, plus (round 15) optional dev_* rows from
+    # the in-kernel stage timers wherever native kernels engaged
+    walls = {k for k in stages if not k.startswith("dev_")}
+    assert walls == {"white_mh_block", "tnt_reduction",
+                     "hyper_and_draws"}
     assert all(v["mean_s"] > 0 for v in stages.values())
 
 

@@ -212,6 +212,20 @@ def print_report(ledger_recs, include_rounds=True):
                       f" dispatch_wall_ms={c.get('dispatch_wall_ms')} "
                       f"share={c.get('share_of_dispatch')} "
                       f"tenants={len(c.get('tenants') or {})}")
+            # device-stage sub-line (round-15 records): the in-kernel
+            # per-stage device ms per quantum + share of dispatch
+            sd = m.get("stage_device_ms")
+            if isinstance(sd, dict) and sd:
+                rows = sorted(
+                    sd.items(),
+                    key=lambda kv: -(kv[1].get("mean_s") or 0.0)
+                    if isinstance(kv[1], dict) else 0.0)
+                line = " ".join(
+                    f"{name}={v['mean_s'] * 1e3:.1f}ms"
+                    for name, v in rows
+                    if isinstance(v, dict)
+                    and isinstance(v.get("mean_s"), (int, float)))
+                print(f"    stage_device_ms/quantum {line}")
             # chaos-arm sub-line (serve_bench --faults records)
             f = m.get("faults")
             if isinstance(f, dict):
@@ -342,6 +356,54 @@ def _stages_of(rec):
     return out
 
 
+def _serve_stages_of(rec):
+    """``{stage: mean_s per quantum}`` from a serve_bench record's
+    ``stage_device_ms`` block (the round-15 in-kernel stage timers);
+    {} when absent or malformed."""
+    m = rec.get("metrics") or {}
+    sd = m.get("stage_device_ms")
+    if not isinstance(sd, dict):
+        return {}
+    out = {}
+    for name, v in sd.items():
+        mean = v.get("mean_s") if isinstance(v, dict) else v
+        if isinstance(mean, (int, float)) and mean > 0:
+            out[str(name)] = float(mean)
+    return out
+
+
+def _compare_stages(st, bst, max_stage_growth, failures, label="stage",
+                    total_label="sweep"):
+    """The shared per-stage growth gate (solo bench wall stages AND
+    serve_bench device stages): compare every stage both records
+    timed, report asymmetric sets loudly (the r07 contract — a
+    renamed stage must stay visible the round it appears), and append
+    named failures past ``max_stage_growth`` percent."""
+    shared = sorted(set(st) & set(bst))
+    if not shared:
+        print(f"check: per-{label} timings unavailable on one side — "
+              "skipped")
+    for name in sorted(set(st) - set(bst)):
+        print(f"check: {label}[{name}] new this record "
+              f"({st[name] * 1e3:.1f}ms, no baseline to gate against)")
+    for name in sorted(set(bst) - set(st)):
+        print(f"check: {label}[{name}] present in baseline but missing "
+              f"from latest — renamed or dropped?")
+    total_latest = sum(st.values())
+    for name in shared:
+        growth = (st[name] - bst[name]) / bst[name] * 100.0
+        share = (f", {st[name] / total_latest * 100.0:.1f}% of "
+                 f"{total_label}" if total_latest else "")
+        print(f"check: {label}[{name}] {bst[name] * 1e3:.1f}ms -> "
+              f"{st[name] * 1e3:.1f}ms ({growth:+.1f}%{share}, limit "
+              f"{max_stage_growth}%)")
+        if growth > max_stage_growth:
+            # the tripping stage is NAMED here and again in the FAIL
+            # summary line, so a red gate needs no log spelunking
+            failures.append(f"{label} {name} slowed {growth:.1f}% "
+                            f"(> {max_stage_growth}%)")
+
+
 def check_latest(ledger_recs, max_drop, max_compile_growth,
                  max_hbm_growth, baseline_mode, max_stage_growth=100.0,
                  max_dispatch_growth=50.0):
@@ -425,34 +487,9 @@ def check_latest(ledger_recs, max_drop, max_compile_growth,
     # per-stage regression gate: every stage both records timed is
     # compared, so a hyper-block (or any future stage) slowdown fails
     # here even when the headline metric absorbs it
-    st, bst = _stages_of(latest), _stages_of(base)
-    shared = sorted(set(st) & set(bst))
-    if not shared:
-        print("check: per-stage timings unavailable on one side — "
-              "skipped")
-    # asymmetric stage sets are REPORTED, never silently dropped: a
-    # renamed stage would otherwise vanish from the gate entirely (the
-    # r07 contract — new stage names must stay visible the round they
-    # appear)
-    for name in sorted(set(st) - set(bst)):
-        print(f"check: stage[{name}] new this record "
-              f"({st[name] * 1e3:.1f}ms, no baseline to gate against)")
-    for name in sorted(set(bst) - set(st)):
-        print(f"check: stage[{name}] present in baseline but missing "
-              f"from latest — renamed or dropped?")
-    total_latest = sum(st.values())
-    for name in shared:
-        growth = (st[name] - bst[name]) / bst[name] * 100.0
-        share = (f", {st[name] / total_latest * 100.0:.1f}% of sweep"
-                 if total_latest else "")
-        print(f"check: stage[{name}] {bst[name] * 1e3:.1f}ms -> "
-              f"{st[name] * 1e3:.1f}ms ({growth:+.1f}%{share}, limit "
-              f"{max_stage_growth}%)")
-        if growth > max_stage_growth:
-            # the tripping stage is NAMED here and again in the FAIL
-            # summary line, so a red gate needs no log spelunking
-            failures.append(f"stage {name} slowed {growth:.1f}% "
-                            f"(> {max_stage_growth}%)")
+    _compare_stages(_stages_of(latest), _stages_of(base),
+                    max_stage_growth, failures, label="stage",
+                    total_label="sweep")
 
     if failures:
         for f in failures:
@@ -557,14 +594,20 @@ def check_obs(ledger_recs, max_obs_overhead, max_admission_p99):
     return rc
 
 
-def check_serve(ledger_recs, min_occupancy, min_serve_ratio):
+def check_serve(ledger_recs, min_occupancy, min_serve_ratio,
+                max_stage_growth=100.0):
     """Serving gate: the latest ``serve_bench`` record (when one
     exists) must report lane occupancy at or above ``min_occupancy``
     and an aggregate/solo throughput ratio at or above
     ``min_serve_ratio`` (when the record carries a same-host solo arm
-    — ``--no-solo`` records skip that leg with a note). Returns the
-    exit code contribution (0 when no serving record exists — a
-    bench-only ledger is not a serving regression)."""
+    — ``--no-solo`` records skip that leg with a note). Round 15:
+    the ``--max-stage-growth`` gate that always applied to solo bench
+    wall stages now also grades the serving record's in-kernel
+    ``stage_device_ms`` block against the previous serve_bench record
+    that carries one (same platform), with the same asymmetric
+    stage-set reporting. Returns the exit code contribution (0 when
+    no serving record exists — a bench-only ledger is not a serving
+    regression)."""
     serve = [r for r in ledger_recs if r.get("tool") == "serve_bench"]
     if not serve:
         print("check: no serve_bench record — serving gate skipped")
@@ -588,6 +631,32 @@ def check_serve(ledger_recs, min_occupancy, min_serve_ratio):
         print(f"check: FAIL — serve occupancy {occ:.3f} < "
               f"{min_occupancy} (idle lanes are the serving "
               "regression: admissions are not backfilling the pool)")
+        return 2
+    # serving device-stage gate (round 15): baseline = the previous
+    # serve_bench record on the same platform that carries the block
+    failures = []
+    st = _serve_stages_of(serve[-1])
+    if st:
+        base = next(
+            (r for r in reversed(serve[:-1])
+             if r.get("platform") == serve[-1].get("platform")
+             and _serve_stages_of(r)), None)
+        if base is None:
+            print("check: no prior serve_bench record with "
+                  "stage_device_ms — serving stage gate arms on the "
+                  "next record")
+        else:
+            _compare_stages(st, _serve_stages_of(base),
+                            max_stage_growth, failures,
+                            label="serve_stage",
+                            total_label="quantum device time")
+    else:
+        print("check: latest serve_bench record has no "
+              "stage_device_ms block (timers off / pre-round-15) — "
+              "serving stage gate skipped")
+    if failures:
+        for fmsg in failures:
+            print(f"check: FAIL — {fmsg}")
         return 2
     if ratio is None:
         print("check: serve ratio gate skipped — record has no "
@@ -718,7 +787,8 @@ def main(argv=None):
                           max_stage_growth=args.max_stage_growth,
                           max_dispatch_growth=args.max_dispatch_growth)
         rc_serve = check_serve(recs, args.min_occupancy,
-                               args.min_serve_ratio)
+                               args.min_serve_ratio,
+                               max_stage_growth=args.max_stage_growth)
         rc_obs = check_obs(recs, args.max_obs_overhead,
                            args.max_admission_p99)
         rc_faults = check_faults(recs, args.max_fault_rate,

@@ -1,0 +1,196 @@
+#!/usr/bin/env python
+"""Render a flight-recorder postmortem bundle — the black-box reader.
+
+``ChainServer`` dumps a bundle (``postmortem.json``) on pool failure,
+tenant faults, watchdog trips and SIGTERM/atexit, syncs a spanless
+``flight.json`` every few quanta (so even ``os._exit`` leaves
+evidence), and serves the same document over ``GET /postmortem``.
+This tool turns a bundle into a diagnosis:
+
+    python tools/postmortem.py RUN_DIR            # postmortem.json or
+                                                  # flight.json under it
+    python tools/postmortem.py path/to/bundle.json
+    python tools/postmortem.py RUN_DIR --json     # normalized re-emit
+
+It prints the trip/fault headline, heartbeat ages at dump time, the
+per-stage device-time totals, a timeline of the last ring quanta, the
+LAST-GOOD-QUANTUM DIFF (the final quantum vs the median of the ring
+before it — what changed right before death), and the SUSPECT TENANT
+(the tenant named by the most recent fault-class event). Pure stdlib
+JSON parsing — no jax import, safe on a dead host (the serve_top
+discipline).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+
+BUNDLE_SCHEMA = 1
+
+#: event kinds that implicate a tenant (newest wins the suspect slot)
+FAULT_KINDS = ("tenant_fault", "pool_failure", "quarantine",
+               "watchdog_trip")
+
+
+def load_bundle(path):
+    """(bundle, resolved_path) — ``path`` may be a bundle file or a
+    directory holding postmortem.json / flight.json (postmortem
+    preferred: it carries the span tail). Raises ValueError on
+    anything that is not a bundle."""
+    if os.path.isdir(path):
+        for name in ("postmortem.json", "flight.json"):
+            cand = os.path.join(path, name)
+            if os.path.exists(cand):
+                path = cand
+                break
+        else:
+            raise ValueError(
+                f"no postmortem.json or flight.json under {path!r}")
+    with open(path) as fh:
+        doc = json.load(fh)
+    if doc.get("schema") != BUNDLE_SCHEMA:
+        raise ValueError(
+            f"{path}: not a postmortem bundle "
+            f"(schema {doc.get('schema')!r})")
+    return doc, path
+
+
+def suspect_tenant(doc):
+    """The most recently implicated tenant (id + the implicating
+    event), or (None, None)."""
+    for ev in reversed(doc.get("events") or []):
+        if ev.get("kind") in FAULT_KINDS and ev.get("tenant") is not None:
+            return ev.get("tenant"), ev
+    return None, None
+
+
+def last_good_diff(doc):
+    """Compare the final ring quantum against the median of the
+    preceding ring entries: {field: (median, last)} for the fields
+    that moved >20% (or at all, for counters). None with < 3
+    entries."""
+    quanta = doc.get("quanta") or []
+    if len(quanta) < 3:
+        return None
+    prior, last = quanta[:-1], quanta[-1]
+    out = {}
+    for field in ("dispatch_ms", "drain_ms", "busy_lanes",
+                  "queue_depth"):
+        vals = [q.get(field) for q in prior
+                if isinstance(q.get(field), (int, float))]
+        lv = last.get(field)
+        if not vals or not isinstance(lv, (int, float)):
+            continue
+        med = statistics.median(vals)
+        if med == 0:
+            if lv != 0:
+                out[field] = (med, lv)
+        elif abs(lv - med) / abs(med) > 0.2:
+            out[field] = (med, lv)
+    return out
+
+
+def render(doc, path, out=sys.stdout):
+    p = lambda *a: print(*a, file=out)  # noqa: E731
+    reason = doc.get("reason", "?")
+    p(f"postmortem  reason={reason}  t={doc.get('t')}  "
+      f"quantum_idx={doc.get('quantum_idx')}  ({path})")
+    p(f"pool: {doc.get('nlanes')} lanes x {doc.get('quantum_sweeps')} "
+      f"sweeps/quantum, running={doc.get('running_tenants')} "
+      f"queue={doc.get('queue_depth')} "
+      f"pipeline={'on' if doc.get('pipeline') else 'off'} "
+      f"kernel_timers={'on' if doc.get('kernel_timers') else 'off'}")
+    wd = doc.get("watchdog") or {}
+    if wd.get("state") == "tripped" and wd.get("trip"):
+        trip = wd["trip"]
+        p(f"watchdog: TRIPPED {trip.get('cause')} — "
+          f"{trip.get('detail')} [policy {wd.get('policy')}]")
+    elif wd.get("enabled"):
+        p(f"watchdog: {wd.get('state', '?')} "
+          f"[policy {wd.get('policy')}] "
+          f"deadline={wd.get('deadline_s')}s")
+    beats = doc.get("heartbeat_age_s") or {}
+    if beats:
+        p("heartbeats at dump: "
+          + " ".join(f"{k}={v:.2f}s"
+                     for k, v in sorted(beats.items())))
+    faults = doc.get("faults") or {}
+    if any(faults.values()):
+        p("faults: " + " ".join(f"{k}={v}"
+                                for k, v in faults.items() if v))
+    st = doc.get("stage_totals_ms") or {}
+    if st:
+        total = sum(st.values()) or 1.0
+        row = " ".join(
+            f"{k}={v:.1f}ms({v / total * 100:.0f}%)"
+            for k, v in sorted(st.items(), key=lambda kv: -kv[1]))
+        p(f"stage totals (device): {row}")
+    quanta = doc.get("quanta") or []
+    p(f"timeline: {len(quanta)} ring quanta "
+      f"({doc.get('quanta_dropped', 0)} older dropped)")
+    for q in quanta[-10:]:
+        stg = q.get("stage_device_ms") or {}
+        top = (max(stg.items(), key=lambda kv: kv[1])
+               if stg else None)
+        p(f"  q{q.get('q'):>5}  dispatch={_f(q.get('dispatch_ms'))}ms"
+          f"  drain={_f(q.get('drain_ms'))}ms"
+          f"  busy={q.get('busy_lanes')}"
+          f"  queue={q.get('queue_depth')}"
+          + (f"  top_stage={top[0]}({top[1]:.1f}ms)" if top else ""))
+    diff = last_good_diff(doc)
+    if diff:
+        p("last-good-quantum diff (median of ring vs final quantum):")
+        for field, (med, lv) in sorted(diff.items()):
+            p(f"  {field}: {_f(med)} -> {_f(lv)}")
+    elif diff is not None:
+        p("last-good-quantum diff: final quantum within 20% of the "
+          "ring median on every field")
+    tenant, ev = suspect_tenant(doc)
+    if tenant is not None:
+        p(f"suspect tenant: {tenant} "
+          f"({ev.get('kind')}: {ev.get('error', ev.get('detail', ''))})")
+    events = doc.get("events") or []
+    tail = events[-8:]
+    if tail:
+        p(f"events (last {len(tail)} of {len(events)}):")
+        for ev in tail:
+            rest = {k: v for k, v in ev.items()
+                    if k not in ("kind", "t")}
+            p(f"  t+{ev.get('t'):.3f}s {ev.get('kind')} "
+              + " ".join(f"{k}={v}" for k, v in rest.items()))
+    spans = doc.get("spans")
+    if spans is not None:
+        p(f"span tail: {len(spans)} spans in bundle "
+          "(feed the server's /trace or export_trace for Perfetto)")
+
+
+def _f(v):
+    return f"{v:.1f}" if isinstance(v, (int, float)) else "-"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("path", help="bundle file, or a directory holding "
+                                 "postmortem.json / flight.json")
+    ap.add_argument("--json", action="store_true",
+                    help="re-emit the parsed bundle as JSON")
+    args = ap.parse_args(argv)
+    try:
+        doc, path = load_bundle(args.path)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"postmortem: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        json.dump(doc, sys.stdout)
+        print()
+        return 0
+    render(doc, path)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -229,10 +229,17 @@ def main(argv=None):
 
         obs_dir = (tempfile.mkdtemp(prefix="gst_serve_obs_")
                    if obs else None)
+        # the deep profiling plane (round 15) rides the obs arm so the
+        # off/on/off sandwich prices ALL of it: in-kernel stage
+        # timers, the flight recorder (incl. its periodic flight.json
+        # sync) and the stall watchdog are off in the off arms
         srv = ChainServer(template, cfg, nlanes=args.nlanes,
                           quantum=args.quantum,
                           pipeline=False if args.no_pipeline else "auto",
-                          spans=obs, obs_dir=obs_dir)
+                          spans=obs, obs_dir=obs_dir,
+                          kernel_timers="auto" if obs else False,
+                          flight=obs,
+                          watchdog="auto" if obs else False)
         mon = (MonitorSpec(params=list(range(min(
             4, len(template.param_names)))),
             ess_target=args.ess_target) if obs else None)
@@ -336,6 +343,32 @@ def main(argv=None):
     print(f"# cost: sum(tenant device_ms) {device_ms_sum} = "
           f"{cost_block['share_of_dispatch']} of the "
           f"{dispatch_wall_ms} ms dispatch wall", file=sys.stderr)
+
+    # per-stage DEVICE time (round 15: the in-kernel stage timers):
+    # the serving twin of bench's stages block — mean seconds per
+    # quantum per stage, what perf_report's serving stage gate grades
+    stage_block = None
+    stages = summary.get("stages")
+    if isinstance(stages, dict) and stages:
+        stage_block = {
+            name: {"mean_s": round(v["ms_per_quantum"] / 1e3, 6),
+                   "total_ms": v["device_ms"],
+                   "share_of_dispatch": v["share_of_dispatch"]}
+            for name, v in stages.items()}
+        row = " ".join(
+            f"{name}={v['ms_per_quantum']:.1f}ms"
+            for name, v in sorted(
+                stages.items(),
+                key=lambda kv: -kv[1]["device_ms"]))
+        print(f"# stage_device_ms/quantum: {row}", file=sys.stderr)
+    else:
+        print("# stage_device_ms: unavailable (kernel timers off or "
+              "native library without the timer surface)",
+              file=sys.stderr)
+    wd = summary.get("watchdog") or {}
+    print(f"# watchdog: {wd.get('state', 'off')}"
+          + (f" [policy {wd.get('policy')}]" if wd.get("enabled")
+             else ""), file=sys.stderr)
 
     # ---- observability A/B arm: price the plane -----------------------
     # The FIRST workload of a process runs measurably slower than every
@@ -471,6 +504,15 @@ def main(argv=None):
         # lane_quanta / ess_per_core_s per tenant plus the
         # reconciliation against the measured dispatch wall
         "cost": cost_block,
+        # in-kernel per-stage device time (round 15): mean seconds
+        # per quantum per stage (None timers-off), gated by
+        # perf_report --check --max-stage-growth on serving records
+        "stage_device_ms": stage_block,
+        # watchdog verdict for the headline arm (a trip during the
+        # benchmark is a result, not a footnote)
+        "watchdog": {"state": wd.get("state", "off"),
+                     "trips": (1 if wd.get("state") == "tripped"
+                               else 0)},
         "obs_overhead": (None if obs_overhead is None
                          else round(obs_overhead, 4)),
         "obs_off_sweeps_per_s": (None if obs_off_sps is None

@@ -116,6 +116,34 @@ def _render_status(st, out):
     if any(f.values()):
         print("faults: " + " ".join(f"{k}={v}" for k, v in f.items()
                                     if v), file=out)
+    # watchdog line (round 15): detector state + heartbeat ages; a
+    # trip is the headline, not a footnote
+    wd = st.get("watchdog")
+    if isinstance(wd, dict) and wd.get("enabled"):
+        if wd.get("state") == "tripped" and wd.get("trip"):
+            trip = wd["trip"]
+            print(f"watchdog: TRIPPED {trip.get('cause')} — "
+                  f"{trip.get('detail')} [policy {wd.get('policy')}]",
+                  file=out)
+        else:
+            beats = wd.get("heartbeat_age_s") or {}
+            ages = " ".join(f"{k}={v:.1f}s"
+                            for k, v in sorted(beats.items()))
+            print(f"watchdog: ok [policy {wd.get('policy')}]"
+                  + (f" beats {ages}" if ages else ""), file=out)
+    # per-stage device-time pane (the in-kernel stage timers):
+    # ms-per-quantum + share of the dispatch wall, dominant first
+    stages = st.get("stages")
+    if isinstance(stages, dict) and stages:
+        rows = sorted(stages.items(),
+                      key=lambda kv: -(kv[1].get("device_ms") or 0))
+        line = " ".join(
+            f"{name} {v.get('ms_per_quantum', 0):.1f}ms/q"
+            + (f"({v['share_of_dispatch'] * 100:.0f}%)"
+               if isinstance(v.get("share_of_dispatch"),
+                             (int, float)) else "")
+            for name, v in rows)
+        print(f"stages: {line}", file=out)
     slo = st.get("slo") or {}
     for leg in ("admission_ms", "first_result_ms", "converged_ms"):
         p = slo.get(leg)
