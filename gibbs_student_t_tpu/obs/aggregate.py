@@ -114,15 +114,31 @@ def fleet_status(sources: Sequence[str], timeout: float = 2.0) -> dict:
     """Poll every source and merge into one fleet snapshot (the
     ``fleet_status`` schema). Unreachable pools are reported in
     ``pools`` with ``reachable: false`` — never fatal."""
+    results = []
+    for src in sources:
+        try:
+            results.append((src, read_status(src, timeout=timeout)))
+        except Exception as e:  # noqa: BLE001 - a dead pool is data
+            results.append((src, e))
+    return fleet_merge(results)
+
+
+def fleet_merge(results) -> dict:
+    """Merge already-fetched per-pool statuses into the fleet
+    snapshot. ``results`` rows are ``(source_label, status_dict)`` for
+    reachable pools or ``(source_label, Exception)`` for dead ones —
+    the router (serve/router.py) fetches its own statuses (local pools
+    have no wire to poll) and reuses exactly this merge, so the
+    router's fleet view and ``tools/fleet_status.py`` can never
+    disagree on semantics."""
     pools = []
     raw = {leg: [] for leg in SLO_LEGS}
     totals = {"nlanes": 0, "busy_lanes": 0, "queue_depth": 0,
               "staged": 0, "running_tenants": 0}
     n_converged = 0
-    for src in sources:
-        try:
-            st = read_status(src, timeout=timeout)
-        except Exception as e:  # noqa: BLE001 - a dead pool is data
+    for src, st in results:
+        if not isinstance(st, dict):
+            e = st
             pools.append({"source": str(src), "reachable": False,
                           "error": f"{type(e).__name__}: {e}"})
             continue
@@ -165,6 +181,17 @@ def render_fleet(snap: dict, out) -> None:
           f"({(tot.get('occupancy_now') or 0) * 100:.0f}% now) "
           f"queue={tot.get('queue_depth')} staged={tot.get('staged')} "
           f"tenants={tot.get('running_tenants')}", file=out)
+    # router block (serve/router.py fleet snapshots): placement +
+    # failover counters — which pool got which share, and how many
+    # dead-pool recoveries the fleet has absorbed
+    router = snap.get("router")
+    if isinstance(router, dict):
+        pl = router.get("placements") or {}
+        placed = " ".join(f"{k}={v}" for k, v in sorted(pl.items()))
+        print(f"router placements: {placed or '-'}  "
+              f"failovers={router.get('failovers', 0)} "
+              f"resubmitted={router.get('resubmitted', 0)} "
+              f"dead_pools={router.get('dead_pools', 0)}", file=out)
     slo = snap.get("slo") or {}
     for leg in SLO_LEGS:
         p = slo.get(leg)

@@ -27,6 +27,8 @@ operand-vs-baked-constant table.
 
 from gibbs_student_t_tpu.serve.monitor import MonitorSpec, TenantMonitor
 from gibbs_student_t_tpu.serve.pool import GROUP_LANES, SlotPool
+from gibbs_student_t_tpu.serve.router import FleetRouter, spawn_fleet
+from gibbs_student_t_tpu.serve.rpc import RemoteChainServer, RpcServer
 from gibbs_student_t_tpu.serve.scheduler import (
     TenantError,
     TenantHandle,
@@ -43,4 +45,8 @@ __all__ = [
     "ChainServer",
     "MonitorSpec",
     "TenantMonitor",
+    "RpcServer",
+    "RemoteChainServer",
+    "FleetRouter",
+    "spawn_fleet",
 ]

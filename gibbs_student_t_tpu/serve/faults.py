@@ -37,6 +37,16 @@ point               site (fires just before the real work)
 ``kill_before_checkpoint``  ``ChainSpool.append`` before the state
                     checkpoint write (``action="kill"`` → ``os._exit``)
 ``kill_after_checkpoint``   same, after the checkpoint write
+``rpc_sever``       the RPC edge (serve/rpc.py): per-request in the
+                    connection loop and per-chunk in the streaming
+                    push — a firing closes the TCP connection
+                    abruptly (no error frame), the severed-wire chaos
+                    arm at fleet scope
+``pool_kill``       the subprocess pool worker's quantum boundary
+                    (serve/pool_main.py ``on_quantum`` hook;
+                    ``action="kill"`` → ``os._exit(9)``) — the
+                    dead-pool chaos arm the fleet router's failover
+                    contract is pinned against
 ==================  =====================================================
 
 Actions: ``raise`` (the named exception type — the default),
@@ -86,6 +96,8 @@ POINTS = (
     "dispatch_stall",
     "kill_before_checkpoint",
     "kill_after_checkpoint",
+    "rpc_sever",
+    "pool_kill",
 )
 
 _ACTIONS = ("raise", "die", "kill", "sleep")

@@ -144,6 +144,12 @@ class ServerManifest:
         self.record("done", tenant=tenant_id, status=status,
                     sweeps=sweeps)
 
+    def compact(self, keep_lost: bool = True) -> int:
+        """Rewrite this manifest as its compacted snapshot (see
+        :func:`compact_manifest`); the writer keeps appending to the
+        same path afterwards. Returns the number of records kept."""
+        return compact_manifest(self.dir, keep_lost=keep_lost)
+
 
 def load_server_state(manifest_dir: str) -> Tuple[object, object,
                                                   Dict[str, Any]]:
@@ -156,7 +162,8 @@ def load_server_state(manifest_dir: str) -> Tuple[object, object,
         raise ValueError(
             f"manifest at {manifest_dir!r} has no server record")
     kw = {k: v for k, v in server_recs[-1].items()
-          if k not in ("kind", "t", "epoch")}
+          if k not in ("kind", "t", "epoch", "compacted",
+                       "compacted_from")}
     return blob["template_ma"], blob["config"], kw
 
 
@@ -201,3 +208,84 @@ def load_tenant_model(manifest_dir: str, admit_record: Dict[str, Any]):
     with open(os.path.join(manifest_dir, admit_record["model_file"]),
               "rb") as fh:
         return pickle.load(fh)
+
+
+def compact_manifest(manifest_dir: str, keep_lost: bool = True) -> int:
+    """Rewrite ``manifest.jsonl`` as its minimal recovery-equivalent
+    snapshot: ONE ``server`` record (the latest epoch's geometry,
+    stamped ``compacted=true`` + the dropped-record count) followed by
+    every OUTSTANDING tenant's admit and its latest checkpoint.
+    Unreferenced ``model_*.pkl`` blobs are deleted.
+
+    The journal grows without bound in steady state — every admission
+    of a spooled tenant pickles its model beside the log, and a
+    long-lived pool accumulates epochs of finished tenants a recovery
+    must parse past — so a failed-over pool's cold start pays for dead
+    history. Compaction preserves exactly the recovery-relevant
+    state: ``outstanding_tenants`` + ``load_server_state`` over the
+    compacted file answer identically to the full journal, so
+    ``ChainServer.recover`` from either is **bitwise the same run**
+    (pinned in tests/test_fleet.py). Containment history (fault /
+    quarantine / reinit records) is postmortem evidence, not recovery
+    state, and is dropped — the flight recorder owns that story.
+
+    ``keep_lost=False`` additionally drops LOST admits (in-memory
+    tenants whose records died with a crashed process): only the
+    ``recover()``-time compaction passes it — recovery has already
+    surfaced those jobs on ``lost_tenants`` (and at fleet scope the
+    router replays them elsewhere), so keeping their admits would
+    just re-report the same loss at every future recovery, forever.
+
+    Atomic: written to a temp file and ``os.replace``d, so a crash
+    mid-compaction leaves the full journal in place. Returns the
+    number of records in the compacted file."""
+    records = read_manifest(manifest_dir)
+    server_recs = [r for r in records if r.get("kind") == "server"]
+    if not server_recs:
+        return 0   # nothing to compact (empty/foreign dir)
+    recoverable, lost = outstanding_tenants(manifest_dir)
+    outstanding = recoverable + (lost if keep_lost else [])
+    # latest checkpoint per outstanding (epoch, tenant) pair — the
+    # resume point recovery reads. Epochs are tracked the same way
+    # outstanding_tenants walks them.
+    latest_ckpt: Dict[Any, Dict[str, Any]] = {}
+    epoch = -1
+    for r in records:
+        kind = r.get("kind")
+        if kind == "server":
+            epoch += 1
+        elif kind == "checkpoint":
+            latest_ckpt[(epoch, r.get("tenant"))] = r
+    head = dict(server_recs[-1])
+    head["compacted"] = True
+    head["compacted_from"] = len(records)
+    head["epoch"] = 0
+    out: List[Dict[str, Any]] = [head]
+    keep_models = set()
+    for rec in outstanding:
+        admit = {k: v for k, v in rec.items() if k != "epoch"}
+        out.append(admit)
+        if rec.get("model_file"):
+            keep_models.add(rec["model_file"])
+        ck = latest_ckpt.get((rec["epoch"], rec.get("tenant")))
+        if ck is not None:
+            out.append(ck)
+    path = os.path.join(manifest_dir, MANIFEST_NAME)
+    tmp = path + ".tmp"
+    from gibbs_student_t_tpu.obs.metrics import _jsonable
+
+    with open(tmp, "w") as fh:
+        for r in out:
+            fh.write(json.dumps(_jsonable(r), separators=(",", ":"))
+                     + "\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    for name in os.listdir(manifest_dir):
+        if (name.startswith("model_") and name.endswith(".pkl")
+                and name not in keep_models):
+            try:
+                os.unlink(os.path.join(manifest_dir, name))
+            except OSError:
+                pass
+    return len(out)

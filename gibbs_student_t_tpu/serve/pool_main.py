@@ -1,0 +1,142 @@
+"""Subprocess pool worker: one ChainServer behind the RPC + HTTP wire.
+
+The fleet router (serve/router.py) shards tenants across N pools; the
+"per-host subprocesses first" substrate is this module — ``python -m
+gibbs_student_t_tpu.serve.pool_main --dir POOLDIR`` builds a
+:class:`~gibbs_student_t_tpu.serve.server.ChainServer` from the pool
+directory's pickled spec, mounts the mutating RPC edge
+(serve/rpc.py) and the read-only HTTP endpoints (obs/http.py, via
+``http_port=0``), journals to ``POOLDIR/manifest`` (the crash-recovery
+manifest the router's failover contract rides), and drives quanta on
+the main thread until a ``shutdown`` RPC or a signal.
+
+Startup handshake: once everything is mounted the worker atomically
+writes ``POOLDIR/ready.json`` — ``{pid, rpc_port, http_port, obs_dir,
+recovered, lost}`` — which the spawner polls for. ``--recover`` boots
+through :meth:`ChainServer.recover` instead of the spec: outstanding
+spooled tenants resume from their last checkpoint (bitwise the
+uninterrupted run — the PR 12 contract, now at fleet scope) and
+``ready.json.recovered`` maps each logical job key (request name, else
+spool_dir) to its new tenant id so the router can re-point routed
+handles at the resurrected pool.
+
+Chaos: ``--faults`` arms a JSON list of serve/faults.py FaultSpec
+dicts in THIS process (fault state is process-local); the worker fires
+the ``pool_kill`` point at every quantum boundary, so
+``{"point": "pool_kill", "after": N, "action": "kill"}`` dies at a
+deterministic quantum — the dead-pool arm of the fleet chaos tier.
+
+The pool spec (``POOLDIR/spec.pkl``, written by the router's
+spawn path) is ``{"template_ma", "config", "kwargs"}`` — the
+ChainServer constructor arguments minus the wiring this module owns
+(manifest/http/obs directories).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pickle
+import sys
+
+
+READY_NAME = "ready.json"
+SPEC_NAME = "spec.pkl"
+
+
+def _write_ready(pool_dir: str, doc: dict) -> None:
+    tmp = os.path.join(pool_dir, READY_NAME + ".tmp")
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh)
+    os.replace(tmp, os.path.join(pool_dir, READY_NAME))
+
+
+def write_spec(pool_dir: str, template_ma, config, kwargs: dict) -> None:
+    """The spawner's half of the handshake (router-side import is
+    cheap: no jax needed to pickle a spec)."""
+    os.makedirs(pool_dir, exist_ok=True)
+    tmp = os.path.join(pool_dir, SPEC_NAME + ".tmp")
+    with open(tmp, "wb") as fh:
+        pickle.dump({"template_ma": template_ma, "config": config,
+                     "kwargs": dict(kwargs)}, fh)
+    os.replace(tmp, os.path.join(pool_dir, SPEC_NAME))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--dir", required=True,
+                    help="pool directory (spec.pkl in; ready.json, "
+                         "manifest/, obs/ out)")
+    ap.add_argument("--recover", action="store_true",
+                    help="boot via ChainServer.recover() from the pool "
+                         "directory's manifest instead of the spec")
+    ap.add_argument("--faults", default=None,
+                    help="JSON list of FaultSpec dicts to arm in this "
+                         "process (the fleet chaos tier)")
+    args = ap.parse_args(argv)
+    pool_dir = os.path.abspath(args.dir)
+    os.makedirs(pool_dir, exist_ok=True)
+
+    from gibbs_student_t_tpu.serve import faults as _faults
+
+    if args.faults:
+        specs = json.loads(args.faults)
+        _faults.install(*[_faults.FaultSpec(**d) for d in specs])
+
+    from gibbs_student_t_tpu.serve.rpc import RpcServer
+    from gibbs_student_t_tpu.serve.server import ChainServer
+
+    manifest_dir = os.path.join(pool_dir, "manifest")
+    obs_dir = os.path.join(pool_dir, "obs")
+    recovered_map, lost = {}, []
+    if args.recover:
+        srv, handles = ChainServer.recover(
+            manifest_dir, http_port=0, obs_dir=obs_dir)
+        recovered_map = {str(k): h.tenant_id
+                         for k, h in handles.items()}
+        lost = [r.get("name") or r.get("spool_dir") or r.get("tenant")
+                for r in srv.lost_tenants]
+    else:
+        with open(os.path.join(pool_dir, SPEC_NAME), "rb") as fh:
+            spec = pickle.load(fh)
+        srv = ChainServer(spec["template_ma"], spec["config"],
+                          manifest_dir=manifest_dir, http_port=0,
+                          obs_dir=obs_dir, **spec["kwargs"])
+
+    def on_shutdown():
+        srv._stop.set()   # run(idle_exit=False) returns at the boundary
+
+    rpc = RpcServer(srv, on_shutdown=on_shutdown)
+    _write_ready(pool_dir, {
+        "pid": os.getpid(),
+        "rpc_port": rpc.port,
+        "http_port": (srv.http.port if srv.http is not None else None),
+        "obs_dir": obs_dir,
+        "manifest_dir": manifest_dir,
+        "recovered": recovered_map,
+        "lost": lost,
+    })
+
+    seen = {"q": 0}
+
+    def on_quantum(server):
+        # the dead-pool injection point: fires once per COMPLETED
+        # quantum (the driver hook also ticks on idle polls, which
+        # must not advance a fault spec's deterministic count);
+        # action="kill" dies here, exactly like a node loss mid-serving
+        q = server.quanta
+        while seen["q"] < q:
+            seen["q"] += 1
+            _faults.fire("pool_kill")
+
+    # drive quanta on the main thread until retired over the wire; the
+    # RPC submit path feeds the admission queue from its own threads
+    srv.run(idle_exit=False, on_quantum=on_quantum)
+    rpc.close()
+    srv.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

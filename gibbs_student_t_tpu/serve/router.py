@@ -1,0 +1,712 @@
+"""FleetRouter: shard tenants across N chain-server pools.
+
+ROADMAP item 1's multiplier: one :class:`ChainServer` pool tops out at
+one host's lanes, so the fleet router turns pool count into aggregate
+throughput — N pools ≈ min(N, cores)× on one machine (per-host
+subprocess pools, the first substrate), N hosts ≈ N× over the wire
+(the :class:`~gibbs_student_t_tpu.serve.rpc.RemoteChainServer` client
+is transport-identical either way).
+
+**Placement** is by live pool status — the same snapshot the round-14
+read-only wire already serves: at every ``submit`` the router polls
+each pool (HTTP ``/status`` for subprocess/remote pools, a direct
+``status()`` call for in-process ones) and places on the healthy pool
+with the lightest load — ``(queue_depth + staged, -free lanes,
+occupancy_now, admission p99)`` lexicographic, pool index breaking
+ties deterministically. ``placement="round_robin"`` forces a
+deterministic spread (the replay-determinism test arm: thanks to the
+PR 7 lane-position-independent draw contract, per-tenant results are
+bitwise identical under ANY placement — pinned in
+tests/test_fleet.py).
+
+**Failover** rides the PR 12 manifest + ``recover()`` contract, at
+fleet scope: a watch thread polls pool liveness; a dead pool (its
+process exited, or its wire unreachable past a grace count) is
+replaced by a recovery respawn (``pool_main --recover``) that resumes
+every spooled tenant from its last checkpoint — and the router
+re-points the victims' :class:`RoutedHandle`\\ s at the resurrected
+pool, so a caller blocked in ``result()`` just gets its (bitwise
+identical) answer late. Unspooled victims are **resubmitted from
+scratch to any healthy pool**: request-replay determinism makes the
+re-run bitwise the lost one, so failover-by-replay is exact, not
+best-effort. Co-resident pools' tenants are untouched (pinned).
+
+**The fleet wire**: ``http_port=`` mounts the same read-only endpoint
+server pools use (obs/http.py) — ``GET /status`` answers the
+aggregated :func:`~gibbs_student_t_tpu.obs.aggregate.fleet_merge`
+snapshot plus a ``router`` block (placements, failovers,
+resubmissions, dead pools), ``GET /healthz`` the fleet liveness
+verdict — so ``tools/fleet_status.py`` / ``serve_top --url`` point at
+a router exactly like at a pool.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+import threading
+import time
+import warnings
+from typing import Dict, List, Optional
+
+from gibbs_student_t_tpu.serve.rpc import RemoteChainServer
+
+#: default seconds between liveness sweeps of the failover watch
+WATCH_POLL_S = 0.5
+
+#: consecutive unreachable healthz polls before a live process's pool
+#: counts as dead (a process that EXITED is dead immediately)
+DEAD_AFTER_POLLS = 4
+
+
+class PoolSpec:
+    """What it takes to (re)spawn one subprocess pool: the directory
+    the worker owns and the pickled server spec inside it."""
+
+    def __init__(self, pool_dir: str, template_ma, config,
+                 kwargs: Optional[dict] = None):
+        self.pool_dir = os.path.abspath(pool_dir)
+        self.template_ma = template_ma
+        self.config = config
+        self.kwargs = dict(kwargs or {})
+
+
+class ProcPool:
+    """One subprocess pool (serve/pool_main.py) and its wire clients.
+
+    ``spawn`` writes the spec, launches the worker, and blocks until
+    its ``ready.json`` handshake (the pool compile happens in the
+    child; ``ready_timeout`` must cover it). ``recover_spawn`` boots a
+    replacement through the manifest instead — ``recovered`` maps each
+    logical job key (request name, else spool_dir) to its new tenant
+    id, the rebinding input for the router's failover."""
+
+    def __init__(self, spec: PoolSpec, proc, ready: dict):
+        self.spec = spec
+        self.proc = proc
+        self.ready = ready
+        self.rpc = RemoteChainServer(
+            ("127.0.0.1", int(ready["rpc_port"])))
+        self.status_url = (
+            f"http://127.0.0.1:{ready['http_port']}"
+            if ready.get("http_port") else None)
+        self.label = os.path.basename(self.spec.pool_dir)
+
+    # -- spawning -------------------------------------------------------
+
+    @classmethod
+    def spawn(cls, spec: PoolSpec, faults=None, env=None,
+              ready_timeout: float = 600.0) -> "ProcPool":
+        from gibbs_student_t_tpu.serve import pool_main
+
+        pool_main.write_spec(spec.pool_dir, spec.template_ma,
+                             spec.config, spec.kwargs)
+        return cls._launch(spec, ["--dir", spec.pool_dir], faults, env,
+                           ready_timeout)
+
+    @classmethod
+    def recover_spawn(cls, spec: PoolSpec, faults=None, env=None,
+                      ready_timeout: float = 600.0) -> "ProcPool":
+        return cls._launch(spec,
+                           ["--dir", spec.pool_dir, "--recover"],
+                           faults, env, ready_timeout)
+
+    @classmethod
+    def _launch(cls, spec: PoolSpec, args: List[str], faults, env,
+                ready_timeout: float) -> "ProcPool":
+        import json as _json
+
+        ready_path = os.path.join(spec.pool_dir, "ready.json")
+        if os.path.exists(ready_path):
+            os.unlink(ready_path)   # a stale handshake must not race
+        cmd = [sys.executable, "-m",
+               "gibbs_student_t_tpu.serve.pool_main"] + args
+        if faults:
+            cmd += ["--faults", _json.dumps(list(faults))]
+        child_env = dict(os.environ if env is None else env)
+        child_env.setdefault("JAX_PLATFORMS", "cpu")
+        # the worker must resolve the package no matter the caller's
+        # cwd (pytest tmp dirs, service managers)
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        child_env["PYTHONPATH"] = pkg_root + (
+            os.pathsep + child_env["PYTHONPATH"]
+            if child_env.get("PYTHONPATH") else "")
+        log = open(os.path.join(spec.pool_dir, "worker.log"), "ab")
+        try:
+            proc = subprocess.Popen(cmd, env=child_env, stdout=log,
+                                    stderr=subprocess.STDOUT)
+        finally:
+            log.close()
+        deadline = time.monotonic() + ready_timeout
+        while not os.path.exists(ready_path):
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"pool worker at {spec.pool_dir!r} died before "
+                    f"ready (rc {proc.returncode}); see worker.log")
+            if time.monotonic() > deadline:
+                proc.kill()
+                raise TimeoutError(
+                    f"pool worker at {spec.pool_dir!r} not ready "
+                    f"after {ready_timeout}s")
+            time.sleep(0.05)
+        with open(ready_path) as fh:
+            ready = _json.load(fh)
+        return cls(spec, proc, ready)
+
+    # -- the pool surface the router drives -----------------------------
+
+    @property
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def submit(self, request, timeout=None):
+        return self.rpc.submit(request, timeout=timeout)
+
+    def cancel(self, handle) -> bool:
+        return self.rpc.cancel(handle)
+
+    def status(self) -> dict:
+        """Prefer the HTTP read wire (it answers during RPC load);
+        fall back to the RPC status op."""
+        if self.status_url is not None:
+            from gibbs_student_t_tpu.obs.aggregate import read_status
+
+            return read_status(self.status_url, timeout=2.0)
+        return self.rpc.status()
+
+    def healthz(self) -> dict:
+        return self.rpc.healthz()
+
+    def reset_counters(self) -> None:
+        self.rpc.reset_counters()
+
+    def recover(self) -> "ProcPool":
+        """The failover respawn: a fresh worker booted through this
+        pool's manifest (``pool_main --recover``). The router calls
+        this on whatever pool object died — the method IS the
+        failover contract surface."""
+        return ProcPool.recover_spawn(self.spec)
+
+    def handle_for(self, tenant_id: int, request):
+        """A caller-facing handle for an ALREADY-resident tenant (the
+        failover rebinding path: the recovered worker advertised this
+        id in ready.json)."""
+        from gibbs_student_t_tpu.serve.rpc import RemoteTenantHandle
+
+        return RemoteTenantHandle(self.rpc, tenant_id, request)
+
+    def close(self, grace: float = 30.0) -> None:
+        """Retire the worker: polite shutdown RPC, then SIGKILL."""
+        if self.alive:
+            try:
+                self.rpc.shutdown()
+            except Exception:  # noqa: BLE001 - already dying is fine
+                pass
+            try:
+                self.proc.wait(timeout=grace)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=10.0)
+        self.rpc.close()
+
+    def kill(self) -> None:
+        """The impolite path (tests tearing down a chaos arm)."""
+        if self.alive:
+            self.proc.kill()
+            self.proc.wait(timeout=10.0)
+
+
+class LocalPool:
+    """An in-process pool: a ChainServer driven on a background
+    thread, presented through the same surface as :class:`ProcPool`
+    (the tier-1 fleet tests ride these — no subprocess spawn, no
+    wire, same router code paths)."""
+
+    def __init__(self, server, label: str = "local"):
+        self.server = server
+        self.label = label
+        self.proc = None
+        self.status_url = None
+        server.start()
+
+    @property
+    def alive(self) -> bool:
+        return self.server._thread is not None \
+            and self.server._thread.is_alive()
+
+    def submit(self, request, timeout=None):
+        return self.server.submit(request, timeout=timeout)
+
+    def cancel(self, handle) -> bool:
+        return self.server.cancel(handle)
+
+    def status(self) -> dict:
+        return self.server.status()
+
+    def healthz(self) -> dict:
+        return self.server.healthz()
+
+    def reset_counters(self) -> None:
+        self.server.reset_counters()
+
+    def close(self, grace: float = 30.0) -> None:
+        self.server.close()
+
+    def kill(self) -> None:
+        self.server.close()
+
+
+class RoutedHandle:
+    """The router's caller-facing handle: delegates to the placed
+    pool's handle and survives a failover rebinding — ``result()``
+    blocked on a dying pool's wire retries on the replacement handle
+    once the watch thread re-points it (``_rebind``), so fleet callers
+    never observe the recovery, only latency."""
+
+    def __init__(self, router: "FleetRouter", request, pool_idx: int,
+                 inner):
+        self.router = router
+        self.request = request
+        self.pool_idx = pool_idx
+        self._inner = inner
+        self._gen = 0               # bumps at every rebind
+        self._rebound = threading.Event()
+
+    @property
+    def tenant_id(self):
+        return self._inner.tenant_id
+
+    def _rebind(self, pool_idx: int, inner) -> None:
+        self.pool_idx = pool_idx
+        self._inner = inner
+        self._gen += 1
+        self._rebound.set()
+
+    def _retryable(self, fn, *a, **kw):
+        """Run one delegated call; on a severed wire wait (bounded) for
+        a failover rebind and retry once per generation."""
+        while True:
+            gen, inner = self._gen, self._inner
+            try:
+                return fn(inner, *a, **kw)
+            except (ConnectionError, OSError) as e:
+                if self._gen != gen:
+                    continue   # already rebound: retry immediately
+                self._rebound.clear()
+                if not self._rebound.wait(
+                        timeout=self.router.failover_timeout):
+                    raise ConnectionError(
+                        f"pool {self.pool_idx} unreachable and no "
+                        f"failover within "
+                        f"{self.router.failover_timeout}s") from e
+
+    def progress(self):
+        return self._retryable(lambda h: h.progress())
+
+    def cost(self):
+        return self._retryable(lambda h: h.cost())
+
+    def done(self) -> bool:
+        return self._retryable(lambda h: h.done())
+
+    @property
+    def status(self):
+        inner = self._inner
+        st = getattr(inner, "status", None)
+        return st if isinstance(st, str) else self.progress().get("status")
+
+    def cancel(self) -> bool:
+        return self.router.cancel(self)
+
+    def result(self, timeout: Optional[float] = None):
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        while True:
+            remaining = (None if deadline is None
+                         else max(deadline - time.monotonic(), 0.0))
+            try:
+                return self._retryable(
+                    lambda h, r=remaining: h.result(timeout=r))
+            except TimeoutError:
+                if deadline is not None \
+                        and time.monotonic() >= deadline:
+                    raise
+                # a server-side wait expiring under an open deadline
+                # (failover window): poll again
+
+
+class FleetRouter:
+    """Shard tenants across pools; fail over through the manifest.
+
+    ``pools`` is a list of :class:`ProcPool` / :class:`LocalPool` (or
+    anything with their surface). ``placement`` is ``"load"`` (the
+    status-driven default) or ``"round_robin"`` (deterministic spread).
+    ``failover=True`` starts the liveness watch (subprocess pools
+    only: an in-process pool shares our fate). ``http_port`` mounts
+    the fleet-level read-only wire."""
+
+    def __init__(self, pools: List, placement: str = "load",
+                 failover: bool = True,
+                 failover_timeout: float = 900.0,
+                 watch_poll_s: float = WATCH_POLL_S,
+                 status_stale_s: float = 30.0,
+                 http_port: Optional[int] = None,
+                 http_host: str = "127.0.0.1"):
+        if placement not in ("load", "round_robin"):
+            raise ValueError(
+                f"placement must be 'load' or 'round_robin', got "
+                f"{placement!r}")
+        if not pools:
+            raise ValueError("a fleet needs at least one pool")
+        self.pools: List = list(pools)
+        self.placement = placement
+        self.failover_timeout = failover_timeout
+        self._lock = threading.Lock()
+        self._routed: List[RoutedHandle] = []
+        self._rr_next = 0
+        self._dead: set = set()
+        self._unreachable: Dict[int, int] = {}
+        # last good status per pool + its timestamp: a pool busy
+        # inside a quantum holds its server lock, so its status
+        # endpoint can time out under load — placement then reuses
+        # the last snapshot (bounded by ``status_stale_s``) instead of
+        # EXCLUDING the pool, which would bias every submit toward
+        # whichever pool happens to be idle enough to answer (measured
+        # on the 1-core bench host: a 12/4/4/4 split over 4 pools)
+        self.status_stale_s = status_stale_s
+        self._status_cache: Dict[int, tuple] = {}
+        self.placements: Dict[str, int] = {}
+        self.failovers = 0
+        self.resubmitted = 0
+        self._stop = threading.Event()
+        self._watch: Optional[threading.Thread] = None
+        if failover:
+            self._watch = threading.Thread(
+                target=self._watch_loop, args=(watch_poll_s,),
+                name="gst-fleet-watch", daemon=True)
+            self._watch.start()
+        self.http = None
+        if http_port is not None:
+            try:
+                from gibbs_student_t_tpu.obs.http import ObsHttpServer
+
+                self.http = ObsHttpServer(
+                    host=http_host, port=http_port,
+                    status_fn=self.fleet_status,
+                    healthz_fn=self.healthz)
+            except Exception as e:  # noqa: BLE001 - obs contract
+                warnings.warn(
+                    f"fleet observability endpoint failed to start "
+                    f"({type(e).__name__}: {e}); routing continues "
+                    "without the wire", RuntimeWarning)
+
+    # ------------------------------------------------------------------
+    # placement
+    # ------------------------------------------------------------------
+
+    def _statuses(self) -> List:
+        """[(pool_idx, status-or-Exception)] for every live pool; a
+        failed poll degrades to the pool's last snapshot while it is
+        fresher than ``status_stale_s`` (see the cache comment in
+        ``__init__``)."""
+        out = []
+        now = time.monotonic()
+        for i, p in enumerate(self.pools):
+            if i in self._dead:
+                out.append((i, ConnectionError("pool marked dead")))
+                continue
+            try:
+                st = p.status()
+                self._status_cache[i] = (now, st)
+                out.append((i, st))
+            except Exception as e:  # noqa: BLE001 - a dead pool is data
+                cached = self._status_cache.get(i)
+                if cached is not None \
+                        and now - cached[0] <= self.status_stale_s:
+                    out.append((i, cached[1]))
+                else:
+                    out.append((i, e))
+        return out
+
+    @staticmethod
+    def _load_score(st: dict):
+        """Lower is better: queue pressure first, then free lanes,
+        then occupancy, then the admission-p99 SLO."""
+        free = (st.get("free_groups") or 0) * (st.get("group") or 1)
+        p99 = (((st.get("slo") or {}).get("admission_ms") or {})
+               .get("p99")) or 0.0
+        return ((st.get("queue_depth") or 0) + (st.get("staged") or 0),
+                -free, st.get("occupancy_now") or 0.0, p99)
+
+    def _place(self, request) -> int:
+        """Choose the pool for one request (caller holds ``_lock``)."""
+        live = [i for i in range(len(self.pools))
+                if i not in self._dead]
+        if not live:
+            raise RuntimeError("no live pools in the fleet")
+        if self.placement == "round_robin":
+            for _ in range(len(self.pools)):
+                i = self._rr_next % len(self.pools)
+                self._rr_next += 1
+                if i in live:
+                    return i
+            return live[0]
+        scored = []
+        for i, st in self._statuses():
+            if isinstance(st, dict):
+                faults = st.get("faults") or {}
+                if not faults.get("pool_failures"):
+                    scored.append((self._load_score(st), i))
+        if not scored:
+            # every pool unreachable/sick right now: fall back to a
+            # deterministic spread rather than refusing service
+            return live[0]
+        return min(scored)[1]
+
+    # ------------------------------------------------------------------
+    # the ChainServer-shaped fleet surface
+    # ------------------------------------------------------------------
+
+    def submit(self, request, timeout=None) -> RoutedHandle:
+        """Place one tenant and return its routed handle. Placement is
+        status-driven (one poll sweep per submit — submits are rare
+        next to quanta); the chosen pool's own admission queue applies
+        its backpressure policy."""
+        with self._lock:
+            idx = self._place(request)
+            inner = self.pools[idx].submit(request, timeout=timeout)
+            rh = RoutedHandle(self, request, idx, inner)
+            self._routed.append(rh)
+            label = self.pools[idx].label
+            self.placements[label] = self.placements.get(label, 0) + 1
+            # account the submit in the cached snapshot so a burst of
+            # placements between polls (or against a stale snapshot)
+            # still joins the shortest queue
+            cached = self._status_cache.get(idx)
+            if cached is not None:
+                cached[1]["queue_depth"] = \
+                    (cached[1].get("queue_depth") or 0) + 1
+        return rh
+
+    def cancel(self, handle: RoutedHandle) -> bool:
+        try:
+            return self.pools[handle.pool_idx].cancel(handle._inner)
+        except Exception:  # noqa: BLE001 - a dead pool can't cancel
+            return False
+
+    def healthz(self) -> dict:
+        """Fleet liveness: ok while at least one pool serves and no
+        dead pool is stuck unrecovered."""
+        per_pool = []
+        n_ok = 0
+        for i, p in enumerate(self.pools):
+            if i in self._dead:
+                per_pool.append({"pool": p.label, "ok": False,
+                                 "error": "dead, recovery pending"})
+                continue
+            try:
+                h = p.healthz()
+                ok = bool(h.get("ok"))
+            except Exception as e:  # noqa: BLE001
+                h, ok = {"error": f"{type(e).__name__}: {e}"}, False
+            n_ok += ok
+            per_pool.append({"pool": p.label, "ok": ok,
+                             "error": h.get("error")})
+        return {
+            "ok": n_ok > 0 and not self._dead,
+            "t": round(time.time(), 3),
+            "n_pools": len(self.pools),
+            "n_ok": n_ok,
+            "failovers": self.failovers,
+            "pools": per_pool,
+        }
+
+    def fleet_status(self) -> dict:
+        """The aggregated fleet snapshot (obs/aggregate.fleet_merge —
+        the same semantics as ``tools/fleet_status.py``) plus the
+        ``router`` block: placement counts per pool, failovers,
+        replay resubmissions, currently-dead pools."""
+        from gibbs_student_t_tpu.obs.aggregate import fleet_merge
+
+        results = []
+        for i, st in self._statuses():
+            results.append((self.pools[i].label, st))
+        snap = fleet_merge(results)
+        snap["router"] = {
+            "placement": self.placement,
+            "placements": dict(self.placements),
+            "failovers": self.failovers,
+            "resubmitted": self.resubmitted,
+            "dead_pools": len(self._dead),
+        }
+        return snap
+
+    def reset_counters(self) -> None:
+        """Zero every pool's run-level aggregates plus the router's
+        own placement counters (the fleet_bench warmup boundary)."""
+        for p in self.pools:
+            try:
+                p.reset_counters()
+            except Exception:  # noqa: BLE001 - a dead pool resets later
+                pass
+        with self._lock:
+            self.placements.clear()
+            self.resubmitted = 0
+
+    def close(self, grace: float = 30.0) -> None:
+        """Retire the fleet: stop the watch, close the wire, shut
+        every pool down politely."""
+        self._stop.set()
+        if self._watch is not None:
+            self._watch.join(timeout=5.0)
+            self._watch = None
+        if self.http is not None:
+            self.http.close()
+            self.http = None
+        for p in self.pools:
+            try:
+                p.close(grace=grace)
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+
+    # ------------------------------------------------------------------
+    # failover
+    # ------------------------------------------------------------------
+
+    def _watch_loop(self, poll_s: float) -> None:
+        while not self._stop.wait(poll_s):
+            for i, p in enumerate(list(self.pools)):
+                if i in self._dead or p.proc is None:
+                    continue   # local pools share our fate
+                dead = not p.alive
+                if not dead:
+                    try:
+                        p.healthz()
+                        self._unreachable[i] = 0
+                    except Exception:  # noqa: BLE001 - count strikes
+                        n = self._unreachable.get(i, 0) + 1
+                        self._unreachable[i] = n
+                        dead = n >= DEAD_AFTER_POLLS
+                if dead:
+                    try:
+                        self._failover(i)
+                    except Exception as e:  # noqa: BLE001
+                        warnings.warn(
+                            f"fleet failover of pool "
+                            f"{p.label!r} failed "
+                            f"({type(e).__name__}: {e}); its tenants "
+                            "stay pending until the next sweep",
+                            RuntimeWarning)
+
+    def _failover(self, idx: int) -> None:
+        """Replace a dead subprocess pool: recovery respawn through
+        its manifest (spooled tenants resume from their checkpoints,
+        bitwise), rebind the victims' routed handles, and resubmit
+        the unspooled victims from scratch to any healthy pool
+        (request-replay determinism makes the re-run exact)."""
+        with self._lock:
+            if idx in self._dead:
+                return
+            self._dead.add(idx)
+            routed = list(self._routed)
+        old = self.pools[idx]
+        victims = [rh for rh in routed
+                   if rh.pool_idx == idx and not self._finished(rh)]
+        try:
+            old.kill()   # make death unambiguous before recovering
+        except Exception:  # noqa: BLE001
+            pass
+        new_pool = old.recover()
+        rec = {str(k): v for k, v in
+               (getattr(new_pool, "ready", {}).get("recovered")
+                or {}).items()}
+        with self._lock:
+            self.pools[idx] = new_pool
+            self._dead.discard(idx)
+            self._unreachable[idx] = 0
+            self._status_cache.pop(idx, None)   # dead pool's snapshot
+            self.failovers += 1
+        for rh in victims:
+            key = (rh.request.name if rh.request.name is not None
+                   else rh.request.spool_dir)
+            tid = rec.get(str(key))
+            if tid is not None:
+                rh._rebind(idx, new_pool.handle_for(tid, rh.request))
+                continue
+            # unspooled: replay the request on any healthy pool
+            with self._lock:
+                tgt = self._place(rh.request)
+                inner = self.pools[tgt].submit(rh.request)
+                label = self.pools[tgt].label
+                self.placements[label] = \
+                    self.placements.get(label, 0) + 1
+                self.resubmitted += 1
+            rh._rebind(tgt, inner)
+
+    @staticmethod
+    def _finished(rh: RoutedHandle) -> bool:
+        """Best-effort 'already resolved' check that must not touch
+        the dead pool's wire."""
+        inner = rh._inner
+        ev = getattr(inner, "_done", None)
+        if ev is not None and hasattr(ev, "is_set"):
+            return ev.is_set()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# convenience constructors
+# ---------------------------------------------------------------------------
+
+def spawn_fleet(base_dir: str, n_pools: int, template_ma, config,
+                pool_kwargs: Optional[dict] = None,
+                faults_for: Optional[Dict[int, list]] = None,
+                ready_timeout: float = 600.0,
+                **router_kwargs) -> FleetRouter:
+    """Spawn ``n_pools`` subprocess pools under ``base_dir/poolK`` and
+    wrap them in a router. ``faults_for`` arms serve/faults FaultSpec
+    dicts in selected workers (the chaos tier: ``{1: [{"point":
+    "pool_kill", "after": 3, "action": "kill"}]}``). Workers spawn
+    CONCURRENTLY (each pays its own jax import + pool compile; on a
+    many-core host they overlap)."""
+    specs = [PoolSpec(os.path.join(base_dir, f"pool{i}"), template_ma,
+                      config, pool_kwargs)
+             for i in range(n_pools)]
+    pools: List[Optional[ProcPool]] = [None] * n_pools
+    errors: List = []
+
+    def boot(i):
+        try:
+            pools[i] = ProcPool.spawn(
+                specs[i], faults=(faults_for or {}).get(i),
+                ready_timeout=ready_timeout)
+        except Exception as e:  # noqa: BLE001
+            errors.append((i, e))
+
+    threads = [threading.Thread(target=boot, args=(i,), daemon=True)
+               for i in range(n_pools)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        for p in pools:
+            if p is not None:
+                p.kill()
+        i, e = errors[0]
+        raise RuntimeError(f"pool {i} failed to spawn") from e
+    return FleetRouter(pools, **router_kwargs)
+
+
+def teardown_fleet(router: FleetRouter, remove_dirs: bool = False,
+                   grace: float = 30.0) -> None:
+    """Close the router and (optionally) delete the pool dirs."""
+    router.close(grace=grace)
+    if remove_dirs:
+        for p in router.pools:
+            spec = getattr(p, "spec", None)
+            if spec is not None:
+                shutil.rmtree(spec.pool_dir, ignore_errors=True)

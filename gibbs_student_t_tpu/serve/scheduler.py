@@ -58,6 +58,16 @@ class TenantError(RuntimeError):
 #: telemetry and a supervised server (validated at submit).
 DIVERGENCE_POLICIES = ("none", "fail", "quarantine", "reinit")
 
+#: Valid ``TenantRequest.on_converged`` policies (ROADMAP item 4c).
+#: ``none`` serves the full ``niter`` budget; ``evict`` frees the
+#: tenant's lanes at the first quantum boundary after its streaming
+#: monitor's armed targets hold (``converged_at``) — the cancel
+#: machinery, so the result is the served prefix with status ``done``
+#: — turning convergence speed directly into pool capacity (the freed
+#: groups backfill from the queue at the same boundary). Requires a
+#: monitor with at least one armed target (validated at submit).
+CONVERGED_POLICIES = ("none", "evict")
+
 
 @dataclass
 class TenantRequest:
@@ -102,6 +112,12 @@ class TenantRequest:
     name: Optional[str] = None
     on_divergence: str = "none"
     monitor: object = None                # serve/monitor.MonitorSpec
+    #: convergence-eviction policy (``none`` | ``evict``): with
+    #: ``evict``, the tenant releases its lanes at the first boundary
+    #: after the armed monitor targets hold instead of serving the
+    #: full budget — sweeps the pool would spend past convergence
+    #: become backfill capacity (ROADMAP 4c; docs/SERVING.md)
+    on_converged: str = "none"
 
 
 class TenantHandle:

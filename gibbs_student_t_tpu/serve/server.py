@@ -112,6 +112,7 @@ from gibbs_student_t_tpu.serve.pool import (
     TenantSlot,
 )
 from gibbs_student_t_tpu.serve.scheduler import (
+    CONVERGED_POLICIES,
     DIVERGENCE_POLICIES,
     AdmissionQueue,
     TenantError,
@@ -499,6 +500,9 @@ class ChainServer:
         self._fault_counts = {"tenant_failures": 0,
                               "quarantined_lanes": 0, "reinits": 0,
                               "worker_restarts": 0, "pool_failures": 0}
+        # convergence-based evictions served (ROADMAP 4c): tenants
+        # released early because their armed monitor targets held
+        self._converged_evictions = 0
         # cost accounting (round 14): total measured dispatch wall —
         # the quantity the per-tenant device_ms shares sum back to
         self._dispatch_wall_ms = 0.0
@@ -539,6 +543,7 @@ class ChainServer:
         self._dispatch_wall_ms = 0.0
         for k in self._fault_counts:
             self._fault_counts[k] = 0
+        self._converged_evictions = 0
         # stage-timer accounting restarts from the current cumulative
         # snapshot so warmup kernels never leak into the timed window
         self._stage_prev = (_nffi.timers_snapshot()
@@ -583,6 +588,19 @@ class ChainServer:
             raise ValueError(
                 f"monitor must be a serve.monitor.MonitorSpec or None, "
                 f"got {type(request.monitor).__name__}")
+        if request.on_converged not in CONVERGED_POLICIES:
+            raise ValueError(
+                f"on_converged must be one of {CONVERGED_POLICIES}, "
+                f"got {request.on_converged!r}")
+        if request.on_converged == "evict":
+            mon = request.monitor
+            if mon is None or (mon.ess_target is None
+                               and mon.rhat_target is None):
+                raise ValueError(
+                    "on_converged='evict' needs a monitor with an "
+                    "armed target (ess_target and/or rhat_target) — "
+                    "the streaming convergence verdict is what "
+                    "triggers the eviction")
         if request.on_divergence != "none":
             if not self.supervise:
                 raise ValueError(
@@ -1505,6 +1523,29 @@ class ChainServer:
                     self.metrics.emit(
                         "tenant_converged", tenant=slot.tenant_id,
                         sweep=mon.converged_at, ms=ms)
+                # convergence-based eviction (ROADMAP 4c): the armed
+                # targets hold, so the remaining budget buys no
+                # requested statistics — freeze at the next boundary
+                # via the cancel machinery (result = the served
+                # prefix, status done) and let the freed groups
+                # backfill. Written on the drain worker; the dispatch
+                # thread's boundary read is GIL-atomic, at worst one
+                # extra quantum runs (same as a racing cancel()).
+                if (handle.request.on_converged == "evict"
+                        and not slot.cancelled and not slot.failed):
+                    slot.cancelled = True
+                    self._converged_evictions += 1
+                    if self.metrics is not None:
+                        self.metrics.counter(
+                            "serve_converged_evictions").inc()
+                        self.metrics.emit(
+                            "evict_converged", tenant=slot.tenant_id,
+                            sweep=mon.converged_at,
+                            budget=handle.request.niter)
+                    if self.flight is not None:
+                        self.flight.note_event(
+                            "evict_converged", tenant=slot.tenant_id,
+                            sweep=mon.converged_at)
         except Exception as e:  # noqa: BLE001 - observability contract
             handle._monitor = None
             warnings.warn(
@@ -2021,6 +2062,19 @@ class ChainServer:
             self._stage_thread.join()
         self._stage_thread = None
         self._fail_all_outstanding("server closed")
+        if self._manifest is not None:
+            # clean close: every tenant is finalized, so the compacted
+            # snapshot is just the geometry — a failed-over / restarted
+            # pool cold-starts without re-reading (or re-pickling) the
+            # full admission history. Non-fatal like every manifest
+            # write.
+            try:
+                self._manifest.compact()
+            except Exception as e:  # noqa: BLE001 - bookkeeping only
+                warnings.warn(
+                    f"manifest compaction at close failed "
+                    f"({type(e).__name__}: {e}); the full journal "
+                    "remains valid", RuntimeWarning)
         if self._watchdog is not None:
             self._watchdog.stop()
         if self._atexit_registered:
@@ -2299,12 +2353,22 @@ class ChainServer:
             remaining = rec["niter"] - done
             if remaining <= 0:
                 # fully served and checkpointed; only the finalize was
-                # lost — deliver the spooled result directly
-                h = TenantHandle(-1, TenantRequest(
+                # lost — deliver the spooled result directly. The
+                # handle still gets a real id in the registry so the
+                # RPC wire / progress endpoint can address it (the
+                # fleet router's rebinding path needs every recovered
+                # job reachable by tenant id).
+                with srv._lock:
+                    tid = srv._next_id
+                    srv._next_id += 1
+                h = TenantHandle(tid, TenantRequest(
                     ma=ma, niter=rec["niter"], nchains=rec["nchains"],
                     seed=rec["seed"], spool_dir=rec["spool_dir"],
                     name=rec.get("name")))
                 h._finish(load_spool(rec["spool_dir"]))
+                with srv._lock:
+                    srv._handles[tid] = h
+                srv._tenant_names[tid] = rec.get("name")
                 handles[key] = h
                 continue
             handles[key] = srv.submit(TenantRequest(
@@ -2312,6 +2376,16 @@ class ChainServer:
                 seed=rec["seed"], state=state, start_sweep=next_sweep,
                 spool_dir=rec["spool_dir"], name=rec.get("name"),
                 on_divergence=rec.get("on_divergence") or "none"))
+        # the resubmissions above are journaled in the NEW epoch, so
+        # everything before it is dead weight a future recovery would
+        # re-parse (and the admissions carry pickled models) — compact
+        # to the outstanding snapshot; recovery from a compacted
+        # manifest is bitwise recovery from the full journal (pinned).
+        # keep_lost=False: the lost jobs were just surfaced on
+        # ``lost_tenants`` — their admits must not re-report the same
+        # loss at every future recovery.
+        if srv._manifest is not None:
+            srv._manifest.compact(keep_lost=False)
         return srv, handles
 
     # ------------------------------------------------------------------
@@ -2346,6 +2420,10 @@ class ChainServer:
                 "dispatch_gap": _percentiles(self._gap_ms),
             },
             "faults": dict(self._fault_counts),
+            # convergence-based evictions (ROADMAP 4c): how many
+            # tenants finished early because their armed monitor
+            # targets held — the serve_bench --evict-arm headline
+            "converged_evictions": self._converged_evictions,
             "slo": self._slo_block(),
             # per-stage DEVICE time from the in-kernel timers (round
             # 15): total/mean-per-quantum/share-of-dispatch per stage,

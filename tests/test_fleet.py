@@ -1,0 +1,455 @@
+"""Fleet end-to-end tests: the mutating RPC edge over a live pool,
+subprocess fleets behind the router, request-replay determinism,
+convergence-based eviction, manifest compaction, and the dead-pool
+chaos arm (docs/SERVING.md "The wire").
+
+The headline pins:
+
+- **request-replay determinism** — the same tenant stream through a
+  1-pool fleet and a forced-spread multi-pool fleet (different
+  placements, different processes, the wire in between) yields
+  BITWISE-equal per-tenant results; likewise remote-vs-local submit
+  on one pool. The PR 7 lane-position-independent draw contract makes
+  this provable, and it is what makes router failover-by-replay exact.
+- **dead-pool failover** (slow, chaos) — an injected ``pool_kill``
+  mid-workload: the router recovers the pool through its manifest,
+  victims' results are bitwise an uninterrupted run (spooled: resumed
+  from checkpoint; unspooled: replayed), survivors on co-resident
+  pools untouched.
+- **compaction equivalence** — ``recover()`` from a compacted
+  manifest is bitwise ``recover()`` from the full journal.
+
+Budget: tier-1 arms ride tiny geometries (32-lane pools, quantum 5)
+and at most 2 subprocess pools; the chaos and bench arms are slow.
+"""
+
+import io
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from tests.conftest import make_demo_pta
+from gibbs_student_t_tpu.config import GibbsConfig
+from gibbs_student_t_tpu.serve import (
+    ChainServer,
+    MonitorSpec,
+    RemoteChainServer,
+    RpcServer,
+    TenantRequest,
+)
+from gibbs_student_t_tpu.serve.router import spawn_fleet, teardown_fleet
+
+pytestmark = pytest.mark.fleet
+
+EXACT_FIELDS = ("chain", "zchain", "thetachain", "dfchain")
+
+
+def _native_available() -> bool:
+    from gibbs_student_t_tpu import native
+
+    return native.available()
+
+
+@pytest.fixture(scope="module")
+def demo():
+    pta = make_demo_pta()
+    return pta.frozen(0), GibbsConfig(model="mixture")
+
+
+def _assert_bitwise(ra, rb, label=""):
+    for f in EXACT_FIELDS:
+        assert np.array_equal(np.asarray(getattr(ra, f)),
+                              np.asarray(getattr(rb, f))), (label, f)
+
+
+# ---------------------------------------------------------------------------
+# the RPC edge over one live pool (in-process, one compile)
+# ---------------------------------------------------------------------------
+
+def test_remote_submit_matches_local_bitwise(demo):
+    """submit/progress/cost/cancel/result over the wire against a real
+    pool: a remote tenant (streamed and unstreamed) is BITWISE the
+    local submit with the same request — the wire adds transport, not
+    semantics."""
+    ma, cfg = demo
+    srv = ChainServer(ma, cfg, nlanes=32, quantum=5, record="full")
+    rpc = RpcServer(srv)
+    cli = RemoteChainServer(rpc.address)
+    try:
+        chunks = []
+        h_local = srv.submit(TenantRequest(ma=ma, niter=10, nchains=16,
+                                           seed=4, name="L"))
+        h_stream = cli.submit(TenantRequest(
+            ma=ma, niter=10, nchains=16, seed=4, name="S",
+            on_chunk=lambda h, s, r: chunks.append(
+                (s, {k: v.copy() for k, v in r.items()}))))
+        h_plain = cli.submit(TenantRequest(ma=ma, niter=10, nchains=16,
+                                           seed=4, name="P"))
+        srv.run()
+        res_l = h_local.result()
+        res_s = h_stream.result(timeout=120)
+        res_p = h_plain.result(timeout=120)
+        _assert_bitwise(res_l, res_s, "stream")
+        _assert_bitwise(res_l, res_p, "plain")
+        # streamed chunks arrived per quantum, materialized records
+        assert [s for s, _ in chunks] == [5, 10]
+        assert chunks[0][1]["x"].shape == (5, 16, 3)
+        # ...and their concatenation IS the result's chain, bitwise
+        assert np.array_equal(
+            np.concatenate([c["x"] for _, c in chunks], axis=0),
+            np.asarray(res_s.chain))
+        # control surface over the wire
+        p = h_plain.progress()
+        assert p["status"] == "done" and p["sweeps_done"] == 10
+        assert h_plain.cost()["lane_quanta"] == 16 * 2
+        assert cli.healthz()["ok"] is True
+        assert cli.status()["nlanes"] == 32
+        # a queued tenant cancelled over the wire rejects its handle
+        h_c = cli.submit(TenantRequest(ma=ma, niter=10, nchains=16,
+                                       seed=5, name="C"))
+        assert h_c.cancel() is True
+        with pytest.raises(RuntimeError, match="cancelled"):
+            h_c.result(timeout=5)
+        # a structurally bad remote request rejects, never kills pool
+        bad = make_demo_pta(components=10).frozen(0)
+        h_bad = cli.submit(TenantRequest(ma=bad, niter=10, nchains=16))
+        srv.run()
+        with pytest.raises(RuntimeError, match="basis size"):
+            h_bad.result(timeout=60)
+    finally:
+        srv.close()
+        rpc.close()
+        cli.close()
+
+
+# ---------------------------------------------------------------------------
+# subprocess fleet: replay determinism + the fleet wire
+# ---------------------------------------------------------------------------
+
+def test_replay_determinism_across_subprocess_fleet(demo, tmp_path):
+    """THE placement-independence pin at fleet scope: the same tenant
+    stream served in-process by one pool and through a 2-pool
+    subprocess fleet with a forced round-robin spread (different
+    pools, different processes, the RPC wire in between) →
+    bitwise-equal per-tenant results. Also exercises the fleet read
+    wire (schema-valid aggregated snapshot with the router block,
+    fleet healthz, the fleet_status renderer)."""
+    from gibbs_student_t_tpu.obs import schema as obs_schema
+    from gibbs_student_t_tpu.obs.aggregate import render_fleet
+
+    ma, cfg = demo
+    kw = dict(nlanes=32, quantum=5, record="full")
+    stream = [dict(niter=10, nchains=16, seed=s, name=f"t{s}")
+              for s in range(5)]
+
+    # reference arm: the same stream served IN-PROCESS by one pool
+    srv = ChainServer(ma, cfg, **kw)
+    ref_handles = [srv.submit(TenantRequest(ma=ma, **s))
+                   for s in stream]
+    srv.run()
+    res1 = {h.request.name: h.result() for h in ref_handles}
+    srv.close()
+
+    fleet = spawn_fleet(str(tmp_path / "two"), 2, ma, cfg,
+                        pool_kwargs=kw, placement="round_robin")
+    try:
+        handles = [fleet.submit(TenantRequest(ma=ma, **s))
+                   for s in stream]
+        res2 = {h.request.name: h.result(timeout=600)
+                for h in handles}
+        snap = fleet.fleet_status()
+        hz = fleet.healthz()
+    finally:
+        teardown_fleet(fleet, remove_dirs=True)
+    for name in res1:
+        _assert_bitwise(res1[name], res2[name], name)
+    # the spread really was forced across both pools
+    assert snap["router"]["placements"] == {"pool0": 3, "pool1": 2}
+    assert snap["n_reachable"] == 2 and hz["ok"] is True
+    schemas = obs_schema.load_schemas()
+    obs_schema.assert_valid(snap, schemas["fleet_status"],
+                            "fleet snapshot", defs=schemas)
+    out = io.StringIO()
+    render_fleet(snap, out)
+    text = out.getvalue()
+    assert "router placements:" in text and "pool0=3" in text
+
+
+# ---------------------------------------------------------------------------
+# convergence-based eviction (ROADMAP 4c)
+# ---------------------------------------------------------------------------
+
+def test_converged_eviction_frees_lanes_and_backfills(demo):
+    """on_converged='evict': the tenant releases at the first boundary
+    after its armed target holds — result is the served prefix
+    (bitwise, the cancel contract), the queued successor backfills
+    the freed groups, and the summary counts the eviction."""
+    ma, cfg = demo
+    srv = ChainServer(ma, cfg, nlanes=32, quantum=5, record="full")
+    mon = MonitorSpec(params=[0, 1], ess_target=1.0, min_rows=4)
+    h = srv.submit(TenantRequest(ma=ma, niter=50, nchains=16, seed=0,
+                                 name="E", monitor=mon,
+                                 on_converged="evict"))
+    # 32 chains cannot fit until E's 16 release: backfill proves the
+    # freed groups became capacity
+    h_fill = srv.submit(TenantRequest(ma=ma, niter=10, nchains=32,
+                                      seed=1, name="F"))
+    srv.run()
+    res = h.result()
+    assert h.sweeps_done < 50, "eviction never fired"
+    assert h.status == "done" and h_fill.status == "done"
+    s = srv.summary()
+    assert s["converged_evictions"] == 1
+    srv.close()
+    # prefix bitwise vs the un-evicted run
+    srv2 = ChainServer(ma, cfg, nlanes=32, quantum=5, record="full")
+    h2 = srv2.submit(TenantRequest(ma=ma, niter=50, nchains=16,
+                                   seed=0, name="E"))
+    srv2.run()
+    full = h2.result()
+    srv2.close()
+    rows = np.asarray(res.chain).shape[0]
+    for f in EXACT_FIELDS:
+        assert np.array_equal(
+            np.asarray(getattr(res, f)),
+            np.asarray(getattr(full, f))[:rows]), f
+    # the monitor stats record the verdict the eviction acted on
+    assert res.stats["converged_at"] is not None
+    # validation rides the same pool: bad policy name, policy without
+    # a monitor, monitor without an armed target
+    with pytest.raises(ValueError, match="on_converged must be"):
+        srv2.submit(TenantRequest(ma=ma, niter=5, nchains=16,
+                                  on_converged="early"))
+    with pytest.raises(ValueError, match="armed target"):
+        srv2.submit(TenantRequest(ma=ma, niter=5, nchains=16,
+                                  on_converged="evict"))
+    with pytest.raises(ValueError, match="armed target"):
+        srv2.submit(TenantRequest(ma=ma, niter=5, nchains=16,
+                                  monitor=MonitorSpec(params=[0]),
+                                  on_converged="evict"))
+
+
+# ---------------------------------------------------------------------------
+# manifest compaction
+# ---------------------------------------------------------------------------
+
+def _crash_manifest(ma, cfg, tmp_path):
+    """A mid-flight 'crashed' server's manifest: a spooled tenant S
+    2 quanta into 4, an in-memory tenant B (lost on a crash), and a
+    FINISHED spooled tenant D whose admit + model pickle are the dead
+    history compaction must drop. Returns (man, spool_S)."""
+    man = str(tmp_path / "man")
+    spool = str(tmp_path / "sS")
+    # 48 lanes so all three tenants admit at the first boundary (the
+    # finished one must land a done record before the "crash")
+    srv = ChainServer(ma, cfg, nlanes=48, quantum=5, record="full",
+                      pipeline=False, manifest_dir=man)
+    srv.submit(TenantRequest(ma=ma, niter=20, nchains=16, seed=3,
+                             name="S", spool_dir=spool))
+    srv.submit(TenantRequest(ma=ma, niter=20, nchains=16, seed=2,
+                             name="B"))   # in-memory: lost on a crash
+    done_h = srv.submit(TenantRequest(ma=ma, niter=5, nchains=16,
+                                      seed=9, name="D",
+                                      spool_dir=str(tmp_path / "sD")))
+    for _ in range(2):
+        srv.step()   # D done; S mid-flight; then the "process dies"
+    assert done_h.status == "done"
+    del srv
+    return man, spool
+
+
+@pytest.mark.skipif(not _native_available(),
+                    reason="spooling needs the native library")
+def test_manifest_compaction_invariants(demo, tmp_path):
+    """Compaction preserves exactly what recovery consumes — the
+    ``outstanding_tenants`` resolution and ``load_server_state`` —
+    while shrinking the journal and pruning stale model pickles.
+    (Identical recovery inputs ⇒ identical recovery; the end-to-end
+    bitwise double-recovery pin is the slow arm below.)"""
+    from gibbs_student_t_tpu.serve.manifest import (
+        compact_manifest,
+        load_server_state,
+        outstanding_tenants,
+        read_manifest,
+    )
+
+    ma, cfg = demo
+    man, _ = _crash_manifest(ma, cfg, tmp_path)
+    n_before = len(read_manifest(man))
+    rec_before, lost_before = outstanding_tenants(man)
+    _, _, kw_before = load_server_state(man)
+    kept = compact_manifest(man)
+    recs = read_manifest(man)
+    assert kept == len(recs) < n_before
+    head = recs[0]
+    assert head["kind"] == "server" and head["compacted"] is True
+    assert head["compacted_from"] == n_before
+    # recovery-relevant state is invariant under compaction
+    rec_after, lost_after = outstanding_tenants(man)
+    assert ([r["spool_dir"] for r in rec_before]
+            == [r["spool_dir"] for r in rec_after] == [
+                str(tmp_path / "sS")])
+    assert ([r.get("name") for r in lost_before]
+            == [r.get("name") for r in lost_after] == ["B"])
+    for k in ("seed", "niter", "nchains", "start_sweep"):
+        assert rec_before[0][k] == rec_after[0][k], k
+    _, _, kw_after = load_server_state(man)
+    assert kw_before == kw_after
+    # the finished tenant's model pickle was pruned; S's kept
+    models = sorted(f for f in os.listdir(man)
+                    if f.startswith("model_"))
+    assert models == sorted(r["model_file"] for r in rec_after)
+    # compacting a compacted manifest is a fixpoint
+    assert compact_manifest(man) == len(read_manifest(man)) == kept
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not _native_available(),
+                    reason="spooling needs the native library")
+def test_manifest_compaction_recovery_bitwise(demo, tmp_path):
+    """THE compaction pin, end to end: ``recover()`` from a compacted
+    manifest == ``recover()`` from the full journal, BITWISE, lost
+    report included; a cleanly closed recovered server leaves a
+    compacted geometry-only manifest."""
+    from gibbs_student_t_tpu.serve.manifest import (
+        compact_manifest,
+        read_manifest,
+    )
+
+    ma, cfg = demo
+    man, spool = _crash_manifest(ma, cfg, tmp_path)
+    # snapshot the crash state so both recovery arms start identical
+    shutil.copytree(man, str(tmp_path / "man_bak"))
+    shutil.copytree(spool, str(tmp_path / "sS_bak"))
+
+    def restore():
+        shutil.rmtree(man)
+        shutil.copytree(str(tmp_path / "man_bak"), man)
+        shutil.rmtree(spool)
+        shutil.copytree(str(tmp_path / "sS_bak"), spool)
+
+    def recover_and_finish():
+        srv2, handles = ChainServer.recover(man)
+        lost = [r["name"] for r in srv2.lost_tenants]
+        srv2.run()
+        srv2.close()
+        return handles["S"].result(), lost
+
+    res_full, lost_full = recover_and_finish()
+    restore()
+    compact_manifest(man)
+    res_comp, lost_comp = recover_and_finish()
+    assert lost_full == lost_comp == ["B"]
+    _assert_bitwise(res_full, res_comp, "compacted-vs-full")
+    assert np.asarray(res_comp.chain).shape[0] == 20
+    # the clean close at the end of recover_and_finish compacted
+    # again: geometry only, nothing outstanding
+    final = read_manifest(man)
+    assert [r["kind"] for r in final] == ["server"]
+    assert final[0]["compacted"] is True
+
+
+# ---------------------------------------------------------------------------
+# the dead-pool chaos arm (slow: subprocess kill + recovery respawn)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.skipif(not _native_available(),
+                    reason="spool failover needs the native library")
+def test_dead_pool_failover_bitwise(demo, tmp_path):
+    """THE fleet chaos pin: one pool of a two-pool fleet is killed by
+    an injected ``pool_kill`` (os._exit in the worker) mid-workload.
+    The router fails it over through the manifest + recover()
+    contract; the spooled victim resumes from its checkpoint and the
+    in-memory victim is replayed — both BITWISE an uninterrupted
+    fleet's run — while the co-resident pool's tenants are
+    untouched."""
+    ma, cfg = demo
+    kw = dict(nlanes=32, quantum=5, record="full")
+    jobs = [
+        dict(niter=20, nchains=16, seed=0, name="s0"),   # -> pool0
+        dict(niter=20, nchains=16, seed=1, name="V",     # -> pool1
+             spool_dir=str(tmp_path / "spoolV")),
+        dict(niter=10, nchains=16, seed=2, name="s1"),   # -> pool0
+        dict(niter=20, nchains=16, seed=3, name="M"),    # -> pool1
+    ]
+
+    def run(tag, faults_for=None):
+        fleet = spawn_fleet(str(tmp_path / tag), 2, ma, cfg,
+                            pool_kwargs=kw, placement="round_robin",
+                            faults_for=faults_for)
+        try:
+            handles = [fleet.submit(TenantRequest(ma=ma, **j))
+                       for j in jobs]
+            res = {h.request.name: h.result(timeout=600)
+                   for h in handles}
+            return res, fleet.failovers, fleet.resubmitted
+        finally:
+            teardown_fleet(fleet, remove_dirs=False)
+
+    res, failovers, resubmitted = run(
+        "chaos", faults_for={1: [{"point": "pool_kill", "after": 2,
+                                  "action": "kill"}]})
+    assert failovers == 1
+    assert resubmitted == 1     # M replayed; V resumed via recover()
+    # the recovered worker closed cleanly at teardown: its manifest is
+    # the compacted geometry-only snapshot (everything finalized)
+    from gibbs_student_t_tpu.serve.manifest import read_manifest
+
+    man = str(tmp_path / "chaos" / "pool1" / "manifest")
+    recs = read_manifest(man)
+    assert [r["kind"] for r in recs] == ["server"]
+    assert recs[0]["compacted"] is True
+    # spool paths collide across arms — reference uses fresh names
+    jobs[1] = dict(jobs[1], spool_dir=str(tmp_path / "spoolV_ref"))
+    ref, f0, r0 = run("ref")
+    assert f0 == 0 and r0 == 0
+    for name in ("V", "M"):       # the victims: bitwise the ref
+        _assert_bitwise(res[name], ref[name], name)
+    for name in ("s0", "s1"):     # the survivors: untouched
+        _assert_bitwise(res[name], ref[name], name)
+
+
+# ---------------------------------------------------------------------------
+# fleet_bench emission contract (slow: spawns 4 pools total)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_fleet_bench_quick_ledger_matches_final_line(tmp_path):
+    """The bench emission contract at fleet scope: the final combined
+    stream line parses, equals the fleet_bench ledger record's
+    metrics, and validates against the fleet_bench_metrics schema."""
+    import json
+
+    from gibbs_student_t_tpu.obs import schema as obs_schema
+    from gibbs_student_t_tpu.obs.ledger import read_ledger
+
+    lpath = str(tmp_path / "ledger.jsonl")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PYTHONPATH", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "fleet_bench.py"),
+         "--quick", "--ledger", lpath],
+        capture_output=True, text=True, env=env, timeout=1200,
+        cwd=repo)
+    assert out.returncode == 0, out.stderr[-2000:]
+    last = out.stdout.strip().splitlines()[-1]
+    line = json.loads(last)
+    assert line["metric"] == "fleet_aggregate_chain_sweeps_per_s"
+    assert line["pools"] == 2 and line["value"] > 0
+    assert line["fleet_ratio"] is not None
+    recs = read_ledger(lpath)
+    assert len(recs) == 1 and recs[0]["tool"] == "fleet_bench"
+    assert recs[0]["metrics"] == line
+    schemas = obs_schema.load_schemas()
+    obs_schema.assert_valid(line, schemas["fleet_bench_metrics"],
+                            "fleet_bench line", defs=schemas)
+    obs_schema.assert_valid(recs[0], schemas["ledger_record"],
+                            "fleet_bench record", defs=schemas)

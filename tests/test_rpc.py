@@ -1,0 +1,497 @@
+"""RPC-wire unit tests: framing, rejection paths, the RpcServer over a
+stub chain server, and FleetRouter placement/failover logic over fake
+pools — no pool compiles, no subprocesses (the jax-heavy fleet
+end-to-end arms live in tests/test_fleet.py).
+
+The rejection contract pinned here (docs/SERVING.md "The wire"):
+malformed magic/version/kind answers one error frame and closes;
+an oversized declared length is rejected BEFORE allocation; a peer
+disconnect mid-frame is contained to that connection and the server
+keeps answering the next one; an injected ``rpc_sever`` closes a
+stream abruptly and the client's handle resolves to a
+ConnectionError instead of hanging.
+"""
+
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gibbs_student_t_tpu.serve import faults as faults_mod
+from gibbs_student_t_tpu.serve.rpc import (
+    _HEADER,
+    MAGIC,
+    FrameError,
+    Pickled,
+    RemoteChainServer,
+    RpcError,
+    RpcServer,
+    decode_payload,
+    encode_frame,
+    recv_frame,
+    rpc_max_frame_env,
+    send_frame,
+)
+from gibbs_student_t_tpu.serve.scheduler import TenantRequest
+
+pytestmark = pytest.mark.fleet
+
+
+# ---------------------------------------------------------------------------
+# framing
+# ---------------------------------------------------------------------------
+
+def _decode(data: bytes) -> dict:
+    magic, ver, kind, length = _HEADER.unpack(data[:_HEADER.size])
+    assert magic == MAGIC and length == len(data) - _HEADER.size
+    return decode_payload(kind, data[_HEADER.size:])
+
+
+def test_frame_roundtrip_json_arrays_pickles():
+    body = {
+        "op": "x", "n": 3, "f": 1.5, "none": None, "flag": True,
+        "arr_f32": np.arange(12, dtype=np.float32).reshape(3, 4),
+        "arr_i64": np.array([[1, -2], [3, 4]], np.int64),
+        "blob": Pickled({"k": np.ones(5), "s": "v"}),
+        "nested": [{"deep": np.arange(3, dtype=np.uint8)},
+                   np.float64(2.25)],
+    }
+    back = _decode(encode_frame(body))
+    assert back["op"] == "x" and back["n"] == 3 and back["none"] is None
+    assert back["arr_f32"].dtype == np.float32
+    assert np.array_equal(back["arr_f32"], body["arr_f32"])
+    assert np.array_equal(back["arr_i64"], body["arr_i64"])
+    assert np.array_equal(back["blob"]["k"], np.ones(5))
+    assert back["blob"]["s"] == "v"
+    assert np.array_equal(back["nested"][0]["deep"],
+                          np.arange(3, dtype=np.uint8))
+    assert back["nested"][1] == 2.25   # np scalars -> plain JSON
+
+
+def test_frame_roundtrip_pure_json_stays_json_kind():
+    data = encode_frame({"op": "status"})
+    _, _, kind, _ = _HEADER.unpack(data[:_HEADER.size])
+    assert kind == b"j"
+    assert _decode(data) == {"op": "status"}
+
+
+def test_malformed_frames_raise():
+    # composite whose declared JSON length overruns the payload
+    with pytest.raises(FrameError, match="JSON length"):
+        decode_payload(b"m", b"\x00\x00\x00\xffxx")
+    # unknown kind
+    with pytest.raises(FrameError, match="kind"):
+        decode_payload(b"q", b"{}")
+    # non-object body
+    with pytest.raises(FrameError, match="not a JSON object"):
+        decode_payload(b"j", b"[1,2]")
+    # dangling buffer reference
+    with pytest.raises(FrameError, match="dangling"):
+        decode_payload(b"m", struct.pack(">I", 26)
+                       + b'{"a":{"$nd":7},"op":"x"}  ')
+    # buffer table overrunning the payload
+    bad = {"__buffers__": [["<f4", [64], 256]], "a": {"$nd": 0}}
+    import json as _json
+
+    jb = _json.dumps(bad).encode()
+    with pytest.raises(FrameError, match="overruns"):
+        decode_payload(b"m", struct.pack(">I", len(jb)) + jb + b"xx")
+
+
+def test_max_frame_env_validation(monkeypatch):
+    monkeypatch.setenv("GST_RPC_MAX_FRAME", "bogus")
+    with pytest.raises(ValueError, match="GST_RPC_MAX_FRAME"):
+        rpc_max_frame_env()
+    monkeypatch.setenv("GST_RPC_MAX_FRAME", "-3")
+    with pytest.raises(ValueError, match="GST_RPC_MAX_FRAME"):
+        rpc_max_frame_env()
+    monkeypatch.setenv("GST_RPC_MAX_FRAME", "4096")
+    assert rpc_max_frame_env() == 4096
+    monkeypatch.delenv("GST_RPC_MAX_FRAME")
+    assert rpc_max_frame_env() == 256 * 1024 * 1024
+
+
+def test_oversized_frames_rejected_both_directions():
+    a, b = socket.socketpair()
+    try:
+        big = {"op": "x", "arr": np.zeros(100000, np.float64)}
+        with pytest.raises(FrameError, match="exceeds"):
+            send_frame(a, big, max_frame=1024)
+        # receiver-side: a header declaring more than the ceiling is
+        # rejected before any payload allocation
+        a.sendall(_HEADER.pack(MAGIC, b"\x01", b"j", 1 << 30))
+        with pytest.raises(FrameError, match="ceiling"):
+            recv_frame(b, max_frame=1024)
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# the RpcServer over a stub chain server (no jax, no pool)
+# ---------------------------------------------------------------------------
+
+class _StubHandle:
+    def __init__(self, tenant_id, request):
+        self.tenant_id = tenant_id
+        self.request = request
+        self._done = threading.Event()
+        self._result = None
+
+    def progress(self):
+        return {"tenant_id": self.tenant_id, "status":
+                ("done" if self._done.is_set() else "running"),
+                "name": self.request.name}
+
+    def cost(self):
+        return {"device_ms": 1.25, "lane_quanta": 4,
+                "ess_per_core_s": None}
+
+    def done(self):
+        return self._done.is_set()
+
+    def result(self, timeout=None):
+        if not self._done.wait(timeout if timeout is not None else 30):
+            raise TimeoutError("stub tenant not done")
+        return self._result
+
+    def _finish(self, res):
+        self._result = res
+        self._done.set()
+
+
+class _StubServer:
+    """Duck-typed ChainServer: submit/cancel/status/healthz/_handles.
+    ``chunks`` > 0 makes submit serve that many on_chunk callbacks
+    from a worker thread, then finish — the streaming test bed."""
+
+    def __init__(self, chunks=0):
+        self._handles = {}
+        self._next = 0
+        self.chunks = chunks
+        self.cancelled = []
+
+    def submit(self, request, timeout=None):
+        h = _StubHandle(self._next, request)
+        self._handles[h.tenant_id] = h
+        self._next += 1
+
+        def run():
+            for i in range(self.chunks):
+                if request.on_chunk is not None:
+                    request.on_chunk(
+                        h, (i + 1) * 5,
+                        {"x": np.full((5, 2), i, np.float32)})
+            h._finish({"rows": self.chunks * 5,
+                       "seed": request.seed})
+
+        threading.Thread(target=run, daemon=True).start()
+        return h
+
+    def cancel(self, h):
+        self.cancelled.append(h.tenant_id)
+        return True
+
+    def status(self):
+        return {"schema": 1, "queue_depth": 0, "tenants": []}
+
+    def healthz(self):
+        return {"ok": True}
+
+    def reset_counters(self):
+        self.reset = True
+
+
+@pytest.fixture()
+def stub_rpc():
+    stub = _StubServer(chunks=2)
+    rpc = RpcServer(stub)
+    yield stub, rpc, RemoteChainServer(rpc.address, timeout=10.0)
+    rpc.close()
+
+
+def test_rpc_ops_over_stub(stub_rpc):
+    stub, rpc, cli = stub_rpc
+    req = TenantRequest(ma={"m": 1}, niter=10, nchains=4, seed=7,
+                        name="tA")
+    h = cli.submit(req)
+    res = h.result(timeout=10)
+    assert res == {"rows": 10, "seed": 7}
+    assert h.progress()["status"] == "done"
+    assert h.cost()["lane_quanta"] == 4
+    assert cli.status()["schema"] == 1
+    assert cli.healthz()["ok"] is True
+    assert h.cancel() is True and stub.cancelled == [h.tenant_id]
+    cli.reset_counters()
+    assert getattr(stub, "reset", False) is True
+    # unknown tenant and unknown op answer error frames, not hangs
+    with pytest.raises(RpcError, match="unknown tenant"):
+        cli._call({"op": "progress", "tenant": 999})
+    with pytest.raises(RpcError, match="unknown op"):
+        cli._call({"op": "frobnicate"})
+    # shutdown without a callback is an error, never an exit
+    with pytest.raises(RpcError, match="shutdown not armed"):
+        cli.shutdown()
+
+
+def test_rpc_streaming_chunks_over_stub(stub_rpc):
+    stub, rpc, cli = stub_rpc
+    got = []
+
+    def on_chunk(h, sweep_end, records):
+        got.append((sweep_end, records["x"].copy()))
+
+    h = cli.submit(TenantRequest(ma={"m": 1}, niter=10, nchains=4,
+                                 seed=3, name="tS", on_chunk=on_chunk))
+    res = h.result(timeout=10)
+    assert res["seed"] == 3
+    assert [s for s, _ in got] == [5, 10]
+    assert got[0][1].dtype == np.float32
+    assert np.array_equal(got[1][1], np.full((5, 2), 1, np.float32))
+
+
+def test_malformed_and_disconnect_contained(stub_rpc):
+    stub, rpc, cli = stub_rpc
+    # garbage magic: one error frame, closed connection
+    s = socket.create_connection(("127.0.0.1", rpc.port), timeout=5)
+    s.sendall(b"XX" + b"\x00" * 30)
+    reply = recv_frame(s)
+    assert reply["op"] == "error" and "bad frame" in reply["error"]
+    try:
+        assert s.recv(1) == b""   # server closed after the error frame
+    except ConnectionResetError:
+        pass  # RST instead of FIN: unread garbage was still buffered
+    s.close()
+    # disconnect mid-frame (header promises more than is sent)
+    s2 = socket.create_connection(("127.0.0.1", rpc.port), timeout=5)
+    s2.sendall(_HEADER.pack(MAGIC, b"\x01", b"j", 100) + b"{}")
+    s2.close()
+    time.sleep(0.05)
+    # the server survives and answers the next connection
+    assert cli.healthz()["ok"] is True
+
+
+def test_rpc_sever_closes_stream_and_result_survives(stub_rpc):
+    """A severed stream resolves the client handle to a
+    ConnectionError — and because the SERVER kept serving, the result
+    is still fetchable over a fresh connection by tenant id."""
+    stub, rpc, cli = stub_rpc
+    got = []
+    with faults_mod.inject(
+            faults_mod.FaultSpec("rpc_sever", tenant="tV", after=1)):
+        h = cli.submit(TenantRequest(
+            ma={"m": 1}, niter=10, nchains=4, seed=5, name="tV",
+            on_chunk=lambda hh, s, r: got.append(s)))
+        with pytest.raises(ConnectionError, match="severed"):
+            h.result(timeout=10)
+    assert faults_mod.fired_counts()[("rpc_sever", "tV")] == 1
+    # a fresh handle to the same tenant id gets the full result
+    from gibbs_student_t_tpu.serve.rpc import RemoteTenantHandle
+
+    h2 = RemoteTenantHandle(cli, h.tenant_id, h.request)
+    assert h2.result(timeout=10)["seed"] == 5
+
+
+# ---------------------------------------------------------------------------
+# FleetRouter placement + failover logic over fake pools
+# ---------------------------------------------------------------------------
+
+class _FakePool:
+    alive = True        # class attr so _DyingPool can shadow with a
+                        # property (liveness from its fake Popen)
+
+    def __init__(self, label, queue_depth=0, free_groups=2,
+                 occupancy=0.5):
+        self.label = label
+        self.proc = None           # the watch loop skips local pools
+        self.queue_depth = queue_depth
+        self.free_groups = free_groups
+        self.occupancy = occupancy
+        self.submitted = []
+
+    def submit(self, request, timeout=None):
+        self.submitted.append(request)
+        return _StubHandle(len(self.submitted), request)
+
+    def cancel(self, h):
+        return True
+
+    def status(self):
+        return {"schema": 1, "queue_depth": self.queue_depth,
+                "staged": 0, "free_groups": self.free_groups,
+                "group": 16, "occupancy_now": self.occupancy,
+                "nlanes": 64, "busy_lanes": 32, "faults": {},
+                "slo": {"admission_ms": None},
+                "slo_raw": {"admission_ms": [1.0 * self.queue_depth]},
+                "tenants": []}
+
+    def healthz(self):
+        return {"ok": True, "error": None}
+
+    def reset_counters(self):
+        pass
+
+    def close(self, grace=0):
+        pass
+
+
+def _router(pools, **kw):
+    from gibbs_student_t_tpu.serve.router import FleetRouter
+
+    kw.setdefault("failover", False)
+    return FleetRouter(pools, **kw)
+
+
+def test_router_places_by_load_and_counts():
+    light = _FakePool("light", queue_depth=0, free_groups=3,
+                      occupancy=0.2)
+    heavy = _FakePool("heavy", queue_depth=5, free_groups=0,
+                      occupancy=0.9)
+    r = _router([heavy, light])
+    req = TenantRequest(ma={}, niter=5, nchains=4, name="a")
+    for _ in range(3):
+        r.submit(req)
+    assert len(light.submitted) == 3 and not heavy.submitted
+    assert r.placements == {"light": 3}
+    snap = r.fleet_status()
+    assert snap["router"]["placements"] == {"light": 3}
+    assert snap["n_reachable"] == 2
+    assert r.healthz()["ok"] is True
+    r.close()
+
+
+def test_router_round_robin_spreads_deterministically():
+    a, b = _FakePool("a"), _FakePool("b")
+    r = _router([a, b], placement="round_robin")
+    for i in range(4):
+        r.submit(TenantRequest(ma={}, niter=5, nchains=4,
+                               name=f"t{i}"))
+    assert len(a.submitted) == 2 and len(b.submitted) == 2
+    with pytest.raises(ValueError, match="placement"):
+        _router([a], placement="fastest")
+    r.close()
+
+
+def test_router_uses_stale_snapshot_for_busy_pool():
+    """A pool that stops answering status (its server lock is held for
+    the whole quantum under load) is still PLACED ON through its
+    cached snapshot — exclusion would bias every submit toward
+    whichever pool is idle enough to answer. The cached queue_depth is
+    bumped per placement so a burst still joins the shortest queue."""
+    busy = _FakePool("busy", queue_depth=0, free_groups=4)
+    other = _FakePool("other", queue_depth=1, free_groups=4)
+    r = _router([busy, other])
+    r.submit(TenantRequest(ma={}, niter=5, nchains=4, name="warm"))
+    assert len(busy.submitted) == 1     # busy was the lighter pool
+
+    def timeout_now():
+        raise TimeoutError("server lock held mid-quantum")
+
+    busy.status = timeout_now
+    # cached snapshot (queue 0 + 1 placed) still beats other's queue=1
+    # exactly once; the bump then tips the balance to `other`
+    r.submit(TenantRequest(ma={}, niter=5, nchains=4, name="a"))
+    r.submit(TenantRequest(ma={}, niter=5, nchains=4, name="b"))
+    assert len(busy.submitted) + len(other.submitted) == 3
+    assert len(other.submitted) >= 1    # no starvation of the pollable
+    # with the cache expired, the busy pool is finally excluded
+    r.status_stale_s = 0.0
+    r.submit(TenantRequest(ma={}, niter=5, nchains=4, name="c"))
+    assert len(other.submitted) >= 2
+    snap = r.fleet_status()
+    rows = {p["source"]: p["reachable"] for p in snap["pools"]}
+    assert rows["other"] is True
+    r.close()
+
+
+def test_router_skips_unreachable_pool():
+    ok = _FakePool("ok")
+    down = _FakePool("down")
+
+    def boom():
+        raise ConnectionError("refused")
+
+    down.status = boom
+    r = _router([down, ok])
+    r.submit(TenantRequest(ma={}, niter=5, nchains=4, name="x"))
+    assert len(ok.submitted) == 1 and not down.submitted
+    snap = r.fleet_status()
+    rows = {p["source"]: p["reachable"] for p in snap["pools"]}
+    assert rows == {"down": False, "ok": True}
+    r.close()
+
+
+class _DyingPool(_FakePool):
+    """A fake subprocess pool: 'dies' on demand, recovers into a
+    replacement that knows one spooled tenant's new id."""
+
+    def __init__(self, label):
+        super().__init__(label)
+
+        class _P:   # a Popen-shaped corpse detector
+            def __init__(s):
+                s.dead = False
+
+            def poll(s):
+                return 9 if s.dead else None
+
+        self.proc = _P()
+        self.recovered_into = None
+
+    @property
+    def alive(self):
+        return self.proc.poll() is None
+
+    def kill(self):
+        self.proc.dead = True
+
+    def recover(self):
+        new = _FakePool(self.label + "'")
+        new.ready = {"recovered": {"spooled": 77}}
+        new.handle_for = lambda tid, request: _StubHandle(tid, request)
+        self.recovered_into = new
+        return new
+
+
+def test_router_failover_rebinds_and_resubmits():
+    """The failover unit: victims on the dead pool rebind (spooled ->
+    the recovered pool's advertised id; unspooled -> replayed on a
+    healthy pool); survivors and their pool are untouched."""
+    dying = _DyingPool("dying")
+    healthy = _FakePool("healthy", queue_depth=9)  # load prefers dying
+    r = _router([dying, healthy], watch_poll_s=0.05, failover=True)
+    spooled = r.submit(TenantRequest(ma={}, niter=5, nchains=4,
+                                     name="spooled"))
+    mem = r.submit(TenantRequest(ma={}, niter=5, nchains=4,
+                                 name="mem"))
+    # pin the bystander onto the healthy pool (make dying look loaded
+    # for one placement decision)
+    dying.queue_depth = 99
+    bystander = r.submit(TenantRequest(ma={}, niter=5, nchains=4,
+                                       name="by"))
+    assert bystander.pool_idx == 1
+    by_inner = bystander._inner
+    dying.proc.dead = True
+    deadline = time.monotonic() + 5
+    while r.failovers == 0 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert r.failovers == 1
+    assert spooled._inner.tenant_id == 77          # rebound to recover
+    assert spooled.pool_idx == 0
+    # the unspooled victim was REPLAYED somewhere healthy
+    assert r.resubmitted == 1
+    assert mem._rebound.is_set()
+    replay_targets = (healthy.submitted
+                      + dying.recovered_into.submitted)
+    assert any(q.name == "mem" for q in replay_targets)
+    # the bystander on the co-resident pool is untouched
+    assert bystander._inner is by_inner
+    assert not any(q.name == "by" for q in
+                   dying.recovered_into.submitted)
+    assert r.pools[0] is dying.recovered_into
+    snap = r.fleet_status()
+    assert snap["router"]["failovers"] == 1
+    r.close()

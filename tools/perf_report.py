@@ -226,6 +226,16 @@ def print_report(ledger_recs, include_rounds=True):
                     if isinstance(v, dict)
                     and isinstance(v.get("mean_s"), (int, float)))
                 print(f"    stage_device_ms/quantum {line}")
+            # convergence-eviction sub-line (--evict-arm records):
+            # jobs-per-hour at equal delivered ESS, base vs evict
+            ev = m.get("evict")
+            if isinstance(ev, dict):
+                print(f"    evict jobs/h {ev.get('jobs_per_hour_base')}"
+                      f" -> {ev.get('jobs_per_hour')} "
+                      f"({(ev.get('gain') or 0) * 100:+.1f}%) "
+                      f"evictions={ev.get('converged_evictions')} "
+                      f"sweeps_saved={ev.get('sweeps_saved_frac')} "
+                      f"ess_min_mean={ev.get('ess_min_mean')}")
             # chaos-arm sub-line (serve_bench --faults records)
             f = m.get("faults")
             if isinstance(f, dict):
@@ -236,6 +246,35 @@ def print_report(ledger_recs, include_rounds=True):
                       f"quarantined={f.get('quarantined_lanes')} "
                       f"restarts={f.get('worker_restarts')} "
                       f"pool_failures={f.get('pool_failures')}")
+        elif rec.get("tool") == "fleet_bench":
+            # fleet record: the pools->ratio multiplier IS the story
+            print(f"  {rec.get('timestamp_utc', '?'):20s} "
+                  f"{rec.get('tool', '?'):14s} "
+                  f"{rec.get('platform') or '?':8s} "
+                  f"{m.get('metric', '?')}={m.get('value')} "
+                  f"pools={m.get('pools')} "
+                  f"ratio={m.get('fleet_ratio')} "
+                  f"(linear bound {m.get('linear_bound')}x on "
+                  f"{m.get('cpu_cores')} cores) "
+                  f"tenants={m.get('tenants')} "
+                  f"admission_p99={m.get('admission_p99_ms')}ms")
+            r = m.get("router") or {}
+            pl = r.get("placements") or {}
+            placed = " ".join(f"{k}={v}" for k, v in sorted(pl.items()))
+            print(f"    router placement={r.get('placement')} "
+                  f"[{placed}] failovers={r.get('failovers')} "
+                  f"resubmitted={r.get('resubmitted')}")
+            for p in m.get("pools_detail") or []:
+                if not p.get("reachable"):
+                    print(f"    pool {str(p.get('source')):12s} DOWN "
+                          f"{p.get('error')}")
+                    continue
+                occ = p.get("occupancy")
+                print(f"    pool {str(p.get('source')):12s} "
+                      f"{'ok' if p.get('healthy') else 'SICK':>4} "
+                      f"lanes={p.get('nlanes')} "
+                      f"occupancy={occ if occ is not None else '?'} "
+                      f"queue={p.get('queue_depth')}")
         else:
             brief = {k: v for k, v in m.items()
                      if isinstance(v, (int, float, bool, str))}
@@ -250,7 +289,8 @@ def _metric_series(ledger_recs):
     the per-series history the trend gate and sparkline table fold."""
     out = {}
     for rec in ledger_recs:
-        if rec.get("tool") not in ("bench", "serve_bench"):
+        if rec.get("tool") not in ("bench", "serve_bench",
+                                   "fleet_bench"):
             continue
         m = rec.get("metrics") or {}
         name, value = m.get("metric"), m.get("value")
@@ -675,6 +715,87 @@ def check_serve(ledger_recs, min_occupancy, min_serve_ratio,
     return 0
 
 
+def check_fleet(ledger_recs, min_fleet_ratio, max_admission_p99):
+    """Fleet gate over the latest ``fleet_bench`` record: aggregate
+    throughput over N pools vs the bracketing single-pool arms. On one
+    host the physically available multiplier is ``min(pools, cores)``
+    (the record's ``linear_bound``), so the ratio is graded against
+    ``min_fleet_ratio * linear_bound / pools`` — the default 3.5 means
+    "3.5x for 4 pools on a >=4-core host". On a 1-CORE host the leg
+    is SKIPPED with a note, not scaled: N pools there don't just
+    timeshare, they multiply the cache working set on one core
+    (measured: a 4x1024-lane fleet runs ~0.5x of a single pool doing
+    the same closed-loop work — LLC thrash, not wire overhead, which
+    the bitwise remote-vs-local pins separately bound), so no ratio
+    on such a host measures the router. Fleet admission p99
+    (percentiles merged from the pools' raw series) guards placement
+    starvation on every host; pinned failover leaks
+    (``pool_failures`` on any reachable pool) fail outright."""
+    fleet = [r for r in ledger_recs if r.get("tool") == "fleet_bench"]
+    if not fleet:
+        print("check: no fleet_bench record — fleet gate skipped")
+        return 0
+    m = fleet[-1].get("metrics") or {}
+    value, ratio = m.get("value"), m.get("fleet_ratio")
+    pools = m.get("pools")
+    bound = m.get("linear_bound")
+    if not isinstance(value, (int, float)):
+        print("check: FAIL — latest fleet_bench record has no usable "
+              f"value ({value!r})")
+        return 3
+    if ratio is None:
+        print("check: fleet ratio gate skipped — record has no "
+              "single-pool arms (--no-single run)")
+    else:
+        if not isinstance(ratio, (int, float)) \
+                or not isinstance(pools, int) \
+                or not isinstance(bound, (int, float)) or bound <= 0:
+            print("check: FAIL — latest fleet_bench record has an "
+                  f"unusable ratio/pools/linear_bound "
+                  f"({ratio!r}/{pools!r}/{bound!r})")
+            return 3
+        if bound < 2:
+            print(f"check: fleet {value} chain-sweeps/s over {pools} "
+                  f"pools, ratio {ratio:.3f}x recorded — ratio gate "
+                  "SKIPPED on a 1-core host (pools timeshare one "
+                  "core AND multiply its cache working set; no "
+                  "ratio here measures the router — it arms on "
+                  ">=2-core hosts)")
+        else:
+            need = min_fleet_ratio * bound / pools
+            print(f"check: fleet {value} chain-sweeps/s over {pools} "
+                  f"pools, ratio {ratio:.3f}x vs single pool (min "
+                  f"{need:.3f} = {min_fleet_ratio} * linear_bound "
+                  f"{bound}/{pools} pools)")
+            if ratio < need:
+                print(f"check: FAIL — fleet aggregate/single ratio "
+                      f"{ratio:.3f} < {need:.3f} (pool count is not "
+                      "multiplying throughput: check the router "
+                      "placements block and per-pool occupancy rows)")
+                return 2
+    p99 = m.get("admission_p99_ms")
+    if isinstance(p99, (int, float)):
+        print(f"check: fleet admission p99 {p99:.0f} ms (max "
+              f"{max_admission_p99:.0f})")
+        if p99 > max_admission_p99:
+            print(f"check: FAIL — fleet admission p99 {p99:.0f} ms > "
+                  f"{max_admission_p99:.0f} (placement is starving "
+                  "tenants: a pool is hoarding the queue while "
+                  "others idle)")
+            return 2
+    for p in m.get("pools_detail") or []:
+        if p.get("reachable") and p.get("healthy") is False:
+            print(f"check: FAIL — pool {p.get('source')!r} finished "
+                  "the fleet arm unhealthy (pool_failures counted)")
+            return 2
+    r = m.get("router") or {}
+    if r.get("failovers"):
+        print(f"check: note — {r['failovers']} failover(s) during the "
+              "fleet arm (recovered; throughput already reflects the "
+              "recovery cost)")
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--ledger", default=None,
@@ -748,6 +869,26 @@ def main(argv=None):
                          "~37s by design — hence the loose default: "
                          "this is a starvation guard, not a tuning "
                          "target)")
+    ap.add_argument("--min-fleet-ratio", type=float, default=3.5,
+                    metavar="X",
+                    help="fleet gate: minimum aggregate/single-pool "
+                         "throughput ratio the latest fleet_bench "
+                         "record must report, stated for the record's "
+                         "pool count on a host with >= that many "
+                         "cores; graded as min_fleet_ratio * "
+                         "linear_bound/pools, where linear_bound = "
+                         "min(pools, cpu_cores). On a 1-core host the "
+                         "leg is skipped with a note (N pools "
+                         "multiply the cache working set on one core "
+                         "— no ratio there measures the router); "
+                         "skipped too when no fleet record exists")
+    ap.add_argument("--max-fleet-admission-p99", type=float,
+                    default=120000.0, metavar="MS",
+                    help="fleet gate: max tolerated fleet-merged "
+                         "submit->admit p99 (the whole workload is "
+                         "submitted up front, so deliberate queue-wait "
+                         "dominates — this is a placement-starvation "
+                         "guard, not a tuning target)")
     ap.add_argument("--max-trend-drop", type=float, default=25.0,
                     metavar="PCT",
                     help="trend gate: max tolerated drop of a "
@@ -793,10 +934,13 @@ def main(argv=None):
                            args.max_admission_p99)
         rc_faults = check_faults(recs, args.max_fault_rate,
                                  args.min_fault_ratio)
+        rc_fleet = check_fleet(recs, args.min_fleet_ratio,
+                               args.max_fleet_admission_p99)
         rc_trend = check_trend(recs, args.max_trend_drop,
                                window=args.trend_window,
                                points=args.trend_points)
-        return rc or rc_serve or rc_obs or rc_faults or rc_trend
+        return (rc or rc_serve or rc_obs or rc_faults or rc_fleet
+                or rc_trend)
     return 0
 
 

@@ -125,6 +125,15 @@ def main(argv=None):
                          "off arm is what prices it — obs_overhead in "
                          "the record, gated by perf_report "
                          "--max-obs-overhead)")
+    ap.add_argument("--evict-arm", action="store_true",
+                    help="after the headline workload, repeat it with "
+                         "on_converged='evict' on every tenant "
+                         "(ROADMAP 4c): tenants release their lanes "
+                         "the moment the streaming monitor's ESS "
+                         "budget holds instead of serving the full "
+                         "sweep budget — the record gains an 'evict' "
+                         "block with the jobs-per-hour gain at equal "
+                         "delivered ESS (both arms hit --ess-target)")
     ap.add_argument("--ess-target", type=float, default=500.0,
                     help="streaming-monitor ESS budget per monitored "
                          "parameter (arXiv:1611.07056 frames ESS as "
@@ -409,6 +418,51 @@ def main(argv=None):
               f"(mean {obs_off_sps:.1f}) chain-sweeps/s -> overhead "
               f"{obs_overhead * 100:+.2f}%", file=sys.stderr)
 
+    # ---- convergence-eviction arm (ROADMAP 4c) ------------------------
+    # Same workload, every tenant armed on_converged="evict": sweeps
+    # the base arm spends PAST its ESS budget become backfill capacity,
+    # so the same pool clears the same job list faster at the same
+    # delivered ESS. Jobs-per-hour is the honest unit (the aggregate
+    # sweeps/s headline cannot rise — eviction serves FEWER sweeps).
+    evict_block = None
+    if args.evict_arm:
+        emods = {i: {"on_converged": "evict"}
+                 for i in range(args.tenants)}
+        ehandles, ewall, esummary = run_workload(emods)
+        ebad = [h for h in ehandles if h.status != "done"]
+        if ebad:
+            raise RuntimeError(
+                f"{len(ebad)} tenant(s) failed in the evict arm: "
+                + "; ".join(str(h.error) for h in ebad[:3]))
+        base_jph = args.tenants / (wall / 3600.0)
+        evict_jph = args.tenants / (ewall / 3600.0)
+        esweeps = sum(h.sweeps_done for h in ehandles)
+        bsweeps = sum(h.sweeps_done for h in handles)
+        e_ess = [h.progress().get("ess_min") for h in ehandles]
+        e_ess = [v for v in e_ess if isinstance(v, (int, float))]
+        e_conv = sum(1 for h in ehandles
+                     if h.progress().get("converged_at") is not None)
+        evict_block = {
+            "jobs_per_hour_base": round(base_jph, 2),
+            "jobs_per_hour": round(evict_jph, 2),
+            "gain": round(evict_jph / base_jph - 1.0, 4),
+            "wall_s": round(ewall, 3),
+            "converged_evictions":
+                esummary["converged_evictions"],
+            "converged": e_conv,
+            "sweeps_saved_frac": (round(1.0 - esweeps / bsweeps, 4)
+                                  if bsweeps else None),
+            "ess_min_mean": (round(float(np.mean(e_ess)), 1)
+                             if e_ess else None),
+            "ess_target": args.ess_target,
+        }
+        print(f"# evict arm: {evict_jph:.1f} jobs/h vs "
+              f"{base_jph:.1f} base ({evict_block['gain'] * 100:+.1f}%"
+              f" at equal ESS budget; "
+              f"{evict_block['converged_evictions']} early evictions, "
+              f"{evict_block['sweeps_saved_frac']} of sweeps saved)",
+              file=sys.stderr)
+
     # ---- fault-injection arm -----------------------------------------
     faults_block = None
     if args.faults:
@@ -525,6 +579,10 @@ def main(argv=None):
     }
     if faults_block is not None:
         line["faults"] = faults_block
+    if evict_block is not None:
+        # convergence-eviction economics (ROADMAP 4c): jobs-per-hour
+        # at equal delivered ESS, base vs on_converged="evict"
+        line["evict"] = evict_block
     if args.ledger != "":
         try:
             from gibbs_student_t_tpu.obs import ledger as _ledger

@@ -102,7 +102,29 @@ def _fmt(v, nd=1, width=8):
     return f"{v:>{width}}"
 
 
+def _load_aggregate():
+    """obs/aggregate.py by file path (the fleet_status.py trick —
+    keeps jax out of the dashboard)."""
+    import importlib.util
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(os.path.dirname(here), "gibbs_student_t_tpu",
+                        "obs", "aggregate.py")
+    spec = importlib.util.spec_from_file_location("gst_obs_aggregate",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def _render_status(st, out):
+    if "pools" in st and "totals" in st:
+        # a FleetRouter endpoint: the aggregated fleet snapshot
+        # (router placement + failover counts included) — same
+        # renderer as tools/fleet_status.py, so the two dashboards
+        # cannot drift
+        _load_aggregate().render_fleet(st, out)
+        return
     occ = st.get("occupancy_now")
     print(f"serve_top  quanta={st.get('quanta')} "
           f"uptime={st.get('uptime_s', 0):.0f}s "
