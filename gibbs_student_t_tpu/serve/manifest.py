@@ -20,8 +20,11 @@ docs/OBSERVABILITY.md):
   rebuilds an identical pool. The template model + config are pickled
   beside the log (``server.pkl``) — they are numpy pytrees, not JSON.
 - ``admit``   — tenant admission: id, name, seed, niter, nchains,
-  start_sweep, spool_dir, on_divergence, and (for spooled tenants)
-  the pickled model file recovery re-reads.
+  start_sweep, spool_dir, on_divergence, on_converged, the monitor
+  spec (JSON fields — recovery re-arms convergence eviction, so a
+  failed-over ``on_converged='evict'`` tenant still evicts at its
+  convergence boundary), and (for spooled tenants) the pickled model
+  file recovery re-reads.
 - ``checkpoint`` — after every spool append: the tenant's resume point
   (``next_sweep``) — the generation counter recovery resumes from.
 - ``done``    — tenant finalized (status ``done`` or ``failed``).
@@ -128,12 +131,22 @@ class ServerManifest:
             with open(tmp, "wb") as fh:
                 pickle.dump(model, fh)
             os.replace(tmp, os.path.join(self.dir, model_file))
+        mon = getattr(request, "monitor", None)
         self.record(
             "admit", tenant=tenant_id, name=request.name,
             seed=request.seed, niter=request.niter,
             nchains=request.nchains, start_sweep=request.start_sweep,
             spool_dir=request.spool_dir,
-            on_divergence=request.on_divergence, model_file=model_file)
+            on_divergence=request.on_divergence,
+            on_converged=getattr(request, "on_converged", "none"),
+            monitor=(None if mon is None else {
+                "params": (None if mon.params is None
+                           else [p if isinstance(p, str) else int(p)
+                                 for p in mon.params]),
+                "ess_target": mon.ess_target,
+                "rhat_target": mon.rhat_target,
+                "every": mon.every, "min_rows": mon.min_rows}),
+            model_file=model_file)
 
     def record_checkpoint(self, tenant_id: int, next_sweep: int) -> None:
         self.record("checkpoint", tenant=tenant_id,

@@ -194,6 +194,31 @@ class TenantMonitor:
                     and self._rows >= self.spec.min_rows):
                 self._evaluate(now, int(sweep_end))
 
+    def backfill(self, x_rows: np.ndarray, sweep_end: int,
+                 updates: int = 0) -> None:
+        """Seed the window with rows recorded BEFORE this monitor
+        existed — a resumed tenant's spooled prefix. One
+        evaluation-free fold (append + Welford) plus the update count
+        the prefix's quanta would have advanced, so the first
+        post-resume windowed evaluation sees the same accumulated
+        rows (and the same ``every`` phase) as the uninterrupted
+        run's evaluation at that sweep — which is what keeps a
+        recovered ``on_converged='evict'`` tenant's eviction
+        boundary, and with it the failover bitwise claim, intact."""
+        x_rows = np.asarray(x_rows)
+        if x_rows.ndim != 3 or x_rows.shape[1] != self.nchains:
+            raise ValueError(
+                f"monitor backfill wants (rows, nchains="
+                f"{self.nchains}, p), got {x_rows.shape}")
+        if x_rows.shape[2] != len(self.param_idx):
+            x_rows = x_rows[:, :, self.param_idx]
+        with self._lock:
+            self._append(np.asarray(x_rows, np.float32))
+            self._welford(x_rows)
+            self._updates += int(updates)
+            self._snap["rows"] = self._rows
+            self._snap["sweeps"] = int(sweep_end)
+
     def _evaluate(self, now: float, sweep_end: int) -> None:
         """The windowed diagnostics over the accumulated buffer —
         exactly the post-hoc ``parallel/diagnostics`` forms, so

@@ -298,6 +298,11 @@ class RoutedHandle:
                 self._rebound.clear()
                 if not self._rebound.wait(
                         timeout=self.router.failover_timeout):
+                    if self._gen != gen:
+                        # a rebind landed between the gen check and
+                        # clear() (its set() was discarded): the
+                        # failover DID happen — retry, don't raise
+                        continue
                     raise ConnectionError(
                         f"pool {self.pool_idx} unreachable and no "
                         f"failover within "
@@ -649,11 +654,19 @@ class FleetRouter:
     @staticmethod
     def _finished(rh: RoutedHandle) -> bool:
         """Best-effort 'already resolved' check that must not touch
-        the dead pool's wire."""
+        the dead pool's wire. A streamed RemoteTenantHandle on a
+        crashed pool has ``_done`` SET — its stream reader resolved it
+        to a ConnectionError before the watch thread noticed the death
+        — so a severed-stream resolution counts as UNFINISHED: that
+        handle is a failover victim to rebind/resubmit, not a served
+        tenant."""
         inner = rh._inner
         ev = getattr(inner, "_done", None)
         if ev is not None and hasattr(ev, "is_set"):
-            return ev.is_set()
+            if not ev.is_set():
+                return False
+            return not isinstance(getattr(inner, "_error", None),
+                                  ConnectionError)
         return False
 
 

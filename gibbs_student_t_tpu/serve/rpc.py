@@ -541,9 +541,21 @@ class RpcServer:
             return True
         if stream:
             chunks = _queue.Queue(maxsize=self._chunk_queue)
+            detached = threading.Event()
 
             def on_chunk(handle, sweep_end, records):
-                chunks.put((sweep_end, records))
+                # Block (the backpressure contract) only while the
+                # push loop below still drains the queue. Once the
+                # connection is gone — client disconnect, injected
+                # sever — ``detached`` turns this callback into a
+                # no-op: a dead wire must never wedge the pool's
+                # shared drain worker behind a full queue.
+                while not detached.is_set():
+                    try:
+                        chunks.put((sweep_end, records), timeout=0.2)
+                        return
+                    except _queue.Full:
+                        continue
 
             request.on_chunk = on_chunk
         try:
@@ -558,24 +570,31 @@ class RpcServer:
         if not stream:
             return True
         # -- dedicated streaming push loop ------------------------------
-        while True:
-            try:
-                sweep_end, records = chunks.get(timeout=0.05)
-            except _queue.Empty:
-                if h.done() and chunks.empty():
-                    break
-                continue
-            try:
-                _faults.fire("rpc_sever",
-                             tenant=request.name
-                             if request.name is not None
-                             else h.tenant_id)
-            except Exception:  # noqa: BLE001 - abrupt sever
-                return False
-            send_frame(sock, {"op": "chunk", "sweep_end": sweep_end,
-                              "records": {f: np.asarray(a)
-                                          for f, a in records.items()}},
-                       self.max_frame)
+        try:
+            while True:
+                try:
+                    sweep_end, records = chunks.get(timeout=0.05)
+                except _queue.Empty:
+                    if h.done() and chunks.empty():
+                        break
+                    continue
+                try:
+                    _faults.fire("rpc_sever",
+                                 tenant=request.name
+                                 if request.name is not None
+                                 else h.tenant_id)
+                except Exception:  # noqa: BLE001 - abrupt sever
+                    return False
+                send_frame(sock, {"op": "chunk", "sweep_end": sweep_end,
+                                  "records": {f: np.asarray(a)
+                                              for f, a in
+                                              records.items()}},
+                           self.max_frame)
+        finally:
+            # every exit — clean finish, sever, or a send_frame error
+            # on a dead client — detaches the callback; the tenant
+            # keeps running and its result stays fetchable by id
+            detached.set()
         self._send_result(sock, h, req.get("timeout"))
         return False
 
@@ -763,7 +782,9 @@ class RemoteChainServer:
         must never hang on a dead wire)."""
         try:
             while True:
-                body = recv_frame(sock)
+                # the client's configured ceiling, not the env default
+                # — chunk/result frames obey the same limit as _call
+                body = recv_frame(sock, h.client.max_frame)
                 if body.get("op") == "chunk":
                     try:
                         on_chunk(h, body["sweep_end"], body["records"])

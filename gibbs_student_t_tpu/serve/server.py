@@ -685,6 +685,8 @@ class ChainServer:
                     resolve_params(req.monitor, t._ma.param_names),
                     param_names=t._ma.param_names,
                     record_thin=t.record_thin)
+                if req.spool_dir is not None and req.start_sweep > 0:
+                    self._backfill_monitor(monitor, req)
             ma = _localize_names(req.ma)
             if ma.row_mask is not None:
                 raise ValueError("tenant models must be unpadded; the "
@@ -1485,6 +1487,35 @@ class ChainServer:
         if tele is not None:
             self._accumulate_tele(handle, slot, tele)
         self._feed_monitor(handle, slot, records, wire_cols, sweep_end)
+
+    def _backfill_monitor(self, monitor: TenantMonitor, req) -> None:
+        """A resumed monitored tenant re-arms its monitor over the
+        FULL recorded prefix, not just post-resume rows: fold the
+        spooled ``x`` rows below the resume point in one
+        evaluation-free pass, so a recovered ``on_converged='evict'``
+        tenant converges — and evicts — at the same sweep as the
+        uninterrupted run (the failover bitwise claim). Failure keeps
+        the monitor contract: warn and serve with a fresh window,
+        never a tenant fault."""
+        from gibbs_student_t_tpu.utils.spool import load_spool_prefix
+
+        try:
+            loaded = load_spool_prefix(req.spool_dir, "x",
+                                       req.start_sweep)
+            if loaded is None:
+                return   # light-record run: x was never spooled
+            rows, base = loaded
+            if not len(rows):
+                return
+            quantum = max(int(self.pool.quantum), 1)
+            monitor.backfill(
+                rows, req.start_sweep,
+                updates=(req.start_sweep - base) // quantum)
+        except Exception as e:  # noqa: BLE001 - observability contract
+            warnings.warn(
+                f"monitor backfill from {req.spool_dir!r} failed "
+                f"({type(e).__name__}: {e}); the monitor window "
+                "restarts at the resume point", RuntimeWarning)
 
     def _feed_monitor(self, handle: TenantHandle, slot: TenantSlot,
                       records, wire_cols, sweep_end: int) -> None:
@@ -2371,11 +2402,24 @@ class ChainServer:
                 srv._tenant_names[tid] = rec.get("name")
                 handles[key] = h
                 continue
+            # the convergence policy rides the journal too: without
+            # it a failed-over on_converged='evict' tenant would
+            # serve its full niter budget instead of evicting at its
+            # convergence boundary — a different result than the
+            # uninterrupted run (the monitor itself is re-armed at
+            # admission and backfilled from the spooled prefix, see
+            # _prepare, so the eviction boundary is preserved)
+            mon = rec.get("monitor")
+            if mon is not None:
+                mon = MonitorSpec(**{k: v for k, v in mon.items()
+                                     if v is not None})
             handles[key] = srv.submit(TenantRequest(
                 ma=ma, niter=remaining, nchains=rec["nchains"],
                 seed=rec["seed"], state=state, start_sweep=next_sweep,
                 spool_dir=rec["spool_dir"], name=rec.get("name"),
-                on_divergence=rec.get("on_divergence") or "none"))
+                on_divergence=rec.get("on_divergence") or "none",
+                on_converged=rec.get("on_converged") or "none",
+                monitor=mon))
         # the resubmissions above are journaled in the NEW epoch, so
         # everything before it is dead weight a future recovery would
         # re-parse (and the admissions carry pickled models) — compact

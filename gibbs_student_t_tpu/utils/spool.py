@@ -203,6 +203,31 @@ def load_spool(path: str) -> ChainResult:
     return ChainResult(**chains, stats=cols)
 
 
+def load_spool_prefix(path: str, field: str, upto_sweep: int):
+    """``(rows, base)`` for one spooled field: its recorded rows
+    strictly below sweep ``upto_sweep`` (orphans from a crash
+    mid-append excluded, exactly as a resume truncates them) plus the
+    spool's base sweep — the prefix a resumed tenant's convergence
+    monitor backfills from. ``None`` when the field was never spooled
+    (record="light" runs) or no meta exists yet."""
+    from gibbs_student_t_tpu import native
+
+    meta_path = os.path.join(path, "meta.json")
+    fpath = os.path.join(path, field + ".spool")
+    if not (os.path.exists(meta_path) and os.path.exists(fpath)):
+        return None
+    with open(meta_path) as fh:
+        meta = json.load(fh)
+    if field not in meta.get("fields", []):
+        return None
+    base = meta.get("base", 0)
+    keep = (upto_sweep - base) // meta.get("record_thin", 1)
+    if keep <= 0:
+        return None
+    rows = native.read_spool(fpath)
+    return rows[:min(keep, len(rows))], base
+
+
 def load_spool_state(path: str):
     """(state, next_sweep, seed) from a spool directory's checkpoint."""
     return load_checkpoint(os.path.join(path, "state.npz"))

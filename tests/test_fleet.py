@@ -308,6 +308,56 @@ def test_manifest_compaction_invariants(demo, tmp_path):
     assert compact_manifest(man) == len(read_manifest(man)) == kept
 
 
+@pytest.mark.skipif(not _native_available(),
+                    reason="spooling needs the native library")
+def test_recovery_restores_monitor_and_eviction_policy(demo, tmp_path):
+    """The request-replay determinism contract for eviction tenants:
+    the admit record journals the monitor spec and on_converged, and
+    recover() resubmits with BOTH — a failed-over
+    ``on_converged='evict'`` tenant still watches (and would still
+    evict at) its convergence boundary instead of silently serving
+    its full budget. The re-armed monitor's window is backfilled from
+    the spooled prefix, so post-resume evaluations see the same
+    accumulated rows as the uninterrupted run's."""
+    from gibbs_student_t_tpu.serve.manifest import outstanding_tenants
+
+    ma, cfg = demo
+    man = str(tmp_path / "man_mon")
+    spool = str(tmp_path / "sM")
+    srv = ChainServer(ma, cfg, nlanes=32, quantum=5, record="full",
+                      pipeline=False, manifest_dir=man)
+    srv.submit(TenantRequest(
+        ma=ma, niter=20, nchains=16, seed=3, name="M",
+        spool_dir=spool,
+        monitor=MonitorSpec(params=[0], ess_target=1e9, every=2),
+        on_converged="evict"))
+    for _ in range(2):
+        srv.step()   # 2 of 4 quanta, then the "process dies"
+    del srv
+
+    rec, _ = outstanding_tenants(man)
+    assert rec[0]["on_converged"] == "evict"
+    assert rec[0]["monitor"] == {"params": [0], "ess_target": 1e9,
+                                 "rhat_target": None, "every": 2,
+                                 "min_rows": 8}
+    srv2, handles = ChainServer.recover(man)
+    req = handles["M"].request
+    assert req.on_converged == "evict"
+    assert req.monitor is not None
+    assert req.monitor.ess_target == 1e9 and req.monitor.every == 2
+    assert req.monitor.params == [0]
+    srv2.run()
+    srv2.close()
+    res = handles["M"].result()
+    # the unreachable target never held: full budget, no spurious
+    # evict — and the final monitor window spans the FULL 20 recorded
+    # rows (10 backfilled from the spool + 10 post-resume), not just
+    # the resumed half
+    assert np.asarray(res.chain).shape[0] == 20
+    assert res.stats["converged_at"] is None
+    assert res.stats["monitor"]["rows"] == 20
+
+
 @pytest.mark.slow
 @pytest.mark.skipif(not _native_available(),
                     reason="spooling needs the native library")

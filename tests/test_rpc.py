@@ -295,6 +295,70 @@ def test_rpc_sever_closes_stream_and_result_survives(stub_rpc):
     assert h2.result(timeout=10)["seed"] == 5
 
 
+def test_severed_stream_detaches_server_callback():
+    """A connection that dies mid-stream must DETACH the server-side
+    on_chunk: the tenant keeps producing chunks with nobody draining
+    the bounded per-stream queue, and a blocking put there would wedge
+    the pool's shared drain worker — every co-resident tenant with it.
+    Pinned with more chunks than queue slots: the producer finishes,
+    and the result stays fetchable over a fresh connection."""
+    stub = _StubServer(chunks=30)
+    rpc = RpcServer(stub, chunk_queue=4)
+    cli = RemoteChainServer(rpc.address, timeout=10.0)
+    try:
+        with faults_mod.inject(
+                faults_mod.FaultSpec("rpc_sever", tenant="tW",
+                                     after=1)):
+            h = cli.submit(TenantRequest(
+                ma={"m": 1}, niter=10, nchains=4, seed=11, name="tW",
+                on_chunk=lambda hh, s, r: None))
+            with pytest.raises(ConnectionError, match="severed"):
+                h.result(timeout=10)
+        # the producer (the drain worker in a real pool) must not be
+        # wedged behind the dead stream's full queue: the tenant
+        # finishes and a fresh handle fetches its result
+        from gibbs_student_t_tpu.serve.rpc import RemoteTenantHandle
+
+        h2 = RemoteTenantHandle(cli, h.tenant_id, h.request)
+        assert h2.result(timeout=10)["seed"] == 11
+    finally:
+        rpc.close()
+
+
+def test_stream_reader_honors_client_max_frame(monkeypatch):
+    """A client constructed with an explicit frame ceiling applies it
+    to streamed chunk/result frames too — not the env default, which
+    would spuriously sever streams carrying frames between the two
+    limits."""
+    monkeypatch.setenv("GST_RPC_MAX_FRAME", "2048")
+    big = 8 * 1024 * 1024
+    stub = _StubServer(chunks=2)
+    # chunk frames ≈ 40 KiB: over the env default, under the explicit
+    stub_submit = stub.submit
+
+    def submit_big(request, timeout=None):
+        orig = request.on_chunk
+
+        def wrap(h, s, r):
+            orig(h, s, {"x": np.zeros((5, 2048), np.float32)})
+
+        request.on_chunk = wrap if orig is not None else None
+        return stub_submit(request, timeout)
+
+    stub.submit = submit_big
+    rpc = RpcServer(stub, max_frame=big)
+    cli = RemoteChainServer(rpc.address, timeout=10.0, max_frame=big)
+    got = []
+    try:
+        h = cli.submit(TenantRequest(
+            ma={"m": 1}, niter=10, nchains=4, seed=13, name="tF",
+            on_chunk=lambda hh, s, r: got.append(r["x"].shape)))
+        assert h.result(timeout=10)["seed"] == 13
+        assert got == [(5, 2048), (5, 2048)]
+    finally:
+        rpc.close()
+
+
 # ---------------------------------------------------------------------------
 # FleetRouter placement + failover logic over fake pools
 # ---------------------------------------------------------------------------
@@ -495,3 +559,71 @@ def test_router_failover_rebinds_and_resubmits():
     snap = r.fleet_status()
     assert snap["router"]["failovers"] == 1
     r.close()
+
+
+def test_finished_counts_severed_stream_as_victim():
+    """A streamed RemoteTenantHandle on a crashed pool has _done SET
+    (its stream reader resolved it to a ConnectionError before the
+    watch thread saw the death) — the failover victim filter must NOT
+    mistake that for a served tenant, or the handle is never
+    rebound/resubmitted and its caller waits out the full
+    failover_timeout for nothing."""
+    from gibbs_student_t_tpu.serve.router import FleetRouter, RoutedHandle
+
+    req = TenantRequest(ma={}, niter=5, nchains=4, name="v")
+    done = _StubHandle(1, req)
+    done._finish({"ok": True})
+    rh_done = RoutedHandle(None, req, 0, done)
+    assert FleetRouter._finished(rh_done) is True
+    severed = _StubHandle(2, req)
+    severed._error = ConnectionError("stream severed")
+    severed._done.set()
+    rh_severed = RoutedHandle(None, req, 0, severed)
+    assert FleetRouter._finished(rh_severed) is False
+    # a handle resolved to a TENANT failure is genuinely finished
+    failed = _StubHandle(3, req)
+    failed._error = RuntimeError("rejected")
+    failed._done.set()
+    assert FleetRouter._finished(RoutedHandle(None, req, 0,
+                                              failed)) is True
+
+
+def test_retryable_rechecks_generation_after_wait_timeout():
+    """The lost-wakeup race: a rebind landing between _retryable's gen
+    check and its _rebound.clear() has its set() discarded — after the
+    wait times out the handle must re-check the generation and retry
+    on the rebound inner instead of raising a ConnectionError for a
+    failover that DID happen."""
+    from gibbs_student_t_tpu.serve.router import RoutedHandle
+
+    class _Router:
+        failover_timeout = 0.05
+
+    req = TenantRequest(ma={}, niter=5, nchains=4, name="r")
+    rh = RoutedHandle(_Router(), req, 0, "old")
+
+    class _RacingEvent:
+        """clear() lands the rebind first — so its set() is exactly
+        the wakeup the real clear() would discard — then reports an
+        unset event whose wait() times out."""
+
+        def clear(self):
+            RoutedHandle._rebind(rh, 1, "new")
+
+        def wait(self, timeout=None):
+            return False
+
+        def set(self):
+            pass
+
+    rh._rebound = _RacingEvent()
+    calls = []
+
+    def fn(inner):
+        calls.append(inner)
+        if inner == "old":
+            raise ConnectionError("severed")
+        return "served"
+
+    assert rh._retryable(fn) == "served"
+    assert calls == ["old", "new"]
