@@ -254,6 +254,18 @@ class FusedConsts(NamedTuple):
 _RECORD_FIELDS = ("x", "b", "z", "theta", "alpha", "df", "pout",
                   "acc_white", "acc_hyper")
 
+# The systematic-scan block order of ``_sweep`` (white-x → hyper-x →
+# b → θ → z → α → ν) splits the recorded fields at the partial-scan
+# point AFTER the coefficient draw: a mid-scan state carries the NEW
+# values of everything the scan has already updated and the OLD values
+# of everything it has not. Recycling Gibbs (arXiv:1611.07056;
+# parallel/recycle.py) reconstructs those partial-scan states from
+# adjacent recorded rows — these two groups are the reconstruction
+# rule, and they must track ``_sweep``'s block order if it ever
+# changes (pinned in tests/test_recycle.py against a tiny run).
+RECYCLE_EARLY_FIELDS = ("x", "b", "acc_white", "acc_hyper")
+RECYCLE_LATE_FIELDS = ("z", "theta", "alpha", "df", "pout")
+
 # record="compact": device->host transport dtypes for the bulky recorded
 # fields. z is exactly 0/1 so it is bit-packed (8 indicators per byte,
 # lossless — unpacked bit-exactly on host); pout is a probability

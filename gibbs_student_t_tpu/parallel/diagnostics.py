@@ -64,11 +64,25 @@ def autocorr_time(x: np.ndarray, c: float = 5.0) -> float:
     return float(autocorr_time_batch(x[:, None], c)[0])
 
 
-def ess_per_param(window: np.ndarray) -> np.ndarray:
+def ess_per_param(window: np.ndarray,
+                  row_class: np.ndarray | None = None) -> np.ndarray:
     """(p,) total effective sample size per parameter over a
     (rows, nchains, p) window: chains pooled, each discounted by its
-    autocorrelation time, all nchains*p columns in one batched FFT."""
+    autocorrelation time, all nchains*p columns in one batched FFT.
+
+    ``row_class`` (parallel/recycle.py) marks recycled partial-scan
+    rows in an interleaved window; they are DROPPED here before the
+    autocorrelation pass. Each coordinate updates once per scan, so a
+    recycled row duplicates its per-param value from an adjacent
+    scan-end row — keeping duplicates would double the row count AND
+    the measured τ, an estimator no-op paid for with a 2× FFT
+    (recycling buys cross-block moments, never per-param ESS; see
+    recycle.py's module docs, pinned in tests/test_recycle.py)."""
     window = np.asarray(window, dtype=np.float64)
+    if row_class is not None:
+        from gibbs_student_t_tpu.parallel.recycle import ROW_SCAN_END
+
+        window = window[np.asarray(row_class) == ROW_SCAN_END]
     rows, nchains, p = window.shape
     taus = autocorr_time_batch(window.reshape(rows, nchains * p))
     return (rows / taus).reshape(nchains, p).sum(axis=0)
@@ -103,11 +117,21 @@ def gelman_rubin(chains: np.ndarray) -> float:
     return float(gelman_rubin_per_param(chains[:, :, None])[0])
 
 
-def split_rhat_per_param(window: np.ndarray) -> np.ndarray:
+def split_rhat_per_param(window: np.ndarray,
+                         row_class: np.ndarray | None = None
+                         ) -> np.ndarray:
     """(p,) split-R-hat over a ``(rows, nchains, p)`` window: every
     chain halved (within-chain drift shows up as cross-half spread),
-    all parameters in one batched :func:`gelman_rubin_per_param`."""
+    all parameters in one batched :func:`gelman_rubin_per_param`.
+    ``row_class`` drops recycled partial-scan rows first (the
+    :func:`ess_per_param` duplicate argument — per-param spread gains
+    nothing from rows whose per-param values repeat their
+    neighbours')."""
     window = np.asarray(window, dtype=np.float64)
+    if row_class is not None:
+        from gibbs_student_t_tpu.parallel.recycle import ROW_SCAN_END
+
+        window = window[np.asarray(row_class) == ROW_SCAN_END]
     n = window.shape[0] // 2
     split = np.concatenate([window[:n], window[n:2 * n]], axis=1)
     return gelman_rubin_per_param(split)

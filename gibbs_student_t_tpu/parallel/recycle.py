@@ -1,0 +1,176 @@
+"""Recycling Gibbs: partial-scan states as extra posterior rows.
+
+Recycling Gibbs (arXiv:1611.07056) observes that a systematic-scan
+Gibbs sampler leaves the target invariant after EVERY block update, not
+just at scan boundaries — so the intermediate ("partial-scan") states
+the sweep already computes are valid posterior samples, and averaging
+estimators over all of them can only lower variance (the paper's Thm 1
+Rao-Blackwellization argument over the scan ordering).
+
+This sampler's scan updates each recorded field in exactly one block
+per sweep (backends/jax_backend.py ``_sweep``: white-x → hyper-x → b →
+θ → z → α → ν), which has a consequence this module exploits and its
+docs are honest about:
+
+- **The partial-scan states are free.** A mid-scan state's fields are
+  each equal to the SAME field in an adjacent recorded scan-end row:
+  blocks already updated this sweep carry the next row's value, blocks
+  not yet updated carry the previous row's. The recycled rows are
+  therefore *reconstructed* from the recorded chain — zero extra
+  kernel work, zero extra wire bytes (the reason recycling is
+  "near-free" for systematic scans).
+- **Per-parameter marginals gain no new draws.** Each coordinate takes
+  one new value per sweep whether or not partial states are kept, so
+  per-param ESS is unchanged (pinned in tests/test_recycle.py) — the
+  streaming monitor's per-param ESS verdicts deliberately ignore
+  recycled rows. The genuine variance reduction is on **cross-block
+  functionals** (e.g. outlier-count × noise-amplitude moments): the
+  recycled stream averages over combinations like (x', z) that the
+  scan-end stream never materializes, which is exactly the estimator
+  family the paper's experiments improve.
+
+The serve drain tags recycled rows with a row-class array
+(``ROW_SCAN_END`` / ``ROW_RECYCLED``) so spool / ``on_chunk`` / result
+consumers keep their sweep-aligned contracts untouched and opt into
+the interleaved view through :func:`interleave` /
+:func:`recycled_result`.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from gibbs_student_t_tpu.backends.jax_backend import (
+    RECYCLE_EARLY_FIELDS,
+    RECYCLE_LATE_FIELDS,
+)
+
+#: row-class codes (uint8): a recorded scan-end state vs a
+#: reconstructed partial-scan ("recycled") state
+ROW_SCAN_END = 0
+ROW_RECYCLED = 1
+
+#: result-field name → record-field name (utils/spool._CHAIN_KEYS,
+#: inverted) for :func:`recycled_result`
+_RESULT_KEYS = {
+    "chain": "x", "bchain": "b", "zchain": "z", "thetachain": "theta",
+    "alphachain": "alpha", "dfchain": "df", "poutchain": "pout",
+}
+
+
+def row_class_pattern(rows: int, carry_in: bool) -> np.ndarray:
+    """The (2*rows-1(+1),) uint8 row-class tag for one drained quantum
+    of ``rows`` scan-end rows: scan-end rows interleaved with the
+    recycled mid-scan rows BETWEEN them. ``carry_in`` prepends the
+    boundary mid-row that straddles the previous quantum's last row
+    (the cross-quantum tail the serve drain carries) — the recycled
+    stream is then a strict prefix of an uninterrupted run's (the
+    cancel/evict contract, tests/test_recycle.py)."""
+    if rows < 1:
+        return np.zeros(0, np.uint8)
+    out = np.zeros(2 * rows - 1 + (1 if carry_in else 0), np.uint8)
+    out[(1 if carry_in else 0) + 1::2] = ROW_RECYCLED
+    if carry_in:
+        out[0] = ROW_RECYCLED
+    return out
+
+
+def interleave(cols: Dict[str, np.ndarray],
+               prev_tail: Optional[Dict[str, np.ndarray]] = None,
+               ) -> Tuple[Dict[str, np.ndarray], np.ndarray,
+                          Dict[str, np.ndarray]]:
+    """Build the recycled (interleaved) view of one span of rows-major
+    records ``{field: (rows, nchains, ...)}``.
+
+    Returns ``(cols_out, row_class, tail)``: ``cols_out`` has
+    ``2*rows-1`` rows (``+1`` with a ``prev_tail``) alternating
+    scan-end and recycled partial-scan states; ``row_class`` tags them;
+    ``tail`` is the last scan-end row per field — feed it back as the
+    next span's ``prev_tail`` to keep the stream seamless across
+    quantum boundaries. A recycled row takes EARLY-group fields (x, b,
+    acceptance — updated before the partial-scan point) from the NEXT
+    scan-end row and LATE-group fields (θ, z, α, pout, ν) from the
+    PREVIOUS one. Fields outside both groups (unknown extras) follow
+    the late group (conservative: a consumer sees them change only at
+    scan boundaries)."""
+    fields = list(cols)
+    rows = len(next(iter(cols.values()))) if fields else 0
+    if rows == 0:
+        return dict(cols), np.zeros(0, np.uint8), dict(prev_tail or {})
+    carry = prev_tail is not None and bool(prev_tail)
+    out = {}
+    for f, a in cols.items():
+        a = np.asarray(a)
+        n_out = 2 * rows - 1 + (1 if carry else 0)
+        buf = np.empty((n_out,) + a.shape[1:], a.dtype)
+        base = 0
+        if carry:
+            # boundary mid-row: early fields from THIS span's first
+            # row, late fields from the previous span's final row
+            buf[0] = (a[0] if f in RECYCLE_EARLY_FIELDS
+                      else prev_tail[f])
+            base = 1
+        buf[base::2] = a
+        if rows > 1:
+            if f in RECYCLE_EARLY_FIELDS:
+                buf[base + 1::2] = a[1:]
+            else:
+                buf[base + 1::2] = a[:-1]
+        out[f] = buf
+    tail = {f: np.array(np.asarray(a)[-1]) for f, a in cols.items()}
+    return out, row_class_pattern(rows, carry), tail
+
+
+def recycle_weights(row_class: np.ndarray) -> np.ndarray:
+    """Per-row weights of the recycling estimator over an interleaved
+    stream: uniform over all partial-scan states (the paper's equal-
+    weight average over the scan ordering), normalized to sum to 1.
+    Shaped for broadcasting against ``(rows, ...)`` windows."""
+    row_class = np.asarray(row_class)
+    n = row_class.shape[0]
+    if n == 0:
+        return np.zeros(0)
+    return np.full(n, 1.0 / n)
+
+
+def weighted_moments(window: np.ndarray, weights: np.ndarray
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+    """Weighted (mean, variance) over the leading row axis — the
+    recycling estimator's moment form (weights from
+    :func:`recycle_weights`). Plain uniform weights reproduce
+    ``window.mean(axis=0)`` / ``window.var(axis=0)`` exactly."""
+    window = np.asarray(window, np.float64)
+    w = np.asarray(weights, np.float64)
+    w = w / w.sum()
+    wb = w.reshape((-1,) + (1,) * (window.ndim - 1))
+    mean = (wb * window).sum(axis=0)
+    var = (wb * (window - mean) ** 2).sum(axis=0)
+    return mean, var
+
+
+def functional_ess(values: np.ndarray) -> float:
+    """ESS of a scalar functional's sample stream ``(rows,)`` or
+    ``(rows, nchains)`` — evaluate a cross-block functional on the
+    interleaved stream vs the scan-end stream to measure the recycling
+    multiplier (tools/serve_bench.py's recycle block)."""
+    from gibbs_student_t_tpu.parallel.diagnostics import (
+        effective_sample_size,
+    )
+
+    return effective_sample_size(np.asarray(values, np.float64))
+
+
+def recycled_result(res) -> Tuple[Dict[str, np.ndarray], np.ndarray]:
+    """The interleaved recycled view of a finished
+    ``ChainResult``: ``({field: (rows', nchains, ...)}, row_class)``
+    over every non-empty chain field. The result's own arrays are
+    untouched (the result contract: chain arrays are scan-end rows,
+    bitwise identical with the gate off)."""
+    cols = {}
+    for res_key, field in _RESULT_KEYS.items():
+        a = np.asarray(getattr(res, res_key))
+        if a.size:
+            cols[field] = a
+    return interleave(cols)[:2]

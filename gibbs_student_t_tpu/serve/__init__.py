@@ -35,8 +35,11 @@ from gibbs_student_t_tpu.serve.scheduler import (
     TenantRequest,
 )
 from gibbs_student_t_tpu.serve.server import ChainServer
+from gibbs_student_t_tpu.serve.warm import WarmStartFit, WarmStartSpec
 
 __all__ = [
+    "WarmStartSpec",
+    "WarmStartFit",
     "GROUP_LANES",
     "SlotPool",
     "TenantRequest",

@@ -54,6 +54,11 @@ from typing import Any, Dict, List, Optional, Tuple
 
 MANIFEST_NAME = "manifest.jsonl"
 SERVER_PICKLE = "server.pkl"
+#: content-addressed model store subdirectory (ROADMAP 1c): one
+#: ``<sha256>.pkl`` per distinct tenant model, referenced by digest
+#: from admit records — resubmission and failover stop re-appending
+#: identical pickles to the manifest directory
+MODELS_DIR = "models"
 
 
 def _append_line(path: str, record: Dict[str, Any]) -> None:
@@ -122,15 +127,35 @@ class ServerManifest:
 
     # -- tenants --------------------------------------------------------
 
-    def record_admit(self, tenant_id: int, request,
-                     model=None) -> None:
-        model_file = None
-        if model is not None:
-            model_file = f"model_{self.epoch}_{tenant_id}.pkl"
-            tmp = os.path.join(self.dir, model_file + ".tmp")
+    def store_model(self, model) -> Tuple[str, str]:
+        """Content-addressed model store (ROADMAP 1c): pickle the
+        model, hash it, and persist ONE ``models/<digest>.pkl`` blob
+        per distinct model — a resubmitted or failed-over tenant's
+        admit references the digest instead of appending another
+        pickle, so the manifest directory stops growing linearly in
+        admissions of the same model. Returns ``(digest,
+        relative_path)``; the write is atomic and skipped on a digest
+        hit."""
+        import hashlib
+
+        blob = pickle.dumps(model, protocol=4)
+        digest = hashlib.sha256(blob).hexdigest()
+        rel = os.path.join(MODELS_DIR, digest + ".pkl")
+        path = os.path.join(self.dir, rel)
+        if not os.path.exists(path):
+            os.makedirs(os.path.join(self.dir, MODELS_DIR),
+                        exist_ok=True)
+            tmp = path + ".tmp"
             with open(tmp, "wb") as fh:
-                pickle.dump(model, fh)
-            os.replace(tmp, os.path.join(self.dir, model_file))
+                fh.write(blob)
+            os.replace(tmp, path)
+        return digest, rel
+
+    def record_admit(self, tenant_id: int, request,
+                     model=None, warm=None) -> None:
+        model_file = model_digest = None
+        if model is not None:
+            model_digest, model_file = self.store_model(model)
         mon = getattr(request, "monitor", None)
         self.record(
             "admit", tenant=tenant_id, name=request.name,
@@ -146,7 +171,8 @@ class ServerManifest:
                 "ess_target": mon.ess_target,
                 "rhat_target": mon.rhat_target,
                 "every": mon.every, "min_rows": mon.min_rows}),
-            model_file=model_file)
+            model_file=model_file, model_digest=model_digest,
+            warm=warm)
 
     def record_checkpoint(self, tenant_id: int, next_sweep: int) -> None:
         self.record("checkpoint", tenant=tenant_id,
@@ -301,4 +327,16 @@ def compact_manifest(manifest_dir: str, keep_lost: bool = True) -> int:
                 os.unlink(os.path.join(manifest_dir, name))
             except OSError:
                 pass
+    # the content-addressed store (ROADMAP 1c): digests no
+    # outstanding admit references are dead weight too
+    mdir = os.path.join(manifest_dir, MODELS_DIR)
+    if os.path.isdir(mdir):
+        for name in os.listdir(mdir):
+            if (name.endswith(".pkl")
+                    and os.path.join(MODELS_DIR, name)
+                    not in keep_models):
+                try:
+                    os.unlink(os.path.join(mdir, name))
+                except OSError:
+                    pass
     return len(out)

@@ -134,6 +134,15 @@ class TenantMonitor:
         self._w_m2 = np.zeros_like(self._w_mean)
         self._updates = 0
         self._t_first: Optional[float] = None
+        # recycling Gibbs (round 17; parallel/recycle.py): count of
+        # partial-scan rows folded into the weighted Welford moments.
+        # The windowed ESS / split-R-hat deliberately stay on the
+        # scan-end buffer — per-param values in recycled rows repeat
+        # their neighbours' (each coordinate updates once per scan),
+        # so including them would double rows AND measured τ for the
+        # same verdict at 2× the FFT cost (pinned in
+        # tests/test_recycle.py).
+        self._recycled = 0
         self._snap: Dict[str, object] = {
             "rows": 0, "sweeps": 0, "params": self.param_names,
             "ess": None, "ess_min": None, "rhat": None, "rhat_max": None,
@@ -153,27 +162,47 @@ class TenantMonitor:
         self._buf[self._rows:need] = rows
         self._rows = need
 
-    def _welford(self, rows: np.ndarray) -> None:
+    def _welford(self, rows: np.ndarray,
+                 weights: Optional[np.ndarray] = None) -> None:
         """Chan's batched Welford merge: fold the new rows' count /
         mean / M2 into the running moments in one vectorized step —
-        O(new rows) work with no per-row Python loop."""
+        O(new rows) work with no per-row Python loop. ``weights``
+        (per-row, the recycling estimator's partial-scan
+        multiplicities) makes the fold the WEIGHTED Chan merge —
+        integer weights are exactly equivalent to duplicating rows."""
         rows = np.asarray(rows, np.float64)            # (nb, nchains, p)
         nb = rows.shape[0]
         if nb == 0:
             return
-        bm = rows.mean(axis=0)
-        bm2 = ((rows - bm) ** 2).sum(axis=0)
-        tot = self._w_n + nb
+        if weights is None:
+            wsum = float(nb)
+            bm = rows.mean(axis=0)
+            bm2 = ((rows - bm) ** 2).sum(axis=0)
+        else:
+            w = np.asarray(weights, np.float64).reshape(nb, 1, 1)
+            wsum = float(w.sum())
+            bm = (w * rows).sum(axis=0) / wsum
+            bm2 = (w * (rows - bm) ** 2).sum(axis=0)
+        tot = self._w_n + wsum
         delta = bm - self._w_mean
-        self._w_m2 += bm2 + delta ** 2 * (self._w_n * nb / tot)
-        self._w_mean += delta * (nb / tot)
+        self._w_m2 += bm2 + delta ** 2 * (self._w_n * wsum / tot)
+        self._w_mean += delta * (wsum / tot)
         self._w_n = tot
 
-    def update(self, x_rows: np.ndarray, sweep_end: int) -> None:
+    def update(self, x_rows: np.ndarray, sweep_end: int,
+               recycled: int = 0) -> None:
         """Fold one drained quantum: ``x_rows`` is the tenant's new
         ``(rows, nchains, p_model)`` (or pre-sliced ``(rows, nchains,
         |params|)``) chain rows in wire values. Called on the drain
-        worker; O(new rows) plus the throttled windowed evaluation."""
+        worker; O(new rows) plus the throttled windowed evaluation.
+
+        ``recycled`` is the quantum's partial-scan row count under
+        ``GST_RECYCLE`` (parallel/recycle.py): each recycled row's x
+        duplicates the FOLLOWING scan-end row's, so the Rao-
+        Blackwellized recycling moments are the weighted Welford fold
+        with multiplicity 2 on the trailing ``recycled`` rows — no
+        reconstructed array needed. The windowed ESS / R-hat verdicts
+        stay on scan-end rows (see ``__init__``'s recycle note)."""
         x_rows = np.asarray(x_rows)
         if x_rows.ndim != 3 or x_rows.shape[1] != self.nchains:
             raise ValueError(
@@ -182,20 +211,29 @@ class TenantMonitor:
         if x_rows.shape[2] != len(self.param_idx):
             x_rows = x_rows[:, :, self.param_idx]
         now = time.monotonic()
+        weights = None
+        if recycled:
+            nb = x_rows.shape[0]
+            recycled = min(int(recycled), nb)
+            weights = np.ones(nb)
+            weights[nb - recycled:] += 1.0
         with self._lock:
             if self._t_first is None:
                 self._t_first = now
             self._append(np.asarray(x_rows, np.float32))
-            self._welford(x_rows)
+            self._welford(x_rows, weights=weights)
+            self._recycled += int(recycled)
             self._updates += 1
             self._snap["rows"] = self._rows
             self._snap["sweeps"] = int(sweep_end)
+            if recycled or self._recycled:
+                self._snap["recycled_rows"] = self._recycled
             if (self._updates % self.spec.every == 0
                     and self._rows >= self.spec.min_rows):
                 self._evaluate(now, int(sweep_end))
 
     def backfill(self, x_rows: np.ndarray, sweep_end: int,
-                 updates: int = 0) -> None:
+                 updates: int = 0, recycled: int = 0) -> None:
         """Seed the window with rows recorded BEFORE this monitor
         existed — a resumed tenant's spooled prefix. One
         evaluation-free fold (append + Welford) plus the update count
@@ -212,12 +250,21 @@ class TenantMonitor:
                 f"{self.nchains}, p), got {x_rows.shape}")
         if x_rows.shape[2] != len(self.param_idx):
             x_rows = x_rows[:, :, self.param_idx]
+        weights = None
+        if recycled:
+            nb = x_rows.shape[0]
+            recycled = min(int(recycled), nb)
+            weights = np.ones(nb)
+            weights[nb - recycled:] += 1.0
         with self._lock:
             self._append(np.asarray(x_rows, np.float32))
-            self._welford(x_rows)
+            self._welford(x_rows, weights=weights)
+            self._recycled += int(recycled)
             self._updates += int(updates)
             self._snap["rows"] = self._rows
             self._snap["sweeps"] = int(sweep_end)
+            if self._recycled:
+                self._snap["recycled_rows"] = self._recycled
 
     def _evaluate(self, now: float, sweep_end: int) -> None:
         """The windowed diagnostics over the accumulated buffer —

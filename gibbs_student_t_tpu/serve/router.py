@@ -437,14 +437,64 @@ class FleetRouter:
         return out
 
     @staticmethod
+    def _est_backlog(st: dict) -> float:
+        """Estimated chain-sweeps still owed to the pool's RESIDENT
+        tenants (cost-aware placement, ROADMAP 1b): per tenant, the
+        monitor's ``est_sweeps_to_target`` when the snapshot carries
+        one (capped by the remaining budget — an ``on_converged=
+        'evict'`` tenant never serves past either), else the remaining
+        budget, × its chain lanes. Two pools at equal occupancy can
+        hide very different drain horizons: one full of nearly-
+        converged tenants frees lanes quanta sooner than one that
+        just admitted its residents — this is the number that sees
+        the difference. 0.0 for snapshots without tenant entries
+        (stale-cache degradation unchanged: the score falls back to
+        the occupancy legs)."""
+        total = 0.0
+        for t in st.get("tenants") or []:
+            if not isinstance(t, dict):
+                continue
+            rem = max((t.get("niter") or 0)
+                      - (t.get("sweeps_done") or 0), 0)
+            est = t.get("est_sweeps_to_target")
+            if isinstance(est, (int, float)) and not isinstance(
+                    est, bool):
+                rem = min(rem, max(float(est), 0.0))
+            total += rem * (t.get("nchains") or 0)
+        return total
+
+    @staticmethod
+    def _pool_efficiency(st: dict) -> float:
+        """Mean monitored ``cost.ess_per_core_s`` over the pool's
+        resident tenants (0.0 when no tenant carries one — the
+        monitor-absent degradation): the delivered-statistics-per-
+        compute signal ROADMAP 1b places by. Used NEGATED in the
+        score (higher efficiency is better), as the tie-break after
+        the backlog/occupancy legs."""
+        vals = [t["cost"]["ess_per_core_s"]
+                for t in st.get("tenants") or []
+                if isinstance(t, dict)
+                and isinstance(t.get("cost"), dict)
+                and isinstance(t["cost"].get("ess_per_core_s"),
+                               (int, float))]
+        return float(sum(vals) / len(vals)) if vals else 0.0
+
+    @staticmethod
     def _load_score(st: dict):
         """Lower is better: queue pressure first, then free lanes,
-        then occupancy, then the admission-p99 SLO."""
+        then occupancy, then the cost legs (estimated resident
+        backlog in chain-sweeps, negated pool ess/core-s efficiency —
+        both 0 when the snapshot carries no tenant evidence, leaving
+        the historical ordering untouched), then the admission-p99
+        SLO. Ties break on pool index (the caller pairs the score
+        with it) — deterministic, pinned in tests/test_rpc.py."""
         free = (st.get("free_groups") or 0) * (st.get("group") or 1)
         p99 = (((st.get("slo") or {}).get("admission_ms") or {})
                .get("p99")) or 0.0
         return ((st.get("queue_depth") or 0) + (st.get("staged") or 0),
-                -free, st.get("occupancy_now") or 0.0, p99)
+                -free, st.get("occupancy_now") or 0.0,
+                FleetRouter._est_backlog(st),
+                -FleetRouter._pool_efficiency(st), p99)
 
     def _place(self, request) -> int:
         """Choose the pool for one request (caller holds ``_lock``)."""

@@ -267,15 +267,29 @@ _MON_FIELDS = ("params", "ess_target", "rhat_target", "every",
                "min_rows")
 
 
-def _request_body(request) -> dict:
+#: WarmStartSpec fields (all JSON-able; serve/warm.py)
+_WARM_SPEC_FIELDS = ("pilot_sweeps", "pilot_chains", "burn_frac",
+                     "jitter_frac")
+
+
+def _request_body(request, include_model: bool = True,
+                  digest: Optional[str] = None) -> dict:
     """A TenantRequest as a submit frame body (the callable ``on_chunk``
-    stays client-side — its presence becomes ``stream``)."""
+    stays client-side — its presence becomes ``stream``).
+    ``include_model=False`` sends only ``ma_digest`` (the
+    content-addressed model cache, ROADMAP 1c): the server resolves
+    the model from its digest store, or answers ``need_model`` and the
+    client falls back to a full submit."""
     if request.state is not None:
         raise ValueError(
             "TenantRequest.state cannot ride the submit wire; resume "
             "via spool_dir + the server-side recover() path")
-    body = {"op": "submit", "ma": Pickled(request.ma),
+    body = {"op": "submit",
             "stream": request.on_chunk is not None}
+    if include_model:
+        body["ma"] = Pickled(request.ma)
+    if digest is not None:
+        body["ma_digest"] = digest
     for f in _REQ_SCALARS:
         body[f] = getattr(request, f)
     if request.x0 is not None:
@@ -283,6 +297,24 @@ def _request_body(request) -> dict:
     if request.monitor is not None:
         body["monitor"] = {f: getattr(request.monitor, f)
                            for f in _MON_FIELDS}
+    ws = request.warm_start
+    if ws is not None:
+        from gibbs_student_t_tpu.serve.warm import (
+            WarmStartFit,
+            WarmStartSpec,
+        )
+
+        if isinstance(ws, WarmStartSpec):
+            body["warm_start"] = {"spec": {
+                f: getattr(ws, f) for f in _WARM_SPEC_FIELDS}}
+        elif isinstance(ws, WarmStartFit):
+            body["warm_start"] = ws.to_json()   # journaled fit: replay
+        elif isinstance(ws, dict):
+            body["warm_start"] = ws
+        else:
+            raise ValueError(
+                f"warm_start cannot ride the wire: "
+                f"{type(ws).__name__}")
     return body
 
 
@@ -295,8 +327,17 @@ def _request_from_body(body: dict):
     if mon is not None:
         mon = MonitorSpec(**{f: mon.get(f) for f in _MON_FIELDS
                              if mon.get(f) is not None})
+    ws = body.get("warm_start")
+    if isinstance(ws, dict) and "spec" in ws:
+        from gibbs_student_t_tpu.serve.warm import WarmStartSpec
+
+        ws = WarmStartSpec(**{f: ws["spec"][f]
+                              for f in _WARM_SPEC_FIELDS
+                              if f in ws["spec"]})
+    # a fit dict passes through verbatim — serve/warm.py
+    # resolve_warm_start reconstructs it at staging
     return TenantRequest(ma=body["ma"], x0=body.get("x0"),
-                         monitor=mon, **kw)
+                         monitor=mon, warm_start=ws, **kw)
 
 
 def _tenant_error_body(err) -> dict:
@@ -340,13 +381,24 @@ class RpcServer:
     def __init__(self, server, host: str = "127.0.0.1", port: int = 0,
                  max_frame: Optional[int] = None,
                  on_shutdown: Optional[Callable] = None,
-                 chunk_queue: int = 8):
+                 chunk_queue: int = 8, model_cache: int = 64):
         self.server = server
         self.max_frame = (max_frame if max_frame is not None
                           else rpc_max_frame_env())
         self._on_shutdown = on_shutdown
         self._chunk_queue = int(chunk_queue)
         self._warned = False
+        # content-addressed model cache (ROADMAP 1c): digest → model
+        # pytree, LRU-capped. A submit carrying both model and digest
+        # registers; a digest-only submit resolves here or answers
+        # ``need_model`` (the client then falls back to a full
+        # submit) — resubmission and failover stop re-shipping (and
+        # re-pickling) identical models over the wire.
+        from collections import OrderedDict
+
+        self._model_cache: "OrderedDict[str, object]" = OrderedDict()
+        self._model_cache_cap = int(model_cache)
+        self._model_lock = threading.Lock()
         outer = self
 
         class _Handler(socketserver.BaseRequestHandler):
@@ -532,6 +584,27 @@ class RpcServer:
         the result/tenant_error/rejected frame."""
         stream = bool(req.get("stream"))
         chunks: Optional[_queue.Queue] = None
+        # content-addressed model resolution (ROADMAP 1c): a
+        # digest-only submit reuses the cached pytree; a miss answers
+        # ``need_model`` (the client retries with the model attached)
+        digest = req.get("ma_digest")
+        if req.get("ma") is None:
+            with self._model_lock:
+                ma = (self._model_cache.get(digest)
+                      if digest is not None else None)
+                if ma is not None:
+                    self._model_cache.move_to_end(digest)
+            if ma is None:
+                send_frame(sock, {"op": "need_model",
+                                  "digest": digest}, self.max_frame)
+                return True
+            req["ma"] = ma
+        elif digest is not None:
+            with self._model_lock:
+                self._model_cache[digest] = req["ma"]
+                self._model_cache.move_to_end(digest)
+                while len(self._model_cache) > self._model_cache_cap:
+                    self._model_cache.popitem(last=False)
         try:
             request = _request_from_body(req)
         except Exception as e:  # noqa: BLE001 - reject, don't kill conn
@@ -714,6 +787,13 @@ class RemoteChainServer:
         self.max_frame = (max_frame if max_frame is not None
                           else rpc_max_frame_env())
         self._streams: list = []
+        # content-addressed submit (ROADMAP 1c): pickled-model digests
+        # by object identity (strong refs pin ids valid; bounded), and
+        # the digests this server has confirmed holding — repeat
+        # submits of one model (the closed-loop bench, failover
+        # replay) skip both the re-pickle and the model bytes
+        self._digest_cache: Dict[int, tuple] = {}
+        self._server_has: set = set()
 
     # -- plumbing -------------------------------------------------------
 
@@ -743,28 +823,65 @@ class RemoteChainServer:
 
     # -- the ChainServer-shaped surface ---------------------------------
 
+    def _digest_of(self, ma) -> str:
+        key = id(ma)
+        hit = self._digest_cache.get(key)
+        if hit is not None and hit[0] is ma:
+            return hit[1]
+        import hashlib
+
+        digest = hashlib.sha256(
+            pickle.dumps(ma, protocol=4)).hexdigest()
+        if len(self._digest_cache) > 128:
+            self._digest_cache.clear()
+        self._digest_cache[key] = (ma, digest)
+        return digest
+
     def submit(self, request,
                timeout: Optional[float] = None) -> RemoteTenantHandle:
         """Queue a job on the remote pool; ``timeout`` bounds the
-        remote admission-queue wait (the backpressure contract)."""
-        body = _request_body(request)
+        remote admission-queue wait (the backpressure contract). A
+        model the server already holds (by content digest) rides the
+        wire as its digest alone; a ``need_model`` reply falls back
+        to a full submit — so the first submission is one round trip
+        either way and repeats skip the model bytes."""
+        digest = self._digest_of(request.ma)
+        omit = digest in self._server_has
+        body = _request_body(request, include_model=not omit,
+                             digest=digest)
         body["timeout"] = timeout
         if not body["stream"]:
             reply = self._call(body)
+            if reply.get("op") == "need_model":
+                self._server_has.discard(digest)
+                body = _request_body(request, digest=digest)
+                body["timeout"] = timeout
+                reply = self._call(body)
             if reply.get("op") == "rejected":
                 raise RuntimeError(reply.get("error"))
+            self._server_has.add(digest)
             return RemoteTenantHandle(self, reply["tenant_id"], request)
         # streaming: the connection outlives the call
         sock = self._connect(None)
         try:
             send_frame(sock, body, self.max_frame)
             reply = recv_frame(sock, self.max_frame)
+            if reply.get("op") == "need_model":
+                # digest miss on a fresh server: retry with the model
+                # on the same connection (the server answered and
+                # kept it open)
+                self._server_has.discard(digest)
+                body = _request_body(request, digest=digest)
+                body["timeout"] = timeout
+                send_frame(sock, body, self.max_frame)
+                reply = recv_frame(sock, self.max_frame)
         except BaseException:
             sock.close()
             raise
         if reply.get("op") in ("rejected", "error"):
             sock.close()
             raise RuntimeError(reply.get("error"))
+        self._server_has.add(digest)
         h = RemoteTenantHandle(self, reply["tenant_id"], request,
                                streamed=True)
         t = threading.Thread(target=self._stream_reader,

@@ -118,6 +118,15 @@ class TenantRequest:
     #: full budget — sweeps the pool would spend past convergence
     #: become backfill capacity (ROADMAP 4c; docs/SERVING.md)
     on_converged: str = "none"
+    #: variational warm start (ROADMAP 4b; serve/warm.py): a
+    #: ``WarmStartSpec`` fits a moment-matched Gaussian mixture on a
+    #: short staged pilot and inits the chains from it instead of the
+    #: prior (burn-in is per-request latency in serving); a
+    #: ``WarmStartFit`` (or its journaled JSON dict) replays a
+    #: previous fit bitwise — the manifest-recovery path. ``None``
+    #: keeps the cold prior init; ``GST_WARM_START`` gates the arm
+    #: globally (0 degrades every request to cold, pinned).
+    warm_start: object = None
 
 
 class TenantHandle:
@@ -159,6 +168,13 @@ class TenantHandle:
         # per-quantum deltas, attributed by the same active-lane
         # share). Empty when the pool runs timers-off.
         self.cost_stage_ms: Dict[str, float] = {}
+        # recycling Gibbs bookkeeping (round 17; parallel/recycle.py):
+        # partial-scan rows the drain tagged for this tenant (0 with
+        # the gate off). Single-writer like the cost counters.
+        self.recycled_rows = 0
+        # warm-start summary ({kind, pilot_sweeps, pilot_ms, ...} /
+        # {"degraded": reason} / None cold) — attached at staging
+        self.warm: Optional[Dict] = None
 
     # -- lifecycle (server side) ---------------------------------------
 
@@ -305,6 +321,10 @@ class TenantHandle:
         if self._monitor is not None:
             p.update(self._monitor.snapshot())
         p["cost"] = self.cost()
+        if self.recycled_rows:
+            p["recycled_rows"] = int(self.recycled_rows)
+        if self.warm is not None:
+            p["warm"] = dict(self.warm)
         return p
 
     @property

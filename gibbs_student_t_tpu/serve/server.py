@@ -135,6 +135,25 @@ def serve_pipeline_env() -> str:
     return env if env is not None else "auto"
 
 
+def serve_recycle_env() -> str:
+    """Validated ``GST_RECYCLE`` (``auto`` when unset) — recycling
+    Gibbs row tagging (parallel/recycle.py): the drain tags the
+    partial-scan states each served sweep already computed as
+    ``recycled`` rows (reconstructed from adjacent recorded rows —
+    zero kernel or wire cost) and the streaming monitor folds them
+    into its Rao-Blackwellized weighted moments. Strict ``auto|1|0``;
+    ``auto`` resolves ON — the recorded chains, spool bytes and every
+    scan-end row are BITWISE identical either way (the tag is pure
+    drain-side bookkeeping + an extra ``row_class`` key on streamed
+    records; pinned in tests/test_recycle.py). ``0`` disables all
+    tagging/weighting — the PR 13 drain graph verbatim."""
+    env = os.environ.get("GST_RECYCLE")
+    if env is not None and env not in ("auto", "1", "0"):
+        raise ValueError(
+            f"GST_RECYCLE must be 'auto', '1' or '0', got {env!r}")
+    return env if env is not None else "auto"
+
+
 def serve_supervise_env() -> str:
     """Validated ``GST_SERVE_SUPERVISE`` (``auto`` when unset) — the
     fault-containment supervisor. Strict ``auto|1|0``; ``auto``
@@ -163,6 +182,11 @@ class _Prepared:
     n_real: int
     prep_ms: float
     monitor: Optional[TenantMonitor] = None
+    # warm start (round 17; serve/warm.py): the fit whose draws
+    # initialized ``state`` — journaled in the manifest admit record
+    # so recovery replays the init bitwise without re-running the
+    # pilot. None for cold (prior-init) tenants.
+    warm_fit: object = None
 
 
 @dataclass
@@ -239,7 +263,7 @@ class ChainServer:
                  watchdog_spec: Optional[WatchdogSpec] = None,
                  flight: bool = True, flight_dir: Optional[str] = None,
                  flight_capacity: int = 64, flight_sync_every: int = 4,
-                 kernel_timers="auto"):
+                 kernel_timers="auto", recycle="auto"):
         """``pipeline`` selects the driver ``run()`` uses: ``"auto"``
         (default) follows ``GST_SERVE_PIPELINE`` (auto -> pipelined);
         ``True``/``False`` force it, still overridden by an explicit
@@ -283,6 +307,20 @@ class ChainServer:
         ``summary()['stages']`` / per-tenant ``cost()`` shares — a
         runtime flag inside the SAME compiled kernels, so chains and
         the lowered graph are bitwise identical either way.
+        Capacity per dollar (round 17): ``recycle`` (``"auto"``
+        follows ``GST_RECYCLE``, auto -> on) arms recycling-Gibbs row
+        tagging — the drain counts/tags the partial-scan rows each
+        served sweep already computed (parallel/recycle.py; they are
+        reconstructed from adjacent recorded rows, so scan-end rows,
+        spool bytes and chains stay bitwise identical on/off) and the
+        streaming monitor folds them into Rao-Blackwellized weighted
+        moments. Warm starts ride the REQUEST
+        (``TenantRequest.warm_start``; serve/warm.py) under the
+        ``GST_WARM_START`` gate: on the pipelined executor the pilot
+        runs on the pool itself as an internal tenant (zero
+        per-tenant recompiles), and the fitted mixture is journaled
+        in the manifest admit record for bitwise recovery replay.
+
         ``flight`` (default on) arms the crash flight recorder: a
         ``flight_capacity``-quanta ring of boundary telemetry +
         events + heartbeats, synced spanless to
@@ -351,6 +389,18 @@ class ChainServer:
             self.supervise = sup_env == "1"
         else:
             self.supervise = True if supervise == "auto" else bool(supervise)
+        # recycling Gibbs (round 17; parallel/recycle.py): drain-side
+        # partial-scan row tagging + monitor moment weighting. Pure
+        # bookkeeping — scan-end rows, spool bytes and chains are
+        # bitwise identical on/off (the gates-off contract).
+        rec_env = serve_recycle_env()
+        if recycle not in ("auto", True, False):
+            raise ValueError(
+                f"recycle must be 'auto', True or False, got {recycle!r}")
+        if rec_env != "auto":
+            self.recycle = rec_env == "1"
+        else:
+            self.recycle = True if recycle == "auto" else bool(recycle)
         if prefetch < 1:
             raise ValueError(f"prefetch must be >= 1, got {prefetch}")
         self._prefetch = int(prefetch)
@@ -367,6 +417,10 @@ class ChainServer:
         self._prep_lock = threading.Lock()
         self._prepared: List[_Prepared] = []
         self._staging_n = 0            # tenants being prepared right now
+        # cancels that landed while their tenant was mid-staging (in
+        # neither the queue nor the prepared window): resolved by the
+        # staging worker / placement instead of falling through
+        self._cancelled_prestage: set = set()
         self._workers_stop = threading.Event()
         self._stage_thread: Optional[threading.Thread] = None
         self._drain_thread: Optional[threading.Thread] = None
@@ -503,6 +557,14 @@ class ChainServer:
         # convergence-based evictions served (ROADMAP 4c): tenants
         # released early because their armed monitor targets held
         self._converged_evictions = 0
+        # capacity-per-dollar accounting (round 17): recycled
+        # partial-scan lane-rows tagged (quarantined lanes excluded —
+        # a frozen lane's scan produced no new partial states) and the
+        # warm-start arm's counters (serve/warm.py)
+        self._recycled_lane_rows = 0
+        self._warm_starts = 0
+        self._warm_degraded = 0
+        self._warm_pilot_ms = 0.0
         # cost accounting (round 14): total measured dispatch wall —
         # the quantity the per-tenant device_ms shares sum back to
         self._dispatch_wall_ms = 0.0
@@ -544,6 +606,10 @@ class ChainServer:
         for k in self._fault_counts:
             self._fault_counts[k] = 0
         self._converged_evictions = 0
+        self._recycled_lane_rows = 0
+        self._warm_starts = 0
+        self._warm_degraded = 0
+        self._warm_pilot_ms = 0.0
         # stage-timer accounting restarts from the current cumulative
         # snapshot so warmup kernels never leak into the timed window
         self._stage_prev = (_nffi.timers_snapshot()
@@ -601,6 +667,18 @@ class ChainServer:
                     "armed target (ess_target and/or rhat_target) — "
                     "the streaming convergence verdict is what "
                     "triggers the eviction")
+        if request.warm_start is not None:
+            from gibbs_student_t_tpu.serve.warm import (
+                WarmStartFit,
+                WarmStartSpec,
+            )
+
+            if not isinstance(request.warm_start,
+                              (WarmStartSpec, WarmStartFit, dict)):
+                raise ValueError(
+                    "warm_start must be a serve.warm.WarmStartSpec, a "
+                    "WarmStartFit (or its journaled JSON dict), or "
+                    f"None, got {type(request.warm_start).__name__}")
         if request.on_divergence != "none":
             if not self.supervise:
                 raise ValueError(
@@ -628,12 +706,16 @@ class ChainServer:
 
     def cancel(self, handle: TenantHandle) -> bool:
         """Request eviction of a tenant. A queued (or staged but not
-        yet placed) tenant is failed immediately; a RUNNING tenant's
-        lanes freeze at the NEXT quantum boundary — the in-flight
-        quantum completes and its records are kept — then the tenant
-        finalizes normally with the sweeps served so far (partial
-        rows, status ``done``). Returns False when the tenant is
-        unknown (already finished)."""
+        yet placed) tenant is failed immediately; a tenant the
+        staging thread is PREPARING right now (in neither the queue
+        nor the prepared window — the in-limbo gap a cancel used to
+        fall through, racing the ~5 ms staging pickup) is marked and
+        dropped the moment its preparation finishes; a RUNNING
+        tenant's lanes freeze at the NEXT quantum boundary — the
+        in-flight quantum completes and its records are kept — then
+        the tenant finalizes normally with the sweeps served so far
+        (partial rows, status ``done``). Returns False when the
+        tenant is unknown (already finished)."""
         with self._lock:
             ent = self._running.get(handle.tenant_id)
             if ent is not None:
@@ -648,6 +730,11 @@ class ChainServer:
                     self._prepared.pop(i)
                     handle._fail("cancelled before admission")
                     return True
+            if handle.status == "queued" and not handle.done():
+                # mid-staging: _stage_worker / _apply_prepared checks
+                # this set and fails the handle instead of placing it
+                self._cancelled_prestage.add(handle.tenant_id)
+                return True
         return False
 
     # ------------------------------------------------------------------
@@ -751,8 +838,18 @@ class ChainServer:
                         "pseudo-counts within the pool's draw width); "
                         "set GST_FAST_BETA=0 on the pool or match "
                         "the tenant's n")
-            state = (req.state if req.state is not None
-                     else tb.init_state(req.x0, seed=req.seed))
+            warm_fit = None
+            if req.state is not None:
+                state = req.state
+            else:
+                warm_fit = (None if req.x0 is not None
+                            else self._warm_fit_for(handle, ma_p))
+                if warm_fit is not None:
+                    x0 = warm_fit.draw_x0(req.nchains, req.seed,
+                                          ma_p.specs_np)
+                    state = tb.init_state(x0, seed=req.seed)
+                else:
+                    state = tb.init_state(req.x0, seed=req.seed)
         except Exception as e:  # noqa: BLE001 - reject, don't kill pool
             handle._fail(f"{type(e).__name__}: {e}")
             return None
@@ -762,13 +859,151 @@ class ChainServer:
                               tenant=handle.tenant_id)
         return _Prepared(handle, ma_p, tb, state,
                          self._groups_needed(handle), ma.n,
-                         prep_ms, monitor=monitor)
+                         prep_ms, monitor=monitor, warm_fit=warm_fit)
+
+    def _warm_fit_for(self, handle: TenantHandle, ma_p):
+        """Resolve the tenant's warm-start input under ``GST_WARM_START``
+        and run/replay the fit (serve/warm.py). Runs on the staging
+        thread inside ``_prepare``'s rejection scope — but a PILOT or
+        fit failure must degrade to the cold prior init (the silent-
+        degradation contract), never reject the tenant; only an
+        invalid ``warm_start`` value itself rejects. Returns the
+        :class:`~gibbs_student_t_tpu.serve.warm.WarmStartFit` or None
+        (cold). Side effects: the handle's ``warm`` summary, the
+        server's warm counters, a ``warm_start`` /
+        ``warm_start_degraded`` event."""
+        from gibbs_student_t_tpu.serve.warm import (
+            WarmStartFit,
+            fit_warm_start,
+            resolve_warm_start,
+        )
+
+        if getattr(handle, "_internal", False):
+            return None        # a pilot never warm-starts itself
+        req = handle.request
+        warm_in = resolve_warm_start(req.warm_start)  # invalid → reject
+        if warm_in is None:
+            if req.warm_start is not None:
+                # requested but force-disabled (GST_WARM_START=0):
+                # serve cold, bitwise the pre-warm-start init — pinned
+                handle.warm = {"degraded": "GST_WARM_START=0"}
+            return None
+        try:
+            if isinstance(warm_in, WarmStartFit):
+                fit = warm_in          # journaled: replay, no pilot
+            elif self.pipeline:
+                # pipelined executor: run the pilot ON the pool — the
+                # one compiled operand-fed chunk program, so a pilot
+                # never compiles anything (a standalone pilot backend
+                # bakes the tenant model as trace constants and pays
+                # a FULL compile per distinct model — measured
+                # seconds/tenant, inverting the warm-start economics)
+                fit = self._pool_pilot_fit(handle, warm_in)
+            else:
+                # serial driver: _prepare runs ON the driving thread,
+                # so an in-pool pilot would deadlock (nothing left to
+                # step the pool) — the standalone backend is the
+                # reference-arm cost
+                fit = fit_warm_start(ma_p, self.config, warm_in,
+                                     seed=req.seed,
+                                     dtype=self.pool.dtype)
+        except Exception as e:  # noqa: BLE001 - degrade, don't reject
+            self._warm_degraded += 1
+            handle.warm = {"degraded": f"{type(e).__name__}: {e}"}
+            warnings.warn(
+                f"tenant {handle.tenant_id} warm-start fit failed "
+                f"({type(e).__name__}: {e}); serving from the cold "
+                "prior init", RuntimeWarning)
+            if self.metrics is not None:
+                self.metrics.counter("serve_warm_degraded").inc()
+                self.metrics.emit(
+                    "warm_start_degraded", tenant=handle.tenant_id,
+                    error=f"{type(e).__name__}: {e}")
+            return None
+        self._warm_starts += 1
+        self._warm_pilot_ms += fit.pilot_ms
+        handle.warm = {"kind": fit.kind,
+                       "pilot_sweeps": fit.pilot_sweeps,
+                       "pilot_chains": fit.pilot_chains,
+                       "pilot_ms": round(fit.pilot_ms, 1),
+                       "replayed": fit.pilot_ms == 0.0}
+        if self.metrics is not None:
+            self.metrics.counter("serve_warm_starts").inc()
+            self.metrics.emit("warm_start", tenant=handle.tenant_id,
+                              kind=fit.kind,
+                              pilot_sweeps=fit.pilot_sweeps,
+                              pilot_ms=round(fit.pilot_ms, 1))
+        return fit
+
+    #: ceiling on one in-pool pilot's wall wait (a saturated pool
+    #: admits the pilot by first-fit backfill as soon as any group
+    #: frees; past this the tenant degrades to the cold init)
+    PILOT_TIMEOUT_S = 300.0
+
+    def _pool_pilot_fit(self, handle: TenantHandle, spec):
+        """Warm-start pilot as an INTERNAL tenant of the slot pool:
+        a ``pilot_chains``-chain job with the warm tenant's own model
+        and seed, prepared directly into the staged window (it cannot
+        ride the queue — THIS thread is the staging worker, and a
+        queued pilot would wait on itself), served by the already-
+        compiled chunk program alongside the resident tenants, then
+        moment-matched by ``fit_from_rows``. The pilot's lanes do
+        real accounted work (occupancy/cost tell the truth) but it is
+        invisible to the crash manifest and the SLO series
+        (``_internal``). Blocks the staging thread only — the
+        dispatch thread keeps the pool serving throughout."""
+        from gibbs_student_t_tpu.serve.warm import fit_from_rows
+
+        req = handle.request
+        t0 = time.monotonic()
+        q = self.pool.quantum
+        niter = -(-int(spec.pilot_sweeps) // q) * q
+        pr = TenantRequest(
+            ma=req.ma, niter=niter, nchains=spec.pilot_chains,
+            seed=req.seed,
+            name=f"__warm_pilot_{handle.tenant_id}")
+        with self._lock:
+            ph = TenantHandle(self._next_id, pr)
+            self._next_id += 1
+            self._handles[ph.tenant_id] = ph
+        ph._internal = True
+        prep = self._prepare(ph)
+        if prep is None:
+            raise RuntimeError(f"pilot rejected: {ph.error}")
+        with self._prep_lock:
+            self._prepared.append(prep)
+        # stop-aware wait: close() joins the staging thread, so a
+        # plain blocking result() here would hold shutdown hostage
+        # for the whole pilot timeout
+        deadline = t0 + self.PILOT_TIMEOUT_S
+        while not ph.done():
+            if self._workers_stop.is_set() or self._stop.is_set():
+                self.cancel(ph)
+                raise RuntimeError("server stopping mid-pilot")
+            if time.monotonic() > deadline:
+                self.cancel(ph)
+                raise TimeoutError(
+                    f"warm-start pilot not served within "
+                    f"{self.PILOT_TIMEOUT_S:.0f}s")
+            ph._done.wait(0.05)
+        res = ph.result(timeout=0)
+        return fit_from_rows(np.asarray(res.chain), spec,
+                             prep.ma_padded.specs_np,
+                             pilot_ms=(time.monotonic() - t0) * 1e3)
 
     def _apply_prepared(self, prep: _Prepared) -> None:
         """Place a prepared tenant into free lane groups: the cheap
         boundary half of admission (host slice writes + bookkeeping).
         Caller holds ``_lock`` and has verified the groups fit."""
         handle, req = prep.handle, prep.handle.request
+        with self._prep_lock:
+            if handle.tenant_id in self._cancelled_prestage:
+                # a cancel that landed mid-staging on the SERIAL path
+                # (the pipelined path resolves it in _stage_worker)
+                self._cancelled_prestage.discard(handle.tenant_id)
+                if not handle.done():
+                    handle._fail("cancelled before admission")
+                return
         pool = self.pool
         t_admit0 = time.monotonic()
         taken = [self._free_groups.pop(0)
@@ -789,6 +1024,7 @@ class ChainServer:
                 req.spool_dir, req.seed, resume=req.start_sweep > 0,
                 resume_at=req.start_sweep if req.start_sweep else None,
                 record_mode=t.record_mode, record_thin=t.record_thin,
+                recycle=self.recycle,
                 extra_meta={"tenant": handle.tenant_id,
                             "n_toa": [prep.n_real]},
                 fault_key=self._tenant_key(handle))
@@ -800,16 +1036,25 @@ class ChainServer:
             slot, handle, spool,
             backend=(prep.backend
                      if req.on_divergence == "reinit" else None))
-        self._admission_ms.append(handle.admission_ms)
+        internal = bool(getattr(handle, "_internal", False))
+        if not internal:
+            # warm-start pilots stay out of the SLO series (their
+            # "admission" is a direct window insert, not a submit)
+            # and out of the crash manifest (a recovered pool must
+            # not resurrect a pilot whose consumer died with the
+            # staging thread)
+            self._admission_ms.append(handle.admission_ms)
         if self.spans is not None:
             self.spans.record("admit", ROLE_DISPATCH, t_admit0,
                               time.monotonic() - t_admit0,
                               tenant=handle.tenant_id,
                               quantum=self.quanta)
-        if self._manifest is not None:
+        if self._manifest is not None and not internal:
             self._manifest.record_admit(
                 handle.tenant_id, req,
-                model=req.ma if req.spool_dir is not None else None)
+                model=req.ma if req.spool_dir is not None else None,
+                warm=(prep.warm_fit.to_json()
+                      if prep.warm_fit is not None else None))
         if self.metrics is not None:
             self.metrics.histogram("serve_admission_ms").observe(
                 handle.admission_ms)
@@ -1467,6 +1712,9 @@ class ChainServer:
                    if need_mat else None)
         wire_cols = None
         if spool is not None:
+            # spool bytes are scan-end rows, bitwise recycle-on/off:
+            # recycled rows are reconstructible (parallel/recycle.py),
+            # so persisting them would store every byte twice
             spool.append(records, state_fn(), sweep_end)
             if self._manifest is not None:
                 self._manifest.record_checkpoint(slot.tenant_id,
@@ -1474,9 +1722,41 @@ class ChainServer:
         else:
             wire_cols = self.pool.tenant_wire(wire, slot)
             handle._append_wire(wire_cols)
+        # recycling Gibbs (round 17): tag this quantum's partial-scan
+        # rows. One recycled row per scan-end row (the mid-scan state
+        # BEFORE it), except a stream's very first row, whose
+        # predecessor state was the init, not a scan. Quarantined
+        # lanes are excluded from the delivered count — a frozen lane
+        # advanced no scan, so it minted no partial states.
+        rec_rows = 0
+        row_class = None
+        if self.recycle:
+            rows_q = self.pool.quantum // self.pool.template.record_thin
+            continuing = (handle.chunks_streamed > 0
+                          or handle.request.start_sweep > 0)
+            rec_rows = rows_q if continuing else max(rows_q - 1, 0)
+            if rec_rows:
+                from gibbs_student_t_tpu.parallel.recycle import (
+                    row_class_pattern,
+                )
+
+                row_class = row_class_pattern(rows_q, continuing)
+                active = max(slot.nchains - len(slot.quarantined), 0)
+                handle.recycled_rows += rec_rows * active
+                self._recycled_lane_rows += rec_rows * active
+                if self.metrics is not None:
+                    self.metrics.counter("serve_recycled_rows").inc(
+                        rec_rows * active)
         was_first = handle.first_result_t is None
-        handle._stream(sweep_end,
-                       records if records is not None else {})
+        if records is not None and row_class is not None:
+            # on_chunk keeps its materialized-records contract; the
+            # row-class tag rides a COPY so the spool/append path
+            # above never sees a non-record field
+            stream_records = dict(records)
+            stream_records["row_class"] = row_class
+        else:
+            stream_records = records if records is not None else {}
+        handle._stream(sweep_end, stream_records)
         if was_first and handle.first_result_t is not None:
             ms = handle.first_result_ms
             if ms is not None:
@@ -1486,7 +1766,8 @@ class ChainServer:
                         "serve_first_result_ms").observe(ms)
         if tele is not None:
             self._accumulate_tele(handle, slot, tele)
-        self._feed_monitor(handle, slot, records, wire_cols, sweep_end)
+        self._feed_monitor(handle, slot, records, wire_cols, sweep_end,
+                           recycled=rec_rows)
 
     def _backfill_monitor(self, monitor: TenantMonitor, req) -> None:
         """A resumed monitored tenant re-arms its monitor over the
@@ -1510,7 +1791,9 @@ class ChainServer:
             quantum = max(int(self.pool.quantum), 1)
             monitor.backfill(
                 rows, req.start_sweep,
-                updates=(req.start_sweep - base) // quantum)
+                updates=(req.start_sweep - base) // quantum,
+                recycled=(max(len(rows) - 1, 0) if self.recycle
+                          else 0))
         except Exception as e:  # noqa: BLE001 - observability contract
             warnings.warn(
                 f"monitor backfill from {req.spool_dir!r} failed "
@@ -1518,7 +1801,8 @@ class ChainServer:
                 "restarts at the resume point", RuntimeWarning)
 
     def _feed_monitor(self, handle: TenantHandle, slot: TenantSlot,
-                      records, wire_cols, sweep_end: int) -> None:
+                      records, wire_cols, sweep_end: int,
+                      recycled: int = 0) -> None:
         """Fold one drained quantum into the tenant's streaming
         convergence monitor. The ``x`` chain rides the wire UNCAST
         (ops record casts touch z/pout/b/alpha only), so the monitored
@@ -1537,7 +1821,7 @@ class ChainServer:
                 # wire slice is (nchains, rows, p): rows-major for the
                 # diagnostics window
                 x_rows = np.swapaxes(wire_cols["x"], 0, 1)
-            mon.update(x_rows, sweep_end)
+            mon.update(x_rows, sweep_end, recycled=recycled)
             if (mon.converged_at is not None
                     and handle.request.monitor is not None
                     and not getattr(handle, "_conv_recorded", False)):
@@ -1635,6 +1919,16 @@ class ChainServer:
         # the cost block is complete here: the tenant's final quantum
         # was attributed earlier in this same drain pass
         mon_stats["cost"] = handle.cost()
+        if self.recycle:
+            # recycled rows are RECONSTRUCTED from the chain arrays
+            # (parallel/recycle.recycled_result), never stored — the
+            # result carries only the delivered count; chain arrays
+            # stay scan-end rows, bitwise the gate-off result
+            mon_stats["recycle"] = {
+                "enabled": True,
+                "recycled_lane_rows": int(handle.recycled_rows)}
+        if handle.warm is not None:
+            mon_stats["warm"] = dict(handle.warm)
         if spool is not None:
             spool.close()
             from gibbs_student_t_tpu.utils.spool import load_spool
@@ -1712,7 +2006,11 @@ class ChainServer:
                 raise  # genuine interpreter exit (KeyboardInterrupt &c)
             with self._prep_lock:
                 self._staging_n -= 1
-                if prep is not None:
+                if h.tenant_id in self._cancelled_prestage:
+                    self._cancelled_prestage.discard(h.tenant_id)
+                    if not h.done():
+                        h._fail("cancelled before admission")
+                elif prep is not None:
                     self._prepared.append(prep)
 
     def _drain_bundle(self, b: _Bundle) -> None:
@@ -1951,6 +2249,36 @@ class ChainServer:
         self._drainq.put(_Bundle(recs, tl, snap, entries, qidx=qidx,
                                  cost=cost))
 
+    def _reap_decided(self) -> None:
+        """Pipelined boundary (caller holds ``_lock``): release
+        tenants whose freeze is already decided — a cancel / converged-
+        eviction verdict or a contained failure that landed since the
+        last boundary — BEFORE admissions and the next dispatch, so
+        their groups backfill THIS quantum instead of riding one more.
+
+        This closes the eviction-latency gap the round-16 evict
+        economics measured: a convergence verdict lands on the drain
+        worker while the NEXT quantum is already in flight, and the
+        old final-check inside ``_dispatch_one`` only saw the flag
+        while INCLUDING the tenant in the dispatch it was about to
+        make — every evicted/cancelled tenant served one full quantum
+        past its freeze decision (at the flagship evict floor of ~2-3
+        quanta per job, a ~30-50% jobs/hour tax). The cancel contract
+        is unchanged — the in-flight quantum still completes and its
+        records are kept (its drain bundle is already queued);
+        finalize rides a drain-ordered finalize-only entry, after the
+        tenant's last real drain. Tenants with nothing drained yet
+        (cancelled before their first quantum) keep the historical
+        ride-one-quantum path: a zero-row finalize has no records to
+        build a result from."""
+        for tid, t in list(self._running.items()):
+            slot = t.slot
+            if ((slot.cancelled or slot.failed)
+                    and slot.done_sweeps > 0):
+                self._running.pop(tid)
+                self._release(slot)
+                self._boundary_failed.append(t)
+
     def _pipeline_idle(self) -> bool:
         """Nothing running, queued, staged or pending drain — the
         prepared window and the staging counter are checked under one
@@ -1974,6 +2302,7 @@ class ChainServer:
             with self._lock:
                 boundary_failed = self._fold_lane_health()
                 self._boundary_failed.extend(boundary_failed)
+                self._reap_decided()
                 t0 = time.monotonic()
                 self._apply_admissions()
                 self._admit_apply_ms.append(
@@ -2413,13 +2742,19 @@ class ChainServer:
             if mon is not None:
                 mon = MonitorSpec(**{k: v for k, v in mon.items()
                                      if v is not None})
+            # the journaled warm-start fit rides too: a tenant that
+            # died BEFORE its first checkpoint restarts from scratch
+            # (state None) and must re-draw the SAME warm init — the
+            # fit JSON replays it bitwise without re-running the pilot
+            # (serve/warm.py); with a checkpoint the state wins and
+            # the fit is inert
             handles[key] = srv.submit(TenantRequest(
                 ma=ma, niter=remaining, nchains=rec["nchains"],
                 seed=rec["seed"], state=state, start_sweep=next_sweep,
                 spool_dir=rec["spool_dir"], name=rec.get("name"),
                 on_divergence=rec.get("on_divergence") or "none",
                 on_converged=rec.get("on_converged") or "none",
-                monitor=mon))
+                monitor=mon, warm_start=rec.get("warm")))
         # the resubmissions above are journaled in the NEW epoch, so
         # everything before it is dead weight a future recovery would
         # re-parse (and the admissions carry pickled models) — compact
@@ -2468,6 +2803,15 @@ class ChainServer:
             # tenants finished early because their armed monitor
             # targets held — the serve_bench --evict-arm headline
             "converged_evictions": self._converged_evictions,
+            # capacity-per-dollar arms (round 17; ROADMAP 4a/4b):
+            # recycled partial-scan lane-rows delivered on top of the
+            # served scan-end rows (quarantined lanes excluded), and
+            # the warm-start arm's pilot economics
+            "recycle": {"enabled": bool(self.recycle),
+                        "recycled_lane_rows": self._recycled_lane_rows},
+            "warm": {"warm_starts": self._warm_starts,
+                     "degraded": self._warm_degraded,
+                     "pilot_ms_total": round(self._warm_pilot_ms, 1)},
             "slo": self._slo_block(),
             # per-stage DEVICE time from the in-kernel timers (round
             # 15): total/mean-per-quantum/share-of-dispatch per stage,

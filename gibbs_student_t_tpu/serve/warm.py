@@ -1,0 +1,254 @@
+"""Variational warm starts for served tenants (arXiv:2405.08857).
+
+In serving, burn-in is per-request latency: a tenant initialized from
+PRIOR draws (the solo convention) spends its first recorded rows in an
+overdispersed transient, which both wastes sweeps and — worse for the
+``on_converged="evict"`` economics — poisons the streaming monitor's
+early windows: the Sokal τ estimate over a window containing the
+transient reads high, ESS reads low, and the eviction verdict lands
+quanta after the chain actually mixed (serve_bench's evict arm
+measures exactly this gap; docs/PERFORMANCE.md "Capacity per dollar").
+
+A :class:`WarmStartFit` replaces the prior-draw init with draws from a
+moment-matched Gaussian mixture fitted to a SHORT pilot run of the
+tenant's own model (a few chains × a few dozen sweeps on the staging
+thread — the 2405.08857 recipe with the cheap mixture standing in for
+the flow; the ``kind`` registry below is the flow-ready seam: a future
+normalizing-flow fit registers a new kind and rides the identical
+journal/draw/replay plumbing). One mixture component per pilot chain
+keeps multimodal hyper posteriors honest — chains that found different
+modes become different components.
+
+Determinism and recovery: the fit is summarized as small JSON-able
+arrays and journaled in the tenant's manifest admit record
+(serve/manifest.py), and the init draw is a ``numpy`` Philox stream
+seeded from the request seed — so :meth:`ChainServer.recover` replays
+a warm-started tenant's init bitwise WITHOUT re-running the pilot
+(tests/test_recycle.py pins the replay).
+
+Failure contract: warm starting is an optimization, never a
+correctness dependency — any pilot/fit failure warns, emits a
+``warm_start_degraded`` event and serves the tenant from the cold
+prior init (the silent-degradation discipline of every GST_* arm).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+import numpy as np
+
+from gibbs_student_t_tpu.models.parameter import KIND_NORMAL
+
+
+def warm_start_env() -> str:
+    """Validated ``GST_WARM_START`` (``auto`` when unset) — the
+    variational warm-start arm. Strict ``auto|1|0`` (the loud-typo
+    contract): ``auto`` honors each request's ``warm_start`` field
+    (no request, no pilot); ``1`` warm-starts EVERY tenant with the
+    default spec (requests keep their own); ``0`` disables the arm —
+    every tenant serves from the cold prior init, bitwise the
+    pre-warm-start graph (requests degrade with an event, pinned)."""
+    env = os.environ.get("GST_WARM_START")
+    if env is not None and env not in ("auto", "1", "0"):
+        raise ValueError(
+            f"GST_WARM_START must be 'auto', '1' or '0', got {env!r}")
+    return env if env is not None else "auto"
+
+
+@dataclass
+class WarmStartSpec:
+    """Per-tenant warm-start request (``TenantRequest.warm_start``).
+
+    ``pilot_sweeps`` × ``pilot_chains`` bounds the pilot's compute
+    (run once on the staging thread, overlapped with serving);
+    ``burn_frac`` discards the pilot's own transient before moment
+    matching; ``jitter_frac`` inflates each component's per-param
+    std by a floor fraction of the prior scale so a degenerate pilot
+    column can never collapse a component to a point mass."""
+
+    pilot_sweeps: int = 64
+    pilot_chains: int = 8
+    burn_frac: float = 0.5
+    jitter_frac: float = 0.02
+
+    def __post_init__(self):
+        if self.pilot_sweeps < 8:
+            raise ValueError(f"pilot_sweeps must be >= 8, got "
+                             f"{self.pilot_sweeps}")
+        if self.pilot_chains < 1:
+            raise ValueError(f"pilot_chains must be >= 1, got "
+                             f"{self.pilot_chains}")
+        if not 0.0 <= self.burn_frac < 1.0:
+            raise ValueError(f"burn_frac must be in [0, 1), got "
+                             f"{self.burn_frac}")
+        if self.jitter_frac < 0.0:
+            raise ValueError(f"jitter_frac must be >= 0, got "
+                             f"{self.jitter_frac}")
+
+
+@dataclass
+class WarmStartFit:
+    """A fitted init distribution: ``K`` diagonal-Gaussian components
+    over the sampled parameter vector, plus the bookkeeping recovery
+    replays from. ``kind`` names the fit family in the registry
+    (``"gmm"`` today; a flow fit would add its own and carry its
+    parameters the same journaled way)."""
+
+    means: np.ndarray            # (K, p)
+    stds: np.ndarray             # (K, p)
+    weights: np.ndarray          # (K,)
+    kind: str = "gmm"
+    pilot_sweeps: int = 0
+    pilot_chains: int = 0
+    pilot_ms: float = 0.0
+    meta: Dict = field(default_factory=dict)
+
+    def draw_x0(self, nchains: int, seed: int,
+                specs: np.ndarray) -> np.ndarray:
+        """``(nchains, p)`` init draws from the mixture, clipped into
+        the prior support (an out-of-support x0 has −inf prior and the
+        MH blocks could never leave it). Deterministic in ``seed``
+        (numpy Philox) — the bitwise recovery-replay contract."""
+        rng = np.random.default_rng(
+            np.random.SeedSequence([int(seed) & 0xFFFFFFFF, 0x57A7]))
+        k = rng.choice(len(self.weights), size=nchains,
+                       p=np.asarray(self.weights, np.float64)
+                       / np.sum(self.weights))
+        x = (np.asarray(self.means, np.float64)[k]
+             + np.asarray(self.stds, np.float64)[k]
+             * rng.standard_normal((nchains, self.means.shape[1])))
+        return clip_to_support(x, specs)
+
+    def to_json(self) -> Dict:
+        return {
+            "kind": self.kind,
+            "means": np.asarray(self.means, np.float64).tolist(),
+            "stds": np.asarray(self.stds, np.float64).tolist(),
+            "weights": np.asarray(self.weights, np.float64).tolist(),
+            "pilot_sweeps": int(self.pilot_sweeps),
+            "pilot_chains": int(self.pilot_chains),
+        }
+
+    @classmethod
+    def from_json(cls, d: Dict) -> "WarmStartFit":
+        kind = d.get("kind", "gmm")
+        if kind not in FIT_KINDS:
+            raise ValueError(
+                f"unknown warm-start fit kind {kind!r} "
+                f"(known: {sorted(FIT_KINDS)})")
+        return cls(means=np.asarray(d["means"], np.float64),
+                   stds=np.asarray(d["stds"], np.float64),
+                   weights=np.asarray(d["weights"], np.float64),
+                   kind=kind,
+                   pilot_sweeps=int(d.get("pilot_sweeps", 0)),
+                   pilot_chains=int(d.get("pilot_chains", 0)))
+
+
+#: fit-family registry — the flow-ready seam: each kind maps to its
+#: reconstructing class (all journaled through the same admit-record
+#: JSON; serve/manifest.py)
+FIT_KINDS: Dict[str, type] = {"gmm": WarmStartFit}
+
+
+def clip_to_support(x: np.ndarray, specs: np.ndarray) -> np.ndarray:
+    """Clip ``(..., p)`` parameter draws into each prior's support
+    with a 1e-3-width inset on the bounded kinds (Uniform/LinearExp
+    carry [a, b] bounds; Normal is unbounded —
+    models/parameter.lnprior_specs)."""
+    specs = np.asarray(specs, np.float64)
+    kind = specs[:, 0].astype(int)
+    a, b = specs[:, 1], specs[:, 2]
+    bounded = kind != KIND_NORMAL
+    inset = 1e-3 * (b - a)
+    lo = np.where(bounded, a + inset, -np.inf)
+    hi = np.where(bounded, b - inset, np.inf)
+    return np.clip(np.asarray(x, np.float64), lo, hi)
+
+
+def fit_from_rows(rows: np.ndarray, spec: WarmStartSpec,
+                  prior_specs: np.ndarray,
+                  pilot_ms: float = 0.0) -> WarmStartFit:
+    """Moment-match the mixture from pilot x rows ``(rows, chains,
+    p)``: the leading ``burn_frac`` rows are discarded and each
+    chain's remainder becomes one diagonal-Gaussian component
+    (uniform weights) — per-chain matching keeps separated pilot
+    chains as separate components instead of averaging modes
+    together. Shared by both pilot paths (the in-pool pilot and the
+    standalone backend) so the fit cannot drift between them."""
+    rows = np.asarray(rows, np.float64)
+    burn = int(spec.burn_frac * rows.shape[0])
+    post = rows[burn:]
+    if post.shape[0] < 2:
+        raise ValueError(
+            f"pilot leaves {post.shape[0]} post-burn rows; need >= 2")
+    means = post.mean(axis=0).astype(np.float64)       # (K, p)
+    stds = post.std(axis=0, ddof=1).astype(np.float64)
+    # per-param std floor: jitter_frac of the prior scale (bounded
+    # kinds: the support width; Normal: sigma) so a stuck pilot
+    # column still yields a usable component
+    specs = np.asarray(prior_specs, np.float64)
+    kind = specs[:, 0].astype(int)
+    scale = np.where(kind == KIND_NORMAL, specs[:, 2],
+                     specs[:, 2] - specs[:, 1])
+    stds = np.maximum(stds, spec.jitter_frac * np.abs(scale))
+    K = means.shape[0]
+    return WarmStartFit(
+        means=means, stds=stds,
+        weights=np.full(K, 1.0 / K),
+        pilot_sweeps=rows.shape[0],
+        pilot_chains=means.shape[0],
+        pilot_ms=pilot_ms)
+
+
+def fit_warm_start(ma, config, spec: WarmStartSpec, seed: int,
+                   dtype=None) -> WarmStartFit:
+    """The STANDALONE pilot: a throwaway ``pilot_chains``-chain
+    backend samples ``pilot_sweeps`` sweeps of the tenant's own
+    (localized, padded) model in ``record="light"`` mode, then
+    :func:`fit_from_rows` moment-matches the mixture.
+
+    This path bakes the tenant model into the pilot trace, so EVERY
+    DISTINCT MODEL PAYS A COMPILE — measured seconds per tenant on
+    the 1-core host, which inverts the warm-start economics for a
+    multi-tenant pool. It exists for the serial (reference) driver
+    and solo/API use; the serving path runs the pilot ON the slot
+    pool's one compiled operand-fed program instead
+    (ChainServer._pool_pilot_fit — zero per-tenant recompiles, the
+    serve stack's core invariant)."""
+    import jax.numpy as jnp
+
+    from gibbs_student_t_tpu.backends.jax_backend import JaxGibbs
+
+    t0 = time.monotonic()
+    pb = JaxGibbs(ma, config, nchains=spec.pilot_chains,
+                  dtype=dtype or jnp.float32,
+                  chunk_size=spec.pilot_sweeps, record="light",
+                  tnt_block_size=None, use_pallas=False,
+                  telemetry=False)
+    res = pb.sample(niter=spec.pilot_sweeps, seed=seed)
+    return fit_from_rows(np.asarray(res.chain), spec, ma.specs_np,
+                         pilot_ms=(time.monotonic() - t0) * 1e3)
+
+
+def resolve_warm_start(request_warm, env: Optional[str] = None):
+    """The tenant's effective warm-start input under the env gate:
+    ``None`` (cold), a :class:`WarmStartSpec` (fit at staging), or a
+    :class:`WarmStartFit` (journaled — recovery replay). ``0``
+    force-disables (requests degrade; the bitwise-off arm); ``1``
+    defaults every tenant without a spec to ``WarmStartSpec()``."""
+    env = env if env is not None else warm_start_env()
+    if env == "0":
+        return None
+    if request_warm is None:
+        return WarmStartSpec() if env == "1" else None
+    if isinstance(request_warm, (WarmStartSpec, WarmStartFit)):
+        return request_warm
+    if isinstance(request_warm, dict):
+        return WarmStartFit.from_json(request_warm)
+    raise ValueError(
+        f"warm_start must be a WarmStartSpec, a WarmStartFit (or its "
+        f"JSON dict), or None, got {type(request_warm).__name__}")

@@ -33,6 +33,7 @@ class ChainSpool:
                  resume_at: Optional[int] = None,
                  record_mode: Optional[str] = None,
                  record_thin: int = 1,
+                 recycle: Optional[bool] = None,
                  extra_meta: Optional[Dict] = None,
                  fault_key=None):
         """``resume=True`` appends to an existing spool directory (after a
@@ -42,7 +43,14 @@ class ChainSpool:
         from a crash mid-append) are truncated away before appending.
         ``record_mode`` is persisted in ``meta.json`` so a spooled run's
         transport quantization (record="compact") stays discoverable; a
-        resume with a different mode is rejected. ``fault_key`` is the
+        resume with a different mode is rejected. ``recycle`` persists
+        the serving recycle tagging (parallel/recycle.py) the same way:
+        the spool always stores SCAN-END rows only (recycled rows are
+        reconstructible, so storing them would double every byte for
+        nothing), but a consumer reconstructing the recycled stream
+        must know the run's mode — so a resume that flips it
+        mid-stream is rejected (``None`` skips the check: solo runs
+        predating the flag). ``fault_key`` is the
         serve fault-injection identity (serve/faults.py): when set, the
         ``spool_io`` / ``kill_before_checkpoint`` /
         ``kill_after_checkpoint`` injection points arm inside
@@ -59,6 +67,7 @@ class ChainSpool:
         self.resume = resume
         self.resume_at = resume_at
         self.record_mode = record_mode
+        self.recycle = recycle
         # spool rows are RECORDED sweeps: with thinning, one row per
         # record_thin sweeps — sweep-indexed bookkeeping (base/resume_at)
         # divides by this to reach row counts
@@ -104,6 +113,15 @@ class ChainSpool:
                         f"resume record_thin {self.record_thin} does not "
                         f"match the spooled run's "
                         f"{meta.get('record_thin', 1)}")
+                prior_rec = meta.get("recycle")
+                if (self.recycle is not None and prior_rec is not None
+                        and bool(prior_rec) != bool(self.recycle)):
+                    raise ValueError(
+                        f"resume recycle={bool(self.recycle)} does not "
+                        f"match the spooled run's {bool(prior_rec)}; a "
+                        "mid-stream flip would desync downstream "
+                        "row-class reconstruction "
+                        "(parallel/recycle.py)")
                 base = meta.get("base", 0)
                 if self.resume_at is not None:
                     if (self.resume_at - base) % self.record_thin:
@@ -123,6 +141,7 @@ class ChainSpool:
                                "seed": self.seed, "base": base,
                                "record_mode": self.record_mode,
                                "record_thin": self.record_thin,
+                               "recycle": self.recycle,
                                "extra": self.extra_meta or {}}, fh)
             self._writers = {
                 f: self._native.SpoolWriter(

@@ -131,7 +131,13 @@ def test_remote_submit_matches_local_bitwise(demo):
 # subprocess fleet: replay determinism + the fleet wire
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_replay_determinism_across_subprocess_fleet(demo, tmp_path):
+    # re-tiered slow in round 17 (28 s of subprocess spawns) for the
+    # tier-1 870 s budget; tier-1 keeps the in-process
+    # remote-vs-local bitwise pin (test_remote_submit_matches_local_
+    # bitwise) and the router fakes, and this end-to-end arm still
+    # runs in every slow-tier pass
     """THE placement-independence pin at fleet scope: the same tenant
     stream served in-process by one pool and through a 2-pool
     subprocess fleet with a forced round-robin spread (different
@@ -300,10 +306,16 @@ def test_manifest_compaction_invariants(demo, tmp_path):
         assert rec_before[0][k] == rec_after[0][k], k
     _, _, kw_after = load_server_state(man)
     assert kw_before == kw_after
-    # the finished tenant's model pickle was pruned; S's kept
-    models = sorted(f for f in os.listdir(man)
-                    if f.startswith("model_"))
-    assert models == sorted(r["model_file"] for r in rec_after)
+    # the finished tenant's model blob was pruned from the content-
+    # addressed store (round 17: models/<digest>.pkl, one per
+    # DISTINCT model — here S and the finished tenant share the demo
+    # model only if their pytrees hash equal); exactly the digests
+    # the outstanding admits reference survive
+    from gibbs_student_t_tpu.serve.manifest import MODELS_DIR
+
+    models = sorted(os.path.join(MODELS_DIR, f)
+                    for f in os.listdir(os.path.join(man, MODELS_DIR)))
+    assert models == sorted({r["model_file"] for r in rec_after})
     # compacting a compacted manifest is a fixpoint
     assert compact_manifest(man) == len(read_manifest(man)) == kept
 

@@ -1,0 +1,519 @@
+"""Capacity-per-dollar tests: recycling Gibbs row tagging + weighted
+estimators (parallel/recycle.py, GST_RECYCLE) and the variational warm
+start (serve/warm.py, GST_WARM_START).
+
+The load-bearing contracts pinned here:
+
+- The interleaved recycled view reconstructs partial-scan states
+  exactly from adjacent recorded rows (the scan-order rule in
+  backends/jax_backend.py), with the cross-quantum carry making the
+  stream a strict prefix under cancel/evict.
+- Recycled rows add NO per-param information (each coordinate updates
+  once per scan): per-param ESS with the row-class filter equals the
+  scan-end computation, and the monitor's weighted Welford moments
+  match the interleaved stream's plain moments exactly.
+- Gates off is bitwise the old graph: a ``GST_RECYCLE=0`` server's
+  results and streamed records are identical to pre-round-17 serving,
+  and ``GST_WARM_START=0`` degrades a requesting tenant to the cold
+  prior init, bitwise.
+- The warm fit is deterministic, journaled JSON round-trips, draws
+  stay inside the prior support, and a pilot/fit failure degrades to
+  cold serving with an event — never a rejection.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tests.conftest import make_demo_pta
+from gibbs_student_t_tpu.config import GibbsConfig
+from gibbs_student_t_tpu.backends.jax_backend import (
+    _RECORD_FIELDS,
+    RECYCLE_EARLY_FIELDS,
+    RECYCLE_LATE_FIELDS,
+)
+from gibbs_student_t_tpu.parallel.recycle import (
+    ROW_RECYCLED,
+    ROW_SCAN_END,
+    interleave,
+    recycle_weights,
+    recycled_result,
+    row_class_pattern,
+    weighted_moments,
+)
+from gibbs_student_t_tpu.serve.warm import (
+    WarmStartFit,
+    WarmStartSpec,
+    clip_to_support,
+    fit_from_rows,
+    resolve_warm_start,
+    warm_start_env,
+)
+
+pytestmark = pytest.mark.recycle
+
+
+@pytest.fixture(scope="module")
+def demo():
+    pta = make_demo_pta()
+    return pta.frozen(0), GibbsConfig(model="mixture")
+
+
+# ----------------------------------------------------------------------
+# estimator units (jax-light)
+# ----------------------------------------------------------------------
+
+
+def test_scan_groups_partition_record_fields():
+    """The recycle groups must stay a partition of the record fields
+    — a new recorded field without a scan-phase assignment would
+    silently corrupt every reconstructed partial state."""
+    early, late = set(RECYCLE_EARLY_FIELDS), set(RECYCLE_LATE_FIELDS)
+    assert not early & late
+    assert early | late == set(_RECORD_FIELDS)
+
+
+def test_interleave_reconstruction_and_carry():
+    rng = np.random.default_rng(0)
+    cols = {"x": rng.normal(size=(4, 3, 2)),
+            "z": rng.normal(size=(4, 3, 5)),
+            "theta": rng.normal(size=(4, 3))}
+    out, rc, tail = interleave(cols)
+    assert list(rc) == [0, 1, 0, 1, 0, 1, 0]
+    # mid-row between k and k+1: EARLY fields (x) from k+1, LATE
+    # fields (z, theta) from k
+    for k in range(3):
+        assert np.array_equal(out["x"][2 * k + 1], cols["x"][k + 1])
+        assert np.array_equal(out["z"][2 * k + 1], cols["z"][k])
+        assert np.array_equal(out["theta"][2 * k + 1],
+                              cols["theta"][k])
+        assert np.array_equal(out["x"][2 * k], cols["x"][k])
+    assert np.array_equal(tail["z"], cols["z"][-1])
+    # the next span continues seamlessly through the carry row
+    nxt = {f: rng.normal(size=(2,) + a.shape[1:])
+           for f, a in cols.items()}
+    out2, rc2, _ = interleave(nxt, prev_tail=tail)
+    assert list(rc2) == [1, 0, 1, 0]
+    assert np.array_equal(out2["x"][0], nxt["x"][0])     # early: next
+    assert np.array_equal(out2["z"][0], cols["z"][-1])   # late: carry
+    # concatenated spans == one interleave over the whole run (the
+    # prefix contract a cancelled/evicted tenant relies on)
+    whole = {f: np.concatenate([cols[f], nxt[f]]) for f in cols}
+    outw, rcw, _ = interleave(whole)
+    for f in cols:
+        assert np.array_equal(np.concatenate([out[f], out2[f]]),
+                              outw[f]), f
+    assert np.array_equal(np.concatenate([rc, rc2]), rcw)
+
+
+def test_row_class_pattern_shapes():
+    assert list(row_class_pattern(1, False)) == [0]
+    assert list(row_class_pattern(1, True)) == [1, 0]
+    assert list(row_class_pattern(3, False)) == [0, 1, 0, 1, 0]
+    assert row_class_pattern(0, True).size == 0
+
+
+def test_weighted_moments_uniform_matches_plain():
+    rng = np.random.default_rng(1)
+    w = rng.normal(size=(9, 4))
+    mean, var = weighted_moments(w, np.ones(9))
+    assert np.allclose(mean, w.mean(axis=0), atol=1e-12)
+    assert np.allclose(var, w.var(axis=0), atol=1e-12)
+    rc = row_class_pattern(5, False)
+    assert recycle_weights(rc).sum() == pytest.approx(1.0)
+
+
+def test_ess_per_param_drops_recycled_rows():
+    from gibbs_student_t_tpu.parallel.diagnostics import (
+        ess_per_param,
+        split_rhat_per_param,
+    )
+
+    rng = np.random.default_rng(2)
+    cols = {"x": rng.normal(size=(40, 4, 3)),
+            "z": rng.normal(size=(40, 4, 2))}
+    out, rc, _ = interleave(cols)
+    keep = out["x"][rc == ROW_SCAN_END]
+    assert np.array_equal(keep, cols["x"])
+    assert np.allclose(ess_per_param(out["x"], row_class=rc),
+                       ess_per_param(cols["x"]))
+    assert np.allclose(split_rhat_per_param(out["x"], row_class=rc),
+                       split_rhat_per_param(cols["x"]))
+
+
+def test_monitor_weighted_welford_matches_interleaved_stream():
+    """The monitor's recycled fold (weight 2 on carried rows) must
+    equal plain Welford over the actual interleaved x stream — the
+    Rao-Blackwellized moments without materializing the stream."""
+    from gibbs_student_t_tpu.serve.monitor import (
+        MonitorSpec,
+        TenantMonitor,
+    )
+
+    rng = np.random.default_rng(3)
+    rows = rng.normal(size=(12, 4, 2)).astype(np.float32)
+    spec = MonitorSpec(params=[0, 1], every=1000)
+    mon = TenantMonitor(spec, 4, np.array([0, 1]))
+    # quantum 4: first update has 3 recycled rows (no carry yet),
+    # later updates carry across the boundary
+    mon.update(rows[:4], 4, recycled=3)
+    mon.update(rows[4:8], 8, recycled=4)
+    mon.update(rows[8:], 12, recycled=4)
+    # the interleaved x stream duplicates every row except the first
+    stream = np.concatenate([rows[:1], np.repeat(rows[1:], 2, axis=0)])
+    assert mon._w_n == pytest.approx(stream.shape[0])
+    assert np.allclose(mon._w_mean,
+                       stream.astype(np.float64).mean(axis=0),
+                       atol=1e-9)
+    var = stream.astype(np.float64).var(axis=0, ddof=0)
+    assert np.allclose(mon._w_m2 / mon._w_n, var, atol=1e-9)
+    assert mon.snapshot()["recycled_rows"] == 11
+
+
+# ----------------------------------------------------------------------
+# env gates (strict auto|1|0)
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("var,fn", [
+    ("GST_RECYCLE", "serve_recycle_env"),
+    ("GST_WARM_START", None),
+])
+def test_env_gate_validation(var, fn, monkeypatch):
+    if fn is None:
+        check = warm_start_env
+    else:
+        from gibbs_student_t_tpu.serve import server as srv_mod
+
+        check = getattr(srv_mod, fn)
+    monkeypatch.setenv(var, "bogus")
+    with pytest.raises(ValueError, match=var):
+        check()
+    for v in ("auto", "1", "0"):
+        monkeypatch.setenv(var, v)
+        assert check() == v
+    monkeypatch.delenv(var)
+    assert check() == "auto"
+
+
+# ----------------------------------------------------------------------
+# warm-start units (jax-light)
+# ----------------------------------------------------------------------
+
+
+def _toy_specs():
+    # (kind, a, b, init): uniform [0,1], normal(0,1), linearexp [-2,-1]
+    return np.array([[0, 0.0, 1.0, 0.5],
+                     [1, 0.0, 1.0, 0.0],
+                     [2, -2.0, -1.0, -1.5]])
+
+
+def test_fit_from_rows_and_draws():
+    rng = np.random.default_rng(4)
+    rows = rng.normal(size=(20, 3, 3)) * 0.1 + 0.4
+    spec = WarmStartSpec(pilot_sweeps=16, pilot_chains=3,
+                         burn_frac=0.5)
+    fit = fit_from_rows(rows, spec, _toy_specs(), pilot_ms=7.0)
+    assert fit.means.shape == (3, 3) and fit.stds.shape == (3, 3)
+    assert np.allclose(fit.means, rows[10:].mean(axis=0))
+    assert (fit.stds > 0).all()          # the jitter floor
+    # deterministic draws, inside the prior support
+    x1 = fit.draw_x0(16, seed=9, specs=_toy_specs())
+    x2 = fit.draw_x0(16, seed=9, specs=_toy_specs())
+    assert np.array_equal(x1, x2)
+    assert x1.shape == (16, 3)
+    assert (x1[:, 0] >= 0).all() and (x1[:, 0] <= 1).all()
+    assert (x1[:, 2] >= -2).all() and (x1[:, 2] <= -1).all()
+    assert not np.array_equal(x1, fit.draw_x0(16, seed=10,
+                                              specs=_toy_specs()))
+    # journal round-trip replays bitwise
+    fit2 = WarmStartFit.from_json(
+        json.loads(json.dumps(fit.to_json())))
+    assert np.array_equal(fit2.draw_x0(16, 9, _toy_specs()), x1)
+    with pytest.raises(ValueError, match="unknown warm-start"):
+        WarmStartFit.from_json({"kind": "flow9", "means": [],
+                                "stds": [], "weights": []})
+
+
+def test_clip_to_support_unbounded_normal():
+    x = np.array([[5.0, 5.0, 5.0]])
+    c = clip_to_support(x, _toy_specs())
+    assert c[0, 1] == 5.0                 # normal: unbounded
+    assert c[0, 0] < 1.0 and c[0, 2] < -1.0
+
+
+def test_resolve_warm_start_semantics():
+    spec = WarmStartSpec()
+    assert resolve_warm_start(None, env="auto") is None
+    assert resolve_warm_start(spec, env="auto") is spec
+    assert resolve_warm_start(spec, env="0") is None
+    assert isinstance(resolve_warm_start(None, env="1"),
+                      WarmStartSpec)
+    fit = resolve_warm_start(
+        {"kind": "gmm", "means": [[0.0]], "stds": [[1.0]],
+         "weights": [1.0]}, env="auto")
+    assert isinstance(fit, WarmStartFit)
+    with pytest.raises(ValueError, match="warm_start"):
+        resolve_warm_start(object(), env="auto")
+    with pytest.raises(ValueError, match="pilot_sweeps"):
+        WarmStartSpec(pilot_sweeps=2)
+
+
+def test_spool_recycle_mode_mismatch(tmp_path):
+    from gibbs_student_t_tpu import native
+    from gibbs_student_t_tpu.utils.spool import ChainSpool
+
+    if not native.available():
+        pytest.skip("native spool writer unavailable")
+    from gibbs_student_t_tpu.backends.jax_backend import ChainState
+
+    d = str(tmp_path / "sp")
+    recs = {"x": np.zeros((2, 3, 1), np.float32)}
+    st = ChainState(*(np.zeros((3, 1), np.float32)
+                      for _ in range(9)))
+    sp = ChainSpool(d, seed=0, recycle=True)
+    sp.append(recs, st, 2)
+    sp.close()
+    with open(os.path.join(d, "meta.json")) as fh:
+        assert json.load(fh)["recycle"] is True
+    sp2 = ChainSpool(d, seed=0, resume=True, resume_at=2,
+                     recycle=False)
+    with pytest.raises(ValueError, match="recycle"):
+        sp2.append(recs, st, 4)
+    # matching mode resumes fine
+    sp3 = ChainSpool(d, seed=0, resume=True, resume_at=2,
+                     recycle=True)
+    sp3.append(recs, st, 4)
+    sp3.close()
+
+
+# ----------------------------------------------------------------------
+# serve integration (pool compiles are the tier-1 budget: ONE shared
+# recycle-on server serves every gate-on test; the gates-off bitwise
+# arm keeps its own short-lived pool; the 4-server warm pool-pilot
+# pin rides the slow tier)
+# ----------------------------------------------------------------------
+
+
+def _mk_server(ma, cfg, recycle, env=None):
+    from gibbs_student_t_tpu.serve import ChainServer
+
+    old = {}
+    env = env or {}
+    for k, v in env.items():
+        old[k] = os.environ.get(k)
+        os.environ[k] = v
+    try:
+        return ChainServer(ma, cfg, nlanes=32, quantum=5,
+                           recycle=recycle, spans=False, flight=False,
+                           watchdog=False)
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _run_tenant(srv, ma, niter=15, nchains=16, seed=3,
+                with_monitor=True, warm_start=None, on_chunk=None):
+    from gibbs_student_t_tpu.serve import MonitorSpec, TenantRequest
+
+    mon = (MonitorSpec(params=[0, 1], ess_target=1e9)
+           if with_monitor else None)
+    h = srv.submit(TenantRequest(
+        ma=ma, niter=niter, nchains=nchains, seed=seed, monitor=mon,
+        warm_start=warm_start, on_chunk=on_chunk))
+    srv.run()
+    return h.result(), h
+
+
+@pytest.fixture(scope="module")
+def pool_on(demo):
+    ma, cfg = demo
+    srv = _mk_server(ma, cfg, recycle=True)
+    yield srv
+    srv.close()
+
+
+def test_serve_recycle_integration(demo, pool_on):
+    """One pool pass pins the serving half: row-class tags on
+    streamed records, per-tenant/monitor accounting, result
+    reconstruction, and gates-off bitwise identity."""
+    ma, cfg = demo
+    chunks = []
+    r_on, h_on = _run_tenant(
+        pool_on, ma,
+        on_chunk=lambda hh, s, r: chunks.append((s, r)))
+    # on_chunk contract: materialized records + the row-class tag
+    assert chunks and all("row_class" in r for _, r in chunks)
+    assert list(chunks[0][1]["row_class"]) == [0, 1, 0, 1, 0, 1, 0,
+                                               1, 0]
+    assert list(chunks[1][1]["row_class"])[:2] == [1, 0]  # the carry
+    # accounting: 14 recycled rows (15 rows, first not recycled) x 16
+    assert h_on.recycled_rows == 14 * 16
+    assert h_on._monitor.snapshot()["recycled_rows"] == 14
+    assert r_on.stats["recycle"] == {
+        "enabled": True, "recycled_lane_rows": 224}
+    assert pool_on.summary()["recycle"]["enabled"] is True
+    assert pool_on.summary()["recycle"]["recycled_lane_rows"] >= 224
+    # reconstruction: the recycled view is built FROM the result,
+    # never stored — spot-check a mid-row against the scan rule
+    cols, rc = recycled_result(r_on)
+    assert rc.size == 2 * 15 - 1
+    assert np.array_equal(cols["x"][1], np.asarray(r_on.chain)[1])
+    assert np.array_equal(cols["z"][1], np.asarray(r_on.zchain)[0])
+    assert (rc == ROW_RECYCLED).sum() == 14
+    # gates off: bitwise the old graph — chains, stats, no tags
+    chunks_off = []
+    srv_off = _mk_server(ma, cfg, recycle=False,
+                         env={"GST_RECYCLE": "0"})
+    try:
+        r_off, h_off = _run_tenant(
+            srv_off, ma,
+            on_chunk=lambda hh, s, r: chunks_off.append((s, r)))
+    finally:
+        srv_off.close()
+    assert all("row_class" not in r for _, r in chunks_off)
+    assert h_off.recycled_rows == 0
+    assert "recycle" not in r_off.stats
+    assert np.array_equal(np.asarray(r_on.chain),
+                          np.asarray(r_off.chain))
+    assert np.array_equal(np.asarray(r_on.zchain),
+                          np.asarray(r_off.zchain))
+    # env forces beat the constructor (the strict-gate contract) —
+    # a construction-level resolution, no pool run needed
+    srv_f = _mk_server(ma, cfg, recycle=False,
+                       env={"GST_RECYCLE": "1"})
+    try:
+        assert srv_f.recycle is True
+        assert srv_f.summary()["recycle"]["enabled"] is True
+    finally:
+        srv_f.close()
+    srv_f0 = _mk_server(ma, cfg, recycle=True,
+                        env={"GST_RECYCLE": "0"})
+    try:
+        assert srv_f0.recycle is False
+    finally:
+        srv_f0.close()
+
+
+def test_quarantine_and_cancel_recycle_edges(demo, pool_on):
+    """The two in-flight edges of the recycled stream: quarantined
+    lanes mint no partial states (excluded from the delivered
+    count), and a mid-run cancel leaves a tagged stream that is a
+    strict prefix of the uninterrupted run's."""
+    from gibbs_student_t_tpu.serve import TenantRequest
+
+    ma, cfg = demo
+    srv = pool_on
+    chunks_q, chunks_c = [], []
+
+    def quarantine_after_first(hh, sweep_end, records):
+        chunks_q.append((sweep_end, records["row_class"]))
+        if len(chunks_q) == 1:
+            # freeze 4 of the tenant's chains between quanta — the
+            # accounting must stop counting their partial states
+            ent = srv._running.get(hh.tenant_id)
+            if ent is not None:
+                ent.slot.quarantined.update(range(4))
+
+    def cancel_after_first(hh, sweep_end, records):
+        chunks_c.append((sweep_end, records["row_class"]))
+        if len(chunks_c) == 1:
+            srv.cancel(hh)
+
+    hq = srv.submit(TenantRequest(
+        ma=ma, niter=15, nchains=16, seed=5,
+        on_chunk=quarantine_after_first))
+    hc = srv.submit(TenantRequest(
+        ma=ma, niter=25, nchains=16, seed=6,
+        on_chunk=cancel_after_first))
+    srv.run()
+    rq, rc_res = hq.result(), hc.result()
+    # quarantine arm: q1 -> 4 recycled rows x 16 active; q2/q3 ->
+    # 5 rows x 12 active (4 lanes frozen)
+    assert hq.recycled_rows == 4 * 16 + 5 * 12 + 5 * 12
+    # cancel arm: frozen before its budget; the tagged stream is a
+    # strict prefix — served rows r give r-1 (+carry) recycled rows
+    served = rc_res.chain.shape[0]
+    assert served < 25
+    assert hc.recycled_rows == (served - 1) * 16
+    # and the reconstructed stream of the partial result is exactly
+    # the prefix of the interleave rule over the served rows
+    cols, tag = recycled_result(rc_res)
+    assert tag.size == 2 * served - 1
+    assert int((tag == ROW_RECYCLED).sum()) == served - 1
+
+
+def test_warm_degradation_on_pilot_failure(demo, pool_on,
+                                           monkeypatch):
+    """A broken pilot/fit degrades to cold serving with the event —
+    never a rejection (the silent-degradation contract)."""
+    ma, cfg = demo
+    from gibbs_student_t_tpu.serve import server as srv_mod
+
+    def boom(self, handle, spec):
+        raise RuntimeError("pilot exploded")
+
+    monkeypatch.setattr(srv_mod.ChainServer, "_pool_pilot_fit", boom)
+    before = pool_on.summary()["warm"]["degraded"]
+    r, h = _run_tenant(pool_on, ma, seed=11,
+                       warm_start=WarmStartSpec())
+    assert h.status == "done"
+    assert "pilot exploded" in h.warm["degraded"]
+    assert pool_on.summary()["warm"]["degraded"] == before + 1
+
+
+@pytest.mark.slow
+def test_warm_start_pool_pilot_and_replay(demo):
+    """The pipelined pool-pilot warm start: fit attached, init draws
+    differ from cold, the run is deterministic (which is what makes
+    the journaled-fit recovery replay bitwise), and
+    GST_WARM_START=0 degrades a requesting tenant to the cold init
+    bitwise. Slow tier: four pool compiles."""
+    ma, cfg = demo
+    spec = WarmStartSpec(pilot_sweeps=10, pilot_chains=8)
+
+    def one(warm, env=None):
+        srv = _mk_server(ma, cfg, recycle=False, env=env)
+        old = {k: os.environ.get(k) for k in (env or {})}
+        for k, v in (env or {}).items():
+            os.environ[k] = v
+        try:
+            res, h = _run_tenant(srv, ma, warm_start=warm)
+            summary = srv.summary()
+        finally:
+            srv.close()
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        return res, h, summary
+
+    r_w, h_w, s_w = one(spec)
+    assert h_w.warm is not None and h_w.warm["kind"] == "gmm"
+    assert s_w["warm"]["warm_starts"] == 1
+    assert r_w.stats["warm"]["kind"] == "gmm"
+    # cold arm: different init, different chains
+    r_c, h_c, _ = one(None)
+    assert h_c.warm is None
+    assert not np.array_equal(np.asarray(r_w.chain),
+                              np.asarray(r_c.chain))
+    # pool-pilot determinism: the pilot rides the pool with the
+    # tenant's seed and the lane-position-independent draw contract,
+    # so a rerun fits the SAME mixture and draws the SAME init —
+    # which is also why the journaled-fit recovery replay (the
+    # fit->json->fit path pinned in test_fit_from_rows_and_draws)
+    # reproduces the run bitwise
+    r_w2, _, _ = one(spec)
+    assert np.array_equal(np.asarray(r_w.chain),
+                          np.asarray(r_w2.chain))
+    # forced off: requested warm start serves cold, bitwise
+    r_d, h_d, _ = one(spec, env={"GST_WARM_START": "0"})
+    assert h_d.warm == {"degraded": "GST_WARM_START=0"}
+    assert np.array_equal(np.asarray(r_d.chain),
+                          np.asarray(r_c.chain))

@@ -12,6 +12,7 @@ stream abruptly and the client's handle resolves to a
 ConnectionError instead of hanging.
 """
 
+import os
 import socket
 import struct
 import threading
@@ -627,3 +628,187 @@ def test_retryable_rechecks_generation_after_wait_timeout():
 
     assert rh._retryable(fn) == "served"
     assert calls == ["old", "new"]
+
+
+# ---------------------------------------------------------------------------
+# cost-aware placement (ROADMAP 1b) + the content-addressed model cache
+# (ROADMAP 1c) + the warm-start wire (round 17)
+# ---------------------------------------------------------------------------
+
+
+def _tenant_entry(niter, sweeps_done, nchains, est=None, eff=None):
+    t = {"niter": niter, "sweeps_done": sweeps_done,
+         "nchains": nchains, "cost": {"ess_per_core_s": eff}}
+    if est is not None:
+        t["est_sweeps_to_target"] = est
+    return t
+
+
+def test_cost_aware_placement_prefers_draining_pool():
+    """Equal queue/lanes/occupancy: the pool whose resident tenants
+    are nearly converged (small est_sweeps_to_target) wins over one
+    that just admitted its residents — and without tenant evidence
+    the legacy ordering is untouched."""
+    from gibbs_student_t_tpu.serve.router import FleetRouter
+
+    near = _FakePool("near")
+    far = _FakePool("far")
+    near.status = lambda: dict(_FakePool.status(near), tenants=[
+        _tenant_entry(200, 100, 16, est=10)])
+    far.status = lambda: dict(_FakePool.status(far), tenants=[
+        _tenant_entry(200, 100, 16, est=90)])
+    r = _router([far, near])
+    req = TenantRequest(ma={}, niter=5, nchains=4, name="c")
+    r.submit(req)
+    assert near.submitted and not far.submitted
+    # est capped by the remaining budget (an evict tenant never
+    # serves past either)
+    st = dict(_FakePool.status(near), tenants=[
+        _tenant_entry(200, 190, 16, est=500)])
+    assert FleetRouter._est_backlog(st) == 10 * 16
+    # no est -> remaining budget; no tenants -> 0 (legacy ordering)
+    st2 = dict(_FakePool.status(near), tenants=[
+        _tenant_entry(200, 150, 8)])
+    assert FleetRouter._est_backlog(st2) == 50 * 8
+    assert FleetRouter._est_backlog(_FakePool.status(far)) == 0.0
+
+
+def test_cost_aware_placement_efficiency_and_tiebreak():
+    """Backlog equal: higher pool ess_per_core_s wins; everything
+    equal: the LOWEST pool index wins (the pinned deterministic
+    tie-break)."""
+    from gibbs_student_t_tpu.serve.router import FleetRouter
+
+    slow = _FakePool("slow")
+    fast = _FakePool("fast")
+    slow.status = lambda: dict(_FakePool.status(slow), tenants=[
+        _tenant_entry(100, 50, 16, est=20, eff=100.0)])
+    fast.status = lambda: dict(_FakePool.status(fast), tenants=[
+        _tenant_entry(100, 50, 16, est=20, eff=900.0)])
+    r = _router([slow, fast])
+    r.submit(TenantRequest(ma={}, niter=5, nchains=4, name="e"))
+    assert fast.submitted and not slow.submitted
+    # the full tie: identical snapshots -> index order
+    a, b = _FakePool("a"), _FakePool("b")
+    r2 = _router([a, b])
+    r2.submit(TenantRequest(ma={}, niter=5, nchains=4, name="t"))
+    assert a.submitted and not b.submitted
+    assert (FleetRouter._load_score(a.status())
+            == FleetRouter._load_score(b.status()))
+
+
+def test_warm_start_rides_the_wire():
+    from gibbs_student_t_tpu.serve.rpc import (
+        _request_body,
+        _request_from_body,
+    )
+    from gibbs_student_t_tpu.serve.warm import (
+        WarmStartFit,
+        WarmStartSpec,
+    )
+
+    spec = WarmStartSpec(pilot_sweeps=12, pilot_chains=3,
+                         burn_frac=0.25)
+    req = TenantRequest(ma={"m": 1}, niter=5, nchains=4, name="w",
+                        warm_start=spec)
+    body = _request_body(req)
+    req2 = _request_from_body(dict(body, ma={"m": 1}))
+    assert isinstance(req2.warm_start, WarmStartSpec)
+    assert req2.warm_start.pilot_sweeps == 12
+    assert req2.warm_start.burn_frac == 0.25
+    # a journaled fit passes through as its JSON dict (staging
+    # reconstructs it — the recovery replay path)
+    fit = WarmStartFit(means=np.zeros((1, 2)), stds=np.ones((1, 2)),
+                       weights=np.ones(1))
+    req3 = TenantRequest(ma={"m": 1}, niter=5, nchains=4, name="f",
+                         warm_start=fit)
+    body3 = _request_body(req3)
+    req4 = _request_from_body(dict(body3, ma={"m": 1}))
+    assert isinstance(req4.warm_start, dict)
+    assert req4.warm_start["kind"] == "gmm"
+
+
+def test_model_digest_negotiation_over_stub():
+    """Submit the same model twice: the second submit omits the
+    pickled model (digest hit). A fresh server that never saw the
+    digest answers ``need_model`` and the client falls back — no
+    caller-visible difference either way."""
+    from gibbs_student_t_tpu.serve.rpc import (
+        RemoteChainServer,
+        RpcServer,
+    )
+
+    stub = _StubServer()
+    seen = []
+    orig = stub.submit
+
+    def spy(request, timeout=None):
+        seen.append(request.ma)
+        return orig(request, timeout)
+
+    stub.submit = spy
+    rs = RpcServer(stub)
+    try:
+        cl = RemoteChainServer((rs.host, rs.port))
+        ma = {"data": np.arange(4).tolist()}
+        req = TenantRequest(ma=ma, niter=5, nchains=4, name="m1")
+        cl.submit(req)
+        cl.submit(req)          # digest hit: model not re-sent
+        assert len(seen) == 2 and seen[0] == ma and seen[1] == ma
+        assert len(cl._server_has) == 1
+        # a NEW client against the same server: first submit already
+        # omits nothing, but a client that WRONGLY believes the
+        # server has a digest recovers through need_model
+        cl2 = RemoteChainServer((rs.host, rs.port))
+        d = cl2._digest_of(ma)
+        with rs._model_lock:
+            rs._model_cache.clear()    # force the miss
+        cl2._server_has.add(d)
+        cl2.submit(req)
+        assert len(seen) == 3 and seen[2] == ma
+    finally:
+        rs.close()
+
+
+def test_manifest_model_store_content_addressed(tmp_path):
+    """One blob per distinct model, shared across admits; compaction
+    prunes unreferenced digests (ROADMAP 1c)."""
+    from gibbs_student_t_tpu.serve.manifest import (
+        MODELS_DIR,
+        ServerManifest,
+        load_tenant_model,
+        outstanding_tenants,
+    )
+
+    d = str(tmp_path / "man")
+    man = ServerManifest(d)
+    man.record_server({"t": 1}, {"c": 2}, {"nlanes": 32})
+    ma = {"model": list(range(16))}
+    req1 = TenantRequest(ma=ma, niter=5, nchains=4, name="a",
+                         spool_dir=str(tmp_path / "s1"))
+    req2 = TenantRequest(ma=ma, niter=5, nchains=4, name="b",
+                         spool_dir=str(tmp_path / "s2"))
+    man.record_admit(0, req1, model=ma,
+                     warm={"kind": "gmm", "means": [[0.0]],
+                           "stds": [[1.0]], "weights": [1.0]})
+    man.record_admit(1, req2, model=ma)
+    mdir = os.path.join(d, MODELS_DIR)
+    assert len(os.listdir(mdir)) == 1      # stored once
+    recoverable, _ = outstanding_tenants(d)
+    assert len(recoverable) == 2
+    assert recoverable[0]["model_digest"] == \
+        recoverable[1]["model_digest"]
+    assert recoverable[0]["warm"]["kind"] == "gmm"
+    assert load_tenant_model(d, recoverable[0]) == ma
+    # tenant 1 finishes; compaction keeps the digest tenant 0 (still
+    # outstanding) references
+    man.record_done(1, "done", 5)
+    man.compact()
+    assert len(os.listdir(mdir)) == 1
+    recoverable2, _ = outstanding_tenants(d)
+    assert [r["tenant"] for r in recoverable2] == [0]
+    assert load_tenant_model(d, recoverable2[0]) == ma
+    # last one done: the blob is pruned
+    man.record_done(0, "done", 5)
+    man.compact()
+    assert os.listdir(mdir) == []

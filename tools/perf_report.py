@@ -236,6 +236,33 @@ def print_report(ledger_recs, include_rounds=True):
                       f"evictions={ev.get('converged_evictions')} "
                       f"sweeps_saved={ev.get('sweeps_saved_frac')} "
                       f"ess_min_mean={ev.get('ess_min_mean')}")
+            # capacity-per-dollar sub-lines (round-17 records):
+            # warm-start economics, recycled-row accounting, and the
+            # content-addressed model-cache probe
+            wm = m.get("warm")
+            if isinstance(wm, dict):
+                print(f"    warm jobs/h {wm.get('jobs_per_hour')} "
+                      f"(evict {wm.get('jobs_per_hour_evict')} / base "
+                      f"{wm.get('jobs_per_hour_base')}; "
+                      f"{(wm.get('gain_vs_evict') or 0) * 100:+.1f}% "
+                      f"vs evict) warm_starts={wm.get('warm_starts')} "
+                      f"degraded={wm.get('warm_degraded')} "
+                      f"pilot_ms={wm.get('pilot_ms_total')}")
+            rcy = m.get("recycle")
+            if isinstance(rcy, dict):
+                print(f"    recycle rows x{rcy.get('row_multiplier')} "
+                      f"({rcy.get('recycled_lane_rows')} recycled on "
+                      f"{rcy.get('served_lane_rows')} served) "
+                      f"functional_ess x"
+                      f"{rcy.get('functional_ess_multiplier')}")
+            mc = m.get("model_cache")
+            if isinstance(mc, dict):
+                print(f"    model_cache manifest "
+                      f"{mc.get('manifest_bytes')}B vs "
+                      f"{mc.get('manifest_bytes_before')}B per-admit; "
+                      f"submit p50 {mc.get('submit_full_p50_ms')}ms "
+                      f"full -> {mc.get('submit_digest_p50_ms')}ms "
+                      f"digest")
             # chaos-arm sub-line (serve_bench --faults records)
             f = m.get("faults")
             if isinstance(f, dict):
@@ -715,6 +742,42 @@ def check_serve(ledger_recs, min_occupancy, min_serve_ratio,
     return 0
 
 
+def check_ess_per_core(ledger_recs, min_ess_per_core_s):
+    """Capacity-per-dollar gate (round 17): the latest ``serve_bench``
+    record's mean per-tenant ``cost.ess_per_core_s`` — delivered
+    statistics per attributed compute — must stay at or above the
+    floor. Trend-class economics, so the default floor is 0
+    (record-only) until a flagship baseline arms it. Records-but-
+    SKIPS when the record carries no monitored cost evidence (monitor
+    absent / --no-obs-arm style runs): a run that measured nothing is
+    not a regression."""
+    serve = [r for r in ledger_recs if r.get("tool") == "serve_bench"]
+    if not serve:
+        print("check: no serve_bench record — ess/core-s gate skipped")
+        return 0
+    m = serve[-1].get("metrics") or {}
+    tenants = (m.get("cost") or {}).get("tenants") or {}
+    vals = [t.get("ess_per_core_s") for t in tenants.values()
+            if isinstance(t, dict)
+            and isinstance(t.get("ess_per_core_s"), (int, float))]
+    if not vals:
+        print("check: ess/core-s gate skipped — latest serve_bench "
+              "record carries no monitored cost evidence (monitor "
+              "absent)")
+        return 0
+    mean = sum(vals) / len(vals)
+    print(f"check: serve ess_per_core_s mean {mean:.1f} over "
+          f"{len(vals)} tenants (min {min_ess_per_core_s})")
+    if mean < min_ess_per_core_s:
+        print(f"check: FAIL — delivered ESS per core-second "
+              f"{mean:.1f} < {min_ess_per_core_s} (the pool is "
+              "spending compute on sweeps that buy no requested "
+              "statistics: check the recycle/warm blocks and the "
+              "evict arm)")
+        return 2
+    return 0
+
+
 def check_fleet(ledger_recs, min_fleet_ratio, max_admission_p99):
     """Fleet gate over the latest ``fleet_bench`` record: aggregate
     throughput over N pools vs the bracketing single-pool arms. On one
@@ -869,6 +932,16 @@ def main(argv=None):
                          "~37s by design — hence the loose default: "
                          "this is a starvation guard, not a tuning "
                          "target)")
+    ap.add_argument("--min-ess-per-core-s", type=float, default=0.0,
+                    metavar="X",
+                    help="capacity gate: minimum mean per-tenant "
+                         "cost.ess_per_core_s (delivered min-ESS per "
+                         "attributed core-second) the latest "
+                         "serve_bench record must report; records-"
+                         "but-skips when the record carries no "
+                         "monitored cost evidence. Default 0 = "
+                         "record-only until a flagship baseline arms "
+                         "a floor")
     ap.add_argument("--min-fleet-ratio", type=float, default=3.5,
                     metavar="X",
                     help="fleet gate: minimum aggregate/single-pool "
@@ -936,11 +1009,12 @@ def main(argv=None):
                                  args.min_fault_ratio)
         rc_fleet = check_fleet(recs, args.min_fleet_ratio,
                                args.max_fleet_admission_p99)
+        rc_ess = check_ess_per_core(recs, args.min_ess_per_core_s)
         rc_trend = check_trend(recs, args.max_trend_drop,
                                window=args.trend_window,
                                points=args.trend_points)
         return (rc or rc_serve or rc_obs or rc_faults or rc_fleet
-                or rc_trend)
+                or rc_ess or rc_trend)
     return 0
 
 

@@ -141,7 +141,26 @@ def main(argv=None):
                          "converged when pooled min-ESS over the "
                          "monitored subset reaches this — the "
                          "submit->converged SLO leg")
+    ap.add_argument("--warm-arm", action="store_true",
+                    help="with --evict-arm: repeat the evict workload "
+                         "with a variational warm start on every "
+                         "tenant (serve/warm.py, arXiv:2405.08857) — "
+                         "chains init from a moment-matched pilot "
+                         "mixture instead of the prior, so the "
+                         "monitor's early windows see no init "
+                         "transient and the eviction verdict lands "
+                         "quanta sooner; the record gains a 'warm' "
+                         "block (jobs/hour vs the evict and base "
+                         "arms at the same --ess-target)")
+    ap.add_argument("--pilot-sweeps", type=int, default=32,
+                    help="warm-start pilot sweeps (staging-thread "
+                         "cost per tenant; serve/warm.py)")
+    ap.add_argument("--pilot-chains", type=int, default=8,
+                    help="warm-start pilot chains")
     args = ap.parse_args(argv)
+    if args.warm_arm and not args.evict_arm:
+        ap.error("--warm-arm requires --evict-arm (it is the evict "
+                 "workload with warm starts)")
     if args.quick:
         args.nlanes = 64
         args.tenants = 6
@@ -175,6 +194,7 @@ def main(argv=None):
         ChainServer,
         MonitorSpec,
         TenantRequest,
+        WarmStartSpec,
     )
 
     platform = jax.default_backend()
@@ -226,7 +246,8 @@ def main(argv=None):
     budgets = [int(rng.integers(args.quanta_min, args.quanta_max + 1))
                * args.quantum for _ in range(args.tenants)]
 
-    def run_workload(mods=None, obs=True):
+    def run_workload(mods=None, obs=True, warm_warmup=False,
+                     demand=False):
         """One staggered mixed-tenant phase on a fresh server; ``mods``
         maps tenant index -> TenantRequest kwargs overrides (the fault
         arm's victim instrumentation). ``obs`` arms the full
@@ -261,9 +282,14 @@ def main(argv=None):
             return TenantRequest(**kw)
 
         # warmup: compile the pool program outside the timed window
-        w = srv.submit(TenantRequest(ma=template, niter=args.quantum,
-                                     nchains=srv.pool.group,
-                                     seed=args.seed))
+        # (warm_warmup also pre-compiles the warm-start PILOT program
+        # — the warm arm's first tenant must not pay it in-window)
+        w = srv.submit(TenantRequest(
+            ma=template, niter=args.quantum, nchains=srv.pool.group,
+            seed=args.seed,
+            warm_start=(WarmStartSpec(pilot_sweeps=args.pilot_sweeps,
+                                      pilot_chains=args.pilot_chains)
+                        if warm_warmup else None)))
         srv.run()
         w.result()
         srv.reset_counters()
@@ -278,12 +304,37 @@ def main(argv=None):
             # fires once per driver iteration (the old manual-step
             # loop's cadence) on whichever thread drives the quanta
             progress["iters"] += 1
-            if (progress["next_i"] < args.tenants
-                    and (args.stagger == 0
-                         or progress["iters"] % max(args.stagger, 1)
-                         == 0)):
-                handles.append(srv.submit(req(progress["next_i"])))
-                progress["next_i"] += 1
+            if progress["next_i"] >= args.tenants:
+                return
+            if demand:
+                # demand-driven arrivals (round 17; the fleet_bench
+                # closed-loop lesson): an eviction arm that drains
+                # jobs in ~2 quanta outruns any fixed stagger — the
+                # round-16 evict arm's wall was EXACTLY the
+                # (tenants - resident) x stagger arrival span, i.e.
+                # it measured the benchmark's own arrival schedule at
+                # ~50% occupancy, not pool capacity. Submit (in a
+                # LOOP — the hook fires once per boundary, so a
+                # single submit per call would just re-create the
+                # 1-per-quantum stagger cap) while the pool has free
+                # groups or the admission pipeline's cushion is low.
+                while progress["next_i"] < args.tenants:
+                    with server._lock:
+                        free = len(server._free_groups)
+                    with server._prep_lock:
+                        staged = (len(server._prepared)
+                                  + server._staging_n)
+                    if free == 0 and len(server.queue) + staged >= 2:
+                        return
+                    handles.append(srv.submit(req(progress["next_i"])))
+                    progress["next_i"] += 1
+                return
+            if not (args.stagger == 0
+                      or progress["iters"] % max(args.stagger, 1)
+                      == 0):
+                return
+            handles.append(srv.submit(req(progress["next_i"])))
+            progress["next_i"] += 1
 
         t0 = time.perf_counter()
         srv.run(on_quantum=stagger_submit)
@@ -322,7 +373,8 @@ def main(argv=None):
         p = h.progress()
         monitor_block[h.request.name] = {
             k: p.get(k) for k in ("rows", "ess_min", "rhat_max",
-                                  "ess_per_s", "converged_at")}
+                                  "ess_per_s", "converged_at",
+                                  "recycled_rows")}
     n_conv = sum(1 for v in monitor_block.values()
                  if v["converged_at"] is not None)
     print(f"# monitor: {n_conv}/{len(monitor_block)} tenants hit the "
@@ -428,7 +480,7 @@ def main(argv=None):
     if args.evict_arm:
         emods = {i: {"on_converged": "evict"}
                  for i in range(args.tenants)}
-        ehandles, ewall, esummary = run_workload(emods)
+        ehandles, ewall, esummary = run_workload(emods, demand=True)
         ebad = [h for h in ehandles if h.status != "done"]
         if ebad:
             raise RuntimeError(
@@ -445,6 +497,11 @@ def main(argv=None):
         evict_block = {
             "jobs_per_hour_base": round(base_jph, 2),
             "jobs_per_hour": round(evict_jph, 2),
+            # the base (full-budget) arm is capacity-bound under the
+            # fixed stagger (its wall exceeds the arrival span), so
+            # the demand-driven evict arm's gain is capacity vs
+            # capacity at the same delivered-ESS budget
+            "demand_driven": True,
             "gain": round(evict_jph / base_jph - 1.0, 4),
             "wall_s": round(ewall, 3),
             "converged_evictions":
@@ -461,6 +518,200 @@ def main(argv=None):
               f" at equal ESS budget; "
               f"{evict_block['converged_evictions']} early evictions, "
               f"{evict_block['sweeps_saved_frac']} of sweeps saved)",
+              file=sys.stderr)
+
+    # ---- warm-start arm (ROADMAP 4b; serve/warm.py) -------------------
+    # The evict workload again, every tenant initialized from a
+    # moment-matched pilot mixture instead of the prior: the monitor's
+    # early windows carry no init transient, so τ estimates are clean
+    # from the first evaluation and the eviction verdict lands quanta
+    # sooner — burn-in converted directly into jobs/hour at the SAME
+    # delivered-ESS budget (the capacity-per-dollar headline).
+    warm_block = None
+    if args.warm_arm:
+        wspec = WarmStartSpec(pilot_sweeps=args.pilot_sweeps,
+                              pilot_chains=args.pilot_chains)
+        wmods = {i: {"on_converged": "evict", "warm_start": wspec}
+                 for i in range(args.tenants)}
+        whandles, wwall, wsummary = run_workload(wmods,
+                                                 warm_warmup=True,
+                                                 demand=True)
+        wbad = [h for h in whandles if h.status != "done"]
+        if wbad:
+            raise RuntimeError(
+                f"{len(wbad)} tenant(s) failed in the warm arm: "
+                + "; ".join(str(h.error) for h in wbad[:3]))
+        warm_jph = args.tenants / (wwall / 3600.0)
+        base_jph = args.tenants / (wall / 3600.0)
+        evict_jph = (evict_block["jobs_per_hour"]
+                     if evict_block else None)
+        wsweeps = sum(h.sweeps_done for h in whandles)
+        bsweeps = sum(h.sweeps_done for h in handles)
+        w_ess = [h.progress().get("ess_min") for h in whandles]
+        w_ess = [v for v in w_ess if isinstance(v, (int, float))]
+        warm_block = {
+            "jobs_per_hour": round(warm_jph, 2),
+            "jobs_per_hour_evict": evict_jph,
+            "jobs_per_hour_base": round(base_jph, 2),
+            "gain_vs_evict": (round(warm_jph / evict_jph - 1.0, 4)
+                              if evict_jph else None),
+            "gain_vs_base": round(warm_jph / base_jph - 1.0, 4),
+            "wall_s": round(wwall, 3),
+            "converged_evictions": wsummary["converged_evictions"],
+            "sweeps_saved_frac": (round(1.0 - wsweeps / bsweeps, 4)
+                                  if bsweeps else None),
+            "ess_min_mean": (round(float(np.mean(w_ess)), 1)
+                             if w_ess else None),
+            "ess_target": args.ess_target,
+            "warm_starts": wsummary["warm"]["warm_starts"],
+            "warm_degraded": wsummary["warm"]["degraded"],
+            "pilot_sweeps": args.pilot_sweeps,
+            "pilot_chains": args.pilot_chains,
+            "pilot_ms_total": wsummary["warm"]["pilot_ms_total"],
+        }
+        print(f"# warm arm: {warm_jph:.1f} jobs/h vs evict "
+              f"{evict_jph} / base {base_jph:.1f} "
+              f"({(warm_block['gain_vs_evict'] or 0) * 100:+.1f}% vs "
+              f"evict at equal ESS budget; "
+              f"{warm_block['warm_starts']} warm starts, "
+              f"{warm_block['pilot_ms_total']:.0f} ms pilot total)",
+              file=sys.stderr)
+
+    # ---- recycling Gibbs accounting (ROADMAP 4a) ----------------------
+    # The drain tags the partial-scan rows each served sweep already
+    # computed (parallel/recycle.py — reconstructed, zero kernel/wire
+    # cost). The honest economics: per-PARAM ESS gains nothing (each
+    # coordinate updates once per scan — documented and pinned), so
+    # the measured multiplier is reported on a CROSS-BLOCK functional
+    # (noise-amplitude × outlier-count), the estimator family the
+    # recycling literature improves.
+    recycle_block = None
+    rsum = summary.get("recycle") or {}
+    if rsum.get("enabled") and handles:
+        from gibbs_student_t_tpu.parallel.recycle import (
+            ROW_SCAN_END,
+            functional_ess,
+            recycled_result,
+        )
+
+        served = summary["busy_chain_sweeps"]
+        rec_rows = rsum["recycled_lane_rows"]
+        mult = None
+        try:
+            cols, rc = recycled_result(handles[0].result())
+            f_all = (cols["x"][..., 0]
+                     * cols["z"].sum(axis=-1))     # (rows', chains)
+            e_plain = functional_ess(f_all[rc == ROW_SCAN_END])
+            e_rec = functional_ess(f_all)
+            mult = e_rec / e_plain if e_plain > 0 else None
+        except Exception as e:  # noqa: BLE001 - accounting only
+            print(f"# recycle functional-ESS probe failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+        recycle_block = {
+            "enabled": True,
+            "recycled_lane_rows": rec_rows,
+            "served_lane_rows": served,
+            "row_multiplier": (round(1.0 + rec_rows / served, 4)
+                               if served else None),
+            "functional_ess_multiplier": (round(mult, 4)
+                                          if mult else None),
+        }
+        print(f"# recycle: {rec_rows} recycled lane-rows on "
+              f"{served} served ({recycle_block['row_multiplier']}x "
+              f"rows), cross-block functional ESS x"
+              f"{recycle_block['functional_ess_multiplier']}",
+              file=sys.stderr)
+
+    # ---- content-addressed model cache probe (ROADMAP 1c) -------------
+    # Jax-light and seconds-cheap: journal every tenant model twice
+    # (the resubmission/failover pattern) through the manifest's
+    # digest store and compare bytes vs the per-admit pickling it
+    # replaced; then time full vs digest-hit submits over a loopback
+    # RPC stub (p50 each) — the wire half of the same cache.
+    def model_cache_probe():
+        import pickle
+        import shutil
+        import tempfile
+
+        from gibbs_student_t_tpu.serve.manifest import (
+            MODELS_DIR,
+            ServerManifest,
+        )
+        from gibbs_student_t_tpu.serve.rpc import (
+            RemoteChainServer,
+            RpcServer,
+        )
+        from gibbs_student_t_tpu.serve.scheduler import (
+            TenantRequest as _TR,
+        )
+
+        d = tempfile.mkdtemp(prefix="gst_modelcache_")
+        try:
+            man = ServerManifest(d)
+            pkl_bytes = sum(len(pickle.dumps(m, protocol=4))
+                            for m in tenant_mas)
+            for m in tenant_mas:
+                man.store_model(m)
+                man.store_model(m)     # the resubmission round
+            mdir = os.path.join(d, MODELS_DIR)
+            store_bytes = sum(
+                os.path.getsize(os.path.join(mdir, f))
+                for f in os.listdir(mdir))
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+
+        class _H:
+            def __init__(self, tid):
+                self.tenant_id = tid
+
+        class _Stub:
+            _handles = {}
+
+            def submit(self, request, timeout=None):
+                h = _H(len(self._handles))
+                self._handles[h.tenant_id] = h
+                return h
+
+            def cancel(self, h):
+                return True
+
+        rs = RpcServer(_Stub())
+        cl = RemoteChainServer((rs.host, rs.port))
+        t_full, t_hit = [], []
+        try:
+            for i, m in enumerate(tenant_mas):
+                req = _TR(ma=m, niter=args.quantum, nchains=1,
+                          name=f"mc{i}")
+                t0 = time.perf_counter()
+                cl.submit(req)
+                t_full.append((time.perf_counter() - t0) * 1e3)
+                t0 = time.perf_counter()
+                cl.submit(req)     # digest hit: model bytes skipped
+                t_hit.append((time.perf_counter() - t0) * 1e3)
+        finally:
+            rs.close()
+        return {
+            "models": len(tenant_mas),
+            "manifest_bytes": store_bytes,
+            "manifest_bytes_before": 2 * pkl_bytes,
+            "submit_full_p50_ms": round(
+                float(np.percentile(t_full, 50)), 3),
+            "submit_digest_p50_ms": round(
+                float(np.percentile(t_hit, 50)), 3),
+        }
+
+    try:
+        model_cache_block = model_cache_probe()
+        print(f"# model cache: manifest "
+              f"{model_cache_block['manifest_bytes']} B vs "
+              f"{model_cache_block['manifest_bytes_before']} B "
+              f"per-admit pickling; submit p50 "
+              f"{model_cache_block['submit_full_p50_ms']} ms full -> "
+              f"{model_cache_block['submit_digest_p50_ms']} ms "
+              f"digest-hit", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 - probe only
+        model_cache_block = None
+        print(f"# model cache probe failed: {type(e).__name__}: {e}",
               file=sys.stderr)
 
     # ---- fault-injection arm -----------------------------------------
@@ -583,6 +834,14 @@ def main(argv=None):
         # convergence-eviction economics (ROADMAP 4c): jobs-per-hour
         # at equal delivered ESS, base vs on_converged="evict"
         line["evict"] = evict_block
+    if warm_block is not None:
+        # warm-start economics (ROADMAP 4b): the evict workload with
+        # pilot-mixture inits — the capacity-per-dollar flagship
+        line["warm"] = warm_block
+    if recycle_block is not None:
+        line["recycle"] = recycle_block
+    if model_cache_block is not None:
+        line["model_cache"] = model_cache_block
     if args.ledger != "":
         try:
             from gibbs_student_t_tpu.obs import ledger as _ledger
