@@ -169,29 +169,13 @@ def _host_cache_dir() -> str:
     fire when a cached executable from an older jaxlib is deserialized
     by a newer one whose feature detection differs — the /proc flags
     alone don't change, so the fingerprint must cover the producer
-    too (ISSUE-4 "parsed: null" satellite)."""
-    import hashlib
-    import platform as _platform
+    too (ISSUE-4 "parsed: null" satellite). Since round 18 the
+    fingerprint logic lives in the dispatch registry
+    (ops/registry.host_cache_dir) so the serve pool workers share the
+    same per-host cache."""
+    from gibbs_student_t_tpu.ops.registry import host_cache_dir
 
-    tag = _platform.machine() or "unknown"
-    try:
-        with open("/proc/cpuinfo") as fh:
-            for cl in fh:
-                if cl.startswith(("flags", "Features")):
-                    feats = " ".join(sorted(cl.split(":", 1)[1].split()))
-                    tag += "-" + hashlib.sha1(
-                        feats.encode()).hexdigest()[:12]
-                    break
-    except OSError:
-        pass  # no /proc (non-Linux): machine-level split still helps
-    try:
-        import jaxlib
-
-        tag += "-" + getattr(jaxlib, "__version__", "unknown")
-    except Exception:  # noqa: BLE001 - fingerprint stays CPU-only
-        pass
-    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        ".jax_cache", tag)
+    return host_cache_dir()
 
 
 def _cap_cpu_threads() -> dict:
@@ -724,6 +708,11 @@ def main(argv=None):
     # (VERDICT r5 #2 / docs/ROUND5_NOTES.md) — a per-CPU cache directory
     # removes the condition instead of filtering the warning.
     try:
+        from gibbs_student_t_tpu.ops.registry import (
+            _harden_aot_cache_writes,
+        )
+
+        _harden_aot_cache_writes()  # atomic entry publish (round 18)
         jax.config.update("jax_compilation_cache_dir", _host_cache_dir())
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
     except Exception:

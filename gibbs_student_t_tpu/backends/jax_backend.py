@@ -94,21 +94,48 @@ def _bdraw_reuse_env() -> str:
     b-draw's block-assembled-factor gate. Strict ``auto|1|0``, raising
     whenever the variable is set to anything else (the same loud-typo
     contract as ``GST_VCHOL`` / ``GST_ENSEMBLE_UNROLL``)."""
-    env = os.environ.get("GST_BDRAW_REUSE")
-    if env is not None and env not in ("auto", "1", "0"):
-        raise ValueError(
-            f"GST_BDRAW_REUSE must be 'auto', '1' or '0', got {env!r}")
-    return env if env is not None else "auto"
+    from gibbs_student_t_tpu.ops import registry
+
+    return registry.value("GST_BDRAW_REUSE")
 
 
 def _donate_env() -> str:
     """Validated ``GST_DONATE_CHUNK`` (``auto`` when unset) — donation
     of the chunk functions' state buffers. Strict ``auto|1|0``."""
-    env = os.environ.get("GST_DONATE_CHUNK")
-    if env is not None and env not in ("auto", "1", "0"):
-        raise ValueError(
-            f"GST_DONATE_CHUNK must be 'auto', '1' or '0', got {env!r}")
-    return env if env is not None else "auto"
+    from gibbs_student_t_tpu.ops import registry
+
+    return registry.value("GST_DONATE_CHUNK")
+
+
+def donate_resolved() -> bool:
+    """The chunk-donation verdict (``auto`` → ON, the round-11
+    serving default) — EXCEPT in a process whose persistent AOT
+    compile cache is armed (ops/registry.enable_persistent_cache: the
+    serve pool workers, failover respawns, ``recover()``): a donated
+    executable DESERIALIZED from the cache loses its input/output
+    aliasing contract on this jaxlib and corrupts the heap (measured:
+    both pools of a fleet arm segfaulting in glibc malloc at tenant
+    admission — ops/registry.aot_cache_armed). ``auto`` therefore
+    degrades to OFF there, recorded with the reason; an explicit
+    ``1`` still forces donation (the A/B hatch), ``0`` disables as
+    ever. Donation never changes chains — only buffer reuse — so the
+    bitwise serving pins hold on either resolution."""
+    from gibbs_student_t_tpu.ops import registry
+
+    env = _donate_env()
+    if env == "0":
+        return False
+    if env == "1":
+        return True
+    if registry.aot_cache_armed():
+        registry.record(
+            "GST_DONATE_CHUNK", value=env, enabled=False, forced=False,
+            reason="degraded: AOT cache armed — deserialized donated "
+                   "executables corrupt the heap on this jaxlib")
+        return False
+    registry.record("GST_DONATE_CHUNK", value=env, enabled=True,
+                    forced=False, reason="auto: on")
+    return True
 
 
 def _fast_gamma_env() -> str:
@@ -121,11 +148,9 @@ def _fast_gamma_env() -> str:
     tools/cpu_microbench.py — more than ALL linear algebra combined);
     OFF on TPU, where the native sampler costs ~0.5 ms and staying on
     it keeps chains bit-identical with earlier rounds."""
-    env = os.environ.get("GST_FAST_GAMMA")
-    if env is not None and env not in ("auto", "1", "0"):
-        raise ValueError(
-            f"GST_FAST_GAMMA must be 'auto', '1' or '0', got {env!r}")
-    return env if env is not None else "auto"
+    from gibbs_student_t_tpu.ops import registry
+
+    return registry.value("GST_FAST_GAMMA")
 
 
 def _hyper_hoist_env() -> str:
@@ -137,11 +162,9 @@ def _hyper_hoist_env() -> str:
     closure-path hyper loop is the production path) and OFF elsewhere.
     The hoist is a pure reassociation-free restructuring: chains are
     bit-identical on/off (pinned in tests/test_nchol.py)."""
-    env = os.environ.get("GST_HYPER_HOIST")
-    if env is not None and env not in ("auto", "1", "0"):
-        raise ValueError(
-            f"GST_HYPER_HOIST must be 'auto', '1' or '0', got {env!r}")
-    return env if env is not None else "auto"
+    from gibbs_student_t_tpu.ops import registry
+
+    return registry.value("GST_HYPER_HOIST")
 
 
 def _fast_beta_env() -> str:
@@ -154,11 +177,9 @@ def _fast_beta_env() -> str:
     rejection loop is a CPU cost). Draws a different (equally exact)
     stream than ``random.beta``, so it is gated separately from
     GST_HYPER_HOIST, whose on/off contract is bit-identical chains."""
-    env = os.environ.get("GST_FAST_BETA")
-    if env is not None and env not in ("auto", "1", "0"):
-        raise ValueError(
-            f"GST_FAST_BETA must be 'auto', '1' or '0', got {env!r}")
-    return env if env is not None else "auto"
+    from gibbs_student_t_tpu.ops import registry
+
+    return registry.value("GST_FAST_BETA")
 
 
 def _fast_gamma_v2_env() -> str:
@@ -172,11 +193,9 @@ def _fast_gamma_v2_env() -> str:
     the jnp philox twin alone does not beat the chi-square arm.
     Forcing ``1`` takes v2 regardless (jnp twin when the kernel is
     absent: same distribution, silent degradation)."""
-    env = os.environ.get("GST_FAST_GAMMA_V2")
-    if env is not None and env not in ("auto", "1", "0"):
-        raise ValueError(
-            f"GST_FAST_GAMMA_V2 must be 'auto', '1' or '0', got {env!r}")
-    return env if env is not None else "auto"
+    from gibbs_student_t_tpu.ops import registry
+
+    return registry.value("GST_FAST_GAMMA_V2")
 
 
 def _fast_theta_env() -> str:
@@ -188,11 +207,9 @@ def _fast_theta_env() -> str:
     ON when the fast-beta pool is unavailable AND the native kernels
     are present on CPU. Draws a different (equally exact) stream than
     ``random.beta``."""
-    env = os.environ.get("GST_FAST_THETA")
-    if env is not None and env not in ("auto", "1", "0"):
-        raise ValueError(
-            f"GST_FAST_THETA must be 'auto', '1' or '0', got {env!r}")
-    return env if env is not None else "auto"
+    from gibbs_student_t_tpu.ops import registry
+
+    return registry.value("GST_FAST_THETA")
 
 
 class ChainState(NamedTuple):
@@ -716,12 +733,17 @@ class JaxGibbs(SamplerBackend):
         smask = static_phi_columns(self._ma)
         n_static = int(smask.sum())
         if hyper_schur == "auto":
-            env = os.environ.get("GST_HYPER_SCHUR")
+            from gibbs_student_t_tpu.ops import registry
+
+            env = registry.value("GST_HYPER_SCHUR")
             if env is not None:  # bench fallback-ladder override
                 hyper_schur = (env not in ("0", "false", "")
                                and 0 < n_static < self._ma.m)
             else:
                 hyper_schur = 8 <= n_static < self._ma.m
+            registry.record(
+                "GST_HYPER_SCHUR", value=env, enabled=bool(hyper_schur),
+                reason=f"auto: n_static={n_static} of m={self._ma.m}")
         elif hyper_schur and not 0 < n_static < self._ma.m:
             raise ValueError(
                 "hyper_schur needs both static and varying phi columns "
@@ -966,7 +988,7 @@ class JaxGibbs(SamplerBackend):
         # double-buffered spool flush snapshots the checkpoint state
         # before the next dispatch invalidates it (chunked_sweep_loop
         # snapshot_fn). auto -> on.
-        self._donate = _donate_env() != "0"
+        self._donate = donate_resolved()
         # the chunk program goes through the explicit lower->compile
         # introspection path (obs/introspect.py): same compile count as
         # plain jit, but compile wall time + XLA cost/memory analyses

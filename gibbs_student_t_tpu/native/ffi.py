@@ -183,12 +183,20 @@ def status() -> str:
 
 def _reset_for_tests() -> None:
     """Drop the latched verdict (tests only — e.g. after deleting the
-    .so to prove graceful degradation)."""
+    .so to prove graceful degradation). Also unlatches the registry's
+    mirror probes so both layers re-verdict together."""
     global _READY, _WHY, _TIMERS_OK, _NS_PER_TICK
     _READY = None
     _WHY = "not probed"
     _TIMERS_OK = None
     _NS_PER_TICK = None
+    try:
+        from gibbs_student_t_tpu.ops import registry
+
+        registry._unlatch_probe("native")
+        registry._unlatch_probe("native_timers")
+    except Exception:  # noqa: BLE001 - reset stays best-effort
+        pass
 
 
 # ---------------------------------------------------------------------
@@ -219,13 +227,11 @@ def kernel_timers_env() -> str:
     channel is bitwise-free: same compiled code, a runtime flag).
     ``1`` forces the request but still degrades silently when the
     library lacks the exports (the forced-but-unavailable contract);
-    ``0`` keeps the flag down and every consumer timer-free."""
-    env = os.environ.get("GST_KERNEL_TIMERS")
-    if env is not None and env not in ("auto", "1", "0"):
-        raise ValueError(
-            f"GST_KERNEL_TIMERS must be 'auto', '1' or '0', got "
-            f"{env!r}")
-    return env if env is not None else "auto"
+    ``0`` keeps the flag down and every consumer timer-free. Strict
+    validation lives in the dispatch registry (ops/registry.py)."""
+    from gibbs_student_t_tpu.ops import registry
+
+    return registry.value("GST_KERNEL_TIMERS")
 
 
 def _lib():
@@ -260,10 +266,11 @@ def timers_available() -> bool:
 def timers_resolved_on() -> bool:
     """The gate verdict consumers act on: ``GST_KERNEL_TIMERS`` (auto
     -> on) AND the surface actually available — forced-but-unavailable
-    degrades to off, silently, like every other native gate."""
-    if kernel_timers_env() == "0":
-        return False
-    return timers_available()
+    degrades to off, silently, like every other native gate (the
+    registry's ``mode3`` pipeline, probe ``native_timers``)."""
+    from gibbs_student_t_tpu.ops import registry
+
+    return registry.mode3("GST_KERNEL_TIMERS")[0]
 
 
 def timers_enable(on: bool) -> None:

@@ -54,7 +54,9 @@ def ledger_path(path: Optional[str] = None) -> str:
     """Resolve the ledger file path (explicit > env > cwd default)."""
     if path:
         return path
-    return os.environ.get("GST_LEDGER_PATH") or DEFAULT_LEDGER
+    from gibbs_student_t_tpu.ops.registry import value
+
+    return value("GST_LEDGER_PATH") or DEFAULT_LEDGER
 
 
 def config_fingerprint(config) -> str:

@@ -41,7 +41,6 @@ injected stall, the plane tests pin no-trip on clean runs).
 from __future__ import annotations
 
 import collections
-import os
 import statistics
 import threading
 import time
@@ -61,14 +60,11 @@ POLICIES = ("warn", "dump", "fail")
 def serve_watchdog_env() -> str:
     """Validated ``GST_SERVE_WATCHDOG`` (``auto`` when unset) — the
     serving stall watchdog. Strict ``auto|0|warn|dump|fail`` (the
-    loud-typo contract); ``auto`` resolves to ``dump``, ``0``
-    disables."""
-    env = os.environ.get("GST_SERVE_WATCHDOG")
-    if env is not None and env not in ("auto", "0") + POLICIES:
-        raise ValueError(
-            f"GST_SERVE_WATCHDOG must be 'auto', '0', 'warn', 'dump' "
-            f"or 'fail', got {env!r}")
-    return env if env is not None else "auto"
+    loud-typo contract, the registry's ``choice`` kind); ``auto``
+    resolves to ``dump``, ``0`` disables."""
+    from gibbs_student_t_tpu.ops import registry
+
+    return registry.value("GST_SERVE_WATCHDOG")
 
 
 @dataclass

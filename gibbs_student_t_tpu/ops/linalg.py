@@ -56,7 +56,6 @@ requires a C toolchain.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
@@ -64,6 +63,7 @@ import numpy as np
 from jax.custom_batching import custom_vmap
 from jax.scipy.linalg import solve_triangular
 
+from gibbs_student_t_tpu.ops import registry
 from gibbs_student_t_tpu.ops.pallas_chol import (
     MAX_PALLAS_DIM,
     chol_fused_lane,
@@ -86,12 +86,10 @@ def vchol_env() -> str:
     set, independent of which dispatch path ultimately wins — a typo'd
     override must fail loudly, not silently measure the wrong arm (the
     ``GST_ENSEMBLE_UNROLL`` validation contract, parallel/ensemble.py).
-    """
-    env = os.environ.get("GST_VCHOL")
-    if env is not None and env not in ("auto", "1", "0"):
-        raise ValueError(
-            f"GST_VCHOL must be 'auto', '1' or '0', got {env!r}")
-    return env if env is not None else "auto"
+    Since round 18 the validation itself lives in the dispatch
+    registry (ops/registry.py — ONE strict surface for every gate);
+    this wrapper is the stable public name."""
+    return registry.value("GST_VCHOL")
 
 
 def _vchol_mode():
@@ -104,14 +102,9 @@ def _vchol_mode():
     the Pallas lane kernel and the unrolled-program experiment already
     measured long unrolled programs scheduling badly inside the sweep
     (artifacts/tpu_validation_r02.json). Read at TRACE time, same
-    snapshot semantics as ``GST_PALLAS_CHOL``.
-    """
-    env = vchol_env()
-    if env == "0":
-        return False, False
-    if env == "1":
-        return True, True
-    return jax.default_backend() not in ("tpu", "axon"), False
+    snapshot semantics as ``GST_PALLAS_CHOL``; resolved (and its
+    provenance recorded) by the registry."""
+    return registry.mode3("GST_VCHOL")
 
 
 def _vchol_ok(shape, forced: bool) -> bool:
@@ -127,28 +120,20 @@ def nchol_env() -> str:
     """Validated ``GST_NCHOL`` value (``auto`` when unset) — the native
     lane-batched CPU kernel gate. Strict ``auto|1|0``, raising whenever
     the variable is set to anything else (the loud-typo contract of
-    every GST_* gate). Note the asymmetry with availability: the VALUE
-    is validated strictly, but a well-formed ``1`` on a host without
-    the library degrades silently to the vchol path — forcing the arm
-    must never make a toolchain a runtime requirement."""
-    env = os.environ.get("GST_NCHOL")
-    if env is not None and env not in ("auto", "1", "0"):
-        raise ValueError(
-            f"GST_NCHOL must be 'auto', '1' or '0', got {env!r}")
-    return env if env is not None else "auto"
+    every GST_* gate, implemented once in ops/registry.py). Note the
+    asymmetry with availability: the VALUE is validated strictly, but
+    a well-formed ``1`` on a host without the library degrades
+    silently to the vchol path — forcing the arm must never make a
+    toolchain a runtime requirement."""
+    return registry.value("GST_NCHOL")
 
 
 def _nchol_ready() -> bool:
-    """Capability probe (latched per process): library built with the
-    FFI kernels, host SIMD level sufficient, jax FFI API present,
-    targets registered. Never raises — an import/probe failure means
-    the kernels are simply absent."""
-    try:
-        from gibbs_student_t_tpu.native import ffi as nffi
-
-        return nffi.ready()
-    except Exception:  # noqa: BLE001 - absence, not an error
-        return False
+    """Capability probe (latched per process, through the registry):
+    library built with the FFI kernels, host SIMD level sufficient,
+    jax FFI API present, targets registered. Never raises — an
+    import/probe failure means the kernels are simply absent."""
+    return registry.probe("native")
 
 
 def _nchol_mode():
@@ -156,37 +141,24 @@ def _nchol_mode():
     are XLA:**CPU** custom calls, so even a forced ``1`` requires the
     CPU backend (on TPU the Pallas kernel is the production path and
     the custom-call target simply does not exist there). Read at TRACE
-    time, same snapshot semantics as every other linalg gate."""
-    env = nchol_env()
-    if env == "0":
-        return False, False
-    if jax.default_backend() != "cpu" or not _nchol_ready():
-        return False, False
-    return True, env == "1"
+    time, same snapshot semantics as every other linalg gate; the
+    probe→validate→degrade→record pipeline is the registry's."""
+    return registry.mode3("GST_NCHOL")
 
 
 def nwhite_env() -> str:
     """Validated ``GST_NWHITE`` (``auto`` when unset) — the native
-    white-MH block arm. Strict ``auto|1|0`` (the loud-typo contract);
-    a well-formed ``1`` on a host without the library degrades
-    silently to the XLA loop, which IS the CPU production path, so the
-    graph is unchanged."""
-    env = os.environ.get("GST_NWHITE")
-    if env is not None and env not in ("auto", "1", "0"):
-        raise ValueError(
-            f"GST_NWHITE must be 'auto', '1' or '0', got {env!r}")
-    return env if env is not None else "auto"
+    white-MH block arm. Strict ``auto|1|0`` (the loud-typo contract,
+    registry-implemented); a well-formed ``1`` on a host without the
+    library degrades silently to the XLA loop, which IS the CPU
+    production path, so the graph is unchanged."""
+    return registry.value("GST_NWHITE")
 
 
 def _nwhite_mode():
     """``(enabled, forced)`` for the native white-MH arm — CPU custom
     call, same trace-time snapshot semantics as ``GST_NCHOL``."""
-    env = nwhite_env()
-    if env == "0":
-        return False, False
-    if jax.default_backend() != "cpu" or not _nchol_ready():
-        return False, False
-    return True, env == "1"
+    return registry.mode3("GST_NWHITE")
 
 
 def nwhite_take(shape, dtype, p: int, nvar: int) -> bool:
@@ -205,21 +177,12 @@ def nhyper_env() -> str:
     """Validated ``GST_NHYPER`` (``auto`` when unset) — the native
     fused hyper-MH block arm (one custom call for the whole 10-step
     block, S0 tile-resident across proposals). Strict ``auto|1|0``."""
-    env = os.environ.get("GST_NHYPER")
-    if env is not None and env not in ("auto", "1", "0"):
-        raise ValueError(
-            f"GST_NHYPER must be 'auto', '1' or '0', got {env!r}")
-    return env if env is not None else "auto"
+    return registry.value("GST_NHYPER")
 
 
 def _nhyper_mode():
     """``(enabled, forced)`` for the native hyper-MH arm."""
-    env = nhyper_env()
-    if env == "0":
-        return False, False
-    if jax.default_backend() != "cpu" or not _nchol_ready():
-        return False, False
-    return True, env == "1"
+    return registry.mode3("GST_NHYPER")
 
 
 def nhyper_take(shape, dtype, p: int, v: int, nk: int) -> bool:
@@ -241,11 +204,7 @@ def fuse_stages_env() -> str:
     ``auto`` resolves at backend construction (CPU + library + Schur +
     b-draw reuse + fusable model structure); anything missing keeps the
     per-stage graph, byte-identically with every gate off."""
-    env = os.environ.get("GST_FUSE_STAGES")
-    if env is not None and env not in ("auto", "1", "0"):
-        raise ValueError(
-            f"GST_FUSE_STAGES must be 'auto', '1' or '0', got {env!r}")
-    return env if env is not None else "auto"
+    return registry.value("GST_FUSE_STAGES")
 
 
 def nresid_env() -> str:
@@ -256,21 +215,28 @@ def nresid_env() -> str:
     matmul even with the family active — the knob that lets a serve
     bit-identity pin align arms with the traced-basis pool path, which
     has no native resid form."""
-    env = os.environ.get("GST_NRESID")
-    if env is not None and env not in ("auto", "1", "0"):
-        raise ValueError(
-            f"GST_NRESID must be 'auto', '1' or '0', got {env!r}")
-    return env if env is not None else "auto"
+    return registry.value("GST_NRESID")
 
 
 def _nresid_mode():
-    """``(enabled, forced)`` for the native residual-matvec arm."""
+    """``(enabled, forced)`` for the native residual-matvec arm —
+    the one gate whose ``auto`` follows ANOTHER gate's resolution
+    (the arm is part of the native kernel family), so its resolver
+    stays here and records through the registry."""
     env = nresid_env()
     if env == "0":
+        registry.record("GST_NRESID", value=env, enabled=False,
+                        forced=False, reason="disabled")
         return False, False
     n_on, n_forced = _nchol_mode()
     if not n_on:
+        registry.record("GST_NRESID", value=env, enabled=False,
+                        forced=False,
+                        reason="follows GST_NCHOL: inactive")
         return False, False
+    registry.record("GST_NRESID", value=env, enabled=True,
+                    forced=env == "1" or n_forced,
+                    reason="follows GST_NCHOL: active")
     return True, env == "1" or n_forced
 
 
@@ -323,7 +289,7 @@ def _unrolled_wanted(m: int) -> bool:
     with the XLA expander) — the long unrolled program schedules badly in
     the sweep's fori_loop context. The expander is the production path;
     the flag is kept for A/B measurement."""
-    env = os.environ.get("GST_UNROLLED_CHOL")
+    env = registry.value("GST_UNROLLED_CHOL")
     if env is not None:
         return env not in ("0", "false", "")
     return False

@@ -44,27 +44,23 @@ def vmem_spec(shape, index_map) -> pl.BlockSpec:
 
 
 def mode_from_env(var: str):
-    """``(enabled, interpret, forced)`` for one kernel gate env var."""
-    env = os.environ.get(var, "auto")
-    if env in ("0", "false", ""):
-        return False, False, False
-    if env == "interpret":
-        return True, True, True
-    if env == "auto":
-        return jax.default_backend() in ("tpu", "axon"), False, False
-    return True, False, True
+    """``(enabled, interpret, forced)`` for one kernel gate env var —
+    since round 18 a thin delegate to the dispatch registry's shared
+    resolution (ops/registry.py ``pallas_mode``: same vocabulary, plus
+    provenance recording)."""
+    from gibbs_student_t_tpu.ops import registry
+
+    return registry.pallas_mode(var)
 
 
 def int_from_env(var: str, default: int, mult: int = 8) -> int:
     """Tuning integer from the environment: ``default`` when unset,
     empty, or non-numeric (the same forgiving contract as the GST_*
-    mode flags), rounded up to a legal ``mult``-multiple."""
-    raw = os.environ.get(var, "")
-    try:
-        val = int(raw) if raw else default
-    except ValueError:
-        val = default
-    return round_up(max(val, mult), mult)
+    mode flags), rounded up to a legal ``mult``-multiple. Registry-
+    backed (ops/registry.py ``int_value``)."""
+    from gibbs_student_t_tpu.ops import registry
+
+    return registry.int_value(var, default, mult)
 
 
 def tpu_compiler_params(dimension_semantics) -> dict:

@@ -1,0 +1,936 @@
+"""The capability-probed dispatch registry (ROADMAP item 5).
+
+Twelve-plus interacting ``GST_*`` gates grew up one at a time, each
+re-implementing the same four-step pipeline — read the environment,
+validate strictly, probe the platform/library capability, degrade
+silently when forced-but-unavailable, and (since round 9) record the
+decision for the run ledger. This module folds that pipeline into ONE
+surface:
+
+- :data:`GATES` declares every environment gate the package reads —
+  name, owning layer, validation kind, capability requirements, the
+  ``auto`` resolution probe, and the one-line description the
+  OBSERVABILITY.md env-gate index is generated from
+  (``tools/gates.py --markdown``). A ``GST_*`` read anywhere else in
+  the package is a tier-1 guard failure (tests/test_obs_wire.py).
+- :func:`value` is the single strict validation implementation (the
+  loud-typo contract): per-kind rules identical to the historical
+  per-gate functions, same error messages.
+- :func:`mode3` / :func:`pallas_mode` / :func:`int_value` /... are the
+  resolution helpers the dispatch call sites consume — each records
+  provenance (gate, validated value, probes consulted, verdict,
+  degradation reason) into a process-local log that rides the
+  ``xla.registry`` block of every ledger record
+  (obs/introspect.compile_summary) and answers ``tools/gates.py``.
+- :data:`OPS` is the per-op implementation table behind
+  ``ops/linalg.py``'s dispatchers — which impls exist for each op, in
+  priority order, guarded by which gate/probe/shape-class — as
+  *data*, so the CLI can print the host's resolved dispatch without
+  tracing anything.
+
+**The pinned contract**: the registry changes WHERE the probe →
+validate → degrade → record pipeline lives, never WHAT it decides.
+Every legacy ``GST_*`` value resolves exactly as before (the
+``*_env()`` wrappers all delegate here and their strict-validation
+tests still pass), and the gates-off lowered graph and chains are
+bitwise identical pre/post refactor (tests/test_registry.py pins
+cache-on/cache-off chains bitwise; the long-standing gates-off parity
+pins in tests/test_nchol.py are the refactor's regression harness).
+
+**Persistence** (the cold-start half of ROADMAP 5): probe outcomes and
+first-trace autotune decisions (the linalg impl table a compiled
+program chose, per-program compile walls) persist as ``gates.json``
+next to the per-host AOT compile cache (:func:`host_cache_dir`),
+keyed by native ABI version, the committed ``.so``'s digest (which
+pins its ``gst_simd_level``), host CPU flags, jax/jaxlib versions and
+the dispatch-config fingerprint (the ``fp``-marked gates' env
+values). A key mismatch is a LOUD ignore — ``RuntimeWarning`` plus a
+``cache_ignored`` counter — followed by a fresh probe, never a silent
+reuse. With a valid cache, a spawned pool worker, a failover respawn
+and ``ChainServer.recover()`` reach first dispatch with zero fresh
+probe/autotune events (:func:`stats` counters, gated by
+``perf_report --check``) and the AOT cache pays the compile; the
+measured spawn→first-result walls live in docs/PERFORMANCE.md "Cold
+starts".
+
+Only stdlib imports at module scope (obs/ledger.py and
+obs/introspect.py import this module and must stay jax-free at import
+time); jax and the native FFI layer are imported lazily inside probes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+import warnings
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
+
+GATE_CACHE_SCHEMA = 1
+GATE_CACHE_NAME = "gates.json"
+
+
+class GateSpec(NamedTuple):
+    """One declared environment gate.
+
+    ``kind`` selects the validation rule in :func:`value`;
+    ``requires`` the capability probes that must ALL pass for the arm
+    to be reachable even when forced (the forced-but-unavailable
+    silent degradation); ``auto`` the probe an ``auto`` value resolves
+    through (``None`` = on whenever ``requires`` holds); ``fp`` marks
+    the gate a member of the dispatch-config fingerprint (gates whose
+    value can change compiled programs or chains — bitwise-free
+    observability toggles stay out so flipping them cannot orphan the
+    probe cache); ``doc`` is the generated env-gate index row."""
+
+    name: str
+    layer: str                       # ops|native|backend|parallel|serve|obs
+    kind: str
+    doc: str
+    requires: Tuple[str, ...] = ()
+    auto: Optional[str] = None
+    fp: bool = True
+    default: Optional[object] = None
+
+
+#: validation kinds (see :func:`value`):
+#: ``strict3``   auto|1|0, default auto — the standard gate contract
+#: ``pallas``    the pallas_util.mode_from_env vocabulary (any value;
+#:               0/false/empty off, ``interpret`` forced-interpret,
+#:               ``auto`` platform-resolved, anything else forced on)
+#: ``truthy``    opt-in flags: unset→None, raw string otherwise (the
+#:               caller treats 0/false/empty as off, else on)
+#: ``choice``    one of ``default`` (a tuple of legal values)
+#: ``enum01``    ''|'0'|'1' (GST_ENSEMBLE_UNROLL's contract)
+#: ``posint``    strict positive integer (bytes/sizes)
+#: ``int``       forgiving tuning integer (non-numeric → default),
+#:               rounded up to a legal multiple
+#: ``offswitch`` default-on layer toggles (0/false/empty disables)
+#: ``path``      a filesystem path, no validation
+_KINDS = ("strict3", "pallas", "truthy", "choice", "enum01", "posint",
+          "int", "offswitch", "path")
+
+_G = GateSpec
+
+GATES: Dict[str, GateSpec] = {g.name: g for g in (
+    # -- ops: the linalg dispatch family --------------------------------
+    _G("GST_VCHOL", "ops", "strict3",
+       "portable vectorized Cholesky family (auto-on off-TPU)",
+       auto="not_tpu"),
+    _G("GST_NCHOL", "native", "strict3",
+       "native FFI lane-batched kernel family master gate",
+       requires=("cpu", "native")),
+    _G("GST_NWHITE", "native", "strict3",
+       "native one-call white MH block (+ `gst_white_lanes` serving "
+       "twin)", requires=("cpu", "native")),
+    _G("GST_NHYPER", "native", "strict3",
+       "native one-call hyper MH block", requires=("cpu", "native")),
+    _G("GST_NRESID", "native", "strict3",
+       "native residual matvec in the z/df glue (auto follows "
+       "`GST_NCHOL`)", requires=("cpu", "native")),
+    _G("GST_FUSE_STAGES", "backend", "strict3",
+       "the schur+hyper+b-draw megastage (probe-gated auto)",
+       requires=("cpu", "native")),
+    _G("GST_UNROLLED_CHOL", "ops", "truthy",
+       "fully-unrolled small-m Cholesky arm"),
+    _G("GST_PALLAS_CHOL", "ops", "pallas",
+       "Pallas TPU Cholesky kernel (`interpret` accepted)",
+       auto="tpu"),
+    _G("GST_PALLAS_WHITE", "ops", "pallas",
+       "Pallas TPU white-MH kernel (`interpret` accepted)",
+       auto="tpu"),
+    _G("GST_PALLAS_HYPER", "ops", "pallas",
+       "Pallas TPU hyper-MH kernel (`interpret` accepted)",
+       auto="tpu"),
+    _G("GST_WHITE_TILE", "ops", "int",
+       "white kernel tile size (integer, rounded to a legal multiple)",
+       default=256),
+    _G("GST_HYPER_TILE", "ops", "int",
+       "hyper kernel tile size (integer)", default=128),
+    # -- backend: draw/structure arms resolved at construction ----------
+    _G("GST_FAST_GAMMA", "backend", "strict3",
+       "fast gamma draw path", auto="not_tpu"),
+    _G("GST_FAST_GAMMA_V2", "backend", "strict3",
+       "philox `-log ∏ U` alpha draw (native; jnp twin is the "
+       "degradation arm)", requires=("cpu", "native")),
+    _G("GST_FAST_BETA", "backend", "strict3",
+       "exact chi-square theta draw (half-integer pseudo-counts)",
+       auto="not_tpu"),
+    _G("GST_FAST_THETA", "backend", "strict3",
+       "native fractional Beta for the remaining priors",
+       requires=("cpu", "native")),
+    _G("GST_HYPER_HOIST", "backend", "strict3",
+       "per-sweep hoisting of proposal-invariant hyper-MH pieces",
+       auto="cpu"),
+    _G("GST_HYPER_SCHUR", "backend", "truthy",
+       "fused Schur pre-elimination in the hyper block"),
+    _G("GST_BDRAW_REUSE", "backend", "strict3",
+       "b-draw block-factor reuse"),
+    _G("GST_DONATE_CHUNK", "backend", "strict3",
+       "donate the chunk program's state buffers"),
+    # -- parallel -------------------------------------------------------
+    _G("GST_ENSEMBLE_UNROLL", "parallel", "enum01",
+       "grouped-ensemble chunk unroll factor (integer)"),
+    # -- serve ----------------------------------------------------------
+    _G("GST_SERVE_PIPELINE", "serve", "strict3",
+       "pipelined serving executor vs the serial reference loop",
+       fp=False),
+    _G("GST_SERVE_SUPERVISE", "serve", "strict3",
+       "tenant-scoped fault containment vs historical fail-fast",
+       fp=False),
+    _G("GST_RECYCLE", "serve", "strict3",
+       "recycling-Gibbs row tagging + weighted monitor moments "
+       "(parallel/recycle.py; auto→on — recycled rows are "
+       "RECONSTRUCTED from adjacent recorded rows, so scan-end rows, "
+       "spool bytes and chains are bitwise identical on/off; `0` is "
+       "the pre-round-17 drain graph verbatim)", fp=False),
+    _G("GST_WARM_START", "serve", "strict3",
+       "variational warm-start arm (serve/warm.py): `auto` honors "
+       "per-request `warm_start` specs, `1` defaults every tenant to "
+       "a pilot-mixture init, `0` force-disables (requests degrade to "
+       "the cold prior init, bitwise, with a `warm_start_degraded` "
+       "event)"),
+    _G("GST_SERVE_WATCHDOG", "serve", "choice",
+       "serving stall watchdog policy: `auto`(→`dump`)\\|`0`\\|`warn`"
+       "\\|`dump`\\|`fail` (not an `auto\\|1\\|0` gate)",
+       fp=False, default=("auto", "0", "warn", "dump", "fail")),
+    _G("GST_RPC_MAX_FRAME", "serve", "posint",
+       "RPC wire per-frame byte ceiling (positive integer, default "
+       "256 MiB; not an `auto\\|1\\|0` gate) — both ends reject "
+       "larger frames BEFORE allocating", fp=False,
+       default=256 * 1024 * 1024),
+    # -- native runtime flag -------------------------------------------
+    _G("GST_KERNEL_TIMERS", "native", "strict3",
+       "in-kernel per-stage cycle timers (a runtime flag in the same "
+       "compiled kernels — chains and the lowered graph are bitwise "
+       "identical on/off; auto-on where the .so has the timer "
+       "surface)", requires=("native_timers",), fp=False),
+    # -- obs ------------------------------------------------------------
+    _G("GST_INTROSPECT", "obs", "offswitch",
+       "XLA compile introspection layer (`0`/`false`/empty disables)",
+       fp=False),
+    _G("GST_LEDGER_PATH", "obs", "path",
+       "run-ledger path override (a path, not a flag)", fp=False),
+    _G("GST_CACHE_DIR", "obs", "path",
+       "persistent cold-start cache directory override (a path; "
+       "default is the per-host `.jax_cache/<fingerprint>` dir) — "
+       "the AOT compile cache and `gates.json` live here", fp=False),
+)}
+
+
+#: Per-op implementation tables behind ops/linalg.py's dispatchers —
+#: priority-ordered ``(impl, gate, shape-class guard)`` rows, as data.
+#: ``tools/gates.py`` renders the host-resolved view; the dispatch
+#: functions themselves keep their (pinned, bitwise) hand play-by-play
+#: — this table documents it, the tests cross-check it never drifts.
+OPS: Dict[str, List[Tuple[str, Optional[str], str]]] = {
+    "factor": [("pallas", "GST_PALLAS_CHOL", "f32, m<=MAX_PALLAS_DIM"),
+               ("nchol", "GST_NCHOL", "f32/f64, m<=MAX_VCHOL_DIM"),
+               ("vchol", "GST_VCHOL", "m<=MAX_VCHOL_DIM"),
+               ("expander", None, "any")],
+    "factor_quad": [("nchol", "GST_NCHOL", "f32/f64, m<=MAX_VCHOL_DIM"),
+                    ("factor-fallback", None, "any (L dead-coded)")],
+    "bwd_vec": [("pallas", "GST_PALLAS_CHOL", "f32, m<=MAX_PALLAS_DIM"),
+                ("nchol", "GST_NCHOL", "f32/f64, m<=MAX_VCHOL_DIM"),
+                ("vchol", "GST_VCHOL", "m<=MAX_VCHOL_DIM"),
+                ("expander", None, "any")],
+    "fwd_mat": [("nchol", "GST_NCHOL", "f32/f64, m<=MAX_VCHOL_DIM"),
+                ("vchol", "GST_VCHOL", "m<=MAX_VCHOL_DIM"),
+                ("expander", None, "any")],
+    "bwd_mat": [("nchol", "GST_NCHOL", "f32/f64, m<=MAX_VCHOL_DIM"),
+                ("vchol", "GST_VCHOL", "m<=MAX_VCHOL_DIM"),
+                ("expander", None, "any")],
+    "schur": [("nchol", "GST_NCHOL", "batched, v<=MAX_VCHOL_DIM"),
+              ("jnp", None, "any")],
+    "robust_draw": [("nchol", "GST_NCHOL", "batched"),
+                    ("stacked", None, "any")],
+    "tnt": [("nchol", "GST_NCHOL", "shared basis, batch>=MIN_BATCH"),
+            ("vmap_jnp", None, "any")],
+    "tnt_lanes": [("nchol", "GST_NCHOL", "per-lane basis, tile-uniform "
+                   "gid"), ("vmap_jnp", None, "any")],
+    "resid": [("nchol", "GST_NRESID", "shared basis"),
+              ("vmap_jnp", None, "any")],
+    "resid_lanes": [("nchol", "GST_NRESID", "per-lane basis"),
+                    ("vmap_jnp", None, "any")],
+    "chisq": [("nchol", "GST_NCHOL", "FORCED (=1) only — auto keeps "
+               "the fused jnp reduction, measured faster"),
+              ("jnp", None, "any")],
+    "gamma_v2": [("nchol", "GST_FAST_GAMMA_V2", "native draws ready"),
+                 ("jnp_philox", None, "any (identical streams)")],
+    "beta_frac": [("nchol", "GST_FAST_THETA", "native draws ready"),
+                  ("random_beta", None, "any (same law, different "
+                   "stream)")],
+    "white_mh": [("nwhite", "GST_NWHITE", "p<=64, nvar<=16"),
+                 ("pallas", "GST_PALLAS_WHITE", "TPU"),
+                 ("loop_xla", None, "any")],
+    "white_lanes": [("nchol", "GST_NWHITE", "per-lane consts, "
+                     "tile-uniform gid"),
+                    ("loop_xla", None, "any")],
+    "hyper_mh": [("nchol", "GST_NHYPER", "p<=64, nk<=16"),
+                 ("pallas", "GST_PALLAS_HYPER", "TPU"),
+                 ("loop_xla", None, "any")],
+    "fused_hyper": [("nchol", "GST_FUSE_STAGES", "fusable model "
+                     "structure"), ("stages", None, "per-stage graph "
+                     "verbatim")],
+    "fused_hyper_lanes": [("nchol", "GST_FUSE_STAGES", "per-lane "
+                           "consts, tile-uniform gid"),
+                          ("stages", None, "per-stage graph "
+                           "verbatim")],
+}
+
+# the declared tables must cover every op the dispatchers ever note —
+# tests/test_registry.py cross-checks at runtime; this static list is
+# the grep target a new dispatcher's author will find first
+assert set(OPS) >= {
+    "factor", "factor_quad", "bwd_vec", "fwd_mat", "bwd_mat", "schur",
+    "robust_draw", "tnt", "tnt_lanes", "resid", "resid_lanes", "chisq",
+    "gamma_v2", "beta_frac", "white_mh", "white_lanes", "hyper_mh",
+    "fused_hyper", "fused_hyper_lanes"}
+
+
+# ----------------------------------------------------------------------
+# capability probes
+# ----------------------------------------------------------------------
+
+_LOCK = threading.RLock()
+_PROBE_SEEN: Dict[str, bool] = {}
+_PROVENANCE: List[Dict[str, Any]] = []
+_AUTOTUNE_SEEN: Dict[str, bool] = {}   # key -> predicted-by-cache
+_CACHE: Optional[Dict[str, Any]] = None    # the loaded gates.json doc
+_CACHE_INFO: Dict[str, Any] = {"dir": None, "loaded": False,
+                               "ignored": None}
+_COUNTERS = {"probes_fresh": 0, "probes_cached": 0,
+             "autotune_fresh": 0, "autotune_cached": 0,
+             "cache_ignored": 0, "resolutions": 0}
+
+
+def _probe_cpu() -> bool:
+    import jax
+
+    return jax.default_backend() == "cpu"
+
+
+def _probe_not_tpu() -> bool:
+    import jax
+
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+def _probe_tpu() -> bool:
+    import jax
+
+    return jax.default_backend() in ("tpu", "axon")
+
+
+def _probe_native() -> bool:
+    try:
+        from gibbs_student_t_tpu.native import ffi as nffi
+
+        return nffi.ready()
+    except Exception:  # noqa: BLE001 - absence, not an error
+        return False
+
+
+def _probe_native_timers() -> bool:
+    try:
+        from gibbs_student_t_tpu.native import ffi as nffi
+
+        return nffi.timers_available()
+    except Exception:  # noqa: BLE001
+        return False
+
+
+_PROBE_FNS: Dict[str, Callable[[], bool]] = {
+    "cpu": _probe_cpu,
+    "not_tpu": _probe_not_tpu,
+    "tpu": _probe_tpu,
+    "native": _probe_native,
+    "native_timers": _probe_native_timers,
+}
+
+
+def probe(name: str) -> bool:
+    """One capability probe, latched per process. The first evaluation
+    counts ``probes_cached`` when a loaded gates cache predicted the
+    outcome, ``probes_fresh`` otherwise (the counter ``perf_report
+    --check`` gates a recovered pool on); a cache that predicted
+    WRONG warns loudly — the probe's live verdict always wins."""
+    with _LOCK:
+        if name in _PROBE_SEEN:
+            return _PROBE_SEEN[name]
+    ok = bool(_PROBE_FNS[name]())
+    with _LOCK:
+        if name in _PROBE_SEEN:          # lost a race: first call won
+            return _PROBE_SEEN[name]
+        predicted = None
+        if _CACHE is not None:
+            ent = (_CACHE.get("probes") or {}).get(name)
+            if isinstance(ent, dict):
+                predicted = ent.get("ok")
+        if predicted is None:
+            _COUNTERS["probes_fresh"] += 1
+            src = "fresh"
+        elif bool(predicted) == ok:
+            _COUNTERS["probes_cached"] += 1
+            src = "cache"
+        else:
+            _COUNTERS["probes_fresh"] += 1
+            src = "fresh"
+            warnings.warn(
+                f"gates cache predicted probe {name!r}={predicted} "
+                f"but the live probe says {ok} — cache entry ignored "
+                "(host changed under the cache key?)", RuntimeWarning)
+        _PROBE_SEEN[name] = ok
+        _record_locked({"probe": name, "ok": ok, "source": src})
+    return ok
+
+
+def _unlatch_probe(name: str) -> None:
+    """Drop one latched probe verdict (tests only — paired with
+    native/ffi._reset_for_tests so both layers re-probe together)."""
+    with _LOCK:
+        _PROBE_SEEN.pop(name, None)
+
+
+def probes_snapshot() -> Dict[str, Dict[str, Any]]:
+    """Evaluated probes so far (the gates.json ``probes`` payload)."""
+    with _LOCK:
+        return {k: {"ok": v} for k, v in _PROBE_SEEN.items()}
+
+
+# ----------------------------------------------------------------------
+# validation — the one strict surface
+# ----------------------------------------------------------------------
+
+
+def value(name: str):
+    """Validated environment value for ``name`` per its declared kind
+    (the per-gate defaults/error messages are byte-compatible with the
+    historical ``*_env()`` functions, which now all delegate here)."""
+    sp = GATES[name]
+    env = os.environ.get(name)
+    if sp.kind == "strict3":
+        if env is not None and env not in ("auto", "1", "0"):
+            raise ValueError(
+                f"{name} must be 'auto', '1' or '0', got {env!r}")
+        return env if env is not None else "auto"
+    if sp.kind == "pallas":
+        return env if env is not None else "auto"
+    if sp.kind == "truthy":
+        return env                       # None when unset — caller's rule
+    if sp.kind == "choice":
+        legal = tuple(sp.default)
+        if env is not None and env not in legal:
+            pretty = ", ".join(f"'{v}'" for v in legal[:-1])
+            raise ValueError(
+                f"{name} must be {pretty} or '{legal[-1]}', got "
+                f"{env!r}")
+        return env if env is not None else legal[0]
+    if sp.kind == "enum01":
+        env = env if env is not None else ""
+        if env != "" and env not in ("0", "1"):
+            raise ValueError(
+                f"{name} must be '0' or '1', got {env!r}")
+        return env
+    if sp.kind == "posint":
+        if env is None:
+            return sp.default
+        try:
+            v = int(env)
+        except ValueError:
+            v = -1
+        if v <= 0:
+            raise ValueError(
+                f"{name} must be a positive integer (bytes), got "
+                f"{env!r}")
+        return v
+    if sp.kind == "int":
+        try:
+            return int(env) if env else int(sp.default)
+        except ValueError:
+            return int(sp.default)
+    if sp.kind == "offswitch":
+        return (env if env is not None else "1") not in ("0", "false",
+                                                         "")
+    if sp.kind == "path":
+        return env
+    raise AssertionError(f"unknown gate kind {sp.kind!r}")  # pragma: no cover
+
+
+# ----------------------------------------------------------------------
+# resolution helpers (probe -> validate -> degrade -> record, once)
+# ----------------------------------------------------------------------
+
+
+def _record_locked(rec: Dict[str, Any]) -> None:
+    if rec not in _PROVENANCE:
+        _PROVENANCE.append(dict(rec))
+        _COUNTERS["resolutions"] += 1
+
+
+def record(gate: str, **meta) -> None:
+    """Record one resolution a call site derived itself (the few gates
+    whose ``auto`` folds in run-structure the registry cannot see —
+    GST_HYPER_SCHUR's static-column count, GST_FUSE_STAGES' model
+    fusability). Never raises; dedup by content."""
+    rec = {"gate": gate}
+    for k, v in sorted(meta.items()):
+        rec[str(k)] = (v if isinstance(v, (int, float, bool, str,
+                                           type(None))) else repr(v))
+    with _LOCK:
+        _record_locked(rec)
+
+
+def mode3(name: str) -> Tuple[bool, bool]:
+    """``(enabled, forced)`` for a ``strict3`` gate declared with
+    ``requires``/``auto`` probes: ``0`` disables; missing capability
+    degrades silently even when forced (no runtime ever requires a
+    toolchain); ``1`` forces; ``auto`` resolves through the declared
+    probe (or to on, when the gate's only condition IS availability)."""
+    sp = GATES[name]
+    v = value(name)
+    if v == "0":
+        record(name, value=v, enabled=False, forced=False,
+               reason="disabled")
+    elif not all(probe(p) for p in sp.requires):
+        record(name, value=v, enabled=False, forced=False,
+               reason="unavailable: " + "+".join(
+                   p for p in sp.requires if not probe(p)))
+        return False, False
+    if v == "0":
+        return False, False
+    if v == "1":
+        record(name, value=v, enabled=True, forced=True,
+               reason="forced")
+        return True, True
+    if sp.auto is None:
+        record(name, value=v, enabled=True, forced=False,
+               reason="auto: capability present")
+        return True, False
+    on = probe(sp.auto)
+    record(name, value=v, enabled=on, forced=False,
+           reason=f"auto: probe {sp.auto}={on}")
+    return on, False
+
+
+def pallas_mode(name: str) -> Tuple[bool, bool, bool]:
+    """``(enabled, interpret, forced)`` — the shared Pallas kernel
+    gate vocabulary (pallas_util.mode_from_env, now registry-backed):
+    ``0``/``false``/empty off, ``interpret`` forced-interpret,
+    ``auto`` on for TPU backends, anything else forced on. Undeclared
+    names (the tests' synthetic gates) resolve by the same vocabulary
+    without a provenance row."""
+    env = (value(name) if name in GATES
+           else (os.environ.get(name, "auto")))
+    if env in ("0", "false", ""):
+        out = (False, False, False)
+        reason = "disabled"
+    elif env == "interpret":
+        out = (True, True, True)
+        reason = "forced (interpret)"
+    elif env == "auto":
+        out = (probe("tpu"), False, False)
+        reason = f"auto: probe tpu={out[0]}"
+    else:
+        out = (True, False, True)
+        reason = "forced"
+    if name in GATES:
+        record(name, value=env, enabled=out[0], forced=out[2],
+               reason=reason)
+    return out
+
+
+def int_value(name: str, default: Optional[int] = None,
+              mult: int = 8) -> int:
+    """Tuning integer: ``default`` when unset/empty/non-numeric (the
+    forgiving contract of the historical ``int_from_env``), rounded up
+    to a legal ``mult``-multiple."""
+    sp = GATES.get(name)
+    raw = os.environ.get(name, "")
+    base = default if default is not None else int(sp.default)
+    try:
+        val = int(raw) if raw else base
+    except ValueError:
+        val = base
+    out = -(-max(val, mult) // mult) * mult
+    if sp is not None:
+        record(name, value=out, reason="env" if raw else "default")
+    return out
+
+
+# ----------------------------------------------------------------------
+# provenance + counters
+# ----------------------------------------------------------------------
+
+
+def provenance() -> List[Dict[str, Any]]:
+    """Every distinct resolution/probe decision recorded so far."""
+    with _LOCK:
+        return [dict(r) for r in _PROVENANCE]
+
+
+def stats() -> Dict[str, int]:
+    """Fresh-vs-cached decision counters — the evidence the cold-start
+    gates grade: a warm spawn / failover respawn / ``recover()`` with
+    a valid gates cache shows ``probes_fresh == 0`` and
+    ``autotune_fresh == 0``."""
+    with _LOCK:
+        return dict(_COUNTERS)
+
+
+def registry_summary() -> Dict[str, Any]:
+    """The ``registry`` block for ledger records / ready.json: cache
+    state, counters, probe verdicts, and the resolution log."""
+    with _LOCK:
+        return {
+            "cache": dict(_CACHE_INFO),
+            "counters": dict(_COUNTERS),
+            "probes": {k: bool(v) for k, v in _PROBE_SEEN.items()},
+            "resolutions": [dict(r) for r in _PROVENANCE],
+        }
+
+
+# ----------------------------------------------------------------------
+# autotune decisions (first-trace evidence, persisted)
+# ----------------------------------------------------------------------
+
+
+def note_autotune(kind: str, key: str, val: Any = None) -> None:
+    """Record one first-trace decision — a linalg dispatcher's chosen
+    impl (``kind='linalg'``, ``key='factor=nchol'``) or a program's
+    measured compile wall (``kind='compile'``, ``key=label``). Counts
+    ``autotune_cached`` when the loaded gates cache already contains
+    the identical decision (a recovered pool re-deriving NOTHING),
+    ``autotune_fresh`` otherwise. Never raises (called from trace
+    paths through obs/introspect)."""
+    k = f"{kind}:{key}"
+    with _LOCK:
+        if k in _AUTOTUNE_SEEN:
+            return
+        known = False
+        if _CACHE is not None:
+            known = k in (_CACHE.get("autotune") or {})
+        _AUTOTUNE_SEEN[k] = known
+        if known:
+            _COUNTERS["autotune_cached"] += 1
+        else:
+            _COUNTERS["autotune_fresh"] += 1
+        _AUTOTUNE_LOG[k] = (val if isinstance(
+            val, (int, float, bool, str, type(None))) else repr(val))
+
+
+_AUTOTUNE_LOG: Dict[str, Any] = {}
+
+
+def autotune_snapshot() -> Dict[str, Any]:
+    with _LOCK:
+        out = dict(_AUTOTUNE_LOG)
+        # carry forward cached entries this process never re-derived,
+        # so a save after a warm run does not shrink the store
+        if _CACHE is not None:
+            for k, v in (_CACHE.get("autotune") or {}).items():
+                out.setdefault(k, v)
+        return out
+
+
+# ----------------------------------------------------------------------
+# persistence: the gates cache next to the AOT compile cache
+# ----------------------------------------------------------------------
+
+
+def _package_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def host_cache_dir() -> str:
+    """``<repo>/.jax_cache/<machine>-<cpu-flag-hash>-<jaxlib>`` — one
+    compile-cache subdirectory per distinct (host CPU, jaxlib build),
+    so an AOT executable is only ever loaded on the feature set AND
+    compiler build that produced it (bench.py's r07 hardening, now the
+    package-wide helper the serve pool workers share)."""
+    import platform as _platform
+
+    tag = _platform.machine() or "unknown"
+    tag += "-" + _cpu_flags_hash()
+    try:
+        import jaxlib
+
+        tag += "-" + getattr(jaxlib, "__version__", "unknown")
+    except Exception:  # noqa: BLE001 - fingerprint stays CPU-only
+        pass
+    return os.path.join(os.path.dirname(_package_root()), ".jax_cache",
+                        tag)
+
+
+def _cpu_flags_hash() -> str:
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for cl in fh:
+                if cl.startswith(("flags", "Features")):
+                    feats = " ".join(sorted(cl.split(":", 1)[1].split()))
+                    return hashlib.sha1(feats.encode()).hexdigest()[:12]
+    except OSError:
+        pass
+    return "noflags"
+
+
+def _so_digest() -> str:
+    """Cheap content proxy for the committed native library (its
+    ``gst_simd_level`` and ABI are baked into the file, so the digest
+    pins both without loading it): size+mtime hash, ``absent`` when
+    not built."""
+    try:
+        from gibbs_student_t_tpu import native
+
+        st = os.stat(native._LIB_PATH)
+        return hashlib.sha1(
+            f"{st.st_size}:{int(st.st_mtime)}".encode()).hexdigest()[:12]
+    except (OSError, Exception):  # noqa: BLE001
+        return "absent"
+
+
+def config_fingerprint_env() -> str:
+    """12-hex sha1 over the ``fp``-marked gates' environment values —
+    the dispatch configuration this process runs under. Two processes
+    with the same fingerprint resolve every dispatch identically, so
+    probe/autotune decisions transfer."""
+    items = sorted((n, os.environ.get(n) or "")
+                   for n, sp in GATES.items() if sp.fp)
+    return hashlib.sha1(repr(items).encode()).hexdigest()[:12]
+
+
+def cache_key() -> Dict[str, Any]:
+    key = {
+        "schema": GATE_CACHE_SCHEMA,
+        "abi": None,
+        "so_digest": _so_digest(),
+        "cpu_flags": _cpu_flags_hash(),
+        "jax": None,
+        "jaxlib": None,
+        "config_fp": config_fingerprint_env(),
+    }
+    try:
+        from gibbs_student_t_tpu.native.ffi import ABI_VERSION
+
+        key["abi"] = ABI_VERSION
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        import jax
+
+        key["jax"] = getattr(jax, "__version__", None)
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        import jaxlib
+
+        key["jaxlib"] = getattr(jaxlib, "__version__", None)
+    except Exception:  # noqa: BLE001
+        pass
+    return key
+
+
+def load_gate_cache(cache_dir: Optional[str] = None) -> bool:
+    """Load ``gates.json`` from the (host-fingerprinted) cache dir.
+    A missing file is a quiet cold start; a key mismatch is a LOUD
+    ignore — ``RuntimeWarning`` naming every stale component plus the
+    ``cache_ignored`` counter — followed by fresh probes. Returns
+    True when the cache armed."""
+    global _CACHE
+    d = cache_dir or host_cache_dir()
+    path = os.path.join(d, GATE_CACHE_NAME)
+    with _LOCK:
+        _CACHE_INFO["dir"] = d
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError):
+        with _LOCK:
+            _CACHE_INFO["loaded"] = False
+        return False
+    key, have = cache_key(), doc.get("key") or {}
+    stale = sorted(k for k in key if have.get(k) != key[k])
+    if stale:
+        with _LOCK:
+            _COUNTERS["cache_ignored"] += 1
+            _CACHE_INFO["loaded"] = False
+            _CACHE_INFO["ignored"] = "+".join(stale)
+        warnings.warn(
+            f"gates cache at {path} ignored: stale key components "
+            f"{stale} (saved {have}, host {key}) — fresh probe",
+            RuntimeWarning)
+        return False
+    with _LOCK:
+        _CACHE = doc
+        _CACHE_INFO["loaded"] = True
+        _CACHE_INFO["ignored"] = None
+    return True
+
+
+def save_gate_cache(cache_dir: Optional[str] = None) -> Optional[str]:
+    """Persist this process's probe outcomes + autotune decisions
+    (atomic write). Returns the path, or None when the directory is
+    unwritable (degrade silently: persistence is an optimization,
+    never a requirement)."""
+    d = cache_dir or _CACHE_INFO.get("dir") or host_cache_dir()
+    path = os.path.join(d, GATE_CACHE_NAME)
+    doc = {
+        "schema": GATE_CACHE_SCHEMA,
+        "key": cache_key(),
+        "saved_t": round(time.time(), 3),
+        "probes": probes_snapshot(),
+        "autotune": autotune_snapshot(),
+        "resolutions": provenance(),
+    }
+    try:
+        os.makedirs(d, exist_ok=True)
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh, indent=1)
+        os.replace(tmp, path)
+        return path
+    except OSError:
+        return None
+
+
+def _harden_aot_cache_writes() -> bool:
+    """Make jax's filesystem compilation-cache publishes ATOMIC.
+
+    The installed jax's ``LRUCache.put`` writes an entry with a plain
+    ``write_bytes`` to its final path — no temp file, no rename, and
+    (with eviction disabled, the default) no lock. Two pool workers
+    compiling the same chunk program concurrently therefore interleave
+    writes into ONE file, and any reader that hits the key mid-write
+    deserializes a torn serialized executable — measured on this host
+    as a glibc heap-corruption segfault that killed BOTH pools of a
+    fleet arm and then poisoned the cache dir for every later boot (a
+    torn entry never heals: ``put`` sees the path exists and returns).
+    A same-directory temp + ``os.replace`` publish closes both the
+    concurrent-writer and the killed-writer tear: readers only ever
+    observe absent or complete entries.
+
+    Version-tolerant (the parallel/compat.py discipline): patches only
+    the module shape it recognizes, once; anything unexpected leaves
+    jax untouched and returns False (callers proceed — the cache then
+    simply keeps upstream semantics)."""
+    try:
+        from jax._src import lru_cache as _lru
+
+        cls = _lru.LRUCache
+        cache_sfx = _lru._CACHE_SUFFIX
+        atime_sfx = _lru._ATIME_SUFFIX
+    except Exception:  # noqa: BLE001 - unknown jax: leave it alone
+        return False
+    if getattr(cls, "_gst_atomic_put", False):
+        return True
+    orig_put = cls.put
+
+    def put(self, key, val):
+        if getattr(self, "eviction_enabled", False):
+            # the evicting configuration takes a cross-process file
+            # lock and does bookkeeping we must not re-implement;
+            # we never enable it (no max size set)
+            return orig_put(self, key, val)
+        if not key:
+            raise ValueError("key cannot be empty")
+        try:
+            cache_path = self.path / f"{key}{cache_sfx}"
+            if cache_path.exists():
+                return
+            tmp = self.path / f"{key}.{os.getpid()}.tmp"
+            tmp.write_bytes(val)
+            os.replace(tmp, cache_path)
+            (self.path / f"{key}{atime_sfx}").write_bytes(
+                time.time_ns().to_bytes(8, "little"))
+        except Exception:  # noqa: BLE001 - a failed WRITE is a lost
+            pass           # optimization, never an error
+
+    cls.put = put
+    cls._gst_atomic_put = True
+    return True
+
+
+_AOT_ARMED = False
+
+
+def aot_cache_armed() -> bool:
+    """True once :func:`enable_persistent_cache` pointed jax's
+    persistent compilation cache at a directory in THIS process.
+    Dispatch resolutions consult it: a chunk program that DONATES its
+    state buffers must not be deserialized from the AOT cache on this
+    jaxlib — a deserialized donated executable loses its aliasing
+    contract and corrupts the heap (measured on the graded host: both
+    pools of a fleet arm segfaulting in glibc malloc at tenant
+    admission) — so ``GST_DONATE_CHUNK``'s ``auto`` resolves OFF in
+    cache-armed processes (forcing ``1`` remains the A/B hatch, at
+    the caller's own risk)."""
+    return _AOT_ARMED
+
+
+def enable_persistent_cache(cache_dir: Optional[str] = None,
+                            min_compile_s: float = 1.0) -> Dict[str, Any]:
+    """Arm BOTH cold-start caches for this process: point jax's
+    persistent compilation cache at the per-host directory (the AOT
+    half — a warm process loads compiled executables instead of
+    re-lowering ~5.5 s programs) and load the gates cache beside it
+    (the probe/autotune half). Idempotent; call before the first
+    trace. Returns ``{dir, aot, gates}`` for the caller's ledger
+    evidence. ``GST_CACHE_DIR`` overrides the per-host default (the
+    cold-vs-warm bench arms point spawned workers at scratch dirs
+    this way); ``GST_CACHE_DIR=0`` disables the arming entirely (the
+    operational escape hatch)."""
+    override = value("GST_CACHE_DIR")
+    if override == "0":
+        return {"dir": None, "aot": False, "gates": False,
+                "disabled": True}
+    global _AOT_ARMED
+    d = cache_dir or override or host_cache_dir()
+    aot = False
+    try:
+        import jax
+
+        _harden_aot_cache_writes()
+        jax.config.update("jax_compilation_cache_dir", d)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_s))
+        aot = True
+        _AOT_ARMED = True
+    except Exception:  # noqa: BLE001 - older jax without the knobs
+        pass
+    gates = load_gate_cache(d)
+    return {"dir": d, "aot": aot, "gates": gates}
+
+
+def _reset_for_tests() -> None:
+    """Drop every latched verdict/counter (tests only)."""
+    global _CACHE, _AOT_ARMED
+    with _LOCK:
+        _AOT_ARMED = False
+        _PROBE_SEEN.clear()
+        _PROVENANCE.clear()
+        _AUTOTUNE_SEEN.clear()
+        _AUTOTUNE_LOG.clear()
+        _CACHE = None
+        _CACHE_INFO.update({"dir": None, "loaded": False,
+                            "ignored": None})
+        for k in _COUNTERS:
+            _COUNTERS[k] = 0
+
+
+# ----------------------------------------------------------------------
+# the generated env-gate index (tools/gates.py --markdown)
+# ----------------------------------------------------------------------
+
+
+def gates_markdown() -> List[str]:
+    """The OBSERVABILITY.md env-gate index table rows, generated from
+    :data:`GATES` (tests pin the committed docs section to exactly
+    this output, so the table can never drift from the registry)."""
+    lines = ["| gate | layer | what it gates |",
+             "|------|-------|---------------|"]
+    for name in sorted(GATES):
+        sp = GATES[name]
+        lines.append(f"| `{name}` | {sp.layer} | {sp.doc} |")
+    return lines

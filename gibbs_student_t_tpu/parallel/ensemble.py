@@ -282,16 +282,13 @@ class EnsembleGibbs:
         always wins, so per-arm A/B harnesses (tools/ensemble_attrib.py)
         measure the form they asked for regardless of the caller's
         environment."""
-        import os
+        from gibbs_student_t_tpu.ops import registry
 
-        env = os.environ.get("GST_ENSEMBLE_UNROLL", "")
-        if env != "" and env not in ("0", "1"):
-            # validated whenever SET, even when an explicit unroll=
-            # argument means it won't be consulted: a typo'd override
-            # must fail loudly, not silently measure the wrong arm
-            # (ADVICE r5)
-            raise ValueError(
-                f"GST_ENSEMBLE_UNROLL must be '0' or '1', got {env!r}")
+        # validated whenever SET, even when an explicit unroll=
+        # argument means it won't be consulted: a typo'd override
+        # must fail loudly, not silently measure the wrong arm
+        # (ADVICE r5; the registry's enum01 kind)
+        env = registry.value("GST_ENSEMBLE_UNROLL")
         if env != "" and unroll == "auto":
             unroll = env == "1"
         mesh_ok = (self.mesh is None

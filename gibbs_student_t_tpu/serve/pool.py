@@ -197,9 +197,11 @@ class SlotPool:
         # serving): between quanta the canonical state lives on device
         # and the chunk donates it; the host mirror is pulled lazily
         # for admission writes and checkpoint reads
-        from gibbs_student_t_tpu.backends.jax_backend import _donate_env
+        from gibbs_student_t_tpu.backends.jax_backend import (
+            donate_resolved,
+        )
 
-        self._donate = _donate_env() != "0"
+        self._donate = donate_resolved()
         self._state_dev = None        # latest post-quantum device state
         self._host_valid = True       # _state_np mirrors the canon
         # the ONE compiled chunk program

@@ -135,6 +135,7 @@ class ProcPool:
             os.pathsep + child_env["PYTHONPATH"]
             if child_env.get("PYTHONPATH") else "")
         log = open(os.path.join(spec.pool_dir, "worker.log"), "ab")
+        t_spawn = time.monotonic()
         try:
             proc = subprocess.Popen(cmd, env=child_env, stdout=log,
                                     stderr=subprocess.STDOUT)
@@ -154,7 +155,11 @@ class ProcPool:
             time.sleep(0.05)
         with open(ready_path) as fh:
             ready = _json.load(fh)
-        return cls(spec, proc, ready)
+        pool = cls(spec, proc, ready)
+        # spawn→ready wall (the cold-start metric's first leg; the
+        # worker's own boot/build breakdown rides ready["coldstart"])
+        pool.spawn_s = round(time.monotonic() - t_spawn, 3)
+        return pool
 
     # -- the pool surface the router drives -----------------------------
 
@@ -274,6 +279,18 @@ class RoutedHandle:
         self._inner = inner
         self._gen = 0               # bumps at every rebind
         self._rebound = threading.Event()
+        # raised for the duration of a live migration: the source
+        # pool's cancel-freeze makes the old inner LOOK finished (its
+        # result is the served prefix), so while this latch is up a
+        # terminal outcome from the pre-migration generation is
+        # discarded and the caller's wait rides through to the
+        # resumed tenant — the same ride-through contract failover
+        # gives callers blocked in result()
+        self._migrating = threading.Event()
+        # a migration that cancelled the tenant and then could not
+        # resume it ANYWHERE poisons the handle: result() raises this
+        # instead of passing the served prefix off as the result
+        self._migration_error: Optional[BaseException] = None
 
     @property
     def tenant_id(self):
@@ -315,6 +332,10 @@ class RoutedHandle:
         return self._retryable(lambda h: h.cost())
 
     def done(self) -> bool:
+        if self._migrating.is_set():
+            # the source's cancel-freeze resolves the OLD inner; the
+            # tenant itself is mid-flight to another pool
+            return False
         return self._retryable(lambda h: h.done())
 
     @property
@@ -326,21 +347,48 @@ class RoutedHandle:
     def cancel(self) -> bool:
         return self.router.cancel(self)
 
+    def _ride_migration(self, gen: int) -> bool:
+        """True when an outcome observed at generation ``gen`` belongs
+        to a migration in flight (or one that just landed) and must be
+        discarded: wait briefly for the rebind, then re-poll the new
+        inner."""
+        if self._gen != gen:
+            return True
+        if not self._migrating.is_set():
+            return False
+        self._rebound.wait(timeout=1.0)
+        return True
+
     def result(self, timeout: Optional[float] = None):
         deadline = (None if timeout is None
                     else time.monotonic() + timeout)
         while True:
             remaining = (None if deadline is None
                          else max(deadline - time.monotonic(), 0.0))
+            gen = self._gen
             try:
-                return self._retryable(
+                res = self._retryable(
                     lambda h, r=remaining: h.result(timeout=r))
             except TimeoutError:
                 if deadline is not None \
                         and time.monotonic() >= deadline:
                     raise
+                continue
                 # a server-side wait expiring under an open deadline
                 # (failover window): poll again
+            except Exception:
+                # a migration's cancel resolves the old inner with
+                # the served-prefix/cancelled outcome — discard it
+                # and wait out the rebind; anything outside a
+                # migration is a real failure
+                if self._ride_migration(gen):
+                    continue
+                raise
+            if self._ride_migration(gen):
+                continue   # pre-migration prefix, not the result
+            if self._migration_error is not None:
+                raise self._migration_error
+            return res
 
 
 class FleetRouter:
@@ -359,7 +407,11 @@ class FleetRouter:
                  watch_poll_s: float = WATCH_POLL_S,
                  status_stale_s: float = 30.0,
                  http_port: Optional[int] = None,
-                 http_host: str = "127.0.0.1"):
+                 http_host: str = "127.0.0.1",
+                 rebalance: bool = False,
+                 rebalance_poll_s: float = 2.0,
+                 rebalance_min_sweeps: float = 0.0,
+                 rebalance_running: bool = False):
         if placement not in ("load", "round_robin"):
             raise ValueError(
                 f"placement must be 'load' or 'round_robin', got "
@@ -383,9 +435,28 @@ class FleetRouter:
         # on the 1-core bench host: a 12/4/4/4 split over 4 pools)
         self.status_stale_s = status_stale_s
         self._status_cache: Dict[int, tuple] = {}
+        # per-pool cache generation: bumped whenever a pool's identity
+        # or load changes OUT OF BAND (failover respawn, migration) so
+        # an in-flight poll of the OLD pool can never write a stale
+        # snapshot back after the invalidation — without this, a
+        # recovered pool could sit behind a stale "loaded" snapshot
+        # for a full status_stale_s TTL and receive no placements
+        self._status_gen: Dict[int, int] = {}
         self.placements: Dict[str, int] = {}
         self.failovers = 0
         self.resubmitted = 0
+        # live migration (ROADMAP 1b "re-balancing long tenants onto
+        # drained pools"): counters + the optional policy thread
+        self.rebalance = bool(rebalance)
+        self.rebalance_min_sweeps = float(rebalance_min_sweeps)
+        # queued steals are near-free replays; stealing a RUNNING
+        # tenant pays a checkpoint round-trip measured in quanta —
+        # on shared-core hosts it only wins for deep queues and long
+        # residents, so the policy takes it opt-in (explicit
+        # ``migrate()`` is always available either way)
+        self.rebalance_running = bool(rebalance_running)
+        self.migrations = 0
+        self.migration_failures = 0
         self._stop = threading.Event()
         self._watch: Optional[threading.Thread] = None
         if failover:
@@ -393,6 +464,12 @@ class FleetRouter:
                 target=self._watch_loop, args=(watch_poll_s,),
                 name="gst-fleet-watch", daemon=True)
             self._watch.start()
+        self._rebal: Optional[threading.Thread] = None
+        if rebalance:
+            self._rebal = threading.Thread(
+                target=self._rebalance_loop, args=(rebalance_poll_s,),
+                name="gst-fleet-rebalance", daemon=True)
+            self._rebal.start()
         self.http = None
         if http_port is not None:
             try:
@@ -423,9 +500,15 @@ class FleetRouter:
             if i in self._dead:
                 out.append((i, ConnectionError("pool marked dead")))
                 continue
+            gen = self._status_gen.get(i, 0)
             try:
                 st = p.status()
-                self._status_cache[i] = (now, st)
+                if self._status_gen.get(i, 0) == gen:
+                    # only cache when the pool was not invalidated
+                    # (failover/migration) while this poll was in
+                    # flight — a snapshot of the OLD pool must not
+                    # outlive its replacement
+                    self._status_cache[i] = (now, st)
                 out.append((i, st))
             except Exception as e:  # noqa: BLE001 - a dead pool is data
                 cached = self._status_cache.get(i)
@@ -435,6 +518,15 @@ class FleetRouter:
                 else:
                     out.append((i, e))
         return out
+
+    def _invalidate_status(self, idx: int) -> None:
+        """Drop pool ``idx``'s cached snapshot NOW and fence any poll
+        already in flight against re-caching it (the bounded-staleness
+        cache serves placement when a busy pool's poll times out — a
+        respawned or migration-rebalanced pool must never hide behind
+        its predecessor's load for a TTL)."""
+        self._status_gen[idx] = self._status_gen.get(idx, 0) + 1
+        self._status_cache.pop(idx, None)
 
     @staticmethod
     def _est_backlog(st: dict) -> float:
@@ -525,13 +617,23 @@ class FleetRouter:
     # the ChainServer-shaped fleet surface
     # ------------------------------------------------------------------
 
-    def submit(self, request, timeout=None) -> RoutedHandle:
+    def submit(self, request, timeout=None,
+               pool: Optional[int] = None) -> RoutedHandle:
         """Place one tenant and return its routed handle. Placement is
         status-driven (one poll sweep per submit — submits are rare
         next to quanta); the chosen pool's own admission queue applies
-        its backpressure policy."""
+        its backpressure policy. ``pool`` pins the placement to one
+        pool index — the operational escape hatch (and the imbalance
+        generator behind ``fleet_bench --migrate-arm``); a pinned dead
+        pool raises."""
         with self._lock:
-            idx = self._place(request)
+            if pool is not None:
+                if pool in self._dead:
+                    raise RuntimeError(
+                        f"pinned pool {pool} is dead")
+                idx = pool
+            else:
+                idx = self._place(request)
             inner = self.pools[idx].submit(request, timeout=timeout)
             rh = RoutedHandle(self, request, idx, inner)
             self._routed.append(rh)
@@ -596,6 +698,9 @@ class FleetRouter:
             "failovers": self.failovers,
             "resubmitted": self.resubmitted,
             "dead_pools": len(self._dead),
+            "rebalance": bool(self.rebalance),
+            "migrations": self.migrations,
+            "migration_failures": self.migration_failures,
         }
         return snap
 
@@ -610,6 +715,8 @@ class FleetRouter:
         with self._lock:
             self.placements.clear()
             self.resubmitted = 0
+            self.migrations = 0
+            self.migration_failures = 0
 
     def close(self, grace: float = 30.0) -> None:
         """Retire the fleet: stop the watch, close the wire, shut
@@ -618,6 +725,9 @@ class FleetRouter:
         if self._watch is not None:
             self._watch.join(timeout=5.0)
             self._watch = None
+        if self._rebal is not None:
+            self._rebal.join(timeout=5.0)
+            self._rebal = None
         if self.http is not None:
             self.http.close()
             self.http = None
@@ -682,7 +792,7 @@ class FleetRouter:
             self.pools[idx] = new_pool
             self._dead.discard(idx)
             self._unreachable[idx] = 0
-            self._status_cache.pop(idx, None)   # dead pool's snapshot
+            self._invalidate_status(idx)   # dead pool's snapshot
             self.failovers += 1
         for rh in victims:
             key = (rh.request.name if rh.request.name is not None
@@ -700,6 +810,238 @@ class FleetRouter:
                     self.placements.get(label, 0) + 1
                 self.resubmitted += 1
             rh._rebind(tgt, inner)
+
+    # ------------------------------------------------------------------
+    # live migration (spool checkpoint -> cancel -> resume elsewhere)
+    # ------------------------------------------------------------------
+
+    def migrate(self, rh: RoutedHandle, to_idx: int,
+                timeout: float = 600.0) -> bool:
+        """Move one tenant to pool ``to_idx`` live, through the
+        primitive failover already proved bitwise: freeze at the next
+        quantum boundary (``cancel``), read the spool checkpoint the
+        finalize fenced, resume on the target from exactly that sweep
+        (docs/SERVING.md "Live migration" — same per-sweep fold-in
+        keying, so the migrated tenant's full-run result is bitwise
+        the unmigrated run's). A tenant still queued (nothing served)
+        is replayed from scratch on the target instead —
+        request-replay determinism makes that exact too. Callers
+        blocked in ``result()`` ride through the rebind.
+
+        Returns True when the tenant now lives on ``to_idx``; False
+        when there was nothing to migrate (finished/unknown, same
+        pool). On a resume-submit failure the tenant goes BACK to its
+        source pool (it just vacated capacity there) — failure never
+        strands a tenant (``migration_failures`` counts it)."""
+        with self._lock:
+            src = rh.pool_idx
+            if (rh not in self._routed or src == to_idx
+                    or src in self._dead or to_idx in self._dead
+                    or rh._migrating.is_set() or self._finished(rh)):
+                return False
+            rh._migrating.set()
+        try:
+            return self._migrate_inner(rh, src, to_idx, timeout)
+        finally:
+            rh._migrating.clear()
+
+    def _migrate_inner(self, rh: RoutedHandle, src: int, to_idx: int,
+                       timeout: float) -> bool:
+        from dataclasses import replace as _replace
+
+        inner, req = rh._inner, rh.request
+        if not self.pools[src].cancel(inner):
+            return False   # already finished: nothing to move
+        # checkpoint fencing: the source finalizes the frozen tenant
+        # at the next boundary — spool closed, rolling checkpoint
+        # consistent with the served prefix — and only THEN reports
+        # done; the spool is not read before that
+        deadline = time.monotonic() + timeout
+        while not inner.done():
+            if time.monotonic() > deadline:
+                with self._lock:
+                    self.migration_failures += 1
+                raise TimeoutError(
+                    f"migration source pool {src} did not release "
+                    f"tenant within {timeout}s of cancel")
+            time.sleep(0.02)
+        resume_req = req
+        if req.spool_dir is not None:
+            try:
+                from gibbs_student_t_tpu.utils.spool import (
+                    load_spool_state,
+                )
+
+                _state, next_sweep, _seed = load_spool_state(
+                    req.spool_dir)
+            except Exception:  # noqa: BLE001 - no checkpoint yet
+                _state, next_sweep = None, req.start_sweep
+            served = next_sweep - req.start_sweep
+            if _state is not None and served > 0:
+                if req.niter - served <= 0:
+                    return False   # fully served: the prefix IS the run
+                # wire-safe resume: the TARGET loads the checkpoint
+                # from the spool at submit (a state pytree cannot
+                # ride the RPC submit frame); start_sweep doubles as
+                # the fencing cross-check against the checkpoint we
+                # just sized the remaining budget from
+                resume_req = _replace(
+                    req, niter=req.niter - served, state=None,
+                    start_sweep=next_sweep, resume_spool=True)
+        # resume on the target; on failure fall back to the source
+        # (its lanes just freed), then to a full from-scratch replay
+        # (request-replay determinism makes it exact, just wasteful)
+        # — a cancelled tenant must NEVER be left delivering its
+        # served prefix as if it were the result
+        attempts = [(to_idx, resume_req), (src, resume_req)]
+        if resume_req is not req:
+            attempts += [(to_idx, req), (src, req)]
+        last_err = None
+        inner2 = None
+        for tgt, r in attempts:
+            try:
+                inner2 = self.pools[tgt].submit(r)
+                break
+            except Exception as e:  # noqa: BLE001
+                last_err = e
+                warnings.warn(
+                    f"migration resume attempt on pool {tgt} failed "
+                    f"({type(e).__name__}: {e}); trying the next "
+                    "fallback", RuntimeWarning)
+        if inner2 is None:
+            with self._lock:
+                self.migration_failures += 1
+            err = RuntimeError(
+                f"migration of tenant {getattr(inner, 'tenant_id', '?')} "
+                f"failed on both target {to_idx} and source {src} — "
+                "the tenant was cancelled and could not be resumed "
+                "anywhere; its handle holds only the served prefix")
+            err.__cause__ = last_err
+            rh._migration_error = err   # callers must not get the
+            raise err                   # prefix as if it completed
+        with self._lock:
+            label = self.pools[tgt].label
+            self.placements[label] = self.placements.get(label, 0) + 1
+            if tgt == to_idx:
+                self.migrations += 1
+            else:
+                self.migration_failures += 1
+            # both pools' load just changed out of band — a stale
+            # "loaded"/"drained" snapshot must not steer placement or
+            # the next rebalance pass (the respawn-staleness fix,
+            # applied to migration too)
+            self._invalidate_status(src)
+            self._invalidate_status(tgt)
+        rh._rebind(tgt, inner2)
+        return tgt == to_idx
+
+    def _rebalance_loop(self, poll_s: float) -> None:
+        while not self._stop.wait(poll_s):
+            try:
+                self._rebalance_once()
+            except Exception as e:  # noqa: BLE001 - policy is advisory
+                warnings.warn(
+                    f"fleet rebalance pass failed "
+                    f"({type(e).__name__}: {e}); tenants stay put",
+                    RuntimeWarning)
+
+    def _rebalance_once(self) -> bool:
+        """One policy pass: the most-drained pool (free lane groups,
+        empty queue — it is dispatching its remaining residents either
+        way, so stolen tenants ride lanes that were computing idle)
+        steals the longest-backlog tenant from the most-loaded pool
+        (queue pressure first, then the PR 14 ``est_sweeps_to_target``
+        backlog evidence). One migration per pass bounds churn; a
+        queued victim is preferred (replay beats checkpoint
+        round-trips), else the running spooled tenant with the most
+        remaining sweeps."""
+        with self._lock:
+            sts = {i: st for i, st in self._statuses()
+                   if isinstance(st, dict)
+                   and not (st.get("faults") or {}).get("pool_failures")}
+        if len(sts) < 2:
+            return False
+        # destination: free capacity, nothing waiting locally
+        dests = [(-(st.get("free_groups") or 0), i)
+                 for i, st in sts.items()
+                 if (st.get("free_groups") or 0) > 0
+                 and not (st.get("queue_depth") or 0)
+                 and not (st.get("staged") or 0)]
+        if not dests:
+            return False
+        dst = min(dests)[1]
+        # source: heaviest load, excluding the destination
+        srcs = [(((st.get("queue_depth") or 0) + (st.get("staged") or 0),
+                  self._est_backlog(st)), i)
+                for i, st in sts.items() if i != dst]
+        srcs = [s for s in srcs if s[0] > (0, 0.0)]
+        if not srcs:
+            return False
+        (src_load, src_backlog), src = max(srcs)
+        if src_load == 0:
+            # no queued/staged work on the source: a running steal
+            # would just empty its slot (the lanes it vacates idle —
+            # dispatch cost unchanged) while paying the checkpoint
+            # round-trip; measured a straight loss, so the policy
+            # only acts on real queue pressure
+            return False
+        victim = self._pick_victim(
+            src, sts[src], sts[dst],
+            allow_running=self.rebalance_running and src_load > 1)
+        if victim is None:
+            return False
+        return self.migrate(victim, dst)
+
+    def _pick_victim(self, src: int, src_st: dict, dst_st: dict,
+                     allow_running: bool = True
+                     ) -> Optional[RoutedHandle]:
+        """The tenant to steal from ``src``: a queued one first (its
+        whole budget moves for the price of a replay), else the
+        running spooled tenant with the largest remaining backlog
+        (``est_sweeps_to_target``-capped, the PR 14 evidence) that
+        fits the destination's free groups. Streamed (``on_chunk``)
+        tenants stay put — their dedicated result connection pins
+        them to the pool that owns it."""
+        group = dst_st.get("group") or 1
+        free_lanes = (dst_st.get("free_groups") or 0) * group
+        with self._lock:
+            cands = [rh for rh in self._routed
+                     if rh.pool_idx == src
+                     and not rh._migrating.is_set()
+                     and rh.request.on_chunk is None
+                     and rh.request.nchains <= free_lanes
+                     and not self._finished(rh)]
+        by_tid = {t.get("tenant_id"): t
+                  for t in src_st.get("tenants") or []
+                  if isinstance(t, dict)}
+        queued, running = [], []
+        for rh in cands:
+            t = by_tid.get(getattr(rh._inner, "tenant_id", None))
+            if t is None:
+                # not resident on the source: queued (or just staged)
+                queued.append(rh)
+                continue
+            if rh.request.spool_dir is None or t.get("cancelled") \
+                    or t.get("failed"):
+                continue
+            rem = max((t.get("niter") or 0)
+                      - (t.get("sweeps_done") or 0), 0)
+            est = t.get("est_sweeps_to_target")
+            if isinstance(est, (int, float)) \
+                    and not isinstance(est, bool):
+                rem = min(rem, max(float(est), 0.0))
+            if rem * (t.get("nchains") or 1) \
+                    >= self.rebalance_min_sweeps:
+                running.append((rem, rh))
+        if queued:
+            return queued[0]
+        if running and allow_running:
+            # a running steal frees a slot the source can immediately
+            # backfill from its (deep) queue; with at most one queued
+            # job left the replay of THAT job is always the better
+            # move, so running steals need allow_running
+            return max(running, key=lambda x: x[0])[1]
+        return None
 
     @staticmethod
     def _finished(rh: RoutedHandle) -> bool:

@@ -52,7 +52,6 @@ the severed-wire chaos arm.
 from __future__ import annotations
 
 import json
-import os
 import pickle
 import queue as _queue
 import socket
@@ -89,19 +88,11 @@ def rpc_max_frame_env() -> int:
     """Validated ``GST_RPC_MAX_FRAME`` (bytes; the loud-typo contract
     of every GST_* gate): unset → 256 MiB, else a strict positive
     integer — the per-frame allocation ceiling both sides enforce
-    BEFORE reading a payload."""
-    env = os.environ.get("GST_RPC_MAX_FRAME")
-    if env is None:
-        return DEFAULT_MAX_FRAME
-    try:
-        v = int(env)
-    except ValueError:
-        v = -1
-    if v <= 0:
-        raise ValueError(
-            f"GST_RPC_MAX_FRAME must be a positive integer (bytes), "
-            f"got {env!r}")
-    return v
+    BEFORE reading a payload. Validation is the registry's ``posint``
+    kind (ops/registry.py)."""
+    from gibbs_student_t_tpu.ops import registry
+
+    return registry.value("GST_RPC_MAX_FRAME")
 
 
 class Pickled:
@@ -260,7 +251,8 @@ def recv_frame(sock: socket.socket,
 
 #: TenantRequest fields that ride the wire as plain JSON values
 _REQ_SCALARS = ("niter", "nchains", "seed", "start_sweep", "spool_dir",
-                "name", "on_divergence", "on_converged")
+                "name", "on_divergence", "on_converged",
+                "resume_spool")
 
 #: MonitorSpec fields (all JSON-able)
 _MON_FIELDS = ("params", "ess_target", "rhat_target", "every",

@@ -108,6 +108,14 @@ class TenantRequest:
     state: object = None
     start_sweep: int = 0
     spool_dir: Optional[str] = None
+    #: wire-safe resume (round 18, the live-migration path): the
+    #: SERVER loads ``state``/``start_sweep`` from ``spool_dir``'s
+    #: rolling checkpoint at submit — a state pytree never rides the
+    #: RPC submit frame (rpc.py rejects it by design). When
+    #: ``start_sweep`` is also set, the loaded checkpoint must sit at
+    #: exactly that sweep (the migration fencing cross-check) or the
+    #: submit is rejected loudly.
+    resume_spool: bool = False
     on_chunk: Optional[Callable] = None   # (handle, sweep_end, records)
     name: Optional[str] = None
     on_divergence: str = "none"

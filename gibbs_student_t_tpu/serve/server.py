@@ -128,11 +128,9 @@ def serve_pipeline_env() -> str:
     executor is a pure host-scheduling change whose per-tenant results
     are bitwise the serial loop's, on every platform. ``0`` keeps the
     serial quantum loop (the A/B arm and the bitwise reference)."""
-    env = os.environ.get("GST_SERVE_PIPELINE")
-    if env is not None and env not in ("auto", "1", "0"):
-        raise ValueError(
-            f"GST_SERVE_PIPELINE must be 'auto', '1' or '0', got {env!r}")
-    return env if env is not None else "auto"
+    from gibbs_student_t_tpu.ops import registry
+
+    return registry.value("GST_SERVE_PIPELINE")
 
 
 def serve_recycle_env() -> str:
@@ -147,11 +145,9 @@ def serve_recycle_env() -> str:
     drain-side bookkeeping + an extra ``row_class`` key on streamed
     records; pinned in tests/test_recycle.py). ``0`` disables all
     tagging/weighting — the PR 13 drain graph verbatim."""
-    env = os.environ.get("GST_RECYCLE")
-    if env is not None and env not in ("auto", "1", "0"):
-        raise ValueError(
-            f"GST_RECYCLE must be 'auto', '1' or '0', got {env!r}")
-    return env if env is not None else "auto"
+    from gibbs_student_t_tpu.ops import registry
+
+    return registry.value("GST_RECYCLE")
 
 
 def serve_supervise_env() -> str:
@@ -161,12 +157,9 @@ def serve_supervise_env() -> str:
     fault-free run is bitwise identical either way). ``0`` keeps the
     historical fail-fast semantics — any worker exception latches a
     pool-wide error — as the reference arm."""
-    env = os.environ.get("GST_SERVE_SUPERVISE")
-    if env is not None and env not in ("auto", "1", "0"):
-        raise ValueError(
-            f"GST_SERVE_SUPERVISE must be 'auto', '1' or '0', got "
-            f"{env!r}")
-    return env if env is not None else "auto"
+    from gibbs_student_t_tpu.ops import registry
+
+    return registry.value("GST_SERVE_SUPERVISE")
 
 
 @dataclass
@@ -637,6 +630,32 @@ class ChainServer:
         its handle. Validation that needs the pool template happens at
         staging/admission time; a structurally incompatible tenant is
         rejected through its handle."""
+        if getattr(request, "resume_spool", False) \
+                and request.state is None:
+            # wire-safe resume (the live-migration path): load the
+            # rolling checkpoint HERE, server-side — the state pytree
+            # never rides a submit frame. The fencing cross-check: a
+            # caller that computed the remaining budget from a
+            # checkpoint must get exactly that checkpoint, or the
+            # resumed chains would not be the uninterrupted run's.
+            if request.spool_dir is None:
+                raise ValueError(
+                    "resume_spool needs spool_dir (the checkpoint to "
+                    "resume from)")
+            from gibbs_student_t_tpu.utils.spool import (
+                load_spool_state,
+            )
+
+            state, next_sweep, _seed = load_spool_state(
+                request.spool_dir)
+            if request.start_sweep and next_sweep != request.start_sweep:
+                raise ValueError(
+                    f"resume_spool checkpoint sits at sweep "
+                    f"{next_sweep}, not the requested start_sweep "
+                    f"{request.start_sweep} — the spool moved under "
+                    "the resume (fencing violation)")
+            request.state = state
+            request.start_sweep = next_sweep
         if request.niter < 1 or request.niter % self.pool.quantum:
             raise ValueError(
                 f"niter ({request.niter}) must be a positive multiple "
@@ -2682,7 +2701,25 @@ class ChainServer:
         that were admitted without a spool died with the process —
         they are listed on ``server.lost_tenants``, never silently
         dropped. ``overrides`` adjust constructor kwargs (the pool
-        geometry defaults to the manifest's record)."""
+        geometry defaults to the manifest's record).
+
+        ``persistent_cache=True`` arms the cold-start caches first
+        (ops/registry.enable_persistent_cache): the per-host AOT
+        compile cache replays the pool's chunk-program compile and
+        the gates cache replays every probe/autotune decision the
+        dead process already derived — a recovered pool reaches
+        first dispatch with zero fresh registry events (the
+        ``perf_report --check`` recover gate). The production
+        recovery path (``pool_main --recover``, i.e. every failover
+        respawn) arms the process BEFORE calling here, so the
+        default is False: arming is process-global (it also degrades
+        ``GST_DONATE_CHUNK``, see backends/jax_backend.
+        donate_resolved), which an in-process library caller — or a
+        test suite sharing one process — must opt into knowingly."""
+        if overrides.pop("persistent_cache", False):
+            from gibbs_student_t_tpu.ops import registry as _registry
+
+            _registry.enable_persistent_cache()
         from gibbs_student_t_tpu.serve.manifest import (
             load_server_state,
             load_tenant_model,

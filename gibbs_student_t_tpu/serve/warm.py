@@ -34,7 +34,6 @@ prior init (the silent-degradation discipline of every GST_* arm).
 
 from __future__ import annotations
 
-import os
 import time
 from dataclasses import dataclass, field
 from typing import Dict, Optional
@@ -52,11 +51,9 @@ def warm_start_env() -> str:
     default spec (requests keep their own); ``0`` disables the arm —
     every tenant serves from the cold prior init, bitwise the
     pre-warm-start graph (requests degrade with an event, pinned)."""
-    env = os.environ.get("GST_WARM_START")
-    if env is not None and env not in ("auto", "1", "0"):
-        raise ValueError(
-            f"GST_WARM_START must be 'auto', '1' or '0', got {env!r}")
-    return env if env is not None else "auto"
+    from gibbs_student_t_tpu.ops import registry
+
+    return registry.value("GST_WARM_START")
 
 
 @dataclass

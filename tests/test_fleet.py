@@ -515,3 +515,116 @@ def test_fleet_bench_quick_ledger_matches_final_line(tmp_path):
                             "fleet_bench line", defs=schemas)
     obs_schema.assert_valid(recs[0], schemas["ledger_record"],
                             "fleet_bench record", defs=schemas)
+
+
+# ---------------------------------------------------------------------------
+# live migration (round 18): checkpoint -> cancel -> resume, bitwise
+# ---------------------------------------------------------------------------
+
+class _RpcPool:
+    """An in-process pool behind a REAL RpcServer/RemoteChainServer
+    pair — the router sees the exact wire surface a subprocess pool
+    exposes (the migration resume submit must survive RPC
+    serialization: a state pytree cannot ride the frame, so the
+    resume goes spool_dir + resume_spool) without paying a worker
+    spawn."""
+
+    def __init__(self, server, label):
+        self.server = server
+        self.label = label
+        self.proc = None
+        self.status_url = None
+        self.rpc = RpcServer(server)
+        self.remote = RemoteChainServer(self.rpc.address)
+        server.start()
+
+    alive = True
+
+    def submit(self, request, timeout=None):
+        return self.remote.submit(request, timeout=timeout)
+
+    def cancel(self, handle):
+        return self.remote.cancel(handle)
+
+    def status(self):
+        return self.server.status()
+
+    def healthz(self):
+        return self.server.healthz()
+
+    def reset_counters(self):
+        self.server.reset_counters()
+
+    def close(self, grace=30.0):
+        self.remote.close()
+        self.rpc.close()
+        self.server.close()
+
+
+@pytest.mark.skipif(not _native_available(),
+                    reason="migration rides the spool (native)")
+def test_live_migration_bitwise_over_the_wire(demo, tmp_path):
+    """The round-18 tentpole pin: a RUNNING spooled tenant migrated
+    between two wire-fronted pools (spool checkpoint -> cancel ->
+    resume_spool submit on the target) and a QUEUED tenant migrated
+    by replay both deliver results BITWISE identical to uninterrupted
+    single-pool reference runs; a caller blocked in result() rides
+    through the rebind; the router's status caches for both pools are
+    invalidated at the migration boundary."""
+    import threading
+
+    from gibbs_student_t_tpu.serve.router import FleetRouter
+
+    ma, cfg = demo
+    kw = dict(nlanes=32, quantum=5, record="full")
+
+    # uninterrupted references (one server, serial runs)
+    ref_srv = ChainServer(ma, cfg, **kw)
+    h_run = ref_srv.submit(TenantRequest(
+        ma=ma, niter=40, nchains=16, seed=7, name="R",
+        spool_dir=str(tmp_path / "ref_run")))
+    h_q = ref_srv.submit(TenantRequest(
+        ma=ma, niter=20, nchains=16, seed=3, name="Q"))
+    ref_srv.run()
+    ref_run, ref_q = h_run.result(), h_q.result()
+    ref_srv.close()
+
+    p0 = _RpcPool(ChainServer(ma, cfg, **kw), "p0")
+    p1 = _RpcPool(ChainServer(ma, cfg, **kw), "p1")
+    router = FleetRouter([p0, p1], placement="round_robin",
+                         failover=False)
+    try:
+        # -- running tenant: checkpoint -> cancel -> resume elsewhere
+        rh = router.submit(TenantRequest(
+            ma=ma, niter=40, nchains=16, seed=7, name="R",
+            spool_dir=str(tmp_path / "mig_run")), pool=0)
+        got = {}
+        waiter = threading.Thread(
+            target=lambda: got.update(res=rh.result(timeout=300)),
+            daemon=True)
+        waiter.start()
+        deadline = time.monotonic() + 120
+        while (rh.progress().get("sweeps_done") or 0) < 10:
+            assert time.monotonic() < deadline, "tenant never ran"
+            time.sleep(0.02)
+        with router._lock:
+            router._statuses()           # seed the status caches
+        assert router.migrate(rh, 1) is True
+        assert rh.pool_idx == 1 and router.migrations == 1
+        assert 0 not in router._status_cache \
+            and 1 not in router._status_cache
+        waiter.join(timeout=300)
+        assert "res" in got, "result() did not ride through"
+        _assert_bitwise(ref_run, got["res"], "running migration")
+
+        # -- queued tenant: replay on the target (anchor fills pool0)
+        anchor = router.submit(TenantRequest(
+            ma=ma, niter=5000, nchains=32, seed=99, name="A"), pool=0)
+        qh = router.submit(TenantRequest(
+            ma=ma, niter=20, nchains=16, seed=3, name="Q"), pool=0)
+        assert router.migrate(qh, 1) is True
+        res_q = qh.result(timeout=300)
+        _assert_bitwise(ref_q, res_q, "queued migration replay")
+        assert anchor.cancel()
+    finally:
+        router.close()

@@ -581,3 +581,118 @@ def test_bench_ledger_record_matches_stdout_line(tmp_path):
     pr = _perf_report()
     assert pr.main(["--ledger", str(ledger_file), "--check",
                     "--no-rounds"]) == 0
+
+
+def _coldstart_rec(warm_s=2.0, cold_s=6.0, rec_s=2.2, fresh_p=0,
+                   fresh_a=0, resumed=True):
+    reg = {"probes_fresh": fresh_p, "probes_cached": 3,
+           "autotune_fresh": fresh_a, "autotune_cached": 17,
+           "cache_ignored": 0, "resolutions": 7}
+    def boot(total, r):
+        return {"spawn_s": 1.0, "first_result_s": total - 1.0,
+                "spawn_to_first_result_s": total, "worker": {},
+                "registry": r}
+
+    return {"schema": 1, "tool": "coldstart", "platform": "cpu",
+            "timestamp_utc": "t", "git_sha": "abc",
+            "config_fingerprint": "f",
+            "metrics": {
+                "metric": "coldstart_warm_spawn_to_first_result_ms",
+                "value": warm_s * 1e3,
+                "cold": boot(cold_s, dict(reg, probes_fresh=3,
+                                          autotune_fresh=17)),
+                "warm": boot(warm_s, reg),
+                "recover": boot(rec_s, reg),
+                "warm_speedup": cold_s / warm_s,
+                "recovered_tenant_resumed": resumed},
+            "xla": None}
+
+
+def test_perf_report_coldstart_gates(tmp_path, capsys):
+    """Round-18 cold-start gates: warm wall ceiling, warm-vs-cold
+    speedup floor, and the recovered-pool zero-re-probe/zero-
+    re-autotune contract (any fresh registry decision on the recover
+    leg is a FAIL)."""
+    pr = _perf_report()
+    # healthy record passes
+    path = _write_ledger(tmp_path, [_bench_rec(100.0),
+                                    _coldstart_rec()])
+    assert pr.check_coldstart(pr._read_ledger(path), 120000.0, 2.0) == 0
+    # warm wall over the ceiling
+    assert pr.check_coldstart(
+        pr._read_ledger(path), 1000.0, 2.0) == 2
+    # speedup under the floor (the caches stopped paying)
+    path = _write_ledger(tmp_path, [_coldstart_rec(warm_s=5.0,
+                                                   cold_s=6.0)])
+    assert pr.check_coldstart(pr._read_ledger(path), 120000.0, 2.0) == 2
+    capsys.readouterr()
+    # a recover leg that re-derived ANYTHING fails
+    path = _write_ledger(tmp_path, [_coldstart_rec(fresh_a=3)])
+    assert pr.check_coldstart(pr._read_ledger(path), 120000.0, 2.0) == 2
+    assert "re-derived" in capsys.readouterr().out
+    path = _write_ledger(tmp_path, [_coldstart_rec(fresh_p=1)])
+    assert pr.check_coldstart(pr._read_ledger(path), 120000.0, 2.0) == 2
+    path = _write_ledger(tmp_path, [_coldstart_rec(resumed=False)])
+    assert pr.check_coldstart(pr._read_ledger(path), 120000.0, 2.0) == 2
+    # no record: skipped, not failed
+    path = _write_ledger(tmp_path, [_bench_rec(100.0)])
+    assert pr.check_coldstart(pr._read_ledger(path), 120000.0, 2.0) == 0
+
+
+def _migrate_rec(base=2691.3, reb=3080.1, migrations=2, failures=0,
+                 bitwise=True):
+    return {"schema": 1, "tool": "migrate_bench", "platform": "cpu",
+            "timestamp_utc": "t", "git_sha": "abc",
+            "config_fingerprint": "f",
+            "metrics": {
+                "metric": "migrate_jobs_per_hour", "value": reb,
+                "jobs": 8,
+                "base": {"jobs_per_hour": base, "migrations": 0,
+                         "wall_s": 10.7},
+                "rebalance": {"jobs_per_hour": reb, "wall_s": 9.35,
+                              "migrations": migrations,
+                              "migration_failures": failures},
+                "gain_pct": round((reb / base - 1) * 100, 1),
+                "bitwise_vs_base": bitwise},
+            "xla": None}
+
+
+def test_perf_report_migrate_gates(tmp_path, capsys):
+    """The live-migration gate: the rebalance arm must migrate, must
+    beat the no-migration arm's jobs/h at equal delivered sweeps, and
+    must keep migrated tenants bitwise; migration failures fail."""
+    pr = _perf_report()
+    path = _write_ledger(tmp_path, [_migrate_rec()])
+    assert pr.check_migrate(pr._read_ledger(path)) == 0
+    assert pr.check_migrate(
+        pr._read_ledger(_write_ledger(tmp_path, [_migrate_rec(
+            reb=2000.0)]))) == 2      # no gain
+    assert pr.check_migrate(
+        pr._read_ledger(_write_ledger(tmp_path, [_migrate_rec(
+            migrations=0)]))) == 2    # policy never fired
+    assert pr.check_migrate(
+        pr._read_ledger(_write_ledger(tmp_path, [_migrate_rec(
+            bitwise=False)]))) == 2   # determinism broken
+    assert pr.check_migrate(
+        pr._read_ledger(_write_ledger(tmp_path, [_migrate_rec(
+            failures=1)]))) == 2
+    capsys.readouterr()
+    # no record: skipped
+    assert pr.check_migrate(
+        pr._read_ledger(_write_ledger(tmp_path,
+                                      [_bench_rec(1.0)]))) == 0
+
+
+def test_new_bench_metrics_match_their_schemas():
+    """The synthetic coldstart/migrate records used by the gate units
+    above stay schema-true (the drift guard for the two new record
+    kinds, docs/observability.schema.json)."""
+    from gibbs_student_t_tpu.obs import schema as obs_schema
+
+    schemas = obs_schema.load_schemas()
+    obs_schema.assert_valid(_coldstart_rec()["metrics"],
+                            schemas["coldstart_metrics"],
+                            "coldstart metrics", defs=schemas)
+    obs_schema.assert_valid(_migrate_rec()["metrics"],
+                            schemas["migrate_bench_metrics"],
+                            "migrate_bench metrics", defs=schemas)

@@ -492,3 +492,47 @@ def test_every_env_gate_is_documented():
         f"env gates read by the package but absent from "
         f"docs/OBSERVABILITY.md: {missing} — add them to the "
         "'Env-gate index' table")
+
+
+def test_no_env_gate_read_bypasses_the_registry():
+    """Round 18's hard guard: the ONLY package file allowed to read a
+    ``GST_*`` variable from the environment is the dispatch registry
+    itself (ops/registry.py) — everything else must resolve through
+    its one probe→validate→degrade→record surface. A new feature that
+    sneaks in a bare ``os.environ.get("GST_...")`` fails here."""
+    pkg = os.path.join(REPO, "gibbs_student_t_tpu")
+    env_line = re.compile(r"GST_[A-Z0-9_]+")
+    offenders = []
+    for root, _, files in os.walk(pkg):
+        if "__pycache__" in root:
+            continue
+        for f in files:
+            if not f.endswith(".py"):
+                continue
+            path = os.path.join(root, f)
+            if path.endswith(os.path.join("ops", "registry.py")):
+                continue
+            for ln, line in enumerate(open(path).read().splitlines(),
+                                      1):
+                if "environ" in line and env_line.search(line):
+                    offenders.append(f"{path}:{ln}: {line.strip()}")
+    assert not offenders, (
+        "GST_* environment reads bypassing ops/registry.py:\n"
+        + "\n".join(offenders))
+
+
+def test_env_gate_index_is_generated_output():
+    """The committed OBSERVABILITY.md env-gate table between the
+    markers must be byte-identical to ``tools/gates.py --markdown``'s
+    output (i.e. to the registry's declared table) — the index cannot
+    drift from the registry that enforces it."""
+    from gibbs_student_t_tpu.ops.registry import gates_markdown
+
+    docs = open(os.path.join(REPO, "docs", "OBSERVABILITY.md")).read()
+    start = docs.index("<!-- gates-table-start")
+    start = docs.index("\n", start) + 1
+    end = docs.index("<!-- gates-table-end -->")
+    committed = docs[start:end].strip("\n")
+    assert committed == "\n".join(gates_markdown()), (
+        "docs/OBSERVABILITY.md env-gate table is stale — regenerate "
+        "with: python tools/gates.py --markdown")

@@ -1,0 +1,373 @@
+"""The capability-probed dispatch registry (ops/registry.py).
+
+The acceptance pins of ROADMAP item 5 / round 18:
+
+- ONE strict config surface: every legacy ``GST_*`` value resolves
+  exactly as the historical per-gate functions did — the probe matrix
+  covers forced / unavailable-degrades / disabled per family, and the
+  strict ``auto|1|0`` typo contract for EVERY declared strict gate
+  (plus the choice/posint/enum kinds' messages).
+- The persistent gates cache is keyed by ABI / library digest / CPU
+  flags / jax+jaxlib / dispatch-config fingerprint, and any stale
+  component is a LOUD ignore (RuntimeWarning + counter) followed by a
+  fresh probe — never a silent reuse.
+- The cache can never change numerics: chains sampled with the
+  cold-start caches armed are bitwise the cache-less chains (this
+  also pins donation-on/off bitwise, since arming degrades
+  ``GST_DONATE_CHUNK`` — see backends/jax_backend.donate_resolved).
+- jax's filesystem AOT cache writes publish atomically after the
+  registry's hardening (the measured two-pools-tear-one-entry
+  segfault, docs/OBSERVABILITY.md).
+"""
+
+import json
+import os
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from gibbs_student_t_tpu.ops import registry  # noqa: E402
+
+
+@pytest.fixture()
+def fresh_registry():
+    """Isolate latched probes/counters/cache state per test, and
+    restore the process to cache-less defaults afterwards (other
+    tests' backends must not silently construct donation-off)."""
+    registry._reset_for_tests()
+    yield registry
+    registry._reset_for_tests()
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", None)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+STRICT3 = sorted(n for n, sp in registry.GATES.items()
+                 if sp.kind == "strict3")
+
+
+@pytest.mark.parametrize("gate", STRICT3)
+def test_strict3_validation_matrix(gate, monkeypatch, fresh_registry):
+    """Every declared strict gate keeps the loud-typo contract: unset
+    -> 'auto', each legal value accepted verbatim, anything else
+    raises naming the gate."""
+    monkeypatch.delenv(gate, raising=False)
+    assert registry.value(gate) == "auto"
+    for v in ("auto", "1", "0"):
+        monkeypatch.setenv(gate, v)
+        assert registry.value(gate) == v
+    monkeypatch.setenv(gate, "banana")
+    with pytest.raises(ValueError, match=gate):
+        registry.value(gate)
+
+
+def test_other_kinds_validation(monkeypatch, fresh_registry):
+    monkeypatch.setenv("GST_SERVE_WATCHDOG", "loud")
+    with pytest.raises(ValueError, match="GST_SERVE_WATCHDOG"):
+        registry.value("GST_SERVE_WATCHDOG")
+    monkeypatch.setenv("GST_RPC_MAX_FRAME", "-1")
+    with pytest.raises(ValueError, match="positive integer"):
+        registry.value("GST_RPC_MAX_FRAME")
+    monkeypatch.setenv("GST_ENSEMBLE_UNROLL", "2")
+    with pytest.raises(ValueError, match="GST_ENSEMBLE_UNROLL"):
+        registry.value("GST_ENSEMBLE_UNROLL")
+    # forgiving kinds stay forgiving
+    monkeypatch.setenv("GST_WHITE_TILE", "not-a-number")
+    assert registry.int_value("GST_WHITE_TILE") == 256
+    monkeypatch.setenv("GST_INTROSPECT", "0")
+    assert registry.value("GST_INTROSPECT") is False
+
+
+def test_legacy_wrappers_still_validate(monkeypatch, fresh_registry):
+    """The public ``*_env`` names all route through the registry and
+    keep raising on typos — the compatibility surface of the
+    refactor."""
+    from gibbs_student_t_tpu.backends.jax_backend import _fast_gamma_env
+    from gibbs_student_t_tpu.native.ffi import kernel_timers_env
+    from gibbs_student_t_tpu.ops import linalg
+    from gibbs_student_t_tpu.serve.rpc import rpc_max_frame_env
+    from gibbs_student_t_tpu.serve.server import serve_pipeline_env
+
+    for var, fn in (("GST_VCHOL", linalg.vchol_env),
+                    ("GST_NCHOL", linalg.nchol_env),
+                    ("GST_NRESID", linalg.nresid_env),
+                    ("GST_FUSE_STAGES", linalg.fuse_stages_env),
+                    ("GST_KERNEL_TIMERS", kernel_timers_env),
+                    ("GST_FAST_GAMMA", _fast_gamma_env),
+                    ("GST_SERVE_PIPELINE", serve_pipeline_env)):
+        monkeypatch.setenv(var, "nope")
+        with pytest.raises(ValueError, match=var):
+            fn()
+        monkeypatch.delenv(var)
+    monkeypatch.setenv("GST_RPC_MAX_FRAME", "12")
+    assert rpc_max_frame_env() == 12
+
+
+def _force_probe(monkeypatch, name, outcome):
+    registry._unlatch_probe(name)
+    monkeypatch.setitem(registry._PROBE_FNS, name, lambda: outcome)
+
+
+@pytest.mark.parametrize("gate", ["GST_NCHOL", "GST_NWHITE",
+                                  "GST_NHYPER"])
+def test_probe_matrix_native_family(gate, monkeypatch, fresh_registry):
+    """Forced / unavailable / disabled for the native kernel family:
+    a well-formed ``1`` on a host without the capability degrades
+    SILENTLY (no toolchain ever becomes a runtime requirement), ``0``
+    never probes, availability + auto resolves on."""
+    _force_probe(monkeypatch, "cpu", True)
+    _force_probe(monkeypatch, "native", True)
+    monkeypatch.setenv(gate, "1")
+    assert registry.mode3(gate) == (True, True)
+    monkeypatch.setenv(gate, "auto")
+    assert registry.mode3(gate) == (True, False)
+    _force_probe(monkeypatch, "native", False)
+    monkeypatch.setenv(gate, "1")
+    assert registry.mode3(gate) == (False, False)   # degraded, silent
+    monkeypatch.setenv(gate, "0")
+    # disabled never evaluates the probes at all
+    registry._unlatch_probe("native")
+    monkeypatch.setitem(
+        registry._PROBE_FNS, "native",
+        lambda: (_ for _ in ()).throw(AssertionError("probed")))
+    assert registry.mode3(gate) == (False, False)
+
+
+def test_probe_matrix_vchol_and_nresid(monkeypatch, fresh_registry):
+    """GST_VCHOL: forced needs NO capability; auto follows the
+    platform probe. GST_NRESID: auto follows GST_NCHOL's resolution
+    (the one gate that chains through another's verdict)."""
+    from gibbs_student_t_tpu.ops import linalg
+
+    _force_probe(monkeypatch, "not_tpu", False)
+    monkeypatch.setenv("GST_VCHOL", "auto")
+    assert registry.mode3("GST_VCHOL") == (False, False)
+    monkeypatch.setenv("GST_VCHOL", "1")
+    assert registry.mode3("GST_VCHOL") == (True, True)
+    _force_probe(monkeypatch, "cpu", True)
+    _force_probe(monkeypatch, "native", True)
+    monkeypatch.setenv("GST_NCHOL", "0")
+    monkeypatch.delenv("GST_NRESID", raising=False)
+    assert linalg._nresid_mode() == (False, False)
+    monkeypatch.setenv("GST_NCHOL", "1")
+    assert linalg._nresid_mode() == (True, True)   # inherits forced
+    monkeypatch.setenv("GST_NCHOL", "auto")
+    assert linalg._nresid_mode() == (True, False)
+
+
+def test_provenance_and_registry_summary(monkeypatch, fresh_registry):
+    _force_probe(monkeypatch, "cpu", True)
+    _force_probe(monkeypatch, "native", True)
+    monkeypatch.delenv("GST_NCHOL", raising=False)
+    registry.mode3("GST_NCHOL")
+    summ = registry.registry_summary()
+    assert summ["probes"] == {"cpu": True, "native": True}
+    gates = {r.get("gate") for r in summ["resolutions"]}
+    assert "GST_NCHOL" in gates
+    assert summ["counters"]["probes_fresh"] == 2
+    # the introspect ledger block carries the same summary
+    from gibbs_student_t_tpu.obs.introspect import compile_summary
+
+    assert compile_summary()["registry"]["probes"]["native"] is True
+
+
+# ----------------------------------------------------------------------
+# the persistent gates cache
+# ----------------------------------------------------------------------
+
+
+def _prime_and_save(tmp_path, monkeypatch):
+    d = str(tmp_path / "cache")
+    registry.probe("native")
+    registry.note_autotune("compile", "chunk", 5.5)
+    registry.note_autotune("linalg", "factor=nchol")
+    path = registry.save_gate_cache(d)
+    assert path and os.path.exists(path)
+    return d, path
+
+
+def test_gate_cache_roundtrip_counts_cached(tmp_path, monkeypatch,
+                                            fresh_registry):
+    d, _ = _prime_and_save(tmp_path, monkeypatch)
+    registry._reset_for_tests()
+    assert registry.load_gate_cache(d)
+    registry.probe("native")
+    registry.note_autotune("compile", "chunk", 0.1)
+    registry.note_autotune("linalg", "factor=nchol")
+    st = registry.stats()
+    assert st["probes_fresh"] == 0 and st["probes_cached"] == 1
+    assert st["autotune_fresh"] == 0 and st["autotune_cached"] == 2
+    # a save after a warm run carries the store forward undiminished
+    registry.save_gate_cache(d)
+    doc = json.load(open(os.path.join(d, registry.GATE_CACHE_NAME)))
+    assert "compile:chunk" in doc["autotune"]
+
+
+@pytest.mark.parametrize("field", ["abi", "so_digest", "cpu_flags",
+                                   "jax", "jaxlib", "config_fp"])
+def test_gate_cache_staleness_is_loud(field, tmp_path, monkeypatch,
+                                      fresh_registry):
+    """Every key component independently invalidates the cache, and
+    the ignore is LOUD: RuntimeWarning naming the stale field, the
+    ``cache_ignored`` counter, and fully fresh probes afterwards —
+    an ABI bump / SIMD-level (.so) change / jaxlib upgrade / config
+    flip can never silently reuse stale decisions."""
+    d, path = _prime_and_save(tmp_path, monkeypatch)
+    registry._reset_for_tests()
+    doc = json.load(open(path))
+    doc["key"][field] = "something-else"
+    json.dump(doc, open(path, "w"))
+    with pytest.warns(RuntimeWarning, match=field):
+        assert not registry.load_gate_cache(d)
+    st = registry.stats()
+    assert st["cache_ignored"] == 1
+    registry.probe("native")
+    assert registry.stats()["probes_fresh"] == 1   # fresh, not cached
+
+
+def test_gate_cache_wrong_prediction_warns(tmp_path, monkeypatch,
+                                           fresh_registry):
+    d, path = _prime_and_save(tmp_path, monkeypatch)
+    registry._reset_for_tests()
+    doc = json.load(open(path))
+    doc["probes"]["native"] = {"ok": not doc["probes"]["native"]["ok"]}
+    json.dump(doc, open(path, "w"))
+    assert registry.load_gate_cache(d)
+    with pytest.warns(RuntimeWarning, match="live probe"):
+        registry.probe("native")
+    assert registry.stats()["probes_fresh"] == 1
+
+
+def test_config_fingerprint_tracks_dispatch_gates_only(monkeypatch,
+                                                       fresh_registry):
+    base = registry.config_fingerprint_env()
+    monkeypatch.setenv("GST_NCHOL", "0")        # dispatch gate: moves
+    assert registry.config_fingerprint_env() != base
+    monkeypatch.delenv("GST_NCHOL")
+    monkeypatch.setenv("GST_LEDGER_PATH", "/tmp/x")  # obs: must not
+    assert registry.config_fingerprint_env() == base
+
+
+def test_aot_cache_writes_are_atomic(tmp_path, fresh_registry):
+    """The registry's hardening of jax's filesystem cache: publishes
+    go through a same-dir temp + rename, double-puts of one key are
+    stable, and no temp litter survives — the stock write_bytes
+    publish let two concurrent pool workers tear one entry and then
+    segfault every later reader (measured; the reason this patch
+    exists)."""
+    assert registry._harden_aot_cache_writes()
+    from jax._src.lru_cache import LRUCache
+
+    c = LRUCache(str(tmp_path), max_size=-1)
+    c.put("k1", b"A" * 1024)
+    c.put("k1", b"B" * 2048)            # first write wins, no tear
+    assert c.get("k1") == b"A" * 1024
+    assert not [f for f in os.listdir(tmp_path) if ".tmp" in f]
+
+
+def test_chains_bitwise_with_and_without_cold_start_caches(
+        tmp_path, fresh_registry):
+    """THE pinned contract: arming the persistent cold-start caches
+    (AOT dir + gates.json — including the donation degradation it
+    implies) changes nothing about the numbers. Chains from a
+    cache-less backend are bitwise the chains from a cache-armed
+    one."""
+    from tests.conftest import make_demo_pta
+    from gibbs_student_t_tpu.backends.jax_backend import JaxGibbs
+    from gibbs_student_t_tpu.config import GibbsConfig
+
+    pta = make_demo_pta()
+    ma, cfg = pta.frozen(0), GibbsConfig(model="mixture")
+
+    def run():
+        res = JaxGibbs(ma, cfg, nchains=2).sample(niter=6, seed=11)
+        return np.asarray(res.chain)
+
+    cold = run()
+    info = registry.enable_persistent_cache(str(tmp_path / "aot"))
+    assert info["aot"] and registry.aot_cache_armed()
+    warm_writer = run()                 # compiles + writes the cache
+    warm_reader = run()                 # loads the AOT entry
+    assert np.array_equal(cold, warm_writer)
+    assert np.array_equal(cold, warm_reader)
+
+
+def test_donation_degrades_only_when_cache_armed(monkeypatch,
+                                                 fresh_registry):
+    from gibbs_student_t_tpu.backends.jax_backend import donate_resolved
+
+    monkeypatch.delenv("GST_DONATE_CHUNK", raising=False)
+    assert donate_resolved() is True
+    registry._AOT_ARMED = True
+    assert donate_resolved() is False   # deserialized donated
+    monkeypatch.setenv("GST_DONATE_CHUNK", "1")   # executables corrupt
+    assert donate_resolved() is True    # the A/B hatch still forces
+    reasons = [r for r in registry.provenance()
+               if r.get("gate") == "GST_DONATE_CHUNK"]
+    assert any("AOT cache" in (r.get("reason") or "") for r in reasons)
+
+
+# ----------------------------------------------------------------------
+# tools/gates.py
+# ----------------------------------------------------------------------
+
+
+def _gates_tool():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "gates_tool", os.path.join(REPO, "tools", "gates.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_gates_cli_resolves_every_gate(fresh_registry, capsys):
+    tool = _gates_tool()
+    doc = tool.resolve_all()
+    assert set(doc["gates"]) == set(registry.GATES)
+    assert set(doc["ops"]) == set(registry.OPS)
+    for name, row in doc["gates"].items():
+        assert "error" not in row, (name, row)
+    assert tool.main([]) == 0
+    out = capsys.readouterr().out
+    assert "GST_NCHOL" in out and "per-op dispatch" in out
+    assert tool.main(["--markdown"]) == 0
+    md = capsys.readouterr().out.strip("\n")
+    assert md == "\n".join(registry.gates_markdown())
+
+
+def test_ops_table_matches_dispatcher_reality(fresh_registry):
+    """The declared per-op impl tables must keep naming real
+    dispatchers: every op name any ops/ module passes to
+    ``_note_impl`` (the trace-time decision record) is a declared
+    OPS key, AND every runtime decision recorded so far in this
+    process resolves to one — a new dispatcher without a table row
+    fails here."""
+    import re
+
+    from gibbs_student_t_tpu.obs import introspect
+
+    known = set(registry.OPS)
+    noted = set()
+    ops_dir = os.path.join(REPO, "gibbs_student_t_tpu", "ops")
+    for f in os.listdir(ops_dir):
+        if f.endswith(".py"):
+            noted |= set(re.findall(
+                r'_note_impl\("([a-z_0-9]+)"',
+                open(os.path.join(ops_dir, f)).read()))
+    assert noted, "the _note_impl scan went blind"
+    assert noted <= known, (
+        f"ops noted by dispatchers but undeclared in registry.OPS: "
+        f"{sorted(noted - known)}")
+    for rec in introspect.linalg_impls():
+        assert rec["op"] in known, (
+            f"runtime records op {rec['op']!r} that ops/registry.OPS "
+            "does not declare")

@@ -812,3 +812,153 @@ def test_manifest_model_store_content_addressed(tmp_path):
     man.record_done(0, "done", 5)
     man.compact()
     assert os.listdir(mdir) == []
+
+
+# ---------------------------------------------------------------------------
+# live migration: router logic over fakes (round 18)
+# ---------------------------------------------------------------------------
+
+
+class _MigFakePool(_FakePool):
+    """A fake whose cancel resolves the handle the way a real pool's
+    cancel-freeze does (the migration fencing wait polls done())."""
+
+    def cancel(self, h):
+        h._done.set()
+        return True
+
+
+def test_migrate_queued_replay_over_fakes():
+    src = _MigFakePool("src", queue_depth=3, free_groups=0)
+    dst = _MigFakePool("dst", queue_depth=0, free_groups=2)
+    r = _router([src, dst])
+    rh = r.submit(TenantRequest(ma={}, niter=5, nchains=4, name="j"),
+                  pool=0)
+    assert len(src.submitted) == 1
+    assert r.migrate(rh, 1) is True
+    # a queued victim (nothing served, no spool) is REPLAYED verbatim
+    assert len(dst.submitted) == 1
+    assert dst.submitted[0] is rh.request
+    assert rh.pool_idx == 1 and r.migrations == 1
+    assert not rh._migrating.is_set()
+    # nothing to migrate twice: the handle now lives on dst
+    assert r.migrate(rh, 1) is False
+    r.close()
+
+
+def test_migrate_invalidates_both_status_caches():
+    """The respawn/migration staleness fix (ISSUE 15 satellite): after
+    a migration both pools' cached snapshots are dropped AND fenced
+    against an in-flight poll re-caching the pre-migration load — a
+    freshly drained/loaded pool must never hide behind its old
+    snapshot for a full TTL."""
+    src = _MigFakePool("src", queue_depth=2, free_groups=0)
+    dst = _MigFakePool("dst", queue_depth=0, free_groups=2)
+    spare = _MigFakePool("spare", queue_depth=9, free_groups=0)
+    r = _router([src, dst, spare])
+    with r._lock:
+        r._statuses()                       # seed every cache entry
+    assert set(r._status_cache) == {0, 1, 2}
+    rh = r.submit(TenantRequest(ma={}, niter=5, nchains=4, name="j"),
+                  pool=0)
+    gen0 = r._status_gen.get(0, 0)
+    assert r.migrate(rh, 1)
+    assert 0 not in r._status_cache and 1 not in r._status_cache
+    assert 2 in r._status_cache             # untouched pool keeps its
+    assert r._status_gen[0] == gen0 + 1     # snapshot; src is fenced
+    # the fence: a poll that STARTED before the invalidation cannot
+    # write its stale snapshot back afterwards
+    with r._lock:
+        gen_now = r._status_gen[0]
+        r._status_gen[0] = gen_now + 1      # invalidation lands mid-poll
+        if r._status_gen.get(0, 0) == gen_now:   # the _statuses guard
+            r._status_cache[0] = (0.0, {"stale": True})
+    assert 0 not in r._status_cache
+    r.close()
+
+
+def test_rebalance_policy_steals_queued_from_loaded_pool():
+    """The drained pool (free groups, empty queue) steals from the
+    most-loaded pool; a queued victim is preferred (replay beats a
+    checkpoint round-trip)."""
+    src = _MigFakePool("src", queue_depth=4, free_groups=0,
+                       occupancy=1.0)
+    dst = _MigFakePool("dst", queue_depth=0, free_groups=2,
+                       occupancy=0.5)
+    r = _router([src, dst])
+    rh = r.submit(TenantRequest(ma={}, niter=5, nchains=4, name="q"),
+                  pool=0)
+    assert r._rebalance_once() is True
+    assert rh.pool_idx == 1 and len(dst.submitted) == 1
+    # balanced fleet: no candidates, no churn
+    src.queue_depth = 0
+    assert r._rebalance_once() is False
+    r.close()
+
+
+def test_rebalance_policy_skips_streamed_and_oversized():
+    src = _MigFakePool("src", queue_depth=4, free_groups=0)
+    dst = _MigFakePool("dst", queue_depth=0, free_groups=2)
+    r = _router([src, dst])
+    # streamed tenants are pinned to their pool; an oversized tenant
+    # cannot fit the destination's free lanes (2 groups x 16)
+    r.submit(TenantRequest(ma={}, niter=5, nchains=4, name="s",
+                           on_chunk=lambda *a: None), pool=0)
+    r.submit(TenantRequest(ma={}, niter=5, nchains=64, name="big"),
+             pool=0)
+    assert r._rebalance_once() is False
+    assert not dst.submitted
+    r.close()
+
+
+def test_migration_failure_poisons_the_handle():
+    """A migration that cancelled the tenant and then could not
+    resume it anywhere must not pass the served prefix off as the
+    result: the handle raises, the failure is counted."""
+    src = _MigFakePool("src")
+    dst = _MigFakePool("dst")
+    r = _router([src, dst])
+    rh = r.submit(TenantRequest(ma={}, niter=5, nchains=4, name="j"),
+                  pool=0)
+
+    def refuse(request, timeout=None):
+        raise RuntimeError("pool full")
+
+    src.submit = refuse
+    dst.submit = refuse
+    with pytest.raises(RuntimeError, match="could not be resumed"):
+        r.migrate(rh, 1)
+    assert r.migration_failures == 1 and r.migrations == 0
+    with pytest.raises(RuntimeError, match="served prefix"):
+        rh.result(timeout=0.5)
+    r.close()
+
+
+def test_routed_handle_rides_through_migration_latch():
+    """A caller blocked in result() while the source's cancel-freeze
+    resolves the OLD inner must NOT receive the prefix: the latch
+    discards pre-migration outcomes until the rebind lands."""
+    src = _MigFakePool("src")
+    dst = _MigFakePool("dst")
+    r = _router([src, dst])
+    rh = r.submit(TenantRequest(ma={}, niter=5, nchains=4, name="j"),
+                  pool=0)
+    old = rh._inner
+    out = {}
+    waiter = threading.Thread(
+        target=lambda: out.update(res=rh.result(timeout=30)),
+        daemon=True)
+    rh._migrating.set()
+    waiter.start()
+    old._finish("PREFIX")
+    old._done.set()
+    time.sleep(0.3)
+    assert "res" not in out          # the prefix was discarded
+    new = _StubHandle(99, rh.request)
+    rh._rebind(1, new)
+    rh._migrating.clear()
+    new._finish("REAL")
+    new._done.set()
+    waiter.join(timeout=10)
+    assert out.get("res") == "REAL"
+    r.close()

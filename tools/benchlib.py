@@ -25,6 +25,11 @@ def enable_compile_cache():
     import jax
 
     try:
+        from gibbs_student_t_tpu.ops.registry import (
+            _harden_aot_cache_writes,
+        )
+
+        _harden_aot_cache_writes()  # atomic entry publish (round 18)
         jax.config.update(
             "jax_compilation_cache_dir",
             os.path.join(os.path.dirname(os.path.dirname(

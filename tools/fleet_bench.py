@@ -58,6 +58,280 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(_HERE))  # repo root for the package
 
 
+def _write_ledger(kind: str, line: dict, args, argv) -> None:
+    if args.ledger == "":
+        return
+    try:
+        from gibbs_student_t_tpu.obs import ledger as _ledger
+
+        lpath = _ledger.append_record(_ledger.make_record(
+            kind, line, platform="cpu", config=vars(args),
+            argv=[sys.argv[0]] + list(argv if argv is not None
+                                      else sys.argv[1:])),
+            args.ledger)
+        print(f"# ledger record -> {lpath}", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001
+        print(f"# ledger write failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
+
+def _coldstart_arm(args, template, cfg, pool_kwargs, base, argv) -> None:
+    """Cold vs warm vs recover: the round-18 persistent-cache payoff,
+    measured. Three spawns against one scratch cache directory —
+    empty (cold: full probe + autotune + XLA compile), warm (the AOT
+    cache replays the compile, gates.json replays every decision),
+    and a kill + ``pool_main --recover`` respawn (the failover path)
+    — each timed spawn→first-result with the worker's registry
+    counters from ready.json. The ``coldstart`` ledger record is what
+    ``perf_report --check --min-coldstart-speedup /
+    --max-coldstart-ms`` and the zero-re-autotune recover gate
+    grade."""
+    from gibbs_student_t_tpu.serve import TenantRequest
+    from gibbs_student_t_tpu.serve.router import PoolSpec, ProcPool
+
+    cache_dir = os.path.join(base, "coldcache")
+    os.makedirs(cache_dir, exist_ok=True)
+    env = dict(os.environ)
+    env["GST_CACHE_DIR"] = cache_dir
+    env.setdefault("JAX_PLATFORMS", "cpu")
+
+    def one_boot(tag: str, recover_from=None):
+        spec = recover_from or PoolSpec(
+            os.path.join(base, f"cs_{tag}"), template, cfg,
+            pool_kwargs)
+        t0 = time.perf_counter()
+        if recover_from is None:
+            pool = ProcPool.spawn(spec, env=env)
+        else:
+            pool = ProcPool.recover_spawn(spec, env=env)
+        t_ready = time.perf_counter()
+        h = pool.submit(TenantRequest(
+            ma=template, niter=args.quantum, nchains=16,
+            seed=args.seed, name=f"cs_{tag}"))
+        h.result(timeout=1800)
+        t_first = time.perf_counter()
+        # re-read the handshake file: the worker refreshes it after
+        # its first dispatched quantum with the post-compile registry
+        # counters (the numbers the recover gate grades)
+        try:
+            with open(os.path.join(spec.pool_dir,
+                                   "ready.json")) as fh:
+                cs = (json.load(fh)).get("coldstart") or {}
+        except (OSError, ValueError):
+            cs = (pool.ready or {}).get("coldstart") or {}
+        block = {
+            "spawn_s": round(t_ready - t0, 3),
+            "first_result_s": round(t_first - t_ready, 3),
+            "spawn_to_first_result_s": round(t_first - t0, 3),
+            "worker": cs,
+            "registry": (cs.get("registry_first_dispatch")
+                         or cs.get("registry") or {}),
+        }
+        print(f"# coldstart[{tag}]: spawn {block['spawn_s']}s, "
+              f"spawn->first-result {block['spawn_to_first_result_s']}s, "
+              f"registry {block['registry']}", file=sys.stderr)
+        return pool, block
+
+    pool, cold = one_boot("cold")
+    pool.close()
+    pool, warm = one_boot("warm")
+    # the recover leg: a spooled tenant mid-flight, an impolite kill,
+    # and the failover respawn through the manifest — the path whose
+    # cold start PR 14 measured as the warm-start arm's undoing
+    spool = os.path.join(base, "cs_spool")
+    h = pool.submit(TenantRequest(
+        ma=template, niter=8 * args.quantum, nchains=16,
+        seed=args.seed + 1, name="cs_rec", spool_dir=spool))
+    deadline = time.monotonic() + 600
+    while (h.progress().get("sweeps_done") or 0) < args.quantum:
+        if time.monotonic() > deadline:
+            raise TimeoutError("recover-leg tenant never progressed")
+        time.sleep(0.05)
+    pool.kill()
+    rec_pool, recover = one_boot("recover", recover_from=pool.spec)
+    rec_map = (rec_pool.ready or {}).get("recovered") or {}
+    tid = rec_map.get("cs_rec")
+    if tid is not None:
+        rh = rec_pool.handle_for(int(tid), h.request)
+        rh.result(timeout=1800)
+    rec_pool.close()
+    speedup = (cold["spawn_to_first_result_s"]
+               / max(warm["spawn_to_first_result_s"], 1e-9))
+    line = {
+        "metric": "coldstart_warm_spawn_to_first_result_ms",
+        "value": round(warm["spawn_to_first_result_s"] * 1e3, 1),
+        "cold": cold,
+        "warm": warm,
+        "recover": recover,
+        "warm_speedup": round(speedup, 3),
+        "recovered_tenant_resumed": tid is not None,
+        "cache_dir": cache_dir,
+        "nlanes": args.nlanes,
+        "quantum": args.quantum,
+        "quick": bool(args.quick),
+        "platform": "cpu",
+    }
+    print(f"# coldstart: cold {cold['spawn_to_first_result_s']}s -> "
+          f"warm {warm['spawn_to_first_result_s']}s "
+          f"({speedup:.2f}x), recover "
+          f"{recover['spawn_to_first_result_s']}s "
+          f"(fresh probes {recover['registry'].get('probes_fresh')}, "
+          f"fresh autotune {recover['registry'].get('autotune_fresh')})",
+          file=sys.stderr)
+    _write_ledger("coldstart", line, args, argv)
+    return line
+
+
+def _migrate_arm(args, template, model_for, cfg, pool_kwargs, base,
+                 cpu_cores, argv) -> None:
+    """The live-migration A/B: a deliberately imbalanced 2-pool fleet
+    — one long low-occupancy anchor per pool (each pool dispatches
+    its full lane program for it regardless, so free lanes compute
+    idle), every medium job pinned to pool0 — run with the rebalance
+    policy off, then on. With the policy on, the drained pool steals
+    pool0's queued/backlogged jobs into lanes it was already paying
+    for, so jobs/h rises even on a single shared core (the fleet's
+    measured 1-core physics, docs/SERVING.md). Job results are
+    hash-compared across arms: migrated == unmigrated, bitwise."""
+    import hashlib
+    import threading
+
+    import numpy as np
+
+    from gibbs_student_t_tpu.serve import TenantRequest
+    from gibbs_student_t_tpu.serve.router import (
+        spawn_fleet,
+        teardown_fleet,
+    )
+
+    rng = np.random.default_rng(args.seed)
+    chains_each = args.nlanes // args.resident
+    n_jobs = args.migrate_jobs
+    budgets = [int(rng.integers(args.quanta_min, args.quanta_max + 1))
+               * args.quantum for _ in range(n_jobs)]
+    job_mas = [model_for(200 + i) for i in range(min(n_jobs, 4))]
+    anchor_iters = 1000 * args.quantum   # outlasts the arm; cancelled
+    # anchors fill every lane group EXCEPT one job slot per pool: the
+    # drained pool's spare slot is dispatch it pays for regardless, so
+    # each stolen job rides it at zero marginal lane cost — and a
+    # one-slot source serializes its pinned jobs, the imbalance the
+    # policy exists to fix. Steals are then queued-tenant replays
+    # (cheap) rather than running-tenant checkpoint round-trips
+    # (quanta of latency each — measured negative at this scale).
+    anchor_chains = max(args.nlanes - chains_each, chains_each)
+
+    def one_arm(tag: str, rebalance: bool):
+        fdir = os.path.join(base, f"mig_{tag}")
+        # failover off: on a saturated shared-core host the liveness
+        # watch can misread a busy pool as dead mid-arm, and a
+        # recovery respawn inside the measured window would grade the
+        # failover path, not the migration policy under test
+        fleet = spawn_fleet(
+            fdir, 2, template, cfg, pool_kwargs=pool_kwargs,
+            failover=False,
+            rebalance=rebalance, rebalance_poll_s=0.5)
+        try:
+            warm = [fleet.submit(TenantRequest(
+                ma=template, niter=args.quantum, nchains=16,
+                seed=args.seed, name=f"warm{i}"), pool=i)
+                for i in range(2)]
+            for w in warm:
+                w.result(timeout=1800)
+            fleet.reset_counters()
+            anchors = [fleet.submit(TenantRequest(
+                ma=template, niter=anchor_iters, nchains=anchor_chains,
+                seed=args.seed + 7 + i, name=f"anchor{i}"), pool=i)
+                for i in range(2)]
+            t0 = time.perf_counter()
+            jobs = [fleet.submit(TenantRequest(
+                ma=job_mas[i % len(job_mas)], niter=budgets[i],
+                nchains=chains_each, seed=args.seed + i,
+                name=f"mjob{i}",
+                spool_dir=os.path.join(fdir, f"spool{i}")), pool=0)
+                for i in range(n_jobs)]
+            hashes, errs = {}, []
+
+            def wait(i, h):
+                try:
+                    res = h.result(timeout=3600)
+                    hashes[i] = hashlib.sha1(
+                        np.ascontiguousarray(
+                            np.asarray(res.chain)).tobytes()
+                    ).hexdigest()
+                except Exception as e:  # noqa: BLE001
+                    errs.append((i, e))
+
+            threads = [threading.Thread(target=wait, args=(i, h),
+                                        daemon=True)
+                       for i, h in enumerate(jobs)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            if errs:
+                raise RuntimeError(
+                    f"{len(errs)} job(s) failed in the {tag} arm: "
+                    f"mjob{errs[0][0]}: {errs[0][1]}")
+            for a in anchors:
+                a.cancel()
+            snap = fleet.fleet_status()
+            sweeps = sum(chains_each * b for b in budgets)
+            out = {
+                "wall_s": round(wall, 3),
+                "jobs_per_hour": round(n_jobs / wall * 3600.0, 1),
+                "job_sweeps_per_s": round(sweeps / wall, 1),
+                "migrations": snap["router"]["migrations"],
+                "migration_failures":
+                    snap["router"]["migration_failures"],
+                "placements": snap["router"]["placements"],
+            }
+            print(f"# migrate[{tag}]: {out['jobs_per_hour']} jobs/h "
+                  f"({out['wall_s']}s wall, "
+                  f"{out['migrations']} migrations, placements "
+                  f"{out['placements']})", file=sys.stderr)
+            return out, hashes
+        finally:
+            teardown_fleet(fleet, remove_dirs=False)
+
+    blk_base, hash_base = one_arm("base", rebalance=False)
+    blk_mig, hash_mig = one_arm("rebalance", rebalance=True)
+    bitwise = (hash_base == hash_mig and len(hash_base) == n_jobs)
+    if not bitwise:
+        for i in range(n_jobs):
+            a, b = hash_base.get(i), hash_mig.get(i)
+            if a != b:
+                print(f"# migrate BITWISE DIFF mjob{i}: base={a} "
+                      f"rebalance={b}", file=sys.stderr)
+    gain = (blk_mig["jobs_per_hour"] / blk_base["jobs_per_hour"] - 1.0
+            if blk_base["jobs_per_hour"] else None)
+    line = {
+        "metric": "migrate_jobs_per_hour",
+        "value": blk_mig["jobs_per_hour"],
+        "base": blk_base,
+        "rebalance": blk_mig,
+        "gain_pct": (None if gain is None else round(gain * 100, 1)),
+        "bitwise_vs_base": bitwise,
+        "jobs": n_jobs,
+        "anchor_chains": chains_each,
+        "cpu_cores": cpu_cores,
+        "nlanes": args.nlanes,
+        "quantum": args.quantum,
+        "quick": bool(args.quick),
+        "platform": "cpu",
+    }
+    print(f"# migrate arm: {blk_base['jobs_per_hour']} -> "
+          f"{blk_mig['jobs_per_hour']} jobs/h "
+          f"({line['gain_pct']}% at equal delivered sweeps; "
+          f"{blk_mig['migrations']} migrations; bitwise "
+          f"{'OK' if bitwise else 'MISMATCH'})", file=sys.stderr)
+    if not bitwise:
+        raise RuntimeError(
+            "migrated job results differ from the no-migration arm")
+    _write_ledger("migrate_bench", line, args, argv)
+    return line
+
+
 def _emit_final_line(line: dict) -> None:
     """bench.py emission hardening: the metric line is the final
     combined-stream line, stderr parked after it."""
@@ -113,6 +387,27 @@ def main(argv=None):
                          "manifests) after the run")
     ap.add_argument("--ledger", default=None,
                     help="ledger path override ('' disables the write)")
+    ap.add_argument("--migrate-arm", action="store_true",
+                    help="run the live-migration A/B instead of the "
+                         "standard workload: an imbalanced 2-pool "
+                         "fleet (anchors on both pools, every job "
+                         "pinned to pool0) with the rebalance policy "
+                         "off vs on — the stolen jobs ride the "
+                         "drained pool's already-dispatching free "
+                         "lanes, so jobs/h rises even on a 1-core "
+                         "host (docs/SERVING.md 'Live migration')")
+    ap.add_argument("--migrate-jobs", type=int, default=8,
+                    help="medium jobs pinned to pool0 in the "
+                         "migrate arm")
+    ap.add_argument("--coldstart-arm", action="store_true",
+                    help="run the cold-start A/B instead of the "
+                         "standard workload: spawn a pool against an "
+                         "EMPTY cold-start cache dir, then again "
+                         "against the now-warm dir, then kill + "
+                         "recover — spawn→first-result walls and the "
+                         "registry's fresh-vs-cached counters land "
+                         "in a 'coldstart' ledger record "
+                         "(docs/PERFORMANCE.md 'Cold starts')")
     args = ap.parse_args(argv)
     if args.quick:
         args.pools = 2
@@ -155,6 +450,21 @@ def main(argv=None):
                * args.quantum for _ in range(n_jobs)]
     pool_kwargs = {"nlanes": args.nlanes, "quantum": args.quantum}
     base = tempfile.mkdtemp(prefix="gst_fleet_bench_")
+
+    if args.coldstart_arm or args.migrate_arm:
+        try:
+            if args.coldstart_arm:
+                line = _coldstart_arm(args, template, cfg,
+                                      pool_kwargs, base, argv)
+            else:
+                line = _migrate_arm(args, template, model_for, cfg,
+                                    pool_kwargs, base, cpu_cores,
+                                    argv)
+        finally:
+            if not args.keep_dirs:
+                shutil.rmtree(base, ignore_errors=True)
+        _emit_final_line(line)
+        return
 
     def run_fleet(n_pools: int, tag: str):
         """One arm: spawn, warm every pool (compile outside the timed

@@ -1,0 +1,143 @@
+"""Print the dispatch registry's resolved decisions for THIS host.
+
+The round-18 registry (gibbs_student_t_tpu/ops/registry.py) folds
+every ``GST_*`` gate's probe → validate → degrade → record pipeline
+into one declared table; this CLI renders what that table resolves to
+on the current host/environment — the provenance a bug report or an
+A/B harness needs, without tracing a single program:
+
+- per-gate rows: validated env value, resolved verdict
+  (enabled/forced/degraded + why), owning layer;
+- the capability probe verdicts (platform, native library, timer
+  surface) and the native library's own status line;
+- the per-op implementation tables (which impl each linalg dispatcher
+  would choose, in priority order, with its shape-class guards);
+- the persistent cold-start cache state (directory, key, loaded or
+  why not).
+
+``--markdown`` emits the OBSERVABILITY.md "Env-gate index" table —
+the committed docs section is literally this output (pinned by
+tests/test_obs_wire.py, so the index can never drift from the
+registry). ``--json`` emits the whole resolution document for
+machines.
+
+Usage::
+
+    python tools/gates.py               # human table
+    python tools/gates.py --json
+    python tools/gates.py --markdown    # the docs index section
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))  # repo root for the package
+
+
+def resolve_all() -> dict:
+    """Resolve every declared gate on this host (forcing the probes
+    the strict3 gates consult) and return the full document."""
+    from gibbs_student_t_tpu.native import ffi as nffi
+    from gibbs_student_t_tpu.ops import registry
+
+    gates = {}
+    for name in sorted(registry.GATES):
+        sp = registry.GATES[name]
+        row = {"layer": sp.layer, "kind": sp.kind, "fp": sp.fp,
+               "env": os.environ.get(name), "doc": sp.doc}
+        try:
+            if sp.kind == "strict3":
+                row["value"] = registry.value(name)
+                en, forced = registry.mode3(name)
+                row["enabled"], row["forced"] = en, forced
+            elif sp.kind == "pallas":
+                en, interp, forced = registry.pallas_mode(name)
+                row.update(value=registry.value(name), enabled=en,
+                           interpret=interp, forced=forced)
+            elif sp.kind == "int":
+                row["value"] = registry.int_value(name)
+            else:
+                row["value"] = registry.value(name)
+        except ValueError as e:
+            row["error"] = str(e)
+        gates[name] = row
+    # note: mode3 above resolves through the declared requires/auto
+    # probes — the few gates whose auto folds in run structure
+    # (GST_FUSE_STAGES' model fusability, GST_HYPER_SCHUR's static
+    # column count) additionally re-resolve at backend construction
+    return {
+        "gates": gates,
+        "probes": {k: bool(v)
+                   for k, v in registry.probes_snapshot().items()},
+        "native_status": nffi.status(),
+        "ops": {op: [{"impl": i, "gate": g, "shape_class": s}
+                     for i, g, s in rows]
+                for op, rows in registry.OPS.items()},
+        "cache": {
+            "dir": registry.host_cache_dir(),
+            "key": registry.cache_key(),
+            "loaded": registry.load_gate_cache(),
+        },
+        "counters": registry.stats(),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full resolution document as JSON")
+    ap.add_argument("--markdown", action="store_true",
+                    help="emit the generated OBSERVABILITY.md "
+                         "env-gate index table")
+    args = ap.parse_args(argv)
+
+    if args.markdown:
+        from gibbs_student_t_tpu.ops import registry
+
+        print("\n".join(registry.gates_markdown()))
+        return 0
+
+    doc = resolve_all()
+    if args.json:
+        json.dump(doc, sys.stdout, indent=1, default=repr)
+        print()
+        return 0
+
+    w = max(len(n) for n in doc["gates"])
+    print(f"# dispatch registry on this host "
+          f"(native: {doc['native_status']})")
+    print(f"{'gate':<{w}}  {'layer':<8} {'env':<10} resolved")
+    for name, row in doc["gates"].items():
+        env = "-" if row.get("env") is None else repr(row["env"])
+        if "error" in row:
+            verdict = f"INVALID: {row['error']}"
+        elif "enabled" in row:
+            verdict = ("on" if row["enabled"] else "off")
+            if row.get("forced"):
+                verdict += " (forced)"
+            if row.get("interpret"):
+                verdict += " (interpret)"
+        else:
+            verdict = repr(row.get("value"))
+        print(f"{name:<{w}}  {row['layer']:<8} {env:<10} {verdict}")
+    print("\n# probes: " + ", ".join(
+        f"{k}={v}" for k, v in sorted(doc["probes"].items())))
+    cache = doc["cache"]
+    print(f"# cold-start cache: {cache['dir']} "
+          f"(gates.json {'loaded' if cache['loaded'] else 'absent/stale'})")
+    print("\n# per-op dispatch (priority order; first row whose gate "
+          "resolves on and shape-class matches wins):")
+    for op, rows in doc["ops"].items():
+        chain = " -> ".join(
+            f"{r['impl']}[{r['gate'] or 'always'}]" for r in rows)
+        print(f"#   {op:<14} {chain}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -302,6 +302,35 @@ def print_report(ledger_recs, include_rounds=True):
                       f"lanes={p.get('nlanes')} "
                       f"occupancy={occ if occ is not None else '?'} "
                       f"queue={p.get('queue_depth')}")
+        elif rec.get("tool") == "coldstart":
+            # cold-start record: warm spawn->first-result is the
+            # headline; cold/recover walls + fresh-decision counters
+            # are the evidence (docs/PERFORMANCE.md "Cold starts")
+            cold = m.get("cold") or {}
+            warm = m.get("warm") or {}
+            rcv = m.get("recover") or {}
+            reg = rcv.get("registry") or {}
+            print(f"  {rec.get('timestamp_utc', '?'):20s} "
+                  f"{rec.get('tool', '?'):14s} "
+                  f"{rec.get('platform') or '?':8s} "
+                  f"warm spawn->first-result "
+                  f"{warm.get('spawn_to_first_result_s')}s "
+                  f"(cold {cold.get('spawn_to_first_result_s')}s, "
+                  f"{m.get('warm_speedup')}x) recover "
+                  f"{rcv.get('spawn_to_first_result_s')}s "
+                  f"fresh_probes={reg.get('probes_fresh')} "
+                  f"fresh_autotune={reg.get('autotune_fresh')}")
+        elif rec.get("tool") == "migrate_bench":
+            base = m.get("base") or {}
+            reb = m.get("rebalance") or {}
+            print(f"  {rec.get('timestamp_utc', '?'):20s} "
+                  f"{rec.get('tool', '?'):14s} "
+                  f"{rec.get('platform') or '?':8s} "
+                  f"jobs/h {base.get('jobs_per_hour')} -> "
+                  f"{reb.get('jobs_per_hour')} "
+                  f"({m.get('gain_pct')}% at equal delivered sweeps) "
+                  f"migrations={reb.get('migrations')} "
+                  f"bitwise={'OK' if m.get('bitwise_vs_base') else 'FAIL'}")
         else:
             brief = {k: v for k, v in m.items()
                      if isinstance(v, (int, float, bool, str))}
@@ -859,6 +888,119 @@ def check_fleet(ledger_recs, min_fleet_ratio, max_admission_p99):
     return 0
 
 
+def check_coldstart(ledger_recs, max_coldstart_ms,
+                    min_coldstart_speedup):
+    """Cold-start gates over the latest ``coldstart`` record (round
+    18, ROADMAP 5): (1) the WARM spawn→first-result wall — what a
+    fleet scale-out or failover respawn actually pays once the
+    per-host AOT + gates caches are populated — must stay under
+    ``max_coldstart_ms``; (2) warm must beat cold by
+    ``min_coldstart_speedup`` (the cache has to EARN its complexity:
+    a warm boot that re-pays the probe→autotune→compile gauntlet
+    fails here); (3) the recovered-pool contract — ``recover()`` /
+    ``pool_main --recover`` must re-derive NOTHING: any fresh probe
+    or fresh autotune decision in the recover leg's registry counters
+    is a fail (the cache was ignored or incomplete), as is a recovery
+    that did not resume the spooled tenant."""
+    recs = [r for r in ledger_recs if r.get("tool") == "coldstart"]
+    if not recs:
+        print("check: no coldstart record — cold-start gates skipped")
+        return 0
+    m = recs[-1].get("metrics") or {}
+    warm = m.get("warm") or {}
+    rcv = m.get("recover") or {}
+    warm_s = warm.get("spawn_to_first_result_s")
+    speedup = m.get("warm_speedup")
+    if not isinstance(warm_s, (int, float)) \
+            or not isinstance(speedup, (int, float)):
+        print("check: FAIL — latest coldstart record has no usable "
+              f"warm wall/speedup ({warm_s!r}/{speedup!r})")
+        return 3
+    print(f"check: coldstart warm spawn->first-result "
+          f"{warm_s * 1e3:.0f} ms (max {max_coldstart_ms:.0f}), "
+          f"speedup {speedup:.2f}x vs cold (min "
+          f"{min_coldstart_speedup})")
+    if warm_s * 1e3 > max_coldstart_ms:
+        print(f"check: FAIL — warm spawn->first-result "
+              f"{warm_s * 1e3:.0f} ms > {max_coldstart_ms:.0f} (a "
+              "respawn pays too much before serving: is the AOT "
+              "cache dir being fingerprint-missed?)")
+        return 2
+    if speedup < min_coldstart_speedup:
+        print(f"check: FAIL — warm/cold speedup {speedup:.2f}x < "
+              f"{min_coldstart_speedup}x (the persistent caches are "
+              "not paying: check cache.gates/cache.aot in the "
+              "record's warm.worker block)")
+        return 2
+    reg = rcv.get("registry") or {}
+    fresh_p = reg.get("probes_fresh")
+    fresh_a = reg.get("autotune_fresh")
+    print(f"check: recover leg fresh probes={fresh_p} fresh "
+          f"autotune={fresh_a} (both must be 0), resumed="
+          f"{m.get('recovered_tenant_resumed')}")
+    if fresh_p or fresh_a or fresh_p is None or fresh_a is None:
+        print("check: FAIL — a recovered pool re-derived "
+              f"{fresh_p} probe / {fresh_a} autotune decision(s) "
+              "(the gates cache was stale, ignored, or never "
+              "written; ROADMAP 5's contract is ZERO re-probing on "
+              "recovery)")
+        return 2
+    if m.get("recovered_tenant_resumed") is False:
+        print("check: FAIL — the recover leg did not resume the "
+              "spooled tenant")
+        return 2
+    return 0
+
+
+def check_migrate(ledger_recs):
+    """Live-migration gate over the latest ``migrate_bench`` record:
+    the rebalance arm must (1) actually migrate, (2) deliver MORE
+    jobs/h than the no-migration arm on the same imbalanced workload
+    (equal delivered sweeps — the jobs are identical), and (3) keep
+    every migrated job's chains bitwise the unmigrated arm's (the
+    checkpoint→cancel→resume primitive must add zero numerics).
+    Structural, so it arms whenever a record exists — no floor to
+    tune."""
+    recs = [r for r in ledger_recs
+            if r.get("tool") == "migrate_bench"]
+    if not recs:
+        print("check: no migrate_bench record — migration gate "
+              "skipped")
+        return 0
+    m = recs[-1].get("metrics") or {}
+    base = (m.get("base") or {}).get("jobs_per_hour")
+    reb = (m.get("rebalance") or {}).get("jobs_per_hour")
+    migs = (m.get("rebalance") or {}).get("migrations")
+    if not isinstance(base, (int, float)) \
+            or not isinstance(reb, (int, float)):
+        print("check: FAIL — latest migrate_bench record has no "
+              f"usable jobs/h pair ({base!r}/{reb!r})")
+        return 3
+    print(f"check: migrate arm {base} -> {reb} jobs/h "
+          f"({m.get('gain_pct')}%), {migs} migration(s), bitwise "
+          f"{m.get('bitwise_vs_base')}")
+    if not migs:
+        print("check: FAIL — the rebalance arm performed zero "
+              "migrations (the policy never fired on an imbalanced "
+              "workload)")
+        return 2
+    if reb <= base:
+        print(f"check: FAIL — rebalance jobs/h {reb} <= base {base} "
+              "(migration is not converting the drained pool's idle "
+              "lanes into throughput)")
+        return 2
+    if m.get("bitwise_vs_base") is not True:
+        print("check: FAIL — migrated job results are not bitwise "
+              "the no-migration arm's (the checkpoint->resume "
+              "primitive broke determinism)")
+        return 2
+    if (m.get("rebalance") or {}).get("migration_failures"):
+        print("check: FAIL — migration failures counted in the "
+              "rebalance arm")
+        return 2
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--ledger", default=None,
@@ -962,6 +1104,16 @@ def main(argv=None):
                          "submitted up front, so deliberate queue-wait "
                          "dominates — this is a placement-starvation "
                          "guard, not a tuning target)")
+    ap.add_argument("--max-coldstart-ms", type=float, default=120000.0,
+                    help="max WARM spawn->first-result wall (ms) on "
+                         "the latest coldstart record — what a "
+                         "scale-out/failover respawn pays before "
+                         "serving (gate skipped with no record)")
+    ap.add_argument("--min-coldstart-speedup", type=float, default=2.0,
+                    help="min warm-vs-cold spawn->first-result "
+                         "speedup on the latest coldstart record "
+                         "(the persistent AOT+gates caches must earn "
+                         "their keep)")
     ap.add_argument("--max-trend-drop", type=float, default=25.0,
                     metavar="PCT",
                     help="trend gate: max tolerated drop of a "
@@ -1010,11 +1162,14 @@ def main(argv=None):
         rc_fleet = check_fleet(recs, args.min_fleet_ratio,
                                args.max_fleet_admission_p99)
         rc_ess = check_ess_per_core(recs, args.min_ess_per_core_s)
+        rc_cold = check_coldstart(recs, args.max_coldstart_ms,
+                                  args.min_coldstart_speedup)
+        rc_mig = check_migrate(recs)
         rc_trend = check_trend(recs, args.max_trend_drop,
                                window=args.trend_window,
                                points=args.trend_points)
         return (rc or rc_serve or rc_obs or rc_faults or rc_fleet
-                or rc_ess or rc_trend)
+                or rc_ess or rc_cold or rc_mig or rc_trend)
     return 0
 
 
