@@ -89,6 +89,16 @@ def _pool_entry(source: str, st: dict) -> dict:
     subset of one reachable pool's status."""
     faults = st.get("faults") or {}
     tenants = st.get("tenants") or []
+    # watchdog fold (round 19 fix): a pool whose watchdog tripped
+    # answers status fine but its healthz is 503 — the fleet row must
+    # say SICK, not healthy. Heartbeat ages ride along so a stalling
+    # (not yet tripped) pool is visible too.
+    wd = st.get("watchdog")
+    wd = wd if isinstance(wd, dict) else {}
+    tripped = wd.get("state") == "tripped"
+    beats = wd.get("heartbeat_age_s")
+    beats = beats if isinstance(beats, dict) else {}
+    ages = [v for v in beats.values() if isinstance(v, (int, float))]
     return {
         "source": str(source),
         "reachable": True,
@@ -103,10 +113,15 @@ def _pool_entry(source: str, st: dict) -> dict:
         "running_tenants": len(tenants),
         "quanta": st.get("quanta"),
         "uptime_s": st.get("uptime_s"),
-        # healthy = the pool itself never failed; tenant-scoped faults
-        # are contained by design and do not disqualify a pool
-        "healthy": not faults.get("pool_failures"),
+        # healthy = the pool itself never failed AND its watchdog has
+        # not tripped; tenant-scoped faults are contained by design
+        # and do not disqualify a pool
+        "healthy": not faults.get("pool_failures") and not tripped,
         "faults": faults,
+        "watchdog_state": wd.get("state"),
+        "watchdog_cause": ((wd.get("trip") or {}).get("cause")
+                           if tripped else None),
+        "heartbeat_age_max_s": (round(max(ages), 3) if ages else None),
     }
 
 
@@ -199,7 +214,7 @@ def render_fleet(snap: dict, out) -> None:
             print(f"slo {leg:16s} p50={p.get('p50'):>8} "
                   f"p90={p.get('p90'):>8} p99={p.get('p99'):>8} "
                   f"(merged from raw series)", file=out)
-    print(f"{'POOL':40s} {'OK':>4} {'LANES':>9} {'OCC%':>6} "
+    print(f"{'POOL':40s} {'OK':>4} {'WD':>5} {'LANES':>9} {'OCC%':>6} "
           f"{'QUEUE':>5} {'TEN':>4} {'FAULTS'}", file=out)
     for p in snap.get("pools") or []:
         src = str(p.get("source"))[:40]
@@ -210,9 +225,181 @@ def render_fleet(snap: dict, out) -> None:
         occ = (p.get("occupancy_now") or 0) * 100
         f = p.get("faults") or {}
         fstr = " ".join(f"{k}={v}" for k, v in f.items() if v) or "-"
+        # a tripped watchdog is a headline: the WD column shouts TRIP
+        # (with the cause folded into the fault string) and the max
+        # heartbeat age shows a stalling pool before it trips
+        wd_state = p.get("watchdog_state")
+        wd = {"tripped": "TRIP", "ok": "ok", "off": "off",
+              None: "-"}.get(wd_state, str(wd_state))
+        hb = p.get("heartbeat_age_max_s")
+        if isinstance(hb, (int, float)) and wd == "ok":
+            wd = f"{hb:.0f}s" if hb >= 1 else "ok"
+        if p.get("watchdog_cause"):
+            fstr = (f"wd:{p['watchdog_cause']} " + fstr).rstrip(" -")
         # str() the sparse fields: a pool serving a partial status is
         # still a renderable row, not a dashboard crash
         print(f"{src:40s} {'ok' if p.get('healthy') else 'SICK':>4} "
-              f"{lanes:>9} {occ:6.1f} "
+              f"{wd:>5} {lanes:>9} {occ:6.1f} "
               f"{str(p.get('queue_depth')):>5} "
               f"{str(p.get('running_tenants')):>4} {fstr}", file=out)
+
+
+# ---------------------------------------------------------------------------
+# fleet trace stitching (round 19): clock-offset estimation + merge
+# ---------------------------------------------------------------------------
+
+#: pool swimlane pid stride in a stitched trace: router events keep
+#: their native pids (< _POOL_PID_STRIDE), pool k's pids shift into
+#: [_POOL_PID_STRIDE*(k+1), ...) — lanes can never collide, and
+#: "which side recorded this" is recoverable from the pid alone
+#: (:func:`trace_coverage`).
+POOL_PID_STRIDE = 1000
+
+
+def estimate_clock_offset(samples) -> dict:
+    """NTP-style clock offset from request/response wall-time triples.
+
+    ``samples`` is an iterable of ``(t0, ts, t1)``: local wall time at
+    send, the server's wall time, local wall time at receive (the
+    ``RemoteChainServer.server_time()`` shape). Under the symmetric-
+    delay assumption the server clock leads the local clock by
+    ``ts - (t0 + t1) / 2``; the estimate is taken at the minimum-RTT
+    sample (least queueing noise, the classic NTP selection), so a few
+    samples suffice and asymmetric outliers are rejected by
+    construction. Returns ``{"offset_s", "rtt_s", "n"}`` — with no
+    usable samples, offset 0.0 and ``rtt_s`` None (an uncorrected
+    merge beats no merge). Malformed rows are skipped, never fatal.
+    """
+    best = None
+    n = 0
+    for s in samples or ():
+        try:
+            t0, ts, t1 = float(s[0]), float(s[1]), float(s[2])
+        except (TypeError, ValueError, IndexError):
+            continue
+        rtt = t1 - t0
+        if rtt < 0:          # non-causal sample: clock stepped mid-RTT
+            continue
+        n += 1
+        if best is None or rtt < best[0]:
+            best = (rtt, ts - 0.5 * (t0 + t1))
+    if best is None:
+        return {"offset_s": 0.0, "rtt_s": None, "n": 0}
+    return {"offset_s": round(best[1], 6),
+            "rtt_s": round(best[0], 6), "n": n}
+
+
+def read_trace(source: str, timeout: float = 5.0) -> dict:
+    """One pool's Chrome trace document: an endpoint URL (``/trace``
+    appended unless present) or a trace JSON path. Raises on failure —
+    the stitching caller degrades per pool."""
+    src = str(source)
+    if src.startswith(("http://", "https://")):
+        url = src.rstrip("/")
+        if not url.endswith("/trace"):
+            url += "/trace"
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            doc = json.loads(resp.read().decode())
+    else:
+        with open(src) as fh:
+            doc = json.load(fh)
+    if not isinstance(doc, dict):
+        raise ValueError(f"trace from {source!r} is not an object")
+    return doc
+
+
+def stitch_fleet_trace(router_doc: dict, pools) -> dict:
+    """Merge the router's Chrome trace with per-pool traces into one
+    offset-corrected fleet document (the ``fleet_trace`` schema).
+
+    ``pools`` rows are ``{"label", "doc", "clock"}`` — ``doc`` a pool's
+    ``chrome_trace_doc()`` (its ``otherData.epoch_wall`` anchors its
+    ts=0 on the pool's wall clock), ``clock`` an
+    :func:`estimate_clock_offset` result for that pool. Every pool
+    event's ``ts`` is rebased onto the ROUTER timeline::
+
+        ts' = ts + ((pool_epoch_wall - offset) - router_epoch_wall)*1e6
+
+    i.e. the pool's wall clock corrected by its estimated offset, then
+    expressed relative to the router's epoch — so one job's router
+    placement span, pool staging/dispatch/drain spans and router
+    result span line up in causal order even under skewed clocks. Pool
+    pids shift by :data:`POOL_PID_STRIDE` per pool (disjoint
+    swimlanes); process_name metadata rows gain a ``label/`` prefix.
+    Pools whose doc carries no ``epoch_wall`` merge uncorrected
+    (shift 0) — degraded, never fatal.
+    """
+    other = router_doc.get("otherData") or {}
+    router_epoch = other.get("epoch_wall")
+    dropped = int(other.get("dropped_spans") or 0)
+    events = []
+    for ev in router_doc.get("traceEvents") or ():
+        if (ev.get("ph") == "M" and ev.get("name") == "process_name"
+                and ev.get("pid") == 0):
+            # the recorder labels pid 0 "pool"; in a fleet doc that
+            # lane is the router's own
+            ev = dict(ev, args={"name": "router"})
+        events.append(ev)
+    clocks = {}
+    for k, p in enumerate(pools or ()):
+        doc = (p.get("doc") or {}) if isinstance(p, dict) else {}
+        label = str((p.get("label") if isinstance(p, dict) else None)
+                    or f"pool{k}")
+        clock = (p.get("clock") if isinstance(p, dict) else None) or {}
+        off = clock.get("offset_s")
+        off = float(off) if isinstance(off, (int, float)) else 0.0
+        pool_other = doc.get("otherData") or {}
+        pool_epoch = pool_other.get("epoch_wall")
+        if (isinstance(pool_epoch, (int, float))
+                and isinstance(router_epoch, (int, float))):
+            shift_us = ((float(pool_epoch) - off)
+                        - float(router_epoch)) * 1e6
+        else:
+            shift_us = 0.0
+        dropped += int(pool_other.get("dropped_spans") or 0)
+        clocks[label] = {"offset_s": off, "rtt_s": clock.get("rtt_s"),
+                         "n": int(clock.get("n") or 0),
+                         "shift_us": round(shift_us, 3)}
+        base = POOL_PID_STRIDE * (k + 1)
+        for ev in doc.get("traceEvents") or ():
+            ev = dict(ev)
+            try:
+                ev["pid"] = base + int(ev.get("pid") or 0)
+            except (TypeError, ValueError):
+                ev["pid"] = base
+            if ev.get("ph") == "M":
+                if ev.get("name") == "process_name":
+                    args = dict(ev.get("args") or {})
+                    args["name"] = f"{label}/{args.get('name', '')}"
+                    ev["args"] = args
+            elif isinstance(ev.get("ts"), (int, float)):
+                ev["ts"] = round(float(ev["ts"]) + shift_us, 3)
+            events.append(ev)
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"dropped_spans": dropped,
+                          "epoch_wall": router_epoch,
+                          "clocks": clocks,
+                          "n_pools": len(list(pools or ()))}}
+
+
+def trace_coverage(doc: dict) -> dict:
+    """Per-job span counts over a stitched fleet trace:
+    ``{trace_id: {"router": n, "pool": n}}``. The side is recovered
+    from the pid (router lanes sit below :data:`POOL_PID_STRIDE`) —
+    this is the end-to-end completeness evidence ``tools/
+    fleet_bench.py`` records and ``perf_report --check`` gates on."""
+    cov = {}
+    for ev in doc.get("traceEvents") or ():
+        if ev.get("ph") != "X":
+            continue
+        tid = (ev.get("args") or {}).get("trace_id")
+        if not tid:
+            continue
+        try:
+            side = ("router" if int(ev.get("pid") or 0) < POOL_PID_STRIDE
+                    else "pool")
+        except (TypeError, ValueError):
+            continue
+        c = cov.setdefault(str(tid), {"router": 0, "pool": 0})
+        c[side] += 1
+    return cov

@@ -154,6 +154,33 @@ def prometheus_text(snapshot: dict, prefix: str = "gst_",
     return "\n".join(out) + "\n"
 
 
+def prometheus_labeled(families: dict, prefix: str = "gst_",
+                       ts_ms: Optional[int] = None) -> str:
+    """Render multi-label-set families in the exposition format.
+
+    ``prometheus_text`` attaches ONE instance label set to a whole
+    registry snapshot; a fleet exposition needs one family declared
+    once with a sample row PER POOL (repeating ``# TYPE`` for a family
+    is invalid exposition). ``families`` maps family name ->
+    ``{"kind": "gauge"|"counter", "help": str (optional),
+    "samples": [(labels_dict, value), ...]}``; HELP/TYPE are emitted
+    exactly once per family, then every sample row with its own label
+    block. Used by the FleetRouter's ``GET /metrics`` for the
+    per-pool capacity gauges (round 19)."""
+    out = []
+    suffix = f" {ts_ms}" if ts_ms is not None else ""
+    for name in sorted(families):
+        fam = families[name] or {}
+        n = _metric_name(name, prefix)
+        kind = fam.get("kind") or "gauge"
+        out.append(f"# HELP {n} "
+                   f"{_escape_help(fam.get('help') or _HELP.get(n, f'{kind} {n}'))}")
+        out.append(f"# TYPE {n} {kind}")
+        for labels, value in fam.get("samples") or ():
+            out.append(f"{n}{_label_str(labels)} {_fmt(value)}{suffix}")
+    return "\n".join(out) + ("\n" if out else "")
+
+
 def write_prometheus(registry, path: str, prefix: str = "gst_",
                      labels: Optional[dict] = None) -> Optional[str]:
     """Atomically write ``registry``'s snapshot to ``path`` in the

@@ -87,6 +87,19 @@ class SpanRecorder:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.capacity = int(capacity)
         self.epoch = time.monotonic()   # export time base (t=0)
+        #: wall-clock anchor of the epoch, sampled back-to-back with
+        #: the monotonic epoch: ``epoch_wall + t0`` places any span on
+        #: this host's wall clock, which is what the fleet stitcher
+        #: (obs/aggregate.py ``stitch_fleet_trace``) corrects with the
+        #: NTP-style per-pool offset to line pool swimlanes up beside
+        #: the router lane (round 19).
+        self.epoch_wall = time.time()
+        #: tenant id -> trace id (fleet trace-context propagation):
+        #: spans recorded with a mapped ``tenant=`` are tagged with the
+        #: trace id so one correlation id spans router + pool. Plain
+        #: dict, registered at admission (`set_trace_id`) — reads are
+        #: GIL-atomic and a missing entry just leaves spans untagged.
+        self.trace_ids: Dict = {}
         self._ring = collections.deque(maxlen=self.capacity)
         self._lock = threading.Lock()
         self._dropped = 0
@@ -106,6 +119,15 @@ class SpanRecorder:
 
     # -- recording ------------------------------------------------------
 
+    def set_trace_id(self, tenant, trace_id) -> None:
+        """Register ``tenant``'s trace id: subsequent (and only
+        subsequent) spans for that tenant carry it. Never raises."""
+        try:
+            if tenant is not None and trace_id:
+                self.trace_ids[tenant] = str(trace_id)
+        except Exception:  # noqa: BLE001 - observability must not crash
+            pass
+
     def span(self, name: str, role: str, tenant=None,
              quantum: Optional[int] = None, **args) -> _SpanCtx:
         """``with recorder.span("drain", ROLE_DRAIN, tenant=3,
@@ -123,6 +145,14 @@ class SpanRecorder:
                    "t0": t0 - self.epoch, "dur": dur,
                    "tenant": tenant, "quantum": quantum,
                    "thread": threading.current_thread().name}
+            # trace-context tagging (round 19): explicit kwarg wins
+            # (router spans name the job they act on), else the
+            # tenant's registered id
+            tid = args.pop("trace_id", None)
+            if tid is None and tenant is not None:
+                tid = self.trace_ids.get(tenant)
+            if tid is not None:
+                rec["trace_id"] = str(tid)
             if args:
                 rec["args"] = args
             with self._lock:
@@ -214,6 +244,8 @@ class SpanRecorder:
             args = {k: v for k, v in (s.get("args") or {}).items()}
             if s["quantum"] is not None:
                 args["quantum"] = s["quantum"]
+            if s.get("trace_id") is not None:
+                args["trace_id"] = s["trace_id"]
             args["thread"] = s["thread"]
             events.append({
                 "name": s["name"], "ph": "X", "cat": s["role"],
@@ -234,7 +266,10 @@ class SpanRecorder:
                              "pid": pid, "tid": tid,
                              "args": {"name": role}})
         return {"traceEvents": meta + events, "displayTimeUnit": "ms",
-                "otherData": {"dropped_spans": self.dropped}}
+                "otherData": {"dropped_spans": self.dropped,
+                              # wall-clock anchor of ts=0, for the
+                              # fleet stitcher's offset correction
+                              "epoch_wall": self.epoch_wall}}
 
     def export_chrome_trace(self, path: str,
                             tenant_names: Optional[Dict] = None) -> str:

@@ -42,16 +42,24 @@ a router exactly like at a pool.
 
 from __future__ import annotations
 
+import collections
+import json
 import os
 import shutil
 import subprocess
 import sys
 import threading
 import time
+import uuid
 import warnings
 from typing import Dict, List, Optional
 
 from gibbs_student_t_tpu.serve.rpc import RemoteChainServer
+
+#: thread role tag on router-side spans (the pool-side roles are
+#: staging/dispatch/drain; the router's single logical role keeps the
+#: fleet trace's swimlane legend flat)
+ROLE_ROUTER = "router"
 
 #: default seconds between liveness sweeps of the failover watch
 WATCH_POLL_S = 0.5
@@ -291,6 +299,8 @@ class RoutedHandle:
         # resume it ANYWHERE poisons the handle: result() raises this
         # instead of passing the served prefix off as the result
         self._migration_error: Optional[BaseException] = None
+        # the router trace's terminal span latches once (round 19)
+        self._result_span = False
 
     @property
     def tenant_id(self):
@@ -360,8 +370,9 @@ class RoutedHandle:
         return True
 
     def result(self, timeout: Optional[float] = None):
+        t_entry = time.monotonic()
         deadline = (None if timeout is None
-                    else time.monotonic() + timeout)
+                    else t_entry + timeout)
         while True:
             remaining = (None if deadline is None
                          else max(deadline - time.monotonic(), 0.0))
@@ -388,7 +399,26 @@ class RoutedHandle:
                 continue   # pre-migration prefix, not the result
             if self._migration_error is not None:
                 raise self._migration_error
+            self._record_result_span(t_entry)
             return res
+
+    def _record_result_span(self, t0: float) -> None:
+        """One terminal router span per job (latched): the caller's
+        result() wait, tagged with the job's trace id — the span that
+        closes the placement → submit → pool-execution story in the
+        stitched fleet trace. Never raises."""
+        if self._result_span:
+            return
+        self._result_span = True
+        spans = getattr(self.router, "spans", None)
+        if spans is None:
+            return
+        spans.record(
+            "result", ROLE_ROUTER, t0, time.monotonic() - t0,
+            trace_id=getattr(self.request, "trace_id", None),
+            job=getattr(self.request, "name", None),
+            pool=getattr(self.router.pools[self.pool_idx], "label",
+                         str(self.pool_idx)))
 
 
 class FleetRouter:
@@ -411,7 +441,12 @@ class FleetRouter:
                  rebalance: bool = False,
                  rebalance_poll_s: float = 2.0,
                  rebalance_min_sweeps: float = 0.0,
-                 rebalance_running: bool = False):
+                 rebalance_running: bool = False,
+                 trace: bool = True,
+                 span_capacity: int = 65536,
+                 obs_dir: Optional[str] = None,
+                 capacity_sample_s: float = 0.0,
+                 capacity_ring: int = 512):
         if placement not in ("load", "round_robin"):
             raise ValueError(
                 f"placement must be 'load' or 'round_robin', got "
@@ -457,6 +492,55 @@ class FleetRouter:
         self.rebalance_running = bool(rebalance_running)
         self.migrations = 0
         self.migration_failures = 0
+        #: queued-steal rebalance migrations (the subset of
+        #: ``migrations`` initiated by the policy thread)
+        self.steals = 0
+        # ------------------------------------------------------------
+        # the router-side observability plane (round 19). All knobs
+        # are constructor params, not env gates — the router is always
+        # constructed explicitly, and ops/registry.py stays the only
+        # env reader (the tier-1 bypass guard).
+        # ------------------------------------------------------------
+        self.spans = None
+        if trace:
+            from gibbs_student_t_tpu.obs.spans import SpanRecorder
+
+            # pure host bookkeeping: chains are bitwise identical with
+            # the fleet plane on or off (the PR 1 contract, at fleet
+            # scope)
+            self.spans = SpanRecorder(capacity=span_capacity)
+        self.obs_dir = obs_dir
+        self._journal_path = None
+        self._capacity_path = None
+        self._postmortem_path = None
+        if obs_dir:
+            try:
+                os.makedirs(obs_dir, exist_ok=True)
+                self._journal_path = os.path.join(
+                    obs_dir, "placements.jsonl")
+                self._capacity_path = os.path.join(
+                    obs_dir, "capacity.jsonl")
+                self._postmortem_path = os.path.join(
+                    obs_dir, "fleet_postmortem.json")
+            except OSError as e:
+                warnings.warn(
+                    f"fleet obs_dir {obs_dir!r} could not be created "
+                    f"({e}); journals disabled, routing continues",
+                    RuntimeWarning)
+        # explainable placement: every placement decision (submit,
+        # failover resubmit, migration resume) appends one event to
+        # the journal (obs/ledger record discipline: atomic line
+        # appends, warn-and-continue) and to a bounded in-memory tail
+        # (the ``explain()`` query + postmortem evidence)
+        self.placement_events = 0
+        self._placement_tail = collections.deque(maxlen=256)
+        self._journal_warned = False
+        # capacity timeline: bounded ring + optional JSONL series
+        self.capacity_sample_s = float(capacity_sample_s or 0.0)
+        self._capacity_ring = collections.deque(
+            maxlen=max(int(capacity_ring), 1))
+        self.capacity_samples = 0
+        self._capacity_warned = False
         self._stop = threading.Event()
         self._watch: Optional[threading.Thread] = None
         if failover:
@@ -470,6 +554,13 @@ class FleetRouter:
                 target=self._rebalance_loop, args=(rebalance_poll_s,),
                 name="gst-fleet-rebalance", daemon=True)
             self._rebal.start()
+        self._sampler: Optional[threading.Thread] = None
+        if self.capacity_sample_s > 0:
+            self._sampler = threading.Thread(
+                target=self._capacity_loop,
+                args=(self.capacity_sample_s,),
+                name="gst-fleet-capacity", daemon=True)
+            self._sampler.start()
         self.http = None
         if http_port is not None:
             try:
@@ -478,7 +569,10 @@ class FleetRouter:
                 self.http = ObsHttpServer(
                     host=http_host, port=http_port,
                     status_fn=self.fleet_status,
-                    healthz_fn=self.healthz)
+                    healthz_fn=self.healthz,
+                    metrics_fn=self.metrics_text,
+                    trace_fn=self.export_trace,
+                    postmortem_fn=self.fleet_postmortem)
             except Exception as e:  # noqa: BLE001 - obs contract
                 warnings.warn(
                     f"fleet observability endpoint failed to start "
@@ -489,13 +583,17 @@ class FleetRouter:
     # placement
     # ------------------------------------------------------------------
 
-    def _statuses(self) -> List:
+    def _statuses(self, meta: Optional[dict] = None) -> List:
         """[(pool_idx, status-or-Exception)] for every live pool; a
         failed poll degrades to the pool's last snapshot while it is
         fresher than ``status_stale_s`` (see the cache comment in
-        ``__init__``)."""
+        ``__init__``). ``meta``, when given, is filled with
+        ``{pool_idx: cache_age_s}`` — 0.0 for a fresh poll, the
+        snapshot's age when the cache served it (the explainable-
+        placement evidence: a decision made on stale data says so)."""
         out = []
-        now = time.monotonic()
+        t_poll0 = time.monotonic()
+        now = t_poll0
         for i, p in enumerate(self.pools):
             if i in self._dead:
                 out.append((i, ConnectionError("pool marked dead")))
@@ -509,14 +607,25 @@ class FleetRouter:
                     # flight — a snapshot of the OLD pool must not
                     # outlive its replacement
                     self._status_cache[i] = (now, st)
+                if meta is not None:
+                    meta[i] = 0.0
                 out.append((i, st))
             except Exception as e:  # noqa: BLE001 - a dead pool is data
                 cached = self._status_cache.get(i)
                 if cached is not None \
                         and now - cached[0] <= self.status_stale_s:
+                    if meta is not None:
+                        meta[i] = round(now - cached[0], 3)
                     out.append((i, cached[1]))
                 else:
                     out.append((i, e))
+        if self.spans is not None:
+            self.spans.record(
+                "status_poll", ROLE_ROUTER, t_poll0,
+                time.monotonic() - t_poll0,
+                n_pools=len(self.pools),
+                n_reachable=sum(1 for _, st in out
+                                if isinstance(st, dict)))
         return out
 
     def _invalidate_status(self, idx: int) -> None:
@@ -588,8 +697,14 @@ class FleetRouter:
                 FleetRouter._est_backlog(st),
                 -FleetRouter._pool_efficiency(st), p99)
 
-    def _place(self, request) -> int:
-        """Choose the pool for one request (caller holds ``_lock``)."""
+    def _place(self, request,
+               explain: Optional[dict] = None) -> int:
+        """Choose the pool for one request (caller holds ``_lock``).
+        ``explain``, when given, is filled with the decision's full
+        evidence — per-candidate score breakdown, status-cache ages
+        and which leg won — the ``placement_event`` journal payload
+        (round 19: "why did job J land on pool K" is recorded, not
+        reconstructed)."""
         live = [i for i in range(len(self.pools))
                 if i not in self._dead]
         if not live:
@@ -599,18 +714,49 @@ class FleetRouter:
                 i = self._rr_next % len(self.pools)
                 self._rr_next += 1
                 if i in live:
+                    if explain is not None:
+                        explain["won"] = "round_robin"
                     return i
+            if explain is not None:
+                explain["won"] = "fallback"
             return live[0]
         scored = []
-        for i, st in self._statuses():
+        cands = []
+        ages: dict = {}
+        for i, st in self._statuses(meta=ages):
+            row = {"pool": getattr(self.pools[i], "label", str(i)),
+                   "pool_idx": i,
+                   "reachable": isinstance(st, dict),
+                   "cache_age_s": ages.get(i)}
             if isinstance(st, dict):
                 faults = st.get("faults") or {}
-                if not faults.get("pool_failures"):
-                    scored.append((self._load_score(st), i))
+                healthy = not faults.get("pool_failures")
+                row["healthy"] = bool(healthy)
+                score = self._load_score(st)
+                row["score"] = {
+                    "queue_staged": score[0],
+                    "free_lanes": -score[1],
+                    "occupancy_now": score[2],
+                    "est_backlog": score[3],
+                    "ess_per_core_s": -score[4],
+                    "admission_p99_ms": score[5],
+                }
+                if healthy:
+                    scored.append((score, i))
+            else:
+                row["healthy"] = False
+                row["error"] = f"{type(st).__name__}: {st}"
+            cands.append(row)
+        if explain is not None:
+            explain["candidates"] = cands
         if not scored:
             # every pool unreachable/sick right now: fall back to a
             # deterministic spread rather than refusing service
+            if explain is not None:
+                explain["won"] = "fallback"
             return live[0]
+        if explain is not None:
+            explain["won"] = "score"
         return min(scored)[1]
 
     # ------------------------------------------------------------------
@@ -626,19 +772,42 @@ class FleetRouter:
         pool index — the operational escape hatch (and the imbalance
         generator behind ``fleet_bench --migrate-arm``); a pinned dead
         pool raises."""
+        # trace-context propagation (round 19): mint the job's
+        # correlation id here — it rides the RPC submit frame, the
+        # pool tags the tenant's spans with it, and every router span
+        # below carries it, so the stitched fleet trace shows this
+        # job's placement → submit → pool execution → result as one
+        # correlated story. Pure metadata: chain math never sees it.
+        if getattr(request, "trace_id", None) is None:
+            from dataclasses import replace as _replace
+
+            request = _replace(request,
+                               trace_id=uuid.uuid4().hex[:16])
+        t_sub0 = time.monotonic()
         with self._lock:
+            explain: dict = {}
+            t_place0 = time.monotonic()
             if pool is not None:
                 if pool in self._dead:
                     raise RuntimeError(
                         f"pinned pool {pool} is dead")
                 idx = pool
+                explain["won"] = "pinned"
             else:
-                idx = self._place(request)
+                idx = self._place(request, explain=explain)
+            if self.spans is not None:
+                self.spans.record(
+                    "place", ROLE_ROUTER, t_place0,
+                    time.monotonic() - t_place0,
+                    trace_id=request.trace_id,
+                    pool=getattr(self.pools[idx], "label", str(idx)),
+                    won=explain.get("won"))
             inner = self.pools[idx].submit(request, timeout=timeout)
             rh = RoutedHandle(self, request, idx, inner)
             self._routed.append(rh)
             label = self.pools[idx].label
             self.placements[label] = self.placements.get(label, 0) + 1
+            self._record_placement("submit", request, idx, explain)
             # account the submit in the cached snapshot so a burst of
             # placements between polls (or against a stale snapshot)
             # still joins the shortest queue
@@ -646,6 +815,12 @@ class FleetRouter:
             if cached is not None:
                 cached[1]["queue_depth"] = \
                     (cached[1].get("queue_depth") or 0) + 1
+        if self.spans is not None:
+            self.spans.record(
+                "submit", ROLE_ROUTER, t_sub0,
+                time.monotonic() - t_sub0,
+                trace_id=request.trace_id, job=request.name,
+                pool=getattr(self.pools[idx], "label", str(idx)))
         return rh
 
     def cancel(self, handle: RoutedHandle) -> bool:
@@ -701,6 +876,9 @@ class FleetRouter:
             "rebalance": bool(self.rebalance),
             "migrations": self.migrations,
             "migration_failures": self.migration_failures,
+            "steals": self.steals,
+            "placement_events": self.placement_events,
+            "capacity_samples": self.capacity_samples,
         }
         return snap
 
@@ -717,6 +895,13 @@ class FleetRouter:
             self.resubmitted = 0
             self.migrations = 0
             self.migration_failures = 0
+            self.steals = 0
+            # the placement-event counter resets WITH the placement
+            # counts (they reconcile 1:1 — the perf_report gate); the
+            # journal file keeps its warmup lines, each stamped, so
+            # the full history stays queryable
+            self.placement_events = 0
+            self._placement_tail.clear()
 
     def close(self, grace: float = 30.0) -> None:
         """Retire the fleet: stop the watch, close the wire, shut
@@ -728,6 +913,9 @@ class FleetRouter:
         if self._rebal is not None:
             self._rebal.join(timeout=5.0)
             self._rebal = None
+        if self._sampler is not None:
+            self._sampler.join(timeout=5.0)
+            self._sampler = None
         if self.http is not None:
             self.http.close()
             self.http = None
@@ -736,6 +924,408 @@ class FleetRouter:
                 p.close(grace=grace)
             except Exception:  # noqa: BLE001 - teardown best-effort
                 pass
+
+    # ------------------------------------------------------------------
+    # explainable placement: the append-only decision journal
+    # ------------------------------------------------------------------
+
+    def _append_jsonl(self, path: Optional[str], rec: dict) -> None:
+        """One atomic journal line (obs/ledger discipline: O_APPEND
+        single write — concurrent writers interleave whole lines, a
+        crash tears at most the tail the readers already skip).
+        Warn-and-continue: a failing journal never fails routing."""
+        if path is None:
+            return
+        try:
+            line = (json.dumps(rec) + "\n").encode()
+            fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                         0o644)
+            try:
+                os.write(fd, line)
+            finally:
+                os.close(fd)
+        except Exception as e:  # noqa: BLE001 - obs must not raise
+            if not self._journal_warned:
+                self._journal_warned = True
+                warnings.warn(
+                    f"fleet journal append to {path!r} failed "
+                    f"({type(e).__name__}: {e}); journaling degraded, "
+                    "routing continues", RuntimeWarning)
+
+    def _record_placement(self, reason: str, request, idx: int,
+                          explain: Optional[dict] = None) -> None:
+        """Record one placement decision (caller holds ``_lock``):
+        the ``placement_event`` schema — who, where, why, with the
+        full per-candidate score breakdown when the load leg decided.
+        Exactly one event per ``placements`` counter bump, so the
+        journal reconciles 1:1 with the router block (the
+        ``perf_report --check`` trace gate)."""
+        try:
+            explain = explain or {}
+            event = {
+                "schema": 1,
+                "kind": "placement",
+                "t": round(time.time(), 6),
+                "reason": reason,
+                "trace_id": getattr(request, "trace_id", None),
+                "job": getattr(request, "name", None),
+                "pool": getattr(self.pools[idx], "label", str(idx)),
+                "pool_idx": idx,
+                "placement": self.placement,
+                "won": explain.get("won"),
+                "candidates": explain.get("candidates") or [],
+            }
+            self.placement_events += 1
+            self._placement_tail.append(event)
+            self._append_jsonl(self._journal_path, event)
+        except Exception:  # noqa: BLE001 - obs must not raise
+            pass
+
+    def explain(self, job) -> List[dict]:
+        """Placement events for one job — "why did job J land on pool
+        K" as recorded evidence. ``job`` is a :class:`RoutedHandle`, a
+        trace id, or a request name. Reads the journal file when one
+        is armed (complete, survives counter resets), else the bounded
+        in-memory tail. Malformed/torn journal lines are skipped."""
+        if isinstance(job, RoutedHandle):
+            keys = {getattr(job.request, "trace_id", None),
+                    getattr(job.request, "name", None)} - {None}
+        else:
+            keys = {job}
+        events = []
+        if self._journal_path is not None \
+                and os.path.exists(self._journal_path):
+            try:
+                with open(self._journal_path) as fh:
+                    for line in fh:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            rec = json.loads(line)
+                        except json.JSONDecodeError:
+                            continue   # torn tail
+                        events.append(rec)
+            except OSError:
+                events = list(self._placement_tail)
+        else:
+            events = list(self._placement_tail)
+        return [e for e in events
+                if e.get("trace_id") in keys or e.get("job") in keys]
+
+    # ------------------------------------------------------------------
+    # the capacity timeline (bounded ring + JSONL series)
+    # ------------------------------------------------------------------
+
+    def _capacity_loop(self, poll_s: float) -> None:
+        while not self._stop.wait(poll_s):
+            try:
+                self.capacity_sample()
+            except Exception as e:  # noqa: BLE001 - obs must not raise
+                if not self._capacity_warned:
+                    self._capacity_warned = True
+                    warnings.warn(
+                        f"fleet capacity sampler failed "
+                        f"({type(e).__name__}: {e}); sampling "
+                        "continues best-effort", RuntimeWarning)
+
+    def capacity_sample(self, record: bool = True) -> dict:
+        """One fleet capacity sample (the ``capacity_sample`` schema):
+        per-pool queue/occupancy/watchdog health plus per-tenant slack
+        — ``remaining_sweeps - est_sweeps_to_target``, the "will it
+        finish inside its budget" signal a deadline scheduler or
+        autoscaler consumes (ROADMAP items 1d/5). ``record=True``
+        appends to the bounded ring (+ JSONL series when ``obs_dir``
+        is armed); ``record=False`` builds a throwaway sample (the
+        ``/metrics`` scrape path)."""
+        pools = []
+        tenants = []
+        for i, st in self._statuses():
+            label = getattr(self.pools[i], "label", str(i))
+            if not isinstance(st, dict):
+                pools.append({"pool": label, "reachable": False,
+                              "error": f"{type(st).__name__}: {st}"})
+                continue
+            wd = st.get("watchdog")
+            wd = wd if isinstance(wd, dict) else {}
+            beats = wd.get("heartbeat_age_s")
+            beats = beats if isinstance(beats, dict) else {}
+            ages = [v for v in beats.values()
+                    if isinstance(v, (int, float))]
+            faults = st.get("faults") or {}
+            tripped = wd.get("state") == "tripped"
+            pools.append({
+                "pool": label,
+                "reachable": True,
+                "queue_depth": st.get("queue_depth") or 0,
+                "staged": st.get("staged") or 0,
+                "occupancy_now": st.get("occupancy_now") or 0.0,
+                "busy_lanes": st.get("busy_lanes"),
+                "nlanes": st.get("nlanes"),
+                "free_groups": st.get("free_groups"),
+                "watchdog_state": wd.get("state"),
+                "heartbeat_age_max_s": (round(max(ages), 3)
+                                        if ages else None),
+                "healthy": (not faults.get("pool_failures")
+                            and not tripped),
+            })
+            for t in st.get("tenants") or []:
+                if not isinstance(t, dict):
+                    continue
+                rem = max((t.get("niter") or 0)
+                          - (t.get("sweeps_done") or 0), 0)
+                est = t.get("est_sweeps_to_target")
+                est = (float(est)
+                       if isinstance(est, (int, float))
+                       and not isinstance(est, bool) else None)
+                row = {"pool": label,
+                       "tenant": t.get("tenant_id"),
+                       "name": t.get("name"),
+                       "trace_id": t.get("trace_id"),
+                       "remaining_sweeps": rem,
+                       "est_sweeps_to_target": est}
+                if est is not None:
+                    # positive slack: expected to converge inside the
+                    # remaining budget (with margin); negative: the
+                    # budget will run out first
+                    row["slack_sweeps"] = round(rem - est, 3)
+                tenants.append(row)
+        sample = {
+            "schema": 1,
+            "kind": "capacity",
+            "t": round(time.time(), 3),
+            "pools": pools,
+            "tenants": tenants,
+            "router": {
+                "placements": sum(self.placements.values()),
+                "placement_events": self.placement_events,
+                "failovers": self.failovers,
+                "resubmitted": self.resubmitted,
+                "migrations": self.migrations,
+                "steals": self.steals,
+                "dead_pools": len(self._dead),
+            },
+        }
+        if record:
+            self._capacity_ring.append(sample)
+            self.capacity_samples += 1
+            self._append_jsonl(self._capacity_path, sample)
+        return sample
+
+    def capacity_timeline(self) -> List[dict]:
+        """Snapshot of the bounded sample ring, oldest first."""
+        return list(self._capacity_ring)
+
+    # ------------------------------------------------------------------
+    # fleet postmortem + metrics + the stitched trace
+    # ------------------------------------------------------------------
+
+    def fleet_postmortem(self, reason: str = "endpoint") -> dict:
+        """The fleet-level evidence bundle (the ``fleet_postmortem``
+        schema): router counters, the capacity timeline ring, the
+        placement-event tail, per-pool liveness. Dumped to
+        ``obs_dir/fleet_postmortem.json`` on every pool failure and
+        served live at ``GET /postmortem``."""
+        pools = []
+        for i, p in enumerate(self.pools):
+            try:
+                alive = bool(p.alive)
+            except Exception:  # noqa: BLE001
+                alive = False
+            pools.append({"pool": getattr(p, "label", str(i)),
+                          "alive": alive,
+                          "dead": i in self._dead})
+        return {
+            "schema": 1,
+            "kind": "fleet_postmortem",
+            "t": round(time.time(), 3),
+            "reason": reason,
+            "router": {
+                "placement": self.placement,
+                "placements": dict(self.placements),
+                "placement_events": self.placement_events,
+                "failovers": self.failovers,
+                "resubmitted": self.resubmitted,
+                "migrations": self.migrations,
+                "migration_failures": self.migration_failures,
+                "steals": self.steals,
+                "dead_pools": len(self._dead),
+            },
+            "pools": pools,
+            "capacity_samples": list(self._capacity_ring),
+            "placements_tail": list(self._placement_tail),
+        }
+
+    def _dump_fleet_postmortem(self, reason: str) -> None:
+        """Atomic postmortem write (warn-and-continue)."""
+        if self._postmortem_path is None:
+            return
+        try:
+            doc = self.fleet_postmortem(reason=reason)
+            tmp = self._postmortem_path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(doc, fh)
+            os.replace(tmp, self._postmortem_path)
+        except Exception as e:  # noqa: BLE001 - obs must not raise
+            warnings.warn(
+                f"fleet postmortem dump failed "
+                f"({type(e).__name__}: {e}); recovery continues",
+                RuntimeWarning)
+
+    def metrics_text(self) -> str:
+        """``GET /metrics``: the fleet in the Prometheus exposition
+        format (obs/export.py) — router counters plus per-pool
+        capacity gauges with ``pool=`` instance labels, from the
+        latest capacity sample (or a fresh unrecorded one when the
+        sampler is off)."""
+        from gibbs_student_t_tpu.obs.export import prometheus_labeled
+
+        sample = (self._capacity_ring[-1] if self._capacity_ring
+                  else self.capacity_sample(record=False))
+        with self._lock:
+            placements = dict(self.placements)
+            counters = {
+                "fleet_failovers": self.failovers,
+                "fleet_resubmitted": self.resubmitted,
+                "fleet_migrations": self.migrations,
+                "fleet_migration_failures": self.migration_failures,
+                "fleet_steals": self.steals,
+                "fleet_placement_events": self.placement_events,
+                "fleet_capacity_samples": self.capacity_samples,
+            }
+            dead = len(self._dead)
+        fam = {
+            "fleet_placements": {
+                "kind": "counter",
+                "help": "Tenants placed, per pool",
+                "samples": [({"pool": k}, v)
+                            for k, v in sorted(placements.items())],
+            },
+            "fleet_dead_pools": {
+                "kind": "gauge",
+                "help": "Pools currently dead awaiting recovery",
+                "samples": [({}, dead)],
+            },
+        }
+        helps = {
+            "fleet_failovers": "Dead-pool recoveries absorbed",
+            "fleet_resubmitted": "Unspooled victims replayed",
+            "fleet_migrations": "Live migrations landed",
+            "fleet_migration_failures": "Migrations that fell back",
+            "fleet_steals": "Rebalance queued-steals",
+            "fleet_placement_events": "Placement decisions journaled",
+            "fleet_capacity_samples": "Capacity timeline samples",
+        }
+        for name, v in counters.items():
+            fam[name] = {"kind": "counter", "help": helps.get(name),
+                         "samples": [({}, v)]}
+        gauges = {
+            "fleet_pool_queue_depth": ("queue_depth",
+                                       "Admission queue depth"),
+            "fleet_pool_staged": ("staged", "Staged tenants"),
+            "fleet_pool_occupancy_now": ("occupancy_now",
+                                         "Busy/pool lanes, now"),
+            "fleet_pool_busy_lanes": ("busy_lanes", "Busy lanes"),
+            "fleet_pool_healthy": ("healthy",
+                                   "1 = reachable, no pool failure, "
+                                   "watchdog untripped"),
+            "fleet_pool_heartbeat_age_max_s": (
+                "heartbeat_age_max_s",
+                "Max executor heartbeat age"),
+        }
+        for name, (key, help_) in gauges.items():
+            samples = []
+            for p in sample.get("pools") or []:
+                v = p.get(key)
+                if key == "healthy":
+                    v = 1 if (p.get("reachable") and v) else 0
+                if v is None:
+                    continue
+                samples.append(({"pool": p.get("pool")}, v))
+            if samples:
+                fam[name] = {"kind": "gauge", "help": help_,
+                             "samples": samples}
+        return prometheus_labeled(
+            fam, ts_ms=int(time.time() * 1e3))
+
+    def _pool_clock(self, pool, samples: int = 5) -> dict:
+        """The pool's clock offset estimate: NTP-style sampling over
+        the RPC ``time`` op for wire pools; in-process pools share our
+        clock (offset 0 by construction)."""
+        from gibbs_student_t_tpu.obs.aggregate import (
+            estimate_clock_offset,
+        )
+
+        cli = getattr(pool, "rpc", None)
+        if cli is None or not hasattr(cli, "server_time"):
+            return {"offset_s": 0.0, "rtt_s": 0.0, "n": 0}
+        obs = []
+        for _ in range(max(int(samples), 1)):
+            try:
+                obs.append(cli.server_time())
+            except Exception:  # noqa: BLE001 - degraded clock is data
+                break
+        return estimate_clock_offset(obs)
+
+    def export_trace(self, path: Optional[str] = None) -> dict:
+        """The stitched fleet trace (the ``fleet_trace`` schema):
+        fetch each pool's Chrome trace (HTTP ``/trace`` for wire
+        pools, the in-process doc for local ones), estimate each
+        pool's clock offset NTP-style over the RPC ``time`` op, and
+        merge pool swimlanes beside the router lane with offset-
+        corrected timestamps (obs/aggregate.py
+        ``stitch_fleet_trace``) — one correlated trace per job.
+        Served at the fleet HTTP port as ``GET /trace``; ``path``
+        additionally writes the doc atomically. Unreachable or
+        trace-less pools degrade to a note in
+        ``otherData.missing_pools``, never an error."""
+        from gibbs_student_t_tpu.obs.aggregate import (
+            read_trace,
+            stitch_fleet_trace,
+        )
+
+        if self.spans is not None:
+            router_doc = self.spans.chrome_trace_doc()
+        else:
+            router_doc = {"traceEvents": [], "displayTimeUnit": "ms",
+                          "otherData": {"dropped_spans": 0,
+                                        "epoch_wall": time.time()}}
+        pools = []
+        missing = []
+        for i, p in enumerate(self.pools):
+            label = getattr(p, "label", str(i))
+            doc = None
+            err = None
+            try:
+                if getattr(p, "status_url", None):
+                    doc = read_trace(p.status_url)
+                elif hasattr(getattr(p, "rpc", None), "trace"):
+                    # wire pool without an HTTP port: the RPC fallback
+                    doc = p.rpc.trace()
+                elif getattr(p, "server", None) is not None:
+                    doc = p.server._trace_doc()
+            except Exception as e:  # noqa: BLE001 - degraded, not fatal
+                err = f"{type(e).__name__}: {e}"
+            if not isinstance(doc, dict):
+                missing.append({"pool": label,
+                                "error": err or "no trace surface"})
+                continue
+            pools.append({"label": label, "doc": doc,
+                          "clock": self._pool_clock(p)})
+        doc = stitch_fleet_trace(router_doc, pools)
+        if missing:
+            doc["otherData"]["missing_pools"] = missing
+        if path:
+            try:
+                tmp = path + ".tmp"
+                with open(tmp, "w") as fh:
+                    json.dump(doc, fh)
+                os.replace(tmp, path)
+            except OSError as e:
+                warnings.warn(
+                    f"fleet trace export to {path!r} failed ({e}); "
+                    "the doc is still returned", RuntimeWarning)
+        return doc
 
     # ------------------------------------------------------------------
     # failover
@@ -772,12 +1362,17 @@ class FleetRouter:
         bitwise), rebind the victims' routed handles, and resubmit
         the unspooled victims from scratch to any healthy pool
         (request-replay determinism makes the re-run exact)."""
+        t_fo0 = time.monotonic()
         with self._lock:
             if idx in self._dead:
                 return
             self._dead.add(idx)
             routed = list(self._routed)
         old = self.pools[idx]
+        # the capacity timeline's whole point: the evidence stream is
+        # on disk BEFORE the recovery mutates fleet state
+        self._dump_fleet_postmortem(
+            reason=f"pool_failure:{old.label}")
         victims = [rh for rh in routed
                    if rh.pool_idx == idx and not self._finished(rh)]
         try:
@@ -802,14 +1397,29 @@ class FleetRouter:
                 rh._rebind(idx, new_pool.handle_for(tid, rh.request))
                 continue
             # unspooled: replay the request on any healthy pool
+            t_rs0 = time.monotonic()
             with self._lock:
-                tgt = self._place(rh.request)
+                explain: dict = {}
+                tgt = self._place(rh.request, explain=explain)
                 inner = self.pools[tgt].submit(rh.request)
                 label = self.pools[tgt].label
                 self.placements[label] = \
                     self.placements.get(label, 0) + 1
                 self.resubmitted += 1
+                self._record_placement("resubmit", rh.request, tgt,
+                                       explain)
             rh._rebind(tgt, inner)
+            if self.spans is not None:
+                self.spans.record(
+                    "resubmit", ROLE_ROUTER, t_rs0,
+                    time.monotonic() - t_rs0,
+                    trace_id=getattr(rh.request, "trace_id", None),
+                    job=rh.request.name, pool=label)
+        if self.spans is not None:
+            self.spans.record(
+                "failover", ROLE_ROUTER, t_fo0,
+                time.monotonic() - t_fo0, pool=old.label,
+                victims=len(victims))
 
     # ------------------------------------------------------------------
     # live migration (spool checkpoint -> cancel -> resume elsewhere)
@@ -840,10 +1450,20 @@ class FleetRouter:
                     or rh._migrating.is_set() or self._finished(rh)):
                 return False
             rh._migrating.set()
+        t_mig0 = time.monotonic()
+        ok = False
         try:
-            return self._migrate_inner(rh, src, to_idx, timeout)
+            ok = self._migrate_inner(rh, src, to_idx, timeout)
+            return ok
         finally:
             rh._migrating.clear()
+            if self.spans is not None:
+                self.spans.record(
+                    "migrate", ROLE_ROUTER, t_mig0,
+                    time.monotonic() - t_mig0,
+                    trace_id=getattr(rh.request, "trace_id", None),
+                    job=rh.request.name, src=src, dst=to_idx,
+                    landed=bool(ok))
 
     def _migrate_inner(self, rh: RoutedHandle, src: int, to_idx: int,
                        timeout: float) -> bool:
@@ -922,6 +1542,9 @@ class FleetRouter:
         with self._lock:
             label = self.pools[tgt].label
             self.placements[label] = self.placements.get(label, 0) + 1
+            self._record_placement("migrate", rh.request, tgt,
+                                   {"won": ("migrate" if tgt == to_idx
+                                            else "migrate_fallback")})
             if tgt == to_idx:
                 self.migrations += 1
             else:
@@ -990,7 +1613,19 @@ class FleetRouter:
             allow_running=self.rebalance_running and src_load > 1)
         if victim is None:
             return False
-        return self.migrate(victim, dst)
+        t_steal0 = time.monotonic()
+        stole = self.migrate(victim, dst)
+        if stole:
+            with self._lock:
+                self.steals += 1
+        if self.spans is not None:
+            self.spans.record(
+                "steal", ROLE_ROUTER, t_steal0,
+                time.monotonic() - t_steal0,
+                trace_id=getattr(victim.request, "trace_id", None),
+                job=victim.request.name, src=src, dst=dst,
+                landed=bool(stole))
+        return stole
 
     def _pick_victim(self, src: int, src_st: dict, dst_st: dict,
                      allow_running: bool = True

@@ -58,6 +58,7 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 import warnings
 from typing import Callable, Dict, Optional
 
@@ -252,7 +253,7 @@ def recv_frame(sock: socket.socket,
 #: TenantRequest fields that ride the wire as plain JSON values
 _REQ_SCALARS = ("niter", "nchains", "seed", "start_sweep", "spool_dir",
                 "name", "on_divergence", "on_converged",
-                "resume_spool")
+                "resume_spool", "trace_id")
 
 #: MonitorSpec fields (all JSON-able)
 _MON_FIELDS = ("params", "ess_target", "rhat_target", "every",
@@ -533,6 +534,23 @@ class RpcServer:
                               "healthz": self.server.healthz()},
                        self.max_frame)
             return True
+        if op == "time":
+            # NTP-style clock sampling (round 19): the worker's wall
+            # clock, read as late as possible — the fleet stitcher
+            # brackets this with local timestamps and corrects pool
+            # span timelines by the min-RTT offset
+            # (obs/aggregate.py ``estimate_clock_offset``)
+            send_frame(sock, {"op": "ok", "t": time.time()},
+                       self.max_frame)
+            return True
+        if op == "trace":
+            # the worker's Chrome trace document, over the wire — the
+            # fleet stitcher's fallback when a pool worker has no HTTP
+            # port (GET /trace is the cheap path when it does)
+            send_frame(sock, {"op": "ok",
+                              "trace": self.server._trace_doc()},
+                       self.max_frame)
+            return True
         if op == "shutdown":
             if self._on_shutdown is None:
                 send_frame(sock, {"op": "error",
@@ -689,13 +707,23 @@ class RemoteTenantHandle:
         # could observe the result before the last on_chunk fired
         self._streamed = streamed
 
+    def _body(self, op: str, **extra) -> dict:
+        """A control-frame body for this tenant; carries the job's
+        ``trace_id`` (when one was minted) so every
+        progress/cost/cancel/result frame is correlatable with the
+        fleet trace (round 19 — the server ignores unknown keys)."""
+        body = {"op": op, "tenant": self.tenant_id}
+        tid = getattr(self.request, "trace_id", None)
+        if tid is not None:
+            body["trace_id"] = tid
+        body.update(extra)
+        return body
+
     def progress(self) -> Dict[str, object]:
-        return self.client._call({"op": "progress",
-                                  "tenant": self.tenant_id})["progress"]
+        return self.client._call(self._body("progress"))["progress"]
 
     def cost(self) -> Dict[str, object]:
-        return self.client._call({"op": "cost",
-                                  "tenant": self.tenant_id})["cost"]
+        return self.client._call(self._body("cost"))["cost"]
 
     @property
     def status(self) -> str:
@@ -746,8 +774,7 @@ class RemoteTenantHandle:
                         f"tenant {self.tenant_id} stream not done")
             else:
                 body = self.client._call(
-                    {"op": "result", "tenant": self.tenant_id,
-                     "timeout": timeout},
+                    self._body("result", timeout=timeout),
                     sock_timeout=(None if timeout is None
                                   else timeout + 30.0))
                 self._resolve(body)
@@ -917,14 +944,29 @@ class RemoteChainServer:
                 pass
 
     def cancel(self, handle: RemoteTenantHandle) -> bool:
-        return bool(self._call({"op": "cancel",
-                                "tenant": handle.tenant_id})["cancelled"])
+        return bool(self._call(handle._body("cancel"))["cancelled"])
 
     def status(self) -> dict:
         return self._call({"op": "status"})["status"]
 
     def healthz(self) -> dict:
         return self._call({"op": "healthz"})["healthz"]
+
+    def server_time(self):
+        """One NTP-style clock sample against the remote worker:
+        ``(t0, ts, t1)`` — local wall time at send, the server's wall
+        time, local wall time at receive. A handful of these through
+        ``obs/aggregate.py estimate_clock_offset`` yields the pool's
+        clock offset (min-RTT sample) for fleet trace stitching."""
+        t0 = time.time()
+        ts = float(self._call({"op": "time"})["t"])
+        return (t0, ts, time.time())
+
+    def trace(self) -> Optional[dict]:
+        """The remote worker's Chrome trace document (None when the
+        worker runs with spans disabled) — the stitcher's RPC fallback
+        when the worker exposes no HTTP ``/trace``."""
+        return self._call({"op": "trace"})["trace"]
 
     def reset_counters(self) -> None:
         """Zero the remote pool's run-level aggregates (the bench

@@ -135,6 +135,14 @@ class TenantRequest:
     #: keeps the cold prior init; ``GST_WARM_START`` gates the arm
     #: globally (0 degrades every request to cold, pinned).
     warm_start: object = None
+    #: fleet trace-context propagation (round 19): an opaque
+    #: correlation id minted by the FleetRouter at submit and carried
+    #: on the RPC submit frame. The server tags every span it records
+    #: for this tenant with it, so router-side placement/failover/
+    #: migration spans and pool-side staging/dispatch/drain spans
+    #: stitch into one per-job trace (``FleetRouter.export_trace``).
+    #: Purely observational — never touches chain math (PR 1 rule).
+    trace_id: Optional[str] = None
 
 
 class TenantHandle:
@@ -328,6 +336,8 @@ class TenantHandle:
         }
         if self._monitor is not None:
             p.update(self._monitor.snapshot())
+        if self.request.trace_id is not None:
+            p["trace_id"] = self.request.trace_id
         p["cost"] = self.cost()
         if self.recycled_rows:
             p["recycled_rows"] = int(self.recycled_rows)

@@ -718,6 +718,10 @@ class ChainServer:
             handle = TenantHandle(self._next_id, request)
             self._next_id += 1
             self._handles[handle.tenant_id] = handle
+        if self.spans is not None:
+            # register the trace id at submit (not admit) so even the
+            # tenant's staging spans carry it (round 19)
+            self.spans.set_trace_id(handle.tenant_id, request.trace_id)
         self.queue.put(handle, timeout=timeout)
         if self.metrics is not None:
             self.metrics.gauge("serve_queue_depth").set(len(self.queue))
@@ -1051,6 +1055,11 @@ class ChainServer:
         handle.status = "running"
         handle._monitor = prep.monitor
         self._tenant_names[handle.tenant_id] = req.name
+        if self.spans is not None:
+            # fleet trace-context propagation (round 19): from here on
+            # every span this pool records for the tenant carries the
+            # router-minted correlation id
+            self.spans.set_trace_id(handle.tenant_id, req.trace_id)
         self._running[handle.tenant_id] = _Tenant(
             slot, handle, spool,
             backend=(prep.backend
@@ -2791,7 +2800,8 @@ class ChainServer:
                 spool_dir=rec["spool_dir"], name=rec.get("name"),
                 on_divergence=rec.get("on_divergence") or "none",
                 on_converged=rec.get("on_converged") or "none",
-                monitor=mon, warm_start=rec.get("warm")))
+                monitor=mon, warm_start=rec.get("warm"),
+                trace_id=rec.get("trace_id")))
         # the resubmissions above are journaled in the NEW epoch, so
         # everything before it is dead weight a future recovery would
         # re-parse (and the admissions carry pickled models) — compact

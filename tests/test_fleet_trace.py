@@ -1,0 +1,508 @@
+"""Fleet tracing, explainable placement and the capacity timeline
+(round 19, docs/OBSERVABILITY.md "Fleet tracing & the capacity
+timeline").
+
+Tier-1 arms run over fakes and canned documents — no pool compiles:
+
+- the NTP-style clock-offset estimator (skewed fake clocks,
+  asymmetric RTT error bound, min-RTT sample selection, degraded
+  inputs),
+- trace stitching over canned Chrome docs (pool pid striding, label
+  prefixes, offset-corrected cross-pool ordering, schema validity
+  against the ``fleet_trace`` schema),
+- the router observability plane over fake pools: trace-id minting,
+  the placement-event journal + ``explain()`` query, the capacity
+  sample/ring/sampler thread, the fleet Prometheus exposition, the
+  fleet postmortem bundle,
+- the watchdog fold in ``fleet_merge`` / ``render_fleet`` (a tripped
+  pool must render sick, not healthy),
+- the ``perf_report --check`` trace-completeness gate over canned
+  ledger records.
+
+The slow arm drives a real 2-pool subprocess fleet and pins the
+acceptance contract: one stitched, schema-valid doc in which every
+completed job has >=1 router span AND >=1 pool span sharing its
+``trace_id``, with a clock block per pool.
+"""
+
+import io
+import json
+import os
+import time
+
+import pytest
+
+from gibbs_student_t_tpu.obs import schema as obs_schema
+from gibbs_student_t_tpu.obs.aggregate import (
+    POOL_PID_STRIDE,
+    estimate_clock_offset,
+    fleet_merge,
+    render_fleet,
+    stitch_fleet_trace,
+    trace_coverage,
+)
+from gibbs_student_t_tpu.serve.scheduler import TenantRequest
+
+from tests.test_rpc import _FakePool, _router
+
+pytestmark = pytest.mark.fleet
+
+SCHEMAS = obs_schema.load_schemas()
+
+
+def _valid(doc, name, label):
+    obs_schema.assert_valid(doc, SCHEMAS[name], label, defs=SCHEMAS)
+
+
+# ---------------------------------------------------------------------------
+# the clock-offset estimator
+# ---------------------------------------------------------------------------
+
+def test_clock_offset_recovers_skewed_clock():
+    """Symmetric RTT, server clock 5s ahead: the estimator recovers
+    the skew exactly."""
+    t0 = 100.0
+    samples = []
+    for rtt in (0.010, 0.004, 0.020):
+        mid = t0 + rtt / 2.0
+        samples.append((t0, mid + 5.0, t0 + rtt))
+        t0 += 1.0
+    est = estimate_clock_offset(samples)
+    assert est["n"] == 3
+    assert est["offset_s"] == pytest.approx(5.0, abs=1e-6)
+    assert est["rtt_s"] == pytest.approx(0.004, abs=1e-6)
+
+
+def test_clock_offset_prefers_min_rtt_sample():
+    """The min-RTT sample wins: a high-RTT sample with a wildly wrong
+    midpoint estimate must not contaminate the answer."""
+    good = (10.0, 10.0005 + 2.0, 10.001)      # rtt 1ms, offset 2s
+    bad = (11.0, 11.25 + 3.7, 11.5)           # rtt 500ms, asymmetric
+    est = estimate_clock_offset([bad, good])
+    assert est["offset_s"] == pytest.approx(2.0, abs=1e-6)
+    assert est["rtt_s"] == pytest.approx(0.001, abs=1e-6)
+
+
+def test_clock_offset_asymmetric_rtt_error_is_bounded():
+    """Fully asymmetric path (all delay on the send leg): the
+    midpoint estimate is off by exactly rtt/2 — the estimator's
+    documented error bound."""
+    rtt = 0.030
+    # server reads its (true-synced) clock only after the full send
+    # delay: ts = t0 + rtt, reply returns instantly
+    est = estimate_clock_offset([(50.0, 50.0 + rtt, 50.0 + rtt)])
+    assert abs(est["offset_s"]) <= rtt / 2.0 + 1e-9
+    assert est["offset_s"] == pytest.approx(rtt / 2.0, abs=1e-6)
+
+
+def test_clock_offset_degrades_on_garbage():
+    """Empty or malformed samples (negative RTT, wrong arity, NaN-free
+    junk) degrade to the identity offset, never raise."""
+    assert estimate_clock_offset([]) == {
+        "offset_s": 0.0, "rtt_s": None, "n": 0}
+    est = estimate_clock_offset(
+        [(5.0, 4.0, 3.0), ("x",), None, (1.0,)])
+    assert est == {"offset_s": 0.0, "rtt_s": None, "n": 0}
+
+
+# ---------------------------------------------------------------------------
+# stitching canned docs
+# ---------------------------------------------------------------------------
+
+def _doc(events, epoch_wall, dropped=0):
+    return {"traceEvents": list(events), "displayTimeUnit": "ms",
+            "otherData": {"dropped_spans": dropped,
+                          "epoch_wall": epoch_wall}}
+
+
+def _xev(name, ts, pid=0, tid=0, **args):
+    return {"name": name, "ph": "X", "pid": pid, "tid": tid,
+            "ts": ts, "dur": 100.0, "args": args}
+
+
+def test_stitch_remaps_pids_and_labels_pools():
+    """Pool swimlanes land on their own pid stride beside the router
+    lane, metadata process names carry the pool label, and the doc
+    validates against the ``fleet_trace`` schema."""
+    router = _doc([
+        {"name": "process_name", "ph": "M", "pid": 0, "tid": 0,
+         "args": {"name": "serve"}},
+        _xev("place", 10.0, trace_id="t1"),
+    ], epoch_wall=1000.0)
+    pool = _doc([
+        {"name": "process_name", "ph": "M", "pid": 0, "tid": 0,
+         "args": {"name": "dispatch"}},
+        _xev("quantum", 20.0, pid=1, trace_id="t1"),
+    ], epoch_wall=1000.0)
+    doc = stitch_fleet_trace(router, [
+        {"label": "pool0", "doc": pool,
+         "clock": {"offset_s": 0.0, "rtt_s": 0.001, "n": 3}}])
+    _valid(doc, "fleet_trace", "stitched doc")
+    _valid(doc, "chrome_trace", "stitched doc (chrome shape)")
+    pids = {ev["pid"] for ev in doc["traceEvents"]}
+    assert pids == {0, POOL_PID_STRIDE, POOL_PID_STRIDE + 1}
+    names = {ev["args"]["name"] for ev in doc["traceEvents"]
+             if ev["name"] == "process_name"}
+    assert names == {"router", "pool0/dispatch"}
+    clocks = doc["otherData"]["clocks"]
+    assert clocks["pool0"]["offset_s"] == 0.0
+    assert doc["otherData"]["n_pools"] == 1
+
+
+def test_stitch_corrects_cross_pool_ordering():
+    """A pool whose wall clock runs 10s AHEAD: after offset
+    correction, a pool event that truly happened at router-epoch
+    +3s lands at ts=3e6 us — same timeline as a router event at
+    +3s, despite the skewed epochs."""
+    # true router epoch 1000.0; pool process started at true 1002.0,
+    # so its (skewed) epoch_wall reads 1012.0; a pool span at true
+    # 1003.0 has local ts (1013.0 - 1012.0) s = 1e6 us
+    router = _doc([_xev("submit", 3_000_000.0, trace_id="j")],
+                  epoch_wall=1000.0)
+    pool = _doc([_xev("quantum", 1_000_000.0, pid=0, trace_id="j")],
+                epoch_wall=1012.0)
+    doc = stitch_fleet_trace(router, [
+        {"label": "p", "doc": pool,
+         "clock": {"offset_s": 10.0, "rtt_s": 0.001, "n": 5}}])
+    evs = {ev["pid"]: ev for ev in doc["traceEvents"]
+           if ev["ph"] == "X"}
+    assert evs[0]["ts"] == pytest.approx(3_000_000.0)
+    assert evs[POOL_PID_STRIDE]["ts"] == pytest.approx(3_000_000.0)
+    shift = doc["otherData"]["clocks"]["p"]["shift_us"]
+    assert shift == pytest.approx(2_000_000.0)
+
+
+def test_trace_coverage_counts_both_sides():
+    router = _doc([_xev("place", 0.0, trace_id="a"),
+                   _xev("submit", 1.0, trace_id="a"),
+                   _xev("noise", 2.0)], epoch_wall=1.0)
+    pool = _doc([_xev("quantum", 0.0, pid=0, trace_id="a"),
+                 _xev("quantum", 5.0, pid=0, trace_id="b")],
+                epoch_wall=1.0)
+    doc = stitch_fleet_trace(router, [
+        {"label": "p", "doc": pool,
+         "clock": {"offset_s": 0.0, "rtt_s": 0.0, "n": 1}}])
+    cov = trace_coverage(doc)
+    assert cov["a"] == {"router": 2, "pool": 1}
+    assert cov["b"] == {"router": 0, "pool": 1}
+
+
+# ---------------------------------------------------------------------------
+# the router plane over fake pools
+# ---------------------------------------------------------------------------
+
+def test_router_mints_trace_ids_and_journals_placements(tmp_path):
+    """Submit through the router: every job gets a trace id (the
+    caller's request object untouched), exactly one schema-valid
+    placement event per placement lands in the journal, and
+    ``explain()`` answers per job."""
+    light = _FakePool("light", queue_depth=0, free_groups=3,
+                      occupancy=0.2)
+    heavy = _FakePool("heavy", queue_depth=5, free_groups=0,
+                      occupancy=0.9)
+    r = _router([heavy, light], obs_dir=str(tmp_path / "obs"))
+    reqs = [TenantRequest(ma={}, niter=5, nchains=4, name=f"job{i}")
+            for i in range(3)]
+    handles = [r.submit(rq) for rq in reqs]
+    assert all(rq.trace_id is None for rq in reqs)  # caller untouched
+    tids = [h.request.trace_id for h in handles]
+    assert all(tids) and len(set(tids)) == 3
+    # one event per placement, reconciling 1:1 with the counters
+    assert r.placement_events == sum(r.placements.values()) == 3
+    jpath = tmp_path / "obs" / "placements.jsonl"
+    events = [json.loads(l) for l in
+              jpath.read_text().splitlines()]
+    assert len(events) == 3
+    for ev in events:
+        _valid(ev, "placement_event", "journal event")
+        assert ev["reason"] == "submit" and ev["pool"] == "light"
+        assert ev["job"] in {"job0", "job1", "job2"}
+        assert ev["won"] == "score"
+        cands = {c["pool"]: c for c in ev["candidates"]}
+        assert set(cands) == {"light", "heavy"}
+        assert cands["heavy"]["score"]["queue_staged"] == 5
+    # explain() by handle and by trace id find the same event
+    ex = r.explain(handles[0])
+    assert len(ex) == 1 and ex[0]["trace_id"] == tids[0]
+    assert r.explain(tids[1])[0]["trace_id"] == tids[1]
+    # the tail answers too when no journal is armed
+    r2 = _router([_FakePool("only")])
+    h2 = r2.submit(reqs[0])
+    assert r2.explain(h2)[0]["won"] == "round_robin" or \
+        r2.explain(h2)[0]["won"] in ("score", "fallback")
+    r2.close()
+    r.close()
+
+
+def test_router_spans_share_trace_id_and_export_degrades(tmp_path):
+    """The router's own spans (place/submit/result) carry the job's
+    trace id; ``export_trace`` over fakes (no trace surface) degrades
+    to ``missing_pools`` notes and still returns a schema-valid doc."""
+    p = _FakePool("p0")
+    r = _router([p], obs_dir=str(tmp_path / "obs"))
+    h = r.submit(TenantRequest(ma={}, niter=5, nchains=4, name="jX"))
+    h._inner._finish({"ok": True})
+    assert h.result(timeout=5) == {"ok": True}
+    tid = h.request.trace_id
+    spans = r.spans.spans()
+    for s in spans:
+        _valid(s, "span", "router span")
+    named = {s["name"] for s in spans if s.get("trace_id") == tid}
+    assert {"place", "submit", "result"} <= named
+    assert all(s["role"] == "router" for s in spans
+               if s["name"] in ("place", "submit", "result"))
+    out = str(tmp_path / "fleet_trace.json")
+    doc = r.export_trace(path=out)
+    _valid(doc, "fleet_trace", "degraded fleet trace")
+    assert [m["pool"] for m in
+            doc["otherData"]["missing_pools"]] == ["p0"]
+    assert json.load(open(out)) == doc
+    cov = trace_coverage(doc)
+    assert cov[tid]["router"] >= 3 and cov[tid]["pool"] == 0
+    # trace=False: no recorder, no spans, submission identical
+    r2 = _router([_FakePool("p1")], trace=False)
+    h2 = r2.submit(TenantRequest(ma={}, niter=5, nchains=4,
+                                 name="jY"))
+    assert r2.spans is None and h2.request.trace_id
+    _valid(r2.export_trace(), "fleet_trace", "spanless fleet trace")
+    r2.close()
+    r.close()
+
+
+def test_capacity_sampler_ring_jsonl_and_metrics(tmp_path):
+    """The sampler thread fills the bounded ring + JSONL series with
+    schema-valid samples (watchdog health + per-tenant slack folded
+    in), the Prometheus exposition renders per-pool gauges exactly
+    once per family, and the postmortem bundle validates."""
+    p = _FakePool("p0")
+    orig_status = p.status
+
+    def status():
+        st = orig_status()
+        st["watchdog"] = {"state": "ok",
+                          "heartbeat_age_s": {"dispatch": 0.25}}
+        st["tenants"] = [{"tenant_id": 7, "name": "jZ",
+                          "trace_id": "abc123", "sweeps_done": 40,
+                          "niter": 100, "est_sweeps_to_target": 45.0}]
+        return st
+
+    p.status = status
+    r = _router([p], obs_dir=str(tmp_path / "obs"),
+                capacity_sample_s=0.02)
+    try:
+        deadline = time.monotonic() + 10.0
+        while r.capacity_samples < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert r.capacity_samples >= 2
+        ring = r.capacity_timeline()
+        assert ring and len(ring) <= 512
+        for s in ring:
+            _valid(s, "capacity_sample", "ring sample")
+        row = ring[-1]["pools"][0]
+        assert row["watchdog_state"] == "ok" and row["healthy"]
+        assert row["heartbeat_age_max_s"] == pytest.approx(0.25)
+        ten = ring[-1]["tenants"][0]
+        assert ten["trace_id"] == "abc123"
+        assert ten["remaining_sweeps"] == 60
+        assert ten["slack_sweeps"] == pytest.approx(15.0)
+        lines = (tmp_path / "obs" / "capacity.jsonl").read_text()
+        for line in lines.splitlines():
+            _valid(json.loads(line), "capacity_sample",
+                   "jsonl sample")
+        text = r.metrics_text()
+        assert text.count("# TYPE gst_fleet_placements counter") == 1
+        assert 'gst_fleet_pool_queue_depth{pool="p0"}' in text
+        assert 'gst_fleet_pool_healthy{pool="p0"} 1.0' in text
+        assert "# TYPE gst_fleet_capacity_samples counter" in text
+        pm = r.fleet_postmortem()
+        _valid(pm, "fleet_postmortem", "fleet postmortem")
+        assert pm["pools"][0]["pool"] == "p0"
+    finally:
+        r.close()
+    # the sampler thread is joined by close()
+    assert not any(t.name == "gst-fleet-capacity"
+                   for t in __import__("threading").enumerate())
+
+
+def test_fleet_merge_folds_watchdog_state():
+    """A pool answering healthz 200 but with a TRIPPED watchdog must
+    not render healthy: the fleet row folds the watchdog state and
+    heartbeat ages, and ``render_fleet`` shows the trip + cause."""
+    def st(state, cause=None, beat=0.1):
+        s = {"schema": 1, "queue_depth": 0, "staged": 0,
+             "free_groups": 2, "group": 16, "occupancy_now": 0.5,
+             "nlanes": 64, "busy_lanes": 32, "faults": {},
+             "slo": {"admission_ms": None},
+             "slo_raw": {"admission_ms": []}, "tenants": [],
+             "watchdog": {"state": state,
+                          "trip": ({"cause": cause} if cause
+                                   else None),
+                          "heartbeat_age_s": {"dispatch": beat}}}
+        return s
+
+    snap = fleet_merge([("good", st("ok")),
+                        ("stuck", st("tripped", cause="dispatch_stall",
+                                     beat=42.0))])
+    _valid(snap, "fleet_status", "fleet snapshot")
+    rows = {p["source"]: p for p in snap["pools"]}
+    assert rows["good"]["healthy"] is True
+    assert rows["good"]["watchdog_state"] == "ok"
+    assert rows["stuck"]["healthy"] is False
+    assert rows["stuck"]["watchdog_state"] == "tripped"
+    assert rows["stuck"]["watchdog_cause"] == "dispatch_stall"
+    assert rows["stuck"]["heartbeat_age_max_s"] == pytest.approx(42.0)
+    out = io.StringIO()
+    render_fleet(snap, out)
+    text = out.getvalue()
+    assert "TRIP" in text and "wd:dispatch_stall" in text
+    # the healthy pool renders ok, not tripped
+    good_line = next(l for l in text.splitlines()
+                     if l.strip().startswith("good"))
+    assert "TRIP" not in good_line
+
+
+# ---------------------------------------------------------------------------
+# the perf_report trace-completeness gate
+# ---------------------------------------------------------------------------
+
+def _perf_report():
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "perf_report", os.path.join(repo, "tools", "perf_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _fleet_rec(trace):
+    return {"tool": "fleet_bench", "metrics": {
+        "metric": "fleet_aggregate_chain_sweeps_per_s",
+        "value": 100.0, "trace": trace}}
+
+
+def test_perf_report_fleet_gate_watchdog_trip_vs_pool_failure(capsys):
+    """The round-16 outright-fail leg means POOL FAILURES: a round-19
+    record whose pool tripped its watchdog (timesharing collapse on a
+    1-core bench host) notes the trip and passes; a counted pool
+    failure still fails; legacy records keep the healthy proxy."""
+    pr = _perf_report()
+
+    def rec(pool):
+        return [{"tool": "fleet_bench", "metrics": {
+            "value": 100.0, "fleet_ratio": None,
+            "pools_detail": [dict({"source": "pool0",
+                                   "reachable": True}, **pool)]}}]
+
+    tripped = rec({"healthy": False, "pool_failures": 0,
+                   "watchdog_state": "tripped",
+                   "watchdog_cause": "throughput_collapse"})
+    assert pr.check_fleet(tripped, 3.5, 1e9) == 0
+    assert pr.check_fleet(rec({"healthy": False,
+                               "pool_failures": 1}), 3.5, 1e9) == 2
+    # legacy record: no pool_failures key, healthy False IS the proxy
+    assert pr.check_fleet(rec({"healthy": False}), 3.5, 1e9) == 2
+    out = capsys.readouterr().out
+    assert "watchdog" in out and "pool_failures counted" in out
+
+
+def test_perf_report_fleet_trace_gate(capsys):
+    pr = _perf_report()
+    good = {"jobs": 4, "jobs_traced_end_to_end": 4,
+            "schema_valid": True, "schema_errors": [],
+            "placement_events": 5, "placements_total": 5,
+            "capacity_samples": 7}
+    assert pr.check_fleet_trace([_fleet_rec(good)]) == 0
+    # records that predate the evidence skip, not fail
+    assert pr.check_fleet_trace(
+        [{"tool": "fleet_bench", "metrics": {}}]) == 0
+    assert pr.check_fleet_trace([]) == 0
+    # an untraced job fails
+    assert pr.check_fleet_trace([_fleet_rec(
+        dict(good, jobs_traced_end_to_end=3))]) == 2
+    # schema drift fails
+    assert pr.check_fleet_trace([_fleet_rec(
+        dict(good, schema_valid=False,
+             schema_errors=["$.x: boom"]))]) == 2
+    # a placement without its journal event fails the reconciliation
+    assert pr.check_fleet_trace([_fleet_rec(
+        dict(good, placement_events=4))]) == 2
+    # a dead sampler fails
+    assert pr.check_fleet_trace([_fleet_rec(
+        dict(good, capacity_samples=0))]) == 2
+    # evidence collection errors fail loudly
+    assert pr.check_fleet_trace(
+        [_fleet_rec({"error": "RuntimeError: x"})]) == 2
+    out = capsys.readouterr().out
+    assert "fleet trace" in out and "FAIL" in out
+
+
+# ---------------------------------------------------------------------------
+# the slow arm: a real 2-pool subprocess fleet, stitched end to end
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_two_pool_subprocess_fleet_stitches_end_to_end(tmp_path):
+    """The acceptance pin: drive a real 2-pool subprocess fleet,
+    export ONE stitched Chrome trace, and require that it is
+    schema-valid, that every completed job has >=1 router span and
+    >=1 pool span sharing its trace id, that each pool contributed a
+    clock block, and that the placement journal reconciles with the
+    router's counters."""
+    from tests.conftest import make_demo_pta
+    from gibbs_student_t_tpu.config import GibbsConfig
+    from gibbs_student_t_tpu.serve.router import (
+        spawn_fleet,
+        teardown_fleet,
+    )
+
+    pta = make_demo_pta()
+    ma = pta.frozen(0)
+    cfg = GibbsConfig(model="mixture")
+    obs = str(tmp_path / "router_obs")
+    fleet = spawn_fleet(str(tmp_path / "fleet"), 2, ma, cfg,
+                        pool_kwargs=dict(nlanes=32, quantum=5),
+                        placement="round_robin", obs_dir=obs,
+                        capacity_sample_s=0.25)
+    try:
+        handles = [fleet.submit(TenantRequest(
+            ma=ma, niter=10, nchains=16, seed=s, name=f"job{s}"))
+            for s in range(4)]
+        for h in handles:
+            h.result(timeout=600)
+        doc = fleet.export_trace(
+            path=str(tmp_path / "fleet_trace.json"))
+        _valid(doc, "fleet_trace", "stitched 2-pool trace")
+        assert not (doc["otherData"].get("missing_pools"))
+        clocks = doc["otherData"]["clocks"]
+        assert set(clocks) == {"pool0", "pool1"}
+        for c in clocks.values():
+            # in-flight RPC sampling really happened (subprocess
+            # pools answer the time op; offsets are sub-second on
+            # one host)
+            assert c["n"] >= 1 and abs(c["offset_s"]) < 1.0
+        cov = trace_coverage(doc)
+        for h in handles:
+            tid = h.request.trace_id
+            assert cov[tid]["router"] >= 1, tid
+            assert cov[tid]["pool"] >= 1, tid
+        # both pools contributed swimlanes
+        pool_pids = {ev["pid"] // POOL_PID_STRIDE
+                     for ev in doc["traceEvents"]
+                     if ev["pid"] >= POOL_PID_STRIDE}
+        assert pool_pids == {1, 2}
+        # the journal reconciles 1:1 with the router counters
+        snap = fleet.fleet_status()
+        assert snap["router"]["placement_events"] == \
+            sum(snap["router"]["placements"].values()) == 4
+        events = [json.loads(l) for l in open(
+            os.path.join(obs, "placements.jsonl"))]
+        assert len(events) == 4
+        assert {e["trace_id"] for e in events} == \
+            {h.request.trace_id for h in handles}
+        assert fleet.capacity_samples >= 1
+        for s in fleet.capacity_timeline():
+            _valid(s, "capacity_sample", "live capacity sample")
+    finally:
+        teardown_fleet(fleet, remove_dirs=True)

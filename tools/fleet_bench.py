@@ -332,6 +332,57 @@ def _migrate_arm(args, template, model_for, cfg, pool_kwargs, base,
     return line
 
 
+def _trace_evidence(fleet, snap, path, job_names):
+    """Export the stitched fleet trace and distill the round-19
+    ``perf_report --check`` gate evidence: every completed job traced
+    end-to-end (>=1 router span AND >=1 pool span sharing its
+    trace_id), the stitched doc schema-valid against ``fleet_trace``,
+    and the placement journal reconciling 1:1 with the router's
+    placement counters. Non-fatal: any failure degrades to an
+    ``error`` marker in the record (the PR 1 rule)."""
+    try:
+        from gibbs_student_t_tpu.obs import schema as _schema
+        from gibbs_student_t_tpu.obs.aggregate import trace_coverage
+
+        doc = fleet.export_trace(path=path)
+        cov = trace_coverage(doc)
+        jobs = set(job_names)
+        # router "submit" spans carry args.job -> map job to trace_id
+        job_tid = {}
+        for ev in doc.get("traceEvents") or ():
+            a = ev.get("args") or {}
+            if (ev.get("ph") == "X" and a.get("job") in jobs
+                    and a.get("trace_id")):
+                job_tid.setdefault(a["job"], str(a["trace_id"]))
+        end_to_end = sum(
+            1 for j in jobs
+            if (c := cov.get(job_tid.get(j))) is not None
+            and c["router"] >= 1 and c["pool"] >= 1)
+        try:
+            defs = _schema.load_schemas()
+            errs = _schema.validate(doc, defs["fleet_trace"],
+                                    defs=defs)
+        except Exception as e:  # noqa: BLE001
+            errs = [f"schema load/validate failed: {e}"]
+        router = (snap.get("router") or {})
+        return {
+            "jobs": len(jobs),
+            "jobs_traced_end_to_end": end_to_end,
+            "trace_ids": len(cov),
+            "schema_valid": not errs,
+            "schema_errors": errs[:5],
+            "placement_events": router.get("placement_events"),
+            "placements_total": sum(
+                (router.get("placements") or {}).values()),
+            "capacity_samples": router.get("capacity_samples"),
+            "missing_pools": len((doc.get("otherData") or {})
+                                 .get("missing_pools") or ()),
+            "path": path,
+        }
+    except Exception as e:  # noqa: BLE001 - evidence, not the bench
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _emit_final_line(line: dict) -> None:
     """bench.py emission hardening: the metric line is the final
     combined-stream line, stderr parked after it."""
@@ -478,8 +529,12 @@ def main(argv=None):
         import threading
 
         fdir = os.path.join(base, tag)
+        # round 19: arm the router observability plane — placement
+        # journal + capacity sampler under the pool dir, spans on
         fleet = spawn_fleet(fdir, n_pools, template, cfg,
-                            pool_kwargs=pool_kwargs)
+                            pool_kwargs=pool_kwargs,
+                            obs_dir=os.path.join(fdir, "router_obs"),
+                            capacity_sample_s=0.5)
         try:
             # warmup: one tiny tenant per pool, round-robin spread
             fleet.placement = "round_robin"
@@ -528,25 +583,28 @@ def main(argv=None):
                     f"{len(errs)} job(s) failed in the {tag} arm: "
                     f"job{errs[0][0]}: {errs[0][1]}")
             snap = fleet.fleet_status()
+            trace_ev = _trace_evidence(
+                fleet, snap, os.path.join(fdir, "fleet_trace.json"),
+                [f"job{i}" for i in served])
             agg = sum(chains_each * budgets[i] for i in served) / wall
             print(f"# {tag}: {agg:.1f} aggregate chain-sweeps/s over "
                   f"{n_pools} pool(s) in {wall:.1f}s "
                   f"({len(served)} jobs, concurrency {args.tenants}); "
                   f"placements {snap['router']['placements']}",
                   file=sys.stderr)
-            return agg, snap, wall
+            return agg, snap, wall, trace_ev
         finally:
             teardown_fleet(fleet, remove_dirs=False)
 
     single_pair = None
     single_sps = None
     if not args.no_single:
-        s_pre, _, _ = run_fleet(1, "single_pre")
+        s_pre, _, _, _ = run_fleet(1, "single_pre")
 
-    fleet_sps, fleet_snap, fleet_wall = run_fleet(args.pools, "fleet")
+    fleet_sps, fleet_snap, fleet_wall, fleet_trace_ev = run_fleet(args.pools, "fleet")
 
     if not args.no_single:
-        s_post, _, _ = run_fleet(1, "single_post")
+        s_post, _, _, _ = run_fleet(1, "single_post")
         single_pair = (s_pre, s_post)
         single_sps = (s_pre + s_post) / 2.0
         print(f"# single-pool baseline (drift-corrected mean): "
@@ -564,9 +622,13 @@ def main(argv=None):
     adm = slo.get("admission_ms") or {}
     router = fleet_snap.get("router") or {}
     pools_block = [
-        {k: p.get(k) for k in ("source", "reachable", "healthy",
-                               "nlanes", "occupancy", "queue_depth",
-                               "running_tenants")}
+        dict({k: p.get(k) for k in ("source", "reachable", "healthy",
+                                    "nlanes", "occupancy",
+                                    "queue_depth", "running_tenants",
+                                    "watchdog_state",
+                                    "watchdog_cause")},
+             pool_failures=(p.get("faults") or {})
+             .get("pool_failures", 0))
         for p in fleet_snap.get("pools") or []]
     line = {
         "metric": "fleet_aggregate_chain_sweeps_per_s",
@@ -597,7 +659,10 @@ def main(argv=None):
             "placements": router.get("placements"),
             "failovers": router.get("failovers", 0),
             "resubmitted": router.get("resubmitted", 0),
+            "placement_events": router.get("placement_events"),
+            "capacity_samples": router.get("capacity_samples"),
         },
+        "trace": fleet_trace_ev,
         "pools_detail": pools_block,
         "quick": bool(args.quick),
         "platform": "cpu",

@@ -291,17 +291,34 @@ def print_report(ledger_recs, include_rounds=True):
             print(f"    router placement={r.get('placement')} "
                   f"[{placed}] failovers={r.get('failovers')} "
                   f"resubmitted={r.get('resubmitted')}")
+            # round-19 observability sub-lines: trace completeness +
+            # placement journal + capacity timeline evidence
+            tr = m.get("trace")
+            if isinstance(tr, dict) and tr.get("error"):
+                print(f"    trace evidence FAILED: {tr['error']}")
+            elif isinstance(tr, dict):
+                print(f"    trace {tr.get('jobs_traced_end_to_end')}"
+                      f"/{tr.get('jobs')} jobs end-to-end "
+                      f"schema_valid={tr.get('schema_valid')} "
+                      f"placement_events={tr.get('placement_events')}"
+                      f"/{tr.get('placements_total')} "
+                      f"capacity_samples={tr.get('capacity_samples')}")
             for p in m.get("pools_detail") or []:
                 if not p.get("reachable"):
                     print(f"    pool {str(p.get('source')):12s} DOWN "
                           f"{p.get('error')}")
                     continue
                 occ = p.get("occupancy")
+                wd = p.get("watchdog_state")
                 print(f"    pool {str(p.get('source')):12s} "
                       f"{'ok' if p.get('healthy') else 'SICK':>4} "
                       f"lanes={p.get('nlanes')} "
                       f"occupancy={occ if occ is not None else '?'} "
-                      f"queue={p.get('queue_depth')}")
+                      f"queue={p.get('queue_depth')}"
+                      + (f" wd={wd}"
+                         + (f"({p.get('watchdog_cause')})"
+                            if p.get('watchdog_cause') else "")
+                         if wd and wd != "ok" else ""))
         elif rec.get("tool") == "coldstart":
             # cold-start record: warm spawn->first-result is the
             # headline; cold/recover walls + fresh-decision counters
@@ -876,16 +893,95 @@ def check_fleet(ledger_recs, min_fleet_ratio, max_admission_p99):
                   "others idle)")
             return 2
     for p in m.get("pools_detail") or []:
-        if p.get("reachable") and p.get("healthy") is False:
+        if not p.get("reachable"):
+            continue
+        pf = p.get("pool_failures")
+        if pf is None:
+            # legacy record (pre round 19): ``healthy`` was exactly
+            # the pool_failures proxy
+            pf = 1 if p.get("healthy") is False else 0
+        if pf:
             print(f"check: FAIL — pool {p.get('source')!r} finished "
                   "the fleet arm unhealthy (pool_failures counted)")
             return 2
+        if p.get("watchdog_state") == "tripped":
+            # recorded loudly, not failed: on 1-core bench hosts the
+            # throughput-collapse detector fires from pools
+            # timesharing one core (the stall arms are pinned in
+            # tier-1); a genuine stall also collapses the headline
+            # value and admission p99, which gate above
+            print(f"check: note — pool {p.get('source')!r} watchdog "
+                  f"tripped during the fleet arm "
+                  f"(cause {p.get('watchdog_cause') or '?'}); "
+                  "serving continued (healthz said so live)")
     r = m.get("router") or {}
     if r.get("failovers"):
         print(f"check: note — {r['failovers']} failover(s) during the "
               "fleet arm (recovered; throughput already reflects the "
               "recovery cost)")
     return 0
+
+
+def check_fleet_trace(ledger_recs):
+    """Trace-completeness gate over the latest ``fleet_bench`` record
+    (round 19): the stitched fleet trace must be schema-valid
+    (``fleet_trace``), every completed job must be traced END TO END
+    (>=1 router span and >=1 pool span sharing its trace_id — a
+    placement you cannot correlate across the wire is a trace context
+    dropped somewhere), the placement journal must reconcile 1:1 with
+    the router's placement counters (every placement explainable),
+    and the capacity sampler must have produced at least one sample.
+    Skipped with a note for records that predate the evidence."""
+    fleet = [r for r in ledger_recs if r.get("tool") == "fleet_bench"]
+    if not fleet:
+        print("check: no fleet_bench record — fleet trace gate "
+              "skipped")
+        return 0
+    m = fleet[-1].get("metrics") or {}
+    tr = m.get("trace")
+    if not isinstance(tr, dict):
+        print("check: latest fleet_bench record predates the trace "
+              "evidence — fleet trace gate skipped")
+        return 0
+    if tr.get("error"):
+        print("check: FAIL — fleet trace evidence collection failed "
+              f"({tr['error']})")
+        return 2
+    jobs = tr.get("jobs")
+    traced = tr.get("jobs_traced_end_to_end")
+    print(f"check: fleet trace {traced}/{jobs} jobs end-to-end, "
+          f"schema_valid={tr.get('schema_valid')}, placement_events="
+          f"{tr.get('placement_events')} vs placements="
+          f"{tr.get('placements_total')}, capacity_samples="
+          f"{tr.get('capacity_samples')}")
+    rc = 0
+    if not isinstance(jobs, int) or not isinstance(traced, int) \
+            or traced < jobs:
+        print("check: FAIL — not every completed job has >=1 router "
+              "span AND >=1 pool span sharing its trace_id (trace "
+              "context is being dropped on the wire or a pool served "
+              "with spans off)")
+        rc = 2
+    if not tr.get("schema_valid"):
+        errs = tr.get("schema_errors") or ["?"]
+        print("check: FAIL — stitched fleet trace is not schema-valid "
+              f"(first: {errs[0]})")
+        rc = 2
+    pe = tr.get("placement_events")
+    pt = tr.get("placements_total")
+    if not isinstance(pe, int) or pe != pt:
+        print("check: FAIL — placement journal does not reconcile "
+              f"with the router placements block ({pe!r} events vs "
+              f"{pt!r} placements; every placement must record "
+              "exactly one explainable event)")
+        rc = 2
+    cs = tr.get("capacity_samples")
+    if not isinstance(cs, int) or cs < 1:
+        print("check: FAIL — the capacity sampler recorded no "
+              f"samples during the fleet arm ({cs!r}); the timeline "
+              "thread is not running")
+        rc = 2
+    return rc
 
 
 def check_coldstart(ledger_recs, max_coldstart_ms,
@@ -1161,6 +1257,7 @@ def main(argv=None):
                                  args.min_fault_ratio)
         rc_fleet = check_fleet(recs, args.min_fleet_ratio,
                                args.max_fleet_admission_p99)
+        rc_fleet_trace = check_fleet_trace(recs)
         rc_ess = check_ess_per_core(recs, args.min_ess_per_core_s)
         rc_cold = check_coldstart(recs, args.max_coldstart_ms,
                                   args.min_coldstart_speedup)
@@ -1169,7 +1266,8 @@ def main(argv=None):
                                window=args.trend_window,
                                points=args.trend_points)
         return (rc or rc_serve or rc_obs or rc_faults or rc_fleet
-                or rc_ess or rc_cold or rc_mig or rc_trend)
+                or rc_fleet_trace or rc_ess or rc_cold or rc_mig
+                or rc_trend)
     return 0
 
 
