@@ -283,6 +283,18 @@ _RECORD_FIELDS = ("x", "b", "z", "theta", "alpha", "df", "pout",
 RECYCLE_EARLY_FIELDS = ("x", "b", "acc_white", "acc_hyper")
 RECYCLE_LATE_FIELDS = ("z", "theta", "alpha", "df", "pout")
 
+# Adaptive block scans (serve/adapt.py; arXiv:1808.09047): indices
+# into ``_sweep``'s per-lane block-enable operand, one per conditional
+# block in the systematic-scan order above. The b-draw's effective
+# gate is tied to the hyper gate (``BLOCK_HYPER & BLOCK_B``) on every
+# path: the fused megastage draws b jointly with — and conditioned
+# on — the proposed hyper x, so a kept b under a discarded x would
+# condition on a value the chain never took.
+BLOCK_WHITE, BLOCK_HYPER, BLOCK_B = 0, 1, 2
+BLOCK_THETA, BLOCK_Z, BLOCK_ALPHA, BLOCK_DF = 3, 4, 5, 6
+NBLOCKS = 7
+BLOCK_NAMES = ("white", "hyper", "b", "theta", "z", "alpha", "df")
+
 # record="compact": device->host transport dtypes for the bulky recorded
 # fields. z is exactly 0/1 so it is bit-packed (8 indicators per byte,
 # lossless — unpacked bit-exactly on host); pout is a probability
@@ -1264,20 +1276,32 @@ class JaxGibbs(SamplerBackend):
         return nv if mask is None else jnp.where(mask, nv, 1.0)
 
     def _sweep(self, state: ChainState, key, ma: ModelArrays | None = None,
-               sweep=None, fused: FusedConsts | None = None) -> ChainState:
+               sweep=None, fused: FusedConsts | None = None,
+               block_gates=None) -> ChainState:
         """One full Gibbs sweep. ``ma`` defaults to the backend's frozen
         model (embedded as constants); the ensemble path passes a traced
         per-pulsar ModelArrays pytree instead (parallel/ensemble.py),
         optionally with ``fused`` — that pulsar's fused-MH constant
         arrays — so the traced model still reaches the fused kernels.
         ``sweep`` is the (traced) sweep index, needed only when MH
-        adaptation is enabled (MHConfig.adapt_until)."""
+        adaptation is enabled (MHConfig.adapt_until).
+
+        ``block_gates`` (adaptive block scans, serve/adapt.py;
+        arXiv:1808.09047) is an optional traced ``(NBLOCKS,)`` 0/1
+        vector enabling each conditional block this sweep: a gated-off
+        block's draw is computed and DISCARDED (its state field carries
+        over and every downstream conditional sees the carried value),
+        which keeps the sweep a valid random-scan composition of Gibbs
+        moves while the RNG key schedule stays fixed. ``None`` — every
+        non-adaptive caller — emits the pre-adaptive graph verbatim
+        (the gates-off bitwise pin)."""
         keys = random.split(key, 7)
         # block_span: trace-time XLA op naming (obs/tracing.py) so a
         # --trace-dir capture attributes device time per Gibbs block;
         # zero runtime cost (HLO metadata only)
         with block_span("gibbs/white_mh"):
-            x, acc_w, nvec = self._sweep_white(state, keys[0], ma, fused)
+            x, acc_w, nvec = self._sweep_white(state, keys[0], ma, fused,
+                                               block_gates=block_gates)
         ma_r, _, bs, _ = self._resolve(ma)
         # per-sweep inner products (reference gibbs.py:302-304), via the
         # fused dense/blocked reduction (ops/tnt.py). The serve slot
@@ -1293,10 +1317,11 @@ class JaxGibbs(SamplerBackend):
                 TNT, d, const_white = tnt_products(ma_r.T, ma_r.y, nvec,
                                                    bs)
         return self._sweep_rest(state, x, acc_w, TNT, d, const_white,
-                                keys[1:], ma, sweep, fused)
+                                keys[1:], ma, sweep, fused,
+                                block_gates=block_gates)
 
     def _sweep_white(self, state: ChainState, kw, ma: ModelArrays | None,
-                     fused: FusedConsts | None = None):
+                     fused: FusedConsts | None = None, block_gates=None):
         """Sweep stage 1: the white-noise MH block
         (reference gibbs.py:114-143). Returns the updated parameter
         vector, the block acceptance rate, and the post-block ``nvec``.
@@ -1379,11 +1404,21 @@ class JaxGibbs(SamplerBackend):
                                  cov_chol=cov_w, lnprior_fn=lnp)
         else:
             acc_w = jnp.zeros((), dtype=self.dtype)
+        if block_gates is not None:
+            # adaptive scan: a thinned white block keeps the carried x
+            # (the draw above is computed and discarded — key schedule
+            # untouched); nvec below is then rebuilt from the CARRIED
+            # x, so the TNT reduction and every later conditional see
+            # a consistent state
+            g_w = block_gates[BLOCK_WHITE].astype(bool)
+            x = jnp.where(g_w, x, state.x)
+            acc_w = jnp.where(g_w, acc_w, jnp.zeros((), acc_w.dtype))
         return x, acc_w, self._masked_nvec(ma, mask, x, az)
 
     def _sweep_rest(self, state: ChainState, x, acc_w, TNT, d, const_white,
                     keys, ma: ModelArrays | None, sweep=None,
-                    fused: FusedConsts | None = None) -> ChainState:
+                    fused: FusedConsts | None = None,
+                    block_gates=None) -> ChainState:
         """Sweep stages 2-7: everything conditioned on the TNT/d inner
         products (hyper MH, coefficient draw, theta/z/alpha/df)."""
         ma_in = ma
@@ -1393,6 +1428,10 @@ class JaxGibbs(SamplerBackend):
         kh, kb, kt, kz, ka, kd = keys
         b, z, alpha, theta, df = (state.b, state.z, state.alpha,
                                   state.theta, state.df)
+        # adaptive block scans: x as it entered this stage (the white
+        # block's — possibly carried — output); the hyper gate selects
+        # back to it so downstream conditionals see the carried value
+        x_in = x
 
         # --- hyper MH block on the marginalized likelihood -------------
         # (reference gibbs.py:80-111, 288-329)
@@ -1571,6 +1610,16 @@ class JaxGibbs(SamplerBackend):
         elif not fuse:
             acc_h = jnp.zeros((), dtype=self.dtype)
 
+        if block_gates is not None:
+            g_h = block_gates[BLOCK_HYPER].astype(bool)
+            x = jnp.where(g_h, x, x_in)
+            acc_h = jnp.where(g_h, acc_h, jnp.zeros((), acc_h.dtype))
+            if fuse:
+                # the megastage drew b jointly with the (possibly
+                # discarded) hyper proposal — b's gate ties to hyper's
+                b = jnp.where(g_h & block_gates[BLOCK_B].astype(bool),
+                              b, state.b)
+
         # --- coefficient draw b ~ N(Sigma^-1 d, Sigma^-1) --------------
         # (reference gibbs.py:145-182; always-redraw, see numpy_backend).
         # The draw cannot MH-reject, so it uses the escalating-jitter
@@ -1623,6 +1672,13 @@ class JaxGibbs(SamplerBackend):
                         Sigma, d, xi,
                         jitters=(cfg.jitter, 1e-4, 1e-2, 1e-1))
                     b = y * isd
+            if block_gates is not None:
+                # tied to the hyper gate (see BLOCK_B) so both b paths
+                # thin identically — the law cannot depend on which
+                # lowering a lane took
+                b = jnp.where(block_gates[BLOCK_HYPER].astype(bool)
+                              & block_gates[BLOCK_B].astype(bool),
+                              b, state.b)
 
         # the (n, m) residual matvec between the draws and the z/df
         # conditionals (FUTURE.md #2's glue): dispatched through the
@@ -1690,6 +1746,9 @@ class JaxGibbs(SamplerBackend):
             else:
                 theta = random.beta(kt, sz + mk, n - sz + k1mm,
                                     dtype=self.dtype)
+            if block_gates is not None:
+                theta = jnp.where(block_gates[BLOCK_THETA].astype(bool),
+                                  theta, state.theta)
 
         # --- outlier indicators z ~ Bernoulli (reference gibbs.py:201-226)
         pout = state.pout
@@ -1706,6 +1765,10 @@ class JaxGibbs(SamplerBackend):
                 q = jnp.where(mask, q, 0.0)  # pads never flag as outliers
             pout = q
             z = random.bernoulli(kz, jnp.clip(q, 0.0, 1.0)).astype(self.dtype)
+            if block_gates is not None:
+                g_z = block_gates[BLOCK_Z].astype(bool)
+                z = jnp.where(g_z, z, state.z)
+                pout = jnp.where(g_z, pout, state.pout)
 
         # --- auxiliary scales alpha (reference gibbs.py:229-242) --------
         if cfg.vary_alpha:
@@ -1741,6 +1804,9 @@ class JaxGibbs(SamplerBackend):
             if mask is not None:
                 alpha_new = jnp.where(mask, alpha_new, 1.0)
             alpha = jnp.where(jnp.sum(z) >= 1.0, alpha_new, alpha)
+            if block_gates is not None:
+                alpha = jnp.where(block_gates[BLOCK_ALPHA].astype(bool),
+                                  alpha, state.alpha)
 
         # --- degrees of freedom on the grid (reference gibbs.py:244-259)
         if cfg.vary_df:
@@ -1753,6 +1819,9 @@ class JaxGibbs(SamplerBackend):
                     + n * (grid / 2.0) * jnp.log(grid / 2.0)
                     - n * gammaln(grid / 2.0))
             df = grid[random.categorical(kd, logp)]
+            if block_gates is not None:
+                df = jnp.where(block_gates[BLOCK_DF].astype(bool),
+                               df, state.df)
 
         # --- Robbins-Monro jump-scale adaptation (opt-in; frozen past
         # adapt_until, so the chain is ordinary MH from that sweep on)
@@ -1769,7 +1838,15 @@ class JaxGibbs(SamplerBackend):
             # joint proposals target the multivariate RWM optimum
             target = (cfg.mh.cov_target_accept if cfg.mh.adapt_cov
                       else cfg.mh.target_accept)
-            mh_ls = mh_ls + eta * (jnp.stack([acc_w, acc_h]) - target)
+            if block_gates is None:
+                mh_ls = mh_ls + eta * (jnp.stack([acc_w, acc_h])
+                                       - target)
+            else:
+                # a thinned MH block's zeroed acceptance must not read
+                # as rejection: freeze its adaptation term instead
+                mh_ls = mh_ls + eta * (
+                    block_gates[:2].astype(self.dtype)
+                    * (jnp.stack([acc_w, acc_h]) - target))
 
         return ChainState(x=x, b=b, z=z, alpha=alpha, theta=theta, df=df,
                           pout=pout, acc_white=acc_w, acc_hyper=acc_h,

@@ -192,6 +192,20 @@ GATES: Dict[str, GateSpec] = {g.name: g for g in (
        "a pilot-mixture init, `0` force-disables (requests degrade to "
        "the cold prior init, bitwise, with a `warm_start_degraded` "
        "event)"),
+    _G("GST_WARM_FLOW", "serve", "strict3",
+       "normalizing-flow warm-start fits (serve/warm.py "
+       "`kind='flow'`): `auto` honors each spec's requested kind, "
+       "`1` upgrades every pilot fit to the masked-affine flow, `0` "
+       "degrades flow requests to the moment-matched mixture (a "
+       "`warm_flow_degraded` event; the init stays warm, never cold)",
+       fp=False),
+    _G("GST_ADAPT_SCAN", "serve", "strict3",
+       "adaptive block scans (serve/adapt.py, arXiv:1808.09047): the "
+       "slot-pool chunk gains a per-lane block-enable operand and "
+       "converged conditional blocks are thinned to a learned "
+       "random-scan selection probability at quantum boundaries "
+       "(host slice-writes, no recompile); `0` omits the operand — "
+       "the pre-adaptive lowered graph and chains, bitwise (pinned)"),
     _G("GST_SERVE_WATCHDOG", "serve", "choice",
        "serving stall watchdog policy: `auto`(→`dump`)\\|`0`\\|`warn`"
        "\\|`dump`\\|`fail` (not an `auto\\|1\\|0` gate)",

@@ -1,0 +1,173 @@
+"""Adaptive block scans for the slot pool (arXiv:1808.09047).
+
+A systematic Gibbs scan re-samples every conditional block every
+sweep, but in a served pool the streaming monitor KNOWS which blocks'
+marginals have already delivered their requested effective sample
+size: continuing to spend full-rate sweeps on a converged white-noise
+block buys statistics nobody asked for, on lanes whose wall time is
+the pool's capacity currency. The adaptive scan thins a converged
+block to a LEARNED selection probability instead — the random-scan
+form of the hybrid scans in arXiv:1808.09047 — while unconverged
+blocks keep full rate, and a floor probability guarantees no block
+ever fully starves (the chain must remain irreducible: every
+conditional keeps a positive selection probability, so the sampler
+stays a valid random-scan Gibbs composition targeting the same
+posterior).
+
+Plumbing: ``backends/jax_backend._sweep`` takes the per-lane
+``(NBLOCKS,)`` 0/1 enable vector as a traced operand (``block_gates``)
+and gates each block's draw branchlessly — computed and discarded,
+key schedule untouched — the exact mechanism the pool's active mask
+already uses. The pool carries the vector in a host-authoritative
+lane buffer (``SlotPool.set_block_gates``: a numpy slice write + one
+operand upload, never a recompile), and the server redraws each
+monitored tenant's gates at drain boundaries from a deterministic
+host RNG seeded by ``(seed, tenant, sweep)`` — replayable, like every
+other serving decision. ``GST_ADAPT_SCAN=0`` builds the pool without
+the operand: the pre-adaptive lowered graph, bitwise (pinned).
+
+Only blocks with monitored x-columns (white/hyper) ever thin — they
+are the blocks whose per-block ESS the monitor can actually measure;
+the θ/z/α/ν conditionals and the coefficient draw stay full-rate
+(the b-draw's gate additionally ties to hyper's; see
+``jax_backend.BLOCK_B``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import numpy as np
+
+#: Conditional-block order of the sweep — MUST mirror
+#: ``backends.jax_backend.BLOCK_NAMES`` (kept numpy-light here so the
+#: monitor/tools side never imports jax; pinned equal in
+#: tests/test_adapt.py).
+BLOCK_NAMES = ("white", "hyper", "b", "theta", "z", "alpha", "df")
+NBLOCKS = len(BLOCK_NAMES)
+BLOCK_WHITE, BLOCK_HYPER = 0, 1
+#: blocks the policy may thin (monitored x-evidence exists)
+THINNABLE = (BLOCK_WHITE, BLOCK_HYPER)
+
+
+def adapt_scan_env() -> str:
+    """Validated ``GST_ADAPT_SCAN`` (``auto`` when unset) — strict
+    ``auto|1|0``. ``auto``/``1`` build the pool chunk with the
+    block-gates operand (all-ones until a policy thins a tenant —
+    value-identical to the gates-off chunk); ``auto`` honors each
+    request's ``adapt_scan`` spec while ``1`` arms every monitored
+    tenant with the default policy; ``0`` omits the operand — the
+    pre-adaptive lowered graph and chains, bitwise (pinned)."""
+    from gibbs_student_t_tpu.ops import registry
+
+    return registry.value("GST_ADAPT_SCAN")
+
+
+def adapt_scan_enabled() -> bool:
+    """Pool-construction verdict: does the chunk carry the block-gates
+    operand? (Resolved once per pool through the registry's
+    probe→validate→record surface.)"""
+    from gibbs_student_t_tpu.ops import registry
+
+    enabled, _ = registry.mode3("GST_ADAPT_SCAN")
+    return enabled
+
+
+@dataclass
+class AdaptScanSpec:
+    """Per-tenant adaptive-scan policy (``TenantRequest.adapt_scan``).
+
+    ``ess_target`` is the per-block convergence threshold (min ESS
+    over the block's monitored columns); ``None`` inherits the
+    tenant's armed ``MonitorSpec.ess_target`` — submit validates that
+    at least one of the two is armed. ``floor`` is the minimum
+    selection probability of a thinned block (irreducibility: no
+    block ever fully starves). A converged block's selection
+    probability is ``clip(ess_target / ess_block, floor, 1)`` — the
+    more surplus ESS a block has delivered, the harder it thins."""
+
+    ess_target: Optional[float] = None
+    floor: float = 0.1
+
+    def __post_init__(self):
+        if not 0.0 < self.floor <= 1.0:
+            raise ValueError(
+                f"adapt_scan floor must be in (0, 1], got {self.floor}")
+        if self.ess_target is not None and self.ess_target <= 0:
+            raise ValueError(
+                f"adapt_scan ess_target must be > 0, got "
+                f"{self.ess_target}")
+
+
+def resolve_adapt_scan(request_adapt, monitor_spec,
+                       env: Optional[str] = None):
+    """The tenant's effective adaptive-scan policy under the env gate:
+    ``0`` disables every request (the bitwise-off arm), ``1`` arms
+    every tenant whose monitor has an ESS target with the default
+    spec, ``auto`` honors the per-request spec. Returns the
+    :class:`AdaptScanSpec` or None (full-rate scan)."""
+    env = env if env is not None else adapt_scan_env()
+    if env == "0":
+        return None
+    spec = request_adapt
+    if spec is None and env == "1":
+        if monitor_spec is None or monitor_spec.ess_target is None:
+            return None          # nothing to measure convergence by
+        spec = AdaptScanSpec()
+    if spec is None:
+        return None
+    if not isinstance(spec, AdaptScanSpec):
+        raise ValueError(
+            f"adapt_scan must be a serve.adapt.AdaptScanSpec or None, "
+            f"got {type(spec).__name__}")
+    return spec
+
+
+def param_blocks(param_idx, white_indices,
+                 hyper_indices) -> np.ndarray:
+    """Map monitored parameter indices to their conditional block:
+    ``BLOCK_WHITE`` / ``BLOCK_HYPER`` / ``-1`` (unmapped — a column
+    no thinnable block owns). The mapping is pure model structure
+    (``ModelArrays.white_indices`` / ``hyper_indices``), computed once
+    at admission."""
+    w = {int(i) for i in np.asarray(white_indices).ravel()}
+    h = {int(i) for i in np.asarray(hyper_indices).ravel()}
+    out = np.full(len(param_idx), -1, int)
+    for j, p in enumerate(np.asarray(param_idx, int)):
+        if int(p) in w:
+            out[j] = BLOCK_WHITE
+        elif int(p) in h:
+            out[j] = BLOCK_HYPER
+    return out
+
+
+def selection_probs(block_ess: Dict[int, float], ess_target: float,
+                    floor: float) -> np.ndarray:
+    """Per-block selection probabilities from the monitor's per-block
+    min-ESS verdicts: unconverged (or unmeasured) blocks stay at 1;
+    a block whose ESS cleared the target thins to
+    ``clip(target / ess, floor, 1)`` — the learned random-scan rate
+    that would have been just enough."""
+    probs = np.ones(NBLOCKS, np.float64)
+    for bi in THINNABLE:
+        ess = block_ess.get(bi)
+        if ess is None or not np.isfinite(ess) or ess < ess_target:
+            continue
+        probs[bi] = float(np.clip(ess_target / ess, floor, 1.0))
+    return probs
+
+
+def draw_gates(probs: np.ndarray, seed: int, tenant_id: int,
+               sweep: int) -> np.ndarray:
+    """One ``(NBLOCKS,)`` 0/1 enable vector: independent Bernoulli
+    draws from a counter-based host stream seeded by
+    ``(seed, tenant, sweep)`` — deterministic, so a replayed request
+    (or a recovered pool) makes the identical thinning decisions at
+    the identical boundaries."""
+    rng = np.random.default_rng(np.random.SeedSequence(
+        [int(seed) & 0xFFFFFFFF, int(tenant_id) & 0xFFFFFFFF,
+         int(sweep) & 0xFFFFFFFF, 0xADA7]))
+    u = rng.random(NBLOCKS)
+    probs = np.asarray(probs, np.float64)
+    return (u < probs).astype(np.float32)

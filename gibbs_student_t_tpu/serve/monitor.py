@@ -110,13 +110,23 @@ class TenantMonitor:
 
     def __init__(self, spec: MonitorSpec, nchains: int,
                  param_idx: np.ndarray, param_names=None,
-                 record_thin: int = 1):
+                 record_thin: int = 1, blocks=None, block_names=None):
         self.spec = spec
         self.nchains = int(nchains)
         self.param_idx = np.asarray(param_idx, int)
         self.param_names = (None if param_names is None else
                             [str(param_names[i]) for i in self.param_idx])
         self.record_thin = int(record_thin)
+        # param→conditional-block mapping (serve/adapt.param_blocks,
+        # round 18): per-MONITORED-column block index, -1 = unmapped.
+        # Arms the per-block ESS/converged rows in the snapshot — the
+        # evidence the adaptive-scan policy thins on — at zero extra
+        # FFT cost (the per-param ESS is already computed; blocks are
+        # min-reductions over it)
+        self.blocks = None if blocks is None else np.asarray(blocks, int)
+        self.block_names = (None if block_names is None
+                            else [str(n) for n in block_names])
+        self._block_ess: Dict[int, float] = {}
         self._lock = threading.Lock()
         # the accumulated monitored window, (rows, nchains, |params|)
         # float32 — grown geometrically so each quantum's append is an
@@ -289,6 +299,19 @@ class TenantMonitor:
         dt = now - (self._t_first or now)
         s["ess_per_s"] = (float(ess.min()) / dt if dt > 0 else None)
         spec = self.spec
+        if self.blocks is not None:
+            bl = {}
+            for bi in np.unique(self.blocks[self.blocks >= 0]):
+                sel = self.blocks == bi
+                be = float(ess[sel].min())
+                self._block_ess[int(bi)] = be
+                name = (self.block_names[bi] if self.block_names
+                        else str(int(bi)))
+                entry = {"ess_min": be, "params": int(sel.sum())}
+                if spec.ess_target is not None:
+                    entry["converged"] = bool(be >= spec.ess_target)
+                bl[name] = entry
+            s["blocks"] = bl
         if spec.ess_target is not None and ess.min() > 0:
             # sweeps scale ~linearly with ESS once mixing: extrapolate
             # from the observed sweeps-per-effective-sample rate
@@ -324,6 +347,14 @@ class TenantMonitor:
                     np.sqrt(self._w_m2 / (self._w_n - 1)).mean())
         out.pop("converged_t", None)
         return out
+
+    def block_ess(self) -> Dict[int, float]:
+        """Latest per-block min-ESS by BLOCK INDEX (the adaptive-scan
+        policy's input — :func:`serve.adapt.selection_probs`); empty
+        until the first windowed evaluation or when no mapping was
+        armed."""
+        with self._lock:
+            return dict(self._block_ess)
 
     @property
     def converged_at(self) -> Optional[int]:

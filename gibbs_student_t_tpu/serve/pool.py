@@ -44,6 +44,7 @@ import numpy as np
 from jax import lax, random
 
 from gibbs_student_t_tpu.backends.jax_backend import (
+    NBLOCKS,
     ChainState,
     FusedConsts,
     JaxGibbs,
@@ -204,6 +205,20 @@ class SlotPool:
         self._donate = donate_resolved()
         self._state_dev = None        # latest post-quantum device state
         self._host_valid = True       # _state_np mirrors the canon
+        # adaptive block scans (serve/adapt.py, GST_ADAPT_SCAN):
+        # resolved ONCE at pool construction — when on, the chunk
+        # carries a per-lane (NBLOCKS,) block-enable operand riding its
+        # own host-authoritative buffer; when off, the chunk is built
+        # WITHOUT the operand, so the gates-off lowered graph is the
+        # pre-adaptive one verbatim (bitwise pin, tests/test_adapt.py)
+        from gibbs_student_t_tpu.serve.adapt import adapt_scan_enabled
+
+        self.adaptive = adapt_scan_enabled()
+        self._bg_np = np.ones((nlanes, NBLOCKS), np.float32)
+        # separate dirty flag: gate redraws at drain boundaries must
+        # not trigger the (expensive) full mas+consts re-upload
+        self._bg_dirty = self.adaptive
+        self._bg_dev = None
         # the ONE compiled chunk program
         from gibbs_student_t_tpu.obs.introspect import introspect_jit
 
@@ -254,14 +269,15 @@ class SlotPool:
         thin = t.record_thin
         use_tele = t._telemetry
 
-        def lane_chunk(ma_l, fc_l, state, chain_key, offset, length):
+        def lane_chunk(ma_l, fc_l, state, chain_key, offset, bg_l=None,
+                       *, length):
             # mirrors the single-model chunk fn (backends/jax_backend
             # _make_chunk_fn one_chain) with the model and fused consts
             # as traced per-lane operands and a per-lane sweep offset
             def one(j, c):
                 s, tl = c
                 s = t._sweep(s, random.fold_in(chain_key, j), ma=ma_l,
-                             sweep=j, fused=fc_l)
+                             sweep=j, fused=fc_l, block_gates=bg_l)
                 return s, (telemetry_update(tl, s) if use_tele else tl)
 
             def body(carry, i0):
@@ -281,10 +297,7 @@ class SlotPool:
                 tl = tl._replace(logpost=t._logpost_chain(st, ma=ma_l))
             return st, recs, tl
 
-        def chunk(states, mas, fcs, keys, offsets, active, length):
-            sts, recs, tl = jax.vmap(
-                functools.partial(lane_chunk, length=length)
-            )(mas, fcs, states, keys, offsets)
+        def freeze_inactive(sts, states, active):
             # freeze empty slots: their draws are discarded and their
             # parked state carries over bitwise, so a stale model in a
             # free group can never poison a future admission
@@ -292,10 +305,27 @@ class SlotPool:
                 m = active.reshape((-1,) + (1,) * (new.ndim - 1))
                 return jnp.where(m, new, old)
 
-            sts = jax.tree.map(keep, sts, states)
+            return jax.tree.map(keep, sts, states)
+
+        def chunk(states, mas, fcs, keys, offsets, active, length):
+            sts, recs, tl = jax.vmap(
+                functools.partial(lane_chunk, length=length)
+            )(mas, fcs, states, keys, offsets)
+            sts = freeze_inactive(sts, states, active)
             return sts, (recs, tl if use_tele else None)
 
-        return chunk
+        def chunk_adaptive(states, mas, fcs, keys, offsets, active,
+                           bgs, length):
+            # the block-gates operand threads to _sweep exactly as the
+            # other per-lane operands do; an all-ones row is the
+            # full-rate systematic scan (value-identical to `chunk`)
+            sts, recs, tl = jax.vmap(
+                functools.partial(lane_chunk, length=length)
+            )(mas, fcs, states, keys, offsets, bgs)
+            sts = freeze_inactive(sts, states, active)
+            return sts, (recs, tl if use_tele else None)
+
+        return chunk_adaptive if self.adaptive else chunk
 
     # ------------------------------------------------------------------
     # lane writes (host-side buffer writes — never a recompile)
@@ -357,6 +387,11 @@ class SlotPool:
                     [val, np.repeat(val[:1], len(lanes) - k, axis=0)])
                 if len(lanes) > k else val),
             self._state_np, st)
+        if self.adaptive:
+            # a fresh tenant always starts at the full-rate systematic
+            # scan; the server's policy thins it later, per boundary
+            self._bg_np[lanes] = 1.0
+            self._bg_dirty = True
         self._dirty = True
 
     def evict(self, slot: TenantSlot) -> None:
@@ -365,7 +400,24 @@ class SlotPool:
         the next admission overwrites them."""
         self._active_np[slot.lanes] = False
         self._gid_np[slot.lanes] = FREE_GID
+        if self.adaptive:
+            self._bg_np[slot.lanes] = 1.0  # parked lanes: inert anyway
+            self._bg_dirty = True
         self._dirty = True
+
+    def set_block_gates(self, lanes: np.ndarray,
+                        gates: np.ndarray) -> None:
+        """Write a tenant's per-block enable vector into its lanes —
+        the adaptive-scan boundary update (serve/adapt.py). A host
+        numpy slice write plus one small operand upload on the next
+        dispatch; never touches the mas/consts upload path and never
+        recompiles. No-op on a non-adaptive pool (the chunk has no
+        gates operand to feed)."""
+        if not self.adaptive:
+            return
+        self._bg_np[np.asarray(lanes, int)] = np.asarray(
+            gates, np.float32)
+        self._bg_dirty = True
 
     def quarantine_lanes(self, lanes: np.ndarray) -> None:
         """Mask diverged lanes inactive WITHOUT freeing their groups:
@@ -468,6 +520,11 @@ class SlotPool:
             if self._spans is not None:
                 self._spans.record("operand_upload", "dispatch", t_up0,
                                    _time.monotonic() - t_up0)
+        if self.adaptive and self._bg_dirty:
+            # clear-then-copy: a boundary write racing this copy is at
+            # worst re-uploaded next quantum, never silently dropped
+            self._bg_dirty = False
+            self._bg_dev = up(self._bg_np)
         if self._host_valid:
             # the private copy additionally keeps donation honest: the
             # program may reuse its state input buffers, never
@@ -476,10 +533,16 @@ class SlotPool:
         else:
             state_in = self._state_dev
         t_call0 = _time.monotonic()
-        sts, (recs, tl) = self._chunk(
-            state_in, self._mas_dev, self._fc_dev,
-            up(self._keys_np), up(self._offsets_np),
-            up(self._active_np), length=self.quantum)
+        if self.adaptive:
+            sts, (recs, tl) = self._chunk(
+                state_in, self._mas_dev, self._fc_dev,
+                up(self._keys_np), up(self._offsets_np),
+                up(self._active_np), self._bg_dev, length=self.quantum)
+        else:
+            sts, (recs, tl) = self._chunk(
+                state_in, self._mas_dev, self._fc_dev,
+                up(self._keys_np), up(self._offsets_np),
+                up(self._active_np), length=self.quantum)
         if self._spans is not None:
             self._spans.record("chunk_call", "dispatch", t_call0,
                                _time.monotonic() - t_call0)

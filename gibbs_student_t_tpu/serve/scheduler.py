@@ -135,6 +135,18 @@ class TenantRequest:
     #: keeps the cold prior init; ``GST_WARM_START`` gates the arm
     #: globally (0 degrades every request to cold, pinned).
     warm_start: object = None
+    #: adaptive block scan (ROADMAP 4; serve/adapt.py,
+    #: arXiv:1808.09047): an ``AdaptScanSpec`` thins this tenant's
+    #: CONVERGED conditional blocks (per-block min-ESS from the
+    #: streaming monitor) to a learned random-scan selection
+    #: probability at drain boundaries — sweeps stop re-sampling
+    #: blocks whose marginals already delivered their ESS. Requires a
+    #: monitor with an ESS target (validated at submit).
+    #: ``GST_ADAPT_SCAN`` gates the arm globally (``0`` disables every
+    #: request AND removes the pool operand — bitwise pre-adaptive
+    #: graph, pinned; ``1`` arms every eligible tenant with the
+    #: default policy).
+    adapt_scan: object = None
     #: fleet trace-context propagation (round 19): an opaque
     #: correlation id minted by the FleetRouter at submit and carried
     #: on the RPC submit frame. The server tags every span it records
@@ -191,6 +203,11 @@ class TenantHandle:
         # warm-start summary ({kind, pilot_sweeps, pilot_ms, ...} /
         # {"degraded": reason} / None cold) — attached at staging
         self.warm: Optional[Dict] = None
+        # adaptive-scan summary (round 18, serve/adapt.py): latest
+        # per-block selection probabilities + drawn gates, written by
+        # the drain worker at each boundary update; None when the
+        # tenant runs the full-rate systematic scan
+        self.adapt: Optional[Dict] = None
 
     # -- lifecycle (server side) ---------------------------------------
 
@@ -343,6 +360,8 @@ class TenantHandle:
             p["recycled_rows"] = int(self.recycled_rows)
         if self.warm is not None:
             p["warm"] = dict(self.warm)
+        if self.adapt is not None:
+            p["adapt"] = dict(self.adapt)
         return p
 
     @property
@@ -433,6 +452,14 @@ class AdmissionQueue:
             h = self._q.pop(0)
             self._not_full.notify()
             return h
+
+    def snapshot(self) -> List[TenantHandle]:
+        """A read-only view of the queued handles in order — the pilot
+        batcher peeks it to find co-pending warm-start requests whose
+        pilots can ride the same staging wave (serve/server.py
+        ``_warm_fit_for``); the handles stay queued."""
+        with self._lock:
+            return list(self._q)
 
     def remove(self, handle: TenantHandle) -> bool:
         """Drop a specific queued job (cancellation before admission).

@@ -558,6 +558,19 @@ class ChainServer:
         self._warm_starts = 0
         self._warm_degraded = 0
         self._warm_pilot_ms = 0.0
+        # batched pilots (round 18): waves staged and rider fits
+        # served out of a wave's cache instead of a fresh pilot
+        self._warm_pilot_batches = 0
+        self._warm_pilot_batched = 0
+        self._pilot_fits: Dict[int, object] = {}
+        # flow warm starts (round 18, GST_WARM_FLOW): flow fits served
+        # and flow requests that degraded to the mixture (still warm)
+        self._warm_flow_fits = 0
+        self._warm_flow_degraded = 0
+        # adaptive block scans (round 18, serve/adapt.py): boundary
+        # gate updates applied and tenants that ever thinned
+        self._adapt_updates = 0
+        self._adapt_tenants: set = set()
         # cost accounting (round 14): total measured dispatch wall —
         # the quantity the per-tenant device_ms shares sum back to
         self._dispatch_wall_ms = 0.0
@@ -603,6 +616,12 @@ class ChainServer:
         self._warm_starts = 0
         self._warm_degraded = 0
         self._warm_pilot_ms = 0.0
+        self._warm_pilot_batches = 0
+        self._warm_pilot_batched = 0
+        self._warm_flow_fits = 0
+        self._warm_flow_degraded = 0
+        self._adapt_updates = 0
+        self._adapt_tenants = set()
         # stage-timer accounting restarts from the current cumulative
         # snapshot so warmup kernels never leak into the timed window
         self._stage_prev = (_nffi.timers_snapshot()
@@ -698,6 +717,24 @@ class ChainServer:
                     "warm_start must be a serve.warm.WarmStartSpec, a "
                     "WarmStartFit (or its journaled JSON dict), or "
                     f"None, got {type(request.warm_start).__name__}")
+        if request.adapt_scan is not None:
+            from gibbs_student_t_tpu.serve.adapt import AdaptScanSpec
+
+            if not isinstance(request.adapt_scan, AdaptScanSpec):
+                raise ValueError(
+                    "adapt_scan must be a serve.adapt.AdaptScanSpec "
+                    f"or None, got {type(request.adapt_scan).__name__}")
+            mon = request.monitor
+            if mon is None:
+                raise ValueError(
+                    "adapt_scan needs a monitor — the per-block ESS "
+                    "the policy thins on is the streaming monitor's")
+            if (request.adapt_scan.ess_target is None
+                    and mon.ess_target is None):
+                raise ValueError(
+                    "adapt_scan needs an ESS target: set "
+                    "AdaptScanSpec.ess_target or arm the monitor's "
+                    "ess_target")
         if request.on_divergence != "none":
             if not self.supervise:
                 raise ValueError(
@@ -790,13 +827,31 @@ class ChainServer:
         try:
             _faults.fire("staging", tenant=self._tenant_key(handle))
             if req.monitor is not None:
+                from gibbs_student_t_tpu.serve import adapt as _adapt
+
+                pidx = resolve_params(req.monitor, t._ma.param_names)
                 monitor = TenantMonitor(
-                    req.monitor, req.nchains,
-                    resolve_params(req.monitor, t._ma.param_names),
+                    req.monitor, req.nchains, pidx,
                     param_names=t._ma.param_names,
-                    record_thin=t.record_thin)
+                    record_thin=t.record_thin,
+                    # param→conditional-block mapping: arms the
+                    # per-block ESS/converged progress rows (and the
+                    # adaptive-scan policy's evidence) for every
+                    # monitored tenant — model structure, zero extra
+                    # diagnostic cost
+                    blocks=_adapt.param_blocks(pidx,
+                                               t._ma.white_indices,
+                                               t._ma.hyper_indices),
+                    block_names=_adapt.BLOCK_NAMES)
                 if req.spool_dir is not None and req.start_sweep > 0:
                     self._backfill_monitor(monitor, req)
+                # the tenant's effective adaptive-scan policy under
+                # GST_ADAPT_SCAN (None = full-rate systematic scan);
+                # needs the pool operand to exist to ever act
+                handle._adapt_spec = (
+                    _adapt.resolve_adapt_scan(req.adapt_scan,
+                                              req.monitor)
+                    if self.pool.adaptive else None)
             ma = _localize_names(req.ma)
             if ma.row_mask is not None:
                 raise ValueError("tenant models must be unpadded; the "
@@ -911,17 +966,29 @@ class ChainServer:
                 # serve cold, bitwise the pre-warm-start init — pinned
                 handle.warm = {"degraded": "GST_WARM_START=0"}
             return None
+        batched = False
         try:
             if isinstance(warm_in, WarmStartFit):
                 fit = warm_in          # journaled: replay, no pilot
             elif self.pipeline:
-                # pipelined executor: run the pilot ON the pool — the
-                # one compiled operand-fed chunk program, so a pilot
-                # never compiles anything (a standalone pilot backend
-                # bakes the tenant model as trace constants and pays
-                # a FULL compile per distinct model — measured
-                # seconds/tenant, inverting the warm-start economics)
-                fit = self._pool_pilot_fit(handle, warm_in)
+                # batched pilots (round 18): an earlier tenant's wave
+                # may have already served THIS tenant's pilot — the
+                # cached fit costs no pilot wait at all, which is what
+                # un-serializes warm admission latency (the measured
+                # PR 14 flagship negative)
+                fit = self._pilot_fits.pop(handle.tenant_id, None)
+                batched = fit is not None
+                if batched:
+                    self._warm_pilot_batched += 1
+                else:
+                    # pipelined executor: run the pilot ON the pool —
+                    # the one compiled operand-fed chunk program, so a
+                    # pilot never compiles anything (a standalone
+                    # pilot backend bakes the tenant model as trace
+                    # constants and pays a FULL compile per distinct
+                    # model — measured seconds/tenant, inverting the
+                    # warm-start economics)
+                    fit = self._pool_pilot_fit(handle, warm_in)
             else:
                 # serial driver: _prepare runs ON the driving thread,
                 # so an in-pool pilot would deadlock (nothing left to
@@ -944,12 +1011,32 @@ class ChainServer:
                     error=f"{type(e).__name__}: {e}")
             return None
         self._warm_starts += 1
-        self._warm_pilot_ms += fit.pilot_ms
+        if not batched:
+            # a batched fit's pilot wall was the wave's (already paid
+            # and counted by the wave primary) — counting it again
+            # would double-bill pilot_ms_total
+            self._warm_pilot_ms += fit.pilot_ms
         handle.warm = {"kind": fit.kind,
                        "pilot_sweeps": fit.pilot_sweeps,
                        "pilot_chains": fit.pilot_chains,
                        "pilot_ms": round(fit.pilot_ms, 1),
-                       "replayed": fit.pilot_ms == 0.0}
+                       "replayed": fit.pilot_ms == 0.0,
+                       "batched": batched}
+        if fit.kind == "flow":
+            self._warm_flow_fits += 1
+        fdeg = (fit.meta or {}).get("flow_degraded")
+        if fdeg:
+            # flow requested but the fit fell back to the mixture
+            # (GST_WARM_FLOW=0 or a training failure): the tenant is
+            # still WARM — this names the family downgrade, distinct
+            # from warm_start_degraded (warm → cold)
+            self._warm_flow_degraded += 1
+            handle.warm["flow_degraded"] = fdeg
+            if self.metrics is not None:
+                self.metrics.counter("serve_warm_flow_degraded").inc()
+                self.metrics.emit("warm_flow_degraded",
+                                  tenant=handle.tenant_id,
+                                  reason=fdeg)
         if self.metrics is not None:
             self.metrics.counter("serve_warm_starts").inc()
             self.metrics.emit("warm_start", tenant=handle.tenant_id,
@@ -963,56 +1050,131 @@ class ChainServer:
     #: frees; past this the tenant degrades to the cold init)
     PILOT_TIMEOUT_S = 300.0
 
+    def _pilot_wave(self, handle: TenantHandle, spec) -> list:
+        """The pilot BATCH for one staging pickup (round 18): this
+        tenant's pilot plus one per co-QUEUED warm-start tenant
+        (riders) whose pilot can ride the same wave. PR 14's measured
+        flagship negative was exactly here — pilots serialize on the
+        staging thread, so N pending warm tenants paid N pilot walls
+        of admission latency each behind the other; a wave pays ONE
+        (the pool serves the pilots concurrently on separate lanes).
+        Returns ``[(tenant_handle, spec)]``, this tenant first."""
+        from gibbs_student_t_tpu.serve.warm import (
+            WarmStartSpec,
+            resolve_warm_start,
+        )
+
+        wave = [(handle, spec)]
+        cap = max(1, self.pool.nlanes // self.pool.group)
+        for rh in self.queue.snapshot():
+            if len(wave) >= cap:
+                break
+            rr = rh.request
+            if (rh is handle or rh.done()
+                    or getattr(rh, "_internal", False)
+                    or rh.tenant_id in self._pilot_fits
+                    or rr.state is not None or rr.x0 is not None):
+                continue
+            try:
+                rspec = resolve_warm_start(rr.warm_start)
+            except Exception:  # noqa: BLE001
+                continue   # its own staging rejects it properly
+            if isinstance(rspec, WarmStartSpec):
+                wave.append((rh, rspec))
+        return wave
+
     def _pool_pilot_fit(self, handle: TenantHandle, spec):
-        """Warm-start pilot as an INTERNAL tenant of the slot pool:
-        a ``pilot_chains``-chain job with the warm tenant's own model
-        and seed, prepared directly into the staged window (it cannot
-        ride the queue — THIS thread is the staging worker, and a
-        queued pilot would wait on itself), served by the already-
-        compiled chunk program alongside the resident tenants, then
-        moment-matched by ``fit_from_rows``. The pilot's lanes do
-        real accounted work (occupancy/cost tell the truth) but it is
-        invisible to the crash manifest and the SLO series
-        (``_internal``). Blocks the staging thread only — the
-        dispatch thread keeps the pool serving throughout."""
+        """Warm-start pilots as INTERNAL tenants of the slot pool:
+        ``pilot_chains``-chain jobs with each warm tenant's own model
+        and seed, prepared directly into the staged window (they
+        cannot ride the queue — THIS thread is the staging worker,
+        and a queued pilot would wait on itself), served by the
+        already-compiled chunk program alongside the resident
+        tenants, then moment-matched by ``fit_from_rows``. The whole
+        wave (this tenant + the co-queued riders from
+        :meth:`_pilot_wave`) waits ONCE; rider fits land in
+        ``_pilot_fits`` for their own staging pickup to consume
+        without a pilot wait. Pilot lanes do real accounted work
+        (occupancy/cost tell the truth) but stay invisible to the
+        crash manifest and the SLO series (``_internal``). Blocks the
+        staging thread only — the dispatch thread keeps the pool
+        serving throughout. Rider failures degrade silently (the
+        rider just runs its own pilot later); only THIS tenant's
+        pilot failure raises (into ``_warm_fit_for``'s degrade
+        scope)."""
         from gibbs_student_t_tpu.serve.warm import fit_from_rows
 
-        req = handle.request
         t0 = time.monotonic()
         q = self.pool.quantum
-        niter = -(-int(spec.pilot_sweeps) // q) * q
-        pr = TenantRequest(
-            ma=req.ma, niter=niter, nchains=spec.pilot_chains,
-            seed=req.seed,
-            name=f"__warm_pilot_{handle.tenant_id}")
-        with self._lock:
-            ph = TenantHandle(self._next_id, pr)
-            self._next_id += 1
-            self._handles[ph.tenant_id] = ph
-        ph._internal = True
-        prep = self._prepare(ph)
-        if prep is None:
-            raise RuntimeError(f"pilot rejected: {ph.error}")
-        with self._prep_lock:
-            self._prepared.append(prep)
-        # stop-aware wait: close() joins the staging thread, so a
-        # plain blocking result() here would hold shutdown hostage
-        # for the whole pilot timeout
+        pilots = []
+        for wh, wspec in self._pilot_wave(handle, spec):
+            niter = -(-int(wspec.pilot_sweeps) // q) * q
+            pr = TenantRequest(
+                ma=wh.request.ma, niter=niter,
+                nchains=wspec.pilot_chains, seed=wh.request.seed,
+                name=f"__warm_pilot_{wh.tenant_id}")
+            with self._lock:
+                ph = TenantHandle(self._next_id, pr)
+                self._next_id += 1
+                self._handles[ph.tenant_id] = ph
+            ph._internal = True
+            prep = self._prepare(ph)
+            if prep is None:
+                if wh is handle:
+                    raise RuntimeError(f"pilot rejected: {ph.error}")
+                continue
+            with self._prep_lock:
+                self._prepared.append(prep)
+            pilots.append((wh, wspec, ph, prep))
+        if len(pilots) > 1:
+            self._warm_pilot_batches += 1
+            if self.metrics is not None:
+                self.metrics.counter("serve_pilot_batches").inc()
+                self.metrics.emit("pilot_batch", tenant=handle.tenant_id,
+                                  size=len(pilots))
+        # ONE stop-aware wait for the whole wave: close() joins the
+        # staging thread, so a plain blocking result() here would
+        # hold shutdown hostage for the whole pilot timeout
         deadline = t0 + self.PILOT_TIMEOUT_S
-        while not ph.done():
-            if self._workers_stop.is_set() or self._stop.is_set():
+        fit_out = None
+        timed_out = False
+        for wh, wspec, ph, prep in pilots:
+            while not ph.done() and not timed_out:
+                if self._workers_stop.is_set() or self._stop.is_set():
+                    for _, _, p2, _ in pilots:
+                        if not p2.done():
+                            self.cancel(p2)
+                    raise RuntimeError("server stopping mid-pilot")
+                if time.monotonic() > deadline:
+                    timed_out = True
+                    break
+                ph._done.wait(0.05)
+            if timed_out and not ph.done():
                 self.cancel(ph)
-                raise RuntimeError("server stopping mid-pilot")
-            if time.monotonic() > deadline:
-                self.cancel(ph)
-                raise TimeoutError(
-                    f"warm-start pilot not served within "
-                    f"{self.PILOT_TIMEOUT_S:.0f}s")
-            ph._done.wait(0.05)
-        res = ph.result(timeout=0)
-        return fit_from_rows(np.asarray(res.chain), spec,
-                             prep.ma_padded.specs_np,
-                             pilot_ms=(time.monotonic() - t0) * 1e3)
+                if wh is handle:
+                    # cancel the undone riders too before raising
+                    for _, _, p2, _ in pilots:
+                        if not p2.done():
+                            self.cancel(p2)
+                    raise TimeoutError(
+                        f"warm-start pilot not served within "
+                        f"{self.PILOT_TIMEOUT_S:.0f}s")
+                continue
+            try:
+                res = ph.result(timeout=0)
+                fit = fit_from_rows(
+                    np.asarray(res.chain), wspec,
+                    prep.ma_padded.specs_np,
+                    pilot_ms=(time.monotonic() - t0) * 1e3)
+            except Exception:  # noqa: BLE001 - rider degrades alone
+                if wh is handle:
+                    raise
+                continue
+            if wh is handle:
+                fit_out = fit
+            else:
+                self._pilot_fits[wh.tenant_id] = fit
+        return fit_out
 
     def _apply_prepared(self, prep: _Prepared) -> None:
         """Place a prepared tenant into free lane groups: the cheap
@@ -1889,6 +2051,15 @@ class ChainServer:
                         self.flight.note_event(
                             "evict_converged", tenant=slot.tenant_id,
                             sweep=mon.converged_at)
+            # adaptive block scan (round 18, serve/adapt.py): redraw
+            # the tenant's block gates from the freshly-evaluated
+            # per-block ESS — runs on the drain worker, lands as a
+            # host slice write the NEXT dispatch uploads
+            spec_a = getattr(handle, "_adapt_spec", None)
+            if (spec_a is not None and not slot.cancelled
+                    and not slot.failed):
+                self._adapt_update(handle, slot, mon, spec_a,
+                                   sweep_end)
         except Exception as e:  # noqa: BLE001 - observability contract
             handle._monitor = None
             warnings.warn(
@@ -1900,6 +2071,54 @@ class ChainServer:
                 self.metrics.emit("monitor_error",
                                   tenant=slot.tenant_id,
                                   error=f"{type(e).__name__}: {e}")
+
+    def _adapt_update(self, handle: TenantHandle, slot: TenantSlot,
+                      mon, spec, sweep_end: int) -> None:
+        """One adaptive-scan boundary update (serve/adapt.py): from
+        the monitor's latest per-block min-ESS, thin every CONVERGED
+        thinnable block to its learned selection probability and draw
+        this boundary's 0/1 gates from the deterministic
+        ``(seed, tenant, sweep)`` host stream. The write is a pool
+        slice-assign on the gates buffer — a small operand upload at
+        the next dispatch, never a recompile. Runs on the drain
+        worker inside ``_feed_monitor``'s failure scope."""
+        from gibbs_student_t_tpu.serve import adapt as _adapt
+
+        target = spec.ess_target
+        if target is None:
+            target = handle.request.monitor.ess_target
+        bess = mon.block_ess()
+        if target is None or not bess:
+            return
+        probs = _adapt.selection_probs(bess, float(target), spec.floor)
+        thinning = bool((probs < 1.0).any())
+        if not thinning and handle.adapt is None:
+            return          # never thinned: gates stay at their ones
+        gates = _adapt.draw_gates(probs, slot.seed, slot.tenant_id,
+                                  int(sweep_end))
+        self.pool.set_block_gates(slot.lanes, gates)
+        self._adapt_updates += 1
+        first = slot.tenant_id not in self._adapt_tenants
+        self._adapt_tenants.add(slot.tenant_id)
+        handle.adapt = {
+            "sweep": int(sweep_end),
+            "probs": {n: round(float(p), 4)
+                      for n, p in zip(_adapt.BLOCK_NAMES, probs)
+                      if p < 1.0},
+            "gates": [int(g) for g in gates],
+            "updates": (handle.adapt or {}).get("updates", 0) + 1,
+        }
+        if self.metrics is not None:
+            self.metrics.counter("serve_adapt_updates").inc()
+            if first:
+                self.metrics.emit(
+                    "adapt_scan", tenant=slot.tenant_id,
+                    sweep=int(sweep_end),
+                    probs=handle.adapt["probs"])
+        if first and self.flight is not None:
+            self.flight.note_event("adapt_scan",
+                                   tenant=slot.tenant_id,
+                                   sweep=int(sweep_end))
 
     def _release(self, slot: TenantSlot) -> None:
         """Free a finished tenant's lanes (pool-side bookkeeping; runs
@@ -2858,7 +3077,22 @@ class ChainServer:
                         "recycled_lane_rows": self._recycled_lane_rows},
             "warm": {"warm_starts": self._warm_starts,
                      "degraded": self._warm_degraded,
-                     "pilot_ms_total": round(self._warm_pilot_ms, 1)},
+                     "pilot_ms_total": round(self._warm_pilot_ms, 1),
+                     # batched pilots (round 18): staging waves run
+                     # and rider fits served from a wave's cache —
+                     # each batched fit is one pilot the staging
+                     # thread did NOT serialize on
+                     "pilot_batches": self._warm_pilot_batches,
+                     "pilot_batched_fits": self._warm_pilot_batched,
+                     # flow warm starts (round 18, GST_WARM_FLOW)
+                     "flow_fits": self._warm_flow_fits,
+                     "flow_degraded": self._warm_flow_degraded},
+            # adaptive block scans (round 18; ROADMAP 4, serve/
+            # adapt.py): boundary gate updates applied and tenants
+            # that ever thinned a converged block
+            "adapt": {"enabled": bool(self.pool.adaptive),
+                      "updates": self._adapt_updates,
+                      "tenants_thinned": len(self._adapt_tenants)},
             "slo": self._slo_block(),
             # per-stage DEVICE time from the in-kernel timers (round
             # 15): total/mean-per-quantum/share-of-dispatch per stage,

@@ -35,12 +35,39 @@ prior init (the silent-degradation discipline of every GST_* arm).
 from __future__ import annotations
 
 import time
+import warnings
 from dataclasses import dataclass, field
 from typing import Dict, Optional
 
 import numpy as np
 
 from gibbs_student_t_tpu.models.parameter import KIND_NORMAL
+
+
+def warm_flow_env() -> str:
+    """Validated ``GST_WARM_FLOW`` (``auto`` when unset) — the
+    normalizing-flow fit family (round 18, arXiv:2405.08857). Strict
+    ``auto|1|0``: ``auto`` honors each spec's requested ``kind``,
+    ``1`` upgrades every pilot fit to the masked-affine flow, ``0``
+    degrades flow requests to the moment-matched mixture (the fit
+    stays WARM — never cold; a ``warm_flow_degraded`` event names
+    the downgrade)."""
+    from gibbs_student_t_tpu.ops import registry
+
+    return registry.value("GST_WARM_FLOW")
+
+
+def resolve_fit_kind(requested: str,
+                     env: Optional[str] = None) -> str:
+    """The effective fit family for a pilot under ``GST_WARM_FLOW``:
+    ``0`` → ``gmm`` always, ``1`` → ``flow`` always, ``auto`` → the
+    spec's own ``kind``."""
+    env = env if env is not None else warm_flow_env()
+    if env == "0":
+        return "gmm"
+    if env == "1":
+        return "flow"
+    return requested
 
 
 def warm_start_env() -> str:
@@ -71,8 +98,18 @@ class WarmStartSpec:
     pilot_chains: int = 8
     burn_frac: float = 0.5
     jitter_frac: float = 0.02
+    #: fit family (``"gmm"`` | ``"flow"``): ``flow`` trains a small
+    #: masked-affine (RealNVP-style) flow on the pilot mixture
+    #: (arXiv:2405.08857's recipe proper) instead of the per-chain
+    #: moment match; ``GST_WARM_FLOW`` can force either family, and a
+    #: flow-fit failure degrades to the mixture (warm either way)
+    kind: str = "gmm"
 
     def __post_init__(self):
+        if self.kind not in ("gmm", "flow"):
+            raise ValueError(
+                f"warm-start kind must be 'gmm' or 'flow', got "
+                f"{self.kind!r}")
         if self.pilot_sweeps < 8:
             raise ValueError(f"pilot_sweeps must be >= 8, got "
                              f"{self.pilot_sweeps}")
@@ -137,6 +174,12 @@ class WarmStartFit:
             raise ValueError(
                 f"unknown warm-start fit kind {kind!r} "
                 f"(known: {sorted(FIT_KINDS)})")
+        tgt = FIT_KINDS[kind]
+        if tgt is not cls:
+            # kind dispatch: a journaled flow record reconstructs the
+            # flow class even through the base entry point (the path
+            # resolve_warm_start and recover() take)
+            return tgt.from_json(d)
         return cls(means=np.asarray(d["means"], np.float64),
                    stds=np.asarray(d["stds"], np.float64),
                    weights=np.asarray(d["weights"], np.float64),
@@ -149,6 +192,173 @@ class WarmStartFit:
 #: reconstructing class (all journaled through the same admit-record
 #: JSON; serve/manifest.py)
 FIT_KINDS: Dict[str, type] = {"gmm": WarmStartFit}
+
+
+@dataclass
+class FlowWarmStartFit(WarmStartFit):
+    """``kind="flow"``: a small masked-affine (RealNVP-style) flow
+    trained on the pooled post-burn pilot samples — the 2405.08857
+    recipe proper, riding the mixture's exact journal/draw/replay
+    plumbing through :data:`FIT_KINDS`.
+
+    The base-class ``means``/``stds`` are repurposed as the ``(1, p)``
+    POOLED standardization stats (``weights == [1.0]``); ``flow``
+    carries the coupling-layer parameters as float64 JSON lists.
+    Training runs in plain jax on the staging thread (jitted full-batch
+    Adam, fixed step count, ``PRNGKey``-seeded init — deterministic per
+    pilot), but :meth:`draw_x0` is PURE NUMPY over the journaled
+    float64 parameters: base Philox normals → coupling layers →
+    de-standardize → :func:`clip_to_support`. JSON round-trip is exact
+    for float64, so recovery replays the init bitwise without jax, the
+    pilot, or the training loop (the same contract the mixture pins).
+    """
+
+    #: {"hidden": H, "layers": [{"mask", "W1", "b1", "W2", "b2"}, ...]}
+    #: — float64 nested lists, JSON-exact
+    flow: Dict = field(default_factory=dict)
+    kind: str = "flow"
+
+    def _forward_np(self, z: np.ndarray) -> np.ndarray:
+        """Base normals ``(n, p)`` → standardized flow samples, pure
+        float64 numpy (the replay-side transform)."""
+        x = np.asarray(z, np.float64)
+        p = x.shape[1]
+        for lyr in self.flow["layers"]:
+            m = np.asarray(lyr["mask"], np.float64)
+            w1 = np.asarray(lyr["W1"], np.float64)
+            b1 = np.asarray(lyr["b1"], np.float64)
+            w2 = np.asarray(lyr["W2"], np.float64)
+            b2 = np.asarray(lyr["b2"], np.float64)
+            hid = np.tanh((x * m) @ w1 + b1)
+            st = hid @ w2 + b2
+            s = np.tanh(st[:, :p]) * (1.0 - m)
+            t = st[:, p:] * (1.0 - m)
+            x = m * x + (1.0 - m) * (x * np.exp(s) + t)
+        return x
+
+    def draw_x0(self, nchains: int, seed: int,
+                specs: np.ndarray) -> np.ndarray:
+        rng = np.random.default_rng(
+            np.random.SeedSequence([int(seed) & 0xFFFFFFFF, 0x57A7]))
+        z = rng.standard_normal((nchains, self.means.shape[1]))
+        x = (np.asarray(self.means, np.float64)[0]
+             + np.asarray(self.stds, np.float64)[0]
+             * self._forward_np(z))
+        return clip_to_support(x, specs)
+
+    def to_json(self) -> Dict:
+        d = super().to_json()
+        d["flow"] = self.flow
+        return d
+
+    @classmethod
+    def from_json(cls, d: Dict) -> "FlowWarmStartFit":
+        fl = d.get("flow")
+        if not fl or not fl.get("layers"):
+            raise ValueError("flow fit record missing 'flow' payload")
+        return cls(means=np.asarray(d["means"], np.float64),
+                   stds=np.asarray(d["stds"], np.float64),
+                   weights=np.asarray(d["weights"], np.float64),
+                   kind="flow",
+                   pilot_sweeps=int(d.get("pilot_sweeps", 0)),
+                   pilot_chains=int(d.get("pilot_chains", 0)),
+                   flow=fl)
+
+    @classmethod
+    def fit(cls, post: np.ndarray, gmm: WarmStartFit,
+            spec: "WarmStartSpec", pilot_ms: float = 0.0,
+            hidden: int = 16, steps: int = 300,
+            lr: float = 5e-3) -> "FlowWarmStartFit":
+        """Train the flow on pooled post-burn rows ``(rows, chains,
+        p)``. Standardization stds are floored by the already-fitted
+        mixture's per-param floors (so a stuck pilot column cannot
+        blow up the standardized data), init is ``PRNGKey(0)`` with
+        zeroed output layers (the flow STARTS as the identity — i.e.
+        exactly the pooled-Gaussian fit — and training can only
+        improve the NLL from there). Raises on non-finite training;
+        the caller degrades to the mixture."""
+        import jax
+        import jax.numpy as jnp
+
+        data = np.asarray(post, np.float64).reshape(-1, post.shape[-1])
+        n, p = data.shape
+        if n < 8:
+            raise ValueError(
+                f"flow fit needs >= 8 pooled pilot rows, got {n}")
+        mu = data.mean(axis=0)
+        sd = np.maximum(data.std(axis=0, ddof=1),
+                        np.asarray(gmm.stds, np.float64).min(axis=0))
+        zdata = jnp.asarray((data - mu) / sd, jnp.float32)
+
+        nlayers = 2
+        masks = [jnp.asarray((np.arange(p) % 2 == (l % 2)),
+                             np.float32) for l in range(nlayers)]
+        key = jax.random.PRNGKey(0)
+        params = []
+        for l in range(nlayers):
+            key, sub = jax.random.split(key)
+            # zero W2/b2 => s = t = 0 => identity init
+            params.append((
+                0.05 * jax.random.normal(sub, (p, hidden), jnp.float32),
+                jnp.zeros((hidden,), jnp.float32),
+                jnp.zeros((hidden, 2 * p), jnp.float32),
+                jnp.zeros((2 * p,), jnp.float32)))
+
+        def _nll(ps):
+            x = zdata
+            ld = jnp.zeros(x.shape[0], x.dtype)
+            for m, (w1, b1, w2, b2) in zip(reversed(masks),
+                                           reversed(ps)):
+                hid = jnp.tanh((x * m) @ w1 + b1)
+                st = hid @ w2 + b2
+                s = jnp.tanh(st[:, :p]) * (1.0 - m)
+                t = st[:, p:] * (1.0 - m)
+                x = m * x + (1.0 - m) * ((x - t) * jnp.exp(-s))
+                ld = ld - s.sum(axis=1)
+            return jnp.mean(0.5 * jnp.sum(x * x, axis=1) - ld)
+
+        b1m, b2m, eps = 0.9, 0.999, 1e-8
+        tmap = jax.tree_util.tree_map
+
+        def _step(carry, _):
+            ps, m, v, i = carry
+            loss, g = jax.value_and_grad(_nll)(ps)
+            i = i + 1.0
+            m = tmap(lambda a, b: b1m * a + (1 - b1m) * b, m, g)
+            v = tmap(lambda a, b: b2m * a + (1 - b2m) * b * b, v, g)
+            ps = tmap(
+                lambda pp, a, b: pp - lr * (a / (1 - b1m ** i))
+                / (jnp.sqrt(b / (1 - b2m ** i)) + eps),
+                ps, m, v)
+            return (ps, m, v, i), loss
+
+        zeros = tmap(jnp.zeros_like, params)
+        (params, _, _, _), losses = jax.lax.scan(
+            jax.jit(_step), (params, zeros, zeros, 0.0),
+            None, length=steps)
+        final = float(losses[-1])
+        if not np.isfinite(final):
+            raise ValueError(f"flow training diverged (nll={final})")
+        layers = []
+        for m, (w1, b1, w2, b2) in zip(masks, params):
+            arrs = [np.asarray(a, np.float64) for a in
+                    (m, w1, b1, w2, b2)]
+            if not all(np.isfinite(a).all() for a in arrs):
+                raise ValueError("flow training produced non-finite "
+                                 "parameters")
+            layers.append(dict(zip(
+                ("mask", "W1", "b1", "W2", "b2"),
+                (a.tolist() for a in arrs))))
+        return cls(
+            means=mu[None, :], stds=sd[None, :],
+            weights=np.ones(1), kind="flow",
+            pilot_sweeps=gmm.pilot_sweeps,
+            pilot_chains=gmm.pilot_chains, pilot_ms=pilot_ms,
+            flow={"hidden": int(hidden), "layers": layers},
+            meta={"nll": final, "steps": int(steps)})
+
+
+FIT_KINDS["flow"] = FlowWarmStartFit
 
 
 def clip_to_support(x: np.ndarray, specs: np.ndarray) -> np.ndarray:
@@ -193,12 +403,28 @@ def fit_from_rows(rows: np.ndarray, spec: WarmStartSpec,
                      specs[:, 2] - specs[:, 1])
     stds = np.maximum(stds, spec.jitter_frac * np.abs(scale))
     K = means.shape[0]
-    return WarmStartFit(
+    gmm = WarmStartFit(
         means=means, stds=stds,
         weights=np.full(K, 1.0 / K),
         pilot_sweeps=rows.shape[0],
         pilot_chains=means.shape[0],
         pilot_ms=pilot_ms)
+    eff = resolve_fit_kind(spec.kind)
+    if eff != "flow":
+        if spec.kind == "flow":
+            # GST_WARM_FLOW=0 downgrade: still WARM (the mixture),
+            # never cold — the server names it (warm_flow_degraded)
+            gmm.meta["flow_degraded"] = "GST_WARM_FLOW=0"
+        return gmm
+    try:
+        return FlowWarmStartFit.fit(post, gmm, spec,
+                                    pilot_ms=pilot_ms)
+    except Exception as e:  # degradation discipline: warm, not cold
+        warnings.warn(f"flow warm-start fit failed "
+                      f"({type(e).__name__}: {e}); degrading to the "
+                      f"moment-matched mixture", RuntimeWarning)
+        gmm.meta["flow_degraded"] = f"{type(e).__name__}: {e}"
+        return gmm
 
 
 def fit_warm_start(ma, config, spec: WarmStartSpec, seed: int,

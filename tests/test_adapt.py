@@ -1,0 +1,596 @@
+"""Sampling-per-dollar round 2 tests: adaptive block scans
+(serve/adapt.py, GST_ADAPT_SCAN), batched staging pilots, and flow
+warm starts (serve/warm.py FlowWarmStartFit, GST_WARM_FLOW).
+
+The load-bearing contracts pinned here:
+
+- ``adapt.BLOCK_NAMES`` mirrors ``jax_backend.BLOCK_NAMES`` exactly
+  (the policy side is numpy-light by design; a drift would mis-map
+  gates onto blocks silently).
+- Gates off is bitwise the old graph: a ``GST_ADAPT_SCAN=0`` server's
+  chains are identical to the default (operand-carrying) pool serving
+  the same request — even while co-resident tenants on the default
+  pool are actively THINNED (tenant isolation + all-ones gating).
+- The thinning policy is deterministic (``(seed, tenant, sweep)``-
+  keyed counter RNG), floor-bounded (irreducibility), and only ever
+  thins the monitored thinnable blocks.
+- Batched pilots: co-queued warm-start tenants ride ONE staging wave;
+  rider fits come from the wave cache, and their pilot walls are NOT
+  added to ``pilot_ms_total`` (the PR 14 admission-latency negative —
+  pilots serializing on the staging thread — is what this pins).
+- The flow fit journals as JSON, reconstructs through the base
+  ``from_json`` (kind dispatch), replays its init draw bitwise, and
+  every failure path degrades to the mixture (warm, never cold) with
+  a named reason.
+
+Budget: ONE shared adaptive pool serves every gate-on serve test
+(the batching test rides the same compiled pool — internal pilots
+reuse the chunk program); the gates-off bitwise arm keeps its own
+short-lived pool; the recover() replay pin (3 pool compiles) rides
+the slow tier.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tests.conftest import make_demo_pta
+from gibbs_student_t_tpu.config import GibbsConfig
+from gibbs_student_t_tpu.serve.adapt import (
+    BLOCK_NAMES,
+    NBLOCKS,
+    THINNABLE,
+    AdaptScanSpec,
+    adapt_scan_env,
+    draw_gates,
+    param_blocks,
+    resolve_adapt_scan,
+    selection_probs,
+)
+from gibbs_student_t_tpu.serve.warm import (
+    FlowWarmStartFit,
+    WarmStartFit,
+    WarmStartSpec,
+    fit_from_rows,
+    resolve_fit_kind,
+    resolve_warm_start,
+    warm_flow_env,
+)
+
+pytestmark = pytest.mark.adapt
+
+EXACT_OR_ROUNDOFF_FIELDS = ("chain", "zchain", "thetachain", "dfchain",
+                            "bchain", "alphachain", "poutchain")
+
+
+@pytest.fixture(scope="module")
+def demo():
+    pta = make_demo_pta()
+    return pta.frozen(0), GibbsConfig(model="mixture")
+
+
+# ----------------------------------------------------------------------
+# policy units (jax-light)
+# ----------------------------------------------------------------------
+
+
+def test_block_names_mirror_backend():
+    """The numpy-light policy copy and the backend's sweep order must
+    never drift — a mismatch silently gates the wrong conditionals."""
+    from gibbs_student_t_tpu.backends import jax_backend as jb
+
+    assert BLOCK_NAMES == jb.BLOCK_NAMES
+    assert NBLOCKS == jb.NBLOCKS
+    from gibbs_student_t_tpu.serve import adapt as ad
+
+    assert ad.BLOCK_WHITE == jb.BLOCK_WHITE
+    assert ad.BLOCK_HYPER == jb.BLOCK_HYPER
+
+
+def test_adapt_spec_validation():
+    AdaptScanSpec()                      # defaults valid
+    AdaptScanSpec(ess_target=100.0, floor=1.0)
+    with pytest.raises(ValueError, match="floor"):
+        AdaptScanSpec(floor=0.0)
+    with pytest.raises(ValueError, match="floor"):
+        AdaptScanSpec(floor=1.5)
+    with pytest.raises(ValueError, match="ess_target"):
+        AdaptScanSpec(ess_target=-1.0)
+
+
+def test_resolve_adapt_scan_semantics():
+    from gibbs_student_t_tpu.serve.monitor import MonitorSpec
+
+    spec = AdaptScanSpec(floor=0.5)
+    mon = MonitorSpec(ess_target=10.0)
+    # 0 disables every request (the bitwise-off arm)
+    assert resolve_adapt_scan(spec, mon, env="0") is None
+    # auto honors the request
+    assert resolve_adapt_scan(spec, mon, env="auto") is spec
+    assert resolve_adapt_scan(None, mon, env="auto") is None
+    # 1 arms monitored tenants with the default policy
+    armed = resolve_adapt_scan(None, mon, env="1")
+    assert isinstance(armed, AdaptScanSpec)
+    assert resolve_adapt_scan(None, None, env="1") is None
+    assert resolve_adapt_scan(None, MonitorSpec(), env="1") is None
+    with pytest.raises(ValueError, match="AdaptScanSpec"):
+        resolve_adapt_scan({"floor": 0.5}, mon, env="auto")
+
+
+def test_param_blocks_mapping(demo):
+    ma, _ = demo
+    pidx = list(range(len(ma.param_names)))
+    blocks = param_blocks(pidx, ma.white_indices, ma.hyper_indices)
+    assert blocks.shape == (len(pidx),)
+    for j, p in enumerate(pidx):
+        if p in set(int(i) for i in ma.white_indices):
+            assert blocks[j] == 0
+        elif p in set(int(i) for i in ma.hyper_indices):
+            assert blocks[j] == 1
+        else:
+            assert blocks[j] == -1
+    # both thinnable blocks are represented in the demo model
+    assert set(blocks) >= {0, 1}
+
+
+def test_selection_probs_policy():
+    # unconverged / unmeasured blocks stay full-rate
+    probs = selection_probs({}, ess_target=100.0, floor=0.1)
+    assert np.array_equal(probs, np.ones(NBLOCKS))
+    probs = selection_probs({0: 50.0, 1: 99.0}, 100.0, 0.1)
+    assert np.array_equal(probs, np.ones(NBLOCKS))
+    # converged thinnable blocks thin to clip(target/ess, floor, 1)
+    probs = selection_probs({0: 400.0, 1: 120.0}, 100.0, 0.1)
+    assert probs[0] == pytest.approx(0.25)
+    assert probs[1] == pytest.approx(100.0 / 120.0)
+    assert np.array_equal(probs[2:], np.ones(NBLOCKS - 2))
+    # the floor wins over an extreme surplus (irreducibility)
+    probs = selection_probs({0: 1e9}, 100.0, 0.2)
+    assert probs[0] == pytest.approx(0.2)
+    # non-thinnable blocks never thin, whatever the verdicts claim
+    probs = selection_probs({3: 1e9, 6: 1e9}, 100.0, 0.1)
+    assert np.array_equal(probs, np.ones(NBLOCKS))
+    assert set(THINNABLE) == {0, 1}
+
+
+def test_draw_gates_deterministic_and_floor_bounded():
+    probs = selection_probs({0: 1000.0, 1: 500.0}, 100.0, 0.25)
+    g1 = draw_gates(probs, seed=7, tenant_id=3, sweep=25)
+    g2 = draw_gates(probs, seed=7, tenant_id=3, sweep=25)
+    assert np.array_equal(g1, g2)
+    assert g1.shape == (NBLOCKS,) and g1.dtype == np.float32
+    assert set(np.unique(g1)) <= {0.0, 1.0}
+    # a different (seed, tenant, sweep) coordinate changes the stream
+    draws = np.stack([draw_gates(probs, 7, 3, s) for s in range(400)])
+    assert len({tuple(d) for d in draws}) > 1
+    assert not np.array_equal(
+        draws, np.stack([draw_gates(probs, 8, 3, s)
+                         for s in range(400)]))
+    # full-rate blocks always fire; thinned blocks fire at ~prob with
+    # the floor keeping them alive
+    assert np.array_equal(draws[:, 2:], np.ones((400, NBLOCKS - 2)))
+    rate0 = draws[:, 0].mean()
+    assert 0.1 < rate0 < 0.45          # prob = floor = 0.25
+    assert draws[:, 0].sum() > 0       # never fully starved
+    assert 0.1 < draws[:, 1].mean() < 0.45    # floored to 0.25 too
+
+
+@pytest.mark.parametrize("var,fn", [
+    ("GST_ADAPT_SCAN", adapt_scan_env),
+    ("GST_WARM_FLOW", warm_flow_env),
+])
+def test_env_gate_validation(var, fn, monkeypatch):
+    """The loud-typo contract: only auto|1|0 parse."""
+    monkeypatch.delenv(var, raising=False)
+    assert fn() == "auto"
+    for ok in ("auto", "1", "0"):
+        monkeypatch.setenv(var, ok)
+        assert fn() == ok
+    monkeypatch.setenv(var, "yes")
+    with pytest.raises(ValueError, match=var):
+        fn()
+
+
+# ----------------------------------------------------------------------
+# flow warm-start units (jax for the training loop only; draws are
+# pure numpy — the replay contract)
+# ----------------------------------------------------------------------
+
+
+def _pilot_rows(rows=40, chains=8, p=5, seed=0):
+    rng = np.random.default_rng(seed)
+    modes = np.where(rng.random((chains, 1)) < 0.5, -2.0, 2.0)
+    data = modes[None] + 0.3 * rng.standard_normal((rows, chains, p))
+    from gibbs_student_t_tpu.models.parameter import KIND_UNIFORM
+
+    specs = np.zeros((p, 3))
+    specs[:, 0] = KIND_UNIFORM
+    specs[:, 1], specs[:, 2] = -10.0, 10.0
+    return data, specs
+
+
+def test_flow_spec_and_kind_resolution():
+    with pytest.raises(ValueError, match="kind"):
+        WarmStartSpec(kind="vae")
+    assert resolve_fit_kind("flow", env="auto") == "flow"
+    assert resolve_fit_kind("gmm", env="auto") == "gmm"
+    assert resolve_fit_kind("flow", env="0") == "gmm"
+    assert resolve_fit_kind("gmm", env="1") == "flow"
+
+
+def test_flow_fit_json_replay_bitwise():
+    """fit -> to_json -> json wire -> base from_json (kind dispatch)
+    -> draw_x0 is bitwise the live fit's draw, inside the support —
+    the recovery-replay contract without jax on the replay side."""
+    data, specs = _pilot_rows()
+    spec = WarmStartSpec(pilot_sweeps=40, kind="flow")
+    fit = fit_from_rows(data, spec, specs, pilot_ms=5.0)
+    assert isinstance(fit, FlowWarmStartFit) and fit.kind == "flow"
+    assert np.isfinite(fit.meta["nll"])
+    assert fit.flow["layers"] and fit.flow["hidden"] > 0
+
+    d = json.loads(json.dumps(fit.to_json()))
+    assert d["kind"] == "flow"
+    back = WarmStartFit.from_json(d)          # base entry point
+    assert isinstance(back, FlowWarmStartFit)
+    x_live = fit.draw_x0(16, 1234, specs)
+    x_back = back.draw_x0(16, 1234, specs)
+    assert np.array_equal(x_live, x_back)
+    assert np.all(x_live >= -10.0) and np.all(x_live <= 10.0)
+    # resolve_warm_start's dict branch dispatches the same way
+    via_resolve = resolve_warm_start(d, env="auto")
+    assert isinstance(via_resolve, FlowWarmStartFit)
+    assert np.array_equal(via_resolve.draw_x0(16, 1234, specs), x_live)
+    # determinism across seeds, variation across seeds
+    assert np.array_equal(fit.draw_x0(8, 5, specs),
+                          fit.draw_x0(8, 5, specs))
+    assert not np.array_equal(fit.draw_x0(8, 5, specs),
+                              fit.draw_x0(8, 6, specs))
+
+
+def test_flow_env_forces_and_degrades(monkeypatch):
+    data, specs = _pilot_rows()
+    # GST_WARM_FLOW=1 upgrades a gmm spec to the flow
+    monkeypatch.setenv("GST_WARM_FLOW", "1")
+    fit = fit_from_rows(data, WarmStartSpec(pilot_sweeps=40), specs)
+    assert isinstance(fit, FlowWarmStartFit)
+    # GST_WARM_FLOW=0 degrades a flow spec to the mixture — WARM,
+    # never cold, with the named reason in meta
+    monkeypatch.setenv("GST_WARM_FLOW", "0")
+    fit = fit_from_rows(data, WarmStartSpec(pilot_sweeps=40,
+                                            kind="flow"), specs)
+    assert type(fit) is WarmStartFit and fit.kind == "gmm"
+    assert fit.meta["flow_degraded"] == "GST_WARM_FLOW=0"
+
+
+def test_flow_fit_failure_degrades_to_mixture():
+    """A pilot too small to train on degrades to the moment-matched
+    mixture with the exception recorded — the silent-degradation
+    discipline, one level up from warm->cold."""
+    data, specs = _pilot_rows(rows=3, chains=1)
+    spec = WarmStartSpec(pilot_sweeps=8, burn_frac=0.0, kind="flow")
+    with pytest.warns(RuntimeWarning, match="flow warm-start"):
+        fit = fit_from_rows(data, spec, specs)
+    assert type(fit) is WarmStartFit and fit.kind == "gmm"
+    assert "flow_degraded" in fit.meta
+    # a journaled flow record without its payload refuses to
+    # reconstruct (a truncated journal must not replay as garbage)
+    with pytest.raises(ValueError, match="flow"):
+        FlowWarmStartFit.from_json({"kind": "flow", "means": [[0.0]],
+                                    "stds": [[1.0]], "weights": [1.0]})
+
+
+# ----------------------------------------------------------------------
+# serve integration: ONE shared adaptive pool (module fixture) serves
+# the thinning-e2e, per-block-progress, schema, and batched-pilot
+# tests; the gates-off bitwise arm keeps its own short-lived pool
+# ----------------------------------------------------------------------
+
+PARITY = dict(niter=15, nchains=16, seed=3, name="parity")
+
+
+def _mk_server(ma, cfg, env=None):
+    from gibbs_student_t_tpu.serve import ChainServer
+
+    old = {}
+    env = env or {}
+    for k, v in env.items():
+        old[k] = os.environ.get(k)
+        os.environ[k] = v
+    try:
+        return ChainServer(ma, cfg, nlanes=32, quantum=5,
+                           record="full", spans=False, flight=False,
+                           watchdog=False)
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+@pytest.fixture(scope="module")
+def pool_adapt(demo):
+    """The shared adaptive run: two monitored+adaptive tenants (tiny
+    ESS target so thinning engages), one monitored-only tenant (block
+    rows without a policy), and one plain parity tenant whose result
+    the gates-off arm pins bitwise — all on ONE pool compile."""
+    from gibbs_student_t_tpu.serve import (
+        ChainServer,
+        MonitorSpec,
+        TenantRequest,
+    )
+
+    ma, cfg = demo
+    srv = _mk_server(ma, cfg)
+    assert srv.pool.adaptive          # default env: operand-carrying
+    mon = MonitorSpec(ess_target=4.0, min_rows=8)
+    hs = {
+        "a0": srv.submit(TenantRequest(
+            ma=ma, niter=60, nchains=16, seed=0, name="a0",
+            monitor=mon, adapt_scan=AdaptScanSpec(floor=0.25))),
+        "a1": srv.submit(TenantRequest(
+            ma=ma, niter=40, nchains=16, seed=1, name="a1",
+            monitor=mon, adapt_scan=AdaptScanSpec(floor=0.25))),
+        "mon_only": srv.submit(TenantRequest(
+            ma=ma, niter=20, nchains=16, seed=2, name="mon_only",
+            monitor=mon)),
+        "parity": srv.submit(TenantRequest(ma=ma, **PARITY)),
+    }
+    # status() lists RUNNING tenants only — capture the live surface
+    # at quantum boundaries (the serve_top / HTTP view)
+    live_statuses = []
+
+    def on_q(server):
+        st = server.status()
+        if st.get("tenants"):
+            live_statuses.append(st)
+
+    srv.run(on_quantum=on_q)
+    results = {k: h.result() for k, h in hs.items()}
+    out = {"server": srv, "handles": hs, "results": results,
+           "summary": srv.summary(), "status": srv.status(),
+           "live_statuses": live_statuses}
+    yield out
+    srv.close()
+
+
+def test_adaptive_thinning_e2e(pool_adapt):
+    s = pool_adapt["summary"]["adapt"]
+    assert s["enabled"] is True
+    assert s["updates"] > 0
+    assert s["tenants_thinned"] >= 1
+    thinned = [h for h in (pool_adapt["handles"]["a0"],
+                           pool_adapt["handles"]["a1"])
+               if h.adapt is not None]
+    assert thinned, "no adaptive tenant ever thinned"
+    for h in thinned:
+        a = h.progress()["adapt"]
+        assert len(a["gates"]) == NBLOCKS
+        assert set(a["gates"]) <= {0, 1}
+        assert a["updates"] >= 1
+        # only thinnable blocks carry a reduced probability, floored
+        assert set(a["probs"]) <= {BLOCK_NAMES[b] for b in THINNABLE}
+        for p in a["probs"].values():
+            assert 0.25 <= p < 1.0
+    # policy replay: the journaled gates are the deterministic draw
+    # (same (seed, tenant, sweep) coordinate -> same vector shape)
+    h = thinned[0]
+    g = draw_gates(np.ones(NBLOCKS), h.request.seed, h.tenant_id,
+                   h.progress()["adapt"]["sweep"])
+    assert g.shape == (NBLOCKS,)
+    # the unmonitored / un-adaptive tenants never grew an adapt view
+    assert pool_adapt["handles"]["mon_only"].adapt is None
+    assert pool_adapt["handles"]["parity"].adapt is None
+
+
+def test_block_progress_rows_and_schema(pool_adapt):
+    from gibbs_student_t_tpu.obs import schema as obs_schema
+
+    schemas = obs_schema.load_schemas()
+    obs_schema.assert_valid(pool_adapt["status"],
+                            schemas["serve_status"],
+                            "post-run status()", defs=schemas)
+    for st in pool_adapt["live_statuses"][-3:]:
+        obs_schema.assert_valid(st, schemas["serve_status"],
+                                "live status()", defs=schemas)
+    for name in ("a0", "a1", "mon_only"):
+        p = pool_adapt["handles"][name].progress()
+        blocks = p.get("blocks")
+        assert blocks, f"{name}: per-block rows missing"
+        assert set(blocks) <= set(BLOCK_NAMES)
+        assert {"white", "hyper"} <= set(blocks)
+        for row in blocks.values():
+            assert row["params"] >= 1
+            assert np.isfinite(row["ess_min"])
+            assert isinstance(row["converged"], bool)
+    # the live status surface carried the same per-block rows (and
+    # the adapt view once thinning engaged) for the running tenants
+    live_blocks = [t for st in pool_adapt["live_statuses"]
+                   for t in st["tenants"] if t.get("blocks")]
+    assert live_blocks, "no live status row ever carried blocks"
+    live_adapt = [t for st in pool_adapt["live_statuses"]
+                  for t in st["tenants"] if t.get("adapt")]
+    assert live_adapt, "no live status row ever carried adapt"
+
+
+def test_adapt_scan_requires_convergence_evidence(pool_adapt, demo):
+    """Submit-side contract: an adaptive policy without a monitor (or
+    without any ESS target to grade blocks by) rejects loudly."""
+    from gibbs_student_t_tpu.serve import MonitorSpec, TenantRequest
+
+    ma, _ = demo
+    srv = pool_adapt["server"]
+    with pytest.raises(ValueError, match="monitor"):
+        srv.submit(TenantRequest(ma=ma, niter=10, nchains=16, seed=9,
+                                 adapt_scan=AdaptScanSpec()))
+    with pytest.raises(ValueError, match="ess_target"):
+        srv.submit(TenantRequest(ma=ma, niter=10, nchains=16, seed=9,
+                                 monitor=MonitorSpec(),
+                                 adapt_scan=AdaptScanSpec()))
+    with pytest.raises(ValueError, match="AdaptScanSpec"):
+        srv.submit(TenantRequest(ma=ma, niter=10, nchains=16, seed=9,
+                                 monitor=MonitorSpec(ess_target=4.0),
+                                 adapt_scan={"floor": 0.5}))
+
+
+def test_gates_off_bitwise(pool_adapt, demo):
+    """THE GST_ADAPT_SCAN=0 pin: the operand-free pool serves the
+    parity request bitwise identical to the default pool — which ran
+    it co-resident with actively-thinned tenants."""
+    from gibbs_student_t_tpu.serve import TenantRequest
+
+    ma, cfg = demo
+    srv = _mk_server(ma, cfg, env={"GST_ADAPT_SCAN": "0"})
+    try:
+        assert srv.pool.adaptive is False
+        h = srv.submit(TenantRequest(ma=ma, **PARITY))
+        srv.run()
+        res = h.result()
+    finally:
+        srv.close()
+    ref = pool_adapt["results"]["parity"]
+    for f in EXACT_OR_ROUNDOFF_FIELDS:
+        assert np.array_equal(np.asarray(getattr(res, f)),
+                              np.asarray(getattr(ref, f))), f
+    for k in ("acc_white", "acc_hyper"):
+        assert np.array_equal(res.stats[k], ref.stats[k]), k
+
+
+def test_pilot_batching_rides_one_wave(pool_adapt, demo):
+    """The batched-pilot pin on the SHARED pool (no new compile):
+    three co-queued warm tenants -> at least one wave, riders served
+    from the wave cache, and the riders' pilot walls NOT billed to
+    pilot_ms_total (the admission-latency economics of the fix)."""
+    from gibbs_student_t_tpu.serve import TenantRequest
+
+    ma, _ = demo
+    srv = pool_adapt["server"]
+    before = srv.summary()["warm"]
+    spec = WarmStartSpec(pilot_sweeps=10, pilot_chains=8)
+    hs = [srv.submit(TenantRequest(ma=ma, niter=10, nchains=16,
+                                   seed=20 + i, name=f"w{i}",
+                                   warm_start=spec))
+          for i in range(3)]
+    srv.run()
+    for h in hs:
+        h.result()
+        assert h.warm is not None and "batched" in h.warm
+    after = srv.summary()["warm"]
+    assert after["warm_starts"] - before["warm_starts"] == 3
+    assert after["pilot_batches"] > before["pilot_batches"]
+    n_batched = sum(1 for h in hs if h.warm["batched"])
+    assert after["pilot_batched_fits"] - before["pilot_batched_fits"] \
+        == n_batched >= 1
+    # accounting: only the non-batched (wave-primary) pilots' walls
+    # are billed — a batched rider pays ZERO staging-serialized wait
+    solo_ms = sum(h.warm["pilot_ms"] for h in hs
+                  if not h.warm["batched"])
+    assert after["pilot_ms_total"] - before["pilot_ms_total"] \
+        == pytest.approx(solo_ms, abs=0.5)
+    # the wave cache fully drained (nothing leaks across workloads)
+    assert srv._pilot_fits == {}
+
+
+def test_flow_fit_serves_and_degrades_on_pool(pool_adapt, demo,
+                                              monkeypatch):
+    """Flow warm starts through the real staging pilot on the shared
+    pool: the fit kind lands on the handle, and GST_WARM_FLOW=0
+    downgrades to the mixture with the named event counter — still
+    warm, never cold."""
+    from gibbs_student_t_tpu.serve import TenantRequest
+
+    ma, _ = demo
+    srv = pool_adapt["server"]
+    spec = WarmStartSpec(pilot_sweeps=10, pilot_chains=8, kind="flow")
+    h = srv.submit(TenantRequest(ma=ma, niter=10, nchains=16, seed=30,
+                                 name="fw", warm_start=spec))
+    srv.run()
+    h.result()
+    assert h.warm["kind"] == "flow"
+    assert "flow_degraded" not in h.warm
+    assert srv.summary()["warm"]["flow_fits"] >= 1
+    before = srv.summary()["warm"]["flow_degraded"]
+    monkeypatch.setenv("GST_WARM_FLOW", "0")
+    h2 = srv.submit(TenantRequest(ma=ma, niter=10, nchains=16, seed=31,
+                                  name="fw0", warm_start=spec))
+    srv.run()
+    h2.result()
+    assert h2.warm["kind"] == "gmm"
+    assert h2.warm["flow_degraded"] == "GST_WARM_FLOW=0"
+    assert srv.summary()["warm"]["flow_degraded"] == before + 1
+
+
+# ----------------------------------------------------------------------
+# recovery replay (slow tier: three pool compiles)
+# ----------------------------------------------------------------------
+
+
+def _native_available():
+    from gibbs_student_t_tpu import native
+
+    return native.available()
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not _native_available(),
+                    reason="spooling needs the native library")
+def test_flow_warm_recover_replay_bitwise(demo, tmp_path):
+    """The journal/replay pin for kind="flow": the manifest admit
+    record carries the flow fit JSON; a tenant that dies before its
+    first surviving checkpoint restarts from scratch through
+    ``recover()``, re-draws the SAME flow init from the journaled
+    parameters (no pilot, no training), and the chains are bitwise an
+    uninterrupted flow-warm run."""
+    from gibbs_student_t_tpu.serve import ChainServer, TenantRequest
+    from gibbs_student_t_tpu.serve.manifest import read_manifest
+
+    ma, cfg = demo
+    spec = WarmStartSpec(pilot_sweeps=10, pilot_chains=8, kind="flow")
+
+    # uninterrupted reference — SERIAL driver throughout this test:
+    # the crashed server must run serial (step() drives staging), and
+    # the serial standalone pilot's fit is the one its manifest
+    # journals, so the reference must grow its fit from the same path
+    ref_srv = ChainServer(ma, cfg, nlanes=32, quantum=5,
+                          record="full", pipeline=False)
+    ref_h = ref_srv.submit(TenantRequest(ma=ma, niter=20, nchains=16,
+                                         seed=5, name="F",
+                                         warm_start=spec))
+    ref_srv.run()
+    ref = ref_h.result()
+    ref_srv.close()
+    assert ref_h.warm["kind"] == "flow"
+
+    man = str(tmp_path / "man")
+    spool = str(tmp_path / "sF")
+    srv = ChainServer(ma, cfg, nlanes=32, quantum=5, record="full",
+                      pipeline=False, manifest_dir=man)
+    srv.submit(TenantRequest(ma=ma, niter=20, nchains=16, seed=5,
+                             name="F", spool_dir=spool,
+                             warm_start=spec))
+    for _ in range(2):
+        srv.step()
+    del srv          # the in-process "kill": no close, no finalize
+    # the admit record journaled the FLOW fit (payload and all)
+    admits = [r for r in read_manifest(man)
+              if r.get("kind") == "admit"]
+    assert admits and admits[-1]["warm"]["kind"] == "flow"
+    assert admits[-1]["warm"]["flow"]["layers"]
+    # the spool died with the process before any checkpoint survived:
+    # recovery must restart from scratch -> the journaled-fit replay
+    import shutil
+
+    shutil.rmtree(spool)
+
+    srv2, handles = ChainServer.recover(man, pipeline=False)
+    srv2.run()
+    srv2.close()
+    res = handles["F"].result()
+    assert handles["F"].warm["kind"] == "flow"
+    assert handles["F"].warm["replayed"] is True
+    for f in EXACT_OR_ROUNDOFF_FIELDS:
+        assert np.array_equal(np.asarray(getattr(res, f)),
+                              np.asarray(getattr(ref, f))), f

@@ -58,6 +58,7 @@ def test_ensemble_adapt_cov_per_pulsar():
         np.asarray(ens2.last_state.mh_cov_chol), L)
 
 
+@pytest.mark.slow  # round-18 re-tier (~11 s: statistical adaptation trajectory)
 def test_acceptance_moves_toward_multivariate_target(ma):
     cfg_f = _cfg()
     cfg_c = cfg_f.with_adapt(150, adapt_cov=True)
@@ -86,6 +87,7 @@ def test_acceptance_moves_toward_multivariate_target(ma):
         assert abs(a[:, pi].mean() - b[:, pi].mean()) < 0.6 * sd
 
 
+@pytest.mark.slow  # round-18 re-tier (~13 s: statistical adaptation freeze)
 def test_frozen_after_adapt_until(ma):
     cfg = _cfg().with_adapt(40, adapt_cov=True)
     gb = JaxGibbs(ma, cfg, nchains=8, chunk_size=20)

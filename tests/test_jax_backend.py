@@ -70,6 +70,7 @@ def test_determinism_and_chain_independence(ma):
     assert not np.allclose(r1.chain[-1, 0], r1.chain[-1, 1])
 
 
+@pytest.mark.slow  # round-18 re-tier (~17 s: per-lane bitwise decomposition; chain determinism stays tier-1 via test_determinism_and_chain_independence)
 def test_vmap_consistency(ma):
     """Chain k of a vmapped run must equal a 1-chain run with chain k's key
     and initial state (SURVEY.md §4). Run in f64: in f32 the batched vs.
@@ -128,6 +129,7 @@ def test_all_models_run_finite(ma, model, kwargs):
         assert (res.dfchain == cfg.tdf).all()
 
 
+@pytest.mark.slow  # round-18 re-tier (~17 s: resume bitwise stays tier-1 via test_tenant_spool_checkpoint_resume + test_native thin-resume)
 def test_resume_matches_unbroken_run(ma):
     """Chunk-boundary resume reproduces an unbroken run exactly — the
     checkpoint/resume guarantee (SURVEY.md §5)."""
@@ -170,6 +172,7 @@ def test_sample_until_converges_and_matches_plain_run(ma):
                                   res.stats["rhat_history"])
 
 
+@pytest.mark.slow  # round-18 re-tier (~22 s: ESS-gated stop; the convergence semantic stays tier-1 via test_sample_until_converges_and_matches_plain_run)
 def test_sample_until_min_ess_gates_stopping(ma):
     """min_ess is the complementary stop criterion: an easily-met R-hat
     with an unreachable ESS floor must run to max_sweeps, and a

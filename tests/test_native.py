@@ -150,6 +150,7 @@ def test_spool_append_truncates_orphaned_rows(tmp_path):
                            keep_rows=99)
 
 
+@pytest.mark.slow  # round-18 re-tier (~17 s: spool append; thin-resume keeps the spool contract tier-1)
 def test_jax_sample_spool_resume_appends(tmp_path, demo_ma):
     """Kill/resume flow: run 6 sweeps, 'crash', resume 4 more from the
     checkpoint — the spool must contain all 10 and match an unbroken run."""
@@ -180,6 +181,7 @@ def jnp_asarray(x):
     return jnp.asarray(x)
 
 
+@pytest.mark.slow  # round-18 re-tier (~17 s: spool dedup under sample_until)
 def test_sample_until_spool_no_duplication(tmp_path, demo_ma):
     """sample_until with a spool: each segment's sample() reloads the
     FULL spool, so the implementation must keep only the latest result —
@@ -227,6 +229,7 @@ def test_jax_sample_spool_thin_resume(tmp_path, demo_ma):
     assert int(out.stats["record_thin"]) == 2
 
 
+@pytest.mark.slow  # round-18 re-tier (~15 s: spooled-vs-inmemory parity; thin-rows parity stays tier-1)
 def test_jax_sample_spooled_matches_inmemory(tmp_path, demo_ma):
     from gibbs_student_t_tpu.backends import JaxGibbs
     from gibbs_student_t_tpu.config import GibbsConfig

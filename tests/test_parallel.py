@@ -20,6 +20,7 @@ from gibbs_student_t_tpu.parallel.diagnostics import rhat_collective
 from tests.conftest import make_demo_pta, make_demo_pulsar
 
 
+@pytest.mark.slow  # round-18 re-tier (~27 s: multihost fallback sweep)
 def test_multihost_single_process_fallbacks():
     """Single-process degenerate paths of the DCN-tier helpers: the hybrid
     mesh reduces to a local mesh (DCN axis first/slowest), initialization

@@ -272,6 +272,7 @@ def test_aot_cache_writes_are_atomic(tmp_path, fresh_registry):
     assert not [f for f in os.listdir(tmp_path) if ".tmp" in f]
 
 
+@pytest.mark.slow  # round-18 re-tier (~29 s: two full cold-start compiles; cache key/probe/degrade pins stay tier-1)
 def test_chains_bitwise_with_and_without_cold_start_caches(
         tmp_path, fresh_registry):
     """THE pinned contract: arming the persistent cold-start caches

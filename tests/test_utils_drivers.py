@@ -40,6 +40,7 @@ def test_checkpoint_roundtrip_resume(ma, tmp_path):
     np.testing.assert_array_equal(full.chain[10:], resumed.chain)
 
 
+@pytest.mark.slow  # round-18 re-tier (~16 s: back-compat checkpoint replay)
 def test_checkpoint_backcompat_missing_new_fields(ma, tmp_path):
     """Checkpoints written before a ChainState field existed load with
     the field at its neutral value — old spools stay resumable."""

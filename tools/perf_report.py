@@ -248,6 +248,25 @@ def print_report(ledger_recs, include_rounds=True):
                       f"vs evict) warm_starts={wm.get('warm_starts')} "
                       f"degraded={wm.get('warm_degraded')} "
                       f"pilot_ms={wm.get('pilot_ms_total')}")
+                # round-18 records: fit family + batched-pilot waves
+                if wm.get("kind") is not None:
+                    print(f"      kind={wm.get('kind')} "
+                          f"flow_fits={wm.get('flow_fits')} "
+                          f"flow_degraded={wm.get('flow_degraded')} "
+                          f"pilot_batches={wm.get('pilot_batches')} "
+                          f"batched_fits="
+                          f"{wm.get('pilot_batched_fits')}")
+            # adaptive-block-scan sub-line (round-18 --adaptive-arm
+            # records): jobs/hour with converged-block thinning
+            ad = m.get("adapt")
+            if isinstance(ad, dict):
+                print(f"    adapt jobs/h {ad.get('jobs_per_hour')} "
+                      f"(evict {ad.get('jobs_per_hour_evict')} / base "
+                      f"{ad.get('jobs_per_hour_base')}; "
+                      f"{(ad.get('gain_vs_evict') or 0) * 100:+.1f}% "
+                      f"vs evict) updates={ad.get('updates')} "
+                      f"tenants_thinned={ad.get('tenants_thinned')} "
+                      f"ess_min_mean={ad.get('ess_min_mean')}")
             rcy = m.get("recycle")
             if isinstance(rcy, dict):
                 print(f"    recycle rows x{rcy.get('row_multiplier')} "
@@ -356,16 +375,30 @@ def print_report(ledger_recs, include_rounds=True):
                   f"{rec.get('platform') or '?':8s} {brief}")
 
 
+def _flagship_serve(ledger_recs):
+    """The serve_bench records the gates grade: flagship shapes only.
+    A ``--quick`` smoke run (64 lanes, 6 tenants) is a different
+    workload, not a point on the flagship series — letting it grade
+    the occupancy/capacity/trend gates reads a deliberate small shape
+    as a fleet regression."""
+    return [r for r in ledger_recs
+            if r.get("tool") == "serve_bench"
+            and not (r.get("metrics") or {}).get("quick")]
+
+
 def _metric_series(ledger_recs):
     """``{(metric, platform): [values...]}`` in ledger order, over the
     bench + serve_bench records with a usable numeric headline value —
-    the per-series history the trend gate and sparkline table fold."""
+    the per-series history the trend gate and sparkline table fold.
+    Quick-shape serve records are excluded (see _flagship_serve)."""
     out = {}
     for rec in ledger_recs:
         if rec.get("tool") not in ("bench", "serve_bench",
                                    "fleet_bench"):
             continue
         m = rec.get("metrics") or {}
+        if rec.get("tool") == "serve_bench" and m.get("quick"):
+            continue
         name, value = m.get("metric"), m.get("value")
         if not name or not isinstance(value, (int, float)) \
                 or isinstance(value, bool):
@@ -622,9 +655,9 @@ def check_faults(ledger_recs, max_fault_rate, min_fault_ratio):
     of the same run's no-fault arm. Skipped (0) when no faults-arm
     record exists — the gate arms itself the first time the chaos arm
     lands a record."""
-    serve = [r for r in ledger_recs if r.get("tool") == "serve_bench"
-             and isinstance((r.get("metrics") or {}).get("faults"),
-                            dict)]
+    serve = [r for r in _flagship_serve(ledger_recs)
+             if isinstance((r.get("metrics") or {}).get("faults"),
+                           dict)]
     if not serve:
         print("check: no serve_bench --faults record — fault gate "
               "skipped")
@@ -673,7 +706,7 @@ def check_obs(ledger_recs, max_obs_overhead, max_admission_p99):
     boundary/staging work is the liveness regression the SLO surface
     exists to catch; queue-wait under deliberate backpressure is
     included, hence the loose default)."""
-    serve = [r for r in ledger_recs if r.get("tool") == "serve_bench"]
+    serve = _flagship_serve(ledger_recs)
     if not serve:
         print("check: no serve_bench record — obs gate skipped")
         return 0
@@ -721,7 +754,7 @@ def check_serve(ledger_recs, min_occupancy, min_serve_ratio,
     stage-set reporting. Returns the exit code contribution (0 when
     no serving record exists — a bench-only ledger is not a serving
     regression)."""
-    serve = [r for r in ledger_recs if r.get("tool") == "serve_bench"]
+    serve = _flagship_serve(ledger_recs)
     if not serve:
         print("check: no serve_bench record — serving gate skipped")
         return 0
@@ -797,7 +830,7 @@ def check_ess_per_core(ledger_recs, min_ess_per_core_s):
     SKIPS when the record carries no monitored cost evidence (monitor
     absent / --no-obs-arm style runs): a run that measured nothing is
     not a regression."""
-    serve = [r for r in ledger_recs if r.get("tool") == "serve_bench"]
+    serve = _flagship_serve(ledger_recs)
     if not serve:
         print("check: no serve_bench record — ess/core-s gate skipped")
         return 0
@@ -821,6 +854,78 @@ def check_ess_per_core(ledger_recs, min_ess_per_core_s):
               "statistics: check the recycle/warm blocks and the "
               "evict arm)")
         return 2
+    return 0
+
+
+def check_capacity_arms(ledger_recs, min_adaptive_gain):
+    """Round-18 economics gates over the latest ``serve_bench``
+    record's warm/adapt blocks.
+
+    Warm-arm gate semantics FIX: a warm arm that LOSES to the evict
+    baseline at the flagship is an HONEST NEGATIVE — it is named here
+    with the measured evidence the record carries (batched-pilot
+    counts tell whether the loss is still admission-latency-bound)
+    instead of being folded into a trend series, where a real
+    capacity miss would read as host noise and a real win would be
+    invisible. Never fails on the warm arm.
+
+    The adaptive gate (``--min-adaptive-gain``, percent vs the evict
+    baseline) is RECORD-ONLY at the default 0 floor — jnp-masked
+    thinning computes-and-discards on backends without real
+    predication, so a negative gain is an expected, documented
+    outcome there; a positive floor arms the gate once a flagship
+    baseline earns it."""
+    serve = _flagship_serve(ledger_recs)
+    if not serve:
+        print("check: no serve_bench record — capacity-arm gates "
+              "skipped")
+        return 0
+    m = serve[-1].get("metrics") or {}
+    wm = m.get("warm")
+    if isinstance(wm, dict):
+        g = wm.get("gain_vs_evict")
+        if isinstance(g, (int, float)) and g < 0:
+            batches = wm.get("pilot_batches")
+            batched = wm.get("pilot_batched_fits")
+            if batches:
+                why = (f"{batched} of {wm.get('warm_starts')} pilot "
+                       f"fits rode {batches} batched wave(s), so the "
+                       "loss is NOT pilot serialization — the pilot "
+                       f"compute itself ({wm.get('pilot_ms_total')} "
+                       "ms) is not paying back at this ESS target")
+            else:
+                why = ("no batched pilot waves ran — pilots "
+                       "serialized on the staging thread (the PR 14 "
+                       "failure mode)")
+            print(f"check: NOTE — warm arm HONEST NEGATIVE: "
+                  f"{g * 100:+.1f}% jobs/h vs evict at equal "
+                  f"delivered ESS; {why}")
+        elif isinstance(g, (int, float)):
+            print(f"check: warm arm {g * 100:+.1f}% jobs/h vs evict "
+                  "(capacity win at equal delivered ESS)")
+    ad = m.get("adapt")
+    if isinstance(ad, dict):
+        g = ad.get("gain_vs_evict")
+        gpct = g * 100 if isinstance(g, (int, float)) else None
+        armed = min_adaptive_gain > 0
+        print(f"check: adapt gain_vs_evict "
+              + (f"{gpct:+.1f}%" if gpct is not None else "n/a")
+              + f" (min {min_adaptive_gain}%"
+              + ("" if armed else "; record-only at <= 0") + "), "
+              f"updates={ad.get('updates')} "
+              f"tenants_thinned={ad.get('tenants_thinned')}")
+        if gpct is not None and gpct < 0 and not armed:
+            print("check: NOTE — adaptive arm honest negative: "
+                  f"{gpct:+.1f}% vs evict (masked thinning computes-"
+                  "and-discards on backends without predication; the "
+                  "gates-off path stays bitwise-pinned)")
+        if armed and (gpct is None or gpct < min_adaptive_gain):
+            print(f"check: FAIL — adaptive-scan gain "
+                  + (f"{gpct:+.1f}%" if gpct is not None else "n/a")
+                  + f" < {min_adaptive_gain}% vs the evict baseline "
+                  "(converged-block thinning is not buying capacity "
+                  "at the flagship shape)")
+            return 2
     return 0
 
 
@@ -1180,6 +1285,17 @@ def main(argv=None):
                          "monitored cost evidence. Default 0 = "
                          "record-only until a flagship baseline arms "
                          "a floor")
+    ap.add_argument("--min-adaptive-gain", type=float, default=0.0,
+                    metavar="PCT",
+                    help="adaptive-scan gate: minimum jobs/hour gain "
+                         "(percent vs the evict baseline at equal "
+                         "delivered ESS) the latest serve_bench "
+                         "record's adapt block must report. Default "
+                         "0 = record-only (masked thinning computes-"
+                         "and-discards on backends without real "
+                         "predication — an honest negative is an "
+                         "expected outcome there); a positive floor "
+                         "arms the gate")
     ap.add_argument("--min-fleet-ratio", type=float, default=3.5,
                     metavar="X",
                     help="fleet gate: minimum aggregate/single-pool "
@@ -1259,6 +1375,7 @@ def main(argv=None):
                                args.max_fleet_admission_p99)
         rc_fleet_trace = check_fleet_trace(recs)
         rc_ess = check_ess_per_core(recs, args.min_ess_per_core_s)
+        rc_cap = check_capacity_arms(recs, args.min_adaptive_gain)
         rc_cold = check_coldstart(recs, args.max_coldstart_ms,
                                   args.min_coldstart_speedup)
         rc_mig = check_migrate(recs)
@@ -1266,8 +1383,8 @@ def main(argv=None):
                                window=args.trend_window,
                                points=args.trend_points)
         return (rc or rc_serve or rc_obs or rc_faults or rc_fleet
-                or rc_fleet_trace or rc_ess or rc_cold or rc_mig
-                or rc_trend)
+                or rc_fleet_trace or rc_ess or rc_cap or rc_cold
+                or rc_mig or rc_trend)
     return 0
 
 

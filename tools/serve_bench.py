@@ -82,7 +82,7 @@ def main(argv=None):
     ap.add_argument("--tenants", type=int, default=24,
                     help="total jobs in the mixed workload (round 11 "
                          "default 12 -> 24: a 12-job burst spends "
-                         "~10% of its lane-quanta in the drain-down "
+                         "~10%% of its lane-quanta in the drain-down "
                          "tail, which measures burst shutdown, not "
                          "serving capacity — the longer steady phase "
                          "is what occupancy should grade)")
@@ -157,10 +157,29 @@ def main(argv=None):
                          "cost per tenant; serve/warm.py)")
     ap.add_argument("--pilot-chains", type=int, default=8,
                     help="warm-start pilot chains")
+    ap.add_argument("--warm-kind", choices=("gmm", "flow"),
+                    default="gmm",
+                    help="warm-start fit family (round 18): 'flow' "
+                         "trains a masked-affine flow on the pilot "
+                         "mixture (serve/warm.py, GST_WARM_FLOW) "
+                         "instead of the moment match")
+    ap.add_argument("--adaptive-arm", action="store_true",
+                    help="with --evict-arm: repeat the evict workload "
+                         "with adaptive block scans on every tenant "
+                         "(serve/adapt.py, GST_ADAPT_SCAN): converged "
+                         "conditional blocks thin to a learned "
+                         "selection probability at quantum "
+                         "boundaries, so sweep wall concentrates on "
+                         "the slow blocks — the record gains an "
+                         "'adapt' block (jobs/hour vs the evict and "
+                         "base arms at the same --ess-target)")
     args = ap.parse_args(argv)
     if args.warm_arm and not args.evict_arm:
         ap.error("--warm-arm requires --evict-arm (it is the evict "
                  "workload with warm starts)")
+    if args.adaptive_arm and not args.evict_arm:
+        ap.error("--adaptive-arm requires --evict-arm (it is the "
+                 "evict workload with adaptive block scans)")
     if args.quick:
         args.nlanes = 64
         args.tenants = 6
@@ -288,7 +307,8 @@ def main(argv=None):
             ma=template, niter=args.quantum, nchains=srv.pool.group,
             seed=args.seed,
             warm_start=(WarmStartSpec(pilot_sweeps=args.pilot_sweeps,
-                                      pilot_chains=args.pilot_chains)
+                                      pilot_chains=args.pilot_chains,
+                                      kind=args.warm_kind)
                         if warm_warmup else None)))
         srv.run()
         w.result()
@@ -530,7 +550,8 @@ def main(argv=None):
     warm_block = None
     if args.warm_arm:
         wspec = WarmStartSpec(pilot_sweeps=args.pilot_sweeps,
-                              pilot_chains=args.pilot_chains)
+                              pilot_chains=args.pilot_chains,
+                              kind=args.warm_kind)
         wmods = {i: {"on_converged": "evict", "warm_start": wspec}
                  for i in range(args.tenants)}
         whandles, wwall, wsummary = run_workload(wmods,
@@ -568,13 +589,82 @@ def main(argv=None):
             "pilot_sweeps": args.pilot_sweeps,
             "pilot_chains": args.pilot_chains,
             "pilot_ms_total": wsummary["warm"]["pilot_ms_total"],
+            # batched pilots (round 18): co-queued warm tenants'
+            # pilots ride one staging wave instead of serializing —
+            # each batched fit is one pilot wall NOT paid as
+            # admission latency
+            "kind": args.warm_kind,
+            "pilot_batches": wsummary["warm"]["pilot_batches"],
+            "pilot_batched_fits":
+                wsummary["warm"]["pilot_batched_fits"],
+            "flow_fits": wsummary["warm"]["flow_fits"],
+            "flow_degraded": wsummary["warm"]["flow_degraded"],
         }
         print(f"# warm arm: {warm_jph:.1f} jobs/h vs evict "
               f"{evict_jph} / base {base_jph:.1f} "
               f"({(warm_block['gain_vs_evict'] or 0) * 100:+.1f}% vs "
               f"evict at equal ESS budget; "
-              f"{warm_block['warm_starts']} warm starts, "
+              f"{warm_block['warm_starts']} warm starts "
+              f"[{args.warm_kind}], "
+              f"{warm_block['pilot_batched_fits']} batched of "
+              f"{warm_block['pilot_batches']} waves, "
               f"{warm_block['pilot_ms_total']:.0f} ms pilot total)",
+              file=sys.stderr)
+
+    # ---- adaptive-block-scan arm (round 18; serve/adapt.py) -----------
+    # The evict workload again, every tenant armed with an
+    # AdaptScanSpec: at each quantum boundary the server maps the
+    # streaming monitor's per-param ESS onto conditional blocks and
+    # thins CONVERGED thinnable blocks to a learned selection
+    # probability (random-scan Gibbs with a floor), fed to the pool as
+    # a per-lane call-time operand — sweep wall concentrates on the
+    # blocks that still need it, at the same delivered-ESS budget.
+    adapt_block = None
+    if args.adaptive_arm:
+        from gibbs_student_t_tpu.serve.adapt import AdaptScanSpec
+
+        amods = {i: {"on_converged": "evict",
+                     "adapt_scan": AdaptScanSpec()}
+                 for i in range(args.tenants)}
+        ahandles, awall, asummary = run_workload(amods, demand=True)
+        abad = [h for h in ahandles if h.status != "done"]
+        if abad:
+            raise RuntimeError(
+                f"{len(abad)} tenant(s) failed in the adaptive arm: "
+                + "; ".join(str(h.error) for h in abad[:3]))
+        adapt_jph = args.tenants / (awall / 3600.0)
+        base_jph = args.tenants / (wall / 3600.0)
+        evict_jph = (evict_block["jobs_per_hour"]
+                     if evict_block else None)
+        asweeps = sum(h.sweeps_done for h in ahandles)
+        bsweeps = sum(h.sweeps_done for h in handles)
+        a_ess = [h.progress().get("ess_min") for h in ahandles]
+        a_ess = [v for v in a_ess if isinstance(v, (int, float))]
+        asum = asummary.get("adapt") or {}
+        adapt_block = {
+            "jobs_per_hour": round(adapt_jph, 2),
+            "jobs_per_hour_evict": evict_jph,
+            "jobs_per_hour_base": round(base_jph, 2),
+            "gain_vs_evict": (round(adapt_jph / evict_jph - 1.0, 4)
+                              if evict_jph else None),
+            "gain_vs_base": round(adapt_jph / base_jph - 1.0, 4),
+            "wall_s": round(awall, 3),
+            "converged_evictions": asummary["converged_evictions"],
+            "sweeps_saved_frac": (round(1.0 - asweeps / bsweeps, 4)
+                                  if bsweeps else None),
+            "ess_min_mean": (round(float(np.mean(a_ess)), 1)
+                             if a_ess else None),
+            "ess_target": args.ess_target,
+            "enabled": bool(asum.get("enabled")),
+            "updates": asum.get("updates", 0),
+            "tenants_thinned": asum.get("tenants_thinned", 0),
+        }
+        print(f"# adaptive arm: {adapt_jph:.1f} jobs/h vs evict "
+              f"{evict_jph} / base {base_jph:.1f} "
+              f"({(adapt_block['gain_vs_evict'] or 0) * 100:+.1f}% vs "
+              f"evict at equal ESS budget; "
+              f"{adapt_block['updates']} gate updates on "
+              f"{adapt_block['tenants_thinned']} tenants)",
               file=sys.stderr)
 
     # ---- recycling Gibbs accounting (ROADMAP 4a) ----------------------
@@ -838,6 +928,10 @@ def main(argv=None):
         # warm-start economics (ROADMAP 4b): the evict workload with
         # pilot-mixture inits — the capacity-per-dollar flagship
         line["warm"] = warm_block
+    if adapt_block is not None:
+        # adaptive-block-scan economics (round 18; serve/adapt.py):
+        # the evict workload with converged-block thinning
+        line["adapt"] = adapt_block
     if recycle_block is not None:
         line["recycle"] = recycle_block
     if model_cache_block is not None:
