@@ -148,9 +148,12 @@ def fleet_merge(results) -> dict:
     disagree on semantics."""
     pools = []
     raw = {leg: [] for leg in SLO_LEGS}
+    tier_raw: dict = {}
     totals = {"nlanes": 0, "busy_lanes": 0, "queue_depth": 0,
               "staged": 0, "running_tenants": 0}
     n_converged = 0
+    sched = {"preemptions": 0, "sheds": 0}
+    queue_tiers: dict = {}
     for src, st in results:
         if not isinstance(st, dict):
             e = st
@@ -168,13 +171,40 @@ def fleet_merge(results) -> dict:
         for leg in SLO_LEGS:
             raw[leg].extend(v for v in (slo_raw.get(leg) or [])
                             if isinstance(v, (int, float)))
+        # per-tier raw series (round 20): same concatenate-then-
+        # percentile discipline as the aggregate legs
+        for tier, legs in (slo_raw.get("tiers") or {}).items():
+            if not isinstance(legs, dict):
+                continue
+            dst = tier_raw.setdefault(
+                str(tier), {leg: [] for leg in SLO_LEGS})
+            for leg in SLO_LEGS:
+                dst[leg].extend(v for v in (legs.get(leg) or [])
+                                if isinstance(v, (int, float)))
         nc = (st.get("slo") or {}).get("n_converged")
         if isinstance(nc, (int, float)):
             n_converged += int(nc)
+        # scheduling counters (round 20): summed over reachable pools
+        sb = st.get("sched")
+        if isinstance(sb, dict):
+            for k in ("preemptions", "sheds"):
+                v = sb.get(k)
+                if isinstance(v, (int, float)):
+                    sched[k] += int(v)
+            for tier, d in (sb.get("queue_tiers") or {}).items():
+                if isinstance(d, (int, float)):
+                    queue_tiers[str(tier)] = \
+                        queue_tiers.get(str(tier), 0) + int(d)
     totals["occupancy_now"] = (totals["busy_lanes"] / totals["nlanes"]
                                if totals["nlanes"] else 0.0)
     slo = {leg: _percentiles(raw[leg]) for leg in SLO_LEGS}
     slo["n_converged"] = n_converged
+    if tier_raw:
+        slo["tiers"] = {
+            tier: {leg: _percentiles(vals)
+                   for leg, vals in legs.items()}
+            for tier, legs in sorted(tier_raw.items())}
+    sched["queue_tiers"] = queue_tiers
     return {
         "schema": FLEET_SCHEMA,
         "t": round(time.time(), 3),
@@ -183,6 +213,7 @@ def fleet_merge(results) -> dict:
         "pools": pools,
         "totals": totals,
         "slo": slo,
+        "sched": sched,
     }
 
 
@@ -206,7 +237,19 @@ def render_fleet(snap: dict, out) -> None:
         print(f"router placements: {placed or '-'}  "
               f"failovers={router.get('failovers', 0)} "
               f"resubmitted={router.get('resubmitted', 0)} "
-              f"dead_pools={router.get('dead_pools', 0)}", file=out)
+              f"dead_pools={router.get('dead_pools', 0)} "
+              f"sheds={router.get('sheds', 0)}", file=out)
+    # scheduling layer (round 20): fleet preemption/shed totals and
+    # the per-tier door-queue depths behind the aggregate queue figure
+    sched = snap.get("sched")
+    if isinstance(sched, dict) and (sched.get("preemptions")
+                                    or sched.get("sheds")
+                                    or sched.get("queue_tiers")):
+        qt = " ".join(f"t{k}={v}" for k, v in
+                      sorted((sched.get("queue_tiers") or {}).items()))
+        print(f"sched preemptions={sched.get('preemptions', 0)} "
+              f"sheds={sched.get('sheds', 0)} "
+              f"queue_tiers: {qt or '-'}", file=out)
     slo = snap.get("slo") or {}
     for leg in SLO_LEGS:
         p = slo.get(leg)
@@ -214,6 +257,16 @@ def render_fleet(snap: dict, out) -> None:
             print(f"slo {leg:16s} p50={p.get('p50'):>8} "
                   f"p90={p.get('p90'):>8} p99={p.get('p99'):>8} "
                   f"(merged from raw series)", file=out)
+    # per-tier SLO rows (round 20): the high tier's p99 under overload
+    # is the headline the scheduler is graded on
+    for tier, legs in sorted((slo.get("tiers") or {}).items()):
+        if not isinstance(legs, dict):
+            continue
+        p = legs.get("admission_ms")
+        if isinstance(p, dict):
+            print(f"slo tier {tier} admission p50={p.get('p50'):>8} "
+                  f"p90={p.get('p90'):>8} p99={p.get('p99'):>8}",
+                  file=out)
     print(f"{'POOL':40s} {'OK':>4} {'WD':>5} {'LANES':>9} {'OCC%':>6} "
           f"{'QUEUE':>5} {'TEN':>4} {'FAULTS'}", file=out)
     for p in snap.get("pools") or []:

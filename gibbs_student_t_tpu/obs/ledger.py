@@ -34,7 +34,10 @@ JSON line), ``xla`` (obs/introspect compile summary: total
 ``compile_s``, ``flops``, ``peak_bytes`` — each the explicit string
 ``"unavailable"`` when the installed jax cannot report it — plus
 per-program records and Pallas kernel builds), ``config_fingerprint``
-(sha1 of the canonicalized config), optional tool extras.
+(sha1 of the canonicalized config), ``host_canary_ms`` (the fixed-work
+host-speed microbench every record lands so trend gates can tell host
+drift from code regressions; None when the probe fails), optional
+tool extras.
 """
 
 from __future__ import annotations
@@ -68,6 +71,37 @@ def config_fingerprint(config) -> str:
     blob = json.dumps(_jsonable(config), sort_keys=True,
                       separators=(",", ":"))
     return hashlib.sha1(blob.encode()).hexdigest()[:12]
+
+
+def host_canary_ms(reps: int = 3) -> Optional[float]:
+    """Fixed-work host-speed microbench (round 20): best-of-``reps``
+    wall ms for a pinned numpy workload (seeded dense solve + matmul —
+    a proxy for the BLAS-bound serving hot path). Every bench/
+    serve_bench/fleet_bench record lands one alongside its metrics so
+    ``perf_report`` trend gates can annotate HOST drift (PR 17
+    measured a 1.8× slowdown *within* one run; the round-18 graded
+    host ran ~30% slower than the PR 15 baseline) instead of silently
+    reading it as a regression. Returns None when the probe itself
+    fails — the canary must never kill the run it describes."""
+    try:
+        import numpy as _np
+
+        rng = _np.random.default_rng(1234)
+        a = rng.standard_normal((192, 192))
+        a = a @ a.T + 192 * _np.eye(192)
+        b = rng.standard_normal((192, 64))
+        best = None
+        for _ in range(max(int(reps), 1)):
+            t0 = time.perf_counter()
+            x = _np.linalg.solve(a, b)
+            y = a @ x
+            float(y[0, 0])   # force the work
+            dt = (time.perf_counter() - t0) * 1e3
+            if best is None or dt < best:
+                best = dt
+        return round(best, 4)
+    except Exception:  # noqa: BLE001 - observability never crashes a run
+        return None
 
 
 def make_record(tool: str, metrics: Dict[str, Any], *,
@@ -107,6 +141,10 @@ def make_record(tool: str, metrics: Dict[str, Any], *,
         "xla": _jsonable(xla),
         "config_fingerprint": (config_fingerprint(config)
                                if config is not None else None),
+        # host-speed canary (round 20): NOT cached across calls —
+        # within-run drift between a record and its baseline is
+        # exactly the signal the trend gates annotate
+        "host_canary_ms": host_canary_ms(),
     }
     if extra:
         rec.update(_jsonable(extra))

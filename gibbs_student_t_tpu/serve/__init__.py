@@ -30,6 +30,8 @@ from gibbs_student_t_tpu.serve.pool import GROUP_LANES, SlotPool
 from gibbs_student_t_tpu.serve.router import FleetRouter, spawn_fleet
 from gibbs_student_t_tpu.serve.rpc import RemoteChainServer, RpcServer
 from gibbs_student_t_tpu.serve.scheduler import (
+    DeadlineExceeded,
+    RetryAfter,
     TenantError,
     TenantHandle,
     TenantRequest,
@@ -45,6 +47,8 @@ __all__ = [
     "TenantRequest",
     "TenantHandle",
     "TenantError",
+    "RetryAfter",
+    "DeadlineExceeded",
     "ChainServer",
     "MonitorSpec",
     "TenantMonitor",

@@ -172,7 +172,9 @@ class ServerManifest:
                 "rhat_target": mon.rhat_target,
                 "every": mon.every, "min_rows": mon.min_rows}),
             model_file=model_file, model_digest=model_digest,
-            warm=warm, trace_id=getattr(request, "trace_id", None))
+            warm=warm, trace_id=getattr(request, "trace_id", None),
+            priority=getattr(request, "priority", 1),
+            deadline_sweeps=getattr(request, "deadline_sweeps", None))
 
     def record_checkpoint(self, tenant_id: int, next_sweep: int) -> None:
         self.record("checkpoint", tenant=tenant_id,

@@ -446,7 +446,8 @@ class FleetRouter:
                  span_capacity: int = 65536,
                  obs_dir: Optional[str] = None,
                  capacity_sample_s: float = 0.0,
-                 capacity_ring: int = 512):
+                 capacity_ring: int = 512,
+                 max_queue_depth: Optional[int] = None):
         if placement not in ("load", "round_robin"):
             raise ValueError(
                 f"placement must be 'load' or 'round_robin', got "
@@ -480,6 +481,18 @@ class FleetRouter:
         self.placements: Dict[str, int] = {}
         self.failovers = 0
         self.resubmitted = 0
+        # fleet-wide admission control (round 20, ROADMAP 5): with
+        # ``max_queue_depth`` set, a submit that would land on a fleet
+        # whose LEAST-loaded live pool already queues that deep is
+        # shed with a structured RetryAfter (where="router") instead
+        # of growing an unbounded queue. Priority-0 (interactive)
+        # requests get double the depth allowance — under sustained
+        # overload the low tier sheds first, which is exactly the
+        # degradation order the overload bench grades.
+        self.max_queue_depth = (None if max_queue_depth is None
+                                else int(max_queue_depth))
+        self.sheds = 0
+        self.sheds_by_tier: Dict[int, int] = {}
         # live migration (ROADMAP 1b "re-balancing long tenants onto
         # drained pools"): counters + the optional policy thread
         self.rebalance = bool(rebalance)
@@ -723,6 +736,7 @@ class FleetRouter:
         scored = []
         cands = []
         ages: dict = {}
+        free_lanes: Dict[int, int] = {}
         for i, st in self._statuses(meta=ages):
             row = {"pool": getattr(self.pools[i], "label", str(i)),
                    "pool_idx": i,
@@ -733,6 +747,8 @@ class FleetRouter:
                 healthy = not faults.get("pool_failures")
                 row["healthy"] = bool(healthy)
                 score = self._load_score(st)
+                free_lanes[i] = ((st.get("free_groups") or 0)
+                                 * (st.get("group") or 1))
                 row["score"] = {
                     "queue_staged": score[0],
                     "free_lanes": -score[1],
@@ -755,9 +771,70 @@ class FleetRouter:
             if explain is not None:
                 explain["won"] = "fallback"
             return live[0]
+        # urgent placement (round 20): an interactive (priority-0) or
+        # deadline-armed request prefers a pool that can admit it
+        # WITHOUT queueing — when any live pool has the free lanes,
+        # the candidate set narrows to those pools (the slack score
+        # then orders within them); otherwise the full set competes
+        # and the pool-side preemption machinery takes over
+        urgent = (int(getattr(request, "priority", 1)) == 0
+                  or getattr(request, "deadline_sweeps", None)
+                  is not None)
+        if urgent:
+            fits = [(s, i) for s, i in scored
+                    if free_lanes.get(i, 0) >= request.nchains]
+            if fits:
+                if explain is not None:
+                    explain["won"] = "urgent_fit"
+                return min(fits)[1]
         if explain is not None:
             explain["won"] = "score"
         return min(scored)[1]
+
+    def _shed_check(self, request) -> None:
+        """Fleet-wide admission control (caller holds ``_lock``): with
+        ``max_queue_depth`` armed, raise a structured
+        :class:`RetryAfter` (``where="router"``) when even the
+        least-loaded live pool already queues at or past the bound —
+        the queue must shed, not grow. ``queue_depth`` reports that
+        minimum (the best door that still refused); ``retry_after_s``
+        comes from the fleet's admission-p99 evidence when it has any.
+        Priority-0 requests shed at twice the depth."""
+        if self.max_queue_depth is None:
+            return
+        tier = int(getattr(request, "priority", 1))
+        bound = self.max_queue_depth * (2 if tier == 0 else 1)
+        depths = []
+        p99s = []
+        for i, st in self._statuses():
+            if not isinstance(st, dict) or i in self._dead:
+                continue
+            depths.append((st.get("queue_depth") or 0)
+                          + (st.get("staged") or 0))
+            p99 = (((st.get("slo") or {}).get("admission_ms") or {})
+                   .get("p99"))
+            if isinstance(p99, (int, float)):
+                p99s.append(float(p99))
+        if not depths or min(depths) < bound:
+            return
+        retry_s = (max(0.5, sorted(p99s)[len(p99s) // 2] / 1e3)
+                   if p99s else 1.0)
+        self.sheds += 1
+        self.sheds_by_tier[tier] = self.sheds_by_tier.get(tier, 0) + 1
+        if self.spans is not None:
+            self.spans.record(
+                "shed", ROLE_ROUTER, time.monotonic(), 0.0,
+                trace_id=getattr(request, "trace_id", None),
+                job=getattr(request, "name", None), tier=tier,
+                queue_depth=min(depths))
+        from gibbs_student_t_tpu.serve.scheduler import RetryAfter
+
+        raise RetryAfter(
+            f"fleet overloaded: least-loaded pool queues "
+            f"{min(depths)} deep (bound {bound}); retry in "
+            f"~{retry_s:.1f}s",
+            retry_after_s=round(retry_s, 3), queue_depth=min(depths),
+            tier=tier, where="router")
 
     # ------------------------------------------------------------------
     # the ChainServer-shaped fleet surface
@@ -794,6 +871,7 @@ class FleetRouter:
                 idx = pool
                 explain["won"] = "pinned"
             else:
+                self._shed_check(request)
                 idx = self._place(request, explain=explain)
             if self.spans is not None:
                 self.spans.record(
@@ -879,6 +957,12 @@ class FleetRouter:
             "steals": self.steals,
             "placement_events": self.placement_events,
             "capacity_samples": self.capacity_samples,
+            # fleet admission control (round 20): the shed bound and
+            # the structured-retry-after rejections it issued
+            "max_queue_depth": self.max_queue_depth,
+            "sheds": self.sheds,
+            "sheds_by_tier": {str(k): v for k, v in
+                              sorted(self.sheds_by_tier.items())},
         }
         return snap
 
@@ -902,6 +986,8 @@ class FleetRouter:
             # the full history stays queryable
             self.placement_events = 0
             self._placement_tail.clear()
+            self.sheds = 0
+            self.sheds_by_tier = {}
 
     def close(self, grace: float = 30.0) -> None:
         """Retire the fleet: stop the watch, close the wire, shut

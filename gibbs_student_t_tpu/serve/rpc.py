@@ -253,7 +253,8 @@ def recv_frame(sock: socket.socket,
 #: TenantRequest fields that ride the wire as plain JSON values
 _REQ_SCALARS = ("niter", "nchains", "seed", "start_sweep", "spool_dir",
                 "name", "on_divergence", "on_converged",
-                "resume_spool", "trace_id")
+                "resume_spool", "trace_id", "priority",
+                "deadline_sweeps")
 
 #: MonitorSpec fields (all JSON-able)
 _MON_FIELDS = ("params", "ess_target", "rhat_target", "every",
@@ -336,22 +337,66 @@ def _request_from_body(body: dict):
 def _tenant_error_body(err) -> dict:
     """A TenantError flattened for the wire (exceptions with custom
     ``__init__`` signatures don't round-trip pickle; the partial
-    ChainResult does)."""
-    return {"op": "tenant_error", "tenant_id": err.tenant_id,
+    ChainResult does). A :class:`DeadlineExceeded` carries its
+    subclass fields under ``kind`` so the client re-raises the SAME
+    structured type (round 20)."""
+    from gibbs_student_t_tpu.serve.scheduler import DeadlineExceeded
+
+    body = {"op": "tenant_error", "tenant_id": err.tenant_id,
             "reason": err.reason, "where": err.where,
             "cause": (f"{type(err.cause).__name__}: {err.cause}"
                       if err.cause is not None else None),
             "partial": Pickled(err.partial)}
+    if isinstance(err, DeadlineExceeded):
+        body["kind"] = "deadline_exceeded"
+        body["deadline_sweep"] = err.deadline_sweep
+        body["served_sweeps"] = err.served_sweeps
+    return body
 
 
 def _tenant_error_from_body(body: dict):
-    from gibbs_student_t_tpu.serve.scheduler import TenantError
+    from gibbs_student_t_tpu.serve.scheduler import (
+        DeadlineExceeded,
+        TenantError,
+    )
 
+    if body.get("kind") == "deadline_exceeded":
+        return DeadlineExceeded(body["tenant_id"],
+                                body["deadline_sweep"],
+                                body["served_sweeps"],
+                                partial=body.get("partial"))
     return TenantError(body["tenant_id"], reason=body["reason"],
                        where=body.get("where") or "drain",
                        cause=(RuntimeError(body["cause"])
                               if body.get("cause") else None),
                        partial=body.get("partial"))
+
+
+def _retry_after_body(err) -> dict:
+    """A structured overload shed as a rejected frame body (round
+    20): the client re-raises :class:`RetryAfter` with the same
+    backoff/depth/tier signal the local submit call gets."""
+    return {"op": "rejected",
+            "error": f"{type(err).__name__}: {err}",
+            "error_kind": "retry_after",
+            "retry_after_s": err.retry_after_s,
+            "queue_depth": err.queue_depth,
+            "tier": err.tier, "shed_where": err.where}
+
+
+def _rejected_error(reply: dict):
+    """The exception a rejected frame resolves to: a structured
+    :class:`RetryAfter` when the frame carries the overload signal,
+    the historical bare RuntimeError otherwise."""
+    if reply.get("error_kind") == "retry_after":
+        from gibbs_student_t_tpu.serve.scheduler import RetryAfter
+
+        return RetryAfter(reply.get("error") or "rejected",
+                          retry_after_s=reply.get("retry_after_s"),
+                          queue_depth=reply.get("queue_depth"),
+                          tier=reply.get("tier"),
+                          where=reply.get("shed_where") or "server")
+    return RuntimeError(reply.get("error") or "rejected")
 
 
 # ---------------------------------------------------------------------------
@@ -579,8 +624,11 @@ class RpcServer:
             send_frame(sock, _tenant_error_body(e), self.max_frame)
             return
         except RuntimeError as e:
-            send_frame(sock, {"op": "rejected", "error": str(e)},
-                       self.max_frame)
+            from gibbs_student_t_tpu.serve.scheduler import RetryAfter
+
+            body = (_retry_after_body(e) if isinstance(e, RetryAfter)
+                    else {"op": "rejected", "error": str(e)})
+            send_frame(sock, body, self.max_frame)
             return
         send_frame(sock, {"op": "result", "result": Pickled(res)},
                    self.max_frame)
@@ -644,9 +692,12 @@ class RpcServer:
         try:
             h = self.server.submit(request, timeout=req.get("timeout"))
         except Exception as e:  # noqa: BLE001 - queue-full / validation
-            send_frame(sock, {"op": "rejected",
-                              "error": f"{type(e).__name__}: {e}"},
-                       self.max_frame)
+            from gibbs_student_t_tpu.serve.scheduler import RetryAfter
+
+            body = (_retry_after_body(e) if isinstance(e, RetryAfter)
+                    else {"op": "rejected",
+                          "error": f"{type(e).__name__}: {e}"})
+            send_frame(sock, body, self.max_frame)
             return True
         send_frame(sock, {"op": "ok", "tenant_id": h.tenant_id},
                    self.max_frame)
@@ -755,7 +806,7 @@ class RemoteTenantHandle:
         elif op == "timeout":
             raise TimeoutError(body.get("error") or "result timeout")
         elif op == "rejected":
-            self._error = RuntimeError(body.get("error") or "rejected")
+            self._error = _rejected_error(body)
         else:
             raise RpcError(body.get("error") or f"unexpected reply {op!r}")
         self._done.set()
@@ -877,7 +928,7 @@ class RemoteChainServer:
                 body["timeout"] = timeout
                 reply = self._call(body)
             if reply.get("op") == "rejected":
-                raise RuntimeError(reply.get("error"))
+                raise _rejected_error(reply)
             self._server_has.add(digest)
             return RemoteTenantHandle(self, reply["tenant_id"], request)
         # streaming: the connection outlives the call
@@ -899,6 +950,8 @@ class RemoteChainServer:
             raise
         if reply.get("op") in ("rejected", "error"):
             sock.close()
+            if reply.get("op") == "rejected":
+                raise _rejected_error(reply)
             raise RuntimeError(reply.get("error"))
         self._server_has.add(digest)
         h = RemoteTenantHandle(self, reply["tenant_id"], request,

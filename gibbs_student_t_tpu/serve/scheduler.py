@@ -1,11 +1,26 @@
-"""Tenant requests, handles, and the bounded admission queue.
+"""Tenant requests, handles, and the bounded admission queue — the
+serve subsystem's policy layer (docs/SERVING.md "Scheduling &
+overload").
 
-The scheduler side of the serve subsystem is deliberately host-only
-and thread-safe-but-simple: a bounded FIFO with first-fit admission
-(the server scans past a head job that does not currently fit so a
-small job can backfill free groups — classic continuous-batching
-behavior), and per-tenant handles that stream chunk callbacks and
-deliver the final :class:`ChainResult`.
+The scheduler side is deliberately host-only and thread-safe-but-
+simple: a bounded queue with block/reject backpressure and pluggable
+ordering. The default order is FIFO with first-fit admission (the
+server scans past a head job that does not currently fit so a small
+job can backfill free groups — classic continuous-batching behavior).
+A server running the ``priority`` policy installs
+:func:`schedule_score` as the queue's ``score``: pops become
+best-score-first over ``(effective priority, deadline slack, arrival
+seq)`` — which degenerates bitwise to the historical FIFO/first-fit
+order when every request carries the defaults (equal priority, no
+deadline → the arrival-seq tiebreak decides). Per-tenant handles
+stream chunk callbacks and deliver the final :class:`ChainResult`.
+
+Overload semantics: a bounded queue under the ``reject`` policy sheds
+with :class:`RetryAfter` (a structured ``QueueFull`` carrying
+``retry_after_s`` + ``queue_depth``), and a deadline-armed tenant
+preempted past its deadline resolves with :class:`DeadlineExceeded`
+(a structured ``TenantError``) — a shed or expired job's ``result()``
+always raises promptly instead of hanging.
 """
 
 from __future__ import annotations
@@ -23,6 +38,34 @@ from gibbs_student_t_tpu.models.pta import ModelArrays
 class QueueFull(RuntimeError):
     """Raised by ``submit`` under the ``reject`` backpressure policy
     when the admission queue is at capacity."""
+
+
+class RetryAfter(QueueFull):
+    """Structured overload shed (docs/SERVING.md "Scheduling &
+    overload"): the queue (or the fleet router) is at capacity, the
+    job was NOT accepted, and the caller should retry after
+    ``retry_after_s`` seconds. Subclasses :class:`QueueFull` so every
+    existing reject-policy handler keeps working; the extra fields
+    make the signal actionable instead of a bare string:
+
+    - ``retry_after_s``: the shedder's estimate of when capacity
+      frees (from the live admission-latency percentiles when it has
+      them, a fixed floor otherwise); None when it has no estimate.
+    - ``queue_depth``: queued + staged jobs at the shed point (the
+      fleet router reports the MINIMUM across live pools — the best
+      door that still refused).
+    - ``tier``: the rejected request's priority class.
+    - ``where``: ``"server"`` (pool admission queue) or ``"router"``
+      (fleet-wide shed).
+    """
+
+    def __init__(self, msg: str, retry_after_s=None, queue_depth=None,
+                 tier=None, where: str = "server"):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+        self.queue_depth = queue_depth
+        self.tier = tier
+        self.where = where
 
 
 class TenantError(RuntimeError):
@@ -50,6 +93,28 @@ class TenantError(RuntimeError):
         self.partial = partial
         if cause is not None:
             self.__cause__ = cause
+
+
+class DeadlineExceeded(TenantError):
+    """A deadline-armed tenant whose budget can no longer be served
+    in time: preempted (or re-scored at requeue) past
+    ``deadline_sweep``, the server resolves the handle with this
+    structured error instead of parking the continuation in a queue
+    it can never usefully leave — ``result()`` raises promptly (the
+    shed-job contract, satellite of round 20). ``partial`` carries
+    the spooled prefix served before the deadline (a bitwise prefix
+    of the uninterrupted run, the PR 15 cancel contract), so the
+    caller keeps every sweep it paid for."""
+
+    def __init__(self, tenant_id: int, deadline_sweep: int,
+                 served_sweeps: int, partial=None):
+        super().__init__(
+            tenant_id,
+            reason=(f"deadline at sweep {deadline_sweep} passed with "
+                    f"{served_sweeps} sweep(s) served"),
+            where="deadline", partial=partial)
+        self.deadline_sweep = int(deadline_sweep)
+        self.served_sweeps = int(served_sweeps)
 
 
 #: Valid ``TenantRequest.on_divergence`` policies. ``none`` keeps the
@@ -155,6 +220,24 @@ class TenantRequest:
     #: stitch into one per-job trace (``FleetRouter.export_trace``).
     #: Purely observational — never touches chain math (PR 1 rule).
     trace_id: Optional[str] = None
+    #: priority class (round 20, docs/SERVING.md "Scheduling &
+    #: overload"): LOWER is more important — 0 interactive, 1
+    #: standard (the default), 2+ batch. Any non-negative int. Under
+    #: a ``scheduler="priority"`` server, ordering pops
+    #: best-priority-first (with an aging boost bounding starvation)
+    #: and a higher tier's arrival may losslessly preempt the
+    #: lowest-tier SPOOLED running tenant (the checkpoint/
+    #: ``resume_spool`` machinery — final chains bitwise identical to
+    #: an uninterrupted run). Rides the RPC submit frame.
+    priority: int = 1
+    #: deadline, in sweeps from this request's ``start_sweep``
+    #: (None = no deadline): arms slack-aware ordering —
+    #: ``slack = sweeps_to_deadline − est_sweeps_to_target`` (the
+    #: live monitor's estimate when armed, the remaining budget
+    #: otherwise) — so the tightest job pops first within its tier.
+    #: A deadline-armed tenant preempted past its deadline resolves
+    #: with :class:`DeadlineExceeded` instead of requeueing.
+    deadline_sweeps: Optional[int] = None
 
 
 class TenantHandle:
@@ -208,6 +291,17 @@ class TenantHandle:
         # the drain worker at each boundary update; None when the
         # tenant runs the full-rate systematic scan
         self.adapt: Optional[Dict] = None
+        # scheduling state (round 20): arrival sequence within the
+        # admission queue (the FIFO tiebreak of schedule_score),
+        # the aging anchor (survives a preemption requeue, unlike
+        # submitted_t which restarts the continuation's admission SLO
+        # leg), the ABSOLUTE deadline sweep (start_sweep +
+        # deadline_sweeps at FIRST submit — continuations keep it),
+        # and how many times this tenant was preempted
+        self._queue_seq = -1
+        self._age_t = self.submitted_t
+        self._deadline_sweep: Optional[int] = None
+        self.preemptions = 0
 
     # -- lifecycle (server side) ---------------------------------------
 
@@ -254,6 +348,18 @@ class TenantHandle:
 
     def _fail(self, why: str):
         self.error = why
+        self.finished_t = time.monotonic()
+        self.status = "rejected"
+        self._done.set()
+
+    def _fail_shed(self, err: "RetryAfter"):
+        """Complete the handle with an overload shed: the job was
+        never admitted, and ``result()`` raises the same structured
+        :class:`RetryAfter` the submit call does — a shed job can
+        never hang a waiter (the dead-client-wedge class, submit
+        side)."""
+        self._tenant_error = err
+        self.error = str(err)
         self.finished_t = time.monotonic()
         self.status = "rejected"
         self._done.set()
@@ -336,6 +442,23 @@ class TenantHandle:
         return (None if self._monitor is None
                 else self._monitor.converged_at)
 
+    def slack_sweeps(self) -> Optional[float]:
+        """Deadline slack in sweeps (None when no deadline is armed):
+        ``sweeps_to_deadline − est_sweeps_to_target``, the live
+        monitor's estimate when it has one (its snapshot is a cheap
+        dict copy), the remaining budget otherwise. Negative = the
+        deadline is already unservable at the current rate."""
+        if self._deadline_sweep is None:
+            return None
+        pos = self.request.start_sweep + self.sweeps_done
+        to_deadline = self._deadline_sweep - pos
+        est = None
+        if self._monitor is not None:
+            est = self._monitor.snapshot().get("est_sweeps_to_target")
+        if not isinstance(est, (int, float)):
+            est = self.request.niter - self.sweeps_done
+        return float(to_deadline - est)
+
     def progress(self) -> Dict[str, object]:
         """Live per-tenant progress: scheduling state plus — when the
         request armed a :class:`~gibbs_student_t_tpu.serve.monitor.
@@ -355,6 +478,12 @@ class TenantHandle:
             p.update(self._monitor.snapshot())
         if self.request.trace_id is not None:
             p["trace_id"] = self.request.trace_id
+        p["priority"] = int(getattr(self.request, "priority", 1))
+        if self._deadline_sweep is not None:
+            p["deadline_sweep"] = int(self._deadline_sweep)
+            p["slack_sweeps"] = self.slack_sweeps()
+        if self.preemptions:
+            p["preemptions"] = int(self.preemptions)
         p["cost"] = self.cost()
         if self.recycled_rows:
             p["recycled_rows"] = int(self.recycled_rows)
@@ -396,24 +525,70 @@ class TenantHandle:
         return self._result
 
 
-class AdmissionQueue:
-    """Bounded FIFO with first-fit scanning and block/reject
-    backpressure."""
+def schedule_score(handle: TenantHandle, now: Optional[float] = None,
+                   age_boost_s: Optional[float] = None) -> tuple:
+    """The priority scheduler's pop order — LOWER pops first:
+    ``(effective_priority, deadline_slack, arrival_seq)``.
 
-    def __init__(self, maxsize: int = 64, policy: str = "block"):
+    - ``effective_priority``: the request's tier minus one boost per
+      ``age_boost_s`` seconds waited (the starvation bound — a
+      low-tier job left queued long enough outranks fresh high-tier
+      arrivals; ``None``/0 disables aging).
+    - ``deadline_slack``: :meth:`TenantHandle.slack_sweeps` (``+inf``
+      without a deadline), so within a tier the tightest job pops
+      first and deadline-armed jobs outrank open-ended ones.
+    - ``arrival_seq``: the queue's insertion counter — with equal
+      tiers and no deadlines the whole score degenerates to exactly
+      the historical FIFO order (the stability pin).
+    """
+    req = handle.request
+    pr = float(getattr(req, "priority", 1))
+    if age_boost_s:
+        t = now if now is not None else time.monotonic()
+        waited = t - getattr(handle, "_age_t", handle.submitted_t)
+        if waited > 0:
+            pr -= int(waited / age_boost_s)
+    slack = handle.slack_sweeps()
+    return (pr, float("inf") if slack is None else slack,
+            handle._queue_seq)
+
+
+class AdmissionQueue:
+    """Bounded queue with first-fit scanning and block/reject
+    backpressure. ``score`` (None = historical FIFO) orders every pop
+    best-score-first: the server's ``priority`` policy installs
+    :func:`schedule_score` here, and because the score's final
+    tiebreak is the insertion sequence, default requests (equal
+    priority, no deadline) still pop in exact arrival order."""
+
+    def __init__(self, maxsize: int = 64, policy: str = "block",
+                 score=None):
         if policy not in ("block", "reject"):
             raise ValueError(
                 f"backpressure policy must be 'block' or 'reject', "
                 f"got {policy!r}")
         self.maxsize = maxsize
         self.policy = policy
+        #: Optional ``handle -> orderable`` key; pops take the MINIMUM
+        self.score = score
         self._q: List[TenantHandle] = []
+        self._seq = 0
         self._lock = threading.Lock()
         self._not_full = threading.Condition(self._lock)
 
     def __len__(self) -> int:
         with self._lock:
             return len(self._q)
+
+    def depth_by_tier(self) -> Dict[int, int]:
+        """Queued jobs per priority class (the per-tier queue-depth
+        signal on ``/status`` and the fleet snapshot)."""
+        with self._lock:
+            out: Dict[int, int] = {}
+            for h in self._q:
+                tier = int(getattr(h.request, "priority", 1))
+                out[tier] = out.get(tier, 0) + 1
+            return out
 
     def put(self, handle: TenantHandle,
             timeout: Optional[float] = None) -> None:
@@ -427,31 +602,59 @@ class AdmissionQueue:
                         timeout=timeout):
                     raise QueueFull(
                         f"admission queue still full after {timeout}s")
+            handle._queue_seq = self._seq
+            self._seq += 1
             self._q.append(handle)
 
-    def pop_first_fit(self, fits) -> Optional[TenantHandle]:
-        """Remove and return the first queued job for which
-        ``fits(handle)`` is true (first-fit backfill), else None."""
+    def put_displaced(self, handle: TenantHandle) -> None:
+        """Requeue a preempted tenant's continuation, bypassing the
+        capacity check: displaced load was already admitted once —
+        shedding it here would break the lossless-preemption contract
+        — and bounding it by ``maxsize`` would let a full queue turn a
+        preemption into data loss. The continuation still competes by
+        score (it keeps its aging anchor, so it carries its waited
+        time into the next pop)."""
         with self._not_full:
-            for i, h in enumerate(self._q):
-                if fits(h):
-                    self._q.pop(i)
-                    self._not_full.notify()
-                    return h
+            handle._queue_seq = self._seq
+            self._seq += 1
+            self._q.append(handle)
+
+    def _pop_best(self, candidates) -> Optional[TenantHandle]:
+        """Pop the best-scored (or first, FIFO) of ``candidates`` —
+        (index, handle) pairs into ``_q``. Caller holds the lock."""
+        best = None
+        if self.score is None:
+            for i, h in candidates:
+                best = (i, h)
+                break
+        else:
+            best_key = None
+            for i, h in candidates:
+                key = self.score(h)
+                if best_key is None or key < best_key:
+                    best, best_key = (i, h), key
+        if best is None:
             return None
+        self._q.pop(best[0])
+        self._not_full.notify()
+        return best[1]
+
+    def pop_first_fit(self, fits) -> Optional[TenantHandle]:
+        """Remove and return the best-ordered queued job for which
+        ``fits(handle)`` is true (first-fit backfill under FIFO,
+        best-score-fit under a scored queue), else None."""
+        with self._not_full:
+            return self._pop_best(
+                (i, h) for i, h in enumerate(self._q) if fits(h))
 
     def pop_next(self) -> Optional[TenantHandle]:
-        """Non-blocking FIFO pop — the pipelined executor's staging
-        thread takes jobs in arrival order and prepares them ahead of
-        placement (first-fit happens later, over the PREPARED window,
-        so queue order is the preparation order, not the admission
-        order)."""
+        """Non-blocking ordered pop — the pipelined executor's staging
+        thread takes jobs in queue order (arrival under FIFO, score
+        under ``priority``) and prepares them ahead of placement
+        (first-fit happens later, over the PREPARED window, so queue
+        order is the preparation order, not the admission order)."""
         with self._not_full:
-            if not self._q:
-                return None
-            h = self._q.pop(0)
-            self._not_full.notify()
-            return h
+            return self._pop_best(enumerate(self._q))
 
     def snapshot(self) -> List[TenantHandle]:
         """A read-only view of the queued handles in order — the pilot

@@ -115,9 +115,13 @@ from gibbs_student_t_tpu.serve.scheduler import (
     CONVERGED_POLICIES,
     DIVERGENCE_POLICIES,
     AdmissionQueue,
+    DeadlineExceeded,
+    QueueFull,
+    RetryAfter,
     TenantError,
     TenantHandle,
     TenantRequest,
+    schedule_score,
 )
 
 
@@ -256,7 +260,8 @@ class ChainServer:
                  watchdog_spec: Optional[WatchdogSpec] = None,
                  flight: bool = True, flight_dir: Optional[str] = None,
                  flight_capacity: int = 64, flight_sync_every: int = 4,
-                 kernel_timers="auto", recycle="auto"):
+                 kernel_timers="auto", recycle="auto",
+                 scheduler: str = "fifo", age_boost_s: float = 30.0):
         """``pipeline`` selects the driver ``run()`` uses: ``"auto"``
         (default) follows ``GST_SERVE_PIPELINE`` (auto -> pipelined);
         ``True``/``False`` force it, still overridden by an explicit
@@ -397,8 +402,26 @@ class ChainServer:
         if prefetch < 1:
             raise ValueError(f"prefetch must be >= 1, got {prefetch}")
         self._prefetch = int(prefetch)
-        self.queue = AdmissionQueue(maxsize=max_queue,
-                                    policy=backpressure)
+        # the scheduling policy (round 20; docs/SERVING.md "Scheduling
+        # & overload"): "fifo" keeps the historical arrival-order /
+        # first-fit behavior bitwise; "priority" orders every queue
+        # pop by (tier − aging boost, deadline slack, arrival seq) and
+        # arms lossless preemption — a high-tier arrival that does not
+        # fit reclaims lanes from the lowest-tier SPOOLED running
+        # tenant over the checkpoint/resume machinery. ``age_boost_s``
+        # bounds starvation: a queued job gains one tier per that many
+        # seconds waited.
+        if scheduler not in ("fifo", "priority"):
+            raise ValueError(
+                f"scheduler must be 'fifo' or 'priority', got "
+                f"{scheduler!r}")
+        self.scheduler = scheduler
+        self.age_boost_s = float(age_boost_s)
+        self.queue = AdmissionQueue(
+            maxsize=max_queue, policy=backpressure,
+            score=(None if scheduler == "fifo"
+                   else (lambda h: schedule_score(
+                       h, age_boost_s=self.age_boost_s))))
         self._lock = threading.Lock()
         self._running: Dict[int, _Tenant] = {}
         self._free_groups: List[int] = list(
@@ -445,7 +468,7 @@ class ChainServer:
                 "nlanes": nlanes, "quantum": quantum, "group": group,
                 "record": record, "record_thin": record_thin,
                 "max_queue": max_queue, "backpressure": backpressure,
-                "telemetry": telemetry,
+                "telemetry": telemetry, "scheduler": scheduler,
             })
         # ---- the deep profiling plane (round 15) ----------------------
         # in-kernel stage timers: resolve GST_KERNEL_TIMERS against the
@@ -550,6 +573,15 @@ class ChainServer:
         # convergence-based evictions served (ROADMAP 4c): tenants
         # released early because their armed monitor targets held
         self._converged_evictions = 0
+        # scheduling-policy counters (ROADMAP 5): lossless priority
+        # preemptions served, overload sheds (total and per tier), the
+        # high-water queue depth, and the per-tier SLO legs that the
+        # overload bench grades (tier -> leg-name -> ms samples)
+        self._preemptions = 0
+        self._sheds = 0
+        self._sheds_by_tier: Dict[int, int] = {}
+        self._queue_depth_peak = 0
+        self._tier_slo: Dict[int, Dict[str, List[float]]] = {}
         # capacity-per-dollar accounting (round 17): recycled
         # partial-scan lane-rows tagged (quarantined lanes excluded —
         # a frozen lane's scan produced no new partial states) and the
@@ -612,6 +644,11 @@ class ChainServer:
         for k in self._fault_counts:
             self._fault_counts[k] = 0
         self._converged_evictions = 0
+        self._preemptions = 0
+        self._sheds = 0
+        self._sheds_by_tier = {}
+        self._queue_depth_peak = 0
+        self._tier_slo = {}
         self._recycled_lane_rows = 0
         self._warm_starts = 0
         self._warm_degraded = 0
@@ -746,6 +783,17 @@ class ChainServer:
                     "on_divergence policies need pool telemetry — the "
                     "in-kernel sticky diverged flags are what lane "
                     "health folds at quantum boundaries")
+        pr = getattr(request, "priority", 1)
+        if isinstance(pr, bool) or not isinstance(pr, int) or pr < 0:
+            raise ValueError(
+                f"priority must be a non-negative int (0 = most "
+                f"urgent), got {pr!r}")
+        dls = getattr(request, "deadline_sweeps", None)
+        if dls is not None and (isinstance(dls, bool)
+                                or not isinstance(dls, int) or dls < 1):
+            raise ValueError(
+                f"deadline_sweeps must be a positive int or None, "
+                f"got {dls!r}")
         groups_needed = -(-request.nchains // self.pool.group)
         if groups_needed > self.pool.nlanes // self.pool.group:
             raise ValueError(
@@ -755,14 +803,63 @@ class ChainServer:
             handle = TenantHandle(self._next_id, request)
             self._next_id += 1
             self._handles[handle.tenant_id] = handle
+        if dls is not None:
+            handle._deadline_sweep = request.start_sweep + dls
         if self.spans is not None:
             # register the trace id at submit (not admit) so even the
             # tenant's staging spans carry it (round 19)
             self.spans.set_trace_id(handle.tenant_id, request.trace_id)
-        self.queue.put(handle, timeout=timeout)
+        try:
+            self.queue.put(handle, timeout=timeout)
+        except QueueFull as e:
+            # overload shed (ROADMAP 5): the handle must still resolve
+            # — result() raises the same structured RetryAfter the
+            # submit call does, never hangs (the PR 13 dead-client
+            # wedge class, submit side)
+            err = self._shed_error(pr)
+            with self._lock:
+                self._sheds += 1
+                self._sheds_by_tier[pr] = \
+                    self._sheds_by_tier.get(pr, 0) + 1
+                self._handles.pop(handle.tenant_id, None)
+            if self.metrics is not None:
+                self.metrics.counter("serve_sheds_total").inc()
+            handle._fail_shed(err)
+            raise err from e
+        with self._lock:
+            self._queue_depth_peak = max(self._queue_depth_peak,
+                                         len(self.queue))
         if self.metrics is not None:
             self.metrics.gauge("serve_queue_depth").set(len(self.queue))
         return handle
+
+    def _shed_error(self, tier: int) -> RetryAfter:
+        """The structured overload signal: how long to back off
+        (recent admission latency, floored) and how deep the door
+        queue stands right now."""
+        with self._lock:
+            recent = self._admission_ms[-64:]
+        retry_s = 1.0
+        if recent:
+            retry_s = max(0.5, float(np.median(recent)) / 1e3)
+        depth = len(self.queue)
+        with self._prep_lock:
+            depth += len(self._prepared)
+        return RetryAfter(
+            f"admission queue full ({depth} deep); retry in "
+            f"~{retry_s:.1f}s",
+            retry_after_s=round(retry_s, 3), queue_depth=depth,
+            tier=tier)
+
+    def _tier_leg(self, request, leg: str) -> List[float]:
+        """The per-tier SLO sample list for one leg (created on first
+        touch) — same GIL-atomic append discipline as the aggregate
+        series it rides alongside."""
+        tier = int(getattr(request, "priority", 1))
+        legs = self._tier_slo.setdefault(
+            tier, {"admission_ms": [], "first_result_ms": [],
+                   "converged_ms": []})
+        return legs[leg]
 
     def cancel(self, handle: TenantHandle) -> bool:
         """Request eviction of a tenant. A queued (or staged but not
@@ -1234,6 +1331,8 @@ class ChainServer:
             # not resurrect a pilot whose consumer died with the
             # staging thread)
             self._admission_ms.append(handle.admission_ms)
+            self._tier_leg(req, "admission_ms").append(
+                handle.admission_ms)
         if self.spans is not None:
             self.spans.record("admit", ROLE_DISPATCH, t_admit0,
                               time.monotonic() - t_admit0,
@@ -1277,23 +1376,107 @@ class ChainServer:
             if h is None:
                 break
             self._admit(h)   # a rejected tenant frees nothing
+        if self.scheduler == "priority":
+            waiters = self.queue.snapshot()
+            if waiters:
+                self._preempt_for(min(
+                    waiters, key=lambda h: schedule_score(
+                        h, age_boost_s=self.age_boost_s)))
 
     def _apply_admissions(self) -> None:
         """Pipelined-path admission at a quantum boundary: first-fit
         over the PREPARED window (staging already paid the expensive
-        part), placement is slice writes only. Caller holds
-        ``_lock``."""
+        part) under FIFO, best-score-fit under ``priority``; placement
+        is slice writes only. A best waiter that still does not fit
+        may preempt running lower-tier tenants (lanes come back at the
+        NEXT boundary's reap). Caller holds ``_lock``."""
         while self._free_groups:
             free = len(self._free_groups)
             with self._prep_lock:
-                idx = next(
-                    (i for i, p in enumerate(self._prepared)
-                     if p.groups_needed <= free), None)
-                prep = (self._prepared.pop(idx)
-                        if idx is not None else None)
+                fits = [(i, p) for i, p in enumerate(self._prepared)
+                        if p.groups_needed <= free]
+                if not fits:
+                    prep = None
+                elif self.queue.score is None:
+                    prep = self._prepared.pop(fits[0][0])
+                else:
+                    best_i = min(
+                        fits,
+                        key=lambda ip: self.queue.score(ip[1].handle)
+                    )[0]
+                    prep = self._prepared.pop(best_i)
             if prep is None:
                 break
             self._apply_prepared(prep)
+        if self.scheduler == "priority":
+            with self._prep_lock:
+                waiting = [p.handle for p in self._prepared]
+            waiting.extend(self.queue.snapshot())
+            if waiting:
+                self._preempt_for(min(
+                    waiting, key=lambda h: schedule_score(
+                        h, age_boost_s=self.age_boost_s)))
+
+    def _preempt_for(self, waiter: TenantHandle) -> int:
+        """Reclaim lane groups for a high-tier waiter by LOSSLESSLY
+        preempting lower-tier running tenants (caller holds
+        ``_lock``; ``priority`` scheduler only). Victims must be
+        spooled (the rolling checkpoint is what makes the freeze
+        lossless — an in-memory tenant would lose its accumulated
+        records) and strictly lower-tier than the waiter's RAW
+        priority (aging boosts queue order, never preemption — a
+        starved batch job must not start evicting its own tier).
+        Marking ``slot.cancelled`` freezes the victim at the next
+        quantum boundary exactly like a cancel; ``slot.preempted``
+        routes its finalize into :meth:`_requeue_preempted`, which
+        requeues a checkpoint-resume continuation instead of
+        delivering the prefix as a result (the PR 15 poison
+        contract). Returns the number of victims marked."""
+        pr = int(getattr(waiter.request, "priority", 1))
+        needed = self._groups_needed(waiter) - len(self._free_groups)
+        for t in self._running.values():
+            # groups already coming back: a decided freeze releases at
+            # the next reap, so it counts against the deficit
+            if t.slot.cancelled or t.slot.failed:
+                needed -= len(t.slot.lanes) // self.pool.group
+        if needed <= 0:
+            return 0
+        victims = [
+            t for t in self._running.values()
+            if (t.spool is not None
+                and not getattr(t.handle, "_internal", False)
+                and not t.slot.cancelled and not t.slot.failed
+                and int(getattr(t.handle.request, "priority", 1)) > pr)
+        ]
+        # lowest tier first; within a tier, the most slack (inf — no
+        # deadline — before any armed deadline) loses its lanes first
+        def _victim_key(t):
+            s = t.handle.slack_sweeps()
+            return (-int(getattr(t.handle.request, "priority", 1)),
+                    -(float("inf") if s is None else s))
+
+        victims.sort(key=_victim_key)
+        marked = 0
+        for t in victims:
+            if needed <= 0:
+                break
+            t.slot.cancelled = True
+            t.slot.preempted = True
+            needed -= len(t.slot.lanes) // self.pool.group
+            marked += 1
+            self._preemptions += 1
+            if self.flight is not None:
+                self.flight.note_event(
+                    "preempt", tenant=t.slot.tenant_id,
+                    by=waiter.tenant_id, tier_victim=int(getattr(
+                        t.handle.request, "priority", 1)),
+                    tier_waiter=pr)
+            if self.metrics is not None:
+                self.metrics.counter("serve_preemptions_total").inc()
+                self.metrics.emit(
+                    "tenant_preempted", tenant=t.slot.tenant_id,
+                    by=waiter.tenant_id)
+        return marked
 
     # ------------------------------------------------------------------
     # cost accounting (round 14)
@@ -1951,6 +2134,8 @@ class ChainServer:
             ms = handle.first_result_ms
             if ms is not None:
                 self._first_result_ms.append(ms)
+                self._tier_leg(handle.request,
+                               "first_result_ms").append(ms)
                 if self.metrics is not None:
                     self.metrics.histogram(
                         "serve_first_result_ms").observe(ms)
@@ -2021,6 +2206,8 @@ class ChainServer:
                       if conv_t is not None else None)
                 if ms is not None:
                     self._converged_ms.append(ms)
+                    self._tier_leg(handle.request,
+                                   "converged_ms").append(ms)
                 if self.metrics is not None:
                     if ms is not None:
                         self.metrics.histogram(
@@ -2143,6 +2330,14 @@ class ChainServer:
         concatenation run on the first ``result()`` call, on the
         caller's thread — result DECODE is client work and must not
         steal serving cycles from the drain worker."""
+        if getattr(t.slot, "preempted", False) and t.slot.remaining > 0:
+            # a preempted tenant with budget left NEVER delivers its
+            # prefix as the result (the PR 15 poison contract): its
+            # checkpoint becomes a requeued continuation — or a
+            # structured DeadlineExceeded when the deadline already
+            # passed
+            self._requeue_preempted(t)
+            return
         slot, handle, spool = t.slot, t.handle, t.spool
         handle.health = self._tenant_health(t)
         health = handle.health
@@ -2207,6 +2402,91 @@ class ChainServer:
             return res
 
         handle._finish_lazy(build)
+
+    def _requeue_preempted(self, t: _Tenant) -> None:
+        """Turn a preempted tenant's frozen checkpoint into a queued
+        continuation (runs where ``_finalize`` does, after the final
+        quantum's records flushed to the spool). The continuation is
+        the SAME wire-safe resume the live-migration path uses: state
+        reloaded from the rolling checkpoint with a fencing
+        cross-check, ``start_sweep`` advanced, the remaining budget as
+        ``niter`` — the per-sweep fold-in keying makes the finished
+        chains bitwise identical to an uninterrupted run. A
+        deadline-armed tenant whose deadline already passed resolves
+        with :class:`DeadlineExceeded` (partial = the spooled prefix)
+        instead of parking in a queue it can never usefully leave."""
+        from dataclasses import replace as _dc_replace
+
+        from gibbs_student_t_tpu.utils.spool import (
+            load_spool,
+            load_spool_state,
+        )
+
+        slot, handle, spool = t.slot, t.handle, t.spool
+        spool.close()
+        next_sweep = slot.start_sweep + slot.done_sweeps
+        sdir = handle.request.spool_dir
+        if (handle._deadline_sweep is not None
+                and next_sweep >= handle._deadline_sweep):
+            partial = None
+            if slot.done_sweeps > 0:
+                try:
+                    partial = load_spool(sdir)
+                except Exception:  # noqa: BLE001 - partial is best-effort
+                    partial = None
+            handle._fail_tenant(DeadlineExceeded(
+                slot.tenant_id, handle._deadline_sweep, next_sweep,
+                partial=partial))
+            if self._manifest is not None:
+                self._manifest.record_done(slot.tenant_id, "failed",
+                                           slot.done_sweeps)
+            if self.metrics is not None:
+                self.metrics.emit(
+                    "tenant_deadline_exceeded", tenant=slot.tenant_id,
+                    deadline_sweep=handle._deadline_sweep,
+                    at_sweep=next_sweep)
+            return
+        try:
+            state, ck_sweep, _seed = load_spool_state(sdir)
+        except Exception as e:  # noqa: BLE001 - loud, contained
+            handle._fail_tenant(TenantError(
+                slot.tenant_id,
+                f"preemption checkpoint reload failed: "
+                f"{type(e).__name__}: {e}", where="spool", cause=e))
+            return
+        if ck_sweep != next_sweep:
+            handle._fail_tenant(TenantError(
+                slot.tenant_id,
+                f"preemption checkpoint sits at sweep {ck_sweep}, "
+                f"not the frozen tenant's {next_sweep} — the spool "
+                "moved under the preemption (fencing violation)",
+                where="spool"))
+            return
+        cont = _dc_replace(
+            handle.request, niter=slot.niter - slot.done_sweeps,
+            state=state, x0=None, start_sweep=ck_sweep,
+            resume_spool=False, warm_start=None)
+        # reset the handle's per-admission legs; the aging anchor
+        # (_age_t), the ABSOLUTE deadline sweep and the accumulated
+        # cost/telemetry survive the requeue
+        handle.request = cont
+        handle.status = "queued"
+        handle.submitted_t = time.monotonic()
+        handle.admitted_t = None
+        handle.first_result_t = None
+        handle.sweeps_done = 0
+        handle._monitor = None   # re-armed + backfilled at re-admission
+        handle.preemptions += 1
+        self.queue.put_displaced(handle)
+        self._queue_depth_peak = max(self._queue_depth_peak,
+                                     len(self.queue))
+        if self.metrics is not None:
+            self.metrics.gauge("serve_queue_depth").set(len(self.queue))
+        if self.flight is not None:
+            self.flight.note_event(
+                "preempt_requeued", tenant=slot.tenant_id,
+                next_sweep=next_sweep,
+                remaining=slot.niter - slot.done_sweeps)
 
     # ------------------------------------------------------------------
     # the pipelined executor
@@ -2710,11 +2990,37 @@ class ChainServer:
         (queue-wait included), admit->first drained result, and
         submit->converged (tenants whose armed monitor targets held;
         ``n_converged`` counts them)."""
-        return {
+        blk = {
             "admission_ms": _percentiles(self._admission_ms),
             "first_result_ms": _percentiles(self._first_result_ms),
             "converged_ms": _percentiles(self._converged_ms),
             "n_converged": len(self._converged_ms),
+        }
+        if self._tier_slo:
+            # per-priority-class percentile blocks (round 20) — what
+            # the overload bench grades the high tier's p99 against
+            blk["tiers"] = {
+                str(tier): {leg: _percentiles(vals)
+                            for leg, vals in legs.items()}
+                for tier, legs in sorted(self._tier_slo.items())}
+        return blk
+
+    def _sched_block(self) -> dict:
+        """The scheduling-policy surface (round 20, docs/SERVING.md
+        "Scheduling & overload"): active policy, starvation bound,
+        preemption/shed counters, and the per-tier door-queue depths
+        behind the aggregate ``queue_depth``."""
+        return {
+            "policy": self.scheduler,
+            "age_boost_s": self.age_boost_s,
+            "preemptions": self._preemptions,
+            "sheds": self._sheds,
+            "sheds_by_tier": {str(k): v for k, v in
+                              sorted(self._sheds_by_tier.items())},
+            "queue_tiers": {str(k): v for k, v in
+                            sorted(self.queue.depth_by_tier().items())},
+            "queue_max": self.queue.maxsize,
+            "queue_depth_peak": self._queue_depth_peak,
         }
 
     def _status_locked(self) -> dict:
@@ -2761,6 +3067,7 @@ class ChainServer:
             # render
             "stages": self._stages_block(),
             "watchdog": self._watchdog_block(),
+            "sched": self._sched_block(),
             "slo": self._slo_block(),
             # the raw per-tenant latency series behind the percentile
             # blocks — what the fleet aggregator merges across pools
@@ -2773,6 +3080,12 @@ class ChainServer:
                                     for v in self._first_result_ms],
                 "converged_ms": [round(v, 3)
                                  for v in self._converged_ms],
+                # per-tier raw series (round 20) — merged fleet-wide
+                # by obs/aggregate.py exactly like the aggregates
+                "tiers": {
+                    str(tier): {leg: [round(v, 3) for v in vals]
+                                for leg, vals in legs.items()}
+                    for tier, legs in sorted(self._tier_slo.items())},
             },
             "tenants": tenants,
         }
@@ -3013,6 +3326,17 @@ class ChainServer:
             # fit JSON replays it bitwise without re-running the pilot
             # (serve/warm.py); with a checkpoint the state wins and
             # the fit is inert
+            # scheduling state rides the journal (round 20): the
+            # priority class is verbatim; the deadline was journaled
+            # RELATIVE to the original start_sweep, so re-anchor it to
+            # the checkpoint — and drop it when it already passed
+            # (recovery favors delivering the paid-for sweeps over
+            # rejecting a job the dead process would have finished)
+            dls = rec.get("deadline_sweeps")
+            if dls is not None:
+                dls = rec["start_sweep"] + int(dls) - next_sweep
+                if dls <= 0:
+                    dls = None
             handles[key] = srv.submit(TenantRequest(
                 ma=ma, niter=remaining, nchains=rec["nchains"],
                 seed=rec["seed"], state=state, start_sweep=next_sweep,
@@ -3020,7 +3344,9 @@ class ChainServer:
                 on_divergence=rec.get("on_divergence") or "none",
                 on_converged=rec.get("on_converged") or "none",
                 monitor=mon, warm_start=rec.get("warm"),
-                trace_id=rec.get("trace_id")))
+                trace_id=rec.get("trace_id"),
+                priority=int(rec.get("priority") or 1),
+                deadline_sweeps=dls))
         # the resubmissions above are journaled in the NEW epoch, so
         # everything before it is dead weight a future recovery would
         # re-parse (and the admissions carry pickled models) — compact
@@ -3093,6 +3419,10 @@ class ChainServer:
             "adapt": {"enabled": bool(self.pool.adaptive),
                       "updates": self._adapt_updates,
                       "tenants_thinned": len(self._adapt_tenants)},
+            # the scheduling policy layer (round 20; ROADMAP 5):
+            # preemptions served, overload sheds, queue high-water —
+            # the overload bench's shed-not-grow invariant reads these
+            "sched": self._sched_block(),
             "slo": self._slo_block(),
             # per-stage DEVICE time from the in-kernel timers (round
             # 15): total/mean-per-quantum/share-of-dispatch per stage,

@@ -696,3 +696,167 @@ def test_new_bench_metrics_match_their_schemas():
     obs_schema.assert_valid(_migrate_rec()["metrics"],
                             schemas["migrate_bench_metrics"],
                             "migrate_bench metrics", defs=schemas)
+
+
+# ----------------------------------------------------------------------
+# round 20: the overload-goodput gate + the host-speed canary
+# ----------------------------------------------------------------------
+
+
+def _overload_tier(p99=200.0, jph=120.0, misses=0, sheds=1):
+    return {"jobs": 6, "done": 6 - misses, "deadline_misses": misses,
+            "makespan_s": 60.0, "jobs_per_hour": jph,
+            "admission_p50_ms": p99 / 2, "admission_p99_ms": p99,
+            "ess_min_mean": 420.0, "shed_events": sheds}
+
+
+def _overload_arm_rec(scheduler, p99=200.0, jph=120.0, preempts=2,
+                      sheds=3, bounded=True):
+    return {"scheduler": scheduler, "wall_s": 90.0,
+            "high": _overload_tier(p99=p99, jph=jph),
+            "low": _overload_tier(p99=p99 * 2, jph=jph / 3),
+            "preemptions": preempts, "sheds": sheds,
+            "sheds_by_tier": {"2": sheds}, "queue_depth_peak": 2,
+            "queue_max": 2, "queue_bounded": bounded}
+
+
+def _overload_serve_rec(p99=200.0, p99_fifo=600.0, gain=0.5,
+                        bounded=True, sheds=3, preempts=2):
+    return {"schema": 1, "tool": "serve_bench", "platform": "cpu",
+            "timestamp_utc": "t", "git_sha": "abc",
+            "config_fingerprint": "f",
+            "metrics": {"overload": {
+                "fifo": _overload_arm_rec("fifo", p99=p99_fifo,
+                                          jph=80.0, preempts=0,
+                                          sheds=sheds, bounded=bounded),
+                "sched": _overload_arm_rec("priority", p99=p99,
+                                           preempts=preempts,
+                                           sheds=sheds,
+                                           bounded=bounded),
+                "high_tier_p99_ms": p99,
+                "high_tier_p99_ms_fifo": p99_fifo,
+                "gain_high_tier_jph": gain,
+                "queue_bounded": bounded, "ess_target": 200.0}},
+            "xla": None}
+
+
+def _overload_fleet_rec(p99=650.0, sheds_total=2):
+    return {"schema": 1, "tool": "overload_bench", "platform": "cpu",
+            "timestamp_utc": "t", "git_sha": "abc",
+            "config_fingerprint": "f",
+            "metrics": {
+                "metric": "fleet_overload_high_tier_admission_p99_ms",
+                "value": p99, "fifo": {}, "sched": {},
+                "high_tier_p99_ms": p99,
+                "high_tier_p99_ms_fifo": 1400.0,
+                "gain_high_tier_jph": 0.17,
+                "sheds_total": sheds_total, "jobs": 8, "pools": 2,
+                "quick": True, "platform": "cpu"},
+            "xla": None}
+
+
+def test_perf_report_overload_gates(tmp_path, capsys):
+    """Round-20 overload gates: high-tier p99 ceiling, the
+    sched-beats-FIFO jobs/h floor at equal delivered ESS, the
+    shed-not-grow queue invariant, and the preemption-actually-fired
+    sanity check — plus the fleet record's router-shed leg."""
+    pr = _perf_report()
+
+    def rc(recs, ceiling=60000.0):
+        path = _write_ledger(tmp_path, recs)
+        return pr.check_overload(pr._read_ledger(path), ceiling)
+
+    # healthy serve + fleet records pass
+    assert rc([_overload_serve_rec(), _overload_fleet_rec()]) == 0
+    # p99 over the ceiling
+    assert rc([_overload_serve_rec(p99=999.0)], ceiling=500.0) == 2
+    capsys.readouterr()
+    # the scheduler must BEAT fifo on high-tier jobs/h
+    assert rc([_overload_serve_rec(gain=-0.1)]) == 2
+    assert "FIFO control" in capsys.readouterr().out
+    # shed-not-grow: an unbounded queue fails
+    assert rc([_overload_serve_rec(bounded=False)]) == 2
+    # an arm that never shed never overloaded
+    assert rc([_overload_serve_rec(sheds=0)]) == 2
+    capsys.readouterr()
+    # preemption must have fired in the sched arm
+    assert rc([_overload_serve_rec(preempts=0)]) == 2
+    assert "preemptions" in capsys.readouterr().out
+    # fleet leg: p99 ceiling + the router bound must have fired
+    assert rc([_overload_serve_rec(),
+               _overload_fleet_rec(p99=700.0)], ceiling=500.0) == 2
+    assert rc([_overload_serve_rec(),
+               _overload_fleet_rec(sheds_total=0)]) == 2
+    # unusable p99 is a structural failure (3), not a threshold one
+    bad = _overload_serve_rec()
+    bad["metrics"]["overload"]["high_tier_p99_ms"] = None
+    assert rc([bad]) == 3
+    capsys.readouterr()
+    # no overload record at all: skipped, not failed
+    assert rc([_bench_rec(100.0)]) == 0
+    assert "skipped" in capsys.readouterr().out
+
+
+def test_overload_metrics_match_their_schemas():
+    """The synthetic overload records above stay schema-true — the
+    drift guard for the round-20 serve_bench ``overload`` block and
+    the fleet ``overload_bench`` record kind."""
+    from gibbs_student_t_tpu.obs import schema as obs_schema
+
+    schemas = obs_schema.load_schemas()
+    ov_schema = schemas["serve_bench_metrics"]["properties"]["overload"]
+    obs_schema.assert_valid(
+        _overload_serve_rec()["metrics"]["overload"], ov_schema,
+        "serve_bench overload block", defs=schemas)
+    obs_schema.assert_valid(
+        _overload_fleet_rec()["metrics"],
+        schemas["overload_bench_metrics"],
+        "overload_bench metrics", defs=schemas)
+
+
+def test_host_canary_rides_every_record():
+    """Satellite (round 20): every bench record lands a fixed-work
+    host-speed microbench so trend gates can tell host drift from a
+    real regression. The canary never raises, returns a small
+    positive wall, and is measured fresh per record."""
+    ms = ledger_mod.host_canary_ms(reps=1)
+    assert ms is None or (isinstance(ms, float) and 0 < ms < 60000)
+    rec = ledger_mod.make_record("bench", {"metric": "m", "value": 1.0},
+                                 platform="cpu", argv=["x"])
+    assert "host_canary_ms" in rec
+    v = rec["host_canary_ms"]
+    assert v is None or (isinstance(v, float) and v > 0)
+    # schema row exists for the field
+    from gibbs_student_t_tpu.obs import schema as obs_schema
+
+    schemas = obs_schema.load_schemas()
+    assert "host_canary_ms" in schemas["ledger_record"]["properties"]
+    obs_schema.assert_valid(rec, schemas["ledger_record"],
+                            "ledger record with canary", defs=schemas)
+
+
+def test_canary_drift_annotation(tmp_path, capsys):
+    """The trend gate's canary note: >=20% drift between the latest
+    record's canary and the window median is tagged HOST DRIFT (an
+    annotation, never a failure)."""
+    pr = _perf_report()
+
+    def rec(value, canary):
+        r = _bench_rec(value)
+        r["host_canary_ms"] = canary
+        return r
+
+    recs = [rec(100.0, 10.0) for _ in range(4)] + [rec(100.0, 14.0)]
+    out = pr._canary_drift(recs, window=5)
+    assert out is not None
+    latest, med, drift = out
+    assert latest == 14.0 and med == 10.0
+    assert drift == pytest.approx(0.4)
+    pr._canary_note(recs, window=5)
+    assert "HOST DRIFT" in capsys.readouterr().out
+    # stable canary: note, no drift tag
+    recs = [rec(100.0, 10.0) for _ in range(5)]
+    pr._canary_note(recs, window=5)
+    assert "HOST DRIFT" not in capsys.readouterr().out
+    # canary-less ledgers stay silent about drift
+    assert pr._canary_drift([_bench_rec(100.0)], window=5) is None

@@ -230,10 +230,17 @@ CANNED_TOP = {
                             "share_of_dispatch": 0.31},
                "tnt": {"device_ms": 120.0, "ms_per_quantum": 3.0,
                        "share_of_dispatch": 0.12}},
+    "sched": {"policy": "priority", "age_boost_s": 30.0,
+              "preemptions": 1, "sheds": 2, "sheds_by_tier": {"2": 2},
+              "queue_tiers": {"0": 1, "2": 1}, "queue_max": 4,
+              "queue_depth_peak": 3},
     "slo": {"admission_ms": {"p50": 10.0, "p90": 20.0, "p99": 30.0,
                              "max": 31.5, "mean": 12.0},
             "first_result_ms": None, "converged_ms": None,
-            "n_converged": 0},
+            "n_converged": 0,
+            "tiers": {"0": {"admission_ms": {"p50": 5.0, "p90": 8.0,
+                                             "p99": 9.0, "max": 9.5,
+                                             "mean": 6.0}}}},
     "slo_raw": {"admission_ms": [10.0, 20.0], "first_result_ms": [],
                 "converged_ms": []},
     "tenants": [
@@ -241,6 +248,7 @@ CANNED_TOP = {
          "nchains": 16, "sweeps_done": 100, "niter": 200, "rows": 100,
          "ess_min": 12.34, "rhat_max": 1.01, "ess_per_s": 5.6,
          "converged_at": None, "quarantined": 0, "reinits": 0,
+         "priority": 0, "deadline_sweep": 180, "slack_sweeps": 30.0,
          "cost": {"device_ms": 1234.5, "lane_quanta": 320,
                   "ess_per_core_s": 10.0}},
         {"tenant_id": 1, "name": "t1", "status": "running",
@@ -256,14 +264,17 @@ GOLDEN_TOP = (
     "faults: tenant_failures=1\n"
     "watchdog: ok [policy dump] beats dispatch=0.1s drain=0.2s\n"
     "stages: hyper_mh 7.5ms/q(31%) tnt 3.0ms/q(12%)\n"
+    "sched: priority queue_tiers[t0=1 t2=1] peak=3/4 preempt=1 "
+    "sheds=2\n"
     "slo admission_ms     p50=    10.0 p90=    20.0 p99=    30.0 "
     "max=    31.5\n"
-    "  ID       NAME   STATUS CHAINS      SWEEPS   ROWS      ESS"
-    "    RHAT    ESS/s  CONV@   Q\n"
-    "   0         t0  running     16     100/200    100     12.3"
-    "   1.010      5.6      -   0\n"
-    "   1         t1  running     32      50/150      -        -"
-    "       -        -      -   -\n"
+    "slo tier 0 admission p50=     5.0 p90=     8.0 p99=     9.0\n"
+    "  ID       NAME   STATUS PRI   SLACK CHAINS      SWEEPS   ROWS"
+    "      ESS    RHAT    ESS/s  CONV@   Q\n"
+    "   0         t0  running   0      30     16     100/200    100"
+    "     12.3   1.010      5.6      -   0\n"
+    "   1         t1  running   -       -     32      50/150      -"
+    "        -       -        -      -   -\n"
 )
 
 

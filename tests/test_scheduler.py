@@ -1,0 +1,426 @@
+"""Scheduler policy-layer tests (round 20; docs/SERVING.md
+"Scheduling & overload").
+
+Three tiers in one module, selected by the ``sched`` marker:
+
+- scheduler-core property tests over FAKES (no pool, no compiles):
+  FIFO degeneration of the priority score, tier/slack/aging ordering,
+  the bounded queue's shed + displaced-bypass semantics, and the
+  shed/deadline handle-resolution contract (satellite of round 20 —
+  ``result()`` can never hang on a job the server refused or expired);
+- tiny-pool tier-1 arms: the preemption bitwise-lossless pin (a
+  preempted spooled tenant's final chains are bitwise the
+  uninterrupted run's — the checkpoint/resume contract under
+  scheduling) and the structured server-side shed;
+- a slow RPC arm: priority/deadline ride the submit frame, preemption
+  stays bitwise over the wire, and a deadline-armed victim resolves
+  with a structured ``DeadlineExceeded`` carrying the spooled prefix.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from gibbs_student_t_tpu.serve.scheduler import (
+    AdmissionQueue,
+    DeadlineExceeded,
+    QueueFull,
+    RetryAfter,
+    TenantError,
+    TenantHandle,
+    TenantRequest,
+    schedule_score,
+)
+
+pytestmark = pytest.mark.sched
+
+
+def _native_available() -> bool:
+    from gibbs_student_t_tpu import native
+
+    return native.available()
+
+
+# ---------------------------------------------------------------------------
+# fakes: a TenantRequest never validates ``ma`` at construction, so the
+# policy layer is testable without a model or a pool
+# ---------------------------------------------------------------------------
+
+class _FakeMA:
+    pass
+
+
+def _handle(tid=0, *, niter=20, priority=1, deadline=None, **kw):
+    req = TenantRequest(ma=_FakeMA(), niter=niter, nchains=4,
+                        priority=priority, **kw)
+    h = TenantHandle(tid, req)
+    if deadline is not None:
+        # what ChainServer.submit arms: the ABSOLUTE deadline sweep
+        h._deadline_sweep = req.start_sweep + deadline
+    return h
+
+
+def _drain(q, score=None, fits=lambda h: True):
+    out = []
+    while True:
+        h = q.pop_first_fit(fits)
+        if h is None:
+            return out
+        out.append(h)
+
+
+# ---------------------------------------------------------------------------
+# schedule_score ordering properties
+# ---------------------------------------------------------------------------
+
+def test_retry_after_is_a_structured_queuefull():
+    e = RetryAfter("full", retry_after_s=1.5, queue_depth=7, tier=2,
+                   where="router")
+    assert isinstance(e, QueueFull)
+    assert e.retry_after_s == 1.5 and e.queue_depth == 7
+    assert e.tier == 2 and e.where == "router"
+    # defaults: a server-side shed with no estimate is still structured
+    e2 = RetryAfter("full")
+    assert e2.retry_after_s is None and e2.queue_depth is None
+    assert e2.where == "server"
+
+
+def test_fifo_degeneration_with_default_requests():
+    """The stability pin: equal priority + no deadline pops in EXACT
+    arrival order under the scored queue — the priority scheduler is
+    bitwise the historical FIFO until someone asks for more."""
+    scored = AdmissionQueue(maxsize=16, score=schedule_score)
+    plain = AdmissionQueue(maxsize=16)
+    hs = [_handle(i) for i in range(6)]
+    for h in hs:
+        scored.put(h)
+    for h in [_handle(100 + i) for i in range(6)]:
+        plain.put(h)
+    assert [h.tenant_id for h in _drain(scored)] == [0, 1, 2, 3, 4, 5]
+    assert [h.tenant_id for h in _drain(plain)] == list(range(100, 106))
+
+
+def test_priority_tiers_order_pops():
+    q = AdmissionQueue(maxsize=16,
+                       score=lambda h: schedule_score(h, age_boost_s=0))
+    for tid, pr in [(0, 2), (1, 0), (2, 1), (3, 0), (4, 3)]:
+        q.put(_handle(tid, priority=pr))
+    # tier first (0 before 1 before 2...), arrival seq within a tier
+    assert [h.tenant_id for h in _drain(q)] == [1, 3, 2, 0, 4]
+
+
+def test_deadline_slack_orders_within_a_tier():
+    """Within a tier the tightest deadline pops first, and any armed
+    deadline outranks an open-ended job (slack +inf)."""
+    q = AdmissionQueue(maxsize=16,
+                       score=lambda h: schedule_score(h, age_boost_s=0))
+    q.put(_handle(0, niter=20))                  # no deadline -> +inf
+    q.put(_handle(1, niter=20, deadline=100))    # slack 80
+    q.put(_handle(2, niter=20, deadline=25))     # slack 5
+    assert [h.tenant_id for h in _drain(q)] == [2, 1, 0]
+    # the slack a fresh handle reports is budget-based: niter left
+    h = _handle(9, niter=20, deadline=25)
+    assert h.slack_sweeps() == pytest.approx(5.0)
+    assert _handle(9, niter=20).slack_sweeps() is None
+
+
+def test_aging_bounds_starvation():
+    """A batch job left queued long enough outranks a FRESH interactive
+    arrival — one tier boost per ``age_boost_s`` waited — and aging
+    off (None/0) keeps raw tiers."""
+    old_batch = _handle(0, priority=2)
+    old_batch._age_t = time.monotonic() - 95.0   # ~3 boosts at 30 s
+    fresh_hi = _handle(1, priority=0)
+    s_old = schedule_score(old_batch, age_boost_s=30.0)
+    s_hi = schedule_score(fresh_hi, age_boost_s=30.0)
+    assert s_old < s_hi
+    assert schedule_score(old_batch, age_boost_s=None)[0] == 2.0
+    assert schedule_score(old_batch, age_boost_s=0)[0] == 2.0
+
+
+def test_scored_first_fit_skips_nonfitting_best():
+    """Best-score-fit: the best-scored job that does not fit is passed
+    over for a fitting lower-tier one (backfill survives the priority
+    scheduler); the big job pops once capacity is claimed."""
+    q = AdmissionQueue(maxsize=16,
+                       score=lambda h: schedule_score(h, age_boost_s=0))
+    big_hi = _handle(0, priority=0)
+    big_hi.request.nchains = 32
+    small_lo = _handle(1, priority=2)
+    q.put(big_hi)
+    q.put(small_lo)
+    got = q.pop_first_fit(lambda h: h.request.nchains <= 4)
+    assert got is small_lo
+    assert q.pop_first_fit(lambda h: True) is big_hi
+
+
+# ---------------------------------------------------------------------------
+# the bounded queue: shed, displaced bypass, per-tier depth
+# ---------------------------------------------------------------------------
+
+def test_reject_policy_sheds_at_capacity():
+    q = AdmissionQueue(maxsize=2, policy="reject")
+    q.put(_handle(0))
+    q.put(_handle(1))
+    with pytest.raises(QueueFull):
+        q.put(_handle(2))
+    assert len(q) == 2
+
+
+def test_block_policy_times_out_loudly():
+    q = AdmissionQueue(maxsize=1, policy="block")
+    q.put(_handle(0))
+    with pytest.raises(QueueFull, match="still full"):
+        q.put(_handle(1), timeout=0.05)
+
+
+def test_put_displaced_bypasses_capacity():
+    """The lossless-preemption contract: a preempted continuation is
+    requeued even through a FULL reject queue (it was admitted once —
+    shedding it would turn a preemption into data loss), and it keeps
+    its aging anchor so it carries waited time into the next pop."""
+    q = AdmissionQueue(maxsize=1, policy="reject",
+                       score=lambda h: schedule_score(
+                           h, age_boost_s=30.0))
+    q.put(_handle(0))
+    displaced = _handle(7, priority=2)
+    displaced._age_t = time.monotonic() - 120.0
+    q.put_displaced(displaced)
+    assert len(q) == 2
+    assert displaced._queue_seq > 0
+    # the preserved anchor outranks the fresh default-tier head
+    assert q.pop_first_fit(lambda h: True) is displaced
+
+
+def test_depth_by_tier():
+    q = AdmissionQueue(maxsize=16)
+    for pr in (0, 2, 2, 1, 2):
+        q.put(_handle(pr, priority=pr))
+    assert q.depth_by_tier() == {0: 1, 1: 1, 2: 3}
+    q.pop_first_fit(lambda h: h.request.priority == 2)
+    assert q.depth_by_tier() == {0: 1, 1: 1, 2: 2}
+
+
+# ---------------------------------------------------------------------------
+# handle resolution: a shed or expired job's result() NEVER hangs
+# ---------------------------------------------------------------------------
+
+def test_shed_handle_resolves_promptly():
+    h = _handle(3, priority=2)
+    err = RetryAfter("admission queue full", retry_after_s=0.5,
+                     queue_depth=4, tier=2)
+    h._fail_shed(err)
+    assert h.done() and h.status == "rejected"
+    with pytest.raises(RetryAfter) as ei:
+        h.result(timeout=0.1)   # resolved -> returns without waiting
+    assert ei.value is err
+    assert ei.value.retry_after_s == 0.5 and ei.value.queue_depth == 4
+    assert ei.value.tier == 2
+
+
+def test_deadline_exceeded_structure():
+    h = _handle(5, deadline=40)
+    err = DeadlineExceeded(5, deadline_sweep=40, served_sweeps=15,
+                           partial="prefix-stub")
+    assert isinstance(err, TenantError)
+    assert err.deadline_sweep == 40 and err.served_sweeps == 15
+    assert err.partial == "prefix-stub" and err.where == "deadline"
+    h._fail_tenant(err)
+    assert h.done() and h.status == "failed"
+    with pytest.raises(DeadlineExceeded) as ei:
+        h.result(timeout=0.1)
+    assert ei.value.partial == "prefix-stub"
+
+
+def test_submit_validates_priority_and_deadline_types():
+    """The wire-field validation lives in ChainServer.submit; pin the
+    score's tolerance here: a handle with the DEFAULTS scores finite
+    and orderable (no deadline -> +inf slack, never a TypeError)."""
+    s = schedule_score(_handle(0))
+    assert s[1] == float("inf") and isinstance(s[0], float)
+
+
+# ---------------------------------------------------------------------------
+# tiny-pool tier-1 arms (one server, one compile)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def demo():
+    from tests.conftest import make_demo_pta
+    from gibbs_student_t_tpu.config import GibbsConfig
+
+    pta = make_demo_pta()
+    return pta.frozen(0), GibbsConfig(model="mixture")
+
+
+EXACT_FIELDS = ("chain", "zchain", "thetachain", "dfchain")
+ROUNDOFF_FIELDS = ("bchain", "alphachain", "poutchain")
+
+
+@pytest.mark.serve
+@pytest.mark.skipif(not _native_available(),
+                    reason="preemption needs spooling (native library)")
+def test_preemption_bitwise_lossless(demo, tmp_path):
+    """The tentpole pin: a spooled low-tier tenant preempted by a
+    priority-0 arrival finishes with final chains BITWISE identical to
+    the same request served uninterrupted — preemption is the cancel
+    freeze + the checkpoint-resume continuation, and the per-sweep
+    fold-in keying makes the splice invisible."""
+    from gibbs_student_t_tpu.serve import ChainServer
+
+    ma, cfg = demo
+    srv = ChainServer(ma, cfg, nlanes=32, quantum=5, record="full",
+                      scheduler="priority")
+    # arm 1: the uninterrupted reference (same request shape, spooled)
+    ref = srv.submit(TenantRequest(
+        ma=ma, niter=20, nchains=32, seed=5, priority=2,
+        spool_dir=str(tmp_path / "ref")))
+    srv.run()
+    ref_res = ref.result()
+    # arm 2: same job; a priority-0 arrival needs the WHOLE pool, so
+    # admission must preempt the running spooled tenant
+    low = srv.submit(TenantRequest(
+        ma=ma, niter=20, nchains=32, seed=5, priority=2,
+        spool_dir=str(tmp_path / "low")))
+    hi_box = []
+
+    def on_q(server):
+        # only once the victim is RUNNING with a checkpoint behind it —
+        # a hi arrival while low is still queued is (correctly) just
+        # admitted first, no preemption needed
+        if low.sweeps_done >= 5 and not hi_box:
+            hi_box.append(server.submit(TenantRequest(
+                ma=ma, niter=10, nchains=32, seed=99, priority=0)))
+
+    srv.run(on_quantum=on_q)
+    for _ in range(20):
+        if low.done() and hi_box and hi_box[0].done():
+            break
+        srv.run(on_quantum=on_q)
+    hi_box[0].result()
+    low_res = low.result()
+    assert low.preemptions >= 1
+    assert srv.summary()["sched"]["preemptions"] >= 1
+    for f in EXACT_FIELDS + ROUNDOFF_FIELDS:
+        assert np.array_equal(np.asarray(getattr(ref_res, f)),
+                              np.asarray(getattr(low_res, f))), f
+    st = srv.status()
+    assert st["sched"]["policy"] == "priority"
+
+
+@pytest.mark.serve
+def test_server_shed_is_structured(demo):
+    """A bounded reject-policy server sheds with the STRUCTURED signal
+    (retry_after_s + queue_depth + tier) and counts it per tier — and
+    the shed happens at submit, before any placement, so the queue
+    never grows past its bound."""
+    from gibbs_student_t_tpu.serve import ChainServer
+
+    ma, cfg = demo
+    srv = ChainServer(ma, cfg, nlanes=32, quantum=5, max_queue=1,
+                      backpressure="reject", pipeline=False)
+    srv.submit(TenantRequest(ma=ma, niter=10, nchains=16, seed=0))
+    with pytest.raises(RetryAfter) as ei:
+        srv.submit(TenantRequest(ma=ma, niter=10, nchains=16, seed=1,
+                                 priority=2))
+    e = ei.value
+    assert e.retry_after_s is not None and e.retry_after_s >= 0.5
+    assert e.queue_depth >= 1 and e.tier == 2 and e.where == "server"
+    sched = srv.summary()["sched"]
+    assert sched["sheds"] == 1
+    assert sched["sheds_by_tier"] in ({"2": 1}, {2: 1})
+    assert srv.status()["queue_depth"] <= 1
+
+
+# ---------------------------------------------------------------------------
+# the wire: priority/deadline on the submit frame (slow tier)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.fleet
+@pytest.mark.skipif(not _native_available(),
+                    reason="preemption needs spooling (native library)")
+def test_rpc_priority_preemption_and_deadline(demo, tmp_path):
+    """Over a REAL RpcServer/RemoteChainServer edge: priority and
+    deadline_sweeps ride the submit frame; a remote spooled tenant
+    preempted by a remote priority-0 arrival still finishes bitwise
+    the uninterrupted run; and a deadline-armed victim whose deadline
+    passed at the freeze resolves with a structured DeadlineExceeded
+    carrying the spooled prefix — the wire adds transport, not
+    semantics."""
+    from gibbs_student_t_tpu.serve import ChainServer
+    from gibbs_student_t_tpu.serve.rpc import (
+        RemoteChainServer,
+        RpcServer,
+    )
+
+    ma, cfg = demo
+    srv = ChainServer(ma, cfg, nlanes=32, quantum=5, record="full",
+                      scheduler="priority")
+    rpc = RpcServer(srv)
+    cli = RemoteChainServer(rpc.address)
+    try:
+        ref = cli.submit(TenantRequest(
+            ma=ma, niter=20, nchains=32, seed=11, priority=2,
+            spool_dir=str(tmp_path / "ref")))
+        srv.run()
+        ref_res = ref.result(timeout=300)
+        # priority + deadline land server-side via the wire
+        low = cli.submit(TenantRequest(
+            ma=ma, niter=20, nchains=32, seed=11, priority=2,
+            deadline_sweeps=100, spool_dir=str(tmp_path / "low")))
+        p = low.progress()
+        assert p["priority"] == 2 and p["deadline_sweep"] == 100
+        hi_box = []
+
+        def on_q(server):
+            if (not hi_box
+                    and low.progress()["sweeps_done"] >= 5):
+                hi_box.append(cli.submit(TenantRequest(
+                    ma=ma, niter=10, nchains=32, seed=77, priority=0)))
+
+        srv.run(on_quantum=on_q)
+        for _ in range(20):
+            if low.done() and hi_box and hi_box[0].done():
+                break
+            srv.run(on_quantum=on_q)
+        hi_box[0].result(timeout=300)
+        low_res = low.result(timeout=300)
+        assert low.progress().get("preemptions", 0) >= 1
+        for f in EXACT_FIELDS:
+            assert np.array_equal(np.asarray(getattr(ref_res, f)),
+                                  np.asarray(getattr(low_res, f))), f
+        # deadline at sweep 5: any preemption freeze lands at/after the
+        # first quantum boundary, so the requeue check must expire it
+        dead = cli.submit(TenantRequest(
+            ma=ma, niter=20, nchains=32, seed=11, priority=2,
+            deadline_sweeps=5, spool_dir=str(tmp_path / "dead")))
+        hi2 = []
+
+        def on_q2(server):
+            if (not hi2
+                    and dead.progress()["sweeps_done"] >= 5):
+                hi2.append(cli.submit(TenantRequest(
+                    ma=ma, niter=10, nchains=32, seed=78, priority=0)))
+
+        srv.run(on_quantum=on_q2)
+        for _ in range(20):
+            if dead.done() and hi2 and hi2[0].done():
+                break
+            srv.run(on_quantum=on_q2)
+        hi2[0].result(timeout=300)
+        with pytest.raises(DeadlineExceeded) as ei:
+            dead.result(timeout=300)
+        err = ei.value
+        assert err.deadline_sweep == 5 and err.served_sweeps >= 5
+        assert err.partial is not None
+        # the prefix is bitwise the uninterrupted run's first sweeps
+        n = np.asarray(err.partial.chain).shape[0]
+        assert n >= 5
+        assert np.array_equal(np.asarray(err.partial.chain),
+                              np.asarray(ref_res.chain)[:n])
+    finally:
+        srv.close()
+        rpc.close()
+        cli.close()

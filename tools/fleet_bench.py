@@ -332,6 +332,187 @@ def _migrate_arm(args, template, model_for, cfg, pool_kwargs, base,
     return line
 
 
+def _overload_arm(args, template, model_for, cfg, pool_kwargs, base,
+                  argv):
+    """Fleet-level overload A/B (ROADMAP 5): a two-tier burst at
+    2 pools arriving faster than the fleet serves it, with the
+    router's ``max_queue_depth`` admission bound armed — submissions
+    past the bound shed at the ROUTER with a structured retry-after
+    (never placed, never queued), and the client retry loop is the
+    closed loop that throttles arrival to drain rate. Run twice —
+    pools under FIFO, then under the priority+deadline scheduler —
+    and graded on the fleet-merged per-tier admission p99 plus
+    high-tier jobs/h over the tier makespan. Every shed's structured
+    fields (retry_after_s / queue_depth / where) are asserted, not
+    just counted."""
+    import threading
+
+    from gibbs_student_t_tpu.serve import RetryAfter, TenantRequest
+    from gibbs_student_t_tpu.serve.router import (
+        spawn_fleet,
+        teardown_fleet,
+    )
+
+    import numpy as np
+
+    n_jobs = args.tenants
+    rng = np.random.default_rng(args.seed)
+    chains_each = args.nlanes // args.resident
+    budgets = [int(rng.integers(args.quanta_min, args.quanta_max + 1))
+               * args.quantum for _ in range(n_jobs)]
+    job_mas = [model_for(300 + i) for i in range(min(n_jobs, 4))]
+
+    def one_arm(scheduler: str):
+        fdir = os.path.join(base, f"over_{scheduler}")
+        fleet = spawn_fleet(
+            fdir, 2, template, cfg,
+            pool_kwargs={**pool_kwargs, "scheduler": scheduler},
+            failover=False,
+            max_queue_depth=args.overload_queue)
+        try:
+            fleet.placement = "round_robin"
+            warm = [fleet.submit(TenantRequest(
+                ma=template, niter=args.quantum, nchains=16,
+                seed=args.seed, name=f"warm{i}"), pool=i)
+                for i in range(2)]
+            for w in warm:
+                w.result(timeout=1800)
+            fleet.placement = "load"
+            fleet.reset_counters()
+
+            def req(i):
+                hi = (i % 4 == 0)
+                return TenantRequest(
+                    ma=job_mas[i % len(job_mas)], niter=budgets[i],
+                    nchains=chains_each, seed=args.seed + i,
+                    name=f"ojob{i}",
+                    spool_dir=os.path.join(fdir, f"spool{i}"),
+                    priority=0 if hi else 2,
+                    deadline_sweeps=3 * budgets[i] if hi else None)
+
+            handles, shed_events, errs = {}, [], []
+            done_t = {}
+
+            def wait(i, h):
+                try:
+                    h.result(timeout=3600)
+                    done_t[i] = time.perf_counter()
+                except Exception as e:  # noqa: BLE001
+                    errs.append((i, e))
+
+            t0 = time.perf_counter()
+            threads = []
+            pending = list(range(n_jobs))
+            tries = 0
+            while pending:
+                i = pending[0]
+                try:
+                    h = fleet.submit(req(i))
+                except RetryAfter as e:
+                    # the shed IS the product: assert its structure
+                    if e.retry_after_s is None or e.queue_depth is None:
+                        raise RuntimeError(
+                            f"unstructured shed: {e!r}") from e
+                    shed_events.append({
+                        "tier": e.tier, "where": e.where,
+                        "retry_after_s": e.retry_after_s,
+                        "queue_depth": e.queue_depth})
+                    tries += 1
+                    if tries > 2000:
+                        raise RuntimeError(
+                            "overload arm never drained") from e
+                    time.sleep(min(e.retry_after_s, 0.25))
+                    continue
+                handles[i] = h
+                t = threading.Thread(target=wait, args=(i, h),
+                                     daemon=True)
+                t.start()
+                threads.append(t)
+                pending.pop(0)
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            if errs:
+                raise RuntimeError(
+                    f"{len(errs)} job(s) failed in the overload "
+                    f"{scheduler} arm: ojob{errs[0][0]}: "
+                    f"{errs[0][1]}")
+            snap = fleet.fleet_status()
+
+            def tier_view(tier):
+                idx = [i for i in range(n_jobs)
+                       if (0 if i % 4 == 0 else 2) == tier]
+                done = [i for i in idx if i in done_t]
+                mk = (max(done_t[i] for i in done) - t0
+                      if done else None)
+                tslo = (((snap.get("slo") or {}).get("tiers") or {})
+                        .get(str(tier)) or {})
+                adm = tslo.get("admission_ms") or {}
+                return {
+                    "jobs": len(idx),
+                    "done": len(done),
+                    "makespan_s": (None if mk is None
+                                   else round(mk, 3)),
+                    "jobs_per_hour": (
+                        0.0 if not done
+                        else round(len(done) / (mk / 3600.0), 2)),
+                    "admission_p99_ms": adm.get("p99"),
+                    "shed_events": sum(1 for s in shed_events
+                                       if s["tier"] == tier),
+                }
+
+            router = snap.get("router") or {}
+            sched = snap.get("sched") or {}
+            return {
+                "scheduler": scheduler,
+                "wall_s": round(wall, 3),
+                "high": tier_view(0),
+                "low": tier_view(2),
+                "router_sheds": router.get("sheds", 0),
+                "router_sheds_by_tier":
+                    router.get("sheds_by_tier") or {},
+                "max_queue_depth": router.get("max_queue_depth"),
+                "pool_preemptions": sched.get("preemptions", 0),
+                "queue_tiers": sched.get("queue_tiers") or {},
+                "shed_events": shed_events[:8],
+            }
+        finally:
+            teardown_fleet(fleet, remove_dirs=False)
+
+    fifo_o = one_arm("fifo")
+    sched_o = one_arm("priority")
+    f_hi, s_hi = fifo_o["high"], sched_o["high"]
+    gain = (s_hi["jobs_per_hour"] / f_hi["jobs_per_hour"] - 1.0
+            if f_hi["jobs_per_hour"] else None)
+    line = {
+        "metric": "fleet_overload_high_tier_admission_p99_ms",
+        "value": s_hi["admission_p99_ms"],
+        "fifo": fifo_o,
+        "sched": sched_o,
+        "high_tier_p99_ms": s_hi["admission_p99_ms"],
+        "high_tier_p99_ms_fifo": f_hi["admission_p99_ms"],
+        "gain_high_tier_jph": (None if gain is None
+                               else round(gain, 4)),
+        "sheds_total": fifo_o["router_sheds"]
+        + sched_o["router_sheds"],
+        "jobs": n_jobs,
+        "pools": 2,
+        "nlanes": args.nlanes,
+        "quantum": args.quantum,
+        "quick": bool(args.quick),
+        "platform": "cpu",
+    }
+    print(f"# overload arm: high-tier admission p99 "
+          f"{s_hi['admission_p99_ms']} ms (sched) vs "
+          f"{f_hi['admission_p99_ms']} ms (fifo); high-tier "
+          f"{s_hi['jobs_per_hour']} vs {f_hi['jobs_per_hour']} "
+          f"jobs/h; router sheds {line['sheds_total']}, pool "
+          f"preemptions {sched_o['pool_preemptions']}",
+          file=sys.stderr)
+    _write_ledger("overload_bench", line, args, argv)
+    return line
+
+
 def _trace_evidence(fleet, snap, path, job_names):
     """Export the stitched fleet trace and distill the round-19
     ``perf_report --check`` gate evidence: every completed job traced
@@ -459,6 +640,22 @@ def main(argv=None):
                          "registry's fresh-vs-cached counters land "
                          "in a 'coldstart' ledger record "
                          "(docs/PERFORMANCE.md 'Cold starts')")
+    ap.add_argument("--overload-arm", action="store_true",
+                    help="run the fleet overload A/B instead of the "
+                         "standard workload: a two-tier burst past "
+                         "fleet capacity against the router's "
+                         "max_queue_depth admission bound, pools "
+                         "under FIFO then under the priority+"
+                         "deadline scheduler — router sheds with "
+                         "structured retry-after, fleet-merged "
+                         "per-tier admission p99, high-tier jobs/h "
+                         "over the tier makespan (an "
+                         "'overload_bench' ledger record; "
+                         "docs/SERVING.md 'Scheduling & overload')")
+    ap.add_argument("--overload-queue", type=int, default=2,
+                    help="router max_queue_depth for the overload "
+                         "arm (min queued+staged across live pools "
+                         "at which unpinned submits shed)")
     args = ap.parse_args(argv)
     if args.quick:
         args.pools = 2
@@ -502,11 +699,14 @@ def main(argv=None):
     pool_kwargs = {"nlanes": args.nlanes, "quantum": args.quantum}
     base = tempfile.mkdtemp(prefix="gst_fleet_bench_")
 
-    if args.coldstart_arm or args.migrate_arm:
+    if args.coldstart_arm or args.migrate_arm or args.overload_arm:
         try:
             if args.coldstart_arm:
                 line = _coldstart_arm(args, template, cfg,
                                       pool_kwargs, base, argv)
+            elif args.overload_arm:
+                line = _overload_arm(args, template, model_for, cfg,
+                                     pool_kwargs, base, argv)
             else:
                 line = _migrate_arm(args, template, model_for, cfg,
                                     pool_kwargs, base, cpu_cores,

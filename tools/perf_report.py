@@ -282,6 +282,20 @@ def print_report(ledger_recs, include_rounds=True):
                       f"submit p50 {mc.get('submit_full_p50_ms')}ms "
                       f"full -> {mc.get('submit_digest_p50_ms')}ms "
                       f"digest")
+            # overload-arm sub-line (round 20 --overload-arm records):
+            # priority+deadline scheduler vs FIFO under
+            # arrival > capacity
+            ov = m.get("overload")
+            if isinstance(ov, dict):
+                sc = (ov.get("sched") or {})
+                print(f"    overload high-tier p99 "
+                      f"{ov.get('high_tier_p99_ms')}ms (fifo "
+                      f"{ov.get('high_tier_p99_ms_fifo')}ms) "
+                      f"high-tier jobs/h "
+                      f"{(ov.get('gain_high_tier_jph') or 0) * 100:+.1f}% "
+                      f"preemptions={sc.get('preemptions')} "
+                      f"sheds={sc.get('sheds')} "
+                      f"queue_bounded={ov.get('queue_bounded')}")
             # chaos-arm sub-line (serve_bench --faults records)
             f = m.get("faults")
             if isinstance(f, dict):
@@ -356,6 +370,19 @@ def print_report(ledger_recs, include_rounds=True):
                   f"{rcv.get('spawn_to_first_result_s')}s "
                   f"fresh_probes={reg.get('probes_fresh')} "
                   f"fresh_autotune={reg.get('autotune_fresh')}")
+        elif rec.get("tool") == "overload_bench":
+            fifo = m.get("fifo") or {}
+            sched = m.get("sched") or {}
+            print(f"  {rec.get('timestamp_utc', '?'):20s} "
+                  f"{rec.get('tool', '?'):14s} "
+                  f"{rec.get('platform') or '?':8s} "
+                  f"high-tier admission p99 "
+                  f"{m.get('high_tier_p99_ms')}ms (fifo "
+                  f"{m.get('high_tier_p99_ms_fifo')}ms) "
+                  f"high-tier jobs/h "
+                  f"{(m.get('gain_high_tier_jph') or 0) * 100:+.1f}% "
+                  f"router_sheds={m.get('sheds_total')} "
+                  f"preemptions={sched.get('pool_preemptions')}")
         elif rec.get("tool") == "migrate_bench":
             base = m.get("base") or {}
             reb = m.get("rebalance") or {}
@@ -451,6 +478,47 @@ def print_trends(ledger_recs, window=5):
               f"{_sparkline(vals)}")
 
 
+def _canary_drift(ledger_recs, window=5):
+    """Host-speed drift evidence from the per-record fixed-work
+    canary (obs/ledger.host_canary_ms, round 20): the latest
+    record's canary vs the median over the ``window`` records
+    preceding it. Returns (latest_ms, median_ms, drift_frac) or None
+    when fewer than two records carry the field."""
+    import statistics
+
+    vals = [r.get("host_canary_ms") for r in ledger_recs
+            if isinstance(r.get("host_canary_ms"), (int, float))]
+    if len(vals) < 2:
+        return None
+    prior = vals[max(0, len(vals) - 1 - window):-1]
+    med = statistics.median(prior)
+    if not med:
+        return None
+    return vals[-1], med, (vals[-1] - med) / med
+
+
+def _canary_note(ledger_recs, window=5):
+    """Print the host-drift annotation the trend gates read alongside
+    their evidence: a slower canary means the HOST slowed, so a
+    same-sized metric drop is drift, not a code regression. Always a
+    note, never a failure — the canary annotates verdicts, it does
+    not render them."""
+    d = _canary_drift(ledger_recs, window=window)
+    if d is None:
+        print("check: host canary — <2 records carry host_canary_ms; "
+              "drift annotation arms as history accrues")
+        return
+    latest, med, drift = d
+    tag = ""
+    if abs(drift) >= 0.2:
+        tag = (" — HOST DRIFT: the host itself runs "
+               f"{'slower' if drift > 0 else 'faster'}; read "
+               "same-direction metric moves against this before "
+               "calling them code regressions")
+    print(f"check: host canary {latest:.2f} ms vs median({window}) "
+          f"{med:.2f} ms ({drift * 100:+.1f}%){tag}")
+
+
 def check_trend(ledger_recs, max_trend_drop, window=5, points=2):
     """The sustained-regression gate: for every (metric, platform)
     series, each of the last ``points`` records is compared against
@@ -464,6 +532,7 @@ def check_trend(ledger_recs, max_trend_drop, window=5, points=2):
     if not series:
         print("check: no metric series — trend gate skipped")
         return 0
+    _canary_note(ledger_recs, window=window)
     rc = 0
     for (metric, platform), vals in sorted(series.items()):
         key = f"{metric}@{platform or '?'}"
@@ -1153,6 +1222,107 @@ def check_coldstart(ledger_recs, max_coldstart_ms,
     return 0
 
 
+def check_overload(ledger_recs, max_high_tier_p99):
+    """Overload-goodput gates (round 20, ROADMAP 5) over the latest
+    ``serve_bench`` record carrying an ``overload`` block (quick
+    shapes are gradable here — the A/B is internally normalized,
+    sched vs FIFO on the same shapes) and the latest fleet
+    ``overload_bench`` record. Four legs on the serve block:
+
+    1. the priority+deadline scheduler's high-tier admission p99 must
+       stay under ``--max-high-tier-p99`` ms;
+    2. the scheduler must BEAT the FIFO control on high-tier jobs/h
+       at equal delivered ESS (``gain_high_tier_jph > 0`` — the
+       economics headline, makespan-based);
+    3. the queue must SHED, not grow: ``queue_bounded`` (peak depth
+       <= the configured bound) in both arms, with at least one
+       structured shed counted (an overload arm that never shed
+       never overloaded);
+    4. lossless preemption must have fired (``sched.preemptions >=
+       1`` — the mechanism under test, not a bystander).
+
+    The fleet record is graded on its structured sheds (the router
+    bound must have fired) and the same p99 ceiling. Skipped with a
+    note when no overload record exists — the gate arms itself the
+    first time the arm lands a record."""
+    serve = [r for r in ledger_recs
+             if r.get("tool") == "serve_bench"
+             and isinstance((r.get("metrics") or {}).get("overload"),
+                            dict)]
+    rc = 0
+    if not serve:
+        print("check: no serve_bench --overload-arm record — "
+              "overload gate skipped")
+    else:
+        ov = serve[-1]["metrics"]["overload"]
+        sched = ov.get("sched") or {}
+        fifo = ov.get("fifo") or {}
+        p99 = ov.get("high_tier_p99_ms")
+        gain = ov.get("gain_high_tier_jph")
+        sheds = (sched.get("sheds") or 0) + (fifo.get("sheds") or 0)
+        print(f"check: overload high-tier admission p99 {p99} ms "
+              f"(max {max_high_tier_p99:.0f}; fifo control "
+              f"{ov.get('high_tier_p99_ms_fifo')} ms), high-tier "
+              f"jobs/h gain "
+              + (f"{gain * 100:+.1f}%"
+                 if isinstance(gain, (int, float)) else "n/a")
+              + f", preemptions {sched.get('preemptions')}, sheds "
+              f"{sheds}, queue_bounded {ov.get('queue_bounded')}")
+        if not isinstance(p99, (int, float)):
+            print("check: FAIL — overload block has no usable "
+                  f"high_tier_p99_ms ({p99!r})")
+            return 3
+        if p99 > max_high_tier_p99:
+            print(f"check: FAIL — high-tier admission p99 {p99:.0f} "
+                  f"ms > {max_high_tier_p99:.0f} under the priority "
+                  "scheduler (the tier the scheduler exists to "
+                  "protect is starving)")
+            rc = 2
+        if not isinstance(gain, (int, float)) or gain <= 0:
+            print("check: FAIL — priority+deadline scheduler does "
+                  "not beat the FIFO control on high-tier jobs/h at "
+                  f"equal delivered ESS (gain {gain!r}); preemption "
+                  "is not converting low-tier lanes into high-tier "
+                  "goodput")
+            rc = 2
+        if ov.get("queue_bounded") is not True:
+            print("check: FAIL — queue depth exceeded its bound "
+                  "during the overload arm (overload must shed with "
+                  "retry-after, never grow the queue)")
+            rc = 2
+        if not sheds:
+            print("check: FAIL — zero sheds across both overload "
+                  "arms (arrival never exceeded capacity: the arm "
+                  "measured a loaded pool, not an overloaded one)")
+            rc = 2
+        if not sched.get("preemptions"):
+            print("check: FAIL — zero preemptions in the scheduler "
+                  "arm (the high tier never reclaimed lanes; the "
+                  "p99 win, if any, is queue-ordering luck)")
+            rc = 2
+    fleet = [r for r in ledger_recs
+             if r.get("tool") == "overload_bench"]
+    if not fleet:
+        print("check: no fleet overload_bench record — fleet "
+              "overload gate skipped")
+        return rc
+    m = fleet[-1].get("metrics") or {}
+    p99 = m.get("high_tier_p99_ms")
+    print(f"check: fleet overload high-tier p99 {p99} ms (max "
+          f"{max_high_tier_p99:.0f}), router sheds "
+          f"{m.get('sheds_total')}")
+    if isinstance(p99, (int, float)) and p99 > max_high_tier_p99:
+        print(f"check: FAIL — fleet high-tier admission p99 "
+              f"{p99:.0f} ms > {max_high_tier_p99:.0f}")
+        rc = 2
+    if not m.get("sheds_total"):
+        print("check: FAIL — the fleet overload arm recorded zero "
+              "router sheds (the max_queue_depth admission bound "
+              "never fired)")
+        rc = 2
+    return rc
+
+
 def check_migrate(ledger_recs):
     """Live-migration gate over the latest ``migrate_bench`` record:
     the rebalance arm must (1) actually migrate, (2) deliver MORE
@@ -1316,6 +1486,15 @@ def main(argv=None):
                          "submitted up front, so deliberate queue-wait "
                          "dominates — this is a placement-starvation "
                          "guard, not a tuning target)")
+    ap.add_argument("--max-high-tier-p99", type=float,
+                    default=60000.0, metavar="MS",
+                    help="overload gate: max tolerated HIGH-TIER "
+                         "submit->admit p99 (ms) under the priority+"
+                         "deadline scheduler in the latest overload "
+                         "record — the tier the scheduler exists to "
+                         "protect; the same ceiling grades the fleet "
+                         "overload_bench record (gate skipped when "
+                         "no overload record exists)")
     ap.add_argument("--max-coldstart-ms", type=float, default=120000.0,
                     help="max WARM spawn->first-result wall (ms) on "
                          "the latest coldstart record — what a "
@@ -1379,12 +1558,13 @@ def main(argv=None):
         rc_cold = check_coldstart(recs, args.max_coldstart_ms,
                                   args.min_coldstart_speedup)
         rc_mig = check_migrate(recs)
+        rc_over = check_overload(recs, args.max_high_tier_p99)
         rc_trend = check_trend(recs, args.max_trend_drop,
                                window=args.trend_window,
                                points=args.trend_points)
         return (rc or rc_serve or rc_obs or rc_faults or rc_fleet
                 or rc_fleet_trace or rc_ess or rc_cap or rc_cold
-                or rc_mig or rc_trend)
+                or rc_mig or rc_over or rc_trend)
     return 0
 
 

@@ -166,6 +166,19 @@ def _render_status(st, out):
                              (int, float)) else "")
             for name, v in rows)
         print(f"stages: {line}", file=out)
+    # scheduling pane (round 20): active policy, per-tier door-queue
+    # depths, preemption/shed counters — rendered only once the server
+    # reports the block (older status.json files stay renderable)
+    sched = st.get("sched")
+    if isinstance(sched, dict):
+        qt = " ".join(f"t{k}={v}" for k, v in
+                      sorted((sched.get("queue_tiers") or {}).items()))
+        print(f"sched: {sched.get('policy', '?')} "
+              f"queue_tiers[{qt or '-'}] "
+              f"peak={sched.get('queue_depth_peak', 0)}/"
+              f"{sched.get('queue_max', '?')} "
+              f"preempt={sched.get('preemptions', 0)} "
+              f"sheds={sched.get('sheds', 0)}", file=out)
     slo = st.get("slo") or {}
     for leg in ("admission_ms", "first_result_ms", "converged_ms"):
         p = slo.get(leg)
@@ -173,15 +186,25 @@ def _render_status(st, out):
             print(f"slo {leg:16s} p50={_fmt(p.get('p50'))} "
                   f"p90={_fmt(p.get('p90'))} p99={_fmt(p.get('p99'))} "
                   f"max={_fmt(p.get('max'))}", file=out)
+    for tier, legs in sorted((slo.get("tiers") or {}).items()):
+        p = (legs or {}).get("admission_ms")
+        if isinstance(p, dict):
+            print(f"slo tier {tier} admission p50={_fmt(p.get('p50'))} "
+                  f"p90={_fmt(p.get('p90'))} p99={_fmt(p.get('p99'))}",
+                  file=out)
     tenants = st.get("tenants") or []
-    print(f"{'ID':>4} {'NAME':>10} {'STATUS':>8} {'CHAINS':>6} "
+    print(f"{'ID':>4} {'NAME':>10} {'STATUS':>8} {'PRI':>3} "
+          f"{'SLACK':>7} {'CHAINS':>6} "
           f"{'SWEEPS':>11} {'ROWS':>6} {'ESS':>8} {'RHAT':>7} "
           f"{'ESS/s':>8} {'CONV@':>6} {'Q':>3}", file=out)
     for t in tenants:
         sw = f"{t.get('sweeps_done', 0)}/{t.get('niter', '?')}"
+        slack = t.get("slack_sweeps")
         print(f"{_fmt(t.get('tenant_id'), width=4)} "
               f"{str(t.get('name') or '-'):>10.10s} "
               f"{t.get('status', '?'):>8} "
+              f"{_fmt(t.get('priority'), width=3)} "
+              f"{_fmt(slack, nd=0, width=7)} "
               f"{_fmt(t.get('nchains'), width=6)} {sw:>11} "
               f"{_fmt(t.get('rows'), width=6)} "
               f"{_fmt(t.get('ess_min'), width=8)} "
