@@ -99,6 +99,13 @@ def _pool_entry(source: str, st: dict) -> dict:
     beats = wd.get("heartbeat_age_s")
     beats = beats if isinstance(beats, dict) else {}
     ages = [v for v in beats.values() if isinstance(v, (int, float))]
+    # execution-backend probe (round 21): which platform the pool's
+    # compiled program runs on, the native-FFI probe verdict (the
+    # probe-recorded reason when kernels degraded) and the resolved
+    # admission write path — placement wants to know a cpu pool from
+    # a tpu pool
+    be = st.get("backend")
+    be = be if isinstance(be, dict) else {}
     return {
         "source": str(source),
         "reachable": True,
@@ -117,6 +124,9 @@ def _pool_entry(source: str, st: dict) -> dict:
         # not tripped; tenant-scoped faults are contained by design
         # and do not disqualify a pool
         "healthy": not faults.get("pool_failures") and not tripped,
+        "platform": be.get("platform"),
+        "native": be.get("native"),
+        "scatter": be.get("scatter"),
         "faults": faults,
         "watchdog_state": wd.get("state"),
         "watchdog_cause": ((wd.get("trip") or {}).get("cause")
@@ -267,7 +277,8 @@ def render_fleet(snap: dict, out) -> None:
             print(f"slo tier {tier} admission p50={p.get('p50'):>8} "
                   f"p90={p.get('p90'):>8} p99={p.get('p99'):>8}",
                   file=out)
-    print(f"{'POOL':40s} {'OK':>4} {'WD':>5} {'LANES':>9} {'OCC%':>6} "
+    print(f"{'POOL':40s} {'OK':>4} {'WD':>5} {'BACKEND':>12} "
+          f"{'LANES':>9} {'OCC%':>6} "
           f"{'QUEUE':>5} {'TEN':>4} {'FAULTS'}", file=out)
     for p in snap.get("pools") or []:
         src = str(p.get("source"))[:40]
@@ -289,10 +300,18 @@ def render_fleet(snap: dict, out) -> None:
             wd = f"{hb:.0f}s" if hb >= 1 else "ok"
         if p.get("watchdog_cause"):
             fstr = (f"wd:{p['watchdog_cause']} " + fstr).rstrip(" -")
+        # execution backend column (round 21): platform + resolved
+        # admission write path; pre-round-21 statuses render "-" (the
+        # full native probe verdict stays on the pool's JSON row)
+        if p.get("platform"):
+            backend = (f"{p['platform']}/"
+                       f"{'scatter' if p.get('scatter') else 'bounce'}")
+        else:
+            backend = "-"
         # str() the sparse fields: a pool serving a partial status is
         # still a renderable row, not a dashboard crash
         print(f"{src:40s} {'ok' if p.get('healthy') else 'SICK':>4} "
-              f"{wd:>5} {lanes:>9} {occ:6.1f} "
+              f"{wd:>5} {backend:>12} {lanes:>9} {occ:6.1f} "
               f"{str(p.get('queue_depth')):>5} "
               f"{str(p.get('running_tenants')):>4} {fstr}", file=out)
 

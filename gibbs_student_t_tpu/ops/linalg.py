@@ -330,6 +330,16 @@ def _pallas_chol_mode():
 # Pallas kernel gate (ops/pallas_util.py), imported so the fused-MH
 # dispatchers' fallback assumptions cannot drift from this one.
 from gibbs_student_t_tpu.ops.pallas_util import MIN_BATCH as _PALLAS_MIN_BATCH  # noqa: E402
+from gibbs_student_t_tpu.ops.pallas_util import LANES_GROUP as _LANES_GROUP  # noqa: E402
+
+
+def _pallas_tnt_mode():
+    """``(enabled, interpret, forced)`` from ``GST_PALLAS_TNT`` — the
+    per-lane-basis TNT lanes twin's gate, same vocabulary and trace-time
+    snapshot semantics as ``GST_PALLAS_CHOL`` (:func:`_pallas_chol_mode`)."""
+    from gibbs_student_t_tpu.ops.pallas_util import mode_from_env
+
+    return mode_from_env("GST_PALLAS_TNT")
 
 
 def _pallas_ok(shape, dtype, forced: bool) -> bool:
@@ -869,6 +879,16 @@ def tnt_gram_lanes(T, y, nvec, gid):
 
         _note_impl("tnt_lanes", "nchol", nvec.shape)
         return tuple(nffi.tnt_lanes(T, y, nvec, gid))
+    p_on, p_interp, p_forced = _pallas_tnt_mode()
+    if (p_on and T.ndim == 3 and y.ndim == 2 and nvec.ndim == 2
+            and gid.ndim == 1 and nvec.dtype == jnp.float32
+            and T.dtype == nvec.dtype and y.dtype == nvec.dtype
+            and T.shape[0] % _LANES_GROUP == 0
+            and (p_forced or batch >= _PALLAS_MIN_BATCH)):
+        from gibbs_student_t_tpu.ops.pallas_tnt import tnt_lanes_pallas
+
+        _note_impl("tnt_lanes", "pallas", nvec.shape)
+        return tnt_lanes_pallas(T, y, nvec, gid, interpret=p_interp)
     _note_impl("tnt_lanes", "vmap_jnp", nvec.shape)
     f = _tnt_gram_jnp
     for _ in range(nvec.ndim - 1):
@@ -1124,7 +1144,8 @@ def _beta_fractional_vmap(axis_size, in_batched, keys, a, b):
 
 
 def _fused_stages_jnp(hyp_idx, jitter, jitters, A, Bm, C, rs, rv, x,
-                      dx, logu, xi, base0, K, sel, phist, specs):
+                      dx, logu, xi, base0, K, sel, phist, specs,
+                      hyper_core=None):
     """The per-stage composition — the megastage's gates-off-
     equivalent graph, parity oracle and degradation target (shared by
     the single-model and lanes dispatchers; the constant operands may
@@ -1145,8 +1166,15 @@ def _fused_stages_jnp(hyp_idx, jitter, jitters, A, Bm, C, rs, rv, x,
                            core_dims=1)
     dS0 = jnp.diagonal(S0, axis1=-2, axis2=-1) + phist_a
     base = base0 + 0.5 * (quad_s - logdetA)
-    xh, acc = hyper_mh_loop_xla(x, S0, dS0, rt, base, dx, logu, K,
-                                sel, specs, hyp_idx, jitter)
+    # ``hyper_core`` swaps the MH-block stage only (the Pallas lanes
+    # arm passes a group-reduced kernel closure); everything around it
+    # — Schur, phi re-eval, draws — is this graph either way
+    if hyper_core is None:
+        xh, acc = hyper_mh_loop_xla(x, S0, dS0, rt, base, dx, logu, K,
+                                    sel, specs, hyp_idx, jitter)
+    else:
+        xh, acc = hyper_core(x, S0, dS0, rt, base, dx, logu, K, sel,
+                             specs)
     Ka = align_consts(jnp.asarray(K, x.dtype), x.ndim - 1)
     sela = align_consts(jnp.asarray(sel, x.dtype), x.ndim - 1,
                         core_dims=1)
@@ -1244,6 +1272,53 @@ def _fused_hyper_lanes_dispatcher(hyp_idx: tuple, jitter: float,
                 jnp.asarray(K, dt), jnp.asarray(sel, dt),
                 jnp.asarray(phist, dt), jnp.asarray(specs, dt), gid,
                 hyp_idx, jitter, jitters))
+        from gibbs_student_t_tpu.ops.pallas_hyper import (
+            MAX_PALLAS_V as _MAX_PV,
+            _pallas_hyper_mode,
+            hyper_mh_fused,
+        )
+        from gibbs_student_t_tpu.ops.pallas_util import (
+            HAVE_PLTPU as _have_pltpu,
+        )
+
+        p_on, p_interp, p_forced = _pallas_hyper_mode()
+        B = x.shape[0] if x.ndim else 0
+        if (p_on and _have_pltpu and A.ndim == 3 and K.ndim == 3
+                and gid.ndim == 1 and x.dtype == jnp.float32
+                and C.shape[-1] <= _MAX_PV
+                and B % _LANES_GROUP == 0 and B
+                and (p_forced or B >= _PALLAS_MIN_BATCH)):
+            # Pallas lanes arm: the per-stage composition verbatim with
+            # only the MH-block stage swapped for the grouped TPU
+            # kernel — the tile-uniform gid contract makes the per-lane
+            # consts constant within every aligned 16-lane tile, so one
+            # stride-sliced consts row per group feeds the grouped form
+            _note_impl("fused_hyper_lanes", "pallas", C.shape)
+
+            def _pallas_core(xc, S0c, dS0c, rtc, basec, dxc, loguc,
+                             Kc, selc, specsc):
+                p = xc.shape[-1]
+                v = S0c.shape[-1]
+                S = dxc.shape[-2]
+                Gn = B // _LANES_GROUP
+                dt = xc.dtype
+                xf, acc = hyper_mh_fused(
+                    xc.reshape(Gn, _LANES_GROUP, p),
+                    S0c.reshape(Gn, _LANES_GROUP, v, v),
+                    dS0c.reshape(Gn, _LANES_GROUP, v),
+                    rtc.reshape(Gn, _LANES_GROUP, v),
+                    basec.reshape(Gn, _LANES_GROUP),
+                    dxc.reshape(Gn, _LANES_GROUP, S, p),
+                    loguc.reshape(Gn, _LANES_GROUP, S),
+                    jnp.asarray(Kc, dt)[::_LANES_GROUP],
+                    jnp.asarray(selc, dt)[::_LANES_GROUP],
+                    jnp.asarray(specsc, dt)[::_LANES_GROUP],
+                    hyp_idx, jitter, interpret=p_interp)
+                return xf.reshape(B, p), acc.reshape(B)
+
+            return _stages_jnp(A, Bm, C, rs, rv, x, dx, logu, xi,
+                               base0, K, sel, phist, specs,
+                               hyper_core=_pallas_core)
         _note_impl("fused_hyper_lanes", "stages", C.shape)
         return _stages_jnp(A, Bm, C, rs, rv, x, dx, logu, xi, base0,
                            K, sel, phist, specs)

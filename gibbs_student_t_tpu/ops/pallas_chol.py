@@ -241,3 +241,66 @@ def tri_solve_T_lane(L, rhs, chain_tile: int = 128,
         **kwargs,
     )(Lt, rt)
     return jnp.transpose(xt, (1, 0))[:B, :m].reshape(batch + (m,))
+
+
+def _check_lanes_gid(arr, gid, who: str) -> None:
+    """Validate the serve slot pool's tile-uniform ``gid`` contract for
+    the per-lane matrix kernels: one group id per lane, lanes in whole
+    16-lane admission groups. The chol kernels are already per-lane
+    (every leading dim lands on the lane batch), so ``gid`` is a
+    contract witness here, not a consumed operand."""
+    from gibbs_student_t_tpu.ops.pallas_util import LANES_GROUP
+
+    if gid.ndim != 1 or gid.shape[0] != arr.shape[0]:
+        raise ValueError(
+            f"{who}: gid must be (lanes,) matching the leading lane "
+            f"axis, got gid {gid.shape} for operand {arr.shape}")
+    if arr.shape[0] % LANES_GROUP:
+        raise ValueError(
+            f"{who}: lane batch {arr.shape[0]} is not a multiple of "
+            f"the {LANES_GROUP}-lane admission group")
+
+
+def chol_fused_lanes(S, rhs, gid, chain_tile: int = 128,
+                     interpret: bool = False
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Serve-lanes entry point for :func:`chol_fused_lane` — ``S (B, m,
+    m)`` / ``rhs (B, m)`` per-lane operands under the slot pool's
+    tile-uniform ``gid`` contract. The underlying kernel is per-lane
+    already (matrices ride the lane batch), so this only validates the
+    contract and notes the dispatch (``chol_lanes`` in the registry's
+    declared OPS table) before delegating."""
+    from gibbs_student_t_tpu.ops.linalg import _factor_fused, _note_impl
+    from gibbs_student_t_tpu.ops.pallas_util import mode_from_env
+
+    _check_lanes_gid(S, gid, "chol_fused_lanes")
+    enabled, interp, _forced = mode_from_env("GST_PALLAS_CHOL")
+    if not (enabled and S.dtype == jnp.float32
+            and S.shape[-1] <= MAX_PALLAS_DIM):
+        # clean degradation: the ordinary factor dispatch (which may
+        # itself pick native/vchol/expander per its own gates)
+        _note_impl("chol_lanes", "factor", S.shape)
+        return _factor_fused(S, rhs)
+    note_kernel_build("pallas_chol_lanes", lanes=int(S.shape[0]),
+                      m=int(S.shape[-1]), interpret=bool(interpret))
+    _note_impl("chol_lanes", "pallas", S.shape)
+    return chol_fused_lane(S, rhs, chain_tile=chain_tile,
+                           interpret=interpret or interp)
+
+
+def tri_solve_T_lanes(L, rhs, gid, chain_tile: int = 128,
+                      interpret: bool = False) -> jnp.ndarray:
+    """Serve-lanes twin of :func:`tri_solve_T_lane` (see
+    :func:`chol_fused_lanes` for the gid contract)."""
+    from gibbs_student_t_tpu.ops.linalg import _backsolve_fused, _note_impl
+    from gibbs_student_t_tpu.ops.pallas_util import mode_from_env
+
+    _check_lanes_gid(L, gid, "tri_solve_T_lanes")
+    enabled, interp, _forced = mode_from_env("GST_PALLAS_CHOL")
+    if not (enabled and L.dtype == jnp.float32
+            and L.shape[-1] <= MAX_PALLAS_DIM):
+        _note_impl("chol_lanes", "factor", L.shape)
+        return _backsolve_fused(L, rhs)
+    _note_impl("chol_lanes", "pallas", L.shape)
+    return tri_solve_T_lane(L, rhs, chain_tile=chain_tile,
+                            interpret=interpret or interp)

@@ -170,6 +170,45 @@ def tnt_batched_pallas(T, y, nvec, block_size: int = 256,
     return TNT, d, const.astype(TNT.dtype)
 
 
+def tnt_lanes_pallas(T, y, nvec, gid, interpret: bool = False):
+    """Per-lane-basis lanes twin of :func:`tnt_batched_pallas` under
+    the serve slot pool's tile-uniform ``gid`` contract.
+
+    ``T (B, n, m)`` / ``y (B, n)`` / ``nvec (B, n)`` are per-lane
+    operands, but admission is 16-lane-group granular (``LANES_GROUP``),
+    so the basis and residuals are CONSTANT within every aligned
+    16-lane tile — one stride-slice row per group is the whole basis
+    plane, and each group reduces through the shared-basis kernel with
+    its 16 lanes as the chain batch. ``gid`` is the contract witness
+    (validated for shape by the dispatcher); its values are not
+    consumed here. ``n`` is zero-padded to a 128 multiple under the
+    ``pad_rows`` contract (zero basis rows, zero residual, unit
+    ``nvec``), which contributes exactly zero to every output.
+    """
+    from gibbs_student_t_tpu.ops.pallas_util import LANES_GROUP
+
+    B, n, m = T.shape
+    G = B // LANES_GROUP
+    note_kernel_build("pallas_tnt_lanes", lanes=int(B), n=int(n),
+                      m=int(m), groups=int(G), interpret=bool(interpret))
+    bs = 128
+    npad = _round_up(n, bs) - n
+    Tg = T[::LANES_GROUP]                       # (G, n, m) group bases
+    yg = y[::LANES_GROUP]                       # (G, n)
+    nvg = nvec.reshape(G, LANES_GROUP, n)
+    if npad:
+        Tg = jnp.pad(Tg, ((0, 0), (0, npad), (0, 0)))
+        yg = jnp.pad(yg, ((0, 0), (0, npad)))
+        nvg = jnp.pad(nvg, ((0, 0), (0, 0), (0, npad)),
+                      constant_values=1.0)
+    outs = [tnt_batched_pallas(Tg[g], yg[g], nvg[g], block_size=bs,
+                               interpret=interpret) for g in range(G)]
+    TNT = jnp.concatenate([o[0] for o in outs]).reshape(B, m, m)
+    d = jnp.concatenate([o[1] for o in outs]).reshape(B, m)
+    const = jnp.concatenate([o[2] for o in outs]).reshape(B)
+    return TNT, d, const
+
+
 def tnt_batched_xla(T, y, nvec,
                     block_size: Optional[int] = None
                     ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:

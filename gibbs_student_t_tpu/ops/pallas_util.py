@@ -32,6 +32,13 @@ except ImportError:  # pragma: no cover
 # outweighs its win and the XLA path is kept.
 MIN_BATCH = 16
 
+# The serving slot pool admits tenants in 16-lane groups and the
+# tile-uniform gid contract guarantees per-lane consts are constant
+# within every aligned 16-lane tile — the ``*_lanes`` Pallas twins
+# group-reduce on this width (stride-slicing one consts row per tile).
+# Kept here (not imported from serve/) so ops/ never depends on serve/.
+LANES_GROUP = 16
+
 
 def round_up(x: int, mult: int) -> int:
     return -(-x // mult) * mult

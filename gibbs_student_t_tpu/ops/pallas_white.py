@@ -62,6 +62,7 @@ from jax.experimental import pallas as pl
 
 from gibbs_student_t_tpu.ops.pallas_util import (
     HAVE_PLTPU as _HAVE_PLTPU,
+    LANES_GROUP,
     MIN_BATCH as _MIN_BATCH,
     int_from_env,
     mode_from_env,
@@ -774,6 +775,35 @@ def make_white_block_lanes(var: Tuple[Tuple[int, int, int], ...]):
             return nffi.white_mh_lanes(
                 x, az, yred2, dx, logu, jnp.asarray(rows, x.dtype),
                 jnp.asarray(specs, x.dtype), gid, var)
+        enabled, interp, forced = _pallas_white_mode()
+        B = x.shape[0] if x.ndim else 0
+        if (enabled and _HAVE_PLTPU and rows.ndim == 3
+                and gid.ndim == 1 and x.ndim == 2
+                and rows.shape[0] == x.shape[0]
+                and x.dtype == jnp.float32
+                and az.shape[-1] <= MAX_PALLAS_N
+                and B % LANES_GROUP == 0 and B
+                and (forced or B >= _MIN_BATCH)):
+            # tile-uniform gid contract: consts are constant within
+            # every aligned 16-lane tile, so one stride-sliced row per
+            # group is the whole consts plane and the lane batch
+            # group-reduces through the grouped kernel (chains = the
+            # 16 lanes of each admission group)
+            _lin._note_impl("white_lanes", "pallas", x.shape)
+            G = B // LANES_GROUP
+            p = x.shape[-1]
+            n = az.shape[-1]
+            S = dx.shape[-2]
+            xf, acc = white_mh_fused(
+                x.reshape(G, LANES_GROUP, p),
+                az.reshape(G, LANES_GROUP, n),
+                yred2.reshape(G, LANES_GROUP, n),
+                dx.reshape(G, LANES_GROUP, S, p),
+                logu.reshape(G, LANES_GROUP, S),
+                jnp.asarray(rows, x.dtype)[::LANES_GROUP],
+                jnp.asarray(specs, x.dtype)[::LANES_GROUP],
+                var, interpret=interp)
+            return xf.reshape(B, p), acc.reshape(B)
         _lin._note_impl("white_lanes", "loop_xla", x.shape)
         return white_mh_loop_xla(x, az, yred2, dx, logu, rows, specs,
                                  var)

@@ -144,6 +144,9 @@ GATES: Dict[str, GateSpec] = {g.name: g for g in (
     _G("GST_PALLAS_HYPER", "ops", "pallas",
        "Pallas TPU hyper-MH kernel (`interpret` accepted)",
        auto="tpu"),
+    _G("GST_PALLAS_TNT", "ops", "pallas",
+       "Pallas TPU per-lane-basis TNT gram lanes twin — tile-uniform "
+       "gid contract (`interpret` accepted)", auto="tpu"),
     _G("GST_WHITE_TILE", "ops", "int",
        "white kernel tile size (integer, rounded to a legal multiple)",
        default=256),
@@ -199,6 +202,14 @@ GATES: Dict[str, GateSpec] = {g.name: g for g in (
        "degrades flow requests to the moment-matched mixture (a "
        "`warm_flow_degraded` event; the init stays warm, never cold)",
        fp=False),
+    _G("GST_SERVE_SCATTER", "serve", "strict3",
+       "device-resident admission (serve/pool.py): boundary writes "
+       "(admit/reinit/poison) land as fixed-shape jitted lane scatters "
+       "and checkpoint reads gather only the owning tenant's lanes "
+       "while a quantum's state is device-resident; `0` keeps the "
+       "host pull/slice-write/re-upload bounce verbatim (chains, "
+       "spool bytes and recovery are bitwise identical on/off — "
+       "pinned)", fp=False),
     _G("GST_ADAPT_SCAN", "serve", "strict3",
        "adaptive block scans (serve/adapt.py, arXiv:1808.09047): the "
        "slot-pool chunk gains a per-lane block-enable operand and "
@@ -263,7 +274,10 @@ OPS: Dict[str, List[Tuple[str, Optional[str], str]]] = {
     "tnt": [("nchol", "GST_NCHOL", "shared basis, batch>=MIN_BATCH"),
             ("vmap_jnp", None, "any")],
     "tnt_lanes": [("nchol", "GST_NCHOL", "per-lane basis, tile-uniform "
-                   "gid"), ("vmap_jnp", None, "any")],
+                   "gid"),
+                  ("pallas", "GST_PALLAS_TNT", "f32, tile-uniform gid, "
+                   "lanes%16==0"),
+                  ("vmap_jnp", None, "any")],
     "resid": [("nchol", "GST_NRESID", "shared basis"),
               ("vmap_jnp", None, "any")],
     "resid_lanes": [("nchol", "GST_NRESID", "per-lane basis"),
@@ -281,6 +295,8 @@ OPS: Dict[str, List[Tuple[str, Optional[str], str]]] = {
                  ("loop_xla", None, "any")],
     "white_lanes": [("nchol", "GST_NWHITE", "per-lane consts, "
                      "tile-uniform gid"),
+                    ("pallas", "GST_PALLAS_WHITE", "f32, tile-uniform "
+                     "gid, lanes%16==0"),
                     ("loop_xla", None, "any")],
     "hyper_mh": [("nchol", "GST_NHYPER", "p<=64, nk<=16"),
                  ("pallas", "GST_PALLAS_HYPER", "TPU"),
@@ -290,8 +306,18 @@ OPS: Dict[str, List[Tuple[str, Optional[str], str]]] = {
                      "verbatim")],
     "fused_hyper_lanes": [("nchol", "GST_FUSE_STAGES", "per-lane "
                            "consts, tile-uniform gid"),
+                          ("pallas", "GST_PALLAS_HYPER", "f32, "
+                           "tile-uniform gid, lanes%16==0, "
+                           "v<=MAX_PALLAS_V (pallas hyper core inside "
+                           "the per-stage composition)"),
                           ("stages", None, "per-stage graph "
                            "verbatim")],
+    "chol_lanes": [("pallas", "GST_PALLAS_CHOL", "f32, "
+                    "m<=MAX_PALLAS_DIM, per-lane matrices (lane batch "
+                    "is the leading axis — gid validated, not "
+                    "consumed)"),
+                   ("factor", None, "delegates to the factor/bwd_vec "
+                    "dispatch above")],
 }
 
 # the declared tables must cover every op the dispatchers ever note —
@@ -301,7 +327,7 @@ assert set(OPS) >= {
     "factor", "factor_quad", "bwd_vec", "fwd_mat", "bwd_mat", "schur",
     "robust_draw", "tnt", "tnt_lanes", "resid", "resid_lanes", "chisq",
     "gamma_v2", "beta_frac", "white_mh", "white_lanes", "hyper_mh",
-    "fused_hyper", "fused_hyper_lanes"}
+    "fused_hyper", "fused_hyper_lanes", "chol_lanes"}
 
 
 # ----------------------------------------------------------------------

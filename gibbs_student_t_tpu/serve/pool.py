@@ -25,6 +25,23 @@ executor's deferred flush) read a ``snapshot`` device copy taken
 before the donated buffers are consumed — the ``snapshot_fn`` ordering
 contract of ``backends.jax_backend.chunked_sweep_loop``.
 
+Under ``GST_SERVE_SCATTER`` (round 21, default on) the boundary writes
+that used to force that lazy pull become DEVICE-RESIDENT too: while
+the canonical state is on device, admissions (:meth:`write_tenant`),
+recovery (:meth:`reinit_lanes`) and fault injection
+(:meth:`poison_lanes`) apply their deltas as fixed-shape jitted lane
+scatters — the delta rides as a small call-time operand plus a
+lane-index vector, and the full state never materializes on the host —
+while checkpoint reads (:meth:`tenant_state`) gather only the owning
+tenant's lane rows. On CPU this removes the mirror bounce from the
+admission path (measured in serve_bench's admission A/B); over PCIe it
+is the difference between a per-admit transfer proportional to the
+TENANT and one proportional to the POOL. ``GST_SERVE_SCATTER=0`` keeps
+every write on the PR-19 pull/slice-write/re-upload path verbatim, and
+scatter-on is pinned bitwise against it (tests/test_serve.py): the
+scatter is a pure copy into the same buffers the bounce would rebuild,
+and untouched lanes' device→host→device roundtrip is bit-preserving.
+
 RNG and keying are bit-compatible with ``JaxGibbs.sample``: a tenant's
 lane ``k`` carries ``random.split(PRNGKey(seed), nchains)[k]`` and each
 sweep folds in the tenant-local sweep index, so a solo tenant's chains
@@ -70,6 +87,49 @@ GROUP_LANES = 16
 #: inactive, their outputs discarded, and their state frozen by the
 #: active mask, so stale constants are harmless.
 FREE_GID = -1
+
+
+def serve_scatter_env() -> str:
+    """Validated ``GST_SERVE_SCATTER`` (``auto`` when unset) — the
+    device-resident admission path. Strict ``auto|1|0`` (the loud-typo
+    contract of every GST_* gate); ``auto`` resolves to ON — the
+    scatter writes the same bytes the host bounce would rebuild, on
+    every platform, so chains/spools/recovery are bitwise identical
+    on/off. ``0`` keeps the pull/slice-write/re-upload bounce (the A/B
+    arm and the bitwise reference)."""
+    from gibbs_student_t_tpu.ops import registry
+
+    return registry.value("GST_SERVE_SCATTER")
+
+
+def serve_scatter_enabled() -> bool:
+    """Resolved ``GST_SERVE_SCATTER`` (see :func:`serve_scatter_env`).
+    Snapshotted ONCE at pool construction, the ``adapt_scan_enabled``
+    discipline — flipping the env var after a pool exists has no
+    effect on it."""
+    from gibbs_student_t_tpu.ops import registry
+
+    on, _forced = registry.mode3("GST_SERVE_SCATTER")
+    return bool(on)
+
+
+def _scatter_state_tree(state: ChainState, lanes, delta: dict):
+    """``state`` with ``delta[f]`` scatter-written into ``state.f`` at
+    the given lane rows — the jitted device-side admission write. The
+    delta's key SET is part of the pytree structure, so each distinct
+    write shape (full admission, the reinit subset, the poison x-only
+    delta) compiles once per lane count and is a cheap fixed-shape
+    scatter thereafter."""
+    repl = {f: getattr(state, f).at[lanes].set(v)
+            for f, v in delta.items()}
+    return state._replace(**repl)
+
+
+def _gather_state_tree(state: ChainState, lanes):
+    """One tenant's lane rows of the device state — the narrow
+    checkpoint-read gather (scalar leaves pass through)."""
+    return jax.tree.map(
+        lambda a: a[lanes] if getattr(a, "ndim", 0) else a, state)
 
 
 class TenantSlot:
@@ -205,6 +265,20 @@ class SlotPool:
         self._donate = donate_resolved()
         self._state_dev = None        # latest post-quantum device state
         self._host_valid = True       # _state_np mirrors the canon
+        # device-resident admission (GST_SERVE_SCATTER, resolved once —
+        # the adapt_scan_enabled discipline): boundary writes landing
+        # while the canon is device-resident go through the jitted
+        # scatter below instead of pulling the mirror; `0` keeps every
+        # write on the host-bounce path verbatim (bitwise pin)
+        self.scatter = serve_scatter_enabled()
+        # plain jax.jit (no introspect label): the one-compile pin
+        # counts only `serve_pool_chunk*` programs, and these small
+        # scatter/gather programs recompile per admitted lane count
+        self._scatter_fn = jax.jit(
+            _scatter_state_tree,
+            donate_argnums=(0,) if self._donate else ())
+        self._gather_fn = jax.jit(_gather_state_tree)
+        self._admit_bytes: list = []  # operand bytes moved per admit
         # adaptive block scans (serve/adapt.py, GST_ADAPT_SCAN):
         # resolved ONCE at pool construction — when on, the chunk
         # carries a per-lane (NBLOCKS,) block-enable operand riding its
@@ -339,13 +413,40 @@ class SlotPool:
             self._state_np = jax.tree.map(np.array, self._state_dev)
             self._host_valid = True
 
+    def _state_nbytes(self) -> int:
+        """Byte size of one full state plane (array leaves) — what the
+        host bounce moves each way when it pulls/re-uploads the
+        mirror. Shapes never change, so the (possibly stale) mirror is
+        a valid ruler."""
+        return sum(int(np.asarray(a).nbytes)
+                   for a in jax.tree_util.tree_leaves(self._state_np)
+                   if np.asarray(a).ndim)
+
+    def _scatter_state(self, lanes: np.ndarray, delta: dict) -> int:
+        """Apply a boundary write as a jitted device scatter into the
+        canonical device-resident state — the mirror is never
+        materialized and ``_host_valid`` stays False. ``delta`` values
+        are freshly-built private host arrays (never views of live
+        canonical buffers), so handing them to jax directly keeps the
+        torn-operand discipline of :meth:`dispatch_quantum`. Returns
+        the operand bytes moved."""
+        lanes_d = jnp.asarray(np.array(lanes, np.int32, copy=True))
+        delta_d = {f: jnp.asarray(v) for f, v in delta.items()}
+        self._state_dev = self._scatter_fn(self._state_dev, lanes_d,
+                                           delta_d)
+        return (int(lanes_d.nbytes)
+                + sum(int(np.asarray(v).nbytes) for v in delta.values()))
+
     def write_tenant(self, slot: TenantSlot, ma_padded: ModelArrays,
                      backend: JaxGibbs, state: ChainState) -> None:
         """Admit a tenant into its lanes: slice-assign its model,
         fused-MH constants, chain keys, offsets and state into the
-        host lane buffers. ``backend`` is the tenant's throwaway
+        host lane buffers. The STATE plane goes as a device scatter
+        instead when ``GST_SERVE_SCATTER`` is on and the canon is
+        device-resident (the other planes are host-authoritative
+        operand buffers either way — they upload on the next dispatch
+        regardless of the gate). ``backend`` is the tenant's throwaway
         construction backend (structure already validated)."""
-        self._pull_state()
         lanes = slot.lanes
         k = slot.nchains
         # model arrays (the localized+padded tenant model)
@@ -381,12 +482,32 @@ class SlotPool:
         # state: tenant chains into their lanes; pad lanes keep a copy
         # of chain 0 (finite, discarded)
         st = jax.tree.map(np.array, state)
-        self._state_np = jax.tree.map(
-            lambda buf, val: _assign(
-                buf, lanes, np.concatenate(
+
+        def padded(val):
+            val = np.asarray(val)
+            if len(lanes) > k:
+                return np.concatenate(
                     [val, np.repeat(val[:1], len(lanes) - k, axis=0)])
-                if len(lanes) > k else val),
-            self._state_np, st)
+            return val
+
+        delta = {
+            f: padded(getattr(st, f))
+            for f in type(self._state_np)._fields
+            if np.asarray(getattr(self._state_np, f)).ndim}
+        if self.scatter and not self._host_valid:
+            moved = self._scatter_state(lanes, delta)
+        else:
+            pulled = not self._host_valid
+            self._pull_state()
+            for f, val in delta.items():
+                _assign(np.asarray(getattr(self._state_np, f)),
+                        lanes, val)
+            moved = sum(int(v.nbytes) for v in delta.values())
+            if pulled:
+                # the bounce's real cost: the full mirror comes down
+                # AND goes back up on the next dispatch
+                moved += 2 * self._state_nbytes()
+        self._admit_bytes.append(moved)
         if self.adaptive:
             # a fresh tenant always starts at the full-rate systematic
             # scan; the server's policy thins it later, per boundary
@@ -433,8 +554,14 @@ class SlotPool:
         deterministic ``lane_nan`` fault-injection arm (serve/faults).
         The in-kernel telemetry's sticky diverged flag picks it up on
         the next quantum exactly as a real numerical divergence."""
+        lanes = np.asarray(lanes, int)
+        if self.scatter and not self._host_valid:
+            x = np.asarray(self._state_np.x)  # shape/dtype ruler only
+            self._scatter_state(lanes, {"x": np.full(
+                (len(lanes),) + x.shape[1:], np.nan, x.dtype)})
+            return
         self._pull_state()
-        np.asarray(self._state_np.x)[np.asarray(lanes, int)] = np.nan
+        np.asarray(self._state_np.x)[lanes] = np.nan
 
     def reinit_lanes(self, lanes: np.ndarray, fresh: ChainState,
                      fresh_idx: np.ndarray) -> None:
@@ -446,20 +573,42 @@ class SlotPool:
         exactly ``backends.jax_backend.merge_reinit``'s contract (a
         zeroed scale would run un-adapted forever after)."""
         lanes = np.asarray(lanes, int)
-        self._pull_state()
-        for f in type(self._state_np)._fields:
-            if f in ("mh_log_scale", "mh_cov_chol"):
-                continue  # adapted scales survive re-init (solo pin)
-            buf = np.asarray(getattr(self._state_np, f))
-            if buf.ndim == 0:
-                continue
-            buf[lanes] = np.asarray(getattr(fresh, f))[fresh_idx]
+        if self.scatter and not self._host_valid:
+            delta = {}
+            for f in type(self._state_np)._fields:
+                if f in ("mh_log_scale", "mh_cov_chol"):
+                    continue  # adapted scales survive (solo pin)
+                if np.asarray(getattr(self._state_np, f)).ndim == 0:
+                    continue
+                # fancy indexing copies: the delta is private
+                delta[f] = np.asarray(getattr(fresh, f))[fresh_idx]
+            self._scatter_state(lanes, delta)
+        else:
+            self._pull_state()
+            for f in type(self._state_np)._fields:
+                if f in ("mh_log_scale", "mh_cov_chol"):
+                    continue  # adapted scales survive re-init (solo pin)
+                buf = np.asarray(getattr(self._state_np, f))
+                if buf.ndim == 0:
+                    continue
+                buf[lanes] = np.asarray(getattr(fresh, f))[fresh_idx]
         self._active_np[lanes] = True
         self._dirty = True
 
     def tenant_state(self, slot: TenantSlot) -> ChainState:
         """The tenant's current chain state (host arrays) — the
-        checkpoint payload for the per-tenant spool."""
+        checkpoint payload for the per-tenant spool. Under the scatter
+        gate this gathers ONLY the owning tenant's lane rows from the
+        device state (a narrow fixed-shape jitted gather; the mirror
+        stays un-materialized and ``_host_valid`` stays False), so a
+        mid-run checkpoint no longer forces — or pays for — a
+        full-pool ``device_get``. Values are bitwise the mirror slice:
+        both are pure copies of the same device rows."""
+        if self.scatter and not self._host_valid:
+            lanes = jnp.asarray(np.array(slot.chain_lanes, np.int32,
+                                         copy=True))
+            rows = self._gather_fn(self._state_dev, lanes)
+            return jax.tree.map(np.array, rows)
         self._pull_state()
         return jax.tree.map(lambda a: a[slot.chain_lanes],
                             self._state_np)
@@ -639,6 +788,52 @@ class SlotPool:
         spool / on_chunk payload): the wire slice cast on demand."""
         return self.materialize_tenant(self.tenant_wire(wire, slot),
                                        slot.n_real)
+
+    def tenant_wire_device(self, recs, slot: TenantSlot) -> dict:
+        """Device-side compaction-gather twin of :meth:`wire_host` +
+        :meth:`tenant_wire`: the tenant's lanes are gathered into a
+        compact ``(nchains, rows, ...)`` buffer ON DEVICE and only
+        those bytes come to host — the accelerator drain arm (over
+        PCIe the full-lane ``wire_host`` pull is nlanes/nchains times
+        the traffic; on CPU the two are within noise, which is what
+        serve_bench's wire A/B records). Values are bitwise the
+        host-slice path: a gather is a pure copy of the same rows."""
+        lanes = jnp.asarray(np.array(slot.chain_lanes, np.int32,
+                                     copy=True))
+        out = {}
+        for f, arr in zip(self.template._record_fields, recs):
+            out[f] = np.asarray(jax.device_get(arr[lanes]))
+        return out
+
+    # ------------------------------------------------------------------
+    # probe / stats surface (serve_top, fleet_status, serve_bench)
+    # ------------------------------------------------------------------
+
+    def admission_stats(self) -> dict:
+        """Admission data-plane counters for the serve_bench
+        ``admission`` block: which write path the pool resolved and
+        the operand bytes each admit moved (scatter: delta + lane
+        index; bounce: delta, plus the full mirror down AND back up
+        when the canon was device-resident)."""
+        n = len(self._admit_bytes)
+        return {
+            "scatter": bool(self.scatter),
+            "admits": n,
+            "bytes_per_admit": (float(np.mean(self._admit_bytes))
+                                if n else None),
+            "bytes_total": int(np.sum(self._admit_bytes)) if n else 0,
+        }
+
+    def backend_info(self) -> dict:
+        """The pool's resolved execution backend for status/fleet rows:
+        the jax platform this pool's one compiled program runs on plus
+        the native-FFI probe verdict (native/ffi.py ``status()`` — the
+        probe-recorded reason when kernels degraded)."""
+        from gibbs_student_t_tpu.native import ffi as nffi
+
+        return {"platform": str(jax.default_backend()),
+                "native": nffi.status(),
+                "scatter": bool(self.scatter)}
 
 
 def _assign(buf: np.ndarray, lanes: np.ndarray, val: np.ndarray):

@@ -3060,6 +3060,10 @@ class ChainServer:
             "staged": staged,
             "pipeline": bool(self.pipeline),
             "supervise": bool(self.supervise),
+            # the pool's resolved execution backend (round 21): jax
+            # platform + native-FFI probe verdict + admission path —
+            # what serve_top's backend line and fleet pool rows show
+            "backend": self.pool.backend_info(),
             "faults": dict(self._fault_counts),
             # the deep profiling plane (round 15): per-stage device
             # time (None until the timers accumulate evidence) + the
@@ -3390,6 +3394,15 @@ class ChainServer:
                 "drain": _percentiles(self._drain_ms),
                 "dispatch_gap": _percentiles(self._gap_ms),
             },
+            # the admission data plane (round 21, GST_SERVE_SCATTER):
+            # which write path the pool resolved, apply-time
+            # percentiles and operand bytes moved per admit — what
+            # serve_bench's scatter A/B compares arm-to-arm
+            "admission": {
+                **self.pool.admission_stats(),
+                "apply_ms": _percentiles(self._admit_apply_ms),
+            },
+            "backend": self.pool.backend_info(),
             "faults": dict(self._fault_counts),
             # convergence-based evictions (ROADMAP 4c): how many
             # tenants finished early because their armed monitor

@@ -541,6 +541,50 @@ def test_perf_report_gates_obs_overhead_and_admission_p99(tmp_path,
     assert "admission gate skipped" in out
 
 
+def test_perf_report_gates_admission_apply_p99(tmp_path, capsys):
+    """The round-21 admission data-plane gate: boundary apply p99 is
+    graded from the A/B sandwich's warm scatter arm when present
+    (the headline arm's first admit pays the one-time scatter
+    compile), falls back to the headline ``apply_ms`` block, and
+    skips with a note on pre-round-21 records."""
+    pr = _perf_report()
+    base = [_bench_rec(100.0), _bench_rec(100.0)]
+
+    def rec(ab_p99=None, headline_p99=None):
+        r = _serve_rec()
+        adm = {"scatter": True, "admits": 5,
+               "bytes_per_admit": 1024.0, "bytes_total": 5120}
+        if headline_p99 is not None:
+            adm["apply_ms"] = {"p50": 0.01, "p99": headline_p99}
+        if ab_p99 is not None:
+            adm["ab"] = {"on": {"apply_p99_ms": ab_p99,
+                                "scatter": True},
+                         "off": {"apply_p99_ms": ab_p99 * 2}}
+        r["metrics"]["admission"] = adm
+        return r
+
+    # the warm A/B arm within the default limit -> pass, even when
+    # the compile-tainted headline block sits over it
+    path = _write_ledger(tmp_path, base + [rec(ab_p99=10.0,
+                                               headline_p99=900.0)])
+    assert pr.main(["--ledger", path, "--check", "--no-rounds"]) == 0
+    # A/B arm over the limit -> exit 2, named failure
+    path = _write_ledger(tmp_path, base + [rec(ab_p99=900.0)])
+    assert pr.main(["--ledger", path, "--check", "--no-rounds"]) == 2
+    assert "admission data plane" in capsys.readouterr().out
+    # no sandwich: the headline apply_ms.p99 is the fallback leg
+    path = _write_ledger(tmp_path, base + [rec(headline_p99=900.0)])
+    assert pr.main(["--ledger", path, "--check", "--no-rounds"]) == 2
+    # a tightened threshold flips a passing record
+    path = _write_ledger(tmp_path, base + [rec(ab_p99=10.0)])
+    assert pr.main(["--ledger", path, "--check", "--no-rounds",
+                    "--max-admission-apply-p99", "5"]) == 2
+    # pre-round-21 record (no admission block): leg skips with a note
+    path = _write_ledger(tmp_path, base + [_serve_rec()])
+    assert pr.main(["--ledger", path, "--check", "--no-rounds"]) == 0
+    assert "apply gate skipped" in capsys.readouterr().out
+
+
 # ----------------------------------------------------------------------
 # bench end-to-end smoke (slow: fresh-process sweep-kernel compile)
 # ----------------------------------------------------------------------

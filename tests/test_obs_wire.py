@@ -164,6 +164,9 @@ def _canned_status(nlanes=32, busy=16, admission=(10.0, 30.0),
         "occupancy_now": busy / nlanes, "occupancy": 0.8,
         "queue_depth": 1, "staged": 0, "pipeline": True,
         "supervise": True,
+        "backend": {"platform": "cpu",
+                    "native": "libgst_native.so not built",
+                    "scatter": True},
         "faults": {"tenant_failures": 0, "quarantined_lanes": 0,
                    "reinits": 0, "worker_restarts": 0,
                    "pool_failures": pool_failures},
@@ -202,6 +205,10 @@ def test_fleet_status_merges_raw_series_and_flags_sick_pools(
     assert merged["p99"] == pytest.approx(np.percentile(ref, 99))
     assert snap["slo"]["n_converged"] == 2
     by_src = {p["source"]: p for p in snap["pools"]}
+    # the execution-backend probe flows onto the pool row (round 21)
+    assert by_src[str(a)]["platform"] == "cpu"
+    assert by_src[str(a)]["native"] == "libgst_native.so not built"
+    assert by_src[str(a)]["scatter"] is True
     assert by_src[str(a)]["healthy"] is True
     assert by_src[str(b)]["healthy"] is False   # pool_failures > 0
     assert by_src[str(tmp_path / "gone.json")]["reachable"] is False
@@ -220,6 +227,8 @@ CANNED_TOP = {
     "nlanes": 64, "group": 16, "quantum": 5, "busy_lanes": 48,
     "free_groups": 1, "occupancy_now": 0.75, "occupancy": 0.8123,
     "queue_depth": 2, "staged": 1, "pipeline": True, "supervise": True,
+    "backend": {"platform": "cpu", "native": "registered (avx512f)",
+                "scatter": True},
     "faults": {"tenant_failures": 1, "quarantined_lanes": 0,
                "reinits": 0, "worker_restarts": 0, "pool_failures": 0},
     "watchdog": {"enabled": True, "policy": "dump", "state": "ok",
@@ -261,6 +270,7 @@ CANNED_TOP = {
 GOLDEN_TOP = (
     "serve_top  quanta=40 uptime=12s lanes=48/64 (75% now, 81.2% run)"
     " queue=2 staged=1 pipeline=on\n"
+    "backend: cpu native[registered (avx512f)] admission=scatter\n"
     "faults: tenant_failures=1\n"
     "watchdog: ok [policy dump] beats dispatch=0.1s drain=0.2s\n"
     "stages: hyper_mh 7.5ms/q(31%) tnt 3.0ms/q(12%)\n"

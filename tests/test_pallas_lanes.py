@@ -1,0 +1,223 @@
+"""Interpret-mode parity for the Pallas LANES twins (round 21).
+
+The serve slot pool's per-lane-consts dispatchers grew Pallas probe
+arms under the tile-uniform ``gid`` contract (ops/registry.py OPS:
+``tnt_lanes`` / ``white_lanes`` / ``fused_hyper_lanes`` /
+``chol_lanes``). On this CPU host the kernels run in interpret mode —
+``GST_PALLAS_*="interpret"`` forces the arm on below the batch floor —
+and the oracle is the SAME dispatcher with the gate pinned ``"0"``,
+which is exactly the fallback graph gates-off serving emits. Native
+arms are pinned off so the dispatch order cannot shadow the pair.
+
+Tolerances follow the existing interpret-mode kernel pins
+(tests/test_pallas_tnt.py): rtol=2e-4 / atol=1e-4 on f32 payloads,
+exact on accept counters.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tests.conftest import make_demo_pta
+
+LANES_GROUP = 16
+
+
+def _native_off(monkeypatch):
+    for k in ("GST_NCHOL", "GST_NWHITE", "GST_NHYPER",
+              "GST_FUSE_STAGES"):
+        monkeypatch.setenv(k, "0")
+
+
+def _gid(B):
+    return jnp.asarray(
+        np.repeat(np.arange(B // LANES_GROUP), LANES_GROUP)
+        .astype(np.int32))
+
+
+def test_tnt_lanes_pallas_interpret_parity(monkeypatch):
+    """tnt_gram_lanes: the Pallas arm (forced, interpret) against the
+    vmap_jnp fallback on a two-group tile-uniform lane batch — and the
+    spy proves the arm actually engaged rather than silently falling
+    through."""
+    from gibbs_student_t_tpu.ops import pallas_tnt
+    from gibbs_student_t_tpu.ops.linalg import tnt_gram_lanes
+
+    _native_off(monkeypatch)
+    B, n, m, G = 32, 96, 10, 2
+    rng = np.random.default_rng(0)
+    # per-GROUP bases repeated across each 16-lane tile (the admission
+    # granularity); nvec is chain state and varies per lane
+    Tg = rng.standard_normal((G, n, m)).astype(np.float32)
+    yg = rng.standard_normal((G, n)).astype(np.float32)
+    T = jnp.asarray(np.repeat(Tg, LANES_GROUP, axis=0))
+    y = jnp.asarray(np.repeat(yg, LANES_GROUP, axis=0))
+    nvec = jnp.asarray(
+        (10.0 ** rng.uniform(-1.5, 1.5, (B, n))).astype(np.float32))
+    gid = _gid(B)
+
+    monkeypatch.setenv("GST_PALLAS_TNT", "0")
+    ref = tnt_gram_lanes(T, y, nvec, gid)
+
+    hits = []
+    real = pallas_tnt.tnt_lanes_pallas
+
+    def spy(*a, **kw):
+        hits.append(kw.get("interpret"))
+        return real(*a, **kw)
+
+    monkeypatch.setattr(pallas_tnt, "tnt_lanes_pallas", spy)
+    monkeypatch.setenv("GST_PALLAS_TNT", "interpret")
+    out = tnt_gram_lanes(T, y, nvec, gid)
+    assert hits == [True]
+    assert len(out) == len(ref) == 3
+    for a, b in zip(out, ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=1e-4)
+
+
+def test_white_lanes_pallas_interpret_parity(monkeypatch):
+    """make_white_block_lanes under the serve vmap: the grouped Pallas
+    MH kernel (interpret) against the white_mh_loop_xla fallback on
+    identical draws — same state out, identical accept counters."""
+    from gibbs_student_t_tpu.ops.pallas_white import (
+        build_white_consts,
+        make_white_block_lanes,
+    )
+
+    _native_off(monkeypatch)
+    ma = make_demo_pta().frozen(0)
+    wc = build_white_consts(ma)
+    rng = np.random.default_rng(2)
+    B, S, p, n = 32, 6, ma.nparam, ma.n
+    x = jnp.asarray(np.stack([ma.x_init(rng) for _ in range(B)]),
+                    jnp.float32)
+    az = jnp.asarray(rng.uniform(0.5, 2.0, (B, n)), jnp.float32)
+    y2 = jnp.asarray(rng.uniform(0.0, 3.0, (B, n)), jnp.float32)
+    dx = jnp.asarray(rng.normal(0, 0.05, (B, S, p)), jnp.float32)
+    logu = jnp.asarray(np.log(rng.uniform(size=(B, S))), jnp.float32)
+    rows = jnp.asarray(np.repeat(wc.rows[None], B, 0), jnp.float32)
+    specs = jnp.asarray(np.repeat(wc.specs[None], B, 0), jnp.float32)
+    gid = _gid(B)
+
+    def run():
+        block = make_white_block_lanes(wc.var)
+        # the serve vmap shape: every operand mapped over the lane axis
+        return jax.vmap(block)(x, az, y2, dx, logu, rows, specs, gid)
+
+    monkeypatch.setenv("GST_PALLAS_WHITE", "0")
+    x0, a0 = run()
+    monkeypatch.setenv("GST_PALLAS_WHITE", "interpret")
+    x1, a1 = run()
+    np.testing.assert_allclose(np.asarray(x1), np.asarray(x0),
+                               rtol=2e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(a1), np.asarray(a0))
+
+
+def test_fused_hyper_lanes_pallas_interpret_parity(monkeypatch):
+    """The lanes megastage with hyper_core swapped for the grouped
+    Pallas MH kernel (interpret) against the per-stage jnp fallback —
+    identical per-lane consts operands and randomness (the
+    test_nchol.py fused_hyper_lanes construction at a 16-multiple
+    batch)."""
+    from gibbs_student_t_tpu.ops.linalg import (
+        _fused_hyper_lanes_dispatcher,
+    )
+
+    _native_off(monkeypatch)
+    rng = np.random.default_rng(1)
+    B, ns, nv, p, nk, S = 32, 4, 6, 8, 2, 3
+    dt = np.float32
+
+    def spd(k):
+        M = rng.standard_normal((B, k, k))
+        return (np.einsum("bij,bkj->bik", M, M)
+                + 5 * np.eye(k)).astype(dt)
+
+    A, C = spd(ns), spd(nv)
+    Bm = (0.1 * rng.standard_normal((B, ns, nv))).astype(dt)
+    rs = rng.standard_normal((B, ns)).astype(dt)
+    rv = rng.standard_normal((B, nv)).astype(dt)
+    x = rng.standard_normal((B, p)).astype(dt)
+    dx = (0.1 * rng.standard_normal((B, S, p))).astype(dt)
+    logu = np.log(rng.random((B, S))).astype(dt)
+    xi = rng.standard_normal((B, ns + nv)).astype(dt)
+    base0 = rng.standard_normal(B).astype(dt)
+    K = (0.3 * rng.standard_normal((1 + nk, nv))).astype(dt)
+    sel = (rng.random(nv) > 0.3).astype(dt)
+    phist = (rng.random(nv) * (1 - sel)).astype(dt)
+    specs = np.zeros((3, p), dt)
+    specs[1], specs[2] = -50, 50
+    fh = _fused_hyper_lanes_dispatcher((1, 4), 1e-6,
+                                       (1e-6, 1e-4, 1e-2, 1e-1))
+    args = [jnp.asarray(a)
+            for a in (A, Bm, C, rs, rv, x, dx, logu, xi, base0)]
+    consts = [jnp.asarray(np.broadcast_to(a, (B,) + a.shape).copy())
+              for a in (K, sel, phist, specs)]
+    gid = _gid(B)
+
+    monkeypatch.setenv("GST_PALLAS_HYPER", "0")
+    ref = fh(*args, *consts, gid)
+    monkeypatch.setenv("GST_PALLAS_HYPER", "interpret")
+    out = fh(*args, *consts, gid)
+    assert len(out) == len(ref) == 6
+    for a, b in zip(out, ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=1e-4)
+
+
+def test_chol_lanes_interpret_parity_and_degrade(monkeypatch):
+    """chol_fused_lanes / tri_solve_T_lanes: the gate-off arm degrades
+    cleanly to the ordinary factor dispatch (checked against the f64
+    oracle), and the forced interpret arm matches it."""
+    from gibbs_student_t_tpu.ops.pallas_chol import (
+        chol_fused_lanes,
+        tri_solve_T_lanes,
+    )
+
+    _native_off(monkeypatch)
+    rng = np.random.default_rng(3)
+    B, m = 32, 12
+    Mh = rng.standard_normal((B, m, 6))
+    S = (np.einsum("bij,bkj->bik", Mh, Mh)
+         + 5 * np.eye(m)).astype(np.float32)
+    rhs = rng.standard_normal((B, m)).astype(np.float32)
+    Sj, rj = jnp.asarray(S), jnp.asarray(rhs)
+    gid = _gid(B)
+
+    monkeypatch.setenv("GST_PALLAS_CHOL", "0")
+    L0, ld0, u0 = chol_fused_lanes(Sj, rj, gid)
+    Lref = np.linalg.cholesky(S.astype(np.float64))
+    np.testing.assert_allclose(np.asarray(L0), Lref,
+                               rtol=2e-4, atol=1e-4)
+    b0 = tri_solve_T_lanes(L0, u0, gid)
+
+    monkeypatch.setenv("GST_PALLAS_CHOL", "interpret")
+    L1, ld1, u1 = chol_fused_lanes(Sj, rj, gid)
+    b1 = tri_solve_T_lanes(L0, u0, gid)
+    np.testing.assert_allclose(np.asarray(L1), np.asarray(L0),
+                               rtol=2e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(ld1), np.asarray(ld0),
+                               rtol=2e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(u1), np.asarray(u0),
+                               rtol=2e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(b1), np.asarray(b0),
+                               rtol=2e-4, atol=1e-4)
+
+
+def test_chol_lanes_gid_contract_validation():
+    """The tile-uniform gid contract is validated loudly, before any
+    dispatch: shape-mismatched gid and ragged (non-16-multiple) lane
+    batches both raise."""
+    from gibbs_student_t_tpu.ops.pallas_chol import chol_fused_lanes
+
+    B, m = 32, 8
+    Sj = jnp.eye(m, dtype=jnp.float32) * 2.0
+    Sj = jnp.broadcast_to(Sj, (B, m, m))
+    rj = jnp.ones((B, m), jnp.float32)
+    with pytest.raises(ValueError, match="gid must be"):
+        chol_fused_lanes(Sj, rj, jnp.zeros((B, 2), jnp.int32))
+    with pytest.raises(ValueError, match="admission group"):
+        chol_fused_lanes(Sj[:24], rj[:24], jnp.zeros(24, jnp.int32))

@@ -650,3 +650,93 @@ def test_cancel_mid_staging_resolves(demo):
         assert not srv._prepared       # never placed
     finally:
         srv.close()
+
+
+# ---------------------------------------------------------------------------
+# device-resident admission (round 21, GST_SERVE_SCATTER)
+# ---------------------------------------------------------------------------
+
+def test_scatter_matches_bounce_bitwise(demo, monkeypatch):
+    """GST_SERVE_SCATTER=0 (the pre-round-21 host bounce) and the
+    device-scatter admission path are BITWISE interchangeable — same
+    deterministic three-tenant schedule, every result field identical
+    across the arms. The schedule deliberately drives every scatter
+    site: two boundary admissions, a lane fault quarantined on one
+    tenant and reinit'd on another (poison_lanes / reinit_lanes), and
+    a queued tenant admitted MID-FLIGHT (device-canonical state, the
+    narrow checkpoint read freeing the drained tenant's lanes)."""
+    from gibbs_student_t_tpu.serve import faults
+
+    ma, cfg = demo
+
+    def run_arm(flag):
+        monkeypatch.setenv("GST_SERVE_SCATTER", flag)
+        srv = ChainServer(ma, cfg, nlanes=32, quantum=5,
+                          record="full")
+        assert srv.pool.scatter is (flag == "1")
+        with faults.inject(
+                faults.FaultSpec("lane_nan", tenant="R", after=1),
+                faults.FaultSpec("lane_nan", tenant="Q", after=1)):
+            hR = srv.submit(TenantRequest(
+                ma=ma, niter=15, nchains=16, seed=1, name="R",
+                on_divergence="reinit"))
+            hQ = srv.submit(TenantRequest(
+                ma=ma, niter=20, nchains=16, seed=2, name="Q",
+                on_divergence="quarantine"))
+            # queued behind the full pool: admitted mid-flight when R
+            # drains, through whichever admission path the arm pins
+            hL = srv.submit(TenantRequest(
+                ma=ma, niter=10, nchains=16, seed=3, name="L"))
+            srv.run()
+        stats = srv.pool.admission_stats()
+        out = (hR.result(), hQ.result(), hL.result())
+        health = (hR.health, hQ.health)
+        srv.close()
+        return out, health, stats
+
+    res1, health1, st1 = run_arm("1")
+    res0, health0, st0 = run_arm("0")
+    assert st1["scatter"] is True and st0["scatter"] is False
+    assert st1["admits"] == st0["admits"] >= 3
+    assert health1[0]["n_reinits"] >= 1
+    assert health0[0]["n_reinits"] >= 1
+    assert health1[1]["n_quarantined"] >= 1
+    assert health0[1]["n_quarantined"] >= 1
+    # the bounce arm's mid-flight admission pulls the full mirror down
+    # and re-uploads it; the scatter arm ships only the lane deltas
+    assert st1["bytes_total"] < st0["bytes_total"]
+    for r1, r0 in zip(res1, res0):
+        for f in EXACT_FIELDS + ROUNDOFF_FIELDS:
+            a = np.asarray(getattr(r1, f))
+            b = np.asarray(getattr(r0, f))
+            # tobytes: literal bitwise, and NaN-proof (the injected
+            # lane fault leaves real NaNs in the victim's record)
+            assert a.shape == b.shape and a.dtype == b.dtype, f
+            assert a.tobytes() == b.tobytes(), f
+        assert np.array_equal(r1.stats["acc_white"],
+                              r0.stats["acc_white"])
+        assert np.array_equal(r1.stats["acc_hyper"],
+                              r0.stats["acc_hyper"])
+
+
+def test_tenant_wire_device_bitwise(demo):
+    """The device-compaction drain (tenant_wire_device, the wire A/B's
+    gather arm) returns byte-identical columns to the host-slice path
+    on the same dispatched records — a gather is a pure copy of the
+    tenant's rows."""
+    from gibbs_student_t_tpu.serve.pool import SlotPool, TenantSlot
+
+    ma, cfg = demo
+    pool = SlotPool(ma, cfg, nlanes=32, quantum=5, telemetry=False)
+    slot = TenantSlot(0, np.arange(pool.group), pool.group, 5, 0,
+                      ma.n, 0)
+    pool._active_np[slot.lanes] = True
+    recs, _tl, _ = pool.dispatch_quantum()
+    host_cols = pool.tenant_wire(pool.wire_host(recs), slot)
+    dev_cols = pool.tenant_wire_device(recs, slot)
+    assert set(host_cols) == set(dev_cols)
+    for f in host_cols:
+        a = np.asarray(host_cols[f])
+        b = np.asarray(dev_cols[f])
+        assert a.dtype == b.dtype and a.shape == b.shape, f
+        assert a.tobytes() == b.tobytes(), f

@@ -183,6 +183,30 @@ def print_report(ledger_recs, include_rounds=True):
                           f"p50={v.get('p50'):>8}ms "
                           f"p90={v.get('p90'):>8}ms "
                           f"max={v.get('max'):>8}ms")
+            # admission data-plane sub-line (round-21 records): the
+            # resolved write path + the scatter A/B sandwich verdict
+            adm = m.get("admission")
+            if isinstance(adm, dict):
+                ab = adm.get("ab") or {}
+                on = ab.get("on") or {}
+                off = ab.get("off") or {}
+                print(f"    admission scatter={adm.get('scatter')} "
+                      f"admits={adm.get('admits')} "
+                      f"bytes/admit={adm.get('bytes_per_admit')}"
+                      + ("" if not ab else
+                         f"; A/B apply p99 {on.get('apply_p99_ms')}ms"
+                         f" scatter vs {off.get('apply_p99_ms')}ms "
+                         f"bounce ({ab.get('apply_p99_speedup')}x), "
+                         f"bytes ratio "
+                         f"{ab.get('bytes_per_admit_ratio')}"))
+            wab = m.get("wire_ab")
+            if isinstance(wab, dict):
+                print(f"    wire_ab host-slice {wab.get('slice_ms')}ms"
+                      f" vs device-gather {wab.get('gather_ms')}ms "
+                      f"per drain "
+                      f"({wab.get('tenant_lanes')}/"
+                      f"{wab.get('pool_lanes')} lanes, bitwise_equal="
+                      f"{wab.get('bitwise_equal')})")
             # SLO sub-lines (round-13 records): the per-tenant latency
             # percentiles + the observability plane's measured price
             slo = m.get("slo") or {}
@@ -763,10 +787,11 @@ def check_faults(ledger_recs, max_fault_rate, min_fault_ratio):
     return 0
 
 
-def check_obs(ledger_recs, max_obs_overhead, max_admission_p99):
+def check_obs(ledger_recs, max_obs_overhead, max_admission_p99,
+              max_admission_apply_p99=None):
     """Observability gate over the latest ``serve_bench`` record.
 
-    Two legs, each skipped with a note when the record predates its
+    Legs, each skipped with a note when the record predates its
     field: ``obs_overhead`` (the plane-on vs plane-off A/B arm) must
     not exceed ``--max-obs-overhead`` percent — the plane's contract
     is that watching a server never costs meaningful throughput — and
@@ -774,7 +799,11 @@ def check_obs(ledger_recs, max_obs_overhead, max_admission_p99):
     ``--max-admission-p99`` ms (admission starving behind the
     boundary/staging work is the liveness regression the SLO surface
     exists to catch; queue-wait under deliberate backpressure is
-    included, hence the loose default)."""
+    included, hence the loose default). Round 21: the ``admission``
+    block's boundary apply-time p99 (the admission DATA plane — the
+    milliseconds a quantum boundary spends landing an admit into the
+    lane buffers, no queue-wait) must stay under
+    ``--max-admission-apply-p99`` ms."""
     serve = _flagship_serve(ledger_recs)
     if not serve:
         print("check: no serve_bench record — obs gate skipped")
@@ -806,6 +835,30 @@ def check_obs(ledger_recs, max_obs_overhead, max_admission_p99):
     else:
         print("check: slo admission p99 absent — admission gate "
               "skipped")
+    # prefer the A/B sandwich's warm scatter-arm p99: the headline
+    # arm's first in-window admit pays the scatter program's one-time
+    # compile, which is a cold-start, not the steady-state apply cost
+    # the gate grades
+    adm = m.get("admission") or {}
+    apply_p99 = (((adm.get("ab") or {}).get("on") or {})
+                 .get("apply_p99_ms"))
+    if not isinstance(apply_p99, (int, float)):
+        apply_p99 = (adm.get("apply_ms") or {}).get("p99")
+    if max_admission_apply_p99 is not None \
+            and isinstance(apply_p99, (int, float)):
+        print(f"check: admission apply p99 {apply_p99:.2f}ms "
+              f"(max {max_admission_apply_p99}ms)")
+        if apply_p99 > max_admission_apply_p99:
+            print(f"check: FAIL — admission boundary apply p99 "
+                  f"{apply_p99:.1f}ms > "
+                  f"{max_admission_apply_p99:.1f}ms (the admission "
+                  "data plane is stalling quantum boundaries; see "
+                  "the admission sub-line — a bounce-path record on "
+                  "a scatter-capable host, or a scatter regression)")
+            rc = 2
+    elif max_admission_apply_p99 is not None:
+        print("check: admission apply p99 absent (pre-round-21 "
+              "record) — apply gate skipped")
     return rc
 
 
@@ -1445,6 +1498,24 @@ def main(argv=None):
                          "~37s by design — hence the loose default: "
                          "this is a starvation guard, not a tuning "
                          "target)")
+    ap.add_argument("--max-admission-apply-p99", type=float,
+                    default=500.0, metavar="MS",
+                    help="admission data-plane gate (round 21): max "
+                         "tolerated boundary apply-time p99 (the ms "
+                         "a quantum boundary spends landing an admit "
+                         "into the lane buffers, no queue-wait) — "
+                         "reads the scatter A/B's warm on-arm p99 "
+                         "when the record carries one (the headline "
+                         "arm's first admit pays the scatter "
+                         "program's one-time compile), else the "
+                         "headline admission.apply_ms p99; skipped "
+                         "on pre-round-21 records. The default is "
+                         "sized for the graded 1-core host's "
+                         "flagship geometry, where even the A/B "
+                         "arm's p99 lands one lane-count-specific "
+                         "scatter compile (~340ms measured) — the "
+                         "steady-state applies sit at p50 "
+                         "~0.01ms)")
     ap.add_argument("--min-ess-per-core-s", type=float, default=0.0,
                     metavar="X",
                     help="capacity gate: minimum mean per-tenant "
@@ -1547,7 +1618,8 @@ def main(argv=None):
                                args.min_serve_ratio,
                                max_stage_growth=args.max_stage_growth)
         rc_obs = check_obs(recs, args.max_obs_overhead,
-                           args.max_admission_p99)
+                           args.max_admission_p99,
+                           args.max_admission_apply_p99)
         rc_faults = check_faults(recs, args.max_fault_rate,
                                  args.min_fault_ratio)
         rc_fleet = check_fleet(recs, args.min_fleet_ratio,

@@ -125,6 +125,15 @@ def main(argv=None):
                          "off arm is what prices it — obs_overhead in "
                          "the record, gated by perf_report "
                          "--max-obs-overhead)")
+    ap.add_argument("--no-scatter-arm", action="store_true",
+                    help="skip the GST_SERVE_SCATTER off/on/off A/B "
+                         "sandwich and the wire-drain micro-bench "
+                         "(round 21: the headline workload runs "
+                         "whatever the env resolves; the sandwich is "
+                         "what prices the device-resident admission "
+                         "path — the record's admission.ab sub-block, "
+                         "gated by perf_report "
+                         "--max-admission-apply-p99)")
     ap.add_argument("--evict-arm", action="store_true",
                     help="after the headline workload, repeat it with "
                          "on_converged='evict' on every tenant "
@@ -1039,6 +1048,134 @@ def main(argv=None):
               f"{faults_block['rejected_tenants']} rejected / "
               f"{faults_block['quarantined_lanes']} lanes quarantined",
               file=sys.stderr)
+    # ---- admission scatter A/B (round 21, GST_SERVE_SCATTER) ----------
+    # The same drift-corrected sandwich as the obs arm: bounce (off),
+    # scatter (on), bounce again — the ON arm compared against the
+    # MEAN of its bracketing OFF arms. A fresh ChainServer per arm
+    # resolves the gate at construction, so the env flip around
+    # run_workload() is the whole switch; plane-off arms so the
+    # admission timings aren't confounded with the obs cost.
+    admission_block = dict(summary.get("admission") or {})
+    wire_ab = None
+    if not args.no_scatter_arm:
+        def scatter_arm(val, tag):
+            prev = os.environ.get("GST_SERVE_SCATTER")
+            os.environ["GST_SERVE_SCATTER"] = val
+            try:
+                shandles, swall, ssummary = run_workload(obs=False)
+            finally:
+                if prev is None:
+                    os.environ.pop("GST_SERVE_SCATTER", None)
+                else:
+                    os.environ["GST_SERVE_SCATTER"] = prev
+            sbad = [h for h in shandles if h.status != "done"]
+            if sbad:
+                raise RuntimeError(
+                    f"{len(sbad)} tenant(s) failed in the scatter "
+                    f"({tag}) arm: "
+                    + "; ".join(str(h.error) for h in sbad[:3]))
+            adm = ssummary["admission"]
+            gap = ssummary["host_ms"]["dispatch_gap"] or {}
+            return {
+                "sweeps_per_s": round(
+                    ssummary["busy_chain_sweeps"] / swall, 1),
+                "apply_p50_ms": (adm["apply_ms"] or {}).get("p50"),
+                "apply_p99_ms": (adm["apply_ms"] or {}).get("p99"),
+                "bytes_per_admit": adm["bytes_per_admit"],
+                "dispatch_gap_p50_ms": gap.get("p50"),
+                "scatter": adm["scatter"],
+            }
+
+        sc_off_pre = scatter_arm("0", "bounce pre")
+        sc_on = scatter_arm("1", "scatter")
+        sc_off_post = scatter_arm("0", "bounce post")
+        if (not sc_on["scatter"] or sc_off_pre["scatter"]
+                or sc_off_post["scatter"]):
+            raise RuntimeError(
+                "scatter A/B arms resolved the wrong admission write "
+                "path (GST_SERVE_SCATTER did not reach the pool?)")
+
+        def _off_mean(k):
+            va, vb = sc_off_pre[k], sc_off_post[k]
+            return (None if va is None or vb is None
+                    else round((va + vb) / 2.0, 3))
+
+        sc_off = {k: _off_mean(k)
+                  for k in ("sweeps_per_s", "apply_p50_ms",
+                            "apply_p99_ms", "bytes_per_admit",
+                            "dispatch_gap_p50_ms")}
+        admission_block["ab"] = {
+            "on": sc_on,
+            "off": sc_off,
+            "off_pair_apply_p99_ms": [sc_off_pre["apply_p99_ms"],
+                                      sc_off_post["apply_p99_ms"]],
+            "apply_p99_speedup": (
+                round(sc_off["apply_p99_ms"] / sc_on["apply_p99_ms"], 3)
+                if sc_off["apply_p99_ms"] and sc_on["apply_p99_ms"]
+                else None),
+            "bytes_per_admit_ratio": (
+                round(sc_on["bytes_per_admit"]
+                      / sc_off["bytes_per_admit"], 4)
+                if sc_off["bytes_per_admit"]
+                and sc_on["bytes_per_admit"] is not None else None),
+        }
+        print(f"# admission A/B (drift-corrected sandwich): scatter "
+              f"apply p99 {sc_on['apply_p99_ms']} ms vs bounce "
+              f"{sc_off_pre['apply_p99_ms']}/"
+              f"{sc_off_post['apply_p99_ms']} (mean "
+              f"{sc_off['apply_p99_ms']}) — "
+              f"{admission_block['ab']['apply_p99_speedup']}x; bytes "
+              f"per admit {sc_on['bytes_per_admit']} vs "
+              f"{sc_off['bytes_per_admit']}", file=sys.stderr)
+
+        # ---- wire drain A/B: device compaction gather vs host slice --
+        # One quantum on a small pool, both drain paths on the SAME
+        # device records: the full-lane wire pull + host lane slice
+        # (the serving default) against the device-side gather that
+        # brings only the tenant's rows to host. Bitwise equality is
+        # asserted (a gather is a pure copy of the same rows); the
+        # timings land as a recorded arm, not a gate — on CPU the two
+        # are within noise, the gather arm is sized for PCIe hosts.
+        from gibbs_student_t_tpu.serve.pool import SlotPool, TenantSlot
+
+        wpool = SlotPool(template, cfg, nlanes=min(args.nlanes, 64),
+                         quantum=args.quantum, telemetry=False)
+        wslot = TenantSlot(0, np.arange(wpool.group), wpool.group,
+                           args.quantum, 0, template.n, args.seed)
+        wpool._active_np[wslot.lanes] = True
+        wrecs, _wtl, _ = wpool.dispatch_quantum()
+        host_cols = wpool.tenant_wire(wpool.wire_host(wrecs), wslot)
+        dev_cols = wpool.tenant_wire_device(wrecs, wslot)  # warm gather
+        wire_bitwise = all(
+            np.asarray(host_cols[f]).tobytes()
+            == np.asarray(dev_cols[f]).tobytes()
+            for f in host_cols)
+        if not wire_bitwise:
+            raise RuntimeError(
+                "wire A/B: the device compaction gather is not bitwise "
+                "the host slice drain")
+        wire_reps = 20
+        t0 = time.perf_counter()
+        for _ in range(wire_reps):
+            wpool.tenant_wire(wpool.wire_host(wrecs), wslot)
+        wire_slice_ms = (time.perf_counter() - t0) / wire_reps * 1e3
+        t0 = time.perf_counter()
+        for _ in range(wire_reps):
+            wpool.tenant_wire_device(wrecs, wslot)
+        wire_gather_ms = (time.perf_counter() - t0) / wire_reps * 1e3
+        wire_ab = {
+            "slice_ms": round(wire_slice_ms, 3),
+            "gather_ms": round(wire_gather_ms, 3),
+            "reps": wire_reps,
+            "pool_lanes": int(wpool.nlanes),
+            "tenant_lanes": int(wslot.nchains),
+            "bitwise_equal": bool(wire_bitwise),
+        }
+        print(f"# wire A/B: host slice {wire_ab['slice_ms']} ms vs "
+              f"device gather {wire_ab['gather_ms']} ms per quantum "
+              f"drain ({wslot.nchains}/{wpool.nlanes} lanes, bitwise "
+              f"equal)", file=sys.stderr)
+        del wpool, wrecs, host_cols, dev_cols
     line = {
         "metric": "serve_aggregate_chain_sweeps_per_s",
         "value": round(agg, 1),
@@ -1067,6 +1204,12 @@ def main(argv=None):
         # consecutive quantum dispatches — what attributes the
         # pipelining win (docs/SERVING.md)
         "host_ms": summary["host_ms"],
+        # admission data plane (round 21, GST_SERVE_SCATTER): the
+        # resolved write path + bytes/apply-time per admit, and —
+        # unless --no-scatter-arm — the drift-corrected off/on/off
+        # sandwich in the 'ab' sub-block, gated by perf_report
+        # --max-admission-apply-p99
+        "admission": admission_block,
         # SLO surface (round 13): per-tenant latency percentiles
         # (submit->admit, admit->first-result, submit->converged; ms
         # incl. p99) + per-tenant final streaming-monitor view + the
@@ -1116,6 +1259,10 @@ def main(argv=None):
         # admission p99 and jobs/h at equal delivered ESS, bounded
         # queue, structured sheds
         line["overload"] = overload_block
+    if wire_ab is not None:
+        # drain-path micro A/B (round 21): host full-lane wire pull +
+        # slice vs device-side compaction gather, bitwise-pinned
+        line["wire_ab"] = wire_ab
     if recycle_block is not None:
         line["recycle"] = recycle_block
     if model_cache_block is not None:

@@ -134,6 +134,18 @@ def _render_status(st, out):
           f"queue={st.get('queue_depth')} staged={st.get('staged')} "
           f"pipeline={'on' if st.get('pipeline') else 'off'}",
           file=out)
+    # backend pane (round 21): the pool's resolved execution backend
+    # — jax platform, native-FFI probe verdict (the probe-recorded
+    # reason when kernels degraded) and the admission write path —
+    # rendered only once the server reports the block (older
+    # status.json files stay renderable)
+    be = st.get("backend")
+    if isinstance(be, dict):
+        print(f"backend: {be.get('platform', '?')} "
+              f"native[{be.get('native', '?')}] "
+              f"admission="
+              f"{'scatter' if be.get('scatter') else 'bounce'}",
+              file=out)
     f = st.get("faults") or {}
     if any(f.values()):
         print("faults: " + " ".join(f"{k}={v}" for k, v in f.items()

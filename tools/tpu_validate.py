@@ -15,7 +15,12 @@ Stages:
 3. full batched sweep, Pallas chol on (default) vs off (GST_PALLAS_CHOL);
 4. Pallas TNT kernel vs XLA blocked reduction: parity + in-scan timing
    at the flagship and stress shapes;
-5. headline: BASELINE chain-sweeps/s through the real sample() driver.
+5. headline: BASELINE chain-sweeps/s through the real sample() driver;
+6. serve_smoke: one tiny tenant through the serving stack (submit ->
+   run -> drain) against a CPU ``JaxGibbs.sample`` reference — the
+   sampled-parameter fields are compared bitwise (the homogeneous-pool
+   parity contract; exact on a CPU host, reported per-field on an
+   accelerator where cross-platform float contraction differs).
 """
 
 from __future__ import annotations
@@ -203,6 +208,56 @@ def main():
         dt = _t.perf_counter() - t0
         return {"chain_sweeps_per_sec": round(200 / dt * 1024, 1),
                 "sweeps_per_sec_per_chain": round(200 / dt, 2)}
+
+    @stage("serve_smoke")
+    def _():
+        # one-command serving smoke (round 21): a tiny pool admits one
+        # tenant on whatever backend this host resolved (device-scatter
+        # admission included), serves it to completion, and the drained
+        # chains are compared against the single-model CPU reference.
+        # The sampled-parameter fields (chain/zchain/theta/df + accept
+        # stats) are the bitwise leg of the parity contract
+        # (docs/SERVING.md); per-TOA continuous fields report max
+        # error only.
+        from gibbs_student_t_tpu.backends import JaxGibbs
+        from gibbs_student_t_tpu.config import GibbsConfig
+        from gibbs_student_t_tpu.data.demo import make_demo_model_arrays
+        from gibbs_student_t_tpu.serve import ChainServer, TenantRequest
+
+        ma = make_demo_model_arrays(n=48, components=6, seed=7)
+        cfg = GibbsConfig(model="mixture")
+        quantum, niter, nchains = 5, 10, 16
+        srv = ChainServer(ma, cfg, nlanes=16, quantum=quantum,
+                          record="full")
+        h = srv.submit(TenantRequest(ma=ma, niter=niter,
+                                     nchains=nchains, seed=3,
+                                     name="smoke"))
+        srv.run()
+        res = h.result()
+        backend = srv.pool.backend_info()
+        srv.close()
+        with jax.default_device(jax.devices("cpu")[0]):
+            ref = JaxGibbs(ma, cfg, nchains=nchains,
+                           chunk_size=quantum, record="full")
+            rs = ref.sample(niter=niter, seed=3)
+        out = {"backend": backend, "exact": {}, "max_abs_err": {}}
+        for f in ("chain", "zchain", "thetachain", "dfchain"):
+            a = np.asarray(getattr(rs, f))
+            b = np.asarray(getattr(res, f))
+            out["exact"][f] = bool(np.array_equal(a, b))
+        for f in ("bchain", "alphachain", "poutchain"):
+            a = np.asarray(getattr(rs, f), np.float64)
+            b = np.asarray(getattr(res, f), np.float64)
+            out["max_abs_err"][f] = float(np.abs(a - b).max())
+        out["bitwise_sampled_fields"] = all(out["exact"].values())
+        if (jax.default_backend() == "cpu"
+                and not out["bitwise_sampled_fields"]):
+            # on a CPU host there is no cross-platform excuse: the
+            # homogeneous pool's parity contract is bitwise
+            raise AssertionError(
+                f"serve smoke lost bitwise parity on cpu: "
+                f"{out['exact']}")
+        return out
 
     flush()
     print(f"wrote {args.out}")
